@@ -1,0 +1,19 @@
+"""deeplearning4j_tpu — a TPU-native deep learning framework.
+
+A ground-up rebuild of the deeplearning4j stack (ND4J arrays, SameDiff
+autodiff, the DL4J layer/configuration API, model zoo, and distributed
+gradient sharing) designed for TPU hardware: arrays are XLA device buffers,
+ops lower to jax.numpy/lax and fuse under jit, networks compile to single
+XLA computations, and scaling rides jax.sharding meshes with ICI
+collectives instead of parameter servers / Aeron UDP.
+
+Top-level convenience re-exports mirror the reference's most-used entry
+points (reference: org.nd4j.linalg.factory.Nd4j,
+org.deeplearning4j.nn.multilayer.MultiLayerNetwork, ...).
+"""
+
+from deeplearning4j_tpu.ndarray import INDArray, Nd4j, DataType
+
+__version__ = "0.1.0"
+
+__all__ = ["INDArray", "Nd4j", "DataType", "__version__"]

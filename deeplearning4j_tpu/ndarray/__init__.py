@@ -1,0 +1,14 @@
+"""Array layer: INDArray + Nd4j factory over XLA device buffers.
+
+Reference modules: nd4j-api (org.nd4j.linalg.api.ndarray,
+org.nd4j.linalg.factory, org.nd4j.linalg.indexing) with libnd4j replaced
+by XLA as the kernel library.
+"""
+
+from deeplearning4j_tpu.ndarray.dtype import DataType
+from deeplearning4j_tpu.ndarray.ndarray import INDArray
+from deeplearning4j_tpu.ndarray.factory import Nd4j
+from deeplearning4j_tpu.ndarray.indexing import NDArrayIndex
+from deeplearning4j_tpu.ndarray.executioner import XlaExecutioner
+
+__all__ = ["DataType", "INDArray", "Nd4j", "NDArrayIndex", "XlaExecutioner"]

@@ -1,0 +1,92 @@
+"""Data types for the array layer.
+
+Reference: org.nd4j.linalg.api.buffer.DataType — ND4J's dtype enum backs
+typed C++ buffers in libnd4j. Here a DataType is a thin name wrapper over a
+numpy/jax dtype; XLA owns the buffer layout. BFLOAT16 is first-class (the
+TPU MXU native matmul type) rather than an afterthought like HALF on CUDA.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class DataType:
+    """Enum-like dtype registry, convertible to/from jax dtypes."""
+
+    _registry: dict[str, "DataType"] = {}
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = jnp.dtype(np_dtype)
+        DataType._registry[name] = self
+
+    def __repr__(self) -> str:
+        return f"DataType.{self.name}"
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, DataType):
+            return self.name == other.name
+        try:
+            return self.np_dtype == jnp.dtype(other)
+        except TypeError:
+            return NotImplemented
+
+    def __hash__(self) -> int:
+        return hash(self.name)
+
+    def is_floating(self) -> bool:
+        return jnp.issubdtype(self.np_dtype, jnp.floating)
+
+    def is_integer(self) -> bool:
+        return jnp.issubdtype(self.np_dtype, jnp.integer)
+
+    @staticmethod
+    def from_dtype(dt) -> "DataType":
+        if isinstance(dt, DataType):
+            return dt
+        dt = jnp.dtype(dt)
+        for v in DataType._registry.values():
+            if v.np_dtype == dt:
+                return v
+        raise ValueError(f"No DataType for dtype {dt}")
+
+
+DataType.FLOAT = DataType("FLOAT", jnp.float32)
+DataType.DOUBLE = DataType("DOUBLE", jnp.float64)
+DataType.HALF = DataType("HALF", jnp.float16)
+DataType.BFLOAT16 = DataType("BFLOAT16", jnp.bfloat16)
+DataType.INT8 = DataType("INT8", jnp.int8)
+DataType.INT16 = DataType("INT16", jnp.int16)
+DataType.INT32 = DataType("INT32", jnp.int32)
+DataType.INT64 = DataType("INT64", jnp.int64)
+DataType.UINT8 = DataType("UINT8", jnp.uint8)
+DataType.UINT16 = DataType("UINT16", jnp.uint16)
+DataType.UINT32 = DataType("UINT32", jnp.uint32)
+DataType.UINT64 = DataType("UINT64", jnp.uint64)
+DataType.BOOL = DataType("BOOL", jnp.bool_)
+
+# Aliases used throughout the reference API surface (registered so the
+# string forms resolve too, e.g. castTo("LONG")).
+for _alias, _target in [
+    ("INT", DataType.INT32),
+    ("LONG", DataType.INT64),
+    ("FLOAT32", DataType.FLOAT),
+    ("FLOAT64", DataType.DOUBLE),
+    ("FLOAT16", DataType.HALF),
+]:
+    setattr(DataType, _alias, _target)
+    DataType._registry[_alias] = _target
+
+
+def resolve(dt) -> jnp.dtype:
+    """Any of DataType / str / np dtype / jnp dtype -> jnp dtype."""
+    if isinstance(dt, DataType):
+        return dt.np_dtype
+    if isinstance(dt, str) and dt.upper() in DataType._registry:
+        return DataType._registry[dt.upper()].np_dtype
+    return jnp.dtype(dt)
+
+
+np  # re-exported for convenience of importers

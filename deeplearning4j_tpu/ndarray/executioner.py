@@ -1,0 +1,75 @@
+"""XlaExecutioner — op execution environment.
+
+Reference: org.nd4j.linalg.api.ops.executioner.OpExecutioner and its
+backends (NativeOpExecutioner dispatching into libnd4j, CudaExecutioner
+into CUDA kernels + streams). There is no per-op kernel dispatch to
+replicate on TPU: eager jax.numpy calls already execute compiled XLA
+programs, and jitted callables fuse whole graphs. What remains useful from
+the executioner abstraction is (a) an execution-environment handle
+(profiling mode, device info, sync), (b) a jit cache keyed by function, and
+(c) commit/sync barriers for timing.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+
+
+class XlaExecutioner:
+    _instance = None
+
+    def __init__(self):
+        self._profiling = False
+        self._jit_cache: dict = {}
+
+    @classmethod
+    def instance(cls) -> "XlaExecutioner":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    # ----- environment -----------------------------------------------
+    def devices(self):
+        return jax.devices()
+
+    def deviceCount(self) -> int:
+        return jax.device_count()
+
+    def platform(self) -> str:
+        return jax.default_backend()
+
+    def enableProfiling(self, flag: bool = True) -> None:
+        self._profiling = flag
+
+    # ----- execution --------------------------------------------------
+    _JIT_CACHE_MAX = 256
+
+    def exec(self, fn, *args, static_argnums=(), donate_argnums=(), **kw):
+        """Execute fn as a single fused XLA computation (jit-cached).
+
+        Keyed on function identity — pass a stable function, not a fresh
+        lambda per call, to hit the cache. FIFO-bounded so closure-churn
+        can't grow memory without limit.
+        """
+        key = (fn, tuple(static_argnums), tuple(donate_argnums))
+        if key not in self._jit_cache:
+            if len(self._jit_cache) >= self._JIT_CACHE_MAX:
+                self._jit_cache.pop(next(iter(self._jit_cache)))
+            self._jit_cache[key] = jax.jit(
+                fn, static_argnums=static_argnums, donate_argnums=donate_argnums
+            )
+        jitted = self._jit_cache[key]
+        if self._profiling:
+            t0 = time.perf_counter()
+            out = jax.block_until_ready(jitted(*args, **kw))
+            print(f"[XlaExecutioner] {getattr(fn, '__name__', fn)}: "
+                  f"{(time.perf_counter() - t0) * 1e3:.3f} ms")
+            return out
+        return jitted(*args, **kw)
+
+    def commit(self) -> None:
+        """Synchronisation barrier (reference: stream sync / flushQueue)."""
+        for d in jax.live_arrays():
+            d.block_until_ready()

@@ -1,0 +1,234 @@
+"""Nd4j — the static array factory.
+
+Reference: org.nd4j.linalg.factory.Nd4j. The reference factory allocates
+typed DataBuffers on the active backend (nd4j-native heap / nd4j-cuda
+device). Here creation lowers to jax.numpy, so arrays materialise directly
+as XLA device buffers on the default device (TPU HBM), and dtype defaults
+to float32 with an overridable global default like Nd4j.setDefaultDataTypes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ndarray.dtype import DataType, resolve
+from deeplearning4j_tpu.ndarray.ndarray import INDArray, _unwrap
+from deeplearning4j_tpu.ndarray import random as _random
+
+
+class Nd4j:
+    _default_dtype = DataType.FLOAT
+
+    # ----- dtype config ----------------------------------------------
+    @staticmethod
+    def setDefaultDataTypes(dtype, *_):
+        Nd4j._default_dtype = DataType.from_dtype(resolve(dtype))
+
+    @staticmethod
+    def defaultFloatingPointType() -> DataType:
+        return Nd4j._default_dtype
+
+    @staticmethod
+    def dataType() -> DataType:
+        return Nd4j._default_dtype
+
+    @staticmethod
+    def _dt(dtype):
+        return resolve(dtype) if dtype is not None else Nd4j._default_dtype.np_dtype
+
+    # ----- creation ---------------------------------------------------
+    @staticmethod
+    def create(data=None, *more, shape=None, dtype=None) -> INDArray:
+        """Nd4j.create(data), Nd4j.create(rows, cols, ...), Nd4j.create(data, shape)."""
+        if data is None and shape is not None:
+            return Nd4j.zeros(*shape, dtype=dtype)
+        if isinstance(data, int):
+            # Nd4j.create(2, 3) — zero-filled array of that shape
+            return Nd4j.zeros(data, *more, dtype=dtype)
+        if more and shape is None and isinstance(more[0], (tuple, list)):
+            shape = tuple(more[0])
+        arr = jnp.asarray(_unwrap(data))
+        if jnp.issubdtype(arr.dtype, jnp.floating) and dtype is None:
+            arr = arr.astype(Nd4j._dt(None))
+        elif dtype is not None:
+            arr = arr.astype(resolve(dtype))
+        if shape is not None:
+            arr = arr.reshape(shape)
+        return INDArray(arr)
+
+    @staticmethod
+    def createFromArray(*values) -> INDArray:
+        if len(values) == 1 and isinstance(values[0], (list, tuple, np.ndarray)):
+            values = values[0]
+        return Nd4j.create(np.asarray(values))
+
+    @staticmethod
+    def zeros(*shape, dtype=None) -> INDArray:
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return INDArray(jnp.zeros(shape, dtype=Nd4j._dt(dtype)))
+
+    @staticmethod
+    def ones(*shape, dtype=None) -> INDArray:
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return INDArray(jnp.ones(shape, dtype=Nd4j._dt(dtype)))
+
+    @staticmethod
+    def zerosLike(arr) -> INDArray:
+        return INDArray(jnp.zeros_like(_unwrap(arr)))
+
+    @staticmethod
+    def onesLike(arr) -> INDArray:
+        return INDArray(jnp.ones_like(_unwrap(arr)))
+
+    @staticmethod
+    def empty(dtype=None) -> INDArray:
+        return INDArray(jnp.zeros((0,), dtype=Nd4j._dt(dtype)))
+
+    @staticmethod
+    def scalar(value, dtype=None) -> INDArray:
+        return INDArray(jnp.asarray(value, dtype=Nd4j._dt(dtype) if dtype or not isinstance(value, bool) else jnp.bool_))
+
+    @staticmethod
+    def valueArrayOf(shape, value, dtype=None) -> INDArray:
+        if isinstance(shape, int):
+            shape = (shape,)
+        return INDArray(jnp.full(tuple(shape), value, dtype=Nd4j._dt(dtype)))
+
+    @staticmethod
+    def eye(n: int, dtype=None) -> INDArray:
+        return INDArray(jnp.eye(n, dtype=Nd4j._dt(dtype)))
+
+    @staticmethod
+    def diag(v) -> INDArray:
+        return INDArray(jnp.diag(_unwrap(v).reshape(-1) if _unwrap(v).ndim != 2 else _unwrap(v)))
+
+    @staticmethod
+    def linspace(start, stop, num, dtype=None) -> INDArray:
+        return INDArray(jnp.linspace(start, stop, int(num), dtype=Nd4j._dt(dtype)))
+
+    @staticmethod
+    def arange(*args, dtype=None) -> INDArray:
+        return INDArray(jnp.arange(*args, dtype=dtype if dtype is None else resolve(dtype)).astype(Nd4j._dt(dtype)))
+
+    # ----- random (reference: Nd4j.rand/randn via backend RNG) --------
+    @staticmethod
+    def rand(*shape, dtype=None, seed=None) -> INDArray:
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return INDArray(_random.uniform(shape, Nd4j._dt(dtype), seed=seed))
+
+    @staticmethod
+    def randn(*shape, dtype=None, seed=None) -> INDArray:
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return INDArray(_random.normal(shape, Nd4j._dt(dtype), seed=seed))
+
+    @staticmethod
+    def getRandom():
+        return _random.getRandom()
+
+    # ----- joining / splitting ---------------------------------------
+    @staticmethod
+    def concat(dimension: int, *arrs) -> INDArray:
+        return INDArray(jnp.concatenate([_unwrap(a) for a in arrs], axis=dimension))
+
+    @staticmethod
+    def vstack(*arrs) -> INDArray:
+        return INDArray(jnp.vstack([_unwrap(a) for a in arrs]))
+
+    @staticmethod
+    def hstack(*arrs) -> INDArray:
+        return INDArray(jnp.hstack([_unwrap(a) for a in arrs]))
+
+    @staticmethod
+    def stack(dimension: int, *arrs) -> INDArray:
+        return INDArray(jnp.stack([_unwrap(a) for a in arrs], axis=dimension))
+
+    @staticmethod
+    def pile(*arrs) -> INDArray:
+        return INDArray(jnp.stack([_unwrap(a) for a in arrs], axis=0))
+
+    @staticmethod
+    def tile(arr, *reps) -> INDArray:
+        if len(reps) == 1 and isinstance(reps[0], (tuple, list)):
+            reps = tuple(reps[0])
+        return INDArray(jnp.tile(_unwrap(arr), reps))
+
+    @staticmethod
+    def repeat(arr, repeats: int, axis: int = 0) -> INDArray:
+        return INDArray(jnp.repeat(_unwrap(arr), repeats, axis=axis))
+
+    # ----- misc ops ---------------------------------------------------
+    @staticmethod
+    def where(condition, x=None, y=None):
+        cond = _unwrap(condition)
+        if x is None:
+            return [INDArray(i) for i in jnp.where(cond)]
+        return INDArray(jnp.where(cond, _unwrap(x), _unwrap(y)))
+
+    @staticmethod
+    def sort(arr, dimension: int = -1, ascending: bool = True) -> INDArray:
+        s = jnp.sort(_unwrap(arr), axis=dimension)
+        if not ascending:
+            s = jnp.flip(s, axis=dimension)
+        return INDArray(s)
+
+    @staticmethod
+    def argsort(arr, dimension: int = -1, ascending: bool = True) -> INDArray:
+        s = jnp.argsort(_unwrap(arr), axis=dimension)
+        if not ascending:
+            s = jnp.flip(s, axis=dimension)
+        return INDArray(s)
+
+    @staticmethod
+    def reverse(arr, *dimension) -> INDArray:
+        if len(dimension) == 1 and isinstance(dimension[0], (tuple, list)):
+            dimension = tuple(dimension[0])
+        dims = tuple(int(d) for d in dimension) if dimension else None
+        return INDArray(jnp.flip(_unwrap(arr), axis=dims))
+
+    @staticmethod
+    def gemm(a, b, transposeA: bool = False, transposeB: bool = False, alpha: float = 1.0, beta: float = 0.0, c=None) -> INDArray:
+        """General matrix multiply (reference: cuBLAS sgemm → MXU dot)."""
+        A = _unwrap(a).T if transposeA else _unwrap(a)
+        B = _unwrap(b).T if transposeB else _unwrap(b)
+        out = alpha * jnp.matmul(A, B)
+        if c is not None and beta != 0.0:
+            out = out + beta * _unwrap(c)
+        return INDArray(out)
+
+    @staticmethod
+    def matmul(a, b) -> INDArray:
+        return INDArray(jnp.matmul(_unwrap(a), _unwrap(b)))
+
+    @staticmethod
+    def expandDims(arr, axis: int) -> INDArray:
+        return INDArray(jnp.expand_dims(_unwrap(arr), axis))
+
+    @staticmethod
+    def squeeze(arr, axis: int) -> INDArray:
+        return INDArray(jnp.squeeze(_unwrap(arr), axis=axis))
+
+    @staticmethod
+    def pad(arr, pad_width, mode: str = "constant", constant_values=0) -> INDArray:
+        return INDArray(jnp.pad(_unwrap(arr), pad_width, mode=mode,
+                                **({"constant_values": constant_values} if mode == "constant" else {})))
+
+    @staticmethod
+    def max(a, b) -> INDArray:
+        return INDArray(jnp.maximum(_unwrap(a), _unwrap(b)))
+
+    @staticmethod
+    def min(a, b) -> INDArray:
+        return INDArray(jnp.minimum(_unwrap(a), _unwrap(b)))
+
+    # ----- executioner / env (reference: Nd4j.getExecutioner()) -------
+    @staticmethod
+    def getExecutioner():
+        from deeplearning4j_tpu.ndarray.executioner import XlaExecutioner
+
+        return XlaExecutioner.instance()

@@ -1,0 +1,541 @@
+"""INDArray — the n-dimensional array of the framework.
+
+Reference surface: org.nd4j.linalg.api.ndarray.INDArray (nd4j-api). In the
+reference, an INDArray owns a typed DataBuffer and every op dispatches
+through an OpExecutioner into libnd4j C++/CUDA kernels. Here the payload is
+a jax.Array: an XLA device buffer resident in TPU HBM. Ops lower to
+jax.numpy / lax eagerly; anything called under jax.jit traces and fuses
+into a single XLA computation, which is what replaces the libnd4j kernel
+library and its hand-written fusion.
+
+Mutation semantics: the reference has true in-place ops (addi, assign,
+putScalar) on mutable buffers. XLA buffers are immutable, so the *wrapper*
+is the unit of identity: in-place methods rebind ``self._jx`` to the new
+buffer and return ``self``. Under donation in jitted train steps XLA reuses
+the memory, so the performance-motivated uses of in-place survive.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ndarray.dtype import DataType, resolve
+
+
+def _unwrap(x):
+    return x._jx if isinstance(x, INDArray) else x
+
+
+def _dims(dimension) -> tuple[int, ...] | None:
+    """Normalise the reference's `int... dimension` varargs."""
+    if len(dimension) == 0:
+        return None
+    if len(dimension) == 1 and isinstance(dimension[0], (tuple, list)):
+        return tuple(dimension[0])
+    return tuple(int(d) for d in dimension)
+
+
+class INDArray:
+    """N-dimensional array backed by an XLA device buffer."""
+
+    __slots__ = ("_jx",)
+    # Let INDArray win in  np_array + indarray  style expressions.
+    __array_priority__ = 100
+
+    def __init__(self, data):
+        if isinstance(data, INDArray):
+            self._jx = data._jx
+        elif isinstance(data, jax.Array):
+            self._jx = data
+        else:
+            self._jx = jnp.asarray(data)
+
+    # ----- structure -------------------------------------------------
+    def shape(self) -> tuple[int, ...]:
+        return tuple(self._jx.shape)
+
+    def rank(self) -> int:
+        return self._jx.ndim
+
+    def length(self) -> int:
+        return int(self._jx.size)
+
+    def size(self, dimension: int) -> int:
+        return int(self._jx.shape[dimension])
+
+    def rows(self) -> int:
+        return self.size(0)
+
+    def columns(self) -> int:
+        return self.size(1)
+
+    def dataType(self) -> DataType:
+        return DataType.from_dtype(self._jx.dtype)
+
+    def isScalar(self) -> bool:
+        return self._jx.ndim == 0 or self._jx.size == 1
+
+    def isVector(self) -> bool:
+        return self._jx.ndim == 1 or (
+            self._jx.ndim == 2 and 1 in self._jx.shape
+        )
+
+    def isRowVector(self) -> bool:
+        return self._jx.ndim == 1 or (self._jx.ndim == 2 and self._jx.shape[0] == 1)
+
+    def isColumnVector(self) -> bool:
+        return self._jx.ndim == 2 and self._jx.shape[1] == 1
+
+    def isMatrix(self) -> bool:
+        return self._jx.ndim == 2
+
+    def isEmpty(self) -> bool:
+        return self._jx.size == 0
+
+    def ordering(self) -> str:
+        return "c"
+
+    # ----- conversion ------------------------------------------------
+    def toNumpy(self) -> np.ndarray:
+        return np.asarray(self._jx)
+
+    def jax(self) -> jax.Array:
+        """Escape hatch to the underlying buffer (TPU-native extension)."""
+        return self._jx
+
+    def castTo(self, dtype) -> "INDArray":
+        return INDArray(self._jx.astype(resolve(dtype)))
+
+    def dup(self) -> "INDArray":
+        return INDArray(jnp.array(self._jx, copy=True))
+
+    def detach(self) -> "INDArray":
+        return INDArray(jax.lax.stop_gradient(self._jx))
+
+    def assign(self, other) -> "INDArray":
+        other = _unwrap(other)
+        self._jx = jnp.broadcast_to(jnp.asarray(other, dtype=self._jx.dtype), self._jx.shape)
+        return self
+
+    # ----- scalar access ---------------------------------------------
+    def getScalar(self, *indices) -> "INDArray":
+        return INDArray(self._jx[tuple(int(i) for i in indices)])
+
+    def getDouble(self, *indices) -> float:
+        if not indices:
+            return float(self._jx.reshape(-1)[0])
+        if len(indices) == 1 and self._jx.ndim > 1:
+            # linear index, matching the reference's flat getDouble(long)
+            return float(self._jx.reshape(-1)[int(indices[0])])
+        return float(self._jx[tuple(int(i) for i in indices)])
+
+    def getFloat(self, *indices) -> float:
+        return self.getDouble(*indices)
+
+    def getInt(self, *indices) -> int:
+        return int(self._jx[tuple(int(i) for i in indices)])
+
+    def putScalar(self, *args) -> "INDArray":
+        *indices, value = args
+        if len(indices) == 1 and isinstance(indices[0], (tuple, list)):
+            indices = list(indices[0])
+        if len(indices) == 1 and self._jx.ndim > 1:
+            # linear index into the flattened array, like the reference
+            i = int(indices[0])
+            if not -self._jx.size <= i < self._jx.size:
+                raise IndexError(f"putScalar index {i} out of bounds for length {self._jx.size}")
+            flat = self._jx.reshape(-1).at[i].set(value)
+            self._jx = flat.reshape(self._jx.shape)
+        else:
+            # XLA scatter drops out-of-bounds updates silently; the reference
+            # throws, so bounds-check host-side.
+            idx = tuple(int(i) for i in indices)
+            for i, n in zip(idx, self._jx.shape):
+                if not -n <= i < n:
+                    raise IndexError(f"putScalar index {idx} out of bounds for shape {self.shape()}")
+            self._jx = self._jx.at[idx].set(value)
+        return self
+
+    # ----- elementwise arithmetic ------------------------------------
+    def _binary(self, other, fn) -> "INDArray":
+        return INDArray(fn(self._jx, _unwrap(other)))
+
+    def add(self, other) -> "INDArray":
+        return self._binary(other, jnp.add)
+
+    def sub(self, other) -> "INDArray":
+        return self._binary(other, jnp.subtract)
+
+    def mul(self, other) -> "INDArray":
+        return self._binary(other, jnp.multiply)
+
+    def div(self, other) -> "INDArray":
+        return self._binary(other, jnp.divide)
+
+    def rsub(self, other) -> "INDArray":
+        return INDArray(jnp.subtract(_unwrap(other), self._jx))
+
+    def rdiv(self, other) -> "INDArray":
+        return INDArray(jnp.divide(_unwrap(other), self._jx))
+
+    def addi(self, other) -> "INDArray":
+        self._jx = jnp.add(self._jx, _unwrap(other))
+        return self
+
+    def subi(self, other) -> "INDArray":
+        self._jx = jnp.subtract(self._jx, _unwrap(other))
+        return self
+
+    def muli(self, other) -> "INDArray":
+        self._jx = jnp.multiply(self._jx, _unwrap(other))
+        return self
+
+    def divi(self, other) -> "INDArray":
+        self._jx = jnp.divide(self._jx, _unwrap(other))
+        return self
+
+    def rsubi(self, other) -> "INDArray":
+        self._jx = jnp.subtract(_unwrap(other), self._jx)
+        return self
+
+    def rdivi(self, other) -> "INDArray":
+        self._jx = jnp.divide(_unwrap(other), self._jx)
+        return self
+
+    def neg(self) -> "INDArray":
+        return INDArray(jnp.negative(self._jx))
+
+    def negi(self) -> "INDArray":
+        self._jx = jnp.negative(self._jx)
+        return self
+
+    def fmod(self, other) -> "INDArray":
+        return self._binary(other, jnp.fmod)
+
+    # Python operator sugar (the reference is Java; in Python these are
+    # the idiomatic entry points and tests/users rely on them).
+    __add__ = add
+    __radd__ = add
+    __sub__ = sub
+    __mul__ = mul
+    __rmul__ = mul
+    __truediv__ = div
+    __rsub__ = rsub
+    __rtruediv__ = rdiv
+    __neg__ = neg
+
+    def __matmul__(self, other) -> "INDArray":
+        return self.mmul(other)
+
+    def __pow__(self, p) -> "INDArray":
+        return INDArray(jnp.power(self._jx, _unwrap(p)))
+
+    # ----- comparison (BOOL results, like modern nd4j) ----------------
+    def eq(self, other) -> "INDArray":
+        return self._binary(other, jnp.equal)
+
+    def neq(self, other) -> "INDArray":
+        return self._binary(other, jnp.not_equal)
+
+    def gt(self, other) -> "INDArray":
+        return self._binary(other, jnp.greater)
+
+    def gte(self, other) -> "INDArray":
+        return self._binary(other, jnp.greater_equal)
+
+    def lt(self, other) -> "INDArray":
+        return self._binary(other, jnp.less)
+
+    def lte(self, other) -> "INDArray":
+        return self._binary(other, jnp.less_equal)
+
+    __eq__ = eq  # matches INDArray.eq broadcasting semantics
+    __ne__ = neq
+    __gt__ = gt
+    __ge__ = gte
+    __lt__ = lt
+    __le__ = lte
+    __hash__ = None
+
+    def equals(self, other) -> bool:
+        """Value equality (reference INDArray.equals: shape + values)."""
+        other = _unwrap(other)
+        if tuple(jnp.shape(other)) != self.shape():
+            return False
+        return bool(jnp.allclose(self._jx, other, rtol=1e-5, atol=1e-5))
+
+    # ----- linear algebra --------------------------------------------
+    def mmul(self, other) -> "INDArray":
+        """Matrix multiply on the MXU (reference: cuBLAS gemm)."""
+        return INDArray(jnp.matmul(self._jx, _unwrap(other)))
+
+    def tensorMmul(self, other, axes) -> "INDArray":
+        return INDArray(jnp.tensordot(self._jx, _unwrap(other), axes=axes))
+
+    def transpose(self) -> "INDArray":
+        return INDArray(self._jx.T)
+
+    def permute(self, *order) -> "INDArray":
+        return INDArray(jnp.transpose(self._jx, _dims(order)))
+
+    def swapAxes(self, a: int, b: int) -> "INDArray":
+        return INDArray(jnp.swapaxes(self._jx, a, b))
+
+    # ----- shape ops --------------------------------------------------
+    def reshape(self, *shape) -> "INDArray":
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return INDArray(self._jx.reshape(shape))
+
+    def ravel(self) -> "INDArray":
+        return INDArray(self._jx.reshape(-1))
+
+    def flatten(self) -> "INDArray":
+        return self.ravel()
+
+    def broadcast(self, *shape) -> "INDArray":
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return INDArray(jnp.broadcast_to(self._jx, shape))
+
+    def repeat(self, dimension: int, repeats: int) -> "INDArray":
+        return INDArray(jnp.repeat(self._jx, repeats, axis=dimension))
+
+    def squeeze(self, axis=None) -> "INDArray":
+        return INDArray(jnp.squeeze(self._jx, axis=axis))
+
+    def expandDims(self, axis: int) -> "INDArray":
+        return INDArray(jnp.expand_dims(self._jx, axis))
+
+    # ----- reductions -------------------------------------------------
+    def _reduce(self, fn, dimension, keepDims=False, **kw) -> "INDArray":
+        axes = _dims(dimension)
+        return INDArray(fn(self._jx, axis=axes, keepdims=keepDims, **kw))
+
+    def sum(self, *dimension, keepDims: bool = False) -> "INDArray":
+        return self._reduce(jnp.sum, dimension, keepDims)
+
+    def mean(self, *dimension, keepDims: bool = False) -> "INDArray":
+        return self._reduce(jnp.mean, dimension, keepDims)
+
+    def prod(self, *dimension, keepDims: bool = False) -> "INDArray":
+        return self._reduce(jnp.prod, dimension, keepDims)
+
+    def max(self, *dimension, keepDims: bool = False) -> "INDArray":
+        return self._reduce(jnp.max, dimension, keepDims)
+
+    def min(self, *dimension, keepDims: bool = False) -> "INDArray":
+        return self._reduce(jnp.min, dimension, keepDims)
+
+    def std(self, *dimension, biasCorrected: bool = True, keepDims: bool = False) -> "INDArray":
+        # Reference default is the bias-corrected sample std (n-1).
+        return self._reduce(jnp.std, dimension, keepDims, ddof=1 if biasCorrected else 0)
+
+    def var(self, *dimension, biasCorrected: bool = True, keepDims: bool = False) -> "INDArray":
+        return self._reduce(jnp.var, dimension, keepDims, ddof=1 if biasCorrected else 0)
+
+    def norm1(self, *dimension, keepDims: bool = False) -> "INDArray":
+        axes = _dims(dimension)
+        return INDArray(jnp.sum(jnp.abs(self._jx), axis=axes, keepdims=keepDims))
+
+    def norm2(self, *dimension, keepDims: bool = False) -> "INDArray":
+        axes = _dims(dimension)
+        return INDArray(jnp.sqrt(jnp.sum(jnp.square(self._jx), axis=axes, keepdims=keepDims)))
+
+    def normmax(self, *dimension, keepDims: bool = False) -> "INDArray":
+        axes = _dims(dimension)
+        return INDArray(jnp.max(jnp.abs(self._jx), axis=axes, keepdims=keepDims))
+
+    def _arg_reduce(self, fn, dimension) -> "INDArray":
+        axes = _dims(dimension)
+        if axes is None or len(axes) == 1:
+            return INDArray(fn(self._jx, axis=None if axes is None else axes[0]))
+        # multiple dims: collapse them to one trailing axis; the result is a
+        # linear index within the combined dims (reference argMax(int...)).
+        axes = tuple(a % self._jx.ndim for a in axes)
+        keep = [d for d in range(self._jx.ndim) if d not in axes]
+        moved = jnp.transpose(self._jx, keep + list(axes))
+        flat = moved.reshape(tuple(self._jx.shape[d] for d in keep) + (-1,))
+        return INDArray(fn(flat, axis=-1))
+
+    def argMax(self, *dimension) -> "INDArray":
+        return self._arg_reduce(jnp.argmax, dimension)
+
+    def argMin(self, *dimension) -> "INDArray":
+        return self._arg_reduce(jnp.argmin, dimension)
+
+    def cumsum(self, dimension: int = 0) -> "INDArray":
+        return INDArray(jnp.cumsum(self._jx, axis=dimension))
+
+    def cumprod(self, dimension: int = 0) -> "INDArray":
+        return INDArray(jnp.cumprod(self._jx, axis=dimension))
+
+    def sumNumber(self) -> float:
+        return float(jnp.sum(self._jx))
+
+    def meanNumber(self) -> float:
+        return float(jnp.mean(self._jx))
+
+    def maxNumber(self) -> float:
+        return float(jnp.max(self._jx))
+
+    def minNumber(self) -> float:
+        return float(jnp.min(self._jx))
+
+    def scan(self, condition) -> int:
+        """Count of elements matching a boolean condition function."""
+        return int(jnp.sum(condition(self._jx)))
+
+    # ----- row/column vector broadcast ops ---------------------------
+    def _row_op(self, vec, fn) -> "INDArray":
+        v = _unwrap(vec).reshape(1, -1)
+        return INDArray(fn(self._jx, v))
+
+    def _col_op(self, vec, fn) -> "INDArray":
+        v = _unwrap(vec).reshape(-1, 1)
+        return INDArray(fn(self._jx, v))
+
+    def addRowVector(self, v) -> "INDArray":
+        return self._row_op(v, jnp.add)
+
+    def subRowVector(self, v) -> "INDArray":
+        return self._row_op(v, jnp.subtract)
+
+    def mulRowVector(self, v) -> "INDArray":
+        return self._row_op(v, jnp.multiply)
+
+    def divRowVector(self, v) -> "INDArray":
+        return self._row_op(v, jnp.divide)
+
+    def addColumnVector(self, v) -> "INDArray":
+        return self._col_op(v, jnp.add)
+
+    def subColumnVector(self, v) -> "INDArray":
+        return self._col_op(v, jnp.subtract)
+
+    def mulColumnVector(self, v) -> "INDArray":
+        return self._col_op(v, jnp.multiply)
+
+    def divColumnVector(self, v) -> "INDArray":
+        return self._col_op(v, jnp.divide)
+
+    def addiRowVector(self, v) -> "INDArray":
+        self._jx = self._row_op(v, jnp.add)._jx
+        return self
+
+    def muliRowVector(self, v) -> "INDArray":
+        self._jx = self._row_op(v, jnp.multiply)._jx
+        return self
+
+    def addiColumnVector(self, v) -> "INDArray":
+        self._jx = self._col_op(v, jnp.add)._jx
+        return self
+
+    def muliColumnVector(self, v) -> "INDArray":
+        self._jx = self._col_op(v, jnp.multiply)._jx
+        return self
+
+    # ----- rows / columns / slices -----------------------------------
+    def getRow(self, i: int) -> "INDArray":
+        return INDArray(self._jx[i])
+
+    def getColumn(self, i: int) -> "INDArray":
+        return INDArray(self._jx[:, i])
+
+    def getRows(self, *rows) -> "INDArray":
+        idx = jnp.asarray(_dims(rows), dtype=jnp.int32)
+        return INDArray(self._jx[idx])
+
+    def getColumns(self, *cols) -> "INDArray":
+        idx = jnp.asarray(_dims(cols), dtype=jnp.int32)
+        return INDArray(self._jx[:, idx])
+
+    def putRow(self, i: int, row) -> "INDArray":
+        self._jx = self._jx.at[i].set(_unwrap(row))
+        return self
+
+    def putColumn(self, i: int, col) -> "INDArray":
+        self._jx = self._jx.at[:, i].set(_unwrap(col).reshape(-1))
+        return self
+
+    def slice(self, i: int, dimension: int = 0) -> "INDArray":
+        return INDArray(jnp.take(self._jx, i, axis=dimension))
+
+    def tensorAlongDimension(self, index: int, *dimension) -> "INDArray":
+        dims = _dims(dimension)
+        other = [d for d in range(self._jx.ndim) if d not in dims]
+        moved = jnp.moveaxis(self._jx, other, range(len(other)))
+        flat = moved.reshape((-1,) + moved.shape[len(other):])
+        return INDArray(flat[index])
+
+    # ----- fancy get/put (NDArrayIndex protocol) ----------------------
+    def get(self, *indices) -> "INDArray":
+        from deeplearning4j_tpu.ndarray.indexing import to_index_tuple
+
+        return INDArray(self._jx[to_index_tuple(indices, self.shape())])
+
+    def put(self, indices, value) -> "INDArray":
+        from deeplearning4j_tpu.ndarray.indexing import to_index_tuple
+
+        if not isinstance(indices, (tuple, list)):
+            indices = (indices,)
+        tup = to_index_tuple(tuple(indices), self.shape())
+        self._jx = self._jx.at[tup].set(_unwrap(value))
+        return self
+
+    def getWhere(self, comp, condition) -> "INDArray":
+        mask = condition(self._jx, _unwrap(comp))
+        return INDArray(self._jx[mask])
+
+    def replaceWhere(self, replacement, mask) -> "INDArray":
+        self._jx = jnp.where(_unwrap(mask).astype(bool), _unwrap(replacement), self._jx)
+        return self
+
+    def __getitem__(self, item) -> "INDArray":
+        if isinstance(item, tuple):
+            item = tuple(_unwrap(i) for i in item)
+        else:
+            item = _unwrap(item)
+        return INDArray(self._jx[item])
+
+    def __setitem__(self, item, value) -> None:
+        if isinstance(item, tuple):
+            item = tuple(_unwrap(i) for i in item)
+        else:
+            item = _unwrap(item)
+        self._jx = self._jx.at[item].set(_unwrap(value))
+
+    def __len__(self) -> int:
+        return self._jx.shape[0]
+
+    def __iter__(self):
+        for i in range(self._jx.shape[0]):
+            yield INDArray(self._jx[i])
+
+    def __float__(self) -> float:
+        return float(self._jx)
+
+    def __int__(self) -> int:
+        return int(self._jx)
+
+    def __repr__(self) -> str:
+        return f"INDArray{self.shape()}{self._jx.dtype}\n{np.asarray(self._jx)}"
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._jx)
+        return a.astype(dtype) if dtype is not None else a
+
+
+def _register_pytree():
+    jax.tree_util.register_pytree_node(
+        INDArray,
+        lambda a: ((a._jx,), None),
+        lambda aux, children: INDArray(children[0]),
+    )
+
+
+_register_pytree()

@@ -1,0 +1,81 @@
+"""Global RNG for the factory layer.
+
+Reference: org.nd4j.linalg.api.rng.DefaultRandom / Nd4j.getRandom(). The
+reference keeps a stateful Mersenne generator per backend. TPU-native
+design: a counter-based splittable jax.random key. Each draw splits the
+root key deterministically, so results are reproducible for a given seed
+regardless of device count or op ordering across hosts — the property the
+reference's distributed trainers have to work around.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+
+class DefaultRandom:
+    """Splittable counter-based RNG with a stateful facade."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.setSeed(seed)
+
+    def setSeed(self, seed: int) -> None:
+        with self._lock:
+            self._seed = int(seed)
+            self._key = jax.random.key(int(seed))
+
+    def getSeed(self) -> int:
+        return self._seed
+
+    def nextKey(self) -> jax.Array:
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+    def nextDouble(self) -> float:
+        return float(jax.random.uniform(self.nextKey(), ()))
+
+    def nextGaussian(self) -> float:
+        return float(jax.random.normal(self.nextKey(), ()))
+
+    def nextInt(self, bound: int) -> int:
+        return int(jax.random.randint(self.nextKey(), (), 0, bound))
+
+
+_global = DefaultRandom(0)
+
+
+def getRandom() -> DefaultRandom:
+    return _global
+
+
+def setSeed(seed: int) -> None:
+    _global.setSeed(seed)
+
+
+def _key(seed=None) -> jax.Array:
+    return jax.random.key(int(seed)) if seed is not None else _global.nextKey()
+
+
+def uniform(shape, dtype, minval=0.0, maxval=1.0, seed=None) -> jax.Array:
+    if not jnp.issubdtype(dtype, jnp.floating):
+        if int(maxval) - int(minval) <= 1:
+            raise ValueError(
+                "uniform with an integer dtype needs explicit integer bounds "
+                f"(got minval={minval}, maxval={maxval}); the float defaults "
+                "would yield a constant array"
+            )
+        return jax.random.randint(_key(seed), shape, int(minval), int(maxval), dtype=dtype)
+    return jax.random.uniform(_key(seed), shape, dtype=dtype, minval=minval, maxval=maxval)
+
+
+def normal(shape, dtype, mean=0.0, std=1.0, seed=None) -> jax.Array:
+    return mean + std * jax.random.normal(_key(seed), shape, dtype=dtype)
+
+
+def bernoulli(shape, p, dtype, seed=None) -> jax.Array:
+    return jax.random.bernoulli(_key(seed), p, shape).astype(dtype)

@@ -1,0 +1,33 @@
+"""Test harness config.
+
+Tests run on a virtual 8-device CPU mesh so multi-chip sharding paths are
+exercised without TPU pod hardware (the driver separately dry-runs the
+multichip path). Env must be set before jax initialises a backend, hence
+module-level, before any framework import.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # the driver's env pins the TPU ("axon")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The container's axon sitecustomize force-selects jax_platforms="axon,cpu"
+# (the tunneled TPU) at interpreter start; re-pin to CPU before any backend
+# initialises so tests run on the virtual 8-device CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+
+jax.config.update("jax_enable_x64", True)  # fp64 oracles for gradchecks
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fixed_seed():
+    from deeplearning4j_tpu.ndarray import random as r
+
+    r.setSeed(12345)
+    yield

@@ -1,0 +1,252 @@
+"""Op-semantics tests for the array layer, numpy as oracle.
+
+Mirrors the reference's nd4j op tests (nd4j-backend-impls tests /
+Nd4jTestsC): creation, arithmetic, reductions, indexing, broadcasting,
+gemm.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import Nd4j, INDArray, DataType
+from deeplearning4j_tpu.ndarray.indexing import NDArrayIndex
+
+
+class TestCreation:
+    def test_zeros_ones(self):
+        z = Nd4j.zeros(2, 3)
+        assert z.shape() == (2, 3)
+        assert z.sumNumber() == 0.0
+        o = Nd4j.ones(4)
+        assert o.sumNumber() == 4.0
+        assert o.dataType() == DataType.FLOAT
+
+    def test_create_from_data(self):
+        a = Nd4j.create([[1.0, 2.0], [3.0, 4.0]])
+        assert a.shape() == (2, 2)
+        assert a.getDouble(1, 0) == 3.0
+
+    def test_create_shape_varargs(self):
+        a = Nd4j.create(3, 4)
+        assert a.shape() == (3, 4)
+        assert a.sumNumber() == 0.0
+
+    def test_linspace_arange_eye(self):
+        l = Nd4j.linspace(0, 1, 5)
+        np.testing.assert_allclose(l.toNumpy(), np.linspace(0, 1, 5), rtol=1e-6)
+        a = Nd4j.arange(5)
+        np.testing.assert_allclose(a.toNumpy(), np.arange(5))
+        e = Nd4j.eye(3)
+        assert e.getDouble(0, 0) == 1.0 and e.getDouble(0, 1) == 0.0
+
+    def test_value_array_scalar(self):
+        v = Nd4j.valueArrayOf((2, 2), 7.0)
+        assert v.meanNumber() == 7.0
+        s = Nd4j.scalar(3.0)
+        assert float(s) == 3.0
+
+    def test_rand_reproducible(self):
+        Nd4j.getRandom().setSeed(42)
+        a = Nd4j.rand(3, 3)
+        Nd4j.getRandom().setSeed(42)
+        b = Nd4j.rand(3, 3)
+        assert a.equals(b)
+        assert 0.0 <= a.minNumber() and a.maxNumber() < 1.0
+
+
+class TestArithmetic:
+    def test_elementwise(self):
+        a = Nd4j.create([[1.0, 2.0], [3.0, 4.0]])
+        b = Nd4j.create([[10.0, 20.0], [30.0, 40.0]])
+        np.testing.assert_allclose((a + b).toNumpy(), [[11, 22], [33, 44]])
+        np.testing.assert_allclose(a.mul(b).toNumpy(), [[10, 40], [90, 160]])
+        np.testing.assert_allclose(b.div(a).toNumpy(), [[10, 10], [10, 10]])
+        np.testing.assert_allclose(a.rsub(1.0).toNumpy(), [[0, -1], [-2, -3]])
+        np.testing.assert_allclose(a.rdiv(12.0).toNumpy(), [[12, 6], [4, 3]])
+
+    def test_inplace_rebinds(self):
+        a = Nd4j.ones(2, 2)
+        r = a.addi(1.0)
+        assert r is a
+        assert a.meanNumber() == 2.0
+        a.muli(3.0).subi(1.0)
+        assert a.meanNumber() == 5.0
+
+    def test_scalar_broadcast(self):
+        a = Nd4j.create([1.0, 2.0, 3.0])
+        np.testing.assert_allclose((a * 2.0 + 1.0).toNumpy(), [3, 5, 7])
+
+    def test_row_col_vector_ops(self):
+        m = Nd4j.ones(3, 4)
+        row = Nd4j.create([0.0, 1.0, 2.0, 3.0])
+        col = Nd4j.create([10.0, 20.0, 30.0])
+        np.testing.assert_allclose(
+            m.addRowVector(row).toNumpy(), 1.0 + np.arange(4)[None, :] * np.ones((3, 4))
+        )
+        np.testing.assert_allclose(
+            m.mulColumnVector(col).toNumpy(), np.array([[10.0] * 4, [20.0] * 4, [30.0] * 4])
+        )
+
+    def test_comparison(self):
+        a = Nd4j.create([1.0, 5.0, 3.0])
+        assert a.gt(2.0).castTo(DataType.INT32).sumNumber() == 2
+        assert a.eq(5.0).castTo(DataType.INT32).sumNumber() == 1
+
+
+class TestReductions:
+    def test_full_reductions(self):
+        a = Nd4j.create([[1.0, 2.0], [3.0, 4.0]])
+        assert a.sumNumber() == 10.0
+        assert a.meanNumber() == 2.5
+        assert a.maxNumber() == 4.0
+        assert a.minNumber() == 1.0
+
+    def test_dimension_reductions(self):
+        a = Nd4j.create([[1.0, 2.0], [3.0, 4.0]])
+        np.testing.assert_allclose(a.sum(0).toNumpy(), [4, 6])
+        np.testing.assert_allclose(a.sum(1).toNumpy(), [3, 7])
+        np.testing.assert_allclose(a.mean(0).toNumpy(), [2, 3])
+        np.testing.assert_allclose(a.max(1).toNumpy(), [2, 4])
+        assert a.sum(0, keepDims=True).shape() == (1, 2)
+
+    def test_std_bias_corrected(self):
+        x = np.array([1.0, 2.0, 3.0, 4.0])
+        a = Nd4j.create(x)
+        np.testing.assert_allclose(float(a.std()), x.std(ddof=1), rtol=1e-6)
+        np.testing.assert_allclose(float(a.std(biasCorrected=False)), x.std(), rtol=1e-6)
+
+    def test_norms_argmax(self):
+        a = Nd4j.create([[3.0, -4.0], [0.0, 5.0]])
+        np.testing.assert_allclose(float(a.norm1()), 12.0)
+        np.testing.assert_allclose(float(a.norm2()), np.sqrt(50.0), rtol=1e-6)
+        np.testing.assert_allclose(a.argMax(1).toNumpy(), [0, 1])
+        np.testing.assert_allclose(a.argMin(1).toNumpy(), [1, 0])
+
+    def test_cumsum(self):
+        a = Nd4j.create([1.0, 2.0, 3.0])
+        np.testing.assert_allclose(a.cumsum(0).toNumpy(), [1, 3, 6])
+
+
+class TestLinalg:
+    def test_mmul(self):
+        a = Nd4j.create([[1.0, 2.0], [3.0, 4.0]])
+        b = Nd4j.create([[5.0, 6.0], [7.0, 8.0]])
+        np.testing.assert_allclose(a.mmul(b).toNumpy(), a.toNumpy() @ b.toNumpy())
+        np.testing.assert_allclose((a @ b).toNumpy(), a.toNumpy() @ b.toNumpy())
+
+    def test_gemm_transpose(self):
+        a = Nd4j.rand(3, 2)
+        b = Nd4j.rand(3, 4)
+        out = Nd4j.gemm(a, b, transposeA=True)
+        np.testing.assert_allclose(out.toNumpy(), a.toNumpy().T @ b.toNumpy(), rtol=1e-5)
+
+    def test_tensor_mmul(self):
+        a = Nd4j.rand(2, 3, 4)
+        b = Nd4j.rand(4, 5)
+        out = a.tensorMmul(b, axes=([2], [0]))
+        np.testing.assert_allclose(
+            out.toNumpy(), np.tensordot(a.toNumpy(), b.toNumpy(), axes=([2], [0])), rtol=1e-5
+        )
+
+    def test_transpose_permute(self):
+        a = Nd4j.rand(2, 3, 4)
+        assert a.permute(2, 0, 1).shape() == (4, 2, 3)
+        m = Nd4j.rand(2, 5)
+        assert m.transpose().shape() == (5, 2)
+
+
+class TestShapeOps:
+    def test_reshape_ravel(self):
+        a = Nd4j.arange(12).reshape(3, 4)
+        assert a.shape() == (3, 4)
+        assert a.ravel().shape() == (12,)
+        assert a.reshape(2, 6).shape() == (2, 6)
+
+    def test_concat_stack(self):
+        a, b = Nd4j.ones(2, 3), Nd4j.zeros(2, 3)
+        assert Nd4j.concat(0, a, b).shape() == (4, 3)
+        assert Nd4j.concat(1, a, b).shape() == (2, 6)
+        assert Nd4j.vstack(a, b).shape() == (4, 3)
+        assert Nd4j.hstack(a, b).shape() == (2, 6)
+        assert Nd4j.stack(0, a, b).shape() == (2, 2, 3)
+
+    def test_tile_repeat(self):
+        a = Nd4j.create([[1.0, 2.0]])
+        assert Nd4j.tile(a, 3, 1).shape() == (3, 2)
+        assert a.repeat(1, 2).shape() == (1, 4)
+
+    def test_broadcast(self):
+        a = Nd4j.create([1.0, 2.0, 3.0])
+        assert a.broadcast(4, 3).shape() == (4, 3)
+
+
+class TestIndexing:
+    def test_basic_get(self):
+        a = Nd4j.arange(12).reshape(3, 4)
+        row = a.getRow(1)
+        np.testing.assert_allclose(row.toNumpy(), [4, 5, 6, 7])
+        col = a.getColumn(2)
+        np.testing.assert_allclose(col.toNumpy(), [2, 6, 10])
+
+    def test_ndarrayindex_get(self):
+        a = Nd4j.arange(24).reshape(4, 6)
+        sub = a.get(NDArrayIndex.interval(1, 3), NDArrayIndex.all())
+        assert sub.shape() == (2, 6)
+        np.testing.assert_allclose(sub.toNumpy(), a.toNumpy()[1:3])
+        p = a.get(NDArrayIndex.point(2), NDArrayIndex.interval(0, 4))
+        np.testing.assert_allclose(p.toNumpy(), a.toNumpy()[2, 0:4])
+
+    def test_put(self):
+        a = Nd4j.zeros(3, 3)
+        a.put([NDArrayIndex.point(1), NDArrayIndex.all()], Nd4j.ones(3))
+        np.testing.assert_allclose(a.sum(1).toNumpy(), [0, 3, 0])
+
+    def test_putscalar_getdouble(self):
+        a = Nd4j.zeros(2, 2)
+        a.putScalar(0, 1, 5.0)
+        assert a.getDouble(0, 1) == 5.0
+        a.putScalar(3, 7.0)  # linear index
+        assert a.getDouble(1, 1) == 7.0
+
+    def test_python_getitem(self):
+        a = Nd4j.arange(12).reshape(3, 4)
+        np.testing.assert_allclose(a[1].toNumpy(), [4, 5, 6, 7])
+        np.testing.assert_allclose(a[:, 1].toNumpy(), [1, 5, 9])
+        a[0] = 0.0
+        assert float(a[0].sum()) == 0.0
+
+    def test_where_replace(self):
+        a = Nd4j.create([1.0, -2.0, 3.0, -4.0])
+        r = Nd4j.where(a.lt(0.0), Nd4j.zerosLike(a), a)
+        np.testing.assert_allclose(r.toNumpy(), [1, 0, 3, 0])
+
+    def test_getrows_slice(self):
+        a = Nd4j.arange(12).reshape(3, 4)
+        np.testing.assert_allclose(a.getRows(0, 2).toNumpy(), a.toNumpy()[[0, 2]])
+        np.testing.assert_allclose(a.slice(1).toNumpy(), a.toNumpy()[1])
+
+
+class TestDtype:
+    def test_cast(self):
+        a = Nd4j.create([1.9, 2.1])
+        i = a.castTo(DataType.INT32)
+        assert i.dataType() == DataType.INT32
+        np.testing.assert_allclose(i.toNumpy(), [1, 2])
+
+    def test_bfloat16(self):
+        a = Nd4j.ones(2, 2).castTo(DataType.BFLOAT16)
+        assert a.dataType() == DataType.BFLOAT16
+        assert a.sumNumber() == 4.0
+
+    def test_dup_is_independent(self):
+        a = Nd4j.ones(2)
+        b = a.dup()
+        a.addi(1.0)
+        assert b.meanNumber() == 1.0 and a.meanNumber() == 2.0
+
+
+class TestSort:
+    def test_sort(self):
+        a = Nd4j.create([3.0, 1.0, 2.0])
+        np.testing.assert_allclose(Nd4j.sort(a).toNumpy(), [1, 2, 3])
+        np.testing.assert_allclose(Nd4j.sort(a, ascending=False).toNumpy(), [3, 2, 1])
