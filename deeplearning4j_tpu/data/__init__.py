@@ -1,0 +1,9 @@
+"""Datasets, iterators and normalizers.
+
+Reference: org.nd4j.linalg.dataset + deeplearning4j-datasets.
+"""
+
+from deeplearning4j_tpu.data.dataset import (
+    DataSet, DataSetIterator, ListDataSetIterator, ExistingDataSetIterator,
+    SplitTestAndTrain,
+)

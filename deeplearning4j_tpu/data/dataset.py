@@ -1,0 +1,179 @@
+"""DataSet / DataSetIterator.
+
+Reference: org.nd4j.linalg.dataset.DataSet and
+org.nd4j.linalg.dataset.api.iterator.DataSetIterator. Iterators here yield
+fixed-shape batches (padding the final partial batch when needed) because
+XLA compiles one executable per shape — the reference's variable final
+minibatch would force a recompile every epoch.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deeplearning4j_tpu.ndarray import INDArray, Nd4j
+
+
+def _wrap(a):
+    if a is None or isinstance(a, INDArray):
+        return a
+    return INDArray(a) if not isinstance(a, np.ndarray) else Nd4j.create(a)
+
+
+class DataSet:
+    def __init__(self, features=None, labels=None, featuresMask=None, labelsMask=None):
+        self._features = _wrap(features)
+        self._labels = _wrap(labels)
+        self._fmask = _wrap(featuresMask)
+        self._lmask = _wrap(labelsMask)
+
+    def getFeatures(self) -> INDArray:
+        return self._features
+
+    def getLabels(self) -> INDArray:
+        return self._labels
+
+    def getFeaturesMaskArray(self):
+        return self._fmask
+
+    def getLabelsMaskArray(self):
+        return self._lmask
+
+    def setFeatures(self, f):
+        self._features = _wrap(f)
+
+    def setLabels(self, l):
+        self._labels = _wrap(l)
+
+    def numExamples(self) -> int:
+        return self._features.shape()[0] if self._features is not None else 0
+
+    def sample(self, n: int, seed=None) -> "DataSet":
+        rng = np.random.RandomState(seed)
+        idx = rng.choice(self.numExamples(), size=n, replace=False)
+        f = self._features.toNumpy()[idx]
+        l = self._labels.toNumpy()[idx]
+        return DataSet(f, l)
+
+    def splitTestAndTrain(self, fraction_or_n):
+        n = self.numExamples()
+        n_train = int(fraction_or_n * n) if isinstance(fraction_or_n, float) else int(fraction_or_n)
+        f, l = self._features.toNumpy(), self._labels.toNumpy()
+        return SplitTestAndTrain(DataSet(f[:n_train], l[:n_train]),
+                                 DataSet(f[n_train:], l[n_train:]))
+
+    def shuffle(self, seed=None):
+        rng = np.random.RandomState(seed)
+        idx = rng.permutation(self.numExamples())
+        self._features = _wrap(self._features.toNumpy()[idx])
+        self._labels = _wrap(self._labels.toNumpy()[idx])
+
+    def asList(self):
+        f, l = self._features.toNumpy(), self._labels.toNumpy()
+        return [DataSet(f[i:i + 1], l[i:i + 1]) for i in range(self.numExamples())]
+
+
+class SplitTestAndTrain:
+    def __init__(self, train, test):
+        self._train, self._test = train, test
+
+    def getTrain(self) -> DataSet:
+        return self._train
+
+    def getTest(self) -> DataSet:
+        return self._test
+
+
+class DataSetIterator:
+    """Base in-memory iterator over (features, labels) arrays."""
+
+    def __init__(self, features, labels, batchSize: int, shuffle=False, seed=123,
+                 featuresMask=None, labelsMask=None, pad_final=True):
+        self._f = np.asarray(features)
+        self._l = np.asarray(labels)
+        self._fm = None if featuresMask is None else np.asarray(featuresMask)
+        self._lm = None if labelsMask is None else np.asarray(labelsMask)
+        self._batch = int(batchSize)
+        self._shuffle = shuffle
+        self._seed = seed
+        self._epoch = 0
+        self._pad_final = pad_final
+        self._preprocessor = None
+        self.reset()
+
+    # ----- iterator protocol (reference names) ------------------------
+    def reset(self):
+        self._cursor = 0
+        order = np.arange(len(self._f))
+        if self._shuffle:
+            rng = np.random.RandomState(self._seed + self._epoch)
+            rng.shuffle(order)
+        self._order = order
+        self._epoch += 1
+
+    def hasNext(self) -> bool:
+        return self._cursor < len(self._f)
+
+    def next(self, num=None) -> DataSet:
+        n = num or self._batch
+        idx = self._order[self._cursor:self._cursor + n]
+        self._cursor += n
+        f, l = self._f[idx], self._l[idx]
+        fm = None if self._fm is None else self._fm[idx]
+        lm = None if self._lm is None else self._lm[idx]
+        if self._pad_final and len(idx) < n:
+            # pad to full batch with repeated rows + zero label-mask so XLA
+            # reuses the compiled executable; loss of padded rows is masked
+            pad = n - len(idx)
+            f = np.concatenate([f, np.repeat(f[-1:], pad, axis=0)])
+            l = np.concatenate([l, np.repeat(l[-1:], pad, axis=0)])
+            if fm is not None:
+                fm = np.concatenate([fm, np.repeat(fm[-1:], pad, axis=0)])
+            if lm is None:
+                lm = np.ones((n,) + (() if l.ndim == 2 else (l.shape[2],)), np.float32)
+                lm[-pad:] = 0.0
+            else:
+                lm = np.concatenate([lm, np.zeros((pad,) + lm.shape[1:], lm.dtype)])
+        ds = DataSet(f, l, fm, lm)
+        if self._preprocessor is not None:
+            self._preprocessor.preProcess(ds)
+        return ds
+
+    def __iter__(self):
+        self.reset()
+        while self.hasNext():
+            yield self.next()
+
+    def batch(self) -> int:
+        return self._batch
+
+    def totalExamples(self) -> int:
+        return len(self._f)
+
+    def inputColumns(self) -> int:
+        return int(np.prod(self._f.shape[1:]))
+
+    def totalOutcomes(self) -> int:
+        return int(self._l.shape[-1])
+
+    def setPreProcessor(self, pp):
+        self._preprocessor = pp
+
+    def getPreProcessor(self):
+        return self._preprocessor
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterator over a list of DataSets (reference: ListDataSetIterator)."""
+
+    def __init__(self, datasets, batchSize=None):
+        f = np.concatenate([d.getFeatures().toNumpy() for d in datasets])
+        l = np.concatenate([d.getLabels().toNumpy() for d in datasets])
+        super().__init__(f, l, batchSize or len(f))
+
+
+class ExistingDataSetIterator(DataSetIterator):
+    def __init__(self, dataset: DataSet, batchSize=None):
+        super().__init__(dataset.getFeatures().toNumpy(),
+                         dataset.getLabels().toNumpy(),
+                         batchSize or dataset.numExamples())

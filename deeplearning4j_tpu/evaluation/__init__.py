@@ -1,0 +1,6 @@
+"""Evaluation metrics.
+
+Reference: org.nd4j.evaluation (Evaluation, RegressionEvaluation, ROC).
+"""
+
+from deeplearning4j_tpu.evaluation.evaluation import Evaluation

@@ -1,0 +1,107 @@
+"""Classification evaluation.
+
+Reference: org.nd4j.evaluation.classification.Evaluation — accuracy,
+precision/recall/F1 (macro), confusion matrix. Counts accumulate on host
+in numpy (evaluation is not a TPU-bound op); predictions stream from
+device once per batch.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _to_np(a):
+    from deeplearning4j_tpu.ndarray import INDArray
+
+    if isinstance(a, INDArray):
+        return a.toNumpy()
+    return np.asarray(a)
+
+
+class Evaluation:
+    def __init__(self, numClasses=None, labelsList=None):
+        self._n = numClasses
+        self._labels = labelsList
+        self._conf = None  # confusion matrix [actual, predicted]
+
+    def eval(self, labels, predictions, mask=None):
+        y = _to_np(labels)
+        p = _to_np(predictions)
+        if y.ndim == 3:  # RNN [B,C,T] -> flatten time
+            y = np.transpose(y, (0, 2, 1)).reshape(-1, y.shape[1])
+            p = np.transpose(p, (0, 2, 1)).reshape(-1, p.shape[1])
+            if mask is not None:
+                m = _to_np(mask).reshape(-1) > 0
+                y, p = y[m], p[m]
+        elif mask is not None:
+            m = _to_np(mask).reshape(-1) > 0
+            y, p = y[m], p[m]
+        n = y.shape[-1]
+        if self._conf is None:
+            self._n = self._n or n
+            self._conf = np.zeros((self._n, self._n), np.int64)
+        actual = np.argmax(y, axis=-1)
+        pred = np.argmax(p, axis=-1)
+        np.add.at(self._conf, (actual, pred), 1)
+        return self
+
+    # ----- metrics ----------------------------------------------------
+    def accuracy(self) -> float:
+        c = self._conf
+        return float(np.trace(c)) / max(1, c.sum())
+
+    def _per_class(self):
+        c = self._conf.astype(np.float64)
+        tp = np.diag(c)
+        fp = c.sum(axis=0) - tp
+        fn = c.sum(axis=1) - tp
+        prec = np.where(tp + fp > 0, tp / np.maximum(tp + fp, 1), 0.0)
+        rec = np.where(tp + fn > 0, tp / np.maximum(tp + fn, 1), 0.0)
+        return prec, rec
+
+    def precision(self, cls=None) -> float:
+        prec, _ = self._per_class()
+        if cls is not None:
+            return float(prec[cls])
+        present = self._conf.sum(axis=1) > 0
+        return float(prec[present].mean()) if present.any() else 0.0
+
+    def recall(self, cls=None) -> float:
+        _, rec = self._per_class()
+        if cls is not None:
+            return float(rec[cls])
+        present = self._conf.sum(axis=1) > 0
+        return float(rec[present].mean()) if present.any() else 0.0
+
+    def f1(self, cls=None) -> float:
+        p, r = self.precision(cls), self.recall(cls)
+        return 2 * p * r / max(p + r, 1e-12)
+
+    def falsePositiveRate(self, cls) -> float:
+        c = self._conf.astype(np.float64)
+        tp = c[cls, cls]
+        fp = c[:, cls].sum() - tp
+        tn = np.trace(c) - tp
+        neg = c.sum() - c[cls].sum()
+        return float(fp / max(neg, 1))
+
+    def getConfusionMatrix(self):
+        return self._conf
+
+    def confusionMatrix(self):
+        return self._conf
+
+    def stats(self) -> str:
+        lines = [
+            "========================Evaluation Metrics========================",
+            f" # of classes:    {self._n}",
+            f" Accuracy:        {self.accuracy():.4f}",
+            f" Precision:       {self.precision():.4f}",
+            f" Recall:          {self.recall():.4f}",
+            f" F1 Score:        {self.f1():.4f}",
+            "",
+            "=========================Confusion Matrix=========================",
+            str(self._conf),
+        ]
+        return "\n".join(lines)

@@ -1,0 +1,27 @@
+"""Neural network configuration + execution layer.
+
+Reference: deeplearning4j-nn (org.deeplearning4j.nn.*).
+"""
+
+from deeplearning4j_tpu.nn.activations import Activation
+from deeplearning4j_tpu.nn.weights import WeightInit, NormalDistribution, UniformDistribution
+from deeplearning4j_tpu.nn.losses import LossFunctions
+from deeplearning4j_tpu.nn import updaters
+from deeplearning4j_tpu.nn.updaters import (
+    Sgd, Adam, AdaMax, Nadam, AMSGrad, AdaGrad, AdaDelta, RmsProp, Nesterovs, NoOp,
+)
+from deeplearning4j_tpu.nn.conf.builder import (
+    NeuralNetConfiguration, MultiLayerConfiguration, BackpropType, GradientNormalization,
+)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    DenseLayer, OutputLayer, RnnOutputLayer, LossLayer, ActivationLayer, DropoutLayer,
+    ConvolutionLayer, Convolution1DLayer, Deconvolution2D, DepthwiseConvolution2D,
+    SeparableConvolution2D, SubsamplingLayer, Upsampling2D, ZeroPaddingLayer,
+    Cropping2D, GlobalPoolingLayer, BatchNormalization, LocalResponseNormalization,
+    EmbeddingLayer, EmbeddingSequenceLayer,
+)
+from deeplearning4j_tpu.nn.conf.recurrent import (
+    LSTM, GravesLSTM, SimpleRnn, GRU, Bidirectional, LastTimeStep,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork

@@ -1,0 +1,104 @@
+"""Activation functions.
+
+Reference: org.nd4j.linalg.activations.Activation (enum) and the
+IActivation implementations. There, each activation is a pair of
+hand-written forward/backprop kernels; here each is a scalar jax function —
+XLA fuses it into the surrounding matmul/conv and autodiff derives the
+backward pass, so the *Derivative op classes have no equivalent.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _softmax(x):
+    return jax.nn.softmax(x, axis=-1)
+
+
+def _cube(x):
+    return x * x * x
+
+
+def _hardtanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+def _hardsigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def _rationaltanh(x):
+    # 1.7159 * tanh(2x/3) approximation used by the reference
+    return 1.7159 * jnp.tanh(2.0 * x / 3.0)
+
+
+def _rectifiedtanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+def _softsign(x):
+    return x / (1.0 + jnp.abs(x))
+
+
+def _mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+ACTIVATIONS = {
+    "identity": lambda x: x,
+    "relu": jax.nn.relu,
+    "relu6": lambda x: jnp.clip(x, 0.0, 6.0),
+    "leakyrelu": lambda x: jax.nn.leaky_relu(x, 0.01),
+    "elu": jax.nn.elu,
+    "selu": jax.nn.selu,
+    "gelu": jax.nn.gelu,
+    "swish": jax.nn.silu,
+    "mish": _mish,
+    "sigmoid": jax.nn.sigmoid,
+    "hardsigmoid": _hardsigmoid,
+    "tanh": jnp.tanh,
+    "hardtanh": _hardtanh,
+    "rationaltanh": _rationaltanh,
+    "rectifiedtanh": _rectifiedtanh,
+    "softmax": _softmax,
+    "softplus": jax.nn.softplus,
+    "softsign": _softsign,
+    "cube": _cube,
+    "thresholdedrelu": lambda x: jnp.where(x > 1.0, x, 0.0),
+}
+
+
+class Activation:
+    """Enum-style accessors: Activation.RELU etc. resolve to names."""
+
+    IDENTITY = "identity"
+    RELU = "relu"
+    RELU6 = "relu6"
+    LEAKYRELU = "leakyrelu"
+    ELU = "elu"
+    SELU = "selu"
+    GELU = "gelu"
+    SWISH = "swish"
+    MISH = "mish"
+    SIGMOID = "sigmoid"
+    HARDSIGMOID = "hardsigmoid"
+    TANH = "tanh"
+    HARDTANH = "hardtanh"
+    RATIONALTANH = "rationaltanh"
+    RECTIFIEDTANH = "rectifiedtanh"
+    SOFTMAX = "softmax"
+    SOFTPLUS = "softplus"
+    SOFTSIGN = "softsign"
+    CUBE = "cube"
+    THRESHOLDEDRELU = "thresholdedrelu"
+
+
+def get(name) -> callable:
+    if callable(name):
+        return name
+    key = str(name).lower()
+    if key not in ACTIVATIONS:
+        raise ValueError(f"Unknown activation '{name}'. Known: {sorted(ACTIVATIONS)}")
+    return ACTIVATIONS[key]

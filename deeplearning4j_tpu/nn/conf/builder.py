@@ -1,0 +1,294 @@
+"""Network configuration builders.
+
+Reference: org.deeplearning4j.nn.conf.NeuralNetConfiguration.Builder →
+ListBuilder → MultiLayerConfiguration. The fluent surface matches the
+reference (seed/updater/weightInit/activation/l2/list/layer/setInputType/
+build); build() performs the same shape-inference walk the reference's
+ListBuilder does — inferring each layer's nIn from the propagated
+InputType and auto-inserting input preprocessors between layer families.
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.ndarray.dtype import DataType
+from deeplearning4j_tpu.nn import updaters as _upd
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf import recurrent as R
+from deeplearning4j_tpu.nn.conf import preprocessors as PP
+
+
+class BackpropType:
+    Standard = "standard"
+    TruncatedBPTT = "tbptt"
+
+
+class GradientNormalization:
+    NoNormalization = None
+    RenormalizeL2PerLayer = "renormalize_l2_per_layer"
+    RenormalizeL2PerParamType = "renormalize_l2_per_param_type"
+    ClipElementWiseAbsoluteValue = "clip_elementwise"
+    ClipL2PerLayer = "clip_l2_per_layer"
+    ClipL2PerParamType = "clip_l2_per_param_type"
+
+
+class MultiLayerConfiguration:
+    def __init__(self, layers, defaults, seed, dataType, inputType,
+                 preprocessors, backpropType, tbpttFwdLength, tbpttBackLength,
+                 gradientNormalization=None, gradientNormalizationThreshold=1.0,
+                 maxNumLineSearchIterations=None):
+        self.layers = layers
+        self.defaults = defaults
+        self.seed = seed
+        self.dataType = dataType
+        self.inputType = inputType
+        self.preprocessors = preprocessors  # {layer_index: InputPreProcessor}
+        self.backpropType = backpropType
+        self.tbpttFwdLength = tbpttFwdLength
+        self.tbpttBackLength = tbpttBackLength
+        self.gradientNormalization = gradientNormalization
+        self.gradientNormalizationThreshold = gradientNormalizationThreshold
+        # resolved per-layer input types (set during shape inference)
+        self.layerInputTypes = []
+
+    def inferShapes(self):
+        """Propagate InputType through layers; auto-insert preprocessors.
+
+        Mirrors MultiLayerConfiguration.Builder.build()'s use of
+        getOutputType/getPreProcessorForInputType in the reference.
+        """
+        if self.inputType is None:
+            raise ValueError(
+                "setInputType(...) is required (or set nIn on every layer)")
+        cur = self.inputType
+        if cur.kind == InputType.CNN_FLAT:
+            first = self.layers[0]
+            if isinstance(first, (L.ConvolutionLayer, L.SubsamplingLayer, L.BatchNormalization)):
+                # reshape flat input to CNN at the entry (reference:
+                # FeedForwardToCnnPreProcessor for convolutionalFlat)
+                self.preprocessors.setdefault(0, PP.FeedForwardToCnnPreProcessor(
+                    cur.height, cur.width, cur.channels))
+                cur = InputType.convolutional(cur.height, cur.width, cur.channels)
+            else:
+                cur = InputType.feedForward(cur.arrayElementsPerExample())
+        self.layerInputTypes = []
+        for i, layer in enumerate(self.layers):
+            layer.mergeGlobals(self.defaults)
+            if i in self.preprocessors:
+                cur = self.preprocessors[i].getOutputType(cur)
+            else:
+                pp, cur2 = self._auto_preprocessor(layer, cur)
+                if pp is not None:
+                    self.preprocessors[i] = pp
+                    cur = cur2
+            if hasattr(layer, "inferNIn"):
+                layer.inferNIn(cur)
+            self.layerInputTypes.append(cur)
+            cur = layer.getOutputType(cur)
+        self.outputType = cur
+        return self
+
+    @staticmethod
+    def _wants(layer):
+        if isinstance(layer, (R.BaseRecurrentLayer, R.Bidirectional, R.LastTimeStep,
+                              L.RnnOutputLayer, L.Convolution1DLayer, L.EmbeddingSequenceLayer)):
+            return InputType.RNN
+        if isinstance(layer, (L.ConvolutionLayer, L.SubsamplingLayer, L.Upsampling2D,
+                              L.ZeroPaddingLayer, L.Cropping2D, L.LocalResponseNormalization)) \
+                and not isinstance(layer, L.Convolution1DLayer):
+            return InputType.CNN
+        if isinstance(layer, (L.DenseLayer, L.BaseOutputLayer, L.EmbeddingLayer)):
+            return InputType.FF
+        return None  # format-agnostic (BN, activation, dropout, global pool...)
+
+    def _auto_preprocessor(self, layer, cur):
+        wants = self._wants(layer)
+        if wants is None or cur.kind == wants:
+            return None, cur
+        if cur.kind == InputType.CNN and wants == InputType.FF:
+            pp = PP.CnnToFeedForwardPreProcessor(cur.height, cur.width, cur.channels)
+            return pp, pp.getOutputType(cur)
+        if cur.kind == InputType.RNN and wants == InputType.FF:
+            pp = PP.RnnToFeedForwardPreProcessor()
+            return pp, pp.getOutputType(cur)
+        if cur.kind == InputType.FF and wants == InputType.RNN:
+            pp = PP.FeedForwardToRnnPreProcessor()
+            return pp, pp.getOutputType(cur)
+        if cur.kind == InputType.CNN and wants == InputType.RNN:
+            pp = PP.CnnToRnnPreProcessor(cur.height, cur.width, cur.channels)
+            return pp, pp.getOutputType(cur)
+        raise ValueError(
+            f"No preprocessor for {cur.kind} -> {wants} (layer {type(layer).__name__})")
+
+
+class ListBuilder:
+    def __init__(self, defaults):
+        self._defaults = defaults
+        self._layers = []
+        self._preprocessors = {}
+        self._inputType = None
+        self._backpropType = BackpropType.Standard
+        self._tbpttFwd = self._tbpttBack = 20
+
+    def layer(self, *args):
+        """layer(l) or layer(index, l) like the reference."""
+        if len(args) == 2:
+            idx, l = args
+            while len(self._layers) <= idx:
+                self._layers.append(None)
+            self._layers[idx] = l
+        else:
+            self._layers.append(args[0])
+        return self
+
+    def setInputType(self, it: InputType):
+        self._inputType = it
+        return self
+
+    def inputPreProcessor(self, idx: int, pp):
+        self._preprocessors[idx] = pp
+        return self
+
+    def backpropType(self, bp):
+        self._backpropType = bp
+        return self
+
+    def tBPTTForwardLength(self, n: int):
+        self._tbpttFwd = n
+        return self
+
+    def tBPTTBackwardLength(self, n: int):
+        self._tbpttBack = n
+        return self
+
+    def tBPTTLength(self, n: int):
+        self._tbpttFwd = self._tbpttBack = n
+        return self
+
+    def build(self) -> MultiLayerConfiguration:
+        if any(l is None for l in self._layers):
+            raise ValueError("Gap in layer indices")
+        d = self._defaults
+        conf = MultiLayerConfiguration(
+            layers=self._layers,
+            defaults=d,
+            seed=d.get("seed", 12345),
+            dataType=d.get("dataType", DataType.FLOAT),
+            inputType=self._inputType,
+            preprocessors=dict(self._preprocessors),
+            backpropType=self._backpropType,
+            tbpttFwdLength=self._tbpttFwd,
+            tbpttBackLength=self._tbpttBack,
+            gradientNormalization=d.get("gradientNormalization"),
+            gradientNormalizationThreshold=d.get("gradientNormalizationThreshold", 1.0),
+        )
+        if self._inputType is not None:
+            conf.inferShapes()
+        else:
+            # all nIn set explicitly: derive input type from first layer
+            first = self._layers[0]
+            if getattr(first, "nIn", None) is None:
+                raise ValueError("Either setInputType(...) or nIn on the first layer")
+            conf.inputType = InputType.feedForward(first.nIn) \
+                if not isinstance(first, (R.BaseRecurrentLayer, L.RnnOutputLayer)) \
+                else InputType.recurrent(first.nIn)
+            conf.inferShapes()
+        return conf
+
+
+class NeuralNetConfiguration:
+    class Builder:
+        def __init__(self):
+            self._d = {}
+
+        # fluent setters, mirroring the reference builder
+        def seed(self, s):
+            self._d["seed"] = int(s)
+            return self
+
+        def updater(self, u):
+            self._d["updater"] = _upd.resolve(u) if not isinstance(u, _upd.IUpdater) else u
+            return self
+
+        def biasUpdater(self, u):
+            self._d["biasUpdater"] = u
+            return self
+
+        def weightInit(self, w):
+            self._d["weightInit"] = w
+            return self
+
+        def dist(self, distribution):
+            self._d["distribution"] = distribution
+            self._d["weightInit"] = "distribution"
+            return self
+
+        def activation(self, a):
+            self._d["activation"] = a
+            return self
+
+        def l1(self, v):
+            self._d["l1"] = float(v)
+            return self
+
+        def l2(self, v):
+            self._d["l2"] = float(v)
+            return self
+
+        def l1Bias(self, v):
+            self._d["l1Bias"] = float(v)
+            return self
+
+        def l2Bias(self, v):
+            self._d["l2Bias"] = float(v)
+            return self
+
+        def weightDecay(self, v):
+            self._d["weightDecay"] = float(v)
+            return self
+
+        def dropOut(self, v):
+            self._d["dropOut"] = float(v)
+            return self
+
+        def dataType(self, dt):
+            self._d["dataType"] = DataType.from_dtype(dt) if not isinstance(dt, DataType) else dt
+            return self
+
+        def gradientNormalization(self, gn):
+            self._d["gradientNormalization"] = gn
+            return self
+
+        def gradientNormalizationThreshold(self, t):
+            self._d["gradientNormalizationThreshold"] = float(t)
+            return self
+
+        def convolutionMode(self, m):
+            self._d["convolutionMode"] = m
+            return self
+
+        def miniBatch(self, flag):
+            self._d["miniBatch"] = bool(flag)
+            return self
+
+        def trainingWorkspaceMode(self, *_):
+            return self  # workspaces are XLA's job; accepted for parity
+
+        def inferenceWorkspaceMode(self, *_):
+            return self
+
+        def cudnnAlgoMode(self, *_):
+            return self  # no cuDNN on TPU; accepted for parity
+
+        def list(self) -> ListBuilder:
+            return ListBuilder(dict(self._d))
+
+        def graphBuilder(self):
+            try:
+                from deeplearning4j_tpu.nn.conf.graph import GraphBuilder
+            except ImportError as e:
+                raise NotImplementedError(
+                    "ComputationGraph configuration (nn.conf.graph) is not "
+                    "available in this build; use .list() for sequential "
+                    "networks") from e
+            return GraphBuilder(dict(self._d))

@@ -1,0 +1,67 @@
+"""Input type declarations and shape inference.
+
+Reference: org.deeplearning4j.nn.conf.inputs.InputType. Used exactly like
+the reference: declare the network's input shape once
+(setInputType(InputType.convolutionalFlat(28,28,1))) and per-layer nIn
+values are inferred by propagating shapes through getOutputType.
+
+Layout note: the API follows the reference's conventions — convolutional
+data is NCHW [batch, channels, height, width] and recurrent data is NCW
+[batch, features, time]. Internally the network computes conv in NHWC
+(the TPU-friendly layout; one transpose at the input boundary) and scans
+recurrent data time-major. InputType tracks the *logical* dims only.
+"""
+
+from __future__ import annotations
+
+
+class InputType:
+    FF = "feedforward"
+    RNN = "recurrent"
+    CNN = "convolutional"
+    CNN_FLAT = "convolutionalFlat"
+    CNN3D = "convolutional3d"
+
+    def __init__(self, kind: str, **dims):
+        self.kind = kind
+        self.dims = dims
+
+    # ----- factories (match reference signatures) ---------------------
+    @staticmethod
+    def feedForward(size: int) -> "InputType":
+        return InputType(InputType.FF, size=int(size))
+
+    @staticmethod
+    def recurrent(size: int, timeSeriesLength: int | None = None) -> "InputType":
+        return InputType(InputType.RNN, size=int(size),
+                         timeSeriesLength=None if timeSeriesLength is None else int(timeSeriesLength))
+
+    @staticmethod
+    def convolutional(height: int, width: int, channels: int) -> "InputType":
+        return InputType(InputType.CNN, height=int(height), width=int(width), channels=int(channels))
+
+    @staticmethod
+    def convolutionalFlat(height: int, width: int, depth: int) -> "InputType":
+        return InputType(InputType.CNN_FLAT, height=int(height), width=int(width), channels=int(depth))
+
+    # ----- helpers ----------------------------------------------------
+    def arrayElementsPerExample(self) -> int:
+        if self.kind == InputType.FF:
+            return self.dims["size"]
+        if self.kind == InputType.RNN:
+            t = self.dims.get("timeSeriesLength") or 1
+            return self.dims["size"] * t
+        return self.dims["height"] * self.dims["width"] * self.dims["channels"]
+
+    def __getattr__(self, item):
+        try:
+            return self.__dict__["dims"][item]
+        except KeyError:
+            raise AttributeError(item)
+
+    def __repr__(self):
+        inner = ", ".join(f"{k}={v}" for k, v in self.dims.items())
+        return f"InputType.{self.kind}({inner})"
+
+    def __eq__(self, other):
+        return isinstance(other, InputType) and self.kind == other.kind and self.dims == other.dims

@@ -1,0 +1,688 @@
+"""Layer configurations with pure init/forward semantics.
+
+Reference: org.deeplearning4j.nn.conf.layers.* (configuration classes) and
+org.deeplearning4j.nn.layers.* (the mutable Layer implementations that
+execute them). TPU design collapses the config/impl split: a layer config
+IS its implementation — `initialize` builds a params/state pytree and
+`forward` is a pure function that traces into the network's single jitted
+XLA computation. There is no per-layer workspace management, no
+activate/backpropGradient pair (jax.grad derives the backward), and no
+cuDNN helper indirection (XLA fuses conv/BN/LSTM directly).
+
+Conventions:
+- conv activations are NHWC internally ([B,H,W,C]); the network converts
+  from the reference's NCHW once at the input boundary.
+- recurrent activations between layers use the reference's NCW [B,F,T];
+  recurrent layers transpose to time-major for lax.scan internally.
+- `dropOut` is the RETAIN probability applied to the layer's input, like
+  the reference.
+- params dict keys follow the reference's param names: "W", "b", "RW"
+  (recurrent weights), "gamma"/"beta" etc. (DefaultParamInitializer,
+  LSTMParamInitializer, BatchNormalizationParamInitializer).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import activations as _act
+from deeplearning4j_tpu.nn import weights as _winit
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.ops import conv as _conv
+from deeplearning4j_tpu.ops import pooling as _pool
+from deeplearning4j_tpu.ops import norm as _norm
+from deeplearning4j_tpu.ops import rnn as _rnn
+from deeplearning4j_tpu.ops.conv import _pair
+
+
+class _FluentBuilder:
+    """Java-style fluent builder parity: DenseLayer.Builder().nIn(4).build().
+
+    Every chained call sets the constructor kwarg of the same name.
+    """
+
+    def __init__(self, cls):
+        self._cls = cls
+        self._kw = {}
+
+    def __getattr__(self, name):
+        def setter(*args):
+            self._kw[name] = args[0] if len(args) == 1 else args
+            return self
+
+        return setter
+
+    def build(self):
+        return self._cls(**self._kw)
+
+
+class Layer:
+    """Base layer config. None-valued common fields inherit the network's
+    global defaults (reference: NeuralNetConfiguration.Builder defaults
+    cloned into each layer)."""
+
+    # fields that fall back to globals when None
+    _GLOBAL_FIELDS = ("activation", "weightInit", "biasInit", "updater",
+                      "biasUpdater", "l1", "l2", "l1Bias", "l2Bias",
+                      "weightDecay", "dropOut", "distribution")
+
+    def __init__(self, name=None, activation=None, weightInit=None, biasInit=None,
+                 updater=None, biasUpdater=None, l1=None, l2=None, l1Bias=None,
+                 l2Bias=None, weightDecay=None, dropOut=None, distribution=None):
+        self.name = name
+        self.activation = activation
+        self.weightInit = weightInit
+        self.biasInit = biasInit
+        self.updater = updater
+        self.biasUpdater = biasUpdater
+        self.l1, self.l2 = l1, l2
+        self.l1Bias, self.l2Bias = l1Bias, l2Bias
+        self.weightDecay = weightDecay
+        self.dropOut = dropOut
+        self.distribution = distribution
+
+    @classmethod
+    def Builder(cls, **kw):
+        b = _FluentBuilder(cls)
+        b._kw.update(kw)
+        return b
+
+    def mergeGlobals(self, defaults: dict) -> None:
+        for f in self._GLOBAL_FIELDS:
+            if getattr(self, f, None) is None and f in defaults:
+                setattr(self, f, defaults[f])
+        if self.activation is None:
+            self.activation = "identity"
+        if self.weightInit is None:
+            self.weightInit = _winit.WeightInit.XAVIER
+        if self.biasInit is None:
+            self.biasInit = 0.0
+
+    # ----- interface --------------------------------------------------
+    def getOutputType(self, inputType: InputType) -> InputType:
+        return inputType
+
+    def initialize(self, key, inputType: InputType, dtype):
+        return {}, {}
+
+    def forward(self, params, state, x, train: bool, key, mask=None):
+        raise NotImplementedError
+
+    def hasParams(self) -> bool:
+        return True
+
+    def _dropout_input(self, x, train, key):
+        p = self.dropOut
+        if not train or p is None or p in (0.0, 1.0) or key is None:
+            return x
+        keep = jax.random.bernoulli(key, p, x.shape)
+        return jnp.where(keep, x / p, 0.0)
+
+    def regularization(self, params):
+        """Scalar l1/l2/weight-decay penalty for this layer's params."""
+        total = 0.0
+        w_keys = [k for k in params if k not in ("b", "beta")]
+        l1 = self.l1 or 0.0
+        l2 = self.l2 or 0.0
+        wd = self.weightDecay or 0.0
+        for k in w_keys:
+            if l1:
+                total = total + l1 * jnp.sum(jnp.abs(params[k]))
+            if l2 or wd:
+                total = total + 0.5 * (l2 + wd) * jnp.sum(jnp.square(params[k]))
+        l1b = self.l1Bias or 0.0
+        l2b = self.l2Bias or 0.0
+        if "b" in params and (l1b or l2b):
+            total = total + l1b * jnp.sum(jnp.abs(params["b"])) \
+                          + 0.5 * l2b * jnp.sum(jnp.square(params["b"]))
+        return total
+
+
+class BaseLayer(Layer):
+    pass
+
+
+# ======================================================================
+# Feed-forward layers
+# ======================================================================
+
+class FeedForwardLayer(BaseLayer):
+    def __init__(self, nIn=None, nOut=None, hasBias=True, **kw):
+        super().__init__(**kw)
+        self.nIn = nIn
+        self.nOut = nOut
+        self.hasBias = hasBias
+
+    def getOutputType(self, inputType: InputType) -> InputType:
+        return InputType.feedForward(self.nOut)
+
+    def inferNIn(self, inputType: InputType) -> None:
+        if self.nIn is None:
+            if inputType.kind == InputType.FF:
+                self.nIn = inputType.size
+            elif inputType.kind == InputType.RNN:
+                self.nIn = inputType.size
+            else:
+                self.nIn = inputType.arrayElementsPerExample()
+
+    def initialize(self, key, inputType, dtype):
+        self.inferNIn(inputType)
+        kW, _ = jax.random.split(key)
+        W = _winit.init(kW, self.weightInit, (self.nIn, self.nOut),
+                        self.nIn, self.nOut, dtype, self.distribution)
+        params = {"W": W}
+        if self.hasBias:
+            params["b"] = jnp.full((self.nOut,), self.biasInit, dtype)
+        return params, {}
+
+
+class DenseLayer(FeedForwardLayer):
+    """Fully connected layer (reference: conf.layers.DenseLayer)."""
+
+    def forward(self, params, state, x, train, key, mask=None):
+        x = self._dropout_input(x, train, key)
+        y = x @ params["W"]
+        if self.hasBias:
+            y = y + params["b"]
+        return _act.get(self.activation)(y), state
+
+
+class EmbeddingLayer(FeedForwardLayer):
+    """Index -> dense row lookup (reference: EmbeddingLayer). Input is
+    [B] or [B,1] integer indices; gather instead of one-hot matmul."""
+
+    def __init__(self, nIn=None, nOut=None, hasBias=False, **kw):
+        super().__init__(nIn=nIn, nOut=nOut, hasBias=hasBias, **kw)
+
+    def inferNIn(self, inputType):
+        if self.nIn is None:
+            raise ValueError(
+                "EmbeddingLayer requires explicit nIn (vocabulary size); it "
+                "cannot be inferred from the input shape")
+
+    def forward(self, params, state, x, train, key, mask=None):
+        idx = x.astype(jnp.int32).reshape(x.shape[0], -1)[:, 0]
+        y = params["W"][idx]
+        if self.hasBias:
+            y = y + params["b"]
+        return _act.get(self.activation)(y), state
+
+
+class EmbeddingSequenceLayer(FeedForwardLayer):
+    """[B,T] indices -> [B,nOut,T] sequence embeddings
+    (reference: EmbeddingSequenceLayer)."""
+
+    def __init__(self, nIn=None, nOut=None, hasBias=False, inputLength=None, **kw):
+        super().__init__(nIn=nIn, nOut=nOut, hasBias=hasBias, **kw)
+        self.inputLength = inputLength
+
+    def getOutputType(self, inputType):
+        return InputType.recurrent(self.nOut, self.inputLength)
+
+    def forward(self, params, state, x, train, key, mask=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 3:  # [B,1,T]
+            idx = idx[:, 0, :]
+        y = params["W"][idx]          # [B,T,nOut]
+        if self.hasBias:
+            y = y + params["b"]
+        y = _act.get(self.activation)(y)
+        return jnp.transpose(y, (0, 2, 1)), state  # -> [B,nOut,T]
+
+
+class BaseOutputLayer(FeedForwardLayer):
+    def __init__(self, lossFunction="mcxent", **kw):
+        super().__init__(**kw)
+        self.lossFunction = lossFunction
+
+    def preoutput(self, params, x):
+        y = x @ params["W"]
+        if self.hasBias:
+            y = y + params["b"]
+        return y
+
+    def forward(self, params, state, x, train, key, mask=None):
+        x = self._dropout_input(x, train, key)
+        return _act.get(self.activation)(self.preoutput(params, x)), state
+
+
+class OutputLayer(BaseOutputLayer):
+    """Dense + loss head (reference: conf.layers.OutputLayer)."""
+
+
+class RnnOutputLayer(BaseOutputLayer):
+    """Per-timestep dense + loss over NCW data
+    (reference: conf.layers.RnnOutputLayer)."""
+
+    def getOutputType(self, inputType):
+        return InputType.recurrent(self.nOut, inputType.dims.get("timeSeriesLength"))
+
+    def preoutput(self, params, x):
+        # x: [B,F,T] -> y: [B,nOut,T]
+        y = jnp.einsum("bft,fo->bot", x, params["W"])
+        if self.hasBias:
+            y = y + params["b"][None, :, None]
+        return y
+
+    def forward(self, params, state, x, train, key, mask=None):
+        x = self._dropout_input(x, train, key)
+        pre = self.preoutput(params, x)
+        # activation over the class axis (softmax must not run over time)
+        y = jnp.transpose(_act.get(self.activation)(jnp.transpose(pre, (0, 2, 1))), (0, 2, 1))
+        return y, state
+
+
+class LossLayer(Layer):
+    """Loss without params (reference: conf.layers.LossLayer)."""
+
+    def __init__(self, lossFunction="mcxent", **kw):
+        super().__init__(**kw)
+        self.lossFunction = lossFunction
+        self.nOut = None
+
+    def hasParams(self):
+        return False
+
+    def preoutput(self, params, x):
+        return x
+
+    def forward(self, params, state, x, train, key, mask=None):
+        return _act.get(self.activation)(x), state
+
+
+class ActivationLayer(Layer):
+    def hasParams(self):
+        return False
+
+    def forward(self, params, state, x, train, key, mask=None):
+        return _act.get(self.activation)(x), state
+
+
+class DropoutLayer(Layer):
+    def __init__(self, dropOut=0.5, **kw):
+        super().__init__(dropOut=dropOut, **kw)
+
+    def hasParams(self):
+        return False
+
+    def forward(self, params, state, x, train, key, mask=None):
+        return self._dropout_input(x, train, key), state
+
+
+# ======================================================================
+# Convolutional layers (NHWC internal)
+# ======================================================================
+
+class ConvolutionLayer(FeedForwardLayer):
+    """2D convolution (reference: conf.layers.ConvolutionLayer; GPU path
+    CudnnConvolutionHelper -> here a single lax conv on the MXU).
+
+    Weights stored HWIO [kh,kw,nIn,nOut]; the reference stores OIYX
+    [nOut,nIn,kh,kw] — layout is an internal detail, fan math matches.
+    """
+
+    def __init__(self, nOut=None, kernelSize=(3, 3), stride=(1, 1), padding=(0, 0),
+                 dilation=(1, 1), convolutionMode="truncate", nIn=None, hasBias=True, **kw):
+        super().__init__(nIn=nIn, nOut=nOut, hasBias=hasBias, **kw)
+        self.kernelSize = _pair(kernelSize)
+        self.stride = _pair(stride)
+        self.padding = _pair(padding)
+        self.dilation = _pair(dilation)
+        self.convolutionMode = convolutionMode
+
+    def inferNIn(self, inputType):
+        if self.nIn is None and inputType.kind == InputType.CNN:
+            self.nIn = inputType.channels
+
+    def getOutputType(self, inputType):
+        h = _conv.conv_output_size(inputType.height, self.kernelSize[0], self.stride[0],
+                                   self.padding[0], self.dilation[0], self.convolutionMode)
+        w = _conv.conv_output_size(inputType.width, self.kernelSize[1], self.stride[1],
+                                   self.padding[1], self.dilation[1], self.convolutionMode)
+        return InputType.convolutional(h, w, self.nOut)
+
+    def initialize(self, key, inputType, dtype):
+        if self.nIn is None:
+            self.nIn = inputType.channels
+        kh, kw = self.kernelSize
+        fan_in = kh * kw * self.nIn
+        fan_out = kh * kw * self.nOut
+        kW, _ = jax.random.split(key)
+        W = _winit.init(kW, self.weightInit, (kh, kw, self.nIn, self.nOut),
+                        fan_in, fan_out, dtype, self.distribution)
+        params = {"W": W}
+        if self.hasBias:
+            params["b"] = jnp.full((self.nOut,), self.biasInit, dtype)
+        return params, {}
+
+    def forward(self, params, state, x, train, key, mask=None):
+        x = self._dropout_input(x, train, key)
+        pad = _conv.explicit_padding(self.convolutionMode, self.padding,
+                                     self.kernelSize, self.stride, self.dilation)
+        y = _conv.conv2d(x, params["W"], params.get("b"), self.stride, pad, self.dilation)
+        return _act.get(self.activation)(y), state
+
+
+class Deconvolution2D(ConvolutionLayer):
+    """Transposed conv (reference: conf.layers.Deconvolution2D)."""
+
+    def getOutputType(self, inputType):
+        h = _conv.deconv_output_size(inputType.height, self.kernelSize[0], self.stride[0],
+                                     self.padding[0], self.dilation[0], self.convolutionMode)
+        w = _conv.deconv_output_size(inputType.width, self.kernelSize[1], self.stride[1],
+                                     self.padding[1], self.dilation[1], self.convolutionMode)
+        return InputType.convolutional(h, w, self.nOut)
+
+    def forward(self, params, state, x, train, key, mask=None):
+        x = self._dropout_input(x, train, key)
+        pad = _conv.explicit_padding(self.convolutionMode, self.padding,
+                                     self.kernelSize, self.stride, self.dilation)
+        y = _conv.deconv2d(x, params["W"], params.get("b"), self.stride, pad, self.dilation)
+        return _act.get(self.activation)(y), state
+
+
+class DepthwiseConvolution2D(ConvolutionLayer):
+    """Depthwise conv (reference: conf.layers.DepthwiseConvolution2D).
+    depthMultiplier output channels per input channel via
+    feature_group_count=nIn."""
+
+    def __init__(self, depthMultiplier=1, **kw):
+        kw.setdefault("nOut", None)
+        super().__init__(**kw)
+        self.depthMultiplier = depthMultiplier
+
+    def getOutputType(self, inputType):
+        h = _conv.conv_output_size(inputType.height, self.kernelSize[0], self.stride[0],
+                                   self.padding[0], self.dilation[0], self.convolutionMode)
+        w = _conv.conv_output_size(inputType.width, self.kernelSize[1], self.stride[1],
+                                   self.padding[1], self.dilation[1], self.convolutionMode)
+        return InputType.convolutional(h, w, self.nIn * self.depthMultiplier)
+
+    def initialize(self, key, inputType, dtype):
+        if self.nIn is None:
+            self.nIn = inputType.channels
+        self.nOut = self.nIn * self.depthMultiplier
+        kh, kw = self.kernelSize
+        kW, _ = jax.random.split(key)
+        W = _winit.init(kW, self.weightInit, (kh, kw, 1, self.nOut),
+                        kh * kw, kh * kw * self.depthMultiplier, dtype, self.distribution)
+        params = {"W": W}
+        if self.hasBias:
+            params["b"] = jnp.full((self.nOut,), self.biasInit, dtype)
+        return params, {}
+
+    def forward(self, params, state, x, train, key, mask=None):
+        x = self._dropout_input(x, train, key)
+        pad = _conv.explicit_padding(self.convolutionMode, self.padding,
+                                     self.kernelSize, self.stride, self.dilation)
+        y = _conv.conv2d(x, params["W"], params.get("b"), self.stride, pad,
+                         self.dilation, groups=self.nIn)
+        return _act.get(self.activation)(y), state
+
+
+class SeparableConvolution2D(ConvolutionLayer):
+    """Depthwise + pointwise (reference: conf.layers.SeparableConvolution2D)."""
+
+    def __init__(self, depthMultiplier=1, **kw):
+        super().__init__(**kw)
+        self.depthMultiplier = depthMultiplier
+
+    def initialize(self, key, inputType, dtype):
+        if self.nIn is None:
+            self.nIn = inputType.channels
+        kh, kw = self.kernelSize
+        kD, kP = jax.random.split(key)
+        depth_out = self.nIn * self.depthMultiplier
+        Wd = _winit.init(kD, self.weightInit, (kh, kw, 1, depth_out),
+                         kh * kw, kh * kw * self.depthMultiplier, dtype, self.distribution)
+        Wp = _winit.init(kP, self.weightInit, (1, 1, depth_out, self.nOut),
+                         depth_out, self.nOut, dtype, self.distribution)
+        params = {"W": Wd, "pW": Wp}
+        if self.hasBias:
+            params["b"] = jnp.full((self.nOut,), self.biasInit, dtype)
+        return params, {}
+
+    def forward(self, params, state, x, train, key, mask=None):
+        x = self._dropout_input(x, train, key)
+        pad = _conv.explicit_padding(self.convolutionMode, self.padding,
+                                     self.kernelSize, self.stride, self.dilation)
+        y = _conv.conv2d(x, params["W"], None, self.stride, pad, self.dilation,
+                         groups=self.nIn)
+        y = _conv.conv2d(y, params["pW"], params.get("b"), (1, 1), ((0, 0), (0, 0)))
+        return _act.get(self.activation)(y), state
+
+
+class Convolution1DLayer(ConvolutionLayer):
+    """1D conv over NCW data (reference: conf.layers.Convolution1DLayer)."""
+
+    def __init__(self, nOut=None, kernelSize=3, stride=1, padding=0, dilation=1,
+                 convolutionMode="truncate", nIn=None, hasBias=True, **kw):
+        FeedForwardLayer.__init__(self, nIn=nIn, nOut=nOut, hasBias=hasBias, **kw)
+        self.kernelSize = int(kernelSize) if not isinstance(kernelSize, (tuple, list)) else int(kernelSize[0])
+        self.stride = int(stride) if not isinstance(stride, (tuple, list)) else int(stride[0])
+        self.padding = int(padding) if not isinstance(padding, (tuple, list)) else int(padding[0])
+        self.dilation = int(dilation) if not isinstance(dilation, (tuple, list)) else int(dilation[0])
+        self.convolutionMode = convolutionMode
+
+    def getOutputType(self, inputType):
+        t = inputType.dims.get("timeSeriesLength")
+        t_out = None if t is None else _conv.conv_output_size(
+            t, self.kernelSize, self.stride, self.padding, self.dilation, self.convolutionMode)
+        return InputType.recurrent(self.nOut, t_out)
+
+    def initialize(self, key, inputType, dtype):
+        if self.nIn is None:
+            self.nIn = inputType.size
+        fan_in = self.kernelSize * self.nIn
+        fan_out = self.kernelSize * self.nOut
+        kW, _ = jax.random.split(key)
+        W = _winit.init(kW, self.weightInit, (self.kernelSize, self.nIn, self.nOut),
+                        fan_in, fan_out, dtype, self.distribution)
+        params = {"W": W}
+        if self.hasBias:
+            params["b"] = jnp.full((self.nOut,), self.biasInit, dtype)
+        return params, {}
+
+    def forward(self, params, state, x, train, key, mask=None):
+        x = self._dropout_input(x, train, key)
+        xw = jnp.transpose(x, (0, 2, 1))  # NCW -> NWC
+        pad = "SAME" if str(self.convolutionMode).lower() == "same" \
+            else ((self.padding, self.padding),)
+        y = _conv.conv1d(xw, params["W"], params.get("b"), self.stride, pad, self.dilation)
+        y = _act.get(self.activation)(y)
+        return jnp.transpose(y, (0, 2, 1)), state
+
+
+class SubsamplingLayer(Layer):
+    """Max/avg/pnorm pooling (reference: conf.layers.SubsamplingLayer)."""
+
+    def __init__(self, poolingType="max", kernelSize=(2, 2), stride=(2, 2),
+                 padding=(0, 0), convolutionMode="truncate", pnorm=2, **kw):
+        super().__init__(**kw)
+        self.poolingType = poolingType
+        self.kernelSize = _pair(kernelSize)
+        self.stride = _pair(stride)
+        self.padding = _pair(padding)
+        self.convolutionMode = convolutionMode
+        self.pnorm = pnorm
+
+    def hasParams(self):
+        return False
+
+    def getOutputType(self, inputType):
+        h = _conv.conv_output_size(inputType.height, self.kernelSize[0], self.stride[0],
+                                   self.padding[0], 1, self.convolutionMode)
+        w = _conv.conv_output_size(inputType.width, self.kernelSize[1], self.stride[1],
+                                   self.padding[1], 1, self.convolutionMode)
+        return InputType.convolutional(h, w, inputType.channels)
+
+    def forward(self, params, state, x, train, key, mask=None):
+        mode = str(self.convolutionMode).lower()
+        pad = "SAME" if mode == "same" else ((self.padding[0], self.padding[0]),
+                                             (self.padding[1], self.padding[1]))
+        t = str(self.poolingType).lower()
+        if t == "max":
+            y = _pool.max_pool2d(x, self.kernelSize, self.stride, pad)
+        elif t == "avg":
+            y = _pool.avg_pool2d(x, self.kernelSize, self.stride, pad)
+        elif t == "pnorm":
+            y = _pool.pnorm_pool2d(x, self.kernelSize, self.stride, pad, self.pnorm)
+        else:
+            raise ValueError(f"Unknown poolingType {self.poolingType}")
+        return y, state
+
+
+class Upsampling2D(Layer):
+    def __init__(self, size=2, **kw):
+        super().__init__(**kw)
+        self.sizev = _pair(size)
+
+    def hasParams(self):
+        return False
+
+    def getOutputType(self, inputType):
+        return InputType.convolutional(inputType.height * self.sizev[0],
+                                       inputType.width * self.sizev[1],
+                                       inputType.channels)
+
+    def forward(self, params, state, x, train, key, mask=None):
+        return _pool.upsample2d(x, self.sizev), state
+
+
+class ZeroPaddingLayer(Layer):
+    def __init__(self, padding=(1, 1), **kw):
+        super().__init__(**kw)
+        p = padding
+        if isinstance(p, int):
+            p = (p, p, p, p)
+        elif len(p) == 2:
+            p = (p[0], p[0], p[1], p[1])
+        self.pad = tuple(int(v) for v in p)  # top, bottom, left, right
+
+    def hasParams(self):
+        return False
+
+    def getOutputType(self, inputType):
+        t, b, l, r = self.pad
+        return InputType.convolutional(inputType.height + t + b,
+                                       inputType.width + l + r, inputType.channels)
+
+    def forward(self, params, state, x, train, key, mask=None):
+        t, b, l, r = self.pad
+        return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0))), state
+
+
+class Cropping2D(Layer):
+    def __init__(self, cropping=(0, 0), **kw):
+        super().__init__(**kw)
+        c = cropping
+        if isinstance(c, int):
+            c = (c, c, c, c)
+        elif len(c) == 2:
+            c = (c[0], c[0], c[1], c[1])
+        self.crop = tuple(int(v) for v in c)
+
+    def hasParams(self):
+        return False
+
+    def getOutputType(self, inputType):
+        t, b, l, r = self.crop
+        return InputType.convolutional(inputType.height - t - b,
+                                       inputType.width - l - r, inputType.channels)
+
+    def forward(self, params, state, x, train, key, mask=None):
+        t, b, l, r = self.crop
+        H, W = x.shape[1], x.shape[2]
+        return x[:, t:H - b, l:W - r, :], state
+
+
+class GlobalPoolingLayer(Layer):
+    """Global pooling over spatial (CNN) or time (RNN) dims
+    (reference: conf.layers.GlobalPoolingLayer)."""
+
+    def __init__(self, poolingType="max", pnorm=2, collapseDimensions=True, **kw):
+        super().__init__(**kw)
+        self.poolingType = poolingType
+        self.pnorm = pnorm
+        self.collapseDimensions = collapseDimensions
+        self._mode = None  # set by getOutputType
+
+    def hasParams(self):
+        return False
+
+    def getOutputType(self, inputType):
+        if inputType.kind == InputType.CNN:
+            self._mode = "cnn"
+            return InputType.feedForward(inputType.channels)
+        if inputType.kind == InputType.RNN:
+            self._mode = "rnn"
+            return InputType.feedForward(inputType.size)
+        self._mode = "ff"
+        return inputType
+
+    def forward(self, params, state, x, train, key, mask=None):
+        if x.ndim == 4:      # [B,H,W,C]
+            y = _pool.global_pool(x, self.poolingType, (1, 2), None, self.pnorm)
+        elif x.ndim == 3:    # [B,F,T]
+            m = None if mask is None else mask[:, None, :]
+            y = _pool.global_pool(x, self.poolingType, (2,), m, self.pnorm)
+        else:
+            y = x
+        return y, state
+
+
+class BatchNormalization(Layer):
+    """Batch norm over the channel axis (reference:
+    conf.layers.BatchNormalization + CudnnBatchNormalizationHelper)."""
+
+    def __init__(self, decay=0.9, eps=1e-5, gamma=1.0, beta=0.0, lockGammaBeta=False,
+                 useLogStd=False, nOut=None, nIn=None, **kw):
+        super().__init__(**kw)
+        self.decay, self.eps = decay, eps
+        self.gammaInit, self.betaInit = gamma, beta
+        self.lockGammaBeta = lockGammaBeta
+        self.nIn, self.nOut = nIn, nOut
+
+    def getOutputType(self, inputType):
+        return inputType
+
+    def _nfeat(self, inputType):
+        if inputType.kind == InputType.CNN:
+            return inputType.channels
+        if inputType.kind == InputType.RNN:
+            return inputType.size
+        return inputType.size
+
+    def initialize(self, key, inputType, dtype):
+        n = self.nOut or self._nfeat(inputType)
+        self.nOut = self.nIn = n
+        params = {}
+        if not self.lockGammaBeta:
+            params["gamma"] = jnp.full((n,), self.gammaInit, dtype)
+            params["beta"] = jnp.full((n,), self.betaInit, dtype)
+        state = {"mean": jnp.zeros((n,), jnp.float32), "var": jnp.ones((n,), jnp.float32)}
+        return params, state
+
+    def forward(self, params, state, x, train, key, mask=None):
+        is_rnn = x.ndim == 3
+        if is_rnn:  # [B,F,T] -> [B,T,F] so channels are last
+            x = jnp.transpose(x, (0, 2, 1))
+        y, rm, rv = _norm.batch_norm(
+            x, params.get("gamma"), params.get("beta"),
+            state["mean"], state["var"], train=train, decay=self.decay, eps=self.eps)
+        if is_rnn:
+            y = jnp.transpose(y, (0, 2, 1))
+        return _act.get(self.activation)(y), {"mean": rm, "var": rv}
+
+
+class LocalResponseNormalization(Layer):
+    def __init__(self, k=2.0, n=5, alpha=1e-4, beta=0.75, **kw):
+        super().__init__(**kw)
+        self.k, self.n, self.alpha, self.beta = k, n, alpha, beta
+
+    def hasParams(self):
+        return False
+
+    def forward(self, params, state, x, train, key, mask=None):
+        return _norm.lrn(x, self.k, self.n, self.alpha, self.beta), state

@@ -1,0 +1,115 @@
+"""Input preprocessors: format adapters between layer families.
+
+Reference: org.deeplearning4j.nn.conf.preprocessor.* (CnnToFeedForward,
+FeedForwardToCnn, RnnToFeedForward, FeedForwardToRnn, CnnToRnn). As in the
+reference, ListBuilder auto-inserts these from InputType inference; users
+can also set them explicitly per layer index.
+
+Internal formats: FF [B,N]; CNN NHWC [B,H,W,C]; RNN NCW [B,F,T].
+Flattening order for CNN->FF is the reference's [C,H,W] row-major order so
+flat feature indices line up with the reference (and Keras-import weights).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+
+class InputPreProcessor:
+    def preProcess(self, x, mask=None):
+        raise NotImplementedError
+
+    def getOutputType(self, inputType: InputType) -> InputType:
+        raise NotImplementedError
+
+
+class CnnToFeedForwardPreProcessor(InputPreProcessor):
+    def __init__(self, inputHeight=None, inputWidth=None, numChannels=None):
+        self.inputHeight, self.inputWidth, self.numChannels = inputHeight, inputWidth, numChannels
+
+    def preProcess(self, x, mask=None):
+        # NHWC -> NCHW order -> flat, matching reference flatten order
+        b = x.shape[0]
+        return jnp.transpose(x, (0, 3, 1, 2)).reshape(b, -1)
+
+    def getOutputType(self, inputType):
+        return InputType.feedForward(
+            inputType.height * inputType.width * inputType.channels)
+
+
+class FeedForwardToCnnPreProcessor(InputPreProcessor):
+    def __init__(self, inputHeight, inputWidth, numChannels):
+        self.inputHeight, self.inputWidth, self.numChannels = inputHeight, inputWidth, numChannels
+
+    def preProcess(self, x, mask=None):
+        b = x.shape[0]
+        x = x.reshape(b, self.numChannels, self.inputHeight, self.inputWidth)
+        return jnp.transpose(x, (0, 2, 3, 1))  # NCHW -> NHWC
+
+    def getOutputType(self, inputType):
+        return InputType.convolutional(self.inputHeight, self.inputWidth, self.numChannels)
+
+
+class RnnToFeedForwardPreProcessor(InputPreProcessor):
+    """[B,F,T] -> [B*T,F]: apply FF layers per timestep."""
+
+    def preProcess(self, x, mask=None):
+        b, f, t = x.shape
+        return jnp.transpose(x, (0, 2, 1)).reshape(b * t, f)
+
+    def getOutputType(self, inputType):
+        return InputType.feedForward(inputType.size)
+
+
+class FeedForwardToRnnPreProcessor(InputPreProcessor):
+    """[B*T,N] -> [B,N,T]. Needs the original batch size at runtime; the
+    network passes it via the `batch` attribute set per forward."""
+
+    def __init__(self):
+        self.batch = None
+
+    def preProcess(self, x, mask=None):
+        bt, n = x.shape
+        b = self.batch if self.batch is not None else bt
+        t = bt // b
+        return jnp.transpose(x.reshape(b, t, n), (0, 2, 1))
+
+    def getOutputType(self, inputType):
+        return InputType.recurrent(inputType.size)
+
+
+class RnnToCnnPreProcessor(InputPreProcessor):
+    """[B,C*H*W,T] -> [B*T,H,W,C]."""
+
+    def __init__(self, inputHeight, inputWidth, numChannels):
+        self.inputHeight, self.inputWidth, self.numChannels = inputHeight, inputWidth, numChannels
+
+    def preProcess(self, x, mask=None):
+        b, f, t = x.shape
+        x = jnp.transpose(x, (0, 2, 1)).reshape(
+            b * t, self.numChannels, self.inputHeight, self.inputWidth)
+        return jnp.transpose(x, (0, 2, 3, 1))
+
+    def getOutputType(self, inputType):
+        return InputType.convolutional(self.inputHeight, self.inputWidth, self.numChannels)
+
+
+class CnnToRnnPreProcessor(InputPreProcessor):
+    """[B*T,H,W,C] -> [B,C*H*W,T]."""
+
+    def __init__(self, inputHeight, inputWidth, numChannels):
+        self.inputHeight, self.inputWidth, self.numChannels = inputHeight, inputWidth, numChannels
+        self.batch = None
+
+    def preProcess(self, x, mask=None):
+        bt = x.shape[0]
+        b = self.batch if self.batch is not None else bt
+        t = bt // b
+        flat = jnp.transpose(x, (0, 3, 1, 2)).reshape(bt, -1)
+        return jnp.transpose(flat.reshape(b, t, -1), (0, 2, 1))
+
+    def getOutputType(self, inputType):
+        return InputType.recurrent(
+            inputType.height * inputType.width * inputType.channels)

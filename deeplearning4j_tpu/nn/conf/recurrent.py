@@ -1,0 +1,232 @@
+"""Recurrent layer configurations.
+
+Reference: org.deeplearning4j.nn.conf.layers.{LSTM, GravesLSTM, SimpleRnn,
+Bidirectional, LastTimeStep} executed by nn.layers.recurrent.* with the
+cuDNN LSTM helper on GPU. Here the cells are the fused scans in
+ops/rnn.py: one big input-projection GEMM for all timesteps on the MXU,
+then a lax.scan carrying only the recurrent matmul.
+
+Data format between layers is the reference's NCW [B, features, time];
+time-major conversion happens inside forward. Stateful truncated-BPTT
+inference (rnnTimeStep) is supported by passing/returning the carry via
+the layer state dict under "h"/"c".
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import activations as _act
+from deeplearning4j_tpu.nn import weights as _winit
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import FeedForwardLayer
+from deeplearning4j_tpu.ops import rnn as _rnn
+
+
+class BaseRecurrentLayer(FeedForwardLayer):
+    def __init__(self, nOut=None, nIn=None, activation="tanh",
+                 gateActivationFn="sigmoid", forgetGateBiasInit=1.0, **kw):
+        super().__init__(nIn=nIn, nOut=nOut, **kw)
+        if self.activation is None:
+            self.activation = activation
+        self.gateActivationFn = gateActivationFn
+        self.forgetGateBiasInit = forgetGateBiasInit
+
+    def getOutputType(self, inputType):
+        return InputType.recurrent(self.nOut, inputType.dims.get("timeSeriesLength"))
+
+    def mergeGlobals(self, defaults):
+        # recurrent layers default to tanh, not the net's global activation
+        act_before = self.activation
+        super().mergeGlobals(defaults)
+        if act_before is not None:
+            self.activation = act_before
+
+
+class LSTM(BaseRecurrentLayer):
+    """LSTM without peepholes (reference: conf.layers.LSTM — the
+    cuDNN-compatible variant)."""
+
+    _peephole = False
+
+    def initialize(self, key, inputType, dtype):
+        if self.nIn is None:
+            self.nIn = inputType.size
+        H = self.nOut
+        kW, kR = jax.random.split(key)
+        W = _winit.init(kW, self.weightInit, (self.nIn, 4 * H), self.nIn, H,
+                        dtype, self.distribution)
+        RW = _winit.init(kR, self.weightInit, (H, 4 * H), H, H, dtype, self.distribution)
+        # bias layout [i, f, o, g]; forget-gate slice gets forgetGateBiasInit
+        b = jnp.zeros((4 * H,), dtype)
+        b = b.at[H:2 * H].set(self.forgetGateBiasInit)
+        params = {"W": W, "RW": RW, "b": b}
+        if self._peephole:
+            params["pi"] = jnp.zeros((H,), dtype)
+            params["pf"] = jnp.zeros((H,), dtype)
+            params["po"] = jnp.zeros((H,), dtype)
+        return params, {}
+
+    def _gates(self, params):
+        """Repack [i,f,o,g] bias/weight layout to the scan's split order."""
+        return params["W"], params["RW"], params["b"]
+
+    def forward(self, params, state, x, train, key, mask=None):
+        x = self._dropout_input(x, train, key)
+        x_tbf = jnp.transpose(x, (2, 0, 1))  # [B,F,T] -> [T,B,F]
+        peep = (params["pi"], params["pf"], params["po"]) if self._peephole else None
+        h0 = state.get("h") if state else None
+        c0 = state.get("c") if state else None
+        ys, (h_t, c_t) = _rnn.lstm_scan(
+            x_tbf, params["W"], params["RW"], params["b"], h0=h0, c0=c0,
+            peephole=peep,
+            activation=_act.get(self.activation),
+            gate_activation=_act.get(self.gateActivationFn))
+        if mask is not None:
+            # zero outputs at masked timesteps (reference mask semantics)
+            ys = ys * jnp.transpose(mask, (1, 0))[:, :, None]
+        y = jnp.transpose(ys, (1, 2, 0))  # [T,B,H] -> [B,H,T]
+        # expose the final carry for tbptt / rnnTimeStep; the network
+        # decides whether to feed it back (standard backprop drops it)
+        return y, {**(state or {}), "h": h_t, "c": c_t}
+
+
+class GravesLSTM(LSTM):
+    """LSTM with peephole connections (reference: conf.layers.GravesLSTM,
+    Graves 2013)."""
+
+    _peephole = True
+
+
+class SimpleRnn(BaseRecurrentLayer):
+    def initialize(self, key, inputType, dtype):
+        if self.nIn is None:
+            self.nIn = inputType.size
+        H = self.nOut
+        kW, kR = jax.random.split(key)
+        W = _winit.init(kW, self.weightInit, (self.nIn, H), self.nIn, H, dtype, self.distribution)
+        RW = _winit.init(kR, self.weightInit, (H, H), H, H, dtype, self.distribution)
+        return {"W": W, "RW": RW, "b": jnp.zeros((H,), dtype)}, {}
+
+    def forward(self, params, state, x, train, key, mask=None):
+        x = self._dropout_input(x, train, key)
+        x_tbf = jnp.transpose(x, (2, 0, 1))
+        h0 = state.get("h") if state else None
+        ys, h_t = _rnn.simple_rnn_scan(x_tbf, params["W"], params["RW"], params["b"],
+                                       h0=h0, activation=_act.get(self.activation))
+        if mask is not None:
+            ys = ys * jnp.transpose(mask, (1, 0))[:, :, None]
+        return jnp.transpose(ys, (1, 2, 0)), {**(state or {}), "h": h_t}
+
+
+class GRU(BaseRecurrentLayer):
+    """GRU (TPU-first extension; the reference fork exposes GRU via
+    SameDiff sd.rnn.gru)."""
+
+    def initialize(self, key, inputType, dtype):
+        if self.nIn is None:
+            self.nIn = inputType.size
+        H = self.nOut
+        kW, kR = jax.random.split(key)
+        W = _winit.init(kW, self.weightInit, (self.nIn, 3 * H), self.nIn, H, dtype, self.distribution)
+        RW = _winit.init(kR, self.weightInit, (H, 3 * H), H, H, dtype, self.distribution)
+        return {"W": W, "RW": RW, "b": jnp.zeros((3 * H,), dtype)}, {}
+
+    def forward(self, params, state, x, train, key, mask=None):
+        x = self._dropout_input(x, train, key)
+        x_tbf = jnp.transpose(x, (2, 0, 1))
+        h0 = state.get("h") if state else None
+        ys, h_t = _rnn.gru_scan(x_tbf, params["W"], params["RW"], params["b"], h0=h0,
+                                activation=_act.get(self.activation),
+                                gate_activation=_act.get(self.gateActivationFn))
+        if mask is not None:
+            ys = ys * jnp.transpose(mask, (1, 0))[:, :, None]
+        return jnp.transpose(ys, (1, 2, 0)), {**(state or {}), "h": h_t}
+
+
+class Bidirectional(FeedForwardLayer):
+    """Wraps a recurrent layer to run both directions
+    (reference: conf.layers.recurrent.Bidirectional; modes CONCAT/ADD/
+    MUL/AVERAGE)."""
+
+    CONCAT, ADD, MUL, AVERAGE = "concat", "add", "mul", "average"
+
+    def __init__(self, layer=None, mode="concat", **kw):
+        super().__init__(**kw)
+        if layer is None:
+            raise ValueError("Bidirectional requires an inner recurrent layer")
+        self.layer = layer
+        self.mode = str(mode).lower()
+        self.nOut = None
+
+    def mergeGlobals(self, defaults):
+        super().mergeGlobals(defaults)
+        self.layer.mergeGlobals(defaults)
+
+    def getOutputType(self, inputType):
+        inner = self.layer.getOutputType(inputType)
+        n = inner.size * 2 if self.mode == self.CONCAT else inner.size
+        self.nOut = n
+        return InputType.recurrent(n, inputType.dims.get("timeSeriesLength"))
+
+    def initialize(self, key, inputType, dtype):
+        kf, kb = jax.random.split(key)
+        import copy
+        self._bwd_layer = copy.deepcopy(self.layer)
+        pf, sf = self.layer.initialize(kf, inputType, dtype)
+        pb, sb = self._bwd_layer.initialize(kb, inputType, dtype)
+        self.nOut = self.layer.nOut * 2 if self.mode == self.CONCAT else self.layer.nOut
+        return {"fwd": pf, "bwd": pb}, {"fwd": sf, "bwd": sb}
+
+    def forward(self, params, state, x, train, key, mask=None):
+        kf = None if key is None else jax.random.fold_in(key, 0)
+        kb = None if key is None else jax.random.fold_in(key, 1)
+        yf, sf = self.layer.forward(params["fwd"], state.get("fwd", {}), x, train, kf, mask)
+        x_rev = jnp.flip(x, axis=2)
+        m_rev = None if mask is None else jnp.flip(mask, axis=1)
+        yb, sb = self._bwd_layer.forward(params["bwd"], state.get("bwd", {}), x_rev, train, kb, m_rev)
+        yb = jnp.flip(yb, axis=2)
+        if self.mode == self.CONCAT:
+            y = jnp.concatenate([yf, yb], axis=1)
+        elif self.mode == self.ADD:
+            y = yf + yb
+        elif self.mode == self.MUL:
+            y = yf * yb
+        else:
+            y = 0.5 * (yf + yb)
+        return y, {"fwd": sf, "bwd": sb}
+
+
+class LastTimeStep(FeedForwardLayer):
+    """Wraps a recurrent layer, emitting only the final (optionally masked)
+    timestep as FF data (reference: conf.layers.recurrent.LastTimeStep)."""
+
+    def __init__(self, layer=None, **kw):
+        super().__init__(**kw)
+        if layer is None:
+            raise ValueError("LastTimeStep requires an inner recurrent layer")
+        self.layer = layer
+        self.nOut = None
+
+    def mergeGlobals(self, defaults):
+        super().mergeGlobals(defaults)
+        self.layer.mergeGlobals(defaults)
+
+    def getOutputType(self, inputType):
+        inner = self.layer.getOutputType(inputType)
+        self.nOut = inner.size
+        return InputType.feedForward(inner.size)
+
+    def initialize(self, key, inputType, dtype):
+        return self.layer.initialize(key, inputType, dtype)
+
+    def forward(self, params, state, x, train, key, mask=None):
+        y, s = self.layer.forward(params, state, x, train, key, mask)
+        if mask is None:
+            out = y[:, :, -1]
+        else:
+            # index of last unmasked step per example
+            idx = jnp.sum(mask, axis=1).astype(jnp.int32) - 1
+            out = jnp.take_along_axis(y, idx[:, None, None], axis=2)[:, :, 0]
+        return out, s

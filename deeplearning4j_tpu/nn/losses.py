@@ -1,0 +1,105 @@
+"""Loss functions.
+
+Reference: org.nd4j.linalg.lossfunctions.LossFunctions.LossFunction and the
+ILossFunction impls. Each loss here is
+``loss(labels, preactivations, activation_name, mask) -> scalar mean loss``
+computed from *pre-activation* outputs so that softmax+xent /
+sigmoid+binary-xent fuse into numerically-stable logsumexp forms (the
+reference pairs separate activation and loss kernels and special-cases
+"softmax+mcxent" for stability; jax.nn gives us the stable forms directly).
+Masking matches the reference's per-timestep mask semantics: masked
+elements contribute zero loss and the mean is over unmasked elements.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import activations as _act
+
+
+class LossFunctions:
+    class LossFunction:
+        MSE = "mse"
+        L2 = "l2"
+        MAE = "mae"
+        L1 = "l1"
+        MCXENT = "mcxent"
+        NEGATIVELOGLIKELIHOOD = "negativeloglikelihood"
+        XENT = "xent"  # binary cross-entropy
+        HINGE = "hinge"
+        SQUARED_HINGE = "squared_hinge"
+        KL_DIVERGENCE = "kl_divergence"
+        POISSON = "poisson"
+        COSINE_PROXIMITY = "cosine_proximity"
+
+
+def _apply_mask_mean(per_elem, mask):
+    """Mean over unmasked elements. per_elem has shape [batch, ...]."""
+    if mask is None:
+        return jnp.mean(jnp.sum(per_elem, axis=tuple(range(1, per_elem.ndim))))
+    # mask is per example/timestep ([batch] or [batch, time]); broadcast over
+    # the output dim and normalise by the unmasked count, like the reference.
+    n_unmasked = jnp.maximum(jnp.sum(mask), 1.0)
+    while mask.ndim < per_elem.ndim:
+        mask = mask[..., None]
+    return jnp.sum(per_elem * mask) / n_unmasked
+
+
+def compute(loss_name, labels, preact, activation="identity", mask=None, weights=None):
+    """Mean loss over the batch (reference: ILossFunction.computeScore)."""
+    name = str(loss_name).lower()
+    act = _act.get(activation)
+
+    if name in ("mcxent", "negativeloglikelihood"):
+        if activation == "softmax":
+            logp = jax.nn.log_softmax(preact, axis=-1)
+        else:
+            logp = jnp.log(jnp.clip(act(preact), 1e-10, 1.0))
+        per = -labels * logp
+        if weights is not None:
+            per = per * weights
+        return _apply_mask_mean(per, mask)
+
+    if name == "xent":
+        if activation == "sigmoid":
+            # stable sigmoid BCE from logits
+            per = jnp.maximum(preact, 0) - preact * labels + jnp.log1p(jnp.exp(-jnp.abs(preact)))
+        else:
+            p = jnp.clip(act(preact), 1e-10, 1.0 - 1e-10)
+            per = -(labels * jnp.log(p) + (1 - labels) * jnp.log1p(-p))
+        if weights is not None:
+            per = per * weights
+        return _apply_mask_mean(per, mask)
+
+    out = act(preact)
+    if name in ("mse", "l2"):
+        per = jnp.square(out - labels)
+        if name == "mse":
+            per = per  # reference L2 = sum sq; MSE = mean over output dim
+    elif name in ("mae", "l1"):
+        per = jnp.abs(out - labels)
+    elif name == "hinge":
+        per = jnp.maximum(0.0, 1.0 - labels * out)
+    elif name == "squared_hinge":
+        per = jnp.square(jnp.maximum(0.0, 1.0 - labels * out))
+    elif name == "kl_divergence":
+        p = jnp.clip(labels, 1e-10, 1.0)
+        q = jnp.clip(out, 1e-10, 1.0)
+        per = p * (jnp.log(p) - jnp.log(q))
+    elif name == "poisson":
+        per = out - labels * jnp.log(jnp.clip(out, 1e-10, None))
+    elif name == "cosine_proximity":
+        ln = labels / (jnp.linalg.norm(labels, axis=-1, keepdims=True) + 1e-10)
+        on = out / (jnp.linalg.norm(out, axis=-1, keepdims=True) + 1e-10)
+        per = -ln * on
+    else:
+        raise ValueError(f"Unknown loss function '{loss_name}'")
+
+    if weights is not None:
+        per = per * weights
+    if name == "mse":
+        # mean over output dim as well (reference MSE divides by nOut)
+        per = per / per.shape[-1]
+    return _apply_mask_mean(per, mask)

@@ -1,0 +1,445 @@
+"""MultiLayerNetwork — the sequential network executor.
+
+Reference: org.deeplearning4j.nn.multilayer.MultiLayerNetwork. The
+reference executes layers one-by-one through mutable Layer objects with
+workspace-managed activations, then a Solver/StochasticGradientDescent
+optimize step and a BaseMultiLayerUpdater over a flattened gradient view.
+
+TPU design: the whole training step — forward, loss (+regularization),
+backward (jax.grad), gradient normalization, per-layer updater, parameter
+update — is ONE jitted function compiled by XLA into a single fused
+computation. Parameters, updater state and layer state (BN running stats)
+are donated device buffers: XLA reuses their memory in-place, which is the
+role the reference's workspaces play. fit()/output()/score() keep the
+reference's signatures.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ndarray import INDArray, Nd4j
+from deeplearning4j_tpu.nn import losses as _losses
+from deeplearning4j_tpu.nn import updaters as _upd
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.builder import BackpropType, GradientNormalization
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+
+def _unwrap(x):
+    if isinstance(x, INDArray):
+        return x.jax()
+    if x is None:
+        return None
+    return jnp.asarray(x)
+
+
+def _grad_normalize(grads_per_layer, mode, threshold):
+    """Gradient clipping/normalization (reference:
+    org.deeplearning4j.nn.conf.GradientNormalization, applied in
+    BaseLayer.backpropGradient)."""
+    if mode is None:
+        return grads_per_layer
+    out = []
+    for g in grads_per_layer:
+        if not g:
+            out.append(g)
+            continue
+        if mode == GradientNormalization.ClipElementWiseAbsoluteValue:
+            g = jax.tree_util.tree_map(lambda a: jnp.clip(a, -threshold, threshold), g)
+        elif mode in (GradientNormalization.ClipL2PerLayer,
+                      GradientNormalization.RenormalizeL2PerLayer):
+            leaves = jax.tree_util.tree_leaves(g)
+            l2 = jnp.sqrt(sum(jnp.sum(jnp.square(a)) for a in leaves) + 1e-12)
+            if mode == GradientNormalization.ClipL2PerLayer:
+                scale = jnp.minimum(1.0, threshold / l2)
+            else:
+                scale = 1.0 / l2
+            g = jax.tree_util.tree_map(lambda a: a * scale, g)
+        elif mode in (GradientNormalization.ClipL2PerParamType,
+                      GradientNormalization.RenormalizeL2PerParamType):
+            def per_param(a):
+                l2 = jnp.sqrt(jnp.sum(jnp.square(a)) + 1e-12)
+                if mode == GradientNormalization.ClipL2PerParamType:
+                    return a * jnp.minimum(1.0, threshold / l2)
+                return a / l2
+            g = jax.tree_util.tree_map(per_param, g)
+        out.append(g)
+    return out
+
+
+class MultiLayerNetwork:
+    def __init__(self, conf):
+        self.conf = conf
+        self.layers = conf.layers
+        self._params = None        # list[dict] per layer
+        self._states = None        # list[dict] per layer
+        self._upd_states = None    # list per layer
+        self._updaters = None
+        self._iteration = 0
+        self._epoch = 0
+        self._listeners = []
+        self._rnn_state = None     # stateful rnnTimeStep carries
+        self._compute_dtype = conf.dataType.np_dtype
+        # params kept fp32 for stable updates even when compute is bf16/fp16;
+        # fp64 dataType (gradient checks) promotes params too
+        self._param_dtype = jnp.float64 if self._compute_dtype == jnp.float64 else jnp.float32
+        self._jit_train = jax.jit(
+            self._train_step,
+            static_argnames=("use_carries",),
+            donate_argnums=(0, 1, 2),
+        )
+        self._jit_forward = jax.jit(self._forward_infer)
+        self._jit_loss = jax.jit(self._loss_only)
+
+    # ------------------------------------------------------------------
+    # initialization
+    # ------------------------------------------------------------------
+    def init(self):
+        key = jax.random.key(self.conf.seed)
+        params, states, upds, upd_states = [], [], [], []
+        for i, layer in enumerate(self.layers):
+            k = jax.random.fold_in(key, i)
+            p, s = layer.initialize(k, self.conf.layerInputTypes[i], self._param_dtype)
+            params.append(p)
+            states.append(s)
+            u = _upd.resolve(layer.updater) if layer.updater is not None else _upd.Sgd()
+            upds.append(u)
+            upd_states.append(u.init(p) if p else ())
+        self._params, self._states = params, states
+        self._updaters, self._upd_states = upds, upd_states
+        self._iteration = 0
+        return self
+
+    # ------------------------------------------------------------------
+    # pure functions (traced under jit)
+    # ------------------------------------------------------------------
+    def _entry(self, x):
+        """API-format input -> internal format (one transpose at entry)."""
+        it = self.conf.inputType
+        if it.kind == InputType.CNN and x.ndim == 4:
+            x = jnp.transpose(x, (0, 2, 3, 1))  # NCHW -> NHWC
+        return x.astype(self._compute_dtype)
+
+    def _cast_params(self, p):
+        """Params (fp32 master) -> compute dtype, shared by every forward path."""
+        if self._compute_dtype == self._param_dtype:
+            return p
+        return jax.tree_util.tree_map(
+            lambda a: a.astype(self._compute_dtype)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a, p)
+
+    def _run_layers(self, params, states, x, train, key, fmask):
+        h = self._entry(x)
+        new_states = []
+        for i, layer in enumerate(self.layers):
+            pp = self.conf.preprocessors.get(i)
+            if pp is not None:
+                if hasattr(pp, "batch"):
+                    pp.batch = x.shape[0]
+                h = pp.preProcess(h, fmask)
+            lk = None if key is None else jax.random.fold_in(key, i)
+            p = self._cast_params(params[i])
+            if i == len(self.layers) - 1 and isinstance(layer, (L.BaseOutputLayer, L.LossLayer)):
+                # dropout applies to the output layer's input too
+                h = layer._dropout_input(h, train, lk)
+                preact = layer.preoutput(p, h)
+                new_states.append(states[i])
+                return preact, new_states
+            h, s = layer.forward(p, states[i], h, train, lk, fmask)
+            new_states.append(s)
+        return h, new_states
+
+    def _loss_from_preact(self, preact, labels, lmask):
+        last = self.layers[-1]
+        if isinstance(last, (L.BaseOutputLayer, L.LossLayer)):
+            if preact.ndim == 3:  # RnnOutputLayer: [B,O,T] -> loss over [B,T,O]
+                pre = jnp.transpose(preact, (0, 2, 1))
+                lab = jnp.transpose(labels, (0, 2, 1))
+                return _losses.compute(last.lossFunction, lab, pre,
+                                       last.activation, lmask)
+            return _losses.compute(last.lossFunction, labels, preact,
+                                   last.activation, lmask)
+        raise ValueError("Final layer must be an OutputLayer/LossLayer to compute loss")
+
+    def _regularization(self, params):
+        reg = 0.0
+        for layer, p in zip(self.layers, params):
+            if p:
+                reg = reg + layer.regularization(p)
+        return reg
+
+    def _loss_fn(self, params, states, x, y, key, fmask, lmask, use_carries):
+        run_states = states if use_carries else self._strip_carries(states)
+        preact, new_states = self._run_layers(params, run_states, x, True, key, fmask)
+        # loss math in >= fp32 (bf16 compute still gets an fp32 loss; fp64
+        # gradient checks keep fp64)
+        ldt = jnp.promote_types(preact.dtype, jnp.float32)
+        loss = self._loss_from_preact(preact.astype(ldt), _unwrap(y).astype(ldt), lmask)
+        loss = loss + self._regularization(params)
+        return loss, new_states
+
+    def _train_step(self, params, upd_states, states, iteration, x, y, key,
+                    fmask, lmask, use_carries=False):
+        (loss, new_states), grads = jax.value_and_grad(
+            self._loss_fn, has_aux=True)(params, states, x, y, key, fmask, lmask,
+                                         use_carries)
+        grads = _grad_normalize(grads, self.conf.gradientNormalization,
+                                self.conf.gradientNormalizationThreshold)
+        new_params, new_upd_states = [], []
+        for i in range(len(self.layers)):
+            if not params[i]:
+                new_params.append(params[i])
+                new_upd_states.append(upd_states[i])
+                continue
+            upd, us = self._updaters[i].apply(grads[i], upd_states[i], iteration)
+            # cast keeps param dtype stable (python-float hyperparams would
+            # otherwise promote under x64)
+            new_params.append(jax.tree_util.tree_map(
+                lambda p, u: (p - u).astype(p.dtype), params[i], upd))
+            new_upd_states.append(us)
+        return new_params, new_upd_states, new_states, loss
+
+    @staticmethod
+    def _out_act(layer, pre):
+        """Apply the output activation over the CLASS axis. NCW [B,O,T]
+        recurrent output needs softmax over O, not the trailing time axis."""
+        from deeplearning4j_tpu.nn import activations as _act
+
+        act = _act.get(layer.activation)
+        if pre.ndim == 3:
+            return jnp.transpose(act(jnp.transpose(pre, (0, 2, 1))), (0, 2, 1))
+        return act(pre)
+
+    def _forward_infer(self, params, states, x, fmask=None):
+        last = self.layers[-1]
+        preact_or_h, _ = self._run_layers(params, self._strip_carries(states),
+                                          x, False, None, fmask)
+        if isinstance(last, (L.BaseOutputLayer, L.LossLayer)):
+            return self._out_act(last, preact_or_h)
+        return preact_or_h
+
+    def _loss_only(self, params, states, x, y, fmask=None, lmask=None):
+        preact, _ = self._run_layers(params, self._strip_carries(states),
+                                     x, False, None, fmask)
+        ldt = jnp.promote_types(preact.dtype, jnp.float32)
+        loss = self._loss_from_preact(preact.astype(ldt), _unwrap(y).astype(ldt), lmask)
+        return loss + self._regularization(params)
+
+    @staticmethod
+    def _strip_carries(states):
+        """Drop transient rnn carries (h/c) so fresh sequences start at 0;
+        keep persistent state like BN running stats."""
+
+        def strip(s):
+            if isinstance(s, dict):
+                return {k: strip(v) for k, v in s.items() if k not in ("h", "c")}
+            return s
+
+        return [strip(s) for s in states]
+
+    # ------------------------------------------------------------------
+    # public API (reference signatures)
+    # ------------------------------------------------------------------
+    def fit(self, data, labels=None, epochs=None):
+        """fit(x, y) | fit(DataSet) | fit(DataSetIterator[, epochs])."""
+        from deeplearning4j_tpu.data.dataset import DataSet
+
+        if labels is not None:
+            ds = DataSet(data, labels)
+            self._fit_batch(ds)
+            return self
+        if isinstance(data, DataSet):
+            self._fit_batch(data)
+            return self
+        # iterator
+        n_epochs = epochs or 1
+        for _ in range(n_epochs):
+            data.reset()
+            while data.hasNext():
+                self._fit_batch(data.next())
+            self._epoch += 1
+        return self
+
+    def _require_init(self):
+        if self._params is None:
+            raise RuntimeError(
+                "Network is not initialized — call net.init() before "
+                "fit/output/score (reference: MultiLayerNetwork.init())")
+
+    def _fit_batch(self, ds):
+        self._require_init()
+        x = _unwrap(ds.getFeatures())
+        y = _unwrap(ds.getLabels())
+        fmask = _unwrap(ds.getFeaturesMaskArray())
+        lmask = _unwrap(ds.getLabelsMaskArray())
+        if self.conf.backpropType == BackpropType.TruncatedBPTT and x.ndim == 3:
+            self._fit_tbptt(x, y, fmask, lmask)
+            return
+        key = jax.random.fold_in(jax.random.key(self.conf.seed ^ 0x5EED), self._iteration)
+        self._params, self._upd_states, self._states, loss = self._jit_train(
+            self._params, self._upd_states, self._states,
+            jnp.asarray(self._iteration, jnp.int32), x, y, key, fmask, lmask)
+        self._score = float(loss)
+        self._iteration += 1
+        for lst in self._listeners:
+            lst.iterationDone(self, self._iteration, self._epoch)
+
+    def _fit_tbptt(self, x, y, fmask, lmask):
+        """Truncated BPTT: split time into tbpttFwdLength chunks, carrying
+        h/c across chunks (reference: MultiLayerNetwork.doTruncatedBPTT)."""
+        T = x.shape[2]
+        L_ = self.conf.tbpttFwdLength
+        n_chunks = math.ceil(T / L_)
+        states = self._states
+        for c in range(n_chunks):
+            sl = slice(c * L_, min((c + 1) * L_, T))
+            xc, yc = x[:, :, sl], y[:, :, sl]
+            fm = None if fmask is None else fmask[:, sl]
+            lm = None if lmask is None else lmask[:, sl]
+            key = jax.random.fold_in(jax.random.key(self.conf.seed ^ 0x5EED), self._iteration)
+            self._params, self._upd_states, states, loss = self._jit_train(
+                self._params, self._upd_states, states,
+                jnp.asarray(self._iteration, jnp.int32), xc, yc, key, fm, lm,
+                use_carries=c > 0)
+            # stop gradients/carries from being donated stale on last chunk
+            self._score = float(loss)
+            self._iteration += 1
+            for lst in self._listeners:
+                lst.iterationDone(self, self._iteration, self._epoch)
+        self._states = self._strip_carries(states)
+
+    def output(self, x, train=False) -> INDArray:
+        self._require_init()
+        out = self._jit_forward(self._params, self._states, _unwrap(x))
+        return INDArray(out)
+
+    def feedForward(self, x) -> list:
+        """All layer activations (eager; reference returns the list)."""
+        x = _unwrap(x)
+        h = self._entry(x)
+        acts = [INDArray(h)]
+        states = self._strip_carries(self._states)
+        for i, layer in enumerate(self.layers):
+            pp = self.conf.preprocessors.get(i)
+            if pp is not None:
+                if hasattr(pp, "batch"):
+                    pp.batch = x.shape[0]
+                h = pp.preProcess(h, None)
+            h, _ = layer.forward(self._cast_params(self._params[i]), states[i],
+                                 h, False, None, None)
+            acts.append(INDArray(h))
+        return acts
+
+    def score(self, dataset=None) -> float:
+        if dataset is None:
+            return getattr(self, "_score", float("nan"))
+        x = _unwrap(dataset.getFeatures())
+        y = _unwrap(dataset.getLabels())
+        return float(self._jit_loss(self._params, self._states, x, y,
+                                    _unwrap(dataset.getFeaturesMaskArray()),
+                                    _unwrap(dataset.getLabelsMaskArray())))
+
+    def computeGradientAndScore(self, x, y):
+        """(grads, score) for gradient checks (reference:
+        Model.computeGradientAndScore)."""
+        (loss, _), grads = jax.value_and_grad(self._loss_fn, has_aux=True)(
+            self._params, self._states, _unwrap(x), _unwrap(y), None, None, None, False)
+        return grads, float(loss)
+
+    def evaluate(self, iterator):
+        from deeplearning4j_tpu.evaluation.evaluation import Evaluation
+
+        e = Evaluation()
+        iterator.reset()
+        while iterator.hasNext():
+            ds = iterator.next()
+            out = self.output(ds.getFeatures())
+            e.eval(ds.getLabels(), out, mask=ds.getLabelsMaskArray())
+        return e
+
+    # ----- rnn stateful inference -------------------------------------
+    def rnnTimeStep(self, x) -> INDArray:
+        """Stateful single/multi-step inference for generation
+        (reference: MultiLayerNetwork.rnnTimeStep)."""
+        x = _unwrap(x)
+        squeeze_out = x.ndim == 2
+        if squeeze_out:
+            x = x[:, :, None]
+        states = self._rnn_state if self._rnn_state is not None \
+            else self._strip_carries(self._states)
+        h = self._entry(x)
+        new_states = []
+        for i, layer in enumerate(self.layers):
+            pp = self.conf.preprocessors.get(i)
+            if pp is not None:
+                if hasattr(pp, "batch"):
+                    pp.batch = x.shape[0]
+                h = pp.preProcess(h, None)
+            if i == len(self.layers) - 1 and isinstance(layer, (L.BaseOutputLayer, L.LossLayer)):
+                pre = layer.preoutput(self._cast_params(self._params[i]), h)
+                h = self._out_act(layer, pre)
+                new_states.append(states[i])
+                break
+            h, s = layer.forward(self._cast_params(self._params[i]), states[i],
+                                 h, False, None, None)
+            new_states.append(s)
+        self._rnn_state = new_states
+        if squeeze_out and h.ndim == 3:
+            h = h[:, :, 0]  # 2d in -> 2d out, like the reference
+        return INDArray(h)
+
+    def rnnClearPreviousState(self):
+        self._rnn_state = None
+
+    # ----- introspection ----------------------------------------------
+    def params(self) -> INDArray:
+        leaves = jax.tree_util.tree_leaves(self._params)
+        if not leaves:
+            return Nd4j.empty()
+        return INDArray(jnp.concatenate([l.reshape(-1).astype(jnp.float32) for l in leaves]))
+
+    def numParams(self) -> int:
+        return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(self._params))
+
+    def paramTable(self) -> dict:
+        out = {}
+        for i, p in enumerate(self._params):
+            for k, v in p.items():
+                out[f"{i}_{k}"] = INDArray(v)
+        return out
+
+    def getLayers(self):
+        return self.layers
+
+    def getnLayers(self) -> int:
+        return len(self.layers)
+
+    def setListeners(self, *listeners):
+        self._listeners = list(listeners)
+        return self
+
+    def addListeners(self, *listeners):
+        self._listeners.extend(listeners)
+        return self
+
+    def getIterationCount(self) -> int:
+        return self._iteration
+
+    def getEpochCount(self) -> int:
+        return self._epoch
+
+    def summary(self) -> str:
+        lines = [f"{'idx':<4}{'type':<28}{'out shape':<24}{'params':<12}"]
+        total = 0
+        for i, layer in enumerate(self.layers):
+            n = sum(int(np.prod(v.shape)) for v in self._params[i].values()) if self._params else 0
+            total += n
+            ot = layer.getOutputType(self.conf.layerInputTypes[i])
+            lines.append(f"{i:<4}{type(layer).__name__:<28}{str(ot):<24}{n:<12}")
+        lines.append(f"Total params: {total}")
+        return "\n".join(lines)

@@ -1,0 +1,136 @@
+"""Learning-rate (and generic hyperparameter) schedules.
+
+Reference: org.nd4j.linalg.schedule.ISchedule and impls (StepSchedule,
+ExponentialSchedule, InverseSchedule, PolySchedule, SigmoidSchedule,
+MapSchedule, CycleSchedule). valueAt is a pure function of the iteration
+counter so it traces into the jitted train step — the schedule advances on
+device with no host round-trip per iteration.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class ScheduleType:
+    ITERATION = "iteration"
+    EPOCH = "epoch"
+
+
+class ISchedule:
+    def valueAt(self, iteration, epoch=0):
+        raise NotImplementedError
+
+    def __call__(self, iteration, epoch=0):
+        return self.valueAt(iteration, epoch)
+
+
+class FixedSchedule(ISchedule):
+    def __init__(self, value: float):
+        self.value = value
+
+    def valueAt(self, iteration, epoch=0):
+        return self.value
+
+
+class StepSchedule(ISchedule):
+    """value * decayRate^floor(iter/step)"""
+
+    def __init__(self, scheduleType, initialValue, decayRate, step):
+        self.scheduleType, self.initialValue = scheduleType, initialValue
+        self.decayRate, self.step = decayRate, step
+
+    def valueAt(self, iteration, epoch=0):
+        i = iteration if self.scheduleType == ScheduleType.ITERATION else epoch
+        return self.initialValue * jnp.power(self.decayRate, jnp.floor(i / self.step))
+
+
+class ExponentialSchedule(ISchedule):
+    def __init__(self, scheduleType, initialValue, gamma):
+        self.scheduleType, self.initialValue, self.gamma = scheduleType, initialValue, gamma
+
+    def valueAt(self, iteration, epoch=0):
+        i = iteration if self.scheduleType == ScheduleType.ITERATION else epoch
+        return self.initialValue * jnp.power(self.gamma, i)
+
+
+class InverseSchedule(ISchedule):
+    def __init__(self, scheduleType, initialValue, gamma, power):
+        self.scheduleType, self.initialValue = scheduleType, initialValue
+        self.gamma, self.power = gamma, power
+
+    def valueAt(self, iteration, epoch=0):
+        i = iteration if self.scheduleType == ScheduleType.ITERATION else epoch
+        return self.initialValue / jnp.power(1 + self.gamma * i, self.power)
+
+
+class PolySchedule(ISchedule):
+    def __init__(self, scheduleType, initialValue, power, maxIter):
+        self.scheduleType, self.initialValue = scheduleType, initialValue
+        self.power, self.maxIter = power, maxIter
+
+    def valueAt(self, iteration, epoch=0):
+        i = iteration if self.scheduleType == ScheduleType.ITERATION else epoch
+        frac = jnp.clip(i / self.maxIter, 0.0, 1.0)
+        return self.initialValue * jnp.power(1 - frac, self.power)
+
+
+class SigmoidSchedule(ISchedule):
+    def __init__(self, scheduleType, initialValue, gamma, stepSize):
+        self.scheduleType, self.initialValue = scheduleType, initialValue
+        self.gamma, self.stepSize = gamma, stepSize
+
+    def valueAt(self, iteration, epoch=0):
+        i = iteration if self.scheduleType == ScheduleType.ITERATION else epoch
+        return self.initialValue / (1 + jnp.exp(self.gamma * (i - self.stepSize)))
+
+
+class MapSchedule(ISchedule):
+    """Piecewise-constant values at given iterations/epochs.
+
+    Traces to a chain of where() selects — static thresholds, so it stays
+    jit-compatible (no data-dependent Python branching).
+    """
+
+    def __init__(self, scheduleType, values: dict):
+        self.scheduleType = scheduleType
+        self.points = sorted(values.items())
+        if self.points[0][0] != 0:
+            raise ValueError("MapSchedule requires a value for iteration/epoch 0")
+
+    def valueAt(self, iteration, epoch=0):
+        i = iteration if self.scheduleType == ScheduleType.ITERATION else epoch
+        v = jnp.asarray(self.points[0][1], dtype=jnp.float32)
+        for at, val in self.points[1:]:
+            v = jnp.where(i >= at, val, v)
+        return v
+
+
+class CycleSchedule(ISchedule):
+    """1cycle schedule (reference: CycleSchedule)."""
+
+    def __init__(self, scheduleType, initialLearningRate, maxLearningRate,
+                 cycleLength, annealingLength=None, annealingDecay=0.1):
+        self.scheduleType = scheduleType
+        self.lr0, self.lrMax = initialLearningRate, maxLearningRate
+        self.cycleLength = cycleLength
+        self.annealingLength = annealingLength or max(1, int(0.1 * cycleLength))
+        self.annealingDecay = annealingDecay
+
+    def valueAt(self, iteration, epoch=0):
+        i = iteration if self.scheduleType == ScheduleType.ITERATION else epoch
+        up = (self.cycleLength - self.annealingLength) / 2
+        pos = jnp.mod(i, self.cycleLength)
+        ramp_up = self.lr0 + (self.lrMax - self.lr0) * (pos / up)
+        ramp_down = self.lrMax - (self.lrMax - self.lr0) * ((pos - up) / up)
+        anneal_pos = (pos - 2 * up) / jnp.maximum(self.annealingLength, 1)
+        anneal = self.lr0 * (1 - (1 - self.annealingDecay) * anneal_pos)
+        v = jnp.where(pos < up, ramp_up, jnp.where(pos < 2 * up, ramp_down, anneal))
+        return v
+
+
+def resolve(value_or_schedule):
+    """A float or an ISchedule -> ISchedule."""
+    if isinstance(value_or_schedule, ISchedule):
+        return value_or_schedule
+    return FixedSchedule(float(value_or_schedule))

@@ -1,0 +1,213 @@
+"""Gradient updaters.
+
+Reference: org.nd4j.linalg.learning.config.IUpdater (Sgd, Adam, AdaMax,
+Nesterovs, RmsProp, AdaGrad, AdaDelta, Nadam, AMSGrad, NoOp) executed by
+GradientUpdater kernels in libnd4j with updater state packed into one flat
+buffer (BaseMultiLayerUpdater). TPU design: an updater is a pair of pure
+pytree functions (init, apply) that trace into the jitted train step; state
+lives in HBM as donated buffers, and the whole update fuses into the step's
+XLA computation. Hyperparameters accept ISchedule for on-device schedules.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import schedules as _sched
+
+
+def _tmap(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
+
+
+class IUpdater:
+    """Base updater. Subclasses define stateShapes/applyUpdater on arrays;
+    tree-mapping over the params pytree happens here."""
+
+    def init(self, params):
+        raise NotImplementedError
+
+    def apply(self, grads, state, iteration, epoch=0):
+        """-> (updates_to_subtract, new_state)"""
+        raise NotImplementedError
+
+    def _lr(self, iteration, epoch):
+        return _sched.resolve(self.learningRate).valueAt(iteration, epoch)
+
+
+class NoOp(IUpdater):
+    def __init__(self):
+        self.learningRate = 0.0
+
+    def init(self, params):
+        return ()
+
+    def apply(self, grads, state, iteration, epoch=0):
+        return _tmap(jnp.zeros_like, grads), state
+
+
+class Sgd(IUpdater):
+    def __init__(self, learningRate=0.1):
+        self.learningRate = learningRate
+
+    def init(self, params):
+        return ()
+
+    def apply(self, grads, state, iteration, epoch=0):
+        lr = self._lr(iteration, epoch)
+        return _tmap(lambda g: lr * g, grads), state
+
+
+class Nesterovs(IUpdater):
+    def __init__(self, learningRate=0.1, momentum=0.9):
+        self.learningRate, self.momentum = learningRate, momentum
+
+    def init(self, params):
+        return _tmap(jnp.zeros_like, params)
+
+    def apply(self, grads, state, iteration, epoch=0):
+        lr = self._lr(iteration, epoch)
+        mu = _sched.resolve(self.momentum).valueAt(iteration, epoch)
+        v_new = _tmap(lambda v, g: mu * v - lr * g, state, grads)
+        # reference Nesterovs: update = -(mu * v_new - lr * g) ... applied as
+        # params += mu*v_new - lr*g ; we return the quantity to SUBTRACT.
+        updates = _tmap(lambda vn, g: -(mu * vn - lr * g), v_new, grads)
+        return updates, v_new
+
+
+class Adam(IUpdater):
+    def __init__(self, learningRate=1e-3, beta1=0.9, beta2=0.999, epsilon=1e-8):
+        self.learningRate, self.beta1, self.beta2, self.epsilon = learningRate, beta1, beta2, epsilon
+
+    def init(self, params):
+        z = _tmap(jnp.zeros_like, params)
+        return {"m": z, "v": _tmap(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, iteration, epoch=0):
+        lr = self._lr(iteration, epoch)
+        t = iteration + 1
+        b1, b2 = self.beta1, self.beta2
+        m = _tmap(lambda m, g: b1 * m + (1 - b1) * g, state["m"], grads)
+        v = _tmap(lambda v, g: b2 * v + (1 - b2) * g * g, state["v"], grads)
+        a = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        updates = _tmap(lambda m, v: a * m / (jnp.sqrt(v) + self.epsilon), m, v)
+        return updates, {"m": m, "v": v}
+
+
+class AdaMax(IUpdater):
+    def __init__(self, learningRate=1e-3, beta1=0.9, beta2=0.999, epsilon=1e-8):
+        self.learningRate, self.beta1, self.beta2, self.epsilon = learningRate, beta1, beta2, epsilon
+
+    def init(self, params):
+        return {"m": _tmap(jnp.zeros_like, params), "u": _tmap(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, iteration, epoch=0):
+        lr = self._lr(iteration, epoch)
+        t = iteration + 1
+        b1, b2 = self.beta1, self.beta2
+        m = _tmap(lambda m, g: b1 * m + (1 - b1) * g, state["m"], grads)
+        u = _tmap(lambda u, g: jnp.maximum(b2 * u, jnp.abs(g)), state["u"], grads)
+        a = lr / (1 - b1 ** t)
+        updates = _tmap(lambda m, u: a * m / (u + self.epsilon), m, u)
+        return updates, {"m": m, "u": u}
+
+
+class Nadam(IUpdater):
+    def __init__(self, learningRate=1e-3, beta1=0.9, beta2=0.999, epsilon=1e-8):
+        self.learningRate, self.beta1, self.beta2, self.epsilon = learningRate, beta1, beta2, epsilon
+
+    def init(self, params):
+        return {"m": _tmap(jnp.zeros_like, params), "v": _tmap(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, iteration, epoch=0):
+        lr = self._lr(iteration, epoch)
+        t = iteration + 1
+        b1, b2 = self.beta1, self.beta2
+        m = _tmap(lambda m, g: b1 * m + (1 - b1) * g, state["m"], grads)
+        v = _tmap(lambda v, g: b2 * v + (1 - b2) * g * g, state["v"], grads)
+        mhat = _tmap(lambda m, g: (b1 * m + (1 - b1) * g) / (1 - b1 ** (t + 1)), m, grads)
+        vhat = _tmap(lambda v: v / (1 - b2 ** t), v)
+        updates = _tmap(lambda mh, vh: lr * mh / (jnp.sqrt(vh) + self.epsilon), mhat, vhat)
+        return updates, {"m": m, "v": v}
+
+
+class AMSGrad(IUpdater):
+    def __init__(self, learningRate=1e-3, beta1=0.9, beta2=0.999, epsilon=1e-8):
+        self.learningRate, self.beta1, self.beta2, self.epsilon = learningRate, beta1, beta2, epsilon
+
+    def init(self, params):
+        z = lambda: _tmap(jnp.zeros_like, params)
+        return {"m": z(), "v": z(), "vhat": z()}
+
+    def apply(self, grads, state, iteration, epoch=0):
+        lr = self._lr(iteration, epoch)
+        t = iteration + 1
+        b1, b2 = self.beta1, self.beta2
+        m = _tmap(lambda m, g: b1 * m + (1 - b1) * g, state["m"], grads)
+        v = _tmap(lambda v, g: b2 * v + (1 - b2) * g * g, state["v"], grads)
+        vhat = _tmap(jnp.maximum, state["vhat"], v)
+        a = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        updates = _tmap(lambda m, vh: a * m / (jnp.sqrt(vh) + self.epsilon), m, vhat)
+        return updates, {"m": m, "v": v, "vhat": vhat}
+
+
+class AdaGrad(IUpdater):
+    def __init__(self, learningRate=0.1, epsilon=1e-6):
+        self.learningRate, self.epsilon = learningRate, epsilon
+
+    def init(self, params):
+        return _tmap(jnp.zeros_like, params)
+
+    def apply(self, grads, state, iteration, epoch=0):
+        lr = self._lr(iteration, epoch)
+        h = _tmap(lambda h, g: h + g * g, state, grads)
+        updates = _tmap(lambda g, h: lr * g / (jnp.sqrt(h) + self.epsilon), grads, h)
+        return updates, h
+
+
+class AdaDelta(IUpdater):
+    def __init__(self, rho=0.95, epsilon=1e-6):
+        self.rho, self.epsilon = rho, epsilon
+        self.learningRate = 1.0  # AdaDelta has no lr
+
+    def init(self, params):
+        return {"g2": _tmap(jnp.zeros_like, params), "dx2": _tmap(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, iteration, epoch=0):
+        rho, eps = self.rho, self.epsilon
+        g2 = _tmap(lambda a, g: rho * a + (1 - rho) * g * g, state["g2"], grads)
+        dx = _tmap(
+            lambda g, a, d: g * jnp.sqrt(d + eps) / jnp.sqrt(a + eps), grads, g2, state["dx2"]
+        )
+        dx2 = _tmap(lambda d, x: rho * d + (1 - rho) * x * x, state["dx2"], dx)
+        return dx, {"g2": g2, "dx2": dx2}
+
+
+class RmsProp(IUpdater):
+    def __init__(self, learningRate=0.1, rmsDecay=0.95, epsilon=1e-8):
+        self.learningRate, self.rmsDecay, self.epsilon = learningRate, rmsDecay, epsilon
+
+    def init(self, params):
+        return _tmap(jnp.zeros_like, params)
+
+    def apply(self, grads, state, iteration, epoch=0):
+        lr = self._lr(iteration, epoch)
+        d = self.rmsDecay
+        h = _tmap(lambda h, g: d * h + (1 - d) * g * g, state, grads)
+        updates = _tmap(lambda g, h: lr * g / (jnp.sqrt(h + self.epsilon)), grads, h)
+        return updates, h
+
+
+def resolve(u) -> IUpdater:
+    if isinstance(u, IUpdater):
+        return u
+    if isinstance(u, str):
+        table = {
+            "sgd": Sgd, "adam": Adam, "adamax": AdaMax, "nadam": Nadam,
+            "amsgrad": AMSGrad, "adagrad": AdaGrad, "adadelta": AdaDelta,
+            "rmsprop": RmsProp, "nesterovs": Nesterovs, "noop": NoOp,
+        }
+        if u.lower() in table:
+            return table[u.lower()]()
+    raise ValueError(f"Cannot resolve updater from {u!r}")

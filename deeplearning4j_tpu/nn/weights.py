@@ -1,0 +1,108 @@
+"""Weight initialization schemes.
+
+Reference: org.deeplearning4j.nn.weights.WeightInit (+ WeightInitUtil).
+Semantics match the reference's fan-in/fan-out formulas; draws come from
+the splittable RNG so initialization is identical at any device count.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class WeightInit:
+    XAVIER = "xavier"
+    XAVIER_UNIFORM = "xavier_uniform"
+    XAVIER_FAN_IN = "xavier_fan_in"
+    RELU = "relu"
+    RELU_UNIFORM = "relu_uniform"
+    LECUN_NORMAL = "lecun_normal"
+    LECUN_UNIFORM = "lecun_uniform"
+    SIGMOID_UNIFORM = "sigmoid_uniform"
+    UNIFORM = "uniform"
+    NORMAL = "normal"
+    ZERO = "zero"
+    ONES = "ones"
+    IDENTITY = "identity"
+    VAR_SCALING_NORMAL_FAN_IN = "var_scaling_normal_fan_in"
+    VAR_SCALING_NORMAL_FAN_OUT = "var_scaling_normal_fan_out"
+    VAR_SCALING_NORMAL_FAN_AVG = "var_scaling_normal_fan_avg"
+
+
+def init(key, scheme, shape, fan_in, fan_out, dtype=jnp.float32, distribution=None):
+    """Initialize a weight array of `shape` with the given scheme.
+
+    fan_in/fan_out are the layer's logical fans (for conv:
+    kh*kw*channels), independent of the storage layout of `shape`.
+    """
+    return _init(key, scheme, shape, fan_in, fan_out, dtype, distribution).astype(dtype)
+
+
+def _init(key, scheme, shape, fan_in, fan_out, dtype, distribution):
+    s = scheme if isinstance(scheme, str) else getattr(scheme, "value", str(scheme))
+    s = s.lower()
+    if s == "zero":
+        return jnp.zeros(shape, dtype)
+    if s == "ones":
+        return jnp.ones(shape, dtype)
+    if s == "identity":
+        if len(shape) != 2 or shape[0] != shape[1]:
+            raise ValueError("IDENTITY init requires a square 2d shape")
+        return jnp.eye(shape[0], dtype=dtype)
+    if s == "distribution":
+        if distribution is None:
+            raise ValueError("WeightInit.DISTRIBUTION requires a distribution")
+        return distribution.sample(key, shape, dtype)
+    if s == "xavier":
+        std = jnp.sqrt(2.0 / (fan_in + fan_out))
+        return std * jax.random.normal(key, shape, dtype)
+    if s == "xavier_uniform":
+        a = jnp.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s == "xavier_fan_in":
+        std = jnp.sqrt(1.0 / fan_in)
+        return std * jax.random.normal(key, shape, dtype)
+    if s == "relu":
+        std = jnp.sqrt(2.0 / fan_in)
+        return std * jax.random.normal(key, shape, dtype)
+    if s == "relu_uniform":
+        a = jnp.sqrt(6.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s == "lecun_normal":
+        std = jnp.sqrt(1.0 / fan_in)
+        return std * jax.random.normal(key, shape, dtype)
+    if s == "lecun_uniform":
+        a = jnp.sqrt(3.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s == "sigmoid_uniform":
+        a = 4.0 * jnp.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s == "uniform":
+        a = 1.0 / jnp.sqrt(fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s == "normal":
+        return jax.random.normal(key, shape, dtype) / jnp.sqrt(fan_in)
+    if s == "var_scaling_normal_fan_in":
+        return jax.random.normal(key, shape, dtype) * jnp.sqrt(1.0 / fan_in)
+    if s == "var_scaling_normal_fan_out":
+        return jax.random.normal(key, shape, dtype) * jnp.sqrt(1.0 / fan_out)
+    if s == "var_scaling_normal_fan_avg":
+        return jax.random.normal(key, shape, dtype) * jnp.sqrt(2.0 / (fan_in + fan_out))
+    raise ValueError(f"Unknown weight init scheme '{scheme}'")
+
+
+class NormalDistribution:
+    def __init__(self, mean: float, std: float):
+        self.mean, self.std = mean, std
+
+    def sample(self, key, shape, dtype):
+        return self.mean + self.std * jax.random.normal(key, shape, dtype)
+
+
+class UniformDistribution:
+    def __init__(self, lower: float, upper: float):
+        self.lower, self.upper = lower, upper
+
+    def sample(self, key, shape, dtype):
+        return jax.random.uniform(key, shape, dtype, self.lower, self.upper)

@@ -1,0 +1,5 @@
+"""Functional TPU op kernels (conv, pooling, rnn scans, norm, attention).
+
+Reference: libnd4j op implementations + cuDNN helper classes; here each is
+a lax/pallas composition that XLA fuses.
+"""

@@ -1,0 +1,96 @@
+"""Convolution primitives (NHWC, MXU-targeted).
+
+Reference: libnd4j conv2d/deconv2d/depthwise ops and the cuDNN helper
+(CudnnConvolutionHelper) that the reference's ConvolutionLayer prefers on
+GPU. On TPU all variants are one primitive — lax.conv_general_dilated —
+which XLA tiles onto the MXU and fuses with surrounding elementwise work,
+so there is no helper/fallback split to port.
+
+Layout: NHWC activations, HWIO weights (the TPU-native layouts). The nn
+layer API remains NCHW like the reference; conversion happens once at the
+network input boundary, not per-op.
+"""
+
+from __future__ import annotations
+
+from jax import lax
+import numpy as np
+
+
+def _pair(v):
+    if isinstance(v, (tuple, list)):
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
+
+
+def explicit_padding(mode, padding, kernel, stride, dilation):
+    """Resolve a ConvolutionMode + explicit padding config to lax padding.
+
+    Reference: org.deeplearning4j.nn.conf.ConvolutionMode — Same computes
+    TF-style same-padding; Truncate/Strict use the configured pad values.
+    """
+    if str(mode).lower() == "same":
+        return "SAME"
+    ph, pw = _pair(padding)
+    return ((ph, ph), (pw, pw))
+
+
+def conv2d(x, w, b=None, stride=(1, 1), padding=((0, 0), (0, 0)), dilation=(1, 1),
+           groups=1):
+    """x: [B,H,W,Cin], w: [kh,kw,Cin/groups,Cout] -> [B,H',W',Cout]."""
+    out = lax.conv_general_dilated(
+        x, w,
+        window_strides=_pair(stride),
+        padding=padding,
+        rhs_dilation=_pair(dilation),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=groups,
+    )
+    if b is not None:
+        out = out + b
+    return out
+
+
+def deconv2d(x, w, b=None, stride=(1, 1), padding=((0, 0), (0, 0)), dilation=(1, 1)):
+    """Transposed convolution. w: [kh,kw,Cout,Cin] stored IO-swapped so
+    fan semantics match the forward conv it inverts."""
+    out = lax.conv_transpose(
+        x, w,
+        strides=_pair(stride),
+        padding=padding,
+        rhs_dilation=_pair(dilation),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    if b is not None:
+        out = out + b
+    return out
+
+
+def conv1d(x, w, b=None, stride=1, padding=((0, 0),), dilation=1):
+    """x: [B,T,Cin], w: [k,Cin,Cout] -> [B,T',Cout]."""
+    out = lax.conv_general_dilated(
+        x, w,
+        window_strides=(int(stride),),
+        padding=padding if padding == "SAME" else tuple(padding),
+        rhs_dilation=(int(dilation),),
+        dimension_numbers=("NWC", "WIO", "NWC"),
+    )
+    if b is not None:
+        out = out + b
+    return out
+
+
+def conv_output_size(size, kernel, stride, pad, dilation, mode):
+    """Spatial shape inference, matching the reference's
+    ConvolutionUtils.getOutputSize."""
+    k_eff = (kernel - 1) * dilation + 1
+    if str(mode).lower() == "same":
+        return int(np.ceil(size / stride))
+    return (size + 2 * pad - k_eff) // stride + 1
+
+
+def deconv_output_size(size, kernel, stride, pad, dilation, mode):
+    k_eff = (kernel - 1) * dilation + 1
+    if str(mode).lower() == "same":
+        return size * stride
+    return stride * (size - 1) + k_eff - 2 * pad

@@ -1,0 +1,55 @@
+"""Normalization primitives.
+
+Reference: BatchNormalization (+ CudnnBatchNormalizationHelper) and
+LocalResponseNormalization layer impls. On TPU both are bandwidth-bound
+elementwise/reduction patterns that XLA fuses; no helper split needed.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def batch_norm(x, gamma, beta, running_mean, running_var, *, train: bool,
+               decay: float = 0.9, eps: float = 1e-5, use_stats: bool = True):
+    """Channels-last batch norm over all leading axes.
+
+    Returns (y, new_running_mean, new_running_var). `decay` matches the
+    reference's decay semantics: running = decay*running + (1-decay)*batch.
+    """
+    axes = tuple(range(x.ndim - 1))
+    if train:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        new_rm = decay * running_mean + (1.0 - decay) * mean
+        new_rv = decay * running_var + (1.0 - decay) * var
+    else:
+        mean, var = running_mean, running_var
+        new_rm, new_rv = running_mean, running_var
+    inv = lax.rsqrt(var + eps)
+    y = (x - mean) * inv
+    if gamma is not None:
+        y = y * gamma
+    if beta is not None:
+        y = y + beta
+    return y, new_rm, new_rv
+
+
+def lrn(x, k=2.0, n=5, alpha=1e-4, beta=0.75):
+    """Local response normalization across channels (NHWC).
+
+    Reference: LocalResponseNormalization (AlexNet-era). Implemented as an
+    average pool over the channel axis.
+    """
+    sq = jnp.square(x)
+    half = n // 2
+    # pad channels and sum a sliding window over the channel dim
+    padded = jnp.pad(sq, ((0, 0), (0, 0), (0, 0), (half, half)))
+    summed = lax.reduce_window(
+        padded, 0.0, lax.add,
+        window_dimensions=(1, 1, 1, n),
+        window_strides=(1, 1, 1, 1),
+        padding="VALID",
+    )
+    return x / jnp.power(k + alpha * summed, beta)

@@ -1,0 +1,96 @@
+"""Pooling primitives (NHWC).
+
+Reference: libnd4j maxpool2d/avgpool2d/pnormpool2d (SubsamplingLayer) and
+global pooling reductions (GlobalPoolingLayer). lax.reduce_window is the
+single underlying primitive; XLA fuses the divisor correction for avg
+pooling.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.ops.conv import _pair
+
+
+def max_pool2d(x, kernel, stride, padding):
+    k, s = _pair(kernel), _pair(stride)
+    pad = padding if padding == "SAME" else ((0, 0),) + tuple(padding) + ((0, 0),)
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max,
+        window_dimensions=(1, k[0], k[1], 1),
+        window_strides=(1, s[0], s[1], 1),
+        padding=pad if padding != "SAME" else "SAME",
+    )
+
+def avg_pool2d(x, kernel, stride, padding, count_include_pad=True):
+    k, s = _pair(kernel), _pair(stride)
+    pad = padding if padding == "SAME" else ((0, 0),) + tuple(padding) + ((0, 0),)
+    summed = lax.reduce_window(
+        x, 0.0, lax.add,
+        window_dimensions=(1, k[0], k[1], 1),
+        window_strides=(1, s[0], s[1], 1),
+        padding=pad if padding != "SAME" else "SAME",
+    )
+    if count_include_pad and padding != "SAME":
+        return summed / (k[0] * k[1])
+    ones = jnp.ones_like(x)
+    counts = lax.reduce_window(
+        ones, 0.0, lax.add,
+        window_dimensions=(1, k[0], k[1], 1),
+        window_strides=(1, s[0], s[1], 1),
+        padding=pad if padding != "SAME" else "SAME",
+    )
+    return summed / counts
+
+
+def pnorm_pool2d(x, kernel, stride, padding, p=2):
+    k, s = _pair(kernel), _pair(stride)
+    pad = padding if padding == "SAME" else ((0, 0),) + tuple(padding) + ((0, 0),)
+    summed = lax.reduce_window(
+        jnp.power(jnp.abs(x), p), 0.0, lax.add,
+        window_dimensions=(1, k[0], k[1], 1),
+        window_strides=(1, s[0], s[1], 1),
+        padding=pad if padding != "SAME" else "SAME",
+    )
+    return jnp.power(summed, 1.0 / p)
+
+
+def upsample2d(x, size):
+    """Nearest-neighbour upsampling [B,H,W,C] (reference: Upsampling2D)."""
+    sh, sw = _pair(size)
+    x = jnp.repeat(x, sh, axis=1)
+    return jnp.repeat(x, sw, axis=2)
+
+
+def global_pool(x, pooling_type, axes, mask=None, pnorm=2):
+    """Global pooling over `axes` with optional mask over those axes.
+
+    Reference: GlobalPoolingLayer (used for masked RNN sequence pooling and
+    CNN global pooling).
+    """
+    t = str(pooling_type).lower()
+    if mask is not None:
+        # mask must already be broadcastable to x (callers reshape, e.g.
+        # [B,T] -> [B,1,T] for NCW recurrent data)
+        m = jnp.broadcast_to(mask, x.shape)
+        if t == "max":
+            x = jnp.where(m > 0, x, -jnp.inf)
+        else:
+            x = x * m
+        denom = jnp.maximum(jnp.sum(m, axis=axes), 1.0)
+    else:
+        denom = None
+    if t == "max":
+        return jnp.max(x, axis=axes)
+    if t == "sum":
+        return jnp.sum(x, axis=axes)
+    if t == "avg":
+        if denom is not None:
+            return jnp.sum(x, axis=axes) / denom
+        return jnp.mean(x, axis=axes)
+    if t == "pnorm":
+        s = jnp.sum(jnp.power(jnp.abs(x), pnorm), axis=axes)
+        return jnp.power(s, 1.0 / pnorm)
+    raise ValueError(f"Unknown pooling type {pooling_type}")

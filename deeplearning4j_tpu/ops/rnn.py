@@ -1,0 +1,101 @@
+"""Recurrent cells as fused scans.
+
+Reference: the reference's LSTM forward is LSTMHelpers.activateHelper
+(hand-rolled per-timestep GEMMs) or the cuDNN LSTM helper (CudnnLSTMHelper)
+on GPU. TPU design: the input projection x_t @ W for ALL timesteps is one
+large [T*B, nIn] x [nIn, 4H] matmul executed on the MXU before the scan;
+the lax.scan body then carries only the recurrent h_t @ U matmul. This is
+the standard XLA RNN recipe — it keeps the MXU busy with one big GEMM
+instead of T skinny ones, which is where cuDNN's fused LSTM gets its speed
+on GPU.
+
+Data layout here is time-major [T, B, F]; the nn layer wrappers convert
+from the API's NCW [B, F, T] at the layer boundary.
+
+Gate order in the packed weights: [input i, forget f, output o, cell g]
+(reference LSTMParamInitializer packs [i, f, o, g] as well).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def lstm_scan(x_tbf, w, u, b, h0=None, c0=None, peephole=None,
+              activation=jnp.tanh, gate_activation=jax.nn.sigmoid):
+    """LSTM over time-major input.
+
+    x_tbf: [T, B, nIn]; w: [nIn, 4H]; u: [H, 4H]; b: [4H]
+    peephole: None or (p_i, p_f, p_o) each [H] (GravesLSTM variant).
+    Returns (outputs [T, B, H], (h_T, c_T)).
+    """
+    T, B, _ = x_tbf.shape
+    H = u.shape[0]
+    # one big MXU matmul for all timesteps' input projections
+    xw = (x_tbf.reshape(T * B, -1) @ w + b).reshape(T, B, 4 * H)
+
+    if h0 is None:
+        h0 = jnp.zeros((B, H), dtype=x_tbf.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((B, H), dtype=x_tbf.dtype)
+
+    def step(carry, xw_t):
+        h, c = carry
+        gates = xw_t + h @ u
+        i, f, o, g = jnp.split(gates, 4, axis=-1)
+        if peephole is not None:
+            p_i, p_f, p_o = peephole
+            i = i + c * p_i
+            f = f + c * p_f
+        i = gate_activation(i)
+        f = gate_activation(f)
+        g = activation(g)
+        c_new = f * c + i * g
+        if peephole is not None:
+            o = o + c_new * p_o
+        o = gate_activation(o)
+        h_new = o * activation(c_new)
+        return (h_new, c_new), h_new
+
+    (h_t, c_t), ys = lax.scan(step, (h0, c0), xw)
+    return ys, (h_t, c_t)
+
+
+def simple_rnn_scan(x_tbf, w, u, b, h0=None, activation=jnp.tanh):
+    """Elman RNN (reference: SimpleRnn). Same big-matmul-then-scan shape."""
+    T, B, _ = x_tbf.shape
+    H = u.shape[0]
+    xw = (x_tbf.reshape(T * B, -1) @ w + b).reshape(T, B, H)
+    if h0 is None:
+        h0 = jnp.zeros((B, H), dtype=x_tbf.dtype)
+
+    def step(h, xw_t):
+        h_new = activation(xw_t + h @ u)
+        return h_new, h_new
+
+    h_t, ys = lax.scan(step, h0, xw)
+    return ys, h_t
+
+
+def gru_scan(x_tbf, w, u, b, h0=None, activation=jnp.tanh,
+             gate_activation=jax.nn.sigmoid):
+    """GRU. w: [nIn, 3H] (r, z, n), u: [H, 3H], b: [3H]."""
+    T, B, _ = x_tbf.shape
+    H = u.shape[0]
+    xw = (x_tbf.reshape(T * B, -1) @ w + b).reshape(T, B, 3 * H)
+    if h0 is None:
+        h0 = jnp.zeros((B, H), dtype=x_tbf.dtype)
+    u_rz, u_n = u[:, : 2 * H], u[:, 2 * H:]
+
+    def step(h, xw_t):
+        x_rz, x_n = xw_t[:, : 2 * H], xw_t[:, 2 * H:]
+        rz = gate_activation(x_rz + h @ u_rz)
+        r, z = jnp.split(rz, 2, axis=-1)
+        n = activation(x_n + (r * h) @ u_n)
+        h_new = (1 - z) * n + z * h
+        return h_new, h_new
+
+    h_t, ys = lax.scan(step, h0, xw)
+    return ys, h_t

@@ -1,0 +1,422 @@
+"""MultiLayerNetwork tests.
+
+Mirrors the reference's deeplearning4j-core test strategy:
+MultiLayerTest (build/fit/output/score), GradientCheckTests
+(finite-difference vs backprop), convergence smoke tests, and
+evaluation integration.
+"""
+
+import numpy as np
+import jax
+import pytest
+
+from deeplearning4j_tpu.ndarray import DataType
+from deeplearning4j_tpu.nn import (
+    NeuralNetConfiguration, InputType, MultiLayerNetwork,
+    DenseLayer, OutputLayer, RnnOutputLayer, ConvolutionLayer, SubsamplingLayer,
+    BatchNormalization, GlobalPoolingLayer, DropoutLayer, ActivationLayer,
+    EmbeddingLayer, LSTM, GravesLSTM, SimpleRnn, Bidirectional, LastTimeStep,
+    Adam, Sgd, Nesterovs, RmsProp, AdaGrad,
+    WeightInit, BackpropType, GradientNormalization,
+)
+from deeplearning4j_tpu.data import DataSet, DataSetIterator
+
+
+def _separable_data(n=128, nin=4, nout=3, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, nin).astype("float32")
+    w = rng.randn(nin, nout)
+    yidx = np.argmax(x @ w, axis=1)
+    return x, np.eye(nout, dtype="float32")[yidx], yidx
+
+
+def _mlp(updater=None, seed=42, **kw):
+    return (NeuralNetConfiguration.Builder()
+            .seed(seed)
+            .updater(updater or Adam(1e-2))
+            .weightInit(WeightInit.XAVIER)
+            .activation("relu")
+            .list()
+            .layer(DenseLayer(nOut=16))
+            .layer(OutputLayer(nOut=3, activation="softmax", lossFunction="mcxent"))
+            .setInputType(InputType.feedForward(4))
+            .build())
+
+
+class TestBuild:
+    def test_nin_inference(self):
+        conf = _mlp()
+        net = MultiLayerNetwork(conf).init()
+        assert conf.layers[0].nIn == 4
+        assert conf.layers[1].nIn == 16
+        assert net.numParams() == 4 * 16 + 16 + 16 * 3 + 3
+
+    def test_explicit_nin(self):
+        conf = (NeuralNetConfiguration.Builder().updater(Sgd(0.1)).list()
+                .layer(DenseLayer(nIn=5, nOut=7))
+                .layer(OutputLayer(nIn=7, nOut=2, activation="softmax"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        assert net.numParams() == 5 * 7 + 7 + 7 * 2 + 2
+
+    def test_builder_fluent_parity(self):
+        # Java-style Layer.Builder() chains work too
+        layer = DenseLayer.Builder().nIn(3).nOut(4).activation("tanh").build()
+        assert layer.nIn == 3 and layer.nOut == 4 and layer.activation == "tanh"
+
+    def test_missing_input_type_raises(self):
+        with pytest.raises(ValueError):
+            (NeuralNetConfiguration.Builder().list()
+             .layer(DenseLayer(nOut=4))
+             .layer(OutputLayer(nOut=2))
+             .build())
+
+    def test_summary(self):
+        net = MultiLayerNetwork(_mlp()).init()
+        s = net.summary()
+        assert "DenseLayer" in s and "Total params" in s
+
+
+class TestFit:
+    def test_mlp_converges(self):
+        x, y, yidx = _separable_data()
+        net = MultiLayerNetwork(_mlp()).init()
+        it = DataSetIterator(x, y, 64, shuffle=True)
+        first = None
+        for _ in range(30):
+            net.fit(it)
+            first = first if first is not None else net.score()
+        assert net.score() < 0.5 * first
+        acc = (net.output(x).argMax(1).toNumpy() == yidx).mean()
+        assert acc > 0.9
+
+    def test_fit_xy_direct(self):
+        x, y, _ = _separable_data()
+        net = MultiLayerNetwork(_mlp()).init()
+        s0 = None
+        for _ in range(20):
+            net.fit(x, y)
+            s0 = s0 if s0 is not None else net.score()
+        assert net.score() < s0
+
+    def test_fit_dataset(self):
+        x, y, _ = _separable_data()
+        net = MultiLayerNetwork(_mlp()).init()
+        net.fit(DataSet(x, y))
+        assert np.isfinite(net.score())
+
+    @pytest.mark.parametrize("upd", [Sgd(0.05), Nesterovs(0.05, 0.9),
+                                     RmsProp(0.01), AdaGrad(0.05), Adam(1e-2)])
+    def test_updaters_reduce_loss(self, upd):
+        x, y, _ = _separable_data()
+        net = MultiLayerNetwork(_mlp(updater=upd)).init()
+        losses = []
+        for _ in range(15):
+            net.fit(x, y)
+            losses.append(net.score())
+        assert losses[-1] < losses[0]
+
+    def test_seed_reproducibility(self):
+        x, y, _ = _separable_data()
+        nets = []
+        for _ in range(2):
+            net = MultiLayerNetwork(_mlp(seed=99)).init()
+            for _ in range(3):
+                net.fit(x, y)
+            nets.append(net.params().toNumpy())
+        np.testing.assert_array_equal(nets[0], nets[1])
+
+    def test_final_partial_batch_padded(self):
+        x, y, _ = _separable_data(n=100)  # 100 % 64 != 0
+        net = MultiLayerNetwork(_mlp()).init()
+        it = DataSetIterator(x, y, 64)
+        net.fit(it)  # should not crash or retrace on a ragged batch
+        assert np.isfinite(net.score())
+
+
+class TestCnn:
+    def test_lenet_shape_inference_and_fit(self):
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(1).updater(Adam(1e-3))
+                .list()
+                .layer(ConvolutionLayer(nOut=4, kernelSize=(5, 5), activation="relu"))
+                .layer(SubsamplingLayer(kernelSize=(2, 2), stride=(2, 2)))
+                .layer(DenseLayer(nOut=16, activation="relu"))
+                .layer(OutputLayer(nOut=3, activation="softmax"))
+                .setInputType(InputType.convolutionalFlat(12, 12, 1))
+                .build())
+        # 12-5+1=8 conv out; 8/2=4 pool out
+        assert conf.layers[2].nIn == 4 * 4 * 4
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.RandomState(0).rand(8, 144).astype("float32")
+        y = np.eye(3, dtype="float32")[np.random.RandomState(1).randint(0, 3, 8)]
+        net.fit(x, y)
+        assert np.isfinite(net.score())
+        out = net.output(x)
+        assert out.shape() == (8, 3)
+        np.testing.assert_allclose(out.sum(1).toNumpy(), np.ones(8), rtol=1e-4)
+
+    def test_batchnorm_updates_running_stats(self):
+        conf = (NeuralNetConfiguration.Builder().seed(1).updater(Sgd(0.1)).list()
+                .layer(DenseLayer(nOut=8, activation="identity"))
+                .layer(BatchNormalization())
+                .layer(OutputLayer(nOut=2, activation="softmax"))
+                .setInputType(InputType.feedForward(4))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.RandomState(0).randn(32, 4).astype("float32") * 3 + 1
+        y = np.eye(2, dtype="float32")[np.random.RandomState(1).randint(0, 2, 32)]
+        m0 = np.array(net._states[1]["mean"])
+        net.fit(x, y)
+        m1 = np.array(net._states[1]["mean"])
+        assert not np.allclose(m0, m1)
+
+    def test_same_mode_conv(self):
+        conf = (NeuralNetConfiguration.Builder().seed(1).updater(Sgd(0.1)).list()
+                .layer(ConvolutionLayer(nOut=2, kernelSize=(3, 3),
+                                        convolutionMode="same", activation="relu"))
+                .layer(GlobalPoolingLayer(poolingType="avg"))
+                .layer(OutputLayer(nOut=2, activation="softmax"))
+                .setInputType(InputType.convolutional(9, 9, 1))
+                .build())
+        # Same mode: spatial dims preserved
+        assert conf.layerInputTypes[1].height == 9
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.RandomState(0).rand(4, 1, 9, 9).astype("float32")
+        out = net.output(x)
+        assert out.shape() == (4, 2)
+
+
+class TestRnn:
+    def _seq_data(self, n=64, F=3, T=8, seed=0):
+        rng = np.random.RandomState(seed)
+        x = rng.randn(n, F, T).astype("float32") * 0.1
+        trend = rng.randint(0, 2, n)
+        ramp = np.linspace(-1, 1, T)
+        x[:, 0, :] += np.where(trend[:, None] == 1, ramp, -ramp)
+        y = np.eye(2, dtype="float32")[trend]
+        return x, np.repeat(y[:, :, None], T, axis=2), y, trend
+
+    def test_lstm_fit_and_output_shape(self):
+        x, yseq, y, trend = self._seq_data()
+        conf = (NeuralNetConfiguration.Builder().seed(7).updater(Adam(2e-2)).list()
+                .layer(LSTM(nOut=8))
+                .layer(RnnOutputLayer(nOut=2, activation="softmax"))
+                .setInputType(InputType.recurrent(3, 8))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        for _ in range(80):
+            net.fit(x, yseq)
+        out = net.output(x)
+        assert out.shape() == (64, 2, 8)
+        acc = (out.toNumpy()[:, :, -1].argmax(1) == trend).mean()
+        assert acc > 0.9
+
+    def test_graves_lstm_has_peepholes(self):
+        conf = (NeuralNetConfiguration.Builder().seed(7).updater(Sgd(0.1)).list()
+                .layer(GravesLSTM(nOut=4))
+                .layer(RnnOutputLayer(nOut=2, activation="softmax"))
+                .setInputType(InputType.recurrent(3, 5))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        assert "pi" in net._params[0] and "pf" in net._params[0]
+
+    def test_bidirectional_concat_doubles_features(self):
+        conf = (NeuralNetConfiguration.Builder().seed(7).updater(Sgd(0.1)).list()
+                .layer(Bidirectional(LSTM(nOut=4)))
+                .layer(RnnOutputLayer(nOut=2, activation="softmax"))
+                .setInputType(InputType.recurrent(3, 5))
+                .build())
+        assert conf.layers[1].nIn == 8
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.RandomState(0).randn(4, 3, 5).astype("float32")
+        assert net.output(x).shape() == (4, 2, 5)
+
+    def test_tbptt(self):
+        x, yseq, _, _ = self._seq_data(T=16)
+        conf = (NeuralNetConfiguration.Builder().seed(7).updater(Adam(5e-3)).list()
+                .layer(LSTM(nOut=8))
+                .layer(RnnOutputLayer(nOut=2, activation="softmax"))
+                .setInputType(InputType.recurrent(3, 16))
+                .build())
+        conf.backpropType = BackpropType.TruncatedBPTT
+        conf.tbpttFwdLength = conf.tbpttBackLength = 8
+        net = MultiLayerNetwork(conf).init()
+        losses = []
+        for _ in range(10):
+            net.fit(x, yseq)
+            losses.append(net.score())
+        assert losses[-1] < losses[0]
+
+    def test_rnn_timestep_stateful(self):
+        conf = (NeuralNetConfiguration.Builder().seed(7).updater(Sgd(0.1)).list()
+                .layer(LSTM(nOut=4))
+                .layer(RnnOutputLayer(nOut=2, activation="softmax"))
+                .setInputType(InputType.recurrent(3, 6))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.RandomState(0).randn(2, 3, 6).astype("float32")
+        full = net.output(x).toNumpy()
+        net.rnnClearPreviousState()
+        # feeding one timestep at a time must reproduce the full sequence
+        steps = []
+        for t in range(6):
+            o = net.rnnTimeStep(x[:, :, t:t + 1]).toNumpy()
+            steps.append(o[:, :, 0])
+        np.testing.assert_allclose(full[:, :, -1], steps[-1], rtol=1e-4, atol=1e-5)
+
+    def test_label_mask_ignores_padded_steps(self):
+        x, yseq, _, _ = self._seq_data(n=16)
+        conf = (NeuralNetConfiguration.Builder().seed(7).updater(Sgd(0.1)).list()
+                .layer(LSTM(nOut=4))
+                .layer(RnnOutputLayer(nOut=2, activation="softmax"))
+                .setInputType(InputType.recurrent(3, 8))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        lmask_full = np.ones((16, 8), np.float32)
+        lmask_half = np.ones((16, 8), np.float32)
+        lmask_half[:, 4:] = 0
+        s_full = net.score(DataSet(x, yseq, labelsMask=lmask_full))
+        s_half = net.score(DataSet(x, yseq, labelsMask=lmask_half))
+        assert not np.isclose(s_full, s_half)
+
+
+class TestGradients:
+    """Finite-difference gradient checks (reference: GradientCheckTests).
+    Run in fp64 on CPU."""
+
+    def _gradcheck(self, conf, x, y, eps=1e-6, tol=1e-4):
+        import jax.numpy as jnp
+
+        net = MultiLayerNetwork(conf).init()
+        net._params = jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.float64), net._params)
+        x = x.astype("float64")
+        y = y.astype("float64")
+        grads, score = net.computeGradientAndScore(x, y)
+        flat, treedef = jax.tree_util.tree_flatten(net._params)
+        gflat, _ = jax.tree_util.tree_flatten(grads)
+        rng = np.random.RandomState(0)
+        for ai, (a, g) in enumerate(zip(flat, gflat)):
+            # sample a few coordinates per array
+            idxs = [tuple(rng.randint(0, s) for s in a.shape) for _ in range(3)]
+            for idx in idxs:
+                pert = a.at[idx].add(eps)
+                flat2 = list(flat)
+                flat2[ai] = pert
+                net._params = jax.tree_util.tree_unflatten(treedef, flat2)
+                s_plus = float(net._jit_loss(net._params, net._states, x, y, None, None))
+                pert = a.at[idx].add(-eps)
+                flat2[ai] = pert
+                net._params = jax.tree_util.tree_unflatten(treedef, flat2)
+                s_minus = float(net._jit_loss(net._params, net._states, x, y, None, None))
+                fd = (s_plus - s_minus) / (2 * eps)
+                bp = float(g[idx])
+                assert abs(fd - bp) < tol * max(1.0, abs(fd), abs(bp)), \
+                    f"array {ai} idx {idx}: fd={fd} bp={bp}"
+            net._params = jax.tree_util.tree_unflatten(treedef, flat)
+
+    def test_dense_gradients(self):
+        x, y, _ = _separable_data(n=8)
+        conf = (NeuralNetConfiguration.Builder().seed(3)
+                .updater(Sgd(0.1)).dataType(DataType.DOUBLE)
+                .activation("tanh").list()
+                .layer(DenseLayer(nOut=6))
+                .layer(OutputLayer(nOut=3, activation="softmax", lossFunction="mcxent"))
+                .setInputType(InputType.feedForward(4)).build())
+        self._gradcheck(conf, x, y)
+
+    def test_conv_gradients(self):
+        rng = np.random.RandomState(0)
+        x = rng.rand(4, 1, 6, 6).astype("float64")
+        y = np.eye(2)[rng.randint(0, 2, 4)]
+        conf = (NeuralNetConfiguration.Builder().seed(3)
+                .updater(Sgd(0.1)).dataType(DataType.DOUBLE).list()
+                .layer(ConvolutionLayer(nOut=3, kernelSize=(3, 3), activation="tanh"))
+                .layer(SubsamplingLayer(kernelSize=(2, 2), stride=(2, 2)))
+                .layer(OutputLayer(nOut=2, activation="softmax"))
+                .setInputType(InputType.convolutional(6, 6, 1)).build())
+        self._gradcheck(conf, x, y)
+
+    def test_lstm_gradients(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 3, 5).astype("float64")
+        y = np.eye(2)[rng.randint(0, 2, 4)]
+        y = np.repeat(y[:, :, None], 5, axis=2)
+        conf = (NeuralNetConfiguration.Builder().seed(3)
+                .updater(Sgd(0.1)).dataType(DataType.DOUBLE).list()
+                .layer(GravesLSTM(nOut=4))
+                .layer(RnnOutputLayer(nOut=2, activation="softmax"))
+                .setInputType(InputType.recurrent(3, 5)).build())
+        self._gradcheck(conf, x, y, tol=1e-3)
+
+    def test_l2_regularization_included(self):
+        x, y, _ = _separable_data(n=8)
+        conf_reg = (NeuralNetConfiguration.Builder().seed(3).updater(Sgd(0.1))
+                    .l2(0.1).list()
+                    .layer(DenseLayer(nOut=6, activation="tanh"))
+                    .layer(OutputLayer(nOut=3, activation="softmax"))
+                    .setInputType(InputType.feedForward(4)).build())
+        conf_none = (NeuralNetConfiguration.Builder().seed(3).updater(Sgd(0.1))
+                     .list()
+                     .layer(DenseLayer(nOut=6, activation="tanh"))
+                     .layer(OutputLayer(nOut=3, activation="softmax"))
+                     .setInputType(InputType.feedForward(4)).build())
+        s_reg = MultiLayerNetwork(conf_reg).init().score(DataSet(x, y))
+        s_none = MultiLayerNetwork(conf_none).init().score(DataSet(x, y))
+        assert s_reg > s_none
+
+    def test_gradient_clipping_applies(self):
+        x, y, _ = _separable_data(n=8)
+        conf = (NeuralNetConfiguration.Builder().seed(3).updater(Sgd(1.0))
+                .gradientNormalization(GradientNormalization.ClipElementWiseAbsoluteValue)
+                .gradientNormalizationThreshold(1e-8)
+                .list()
+                .layer(DenseLayer(nOut=6, activation="tanh"))
+                .layer(OutputLayer(nOut=3, activation="softmax"))
+                .setInputType(InputType.feedForward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        p0 = net.params().toNumpy()
+        net.fit(x, y)
+        p1 = net.params().toNumpy()
+        # with threshold 1e-8 and lr 1, params move by at most ~1e-8 each
+        assert np.max(np.abs(p1 - p0)) < 1e-6
+
+
+class TestDropoutAndEval:
+    def test_dropout_only_in_train(self):
+        conf = (NeuralNetConfiguration.Builder().seed(3).updater(Sgd(0.1))
+                .list()
+                .layer(DenseLayer(nOut=16, activation="relu", dropOut=0.5))
+                .layer(OutputLayer(nOut=3, activation="softmax"))
+                .setInputType(InputType.feedForward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.RandomState(0).randn(8, 4).astype("float32")
+        o1 = net.output(x).toNumpy()
+        o2 = net.output(x).toNumpy()
+        np.testing.assert_array_equal(o1, o2)  # inference is deterministic
+
+    def test_evaluate(self):
+        x, y, yidx = _separable_data()
+        net = MultiLayerNetwork(_mlp()).init()
+        it = DataSetIterator(x, y, 64)
+        for _ in range(30):
+            net.fit(it)
+        e = net.evaluate(DataSetIterator(x, y, 64))
+        assert e.accuracy() > 0.9
+        assert 0 <= e.f1() <= 1
+        assert "Accuracy" in e.stats()
+
+    def test_embedding_layer(self):
+        rng = np.random.RandomState(0)
+        x = rng.randint(0, 10, (32, 1)).astype("float32")
+        y = np.eye(2, dtype="float32")[(x[:, 0] % 2).astype(int)]
+        conf = (NeuralNetConfiguration.Builder().seed(3).updater(Adam(5e-2)).list()
+                .layer(EmbeddingLayer(nIn=10, nOut=8))
+                .layer(OutputLayer(nOut=2, activation="softmax"))
+                .setInputType(InputType.feedForward(1)).build())
+        net = MultiLayerNetwork(conf).init()
+        for _ in range(40):
+            net.fit(x, y)
+        acc = (net.output(x).argMax(1).toNumpy() == (x[:, 0] % 2)).mean()
+        assert acc > 0.9
