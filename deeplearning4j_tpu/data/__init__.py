@@ -7,3 +7,4 @@ from deeplearning4j_tpu.data.dataset import (
     DataSet, DataSetIterator, ListDataSetIterator, ExistingDataSetIterator,
     SplitTestAndTrain,
 )
+from deeplearning4j_tpu.data.multidataset import MultiDataSet, MultiDataSetIterator

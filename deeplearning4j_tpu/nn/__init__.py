@@ -25,3 +25,10 @@ from deeplearning4j_tpu.nn.conf.recurrent import (
     LSTM, GravesLSTM, SimpleRnn, GRU, Bidirectional, LastTimeStep,
 )
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf.graph import (
+    GraphBuilder, ComputationGraphConfiguration, MergeVertex, ElementWiseVertex,
+    SubsetVertex, StackVertex, UnstackVertex, ScaleVertex, ShiftVertex,
+    L2NormalizeVertex, ReshapeVertex, PreprocessorVertex,
+)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.conf.layers import CnnLossLayer, RnnLossLayer

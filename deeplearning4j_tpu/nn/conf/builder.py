@@ -102,23 +102,29 @@ class MultiLayerConfiguration:
         return None  # format-agnostic (BN, activation, dropout, global pool...)
 
     def _auto_preprocessor(self, layer, cur):
-        wants = self._wants(layer)
-        if wants is None or cur.kind == wants:
-            return None, cur
-        if cur.kind == InputType.CNN and wants == InputType.FF:
-            pp = PP.CnnToFeedForwardPreProcessor(cur.height, cur.width, cur.channels)
-            return pp, pp.getOutputType(cur)
-        if cur.kind == InputType.RNN and wants == InputType.FF:
-            pp = PP.RnnToFeedForwardPreProcessor()
-            return pp, pp.getOutputType(cur)
-        if cur.kind == InputType.FF and wants == InputType.RNN:
-            pp = PP.FeedForwardToRnnPreProcessor()
-            return pp, pp.getOutputType(cur)
-        if cur.kind == InputType.CNN and wants == InputType.RNN:
-            pp = PP.CnnToRnnPreProcessor(cur.height, cur.width, cur.channels)
-            return pp, pp.getOutputType(cur)
-        raise ValueError(
-            f"No preprocessor for {cur.kind} -> {wants} (layer {type(layer).__name__})")
+        return auto_preprocessor(layer, cur)
+
+
+def auto_preprocessor(layer, cur):
+    """Auto-insert a format preprocessor for a layer given the incoming
+    InputType (shared by sequential and graph shape inference)."""
+    wants = MultiLayerConfiguration._wants(layer)
+    if wants is None or cur.kind == wants:
+        return None, cur
+    if cur.kind == InputType.CNN and wants == InputType.FF:
+        pp = PP.CnnToFeedForwardPreProcessor(cur.height, cur.width, cur.channels)
+        return pp, pp.getOutputType(cur)
+    if cur.kind == InputType.RNN and wants == InputType.FF:
+        pp = PP.RnnToFeedForwardPreProcessor()
+        return pp, pp.getOutputType(cur)
+    if cur.kind == InputType.FF and wants == InputType.RNN:
+        pp = PP.FeedForwardToRnnPreProcessor()
+        return pp, pp.getOutputType(cur)
+    if cur.kind == InputType.CNN and wants == InputType.RNN:
+        pp = PP.CnnToRnnPreProcessor(cur.height, cur.width, cur.channels)
+        return pp, pp.getOutputType(cur)
+    raise ValueError(
+        f"No preprocessor for {cur.kind} -> {wants} (layer {type(layer).__name__})")
 
 
 class ListBuilder:

@@ -1,0 +1,353 @@
+"""ComputationGraph configuration: DAG of layers and vertices.
+
+Reference: org.deeplearning4j.nn.conf.ComputationGraphConfiguration
+(GraphBuilder) and org.deeplearning4j.nn.conf.graph.* vertex types
+(MergeVertex, ElementWiseVertex, SubsetVertex, StackVertex, UnstackVertex,
+ScaleVertex, ShiftVertex, L2NormalizeVertex, PreprocessorVertex,
+ReshapeVertex). Vertices are pure functions over their input activations;
+the DAG compiles into the network's single jitted XLA computation, so a
+residual add or merge is just another fused op — no vertex-level workspace
+or scheduling exists to port.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ndarray.dtype import DataType
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf import recurrent as R
+from deeplearning4j_tpu.nn.conf import preprocessors as PP
+
+
+class GraphVertex:
+    """Parameterless DAG node combining input activations."""
+
+    def apply(self, inputs: list):
+        raise NotImplementedError
+
+    def getOutputType(self, *inputTypes) -> InputType:
+        raise NotImplementedError
+
+
+class MergeVertex(GraphVertex):
+    """Concatenate along the feature/channel axis (reference: MergeVertex)."""
+
+    def apply(self, inputs):
+        x = inputs[0]
+        if x.ndim == 4:     # NHWC: channel axis -1
+            return jnp.concatenate(inputs, axis=-1)
+        if x.ndim == 3:     # NCW: feature axis 1
+            return jnp.concatenate(inputs, axis=1)
+        return jnp.concatenate(inputs, axis=-1)
+
+    def getOutputType(self, *its):
+        it = its[0]
+        if it.kind == InputType.CNN:
+            return InputType.convolutional(it.height, it.width,
+                                           sum(i.channels for i in its))
+        if it.kind == InputType.RNN:
+            return InputType.recurrent(sum(i.size for i in its),
+                                       it.dims.get("timeSeriesLength"))
+        return InputType.feedForward(sum(i.size for i in its))
+
+
+class ElementWiseVertex(GraphVertex):
+    """Pointwise combine (reference: ElementWiseVertex; Add/Subtract/
+    Product/Average/Max) — the residual-connection vertex."""
+
+    Add, Subtract, Product, Average, Max = "add", "subtract", "product", "average", "max"
+
+    def __init__(self, op="add"):
+        self.op = str(op).lower()
+
+    def apply(self, inputs):
+        if self.op == "add":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out + x
+            return out
+        if self.op == "subtract":
+            return inputs[0] - inputs[1]
+        if self.op == "product":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out * x
+            return out
+        if self.op == "average":
+            return sum(inputs) / len(inputs)
+        if self.op == "max":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = jnp.maximum(out, x)
+            return out
+        raise ValueError(f"Unknown ElementWiseVertex op {self.op}")
+
+    def getOutputType(self, *its):
+        return its[0]
+
+
+class SubsetVertex(GraphVertex):
+    """Feature-range subset (reference: SubsetVertex)."""
+
+    def __init__(self, frm, to):
+        self.frm, self.to = int(frm), int(to)
+
+    def apply(self, inputs):
+        x = inputs[0]
+        if x.ndim == 4:
+            return x[..., self.frm:self.to + 1]
+        if x.ndim == 3:
+            return x[:, self.frm:self.to + 1, :]
+        return x[:, self.frm:self.to + 1]
+
+    def getOutputType(self, *its):
+        it = its[0]
+        n = self.to - self.frm + 1
+        if it.kind == InputType.CNN:
+            return InputType.convolutional(it.height, it.width, n)
+        if it.kind == InputType.RNN:
+            return InputType.recurrent(n, it.dims.get("timeSeriesLength"))
+        return InputType.feedForward(n)
+
+
+class StackVertex(GraphVertex):
+    """Stack along batch dim (reference: StackVertex)."""
+
+    def apply(self, inputs):
+        return jnp.concatenate(inputs, axis=0)
+
+    def getOutputType(self, *its):
+        return its[0]
+
+
+class UnstackVertex(GraphVertex):
+    def __init__(self, stackIndex, numStacks):
+        self.stackIndex, self.numStacks = int(stackIndex), int(numStacks)
+
+    def apply(self, inputs):
+        x = inputs[0]
+        n = x.shape[0] // self.numStacks
+        return x[self.stackIndex * n:(self.stackIndex + 1) * n]
+
+    def getOutputType(self, *its):
+        return its[0]
+
+
+class ScaleVertex(GraphVertex):
+    def __init__(self, scaleFactor):
+        self.scaleFactor = float(scaleFactor)
+
+    def apply(self, inputs):
+        return inputs[0] * self.scaleFactor
+
+    def getOutputType(self, *its):
+        return its[0]
+
+
+class ShiftVertex(GraphVertex):
+    def __init__(self, shiftFactor):
+        self.shiftFactor = float(shiftFactor)
+
+    def apply(self, inputs):
+        return inputs[0] + self.shiftFactor
+
+    def getOutputType(self, *its):
+        return its[0]
+
+
+class L2NormalizeVertex(GraphVertex):
+    def __init__(self, eps=1e-8):
+        self.eps = eps
+
+    def apply(self, inputs):
+        x = inputs[0]
+        axes = tuple(range(1, x.ndim))
+        n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True) + self.eps)
+        return x / n
+
+    def getOutputType(self, *its):
+        return its[0]
+
+
+class ReshapeVertex(GraphVertex):
+    def __init__(self, *newShape):
+        self.newShape = tuple(int(s) for s in newShape)
+
+    def apply(self, inputs):
+        return inputs[0].reshape((inputs[0].shape[0],) + self.newShape[1:])
+
+    def getOutputType(self, *its):
+        if len(self.newShape) == 2:
+            return InputType.feedForward(self.newShape[1])
+        if len(self.newShape) == 4:
+            return InputType.convolutional(self.newShape[1], self.newShape[2], self.newShape[3])
+        return its[0]
+
+
+class PreprocessorVertex(GraphVertex):
+    def __init__(self, preProcessor):
+        self.pp = preProcessor
+
+    def apply(self, inputs):
+        return self.pp.preProcess(inputs[0])
+
+    def getOutputType(self, *its):
+        return self.pp.getOutputType(its[0])
+
+
+class _Node:
+    """Resolved DAG node: input | layer | vertex."""
+
+    def __init__(self, name, kind, payload=None, inputs=()):
+        self.name = name
+        self.kind = kind          # "input" | "layer" | "vertex"
+        self.payload = payload    # Layer config or GraphVertex
+        self.inputs = list(inputs)
+        self.preprocessor = None  # for layer nodes
+        self.inputType = None     # resolved InputType of the node OUTPUT
+
+
+class ComputationGraphConfiguration:
+    def __init__(self, nodes, inputs, outputs, defaults, inputTypes,
+                 backpropType="standard", tbpttFwdLength=20, tbpttBackLength=20):
+        self.nodes = nodes            # {name: _Node} insertion-ordered
+        self.networkInputs = inputs
+        self.networkOutputs = outputs
+        self.defaults = defaults
+        self.inputTypes = inputTypes  # {input_name: InputType}
+        self.seed = defaults.get("seed", 12345)
+        self.dataType = defaults.get("dataType", DataType.FLOAT)
+        self.backpropType = backpropType
+        self.tbpttFwdLength = tbpttFwdLength
+        self.tbpttBackLength = tbpttBackLength
+        self.gradientNormalization = defaults.get("gradientNormalization")
+        self.gradientNormalizationThreshold = defaults.get("gradientNormalizationThreshold", 1.0)
+        self.topoOrder = self._topo_sort()
+        self._infer_shapes()
+
+    def _topo_sort(self):
+        order, seen, temp = [], set(), set()
+
+        def visit(name):
+            if name in seen:
+                return
+            if name in temp:
+                raise ValueError(f"Cycle detected at vertex '{name}'")
+            temp.add(name)
+            for dep in self.nodes[name].inputs:
+                visit(dep)
+            temp.discard(name)
+            seen.add(name)
+            order.append(name)
+
+        for name in self.nodes:
+            visit(name)
+        return order
+
+    def _infer_shapes(self):
+        if not self.inputTypes:
+            raise ValueError("setInputTypes(...) is required for ComputationGraph")
+        for name in self.topoOrder:
+            node = self.nodes[name]
+            if node.kind == "input":
+                it = self.inputTypes[name]
+                if it.kind == InputType.CNN_FLAT:
+                    it = InputType.convolutional(it.height, it.width, it.channels)
+                node.inputType = it
+                continue
+            in_types = [self.nodes[i].inputType for i in node.inputs]
+            if node.kind == "vertex":
+                node.inputType = node.payload.getOutputType(*in_types)
+                continue
+            layer = node.payload
+            layer.mergeGlobals(self.defaults)
+            cur = in_types[0]
+            if node.preprocessor is None:
+                pp, cur2 = self._auto_pp(layer, cur)
+                if pp is not None:
+                    node.preprocessor = pp
+                    cur = cur2
+            else:
+                cur = node.preprocessor.getOutputType(cur)
+            if hasattr(layer, "inferNIn"):
+                layer.inferNIn(cur)
+            node.layerInputType = cur
+            node.inputType = layer.getOutputType(cur)
+
+    @staticmethod
+    def _auto_pp(layer, cur):
+        from deeplearning4j_tpu.nn.conf.builder import auto_preprocessor
+
+        return auto_preprocessor(layer, cur)
+
+
+class GraphBuilder:
+    """Reference: ComputationGraphConfiguration.GraphBuilder."""
+
+    def __init__(self, defaults):
+        self._defaults = defaults
+        self._nodes = {}
+        self._inputs = []
+        self._outputs = []
+        self._inputTypes = {}
+        self._backpropType = "standard"
+        self._tbpttFwd = self._tbpttBack = 20
+
+    def addInputs(self, *names):
+        for n in names:
+            self._inputs.append(n)
+            self._nodes[n] = _Node(n, "input")
+        return self
+
+    def addLayer(self, name, layer, *inputs, preprocessor=None):
+        node = _Node(name, "layer", layer, inputs)
+        node.preprocessor = preprocessor
+        self._nodes[name] = node
+        return self
+
+    def layer(self, name, layer, *inputs):
+        return self.addLayer(name, layer, *inputs)
+
+    def addVertex(self, name, vertex, *inputs):
+        self._nodes[name] = _Node(name, "vertex", vertex, inputs)
+        return self
+
+    def setOutputs(self, *names):
+        self._outputs = list(names)
+        return self
+
+    def setInputTypes(self, *types):
+        for n, t in zip(self._inputs, types):
+            self._inputTypes[n] = t
+        return self
+
+    def inputPreProcessor(self, layerName, pp):
+        self._nodes[layerName].preprocessor = pp
+        return self
+
+    def backpropType(self, bp):
+        self._backpropType = bp
+        return self
+
+    def tBPTTForwardLength(self, n):
+        self._tbpttFwd = n
+        return self
+
+    def tBPTTBackwardLength(self, n):
+        self._tbpttBack = n
+        return self
+
+    def build(self) -> ComputationGraphConfiguration:
+        if not self._inputs:
+            raise ValueError("addInputs(...) required")
+        if not self._outputs:
+            raise ValueError("setOutputs(...) required")
+        for name, node in self._nodes.items():
+            for dep in node.inputs:
+                if dep not in self._nodes:
+                    raise ValueError(f"Vertex '{name}' references unknown input '{dep}'")
+        return ComputationGraphConfiguration(
+            self._nodes, self._inputs, self._outputs, self._defaults,
+            self._inputTypes, self._backpropType, self._tbpttFwd, self._tbpttBack)

@@ -291,6 +291,16 @@ class LossLayer(Layer):
         return _act.get(self.activation)(x), state
 
 
+class CnnLossLayer(LossLayer):
+    """Per-pixel loss head for dense prediction, e.g. segmentation
+    (reference: conf.layers.CnnLossLayer). Activations/labels are per-pixel
+    maps; loss averages over all pixels."""
+
+
+class RnnLossLayer(LossLayer):
+    """Per-timestep loss without params (reference: conf.layers.RnnLossLayer)."""
+
+
 class ActivationLayer(Layer):
     def hasParams(self):
         return False

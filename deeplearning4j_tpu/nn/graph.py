@@ -1,0 +1,341 @@
+"""ComputationGraph — the DAG network executor.
+
+Reference: org.deeplearning4j.nn.graph.ComputationGraph. Same TPU design
+as MultiLayerNetwork (see nn/multilayer.py): the full train step over the
+DAG — all vertices, losses on every output layer, backward, updaters —
+compiles to one donated-buffer XLA computation. Supports multiple inputs
+and outputs via MultiDataSet.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ndarray import INDArray
+from deeplearning4j_tpu.nn import losses as _losses
+from deeplearning4j_tpu.nn import updaters as _upd
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.multilayer import (_grad_normalize, _unwrap,
+                                               cast_params, strip_carries)
+
+
+class ComputationGraph:
+    def __init__(self, conf):
+        self.conf = conf
+        self._layer_names = [n for n in conf.topoOrder
+                             if conf.nodes[n].kind == "layer"]
+        # stable per-layer rng stream ids (python hash() is process-salted)
+        self._layer_idx = {n: i for i, n in enumerate(self._layer_names)}
+        self._params = None    # {layer_name: dict}
+        self._states = None
+        self._upd_states = None
+        self._updaters = None
+        self._iteration = 0
+        self._epoch = 0
+        self._listeners = []
+        self._compute_dtype = conf.dataType.np_dtype
+        self._param_dtype = jnp.float64 if self._compute_dtype == jnp.float64 else jnp.float32
+        self._jit_train = jax.jit(self._train_step, donate_argnums=(0, 1, 2))
+        self._jit_forward = jax.jit(self._forward_infer)
+        self._jit_loss = jax.jit(self._loss_only)
+
+    # ------------------------------------------------------------------
+    def init(self):
+        key = jax.random.key(self.conf.seed)
+        params, states, upds, upd_states = {}, {}, {}, {}
+        for i, name in enumerate(self._layer_names):
+            node = self.conf.nodes[name]
+            k = jax.random.fold_in(key, i)
+            p, s = node.payload.initialize(k, node.layerInputType, self._param_dtype)
+            params[name] = p
+            states[name] = s
+            u = _upd.resolve(node.payload.updater) if node.payload.updater is not None else _upd.Sgd()
+            upds[name] = u
+            upd_states[name] = u.init(p) if p else ()
+        self._params, self._states = params, states
+        self._updaters, self._upd_states = upds, upd_states
+        return self
+
+    def _require_init(self):
+        if self._params is None:
+            raise RuntimeError("Call net.init() before fit/output/score")
+
+    # ------------------------------------------------------------------
+    def _cast_params(self, p):
+        return cast_params(p, self._compute_dtype, self._param_dtype)
+
+    def _entry(self, name, x):
+        it = self.conf.inputTypes.get(name)
+        if it is not None and it.kind == InputType.CNN and x.ndim == 4:
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        if it is not None and it.kind == InputType.CNN_FLAT and x.ndim == 2:
+            x = x.reshape(x.shape[0], it.channels, it.height, it.width)
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        return x.astype(self._compute_dtype)
+
+    def _run_graph(self, params, states, inputs, train, key, fmasks):
+        """inputs: dict name->array. Returns (activations dict, preacts of
+        output layers, new states). Masks propagate node-to-node: a node's
+        mask is its first input's mask (reference:
+        ComputationGraph.feedForwardMaskArrays)."""
+        acts = {}
+        masks = {}
+        new_states = {}
+        preacts = {}
+        B = None
+        for idx, name in enumerate(self.conf.networkInputs):
+            x = self._entry(name, inputs[name])
+            B = x.shape[0] if B is None else B
+            acts[name] = x
+            masks[name] = None if fmasks is None else fmasks.get(name)
+        for name in self.conf.topoOrder:
+            node = self.conf.nodes[name]
+            if node.kind == "input":
+                continue
+            if node.kind == "vertex":
+                acts[name] = node.payload.apply([acts[i] for i in node.inputs])
+                masks[name] = masks.get(node.inputs[0])
+                continue
+            layer = node.payload
+            h = acts[node.inputs[0]]
+            fmask = masks.get(node.inputs[0])
+            if node.preprocessor is not None:
+                if hasattr(node.preprocessor, "batch"):
+                    node.preprocessor.batch = B
+                h = node.preprocessor.preProcess(h)
+            lk = None if key is None else jax.random.fold_in(key, self._layer_idx[name])
+            p = self._cast_params(params[name])
+            if name in self.conf.networkOutputs and isinstance(
+                    layer, (L.BaseOutputLayer, L.LossLayer)):
+                h = layer._dropout_input(h, train, lk)
+                pre = layer.preoutput(p, h)
+                preacts[name] = pre
+                from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+                out = MultiLayerNetwork._out_act(layer, pre)
+                if out.ndim == 4:  # NHWC internal -> NCHW at the API boundary
+                    out = jnp.transpose(out, (0, 3, 1, 2))
+                acts[name] = out
+                new_states[name] = states[name]
+                continue
+            h, s = layer.forward(p, states[name], h, train, lk, fmask)
+            acts[name] = h
+            masks[name] = fmask
+            new_states[name] = s
+        return acts, preacts, new_states
+
+    def _loss(self, preacts, labels, lmasks):
+        total = 0.0
+        for i, name in enumerate(self.conf.networkOutputs):
+            layer = self.conf.nodes[name].payload
+            pre = preacts[name]
+            y = labels[i]
+            lmask = None if lmasks is None else lmasks[i]
+            ldt = jnp.promote_types(pre.dtype, jnp.float32)
+            pre = pre.astype(ldt)
+            y = y.astype(ldt)
+            if pre.ndim == 3:  # NCW preact: loss over [B,T,O]
+                pre = jnp.transpose(pre, (0, 2, 1))
+                y = jnp.transpose(y, (0, 2, 1))
+            elif pre.ndim == 4:  # NHWC preact, NCHW labels from the API
+                y = jnp.transpose(y, (0, 2, 3, 1))
+            total = total + _losses.compute(layer.lossFunction, y, pre,
+                                            layer.activation, lmask)
+        return total
+
+    def _regularization(self, params):
+        reg = 0.0
+        for name in self._layer_names:
+            p = params[name]
+            if p:
+                reg = reg + self.conf.nodes[name].payload.regularization(p)
+        return reg
+
+    def _loss_fn(self, params, states, inputs, labels, key, fmasks, lmasks):
+        _, preacts, new_states = self._run_graph(
+            params, self._strip_carries(states), inputs, True, key, fmasks)
+        loss = self._loss(preacts, labels, lmasks) + self._regularization(params)
+        return loss, new_states
+
+    def _train_step(self, params, upd_states, states, iteration, inputs, labels,
+                    key, fmasks, lmasks):
+        (loss, new_states), grads = jax.value_and_grad(
+            self._loss_fn, has_aux=True)(params, states, inputs, labels, key,
+                                         fmasks, lmasks)
+        glist = _grad_normalize([grads[n] for n in self._layer_names],
+                                self.conf.gradientNormalization,
+                                self.conf.gradientNormalizationThreshold)
+        new_params, new_upd = dict(params), dict(upd_states)
+        for name, g in zip(self._layer_names, glist):
+            if not params[name]:
+                continue
+            upd, us = self._updaters[name].apply(g, upd_states[name], iteration)
+            new_params[name] = jax.tree_util.tree_map(
+                lambda p, u: (p - u).astype(p.dtype), params[name], upd)
+            new_upd[name] = us
+        return new_params, new_upd, new_states, loss
+
+    def _forward_infer(self, params, states, inputs):
+        acts, _, _ = self._run_graph(params, self._strip_carries(states),
+                                     inputs, False, None, None)
+        return [acts[n] for n in self.conf.networkOutputs]
+
+    def _loss_only(self, params, states, inputs, labels, fmasks=None, lmasks=None):
+        _, preacts, _ = self._run_graph(params, self._strip_carries(states),
+                                        inputs, False, None, fmasks)
+        return self._loss(preacts, labels, lmasks) + self._regularization(params)
+
+    @staticmethod
+    def _strip_carries(states):
+        return strip_carries(states)
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+    def _coerce_inputs(self, features):
+        if isinstance(features, (list, tuple)):
+            arrs = [_unwrap(f) for f in features]
+        else:
+            arrs = [_unwrap(features)]
+        return {n: a for n, a in zip(self.conf.networkInputs, arrs)}
+
+    def fit(self, data, labels=None, epochs=None):
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.data.multidataset import MultiDataSet
+
+        self._require_init()
+        if labels is not None:
+            self._fit_arrays(data, labels)
+            return self
+        if isinstance(data, (DataSet, MultiDataSet)):
+            self._fit_ds(data)
+            return self
+        for _ in range(epochs or 1):
+            data.reset()
+            while data.hasNext():
+                self._fit_ds(data.next())
+            self._epoch += 1
+        return self
+
+    def _fit_arrays(self, features, labels):
+        inputs = self._coerce_inputs(features)
+        labs = [_unwrap(l) for l in (labels if isinstance(labels, (list, tuple)) else [labels])]
+        self._step(inputs, labs, None, None)
+
+    def _fit_ds(self, ds):
+        from deeplearning4j_tpu.data.multidataset import MultiDataSet
+
+        if isinstance(ds, MultiDataSet):
+            inputs = {n: _unwrap(f) for n, f in zip(self.conf.networkInputs, ds.getFeatures())}
+            labs = [_unwrap(l) for l in ds.getLabels()]
+            fmasks = None
+            fm = ds.getFeaturesMaskArrays()
+            if fm is not None:
+                fmasks = {n: _unwrap(m) for n, m in zip(self.conf.networkInputs, fm)}
+            lm = ds.getLabelsMaskArrays()
+            lmasks = None if lm is None else [_unwrap(m) for m in lm]
+        else:
+            inputs = {self.conf.networkInputs[0]: _unwrap(ds.getFeatures())}
+            labs = [_unwrap(ds.getLabels())]
+            fm = ds.getFeaturesMaskArray()
+            fmasks = None if fm is None else {self.conf.networkInputs[0]: _unwrap(fm)}
+            lm = ds.getLabelsMaskArray()
+            lmasks = None if lm is None else [_unwrap(lm)]
+        self._step(inputs, labs, fmasks, lmasks)
+
+    def _step(self, inputs, labels, fmasks, lmasks):
+        if self.conf.backpropType == "tbptt":
+            raise NotImplementedError(
+                "Truncated BPTT is not yet supported on ComputationGraph; "
+                "use MultiLayerNetwork or standard backprop")
+        key = jax.random.fold_in(jax.random.key(self.conf.seed ^ 0x5EED), self._iteration)
+        self._params, self._upd_states, self._states, loss = self._jit_train(
+            self._params, self._upd_states, self._states,
+            jnp.asarray(self._iteration, jnp.int32), inputs, labels, key,
+            fmasks, lmasks)
+        self._score = float(loss)
+        self._iteration += 1
+        for lst in self._listeners:
+            lst.iterationDone(self, self._iteration, self._epoch)
+
+    def output(self, *features):
+        self._require_init()
+        inputs = self._coerce_inputs(features if len(features) > 1 else features[0])
+        outs = self._jit_forward(self._params, self._states, inputs)
+        outs = [INDArray(o) for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+    def outputSingle(self, *features) -> INDArray:
+        out = self.output(*features)
+        return out if isinstance(out, INDArray) else out[0]
+
+    def score(self, ds=None) -> float:
+        if ds is None:
+            return getattr(self, "_score", float("nan"))
+        from deeplearning4j_tpu.data.multidataset import MultiDataSet
+
+        self._require_init()
+        if isinstance(ds, MultiDataSet):
+            inputs = {n: _unwrap(f) for n, f in zip(self.conf.networkInputs, ds.getFeatures())}
+            labs = [_unwrap(l) for l in ds.getLabels()]
+            fm = ds.getFeaturesMaskArrays()
+            fmasks = None if fm is None else {
+                n: _unwrap(m) for n, m in zip(self.conf.networkInputs, fm)}
+            lm = ds.getLabelsMaskArrays()
+            lmasks = None if lm is None else [_unwrap(m) for m in lm]
+        else:
+            inputs = {self.conf.networkInputs[0]: _unwrap(ds.getFeatures())}
+            labs = [_unwrap(ds.getLabels())]
+            fm = ds.getFeaturesMaskArray()
+            fmasks = None if fm is None else {self.conf.networkInputs[0]: _unwrap(fm)}
+            lm = ds.getLabelsMaskArray()
+            lmasks = None if lm is None else [_unwrap(lm)]
+        return float(self._jit_loss(self._params, self._states, inputs, labs,
+                                    fmasks, lmasks))
+
+    def evaluate(self, iterator):
+        from deeplearning4j_tpu.evaluation.evaluation import Evaluation
+        from deeplearning4j_tpu.data.multidataset import MultiDataSet
+
+        e = Evaluation()
+        iterator.reset()
+        while iterator.hasNext():
+            ds = iterator.next()
+            if isinstance(ds, MultiDataSet):
+                out = self.outputSingle(ds.getFeatures())
+                lm = ds.getLabelsMaskArrays()
+                e.eval(ds.getLabels(0), out, mask=None if lm is None else lm[0])
+            else:
+                out = self.outputSingle(ds.getFeatures())
+                e.eval(ds.getLabels(), out, mask=ds.getLabelsMaskArray())
+        return e
+
+    def params(self) -> INDArray:
+        leaves = jax.tree_util.tree_leaves(self._params)
+        return INDArray(jnp.concatenate([l.reshape(-1).astype(jnp.float32) for l in leaves]))
+
+    def numParams(self) -> int:
+        return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(self._params))
+
+    def setListeners(self, *listeners):
+        self._listeners = list(listeners)
+        return self
+
+    def getIterationCount(self):
+        return self._iteration
+
+    def summary(self) -> str:
+        lines = [f"{'name':<24}{'type':<26}{'inputs':<30}{'params':<10}"]
+        total = 0
+        for name in self.conf.topoOrder:
+            node = self.conf.nodes[name]
+            n = 0
+            if node.kind == "layer" and self._params:
+                n = sum(int(np.prod(v.shape)) for v in self._params[name].values())
+            total += n
+            kind = type(node.payload).__name__ if node.payload is not None else "Input"
+            lines.append(f"{name:<24}{kind:<26}{','.join(node.inputs):<30}{n:<10}")
+        lines.append(f"Total params: {total}")
+        return "\n".join(lines)

@@ -38,6 +38,29 @@ def _unwrap(x):
     return jnp.asarray(x)
 
 
+def strip_carries(states):
+    """Drop transient rnn carries (h/c) from a state container (list or
+    dict of per-layer state dicts); keep persistent state like BN stats."""
+
+    def strip(s):
+        if isinstance(s, dict):
+            return {k: strip(v) for k, v in s.items() if k not in ("h", "c")}
+        return s
+
+    if isinstance(states, dict):
+        return {n: strip(s) for n, s in states.items()}
+    return [strip(s) for s in states]
+
+
+def cast_params(p, compute_dtype, param_dtype):
+    """fp32 master params -> compute dtype (bf16/fp16) for the forward."""
+    if compute_dtype == param_dtype:
+        return p
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(compute_dtype)
+        if jnp.issubdtype(a.dtype, jnp.floating) else a, p)
+
+
 def _grad_normalize(grads_per_layer, mode, threshold):
     """Gradient clipping/normalization (reference:
     org.deeplearning4j.nn.conf.GradientNormalization, applied in
@@ -126,12 +149,7 @@ class MultiLayerNetwork:
         return x.astype(self._compute_dtype)
 
     def _cast_params(self, p):
-        """Params (fp32 master) -> compute dtype, shared by every forward path."""
-        if self._compute_dtype == self._param_dtype:
-            return p
-        return jax.tree_util.tree_map(
-            lambda a: a.astype(self._compute_dtype)
-            if jnp.issubdtype(a.dtype, jnp.floating) else a, p)
+        return cast_params(p, self._compute_dtype, self._param_dtype)
 
     def _run_layers(self, params, states, x, train, key, fmask):
         h = self._entry(x)
@@ -161,6 +179,10 @@ class MultiLayerNetwork:
                 pre = jnp.transpose(preact, (0, 2, 1))
                 lab = jnp.transpose(labels, (0, 2, 1))
                 return _losses.compute(last.lossFunction, lab, pre,
+                                       last.activation, lmask)
+            if preact.ndim == 4:  # CnnLossLayer: NHWC preact, NCHW labels
+                lab = jnp.transpose(labels, (0, 2, 3, 1))
+                return _losses.compute(last.lossFunction, lab, preact,
                                        last.activation, lmask)
             return _losses.compute(last.lossFunction, labels, preact,
                                    last.activation, lmask)
@@ -232,15 +254,7 @@ class MultiLayerNetwork:
 
     @staticmethod
     def _strip_carries(states):
-        """Drop transient rnn carries (h/c) so fresh sequences start at 0;
-        keep persistent state like BN running stats."""
-
-        def strip(s):
-            if isinstance(s, dict):
-                return {k: strip(v) for k, v in s.items() if k not in ("h", "c")}
-            return s
-
-        return [strip(s) for s in states]
+        return strip_carries(states)
 
     # ------------------------------------------------------------------
     # public API (reference signatures)

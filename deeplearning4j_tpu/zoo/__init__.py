@@ -1,0 +1,16 @@
+"""Model zoo.
+
+Reference: org.deeplearning4j.zoo.model.* (ZooModel subclasses LeNet,
+SimpleCNN, AlexNet, VGG16, ResNet50, UNet, TextGenerationLSTM). Each model
+is a configuration factory; init() returns a ready network. Pretrained
+weight download is not available in this zero-egress build (reference:
+ZooModel.initPretrained) — initPretrained raises with a clear message.
+"""
+
+from deeplearning4j_tpu.zoo.models import (
+    ZooModel, LeNet, SimpleCNN, AlexNet, VGG16, VGG19, ResNet50, UNet,
+    TextGenerationLSTM,
+)
+
+__all__ = ["ZooModel", "LeNet", "SimpleCNN", "AlexNet", "VGG16", "VGG19",
+           "ResNet50", "UNet", "TextGenerationLSTM"]

@@ -1,0 +1,328 @@
+"""Zoo model definitions.
+
+Reference: deeplearning4j-zoo org.deeplearning4j.zoo.model.{LeNet,
+SimpleCNN, AlexNet, VGG16, VGG19, ResNet50, UNet, TextGenerationLSTM}.
+Architectures follow the reference's configurations; all compile to single
+XLA computations through MultiLayerNetwork/ComputationGraph. bf16 compute
+is a constructor flag (TPU-first addition; the reference's fp16 lives in
+its cuDNN helpers).
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.ndarray.dtype import DataType
+from deeplearning4j_tpu.nn import (
+    NeuralNetConfiguration, InputType, MultiLayerNetwork, ComputationGraph,
+    DenseLayer, OutputLayer, RnnOutputLayer, ConvolutionLayer, SubsamplingLayer,
+    BatchNormalization, GlobalPoolingLayer, DropoutLayer, LocalResponseNormalization,
+    LSTM, ElementWiseVertex, MergeVertex, Upsampling2D, ActivationLayer,
+    Adam, Nesterovs, Sgd, WeightInit,
+)
+from deeplearning4j_tpu.nn.conf.layers import CnnLossLayer
+
+
+class ZooModel:
+    def __init__(self, numClasses=1000, seed=123, inputShape=None, updater=None,
+                 cacheMode=None, workspaceMode=None, dataType=None):
+        self.numClasses = numClasses
+        self.seed = seed
+        self.inputShape = inputShape or self.defaultInputShape()
+        self.updater = updater
+        self.dataType = dataType or DataType.FLOAT
+
+    @staticmethod
+    def defaultInputShape():
+        return (3, 224, 224)  # NCHW per-example, reference convention
+
+    def conf(self):
+        raise NotImplementedError
+
+    def init(self):
+        conf = self.conf()
+        from deeplearning4j_tpu.nn.conf.graph import ComputationGraphConfiguration
+
+        net = ComputationGraph(conf) if isinstance(conf, ComputationGraphConfiguration) \
+            else MultiLayerNetwork(conf)
+        return net.init()
+
+    def initPretrained(self, *_, **__):
+        raise NotImplementedError(
+            "Pretrained weights are not bundled in this build (no network "
+            "egress). Train from scratch or load a checkpoint via "
+            "util.serializer.ModelSerializer.")
+
+
+class LeNet(ZooModel):
+    """Reference: zoo.model.LeNet (LeCun MNIST CNN)."""
+
+    @staticmethod
+    def defaultInputShape():
+        return (1, 28, 28)
+
+    def conf(self):
+        c, h, w = self.inputShape
+        return (NeuralNetConfiguration.Builder()
+                .seed(self.seed)
+                .updater(self.updater or Adam(1e-3))
+                .weightInit(WeightInit.XAVIER)
+                .dataType(self.dataType)
+                .list()
+                .layer(ConvolutionLayer(nOut=20, kernelSize=(5, 5), stride=(1, 1),
+                                        activation="relu"))
+                .layer(SubsamplingLayer(poolingType="max", kernelSize=(2, 2), stride=(2, 2)))
+                .layer(ConvolutionLayer(nOut=50, kernelSize=(5, 5), activation="relu"))
+                .layer(SubsamplingLayer(poolingType="max", kernelSize=(2, 2), stride=(2, 2)))
+                .layer(DenseLayer(nOut=500, activation="relu"))
+                .layer(OutputLayer(nOut=self.numClasses, activation="softmax",
+                                   lossFunction="mcxent"))
+                .setInputType(InputType.convolutionalFlat(h, w, c))
+                .build())
+
+
+class SimpleCNN(ZooModel):
+    """Reference: zoo.model.SimpleCNN."""
+
+    @staticmethod
+    def defaultInputShape():
+        return (3, 48, 48)
+
+    def conf(self):
+        c, h, w = self.inputShape
+        return (NeuralNetConfiguration.Builder()
+                .seed(self.seed)
+                .updater(self.updater or Adam(1e-3))
+                .weightInit(WeightInit.RELU)
+                .dataType(self.dataType)
+                .list()
+                .layer(ConvolutionLayer(nOut=16, kernelSize=(3, 3), activation="relu",
+                                        convolutionMode="same"))
+                .layer(BatchNormalization())
+                .layer(ConvolutionLayer(nOut=16, kernelSize=(3, 3), activation="relu",
+                                        convolutionMode="same"))
+                .layer(BatchNormalization())
+                .layer(SubsamplingLayer(poolingType="max", kernelSize=(2, 2), stride=(2, 2)))
+                .layer(ConvolutionLayer(nOut=32, kernelSize=(3, 3), activation="relu",
+                                        convolutionMode="same"))
+                .layer(BatchNormalization())
+                .layer(ConvolutionLayer(nOut=32, kernelSize=(3, 3), activation="relu",
+                                        convolutionMode="same"))
+                .layer(BatchNormalization())
+                .layer(SubsamplingLayer(poolingType="max", kernelSize=(2, 2), stride=(2, 2)))
+                .layer(DropoutLayer(dropOut=0.5))
+                .layer(GlobalPoolingLayer(poolingType="avg"))
+                .layer(OutputLayer(nOut=self.numClasses, activation="softmax"))
+                .setInputType(InputType.convolutional(h, w, c))
+                .build())
+
+
+class AlexNet(ZooModel):
+    """Reference: zoo.model.AlexNet (one-tower variant with LRN)."""
+
+    def conf(self):
+        c, h, w = self.inputShape
+        return (NeuralNetConfiguration.Builder()
+                .seed(self.seed)
+                .updater(self.updater or Nesterovs(1e-2, 0.9))
+                .weightInit(WeightInit.NORMAL)
+                .dataType(self.dataType)
+                .list()
+                .layer(ConvolutionLayer(nOut=96, kernelSize=(11, 11), stride=(4, 4),
+                                        activation="relu"))
+                .layer(LocalResponseNormalization())
+                .layer(SubsamplingLayer(kernelSize=(3, 3), stride=(2, 2)))
+                .layer(ConvolutionLayer(nOut=256, kernelSize=(5, 5), stride=(1, 1),
+                                        padding=(2, 2), activation="relu"))
+                .layer(LocalResponseNormalization())
+                .layer(SubsamplingLayer(kernelSize=(3, 3), stride=(2, 2)))
+                .layer(ConvolutionLayer(nOut=384, kernelSize=(3, 3), padding=(1, 1),
+                                        activation="relu"))
+                .layer(ConvolutionLayer(nOut=384, kernelSize=(3, 3), padding=(1, 1),
+                                        activation="relu"))
+                .layer(ConvolutionLayer(nOut=256, kernelSize=(3, 3), padding=(1, 1),
+                                        activation="relu"))
+                .layer(SubsamplingLayer(kernelSize=(3, 3), stride=(2, 2)))
+                .layer(DenseLayer(nOut=4096, activation="relu", dropOut=0.5))
+                .layer(DenseLayer(nOut=4096, activation="relu", dropOut=0.5))
+                .layer(OutputLayer(nOut=self.numClasses, activation="softmax"))
+                .setInputType(InputType.convolutional(h, w, c))
+                .build())
+
+
+def _vgg_blocks(builder, cfg):
+    for item in cfg:
+        if item == "M":
+            builder.layer(SubsamplingLayer(poolingType="max", kernelSize=(2, 2),
+                                           stride=(2, 2)))
+        else:
+            builder.layer(ConvolutionLayer(nOut=item, kernelSize=(3, 3),
+                                           convolutionMode="same", activation="relu"))
+    return builder
+
+
+class VGG16(ZooModel):
+    """Reference: zoo.model.VGG16."""
+
+    _CFG = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+            512, 512, 512, "M", 512, 512, 512, "M"]
+
+    def conf(self):
+        c, h, w = self.inputShape
+        b = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(self.updater or Nesterovs(1e-2, 0.9))
+             .weightInit(WeightInit.RELU)
+             .dataType(self.dataType)
+             .list())
+        _vgg_blocks(b, self._CFG)
+        return (b.layer(DenseLayer(nOut=4096, activation="relu", dropOut=0.5))
+                 .layer(DenseLayer(nOut=4096, activation="relu", dropOut=0.5))
+                 .layer(OutputLayer(nOut=self.numClasses, activation="softmax"))
+                 .setInputType(InputType.convolutional(h, w, c))
+                 .build())
+
+
+class VGG19(VGG16):
+    _CFG = [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+            512, 512, 512, 512, "M", 512, 512, 512, 512, "M"]
+
+
+class ResNet50(ZooModel):
+    """Reference: zoo.model.ResNet50 (He et al. bottleneck-v1 graph).
+
+    The flagship benchmark model (BASELINE.json). Built as a
+    ComputationGraph whose whole train step fuses to one XLA program; convs
+    map to MXU with NHWC layouts; run with dataType=BFLOAT16 for the bf16
+    compute path.
+    """
+
+    def conf(self):
+        c, h, w = self.inputShape
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(self.updater or Nesterovs(1e-1, 0.9))
+             .weightInit(WeightInit.RELU)
+             .dataType(self.dataType)
+             .graphBuilder()
+             .addInputs("input"))
+        g.addLayer("conv1", ConvolutionLayer(nOut=64, kernelSize=(7, 7), stride=(2, 2),
+                                             padding=(3, 3), activation="identity",
+                                             hasBias=False), "input")
+        g.addLayer("bn1", BatchNormalization(activation="relu"), "conv1")
+        g.addLayer("pool1", SubsamplingLayer(poolingType="max", kernelSize=(3, 3),
+                                             stride=(2, 2), padding=(1, 1)), "bn1")
+        prev = "pool1"
+        stages = [(3, 64, 256, 1), (4, 128, 512, 2), (6, 256, 1024, 2), (3, 512, 2048, 2)]
+        for si, (blocks, mid, out, stride0) in enumerate(stages):
+            for bi in range(blocks):
+                stride = stride0 if bi == 0 else 1
+                prev = self._bottleneck(g, f"s{si}b{bi}", prev, mid, out, stride,
+                                        project=(bi == 0))
+        g.addLayer("gap", GlobalPoolingLayer(poolingType="avg"), prev)
+        g.addLayer("fc", OutputLayer(nOut=self.numClasses, activation="softmax",
+                                     lossFunction="mcxent"), "gap")
+        return (g.setOutputs("fc")
+                 .setInputTypes(InputType.convolutional(h, w, c))
+                 .build())
+
+    @staticmethod
+    def _bottleneck(g, name, inp, mid, out, stride, project):
+        g.addLayer(f"{name}_c1", ConvolutionLayer(nOut=mid, kernelSize=(1, 1),
+                                                  stride=(stride, stride),
+                                                  activation="identity", hasBias=False), inp)
+        g.addLayer(f"{name}_b1", BatchNormalization(activation="relu"), f"{name}_c1")
+        g.addLayer(f"{name}_c2", ConvolutionLayer(nOut=mid, kernelSize=(3, 3),
+                                                  convolutionMode="same",
+                                                  activation="identity", hasBias=False),
+                   f"{name}_b1")
+        g.addLayer(f"{name}_b2", BatchNormalization(activation="relu"), f"{name}_c2")
+        g.addLayer(f"{name}_c3", ConvolutionLayer(nOut=out, kernelSize=(1, 1),
+                                                  activation="identity", hasBias=False),
+                   f"{name}_b2")
+        g.addLayer(f"{name}_b3", BatchNormalization(activation="identity"), f"{name}_c3")
+        if project:
+            g.addLayer(f"{name}_proj", ConvolutionLayer(nOut=out, kernelSize=(1, 1),
+                                                        stride=(stride, stride),
+                                                        activation="identity",
+                                                        hasBias=False), inp)
+            g.addLayer(f"{name}_projbn", BatchNormalization(activation="identity"),
+                       f"{name}_proj")
+            shortcut = f"{name}_projbn"
+        else:
+            shortcut = inp
+        g.addVertex(f"{name}_add", ElementWiseVertex("add"), f"{name}_b3", shortcut)
+        g.addLayer(f"{name}_relu", ActivationLayer(activation="relu"), f"{name}_add")
+        return f"{name}_relu"
+
+
+class UNet(ZooModel):
+    """Reference: zoo.model.UNet (segmentation encoder/decoder)."""
+
+    @staticmethod
+    def defaultInputShape():
+        return (3, 128, 128)
+
+    def conf(self):
+        c, h, w = self.inputShape
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(self.updater or Adam(1e-3))
+             .weightInit(WeightInit.RELU)
+             .dataType(self.dataType)
+             .graphBuilder()
+             .addInputs("input"))
+
+        def double_conv(name, inp, nout):
+            g.addLayer(f"{name}_c1", ConvolutionLayer(nOut=nout, kernelSize=(3, 3),
+                                                      convolutionMode="same",
+                                                      activation="relu"), inp)
+            g.addLayer(f"{name}_c2", ConvolutionLayer(nOut=nout, kernelSize=(3, 3),
+                                                      convolutionMode="same",
+                                                      activation="relu"), f"{name}_c1")
+            return f"{name}_c2"
+
+        enc1 = double_conv("enc1", "input", 32)
+        g.addLayer("p1", SubsamplingLayer(kernelSize=(2, 2), stride=(2, 2)), enc1)
+        enc2 = double_conv("enc2", "p1", 64)
+        g.addLayer("p2", SubsamplingLayer(kernelSize=(2, 2), stride=(2, 2)), enc2)
+        mid = double_conv("mid", "p2", 128)
+        g.addLayer("up2", Upsampling2D(size=2), mid)
+        g.addVertex("cat2", MergeVertex(), "up2", enc2)
+        dec2 = double_conv("dec2", "cat2", 64)
+        g.addLayer("up1", Upsampling2D(size=2), dec2)
+        g.addVertex("cat1", MergeVertex(), "up1", enc1)
+        dec1 = double_conv("dec1", "cat1", 32)
+        g.addLayer("segment", ConvolutionLayer(nOut=self.numClasses, kernelSize=(1, 1),
+                                               activation="identity"), dec1)
+        g.addLayer("out", CnnLossLayer(lossFunction="xent", activation="sigmoid"), "segment")
+        return (g.setOutputs("out")
+                 .setInputTypes(InputType.convolutional(h, w, c))
+                 .build())
+
+
+class TextGenerationLSTM(ZooModel):
+    """Reference: zoo.model.TextGenerationLSTM (char-rnn, Karpathy-style)."""
+
+    def __init__(self, totalUniqueCharacters=77, maxLength=40, **kw):
+        kw.setdefault("numClasses", totalUniqueCharacters)
+        super().__init__(**kw)
+        self.vocab = totalUniqueCharacters
+        self.maxLength = maxLength
+
+    @staticmethod
+    def defaultInputShape():
+        return None
+
+    def conf(self):
+        return (NeuralNetConfiguration.Builder()
+                .seed(self.seed)
+                .updater(self.updater or Adam(2e-3))
+                .weightInit(WeightInit.XAVIER)
+                .dataType(self.dataType)
+                .list()
+                .layer(LSTM(nOut=256))
+                .layer(LSTM(nOut=256))
+                .layer(RnnOutputLayer(nOut=self.vocab, activation="softmax",
+                                      lossFunction="mcxent"))
+                .setInputType(InputType.recurrent(self.vocab, self.maxLength))
+                .build())
+

@@ -1,0 +1,200 @@
+"""ComputationGraph tests (reference: ComputationGraphTestRNN,
+TestComputationGraphNetwork in deeplearning4j-core)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import (
+    NeuralNetConfiguration, InputType, ComputationGraph,
+    DenseLayer, OutputLayer, ConvolutionLayer, SubsamplingLayer,
+    BatchNormalization, ActivationLayer, GlobalPoolingLayer,
+    MergeVertex, ElementWiseVertex, SubsetVertex, ScaleVertex, ShiftVertex,
+    L2NormalizeVertex, StackVertex, UnstackVertex,
+    Adam, Sgd, WeightInit,
+)
+from deeplearning4j_tpu.data import DataSet, MultiDataSet
+
+
+def _xor_ish(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 4).astype("float32")
+    w = rng.randn(4, 3)
+    yi = np.argmax(x @ w, axis=1)
+    return x, np.eye(3, dtype="float32")[yi], yi
+
+
+class TestGraphBuild:
+    def test_residual_graph(self):
+        conf = (NeuralNetConfiguration.Builder().seed(1).updater(Adam(1e-2))
+                .graphBuilder()
+                .addInputs("in")
+                .addLayer("d1", DenseLayer(nOut=16, activation="relu"), "in")
+                .addLayer("d2", DenseLayer(nOut=16, activation="identity"), "d1")
+                .addVertex("res", ElementWiseVertex("add"), "d1", "d2")
+                .addLayer("out", OutputLayer(nOut=3, activation="softmax"), "res")
+                .setOutputs("out")
+                .setInputTypes(InputType.feedForward(4))
+                .build())
+        net = ComputationGraph(conf).init()
+        x, y, yi = _xor_ish()
+        for _ in range(60):
+            net.fit(x, y)
+        acc = (net.outputSingle(x).argMax(1).toNumpy() == yi).mean()
+        assert acc > 0.9
+
+    def test_cycle_detection(self):
+        b = (NeuralNetConfiguration.Builder().updater(Sgd(0.1)).graphBuilder()
+             .addInputs("in")
+             .addLayer("a", DenseLayer(nOut=4), "b")
+             .addLayer("b", DenseLayer(nOut=4), "a")
+             .addLayer("out", OutputLayer(nOut=2), "b")
+             .setOutputs("out")
+             .setInputTypes(InputType.feedForward(3)))
+        with pytest.raises(ValueError, match="Cycle"):
+            b.build()
+
+    def test_unknown_input_reference(self):
+        b = (NeuralNetConfiguration.Builder().updater(Sgd(0.1)).graphBuilder()
+             .addInputs("in")
+             .addLayer("a", DenseLayer(nOut=4), "nope")
+             .setOutputs("a")
+             .setInputTypes(InputType.feedForward(3)))
+        with pytest.raises(ValueError, match="unknown input"):
+            b.build()
+
+    def test_merge_shape_inference(self):
+        conf = (NeuralNetConfiguration.Builder().seed(1).updater(Sgd(0.1))
+                .graphBuilder()
+                .addInputs("a", "b")
+                .addLayer("da", DenseLayer(nOut=8), "a")
+                .addLayer("db", DenseLayer(nOut=8), "b")
+                .addVertex("m", MergeVertex(), "da", "db")
+                .addLayer("out", OutputLayer(nOut=2, activation="softmax"), "m")
+                .setOutputs("out")
+                .setInputTypes(InputType.feedForward(3), InputType.feedForward(5))
+                .build())
+        assert conf.nodes["out"].payload.nIn == 16
+
+
+class TestVertices:
+    def _one_vertex_net(self, vertex, nout_in=6):
+        conf = (NeuralNetConfiguration.Builder().seed(1).updater(Sgd(0.1))
+                .graphBuilder()
+                .addInputs("in")
+                .addLayer("d", DenseLayer(nOut=nout_in, activation="identity"), "in")
+                .addVertex("v", vertex, "d")
+                .addLayer("out", OutputLayer(nOut=2, activation="softmax"), "v")
+                .setOutputs("out")
+                .setInputTypes(InputType.feedForward(4))
+                .build())
+        return ComputationGraph(conf).init()
+
+    def test_subset_vertex(self):
+        net = self._one_vertex_net(SubsetVertex(1, 3))
+        assert net.conf.nodes["out"].payload.nIn == 3
+        x = np.random.RandomState(0).randn(4, 4).astype("float32")
+        assert net.outputSingle(x).shape() == (4, 2)
+
+    def test_scale_shift_l2(self):
+        for v in (ScaleVertex(2.0), ShiftVertex(1.0), L2NormalizeVertex()):
+            net = self._one_vertex_net(v)
+            x = np.random.RandomState(0).randn(4, 4).astype("float32")
+            assert net.outputSingle(x).shape() == (4, 2)
+
+    def test_stack_unstack_roundtrip(self):
+        conf = (NeuralNetConfiguration.Builder().seed(1).updater(Sgd(0.1))
+                .graphBuilder()
+                .addInputs("a", "b")
+                .addVertex("s", StackVertex(), "a", "b")
+                .addLayer("d", DenseLayer(nOut=5, activation="identity"), "s")
+                .addVertex("u0", UnstackVertex(0, 2), "d")
+                .addLayer("out", OutputLayer(nOut=2, activation="softmax"), "u0")
+                .setOutputs("out")
+                .setInputTypes(InputType.feedForward(3), InputType.feedForward(3))
+                .build())
+        net = ComputationGraph(conf).init()
+        xa = np.random.RandomState(0).randn(4, 3).astype("float32")
+        xb = np.random.RandomState(1).randn(4, 3).astype("float32")
+        out = net.output(xa, xb)
+        assert out.shape() == (4, 2)
+
+    def test_elementwise_ops(self):
+        for op in ("add", "product", "average", "max", "subtract"):
+            conf = (NeuralNetConfiguration.Builder().seed(1).updater(Sgd(0.1))
+                    .graphBuilder()
+                    .addInputs("in")
+                    .addLayer("d1", DenseLayer(nOut=4, activation="identity"), "in")
+                    .addLayer("d2", DenseLayer(nOut=4, activation="identity"), "in")
+                    .addVertex("v", ElementWiseVertex(op), "d1", "d2")
+                    .addLayer("out", OutputLayer(nOut=2, activation="softmax"), "v")
+                    .setOutputs("out")
+                    .setInputTypes(InputType.feedForward(3))
+                    .build())
+            net = ComputationGraph(conf).init()
+            x = np.random.RandomState(0).randn(4, 3).astype("float32")
+            assert net.outputSingle(x).shape() == (4, 2)
+
+
+class TestMultiIO:
+    def test_two_inputs(self):
+        conf = (NeuralNetConfiguration.Builder().seed(2).updater(Adam(1e-2))
+                .graphBuilder()
+                .addInputs("a", "b")
+                .addLayer("da", DenseLayer(nOut=8, activation="relu"), "a")
+                .addLayer("db", DenseLayer(nOut=8, activation="relu"), "b")
+                .addVertex("m", MergeVertex(), "da", "db")
+                .addLayer("out", OutputLayer(nOut=2, activation="softmax"), "m")
+                .setOutputs("out")
+                .setInputTypes(InputType.feedForward(3), InputType.feedForward(5))
+                .build())
+        net = ComputationGraph(conf).init()
+        rng = np.random.RandomState(0)
+        xa = rng.randn(16, 3).astype("float32")
+        xb = rng.randn(16, 5).astype("float32")
+        y = np.eye(2, dtype="float32")[rng.randint(0, 2, 16)]
+        net.fit(MultiDataSet([xa, xb], [y]))
+        assert np.isfinite(net.score())
+
+    def test_two_outputs(self):
+        conf = (NeuralNetConfiguration.Builder().seed(2).updater(Adam(1e-2))
+                .graphBuilder()
+                .addInputs("in")
+                .addLayer("trunk", DenseLayer(nOut=16, activation="relu"), "in")
+                .addLayer("out1", OutputLayer(nOut=2, activation="softmax"), "trunk")
+                .addLayer("out2", OutputLayer(nOut=4, activation="softmax"), "trunk")
+                .setOutputs("out1", "out2")
+                .setInputTypes(InputType.feedForward(4))
+                .build())
+        net = ComputationGraph(conf).init()
+        rng = np.random.RandomState(0)
+        x = rng.randn(8, 4).astype("float32")
+        y1 = np.eye(2, dtype="float32")[rng.randint(0, 2, 8)]
+        y2 = np.eye(4, dtype="float32")[rng.randint(0, 4, 8)]
+        net.fit(MultiDataSet([x], [y1, y2]))
+        o1, o2 = net.output(x)
+        assert o1.shape() == (8, 2) and o2.shape() == (8, 4)
+
+    def test_cnn_branch_merge(self):
+        conf = (NeuralNetConfiguration.Builder().seed(2).updater(Adam(1e-2))
+                .graphBuilder()
+                .addInputs("img")
+                .addLayer("c3", ConvolutionLayer(nOut=4, kernelSize=(3, 3),
+                                                 convolutionMode="same",
+                                                 activation="relu"), "img")
+                .addLayer("c5", ConvolutionLayer(nOut=4, kernelSize=(5, 5),
+                                                 convolutionMode="same",
+                                                 activation="relu"), "img")
+                .addVertex("m", MergeVertex(), "c3", "c5")
+                .addLayer("gp", GlobalPoolingLayer(poolingType="avg"), "m")
+                .addLayer("out", OutputLayer(nOut=3, activation="softmax"), "gp")
+                .setOutputs("out")
+                .setInputTypes(InputType.convolutional(8, 8, 1))
+                .build())
+        # merge concatenates channels: 4+4=8
+        assert conf.nodes["gp"].inputType.kind == "feedforward"
+        assert conf.nodes["out"].payload.nIn == 8
+        net = ComputationGraph(conf).init()
+        x = np.random.RandomState(0).rand(4, 1, 8, 8).astype("float32")
+        y = np.eye(3, dtype="float32")[np.random.RandomState(1).randint(0, 3, 4)]
+        net.fit(x, y)
+        assert np.isfinite(net.score())
