@@ -1,0 +1,108 @@
+"""Attention primitives.
+
+Reference: org.deeplearning4j.nn.conf.layers.SelfAttentionLayer /
+LearnedSelfAttentionLayer / RecurrentAttentionLayer and AttentionVertex,
+implemented upstream via SameDiff's sd.nn.multiHeadDotProductAttention.
+
+TPU design: a blockwise (flash-style) attention computed with lax.scan
+over KV blocks — O(T) memory instead of materialising the [T,T] score
+matrix — with the block matmuls on the MXU in bf16. XLA also has a fused
+attention path; the explicit blockwise form here is the building block the
+ring-attention sequence parallelism (parallel/sequence.py) extends across
+chips.
+
+Layout: [B, H, T, D] (batch, heads, time, head_dim).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block_attn(q, k, v, carry, mask_value=-1e30, mask=None):
+    """One flash block: q [B,H,Tq,D] against k/v [B,H,Tk,D].
+
+    carry = (acc [B,H,Tq,D], row_max m [B,H,Tq], row_sum l [B,H,Tq]).
+    Returns updated carry (online softmax, Rabe & Staats / flash-attention
+    recurrence).
+    """
+    acc, m, l = carry
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    if mask is not None:
+        scores = jnp.where(mask, scores, mask_value)
+    m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+    correction = jnp.exp(m - m_new)
+    p = jnp.exp(scores - m_new[..., None])
+    l_new = l * correction + jnp.sum(p, axis=-1)
+    acc_new = acc * correction[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return acc_new, m_new, l_new
+
+
+def blockwise_attention(q, k, v, block_size=512, causal=False):
+    """Flash-style attention over KV blocks. q,k,v: [B,H,T,D]."""
+    B, H, T, D = q.shape
+    Tk = k.shape[2]
+    bs = min(block_size, Tk)
+    n_blocks = (Tk + bs - 1) // bs
+    pad = n_blocks * bs - Tk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    kb = k.reshape(B, H, n_blocks, bs, D).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(B, H, n_blocks, bs, D).transpose(2, 0, 1, 3, 4)
+
+    q_pos = jnp.arange(T)[:, None]
+
+    def scan_fn(carry, blk):
+        kj, vj, j = blk
+        mask = None
+        k_pos = j * bs + jnp.arange(bs)[None, :]
+        valid = k_pos < Tk
+        if causal:
+            mask = (q_pos >= k_pos) & valid
+        elif pad:
+            mask = jnp.broadcast_to(valid, (T, bs))
+        if mask is not None:
+            mask = mask[None, None]
+        return _block_attn(q, kj, vj, carry, mask=mask), None
+
+    acc0 = jnp.zeros_like(q)
+    m0 = jnp.full((B, H, T), -jnp.inf, q.dtype)
+    l0 = jnp.zeros((B, H, T), q.dtype)
+    (acc, m, l), _ = lax.scan(scan_fn, (acc0, m0, l0),
+                              (kb, vb, jnp.arange(n_blocks)))
+    return acc / l[..., None]
+
+
+def dot_product_attention(q, k, v, mask=None, causal=False):
+    """Plain fused attention (XLA materialises and fuses the scores).
+    Fine for short T; blockwise_attention for long T."""
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    if causal:
+        T, Tk = q.shape[2], k.shape[2]
+        cm = jnp.arange(T)[:, None] >= jnp.arange(Tk)[None, :]
+        scores = jnp.where(cm[None, None], scores, -1e30)
+    if mask is not None:
+        scores = jnp.where(mask, scores, -1e30)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def multi_head_attention(x, Wq, Wk, Wv, Wo, nHeads, causal=False,
+                         block_size=None, kv=None):
+    """Full MHA: x [B, T, E]; Wq/Wk/Wv [E, H*D]; Wo [H*D, E]."""
+    B, T, E = x.shape
+    src = x if kv is None else kv
+    q = (x @ Wq).reshape(B, T, nHeads, -1).transpose(0, 2, 1, 3)
+    k = (src @ Wk).reshape(B, src.shape[1], nHeads, -1).transpose(0, 2, 1, 3)
+    v = (src @ Wv).reshape(B, src.shape[1], nHeads, -1).transpose(0, 2, 1, 3)
+    if block_size:
+        o = blockwise_attention(q, k, v, block_size=block_size, causal=causal)
+    else:
+        o = dot_product_attention(q, k, v, causal=causal)
+    o = o.transpose(0, 2, 1, 3).reshape(B, T, -1)
+    return o @ Wo

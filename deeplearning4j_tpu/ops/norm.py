@@ -19,21 +19,28 @@ def batch_norm(x, gamma, beta, running_mean, running_var, *, train: bool,
     reference's decay semantics: running = decay*running + (1-decay)*batch.
     """
     axes = tuple(range(x.ndim - 1))
+    # stats and normalisation math in fp32 (bf16 squares underflow); the
+    # result is cast back so the activation dtype is stable through the net
+    xf = x.astype(jnp.float32)
     if train:
-        mean = jnp.mean(x, axis=axes)
-        var = jnp.var(x, axis=axes)
-        new_rm = decay * running_mean + (1.0 - decay) * mean
-        new_rv = decay * running_var + (1.0 - decay) * var
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.var(xf, axis=axes)
+        # keep the carried stats in their own dtype (donated/scan carries
+        # must be dtype-stable)
+        new_rm = (decay * running_mean.astype(jnp.float32)
+                  + (1.0 - decay) * mean).astype(running_mean.dtype)
+        new_rv = (decay * running_var.astype(jnp.float32)
+                  + (1.0 - decay) * var).astype(running_var.dtype)
     else:
-        mean, var = running_mean, running_var
+        mean, var = running_mean.astype(jnp.float32), running_var.astype(jnp.float32)
         new_rm, new_rv = running_mean, running_var
     inv = lax.rsqrt(var + eps)
-    y = (x - mean) * inv
+    y = (xf - mean) * inv
     if gamma is not None:
-        y = y * gamma
+        y = y * gamma.astype(jnp.float32)
     if beta is not None:
-        y = y + beta
-    return y, new_rm, new_rv
+        y = y + beta.astype(jnp.float32)
+    return y.astype(x.dtype), new_rm, new_rv
 
 
 def lrn(x, k=2.0, n=5, alpha=1e-4, beta=0.75):

@@ -1,0 +1,20 @@
+"""Distributed training: meshes, data/tensor/sequence parallelism.
+
+Reference subsystems replaced: deeplearning4j-parallel-wrapper (multi-GPU),
+deeplearning4j-scaleout/spark (SharedTrainingMaster gradient sharing over
+Aeron), and the NCCL/MPI transports — all collapsed into jax.sharding
+meshes + XLA ICI collectives.
+"""
+
+from deeplearning4j_tpu.parallel.mesh import (
+    build_mesh, data_parallel_mesh, DATA_AXIS, MODEL_AXIS, SEQ_AXIS, PIPE_AXIS,
+)
+from deeplearning4j_tpu.parallel.trainer import ParallelWrapper, SharedTrainingMaster
+from deeplearning4j_tpu.parallel.sharding import shard_params, replicate_params, spec_for_param
+from deeplearning4j_tpu.parallel.sequence import ring_attention, ulysses_attention
+
+__all__ = [
+    "build_mesh", "data_parallel_mesh", "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS",
+    "PIPE_AXIS", "ParallelWrapper", "SharedTrainingMaster", "shard_params",
+    "replicate_params", "spec_for_param", "ring_attention", "ulysses_attention",
+]

@@ -1,0 +1,59 @@
+"""Device mesh construction.
+
+Reference: the reference's device topology handling is implicit in its
+NCCL/Aeron transports (one process per GPU, ring discovered at runtime).
+TPU-native design: an explicit jax.sharding.Mesh over named logical axes —
+"data" (DP replicas), "model" (tensor parallel), "seq" (sequence/context
+parallel). XLA lowers cross-axis reductions to ICI collectives; DCN vs ICI
+routing follows the mesh's device order, so axes that communicate most
+(model/seq) should map to devices on the same ICI domain — pass them last
+so they're innermost (contiguous) in the device mesh.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
+
+
+def build_mesh(axes=None, devices=None) -> Mesh:
+    """build_mesh({"data": 4, "model": 2}) -> Mesh of shape (4, 2).
+
+    Axis sizes may include one -1 (filled from the device count). Innermost
+    (last) axes get contiguous devices => fastest ICI links.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if axes is None:
+        axes = {DATA_AXIS: len(devices)}
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = len(devices) // known
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError(f"Mesh {dict(zip(names, sizes))} needs {total} devices, "
+                         f"have {len(devices)}")
+    arr = np.array(devices[:total]).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def data_parallel_mesh(n=None) -> Mesh:
+    devs = jax.devices()
+    return build_mesh({DATA_AXIS: n or len(devs)}, devs[: n or len(devs)])
+
+
+def replicated(mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh, axis=DATA_AXIS) -> NamedSharding:
+    return NamedSharding(mesh, P(axis))

@@ -1,0 +1,66 @@
+"""Parameter sharding rules for model (tensor) parallelism.
+
+Reference: none — the reference is data-parallel only (its multi-GPU and
+Spark paths replicate the full model). Tensor parallelism is a TPU-first
+capability: parameters are annotated with PartitionSpecs over the mesh
+"model" axis and XLA's SPMD partitioner (GSPMD; see PAPERS.md sharding
+papers) propagates shardings through the computation and inserts the
+all-gather / reduce-scatter collectives over ICI.
+
+Rules follow the Megatron layout:
+  dense W [in, out]      -> P(None, "model")   (column parallel)
+  conv  W [kh,kw,ci,co]  -> P(None,None,None,"model")
+  lstm  W/RW [in, 4H]    -> P(None, "model")
+  biases/gains [out]     -> P("model") when their dim is sharded
+Small params (< min_shard_size) stay replicated — collective latency beats
+the memory win.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import MODEL_AXIS
+
+
+def spec_for_param(name: str, shape, model_axis=MODEL_AXIS, min_shard_size=2 ** 16):
+    """PartitionSpec for one parameter array by name/shape convention."""
+    if int(np.prod(shape)) < min_shard_size:
+        return P()
+    if len(shape) == 2:
+        # dense / recurrent / embedding weights: shard the output dim
+        return P(None, model_axis)
+    if len(shape) == 4:
+        # conv HWIO: shard output channels
+        return P(None, None, None, model_axis)
+    if len(shape) == 1:
+        return P(model_axis)
+    return P()
+
+
+def shard_params(params, mesh: Mesh, model_axis=MODEL_AXIS, min_shard_size=2 ** 16):
+    """Annotate+place a params pytree (list/dict of per-layer dicts) onto
+    the mesh with tensor-parallel shardings; returns the placed pytree."""
+
+    def place(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        # shard only when divisible; otherwise replicate (GSPMD requires
+        # even tiling for the annotated dim)
+        spec = spec_for_param(name, leaf.shape, model_axis, min_shard_size)
+        width = mesh.shape[model_axis]
+        ok = True
+        for dim, axis in zip(leaf.shape, tuple(spec) + (None,) * (leaf.ndim - len(spec))):
+            if axis == model_axis and dim % width != 0:
+                ok = False
+        if not ok:
+            spec = P()
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(place, params)
+
+
+def replicate_params(params, mesh: Mesh):
+    return jax.device_put(params, NamedSharding(mesh, P()))
