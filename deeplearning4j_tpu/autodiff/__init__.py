@@ -1,0 +1,18 @@
+"""SameDiff: define-then-run symbolic autodiff.
+
+Reference modules: nd4j-autodiff (org.nd4j.autodiff.samediff.SameDiff,
+SDVariable, org.nd4j.autodiff.samediff.ops.* namespaces, internal
+InferenceSession, TrainingConfig). TPU design (SURVEY.md §3): the graph is
+not interpreted op-by-op — the whole graph traces into ONE JAX function
+compiled by XLA into a single computation; reverse-mode autodiff is
+jax.grad on that function rather than graph surgery.
+"""
+
+from deeplearning4j_tpu.autodiff.samediff import (
+    SameDiff,
+    SDVariable,
+    VariableType,
+    TrainingConfig,
+)
+
+__all__ = ["SameDiff", "SDVariable", "VariableType", "TrainingConfig"]

@@ -1,0 +1,631 @@
+"""Named op registry for SameDiff graphs.
+
+Reference: the DynamicCustomOp / legacy-op zoo in libnd4j that SameDiff
+nodes dispatch to (org.nd4j.linalg.api.ops.impl.*). Here each op NAME maps
+to a pure function over jnp arrays; XLA is the kernel library, so an "op"
+is just a traceable lowering that fuses with its neighbours. Names are kept
+serializable (graph JSON stores the op name, not the callable).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.ops import attention as _attn
+from deeplearning4j_tpu.ops import conv as _conv
+from deeplearning4j_tpu.ops import pooling as _pool
+from deeplearning4j_tpu.ops import rnn as _rnn
+from deeplearning4j_tpu.nn import losses as _losses
+
+OPS = {}
+
+
+def op(name):
+    def deco(fn):
+        OPS[name] = fn
+        return fn
+    return deco
+
+
+def _reg(name, fn):
+    OPS[name] = fn
+
+
+# ---- math: elementwise ----
+for _n, _f in {
+    "add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+    "div": jnp.divide, "floordiv": jnp.floor_divide, "mod": jnp.mod,
+    "pow": jnp.power, "squaredDifference": lambda a, b: jnp.square(a - b),
+    "maximum": jnp.maximum, "minimum": jnp.minimum,
+    "neg": jnp.negative, "abs": jnp.abs, "sign": jnp.sign,
+    "exp": jnp.exp, "expm1": jnp.expm1, "log": jnp.log, "log1p": jnp.log1p,
+    "log2": jnp.log2, "sqrt": jnp.sqrt, "rsqrt": lax.rsqrt,
+    "square": jnp.square, "reciprocal": jnp.reciprocal,
+    "floor": jnp.floor, "ceil": jnp.ceil, "round": jnp.round,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "asinh": jnp.arcsinh, "acosh": jnp.arccosh, "atanh": jnp.arctanh,
+    "erf": jax.scipy.special.erf, "erfc": jax.scipy.special.erfc,
+    "atan2": jnp.arctan2,
+    "isnan": jnp.isnan, "isinf": jnp.isinf, "isfinite": jnp.isfinite,
+}.items():
+    _reg(_n, _f)
+
+# ---- comparisons / logic ----
+for _n, _f in {
+    "eq": jnp.equal, "neq": jnp.not_equal, "gt": jnp.greater,
+    "gte": jnp.greater_equal, "lt": jnp.less, "lte": jnp.less_equal,
+    "and": jnp.logical_and, "or": jnp.logical_or, "xor": jnp.logical_xor,
+    "not": jnp.logical_not,
+}.items():
+    _reg(_n, _f)
+
+
+@op("where")
+def _where(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+# ---- reductions ----
+def _red(fn):
+    def run(x, dimensions=None, keepDims=False):
+        axis = tuple(dimensions) if dimensions else None
+        return fn(x, axis=axis, keepdims=keepDims)
+    return run
+
+
+for _n, _f in {
+    "sum": jnp.sum, "mean": jnp.mean, "prod": jnp.prod,
+    "max": jnp.max, "min": jnp.min, "std": jnp.std, "variance": jnp.var,
+    "any": jnp.any, "all": jnp.all,
+}.items():
+    _reg(_n, _red(_f))
+
+
+@op("norm1")
+def _norm1(x, dimensions=None, keepDims=False):
+    return jnp.sum(jnp.abs(x), axis=tuple(dimensions) if dimensions else None,
+                   keepdims=keepDims)
+
+
+@op("norm2")
+def _norm2(x, dimensions=None, keepDims=False):
+    return jnp.sqrt(jnp.sum(jnp.square(x),
+                            axis=tuple(dimensions) if dimensions else None,
+                            keepdims=keepDims))
+
+
+@op("normmax")
+def _normmax(x, dimensions=None, keepDims=False):
+    return jnp.max(jnp.abs(x), axis=tuple(dimensions) if dimensions else None,
+                   keepdims=keepDims)
+
+
+@op("argmax")
+def _argmax(x, dimensions=None, keepDims=False):
+    axis = dimensions[0] if dimensions else None
+    r = jnp.argmax(x, axis=axis)
+    return jnp.expand_dims(r, axis) if (keepDims and axis is not None) else r
+
+
+@op("argmin")
+def _argmin(x, dimensions=None, keepDims=False):
+    axis = dimensions[0] if dimensions else None
+    r = jnp.argmin(x, axis=axis)
+    return jnp.expand_dims(r, axis) if (keepDims and axis is not None) else r
+
+
+@op("cumsum")
+def _cumsum(x, axis=0, exclusive=False, reverse=False):
+    if reverse:
+        x = jnp.flip(x, axis)
+    r = jnp.cumsum(x, axis=axis)
+    if exclusive:
+        r = r - x
+    if reverse:
+        r = jnp.flip(r, axis)
+    return r
+
+
+@op("cumprod")
+def _cumprod(x, axis=0):
+    return jnp.cumprod(x, axis=axis)
+
+
+# ---- shape ops ----
+@op("reshape")
+def _reshape(x, shape=None):
+    return jnp.reshape(x, tuple(shape))
+
+
+@op("permute")
+def _permute(x, dimensions=None):
+    return jnp.transpose(x, tuple(dimensions))
+
+
+@op("transpose")
+def _transpose(x):
+    return jnp.transpose(x)
+
+
+@op("expandDims")
+def _expand(x, axis=0):
+    return jnp.expand_dims(x, axis)
+
+
+@op("squeeze")
+def _squeeze(x, axis=None):
+    return jnp.squeeze(x, axis)
+
+
+@op("concat")
+def _concat(*xs, dimension=0):
+    return jnp.concatenate(xs, axis=dimension)
+
+
+@op("stack")
+def _stack(*xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+@op("unstack")
+def _unstack(x, axis=0, num=None):
+    n = num if num is not None else x.shape[axis]
+    return tuple(jnp.squeeze(s, axis)
+                 for s in jnp.split(x, n, axis=axis))
+
+
+@op("tile")
+def _tile(x, reps=None):
+    return jnp.tile(x, tuple(reps))
+
+
+@op("repeat")
+def _repeat(x, repeats=1, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@op("reverse")
+def _reverse(x, dimensions=None):
+    return jnp.flip(x, tuple(dimensions))
+
+
+@op("slice")
+def _slice(x, begin=None, size=None):
+    return lax.dynamic_slice(x, tuple(begin), tuple(size))
+
+
+@op("stridedSlice")
+def _strided_slice(x, begin=None, end=None, strides=None):
+    sl = tuple(slice(b, e, s) for b, e, s in
+               zip(begin, end, strides or [1] * len(begin)))
+    return x[sl]
+
+
+@op("gather")
+def _gather(x, indices, axis=0):
+    return jnp.take(x, indices.astype(jnp.int32), axis=axis)
+
+
+@op("scatterUpdate")
+def _scatter_update(ref, indices, updates):
+    return ref.at[indices.astype(jnp.int32)].set(updates)
+
+
+@op("scatterAdd")
+def _scatter_add(ref, indices, updates):
+    return ref.at[indices.astype(jnp.int32)].add(updates)
+
+
+@op("onehot")
+def _onehot(x, depth=None, axis=-1, on=1.0, off=0.0):
+    return jax.nn.one_hot(x.astype(jnp.int32), depth, axis=axis,
+                          dtype=jnp.float32) * (on - off) + off
+
+
+@op("cast")
+def _cast(x, dtype=None):
+    return x.astype(jnp.dtype(dtype))
+
+
+@op("shape")
+def _shape(x):
+    return jnp.asarray(x.shape, dtype=jnp.int64)
+
+
+@op("pad")
+def _pad(x, padding=None, constant=0.0, mode="CONSTANT"):
+    return jnp.pad(x, tuple(tuple(p) for p in padding),
+                   mode=mode.lower(), **(
+                       {"constant_values": constant}
+                       if mode.upper() == "CONSTANT" else {}))
+
+
+@op("identity")
+def _identity(x):
+    return x
+
+
+# ---- linalg ----
+@op("mmul")
+def _mmul(a, b, transposeA=False, transposeB=False):
+    if transposeA:
+        a = jnp.swapaxes(a, -1, -2)
+    if transposeB:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+@op("tensorMmul")
+def _tensormmul(a, b, dimensionsA=None, dimensionsB=None):
+    return jnp.tensordot(a, b, axes=(tuple(dimensionsA), tuple(dimensionsB)))
+
+
+@op("batchMmul")
+def _batchmmul(a, b):
+    return jnp.matmul(a, b)
+
+
+for _n, _f in {
+    "cholesky": jnp.linalg.cholesky, "inv": jnp.linalg.inv,
+    "det": jnp.linalg.det, "matrixDiag": jnp.diag, "diagPart": jnp.diagonal,
+    "trace": jnp.trace,
+}.items():
+    _reg(_n, _f)
+
+
+@op("svd")
+def _svd(x, fullUV=False):
+    return jnp.linalg.svd(x, full_matrices=fullUV)
+
+
+@op("qr")
+def _qr(x):
+    q, r = jnp.linalg.qr(x)
+    return q, r
+
+
+@op("eye")
+def _eye(rows=None, cols=None):
+    return jnp.eye(rows, cols)
+
+
+@op("cross")
+def _cross(a, b):
+    return jnp.cross(a, b)
+
+
+@op("solve")
+def _solve(a, b):
+    return jnp.linalg.solve(a, b)
+
+
+@op("lstsq")
+def _lstsq(a, b):
+    return jnp.linalg.lstsq(a, b)[0]
+
+
+# ---- nn ----
+for _n, _f in {
+    "relu": jax.nn.relu, "relu6": jax.nn.relu6, "sigmoid": jax.nn.sigmoid,
+    "softplus": jax.nn.softplus, "softsign": jax.nn.soft_sign,
+    "elu": jax.nn.elu, "selu": jax.nn.selu, "gelu": jax.nn.gelu,
+    "swish": jax.nn.swish, "hardSigmoid": jax.nn.hard_sigmoid,
+    "hardTanh": jax.nn.hard_tanh,
+    "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+}.items():
+    _reg(_n, _f)
+
+
+@op("leakyRelu")
+def _lrelu(x, alpha=0.01):
+    return jax.nn.leaky_relu(x, alpha)
+
+
+@op("softmax")
+def _softmax(x, dimension=-1):
+    return jax.nn.softmax(x, axis=dimension)
+
+
+@op("logSoftmax")
+def _log_softmax(x, dimension=-1):
+    return jax.nn.log_softmax(x, axis=dimension)
+
+
+@op("linear")
+def _linear(x, w, b=None):
+    y = jnp.matmul(x, w)
+    return y if b is None else y + b
+
+
+@op("layerNorm")
+def _layernorm(x, gain, bias=None, dimensions=(-1,)):
+    ax = tuple(dimensions)
+    mu = jnp.mean(x, axis=ax, keepdims=True)
+    var = jnp.var(x, axis=ax, keepdims=True)
+    y = (x - mu) * lax.rsqrt(var + 1e-5) * gain
+    return y if bias is None else y + bias
+
+
+@op("dropout")
+def _dropout(x, key=None, rate=0.0, train=False):
+    if not train or rate == 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)
+
+
+@op("dotProductAttention")
+def _dpa(q, k, v, mask=None, causal=False):
+    return _attn.dot_product_attention(q, k, v, mask=mask, causal=causal)
+
+
+@op("multiHeadDotProductAttention")
+def _mhdpa(x, wq, wk, wv, wo, nHeads=1, causal=False):
+    return _attn.multi_head_attention(x, wq, wk, wv, wo, nHeads, causal=causal)
+
+
+@op("batchNorm")
+def _batchnorm(x, mean, var, gamma=None, beta=None, epsilon=1e-5, axis=-1):
+    shp = [1] * x.ndim
+    shp[axis] = x.shape[axis]
+    rs = lambda a: jnp.reshape(a, shp)
+    y = (x - rs(mean)) * lax.rsqrt(rs(var) + epsilon)
+    if gamma is not None:
+        y = y * rs(gamma)
+    if beta is not None:
+        y = y + rs(beta)
+    return y
+
+
+@op("embeddingLookup")
+def _embedding(table, ids):
+    return jnp.take(table, ids.astype(jnp.int32), axis=0)
+
+
+# ---- cnn ----
+@op("conv2d")
+def _conv2d(x, w, b=None, stride=(1, 1), padding=((0, 0), (0, 0)),
+            dilation=(1, 1)):
+    return _conv.conv2d(x, w, b, stride=tuple(stride),
+                        padding=tuple(tuple(p) for p in padding),
+                        dilation=tuple(dilation))
+
+
+@op("conv1d")
+def _conv1d(x, w, b=None, stride=1, padding=((0, 0),), dilation=1):
+    return _conv.conv1d(x, w, b, stride=stride,
+                        padding=tuple(tuple(p) for p in padding),
+                        dilation=dilation)
+
+
+@op("deconv2d")
+def _deconv2d(x, w, b=None, stride=(1, 1), padding=((0, 0), (0, 0)),
+              dilation=(1, 1)):
+    return _conv.deconv2d(x, w, b, stride=tuple(stride),
+                          padding=tuple(tuple(p) for p in padding),
+                          dilation=tuple(dilation))
+
+
+@op("maxPooling2d")
+def _maxpool(x, kernel=(2, 2), stride=(2, 2), padding=((0, 0), (0, 0))):
+    return _pool.max_pool2d(x, tuple(kernel), tuple(stride),
+                            tuple(tuple(p) for p in padding))
+
+
+@op("avgPooling2d")
+def _avgpool(x, kernel=(2, 2), stride=(2, 2), padding=((0, 0), (0, 0))):
+    return _pool.avg_pool2d(x, tuple(kernel), tuple(stride),
+                            tuple(tuple(p) for p in padding))
+
+
+@op("upsampling2d")
+def _upsample(x, size=(2, 2)):
+    return _pool.upsample2d(x, tuple(size))
+
+
+@op("im2col")
+def _im2col(x, kernel=(3, 3), stride=(1, 1), padding=((0, 0), (0, 0))):
+    # NHWC in -> (N, OH, OW, KH, KW, C) patches, one fused XLA gather
+    n, h, w, c = x.shape
+    kh, kw = kernel
+    patches = lax.conv_general_dilated_patches(
+        x, filter_shape=(kh, kw), window_strides=tuple(stride),
+        padding=tuple(tuple(p) for p in padding),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    oh, ow = patches.shape[1], patches.shape[2]
+    # patches feature dim is ordered (C, KH, KW) for NHWC inputs
+    return jnp.transpose(patches.reshape(n, oh, ow, c, kh, kw),
+                         (0, 1, 2, 4, 5, 3))
+
+
+# ---- rnn ----
+@op("lstmLayer")
+def _lstm(x, w, u, b, h0=None, c0=None):
+    ys, (h, c) = _rnn.lstm_scan(x, w, u, b, h0=h0, c0=c0)
+    return ys, h, c
+
+
+@op("gru")
+def _gru(x, w, u, b, h0=None):
+    ys, _h = _rnn.gru_scan(x, w, u, b, h0=h0)
+    return ys
+
+
+@op("simpleRnn")
+def _simple_rnn(x, w, u, b, h0=None):
+    ys, _h = _rnn.simple_rnn_scan(x, w, u, b, h0=h0)
+    return ys
+
+
+# ---- loss ----
+def _reduce_loss(per_ex, reduction):
+    if reduction == "MEAN_BY_WEIGHT" or reduction == "MEAN":
+        return jnp.mean(per_ex)
+    if reduction == "SUM":
+        return jnp.sum(per_ex)
+    return per_ex
+
+
+@op("lossMSE")
+def _loss_mse(labels, predictions, reduction="MEAN"):
+    return _reduce_loss(jnp.mean(jnp.square(predictions - labels), axis=-1),
+                        reduction)
+
+
+@op("lossMAE")
+def _loss_mae(labels, predictions, reduction="MEAN"):
+    return _reduce_loss(jnp.mean(jnp.abs(predictions - labels), axis=-1),
+                        reduction)
+
+
+@op("lossLog")
+def _loss_log(labels, predictions, reduction="MEAN", epsilon=1e-7):
+    p = jnp.clip(predictions, epsilon, 1.0 - epsilon)
+    per = -jnp.mean(labels * jnp.log(p) + (1 - labels) * jnp.log(1 - p),
+                    axis=-1)
+    return _reduce_loss(per, reduction)
+
+
+@op("softmaxCrossEntropy")
+def _loss_sce(labels, logits, reduction="MEAN"):
+    per = -jnp.sum(labels * jax.nn.log_softmax(logits, axis=-1), axis=-1)
+    return _reduce_loss(per, reduction)
+
+
+@op("sparseSoftmaxCrossEntropy")
+def _loss_ssce(labels, logits, reduction="MEAN"):
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    per = -jnp.take_along_axis(
+        lp, labels.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+    return _reduce_loss(per, reduction)
+
+
+@op("lossHinge")
+def _loss_hinge(labels, predictions, reduction="MEAN"):
+    per = jnp.mean(jnp.maximum(0.0, 1.0 - (2.0 * labels - 1.0) * predictions),
+                   axis=-1)
+    return _reduce_loss(per, reduction)
+
+
+@op("lossHuber")
+def _loss_huber(labels, predictions, delta=1.0, reduction="MEAN"):
+    d = jnp.abs(predictions - labels)
+    per = jnp.mean(jnp.where(d <= delta, 0.5 * d * d,
+                             delta * d - 0.5 * delta * delta), axis=-1)
+    return _reduce_loss(per, reduction)
+
+
+@op("lossKLD")
+def _loss_kld(labels, predictions, reduction="MEAN", epsilon=1e-7):
+    l = jnp.clip(labels, epsilon, 1.0)
+    p = jnp.clip(predictions, epsilon, 1.0)
+    return _reduce_loss(jnp.sum(l * jnp.log(l / p), axis=-1), reduction)
+
+
+@op("lossPoisson")
+def _loss_poisson(labels, predictions, reduction="MEAN"):
+    per = jnp.mean(predictions - labels * jnp.log(predictions + 1e-7),
+                   axis=-1)
+    return _reduce_loss(per, reduction)
+
+
+@op("lossCosine")
+def _loss_cosine(labels, predictions, dimension=-1, reduction="MEAN"):
+    ln = labels / (jnp.linalg.norm(labels, axis=dimension, keepdims=True) + 1e-12)
+    pn = predictions / (jnp.linalg.norm(predictions, axis=dimension,
+                                        keepdims=True) + 1e-12)
+    return _reduce_loss(1.0 - jnp.sum(ln * pn, axis=dimension), reduction)
+
+
+# ---- bitwise (int ops) ----
+for _n, _f in {
+    "shiftLeft": jnp.left_shift, "shiftRight": jnp.right_shift,
+    "bitwiseAnd": jnp.bitwise_and, "bitwiseOr": jnp.bitwise_or,
+    "bitwiseXor": jnp.bitwise_xor, "bitwiseNot": jnp.bitwise_not,
+}.items():
+    _reg(_n, _f)
+
+
+# ---- image ----
+@op("resizeBilinear")
+def _resize_bilinear(x, height=None, width=None, alignCorners=False):
+    n, h, w, c = x.shape  # NHWC (framework-wide image layout)
+    return jax.image.resize(x, (n, height, width, c), method="bilinear")
+
+
+@op("resizeNearest")
+def _resize_nearest(x, height=None, width=None):
+    n, h, w, c = x.shape
+    return jax.image.resize(x, (n, height, width, c), method="nearest")
+
+
+@op("cropAndResize")
+def _crop_resize(x, boxes, boxIndices, cropHeight=None, cropWidth=None):
+    # boxes: (nBoxes, 4) normalized [y1, x1, y2, x2]; x: NHWC
+    n, h, w, c = x.shape
+
+    def one(box, bi):
+        y1, x1, y2, x2 = box[0], box[1], box[2], box[3]
+        img = x[bi.astype(jnp.int32)]
+        ys = y1 * (h - 1) + jnp.linspace(0.0, 1.0, cropHeight) * (y2 - y1) * (h - 1)
+        xs = x1 * (w - 1) + jnp.linspace(0.0, 1.0, cropWidth) * (x2 - x1) * (w - 1)
+        # bilinear sample (differentiable w.r.t. box coords, matching the
+        # reference op's default method)
+        y0 = jnp.clip(jnp.floor(ys), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs), 0, w - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1).astype(jnp.int32)
+        x1i = jnp.clip(x0 + 1, 0, w - 1).astype(jnp.int32)
+        wy = (ys - y0)[:, None, None]
+        wx = (xs - x0)[None, :, None]
+        y0i, x0i = y0.astype(jnp.int32), x0.astype(jnp.int32)
+        tl = img[y0i][:, x0i, :]
+        tr = img[y0i][:, x1i, :]
+        bl = img[y1i][:, x0i, :]
+        br = img[y1i][:, x1i, :]
+        top = tl * (1 - wx) + tr * wx
+        bot = bl * (1 - wx) + br * wx
+        return top * (1 - wy) + bot * wy
+
+    return jax.vmap(one)(boxes, boxIndices)
+
+
+@op("adjustContrast")
+def _adjust_contrast(x, factor=1.0):
+    mean = jnp.mean(x, axis=(-1, -2), keepdims=True)
+    return (x - mean) * factor + mean
+
+
+@op("hsvToRgb")
+def _hsv_to_rgb(x):
+    # x: (..., 3) channels-last hsv in [0,1]
+    h, s, v = x[..., 0], x[..., 1], x[..., 2]
+    i = jnp.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    i = i.astype(jnp.int32) % 6
+    r = jnp.choose(i, [v, q, p, p, t, v], mode="clip")
+    g = jnp.choose(i, [t, v, v, q, p, p], mode="clip")
+    b = jnp.choose(i, [p, p, t, v, v, q], mode="clip")
+    return jnp.stack([r, g, b], axis=-1)
+
+
+@op("rgbToHsv")
+def _rgb_to_hsv(x):
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    mx = jnp.maximum(jnp.maximum(r, g), b)
+    mn = jnp.minimum(jnp.minimum(r, g), b)
+    d = mx - mn
+    h = jnp.where(
+        d == 0, 0.0,
+        jnp.where(mx == r, ((g - b) / (d + 1e-12)) % 6,
+                  jnp.where(mx == g, (b - r) / (d + 1e-12) + 2,
+                            (r - g) / (d + 1e-12) + 4))) / 6.0
+    s = jnp.where(mx == 0, 0.0, d / (mx + 1e-12))
+    return jnp.stack([h, s, mx], axis=-1)

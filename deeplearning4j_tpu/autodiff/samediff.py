@@ -1,0 +1,1065 @@
+"""SameDiff graph: define-then-run symbolic autodiff, compiled whole to XLA.
+
+Reference: org.nd4j.autodiff.samediff.SameDiff / SDVariable /
+TrainingConfig; execution in the reference walks the graph op-by-op in an
+InferenceSession, and autodiff builds a backward graph by transformation
+(SameDiff.calculateGradients).
+
+TPU design: the op list IS a trace recipe. Executing (or differentiating)
+the graph builds one pure JAX function over (variables, placeholders) and
+compiles it with jax.jit into a single XLA computation — no interpreter
+loop, no backward-graph surgery (jax.grad of the traced function), static
+shapes so XLA tiles matmuls onto the MXU.
+"""
+
+from __future__ import annotations
+
+import json
+import zipfile
+import io
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.ops_impl import OPS
+from deeplearning4j_tpu.ndarray import INDArray
+from deeplearning4j_tpu.nn import updaters as _upd
+from deeplearning4j_tpu.nn import weights as _weights
+from deeplearning4j_tpu.ndarray import random as _random
+
+
+class VariableType:
+    """Reference: org.nd4j.autodiff.samediff.VariableType."""
+
+    PLACEHOLDER = "PLACEHOLDER"
+    VARIABLE = "VARIABLE"   # trainable
+    CONSTANT = "CONSTANT"
+    ARRAY = "ARRAY"         # op output
+
+
+def _unwrap(x):
+    if isinstance(x, INDArray):
+        return x.jax()
+    return jnp.asarray(x)
+
+
+class SDVariable:
+    """Symbolic handle into a SameDiff graph (reference: SDVariable).
+
+    Operator overloads route through sd.math so `a * b + c` builds graph
+    nodes exactly like explicit namespace calls.
+    """
+
+    def __init__(self, sd, name, vtype):
+        self.sd = sd
+        self.name = name
+        self.variableType = vtype
+
+    # -- graph-building sugar --
+    def _bin(self, opname, other, reverse=False):
+        other = self.sd._lift(other)
+        a, b = (other, self) if reverse else (self, other)
+        return self.sd._op(opname, [a, b])
+
+    def __add__(self, o): return self._bin("add", o)
+    def __radd__(self, o): return self._bin("add", o, True)
+    def __sub__(self, o): return self._bin("sub", o)
+    def __rsub__(self, o): return self._bin("sub", o, True)
+    def __mul__(self, o): return self._bin("mul", o)
+    def __rmul__(self, o): return self._bin("mul", o, True)
+    def __truediv__(self, o): return self._bin("div", o)
+    def __rtruediv__(self, o): return self._bin("div", o, True)
+    def __pow__(self, o): return self._bin("pow", o)
+    def __neg__(self): return self.sd._op("neg", [self])
+    def __matmul__(self, o): return self._bin("mmul", o)
+
+    def add(self, o): return self._bin("add", o)
+    def sub(self, o): return self._bin("sub", o)
+    def mul(self, o): return self._bin("mul", o)
+    def div(self, o): return self._bin("div", o)
+    def rsub(self, o): return self._bin("sub", o, True)
+    def rdiv(self, o): return self._bin("div", o, True)
+    def mmul(self, o): return self._bin("mmul", o)
+    def dot(self, o):
+        return self.sd._op("sum", [self._bin("mul", o)])
+
+    def neg(self): return self.sd._op("neg", [self])
+
+    def sum(self, *dimensions, keepDims=False):
+        return self.sd._op("sum", [self],
+                           {"dimensions": list(dimensions) or None,
+                            "keepDims": keepDims})
+
+    def mean(self, *dimensions, keepDims=False):
+        return self.sd._op("mean", [self],
+                           {"dimensions": list(dimensions) or None,
+                            "keepDims": keepDims})
+
+    def std(self, *dimensions):
+        return self.sd._op("std", [self],
+                           {"dimensions": list(dimensions) or None})
+
+    def norm2(self, *dimensions):
+        return self.sd._op("norm2", [self],
+                           {"dimensions": list(dimensions) or None})
+
+    def argmax(self, dimension=None):
+        return self.sd._op(
+            "argmax", [self],
+            {"dimensions": None if dimension is None else [dimension]})
+
+    def reshape(self, *shape):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        return self.sd._op("reshape", [self], {"shape": list(shape)})
+
+    def permute(self, *dims):
+        return self.sd._op("permute", [self], {"dimensions": list(dims)})
+
+    def transpose(self):
+        return self.sd._op("transpose", [self])
+
+    def get(self, *idx):
+        """Static strided view (reference: SDVariable.get(SDIndex...))."""
+        begin, end, strides = [], [], []
+        shp = self.shape
+
+        def norm(v, i):
+            return v + shp[i] if v < 0 else v
+
+        for i, ix in enumerate(idx):
+            if isinstance(ix, slice):
+                begin.append(norm(ix.start or 0, i))
+                end.append(shp[i] if ix.stop is None else norm(ix.stop, i))
+                strides.append(ix.step or 1)
+            else:
+                p = norm(int(ix), i)
+                begin.append(p)
+                end.append(p + 1)
+                strides.append(1)
+        for i in range(len(idx), len(shp)):
+            begin.append(0); end.append(shp[i]); strides.append(1)
+        out = self.sd._op("stridedSlice", [self],
+                          {"begin": begin, "end": end, "strides": strides})
+        drop = [i for i, ix in enumerate(idx) if not isinstance(ix, slice)]
+        return out if not drop else self.sd._op("squeeze", [out],
+                                                {"axis": tuple(drop)})
+
+    def castTo(self, dtype):
+        return self.sd._op("cast", [self], {"dtype": str(np.dtype(dtype))})
+
+    # -- state --
+    def rename(self, new):
+        self.sd._rename(self.name, new)
+        return self
+
+    @property
+    def shape(self):
+        return self.sd._shape_of(self.name)
+
+    def getArr(self):
+        """Current value (VARIABLE/CONSTANT) or eval with no placeholders."""
+        if self.name in self.sd._arrays:
+            return INDArray(self.sd._arrays[self.name])
+        return self.eval()
+
+    def setArray(self, arr):
+        self.sd._arrays[self.name] = _unwrap(arr)
+
+    def eval(self, placeholders=None):
+        return self.sd.output(placeholders or {}, [self.name])[self.name]
+
+    def markAsLoss(self):
+        self.sd.setLossVariables(self.name)
+        return self
+
+    def __repr__(self):
+        return f"SDVariable(name='{self.name}', type={self.variableType})"
+
+
+class _Op:
+    __slots__ = ("opName", "inputs", "outputs", "kwargs")
+
+    def __init__(self, opName, inputs, outputs, kwargs):
+        self.opName = opName
+        self.inputs = inputs      # list[str]
+        self.outputs = outputs    # list[str]
+        self.kwargs = kwargs      # JSON-able dict
+
+
+class TrainingConfig:
+    """Reference: org.nd4j.autodiff.samediff.TrainingConfig (Builder)."""
+
+    def __init__(self, updater=None, dataSetFeatureMapping=None,
+                 dataSetLabelMapping=None, l1=0.0, l2=0.0, weightDecay=0.0,
+                 lossVariables=None):
+        self.updater = updater or _upd.Adam()
+        self.dataSetFeatureMapping = dataSetFeatureMapping or []
+        self.dataSetLabelMapping = dataSetLabelMapping or []
+        self.l1 = l1
+        self.l2 = l2
+        self.weightDecay = weightDecay
+        self.lossVariables = lossVariables
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def updater(self, u):
+            self._kw["updater"] = u
+            return self
+
+        def dataSetFeatureMapping(self, *names):
+            self._kw["dataSetFeatureMapping"] = list(names)
+            return self
+
+        def dataSetLabelMapping(self, *names):
+            self._kw["dataSetLabelMapping"] = list(names)
+            return self
+
+        def l1(self, v): self._kw["l1"] = v; return self
+        def l2(self, v): self._kw["l2"] = v; return self
+        def weightDecay(self, v): self._kw["weightDecay"] = v; return self
+
+        def build(self):
+            return TrainingConfig(**self._kw)
+
+
+class SameDiff:
+    """The graph container + compiler front-end (reference: SameDiff.create()).
+
+    Ops are appended in definition order; because a variable must exist
+    before it is used, definition order IS a topological order and the
+    backward slice of any output set is a valid trace program.
+    """
+
+    def __init__(self):
+        self._vars = {}        # name -> SDVariable
+        self._arrays = {}      # name -> jnp array (VARIABLE/CONSTANT)
+        self._ops = []         # list[_Op]
+        self._producer = {}    # out name -> op index
+        self._counter = 0
+        self._loss_vars = []
+        self._tc = None
+        self._iteration = 0
+        self._jit_cache = {}
+        # namespaces (reference: sd.math(), sd.nn(), ...)
+        self.math = _MathOps(self)
+        self.nn = _NNOps(self)
+        self.cnn = _CNNOps(self)
+        self.rnn = _RNNOps(self)
+        self.loss = _LossOps(self)
+        self.image = _ImageOps(self)
+        self.linalg = _LinalgOps(self)
+        self.bitwise = _BitwiseOps(self)
+
+    @staticmethod
+    def create():
+        return SameDiff()
+
+    # ---------- variable creation ----------
+    def _name(self, base):
+        self._counter += 1
+        n = f"{base}_{self._counter}"
+        while n in self._vars:
+            self._counter += 1
+            n = f"{base}_{self._counter}"
+        return n
+
+    def _new_var(self, name, vtype):
+        if name in self._vars:
+            raise ValueError(f"variable '{name}' already exists")
+        v = SDVariable(self, name, vtype)
+        self._vars[name] = v
+        return v
+
+    def placeHolder(self, name, dtype=jnp.float32, *shape):
+        v = self._new_var(name, VariableType.PLACEHOLDER)
+        v._ph_shape = tuple(shape)
+        v._ph_dtype = jnp.dtype(dtype)
+        return v
+
+    def var(self, name, *args, weightInit=None, shape=None, dtype=jnp.float32):
+        """sd.var("w", 4, 5) / sd.var("w", init_array) — trainable."""
+        v = self._new_var(name, VariableType.VARIABLE)
+        if len(args) == 1 and not isinstance(args[0], (int, np.integer)):
+            self._arrays[name] = _unwrap(args[0])
+        else:
+            shp = tuple(shape) if shape else tuple(int(a) for a in args)
+            scheme = weightInit or _weights.WeightInit.XAVIER
+            fan_in = shp[0] if shp else 1
+            fan_out = shp[-1] if shp else 1
+            self._arrays[name] = _weights.init(
+                _random.getRandom().nextKey(), scheme, shp, fan_in, fan_out,
+                dtype)
+        return v
+
+    def constant(self, value, name=None):
+        name = name or self._name("const")
+        v = self._new_var(name, VariableType.CONSTANT)
+        self._arrays[name] = _unwrap(value)
+        return v
+
+    def _lift(self, x):
+        if isinstance(x, SDVariable):
+            return x
+        return self.constant(x)
+
+    def _rename(self, old, new):
+        if new in self._vars:
+            raise ValueError(f"'{new}' already exists")
+        v = self._vars.pop(old)
+        v.name = new
+        self._vars[new] = v
+        if old in self._arrays:
+            self._arrays[new] = self._arrays.pop(old)
+        if old in self._producer:
+            self._producer[new] = self._producer.pop(old)
+        for op in self._ops:
+            op.inputs = [new if n == old else n for n in op.inputs]
+            op.outputs = [new if n == old else n for n in op.outputs]
+        self._loss_vars = [new if n == old else n for n in self._loss_vars]
+        self._jit_cache.clear()
+
+    # ---------- op registration ----------
+    def _op(self, opName, inputs, kwargs=None, nOut=1, name=None):
+        if opName not in OPS:
+            raise ValueError(f"unknown op '{opName}'")
+        in_names = [v.name for v in inputs]
+        outs = []
+        for i in range(nOut):
+            base = name if name else opName
+            n = base if (name and nOut == 1 and name not in self._vars) \
+                else self._name(base)
+            outs.append(n)
+            self._new_var(n, VariableType.ARRAY)
+        self._ops.append(_Op(opName, in_names, outs, kwargs or {}))
+        idx = len(self._ops) - 1
+        for n in outs:
+            self._producer[n] = idx
+        self._jit_cache.clear()
+        out_vars = [self._vars[n] for n in outs]
+        return out_vars[0] if nOut == 1 else tuple(out_vars)
+
+    def getVariable(self, name):
+        return self._vars[name]
+
+    def variables(self):
+        return [v for v in self._vars.values()
+                if v.variableType == VariableType.VARIABLE]
+
+    def setLossVariables(self, *names):
+        self._loss_vars = [n.name if isinstance(n, SDVariable) else n
+                           for n in names]
+
+    # ---------- trace / execution ----------
+    def _slice_for(self, out_names):
+        """Backward slice: op indices needed to compute out_names, in order."""
+        needed = set()
+        stack = list(out_names)
+        seen = set()
+        while stack:
+            n = stack.pop()
+            if n in seen:
+                continue
+            seen.add(n)
+            if n in self._producer:
+                i = self._producer[n]
+                needed.add(i)
+                stack.extend(self._ops[i].inputs)
+        return sorted(needed)
+
+    def _run_graph(self, env, out_names, train=False, rng=None):
+        """Pure interpreter over jnp values; called under trace so the whole
+        slice becomes one XLA computation. `train`/`rng` thread training
+        mode + a per-step PRNG key into stochastic ops (dropout)."""
+        for i in self._slice_for(out_names):
+            op = self._ops[i]
+            args = [env[n] for n in op.inputs]
+            kwargs = op.kwargs
+            if op.opName == "dropout":
+                kwargs = dict(kwargs, train=train and rng is not None,
+                              key=(jax.random.fold_in(rng, i)
+                                   if rng is not None else None))
+            res = OPS[op.opName](*args, **kwargs)
+            if len(op.outputs) == 1:
+                env[op.outputs[0]] = res
+            else:
+                for n, r in zip(op.outputs, res):
+                    env[n] = r
+        return {n: env[n] for n in out_names}
+
+    def _base_env(self):
+        return dict(self._arrays)
+
+    def output(self, placeholders, outputs):
+        """Compile-and-run the slice for `outputs` (reference:
+        SameDiff.output/exec → InferenceSession; here: one jax.jit)."""
+        out_names = [o.name if isinstance(o, SDVariable) else o
+                     for o in outputs]
+        ph = {k: _unwrap(v) for k, v in (placeholders or {}).items()}
+        key = (tuple(out_names),
+               tuple(sorted((k, v.shape, str(v.dtype)) for k, v in ph.items())),
+               len(self._ops))
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            def run(arrays, phs):
+                env = dict(arrays)
+                env.update(phs)
+                return self._run_graph(env, out_names)
+            fn = jax.jit(run)
+            self._jit_cache[key] = fn
+        res = fn(self._arrays, ph)
+        return {k: INDArray(v) for k, v in res.items()}
+
+    # alias kept for reference-API parity
+    def exec(self, placeholders, *outputs):
+        return self.output(placeholders, list(outputs))
+
+    def batchOutput(self):
+        sd = self
+
+        class _B:
+            def __init__(b):
+                b._ph, b._out = {}, []
+
+            def input(b, name, arr):
+                b._ph[name] = arr
+                return b
+
+            def output(b, *names):
+                b._out.extend(n.name if isinstance(n, SDVariable) else n
+                              for n in names)
+                return b
+
+            def out(b, *names):
+                return b.output(*names)
+
+            def exec(b):
+                return sd.output(b._ph, b._out)
+
+        return _B()
+
+    def _shape_of(self, name):
+        if name in self._arrays:
+            return tuple(self._arrays[name].shape)
+        v = self._vars[name]
+        if v.variableType == VariableType.PLACEHOLDER:
+            return v._ph_shape
+        # eval_shape the slice with abstract placeholders
+        out = self._eval_shapes([name])
+        return out[name]
+
+    def _eval_shapes(self, names):
+        phs = {n: jax.ShapeDtypeStruct(v._ph_shape, v._ph_dtype)
+               for n, v in self._vars.items()
+               if v.variableType == VariableType.PLACEHOLDER}
+
+        def run(arrays, p):
+            env = dict(arrays)
+            env.update(p)
+            return self._run_graph(env, names)
+
+        shapes = jax.eval_shape(run, self._arrays, phs)
+        return {n: tuple(s.shape) for n, s in shapes.items()}
+
+    # ---------- autodiff ----------
+    def _loss_names(self):
+        if self._loss_vars:
+            return self._loss_vars
+        if self._tc and self._tc.lossVariables:
+            return self._tc.lossVariables
+        raise ValueError("no loss variables set; call setLossVariables()")
+
+    def calculateGradients(self, placeholders, *wrt):
+        """Reference: SameDiff.calculateGradients — returns d(loss)/d(wrt).
+        TPU: jax.grad of the traced slice, not a backward graph."""
+        wrt_names = [w.name if isinstance(w, SDVariable) else w for w in wrt]
+        loss_names = self._loss_names()
+        ph = {k: _unwrap(v) for k, v in (placeholders or {}).items()}
+
+        # wrt may name stored arrays (VARIABLE/CONSTANT) or placeholders
+        # (input gradients, supported by the reference API)
+        w_names = [n for n in wrt_names if n in self._arrays]
+        p_names = [n for n in wrt_names if n not in self._arrays]
+        missing = [n for n in p_names if n not in ph]
+        if missing:
+            raise ValueError(f"wrt {missing} are placeholders but no value "
+                             f"was provided in `placeholders`")
+
+        key = ("grad", tuple(wrt_names), tuple(loss_names),
+               tuple(sorted((k, v.shape, str(v.dtype)) for k, v in ph.items())),
+               len(self._ops))
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            def loss_fn(w_arrays, ph_wrt, other_arrays, phs):
+                env = dict(other_arrays)
+                env.update(w_arrays)
+                env.update(phs)
+                env.update(ph_wrt)
+                outs = self._run_graph(env, loss_names)
+                return sum(jnp.sum(o) for o in outs.values())
+
+            fn = jax.jit(jax.grad(loss_fn, argnums=(0, 1)))
+            self._jit_cache[key] = fn
+
+        w_arrays = {n: self._arrays[n] for n in w_names}
+        ph_wrt = {n: ph[n] for n in p_names}
+        others = {n: a for n, a in self._arrays.items() if n not in w_arrays}
+        ph_rest = {n: a for n, a in ph.items() if n not in ph_wrt}
+        gw, gp = fn(w_arrays, ph_wrt, others, ph_rest)
+        out = {n: INDArray(g) for n, g in gw.items()}
+        out.update({n: INDArray(g) for n, g in gp.items()})
+        return out
+
+    def grad(self, name):
+        """Gradient variable accessor — evaluates lazily via calculateGradients."""
+        return _GradAccessor(self, name)
+
+    # ---------- training ----------
+    def setTrainingConfig(self, tc):
+        self._tc = tc
+        self._train_state = None
+
+    def fit(self, data=None, epochs=1, features=None, labels=None,
+            listeners=None):
+        """Train with TrainingConfig (reference: SameDiff.fit(DataSet)).
+        One jitted step: forward+loss+grad+updater, donated buffers."""
+        if self._tc is None:
+            raise ValueError("setTrainingConfig first")
+        tc = self._tc
+        loss_names = self._loss_names()
+        var_names = sorted(n for n, v in self._vars.items()
+                           if v.variableType == VariableType.VARIABLE)
+
+        if data is not None and features is None:
+            batches = data if isinstance(data, (list, tuple)) else [data]
+        else:
+            batches = [(features, labels)]
+
+        updater = tc.updater
+
+        ckey = ("fit", tuple(var_names), tuple(loss_names), id(tc),
+                len(self._ops))
+        jstep = self._jit_cache.get(ckey)
+        if jstep is None:
+            def step(params, ustate, consts, phs, it, rng):
+                def loss_fn(p):
+                    env = dict(consts)
+                    env.update(p)
+                    env.update(phs)
+                    outs = self._run_graph(env, loss_names, train=True,
+                                           rng=rng)
+                    loss = sum(jnp.sum(o) for o in outs.values())
+                    if tc.l2:
+                        loss = loss + tc.l2 * sum(
+                            jnp.sum(jnp.square(a)) for a in p.values())
+                    if tc.l1:
+                        loss = loss + tc.l1 * sum(
+                            jnp.sum(jnp.abs(a)) for a in p.values())
+                    return loss
+
+                loss, grads = jax.value_and_grad(loss_fn)(params)
+                if tc.weightDecay:
+                    grads = {n: g + tc.weightDecay * params[n]
+                             for n, g in grads.items()}
+                upd, new_state = updater.apply(grads, ustate, it)
+                new_params = {n: params[n] - upd[n] for n in params}
+                return loss, new_params, new_state
+
+            jstep = jax.jit(step, donate_argnums=(0, 1))
+            self._jit_cache[ckey] = jstep
+
+        params = {n: self._arrays[n] for n in var_names}
+        consts = {n: a for n, a in self._arrays.items() if n not in params}
+        state = getattr(self, "_train_state", None)
+        if state is None:
+            state = updater.init(params)
+            pending = getattr(self, "_pending_updater_leaves", None)
+            if pending is not None:
+                leaves, treedef = jax.tree_util.tree_flatten(state)
+                state = jax.tree_util.tree_unflatten(
+                    treedef, [jnp.asarray(l) for l in pending])
+                self._pending_updater_leaves = None
+
+        history = []
+        base_key = jax.random.key(0)
+        for _ in range(epochs):
+            for b in batches:
+                phs = self._batch_to_placeholders(b, tc)
+                rng = jax.random.fold_in(base_key, self._iteration)
+                loss, params, state = jstep(params, state, consts, phs,
+                                            self._iteration, rng)
+                # write back per-step: the inputs were donated, so stale
+                # self._arrays entries would point at deleted buffers if a
+                # listener (or an exception) reads them mid-fit
+                self._arrays.update(params)
+                self._train_state = state
+                self._iteration += 1
+                history.append(float(loss))
+                for l in (listeners or []):
+                    l.iterationDone(self, self._iteration, float(loss))
+        self._arrays.update(params)
+        self._train_state = state
+        return history
+
+    def _batch_to_placeholders(self, b, tc):
+        from deeplearning4j_tpu.data import DataSet
+        if isinstance(b, DataSet):
+            feats = [b.getFeatures()]
+            labs = [b.getLabels()]
+        elif isinstance(b, (tuple, list)):
+            feats = [b[0]] if not isinstance(b[0], (tuple, list)) else list(b[0])
+            labs = [b[1]] if not isinstance(b[1], (tuple, list)) else list(b[1])
+        else:
+            raise TypeError(f"cannot map batch of type {type(b)}")
+        phs = {}
+        for name, arr in zip(tc.dataSetFeatureMapping, feats):
+            phs[name] = _unwrap(arr)
+        for name, arr in zip(tc.dataSetLabelMapping, labs):
+            phs[name] = _unwrap(arr)
+        return phs
+
+    # ---------- serialization ----------
+    def save(self, path, saveUpdaterState=False):
+        """Graph → JSON, arrays → npz, both in one zip (reference:
+        SameDiff.save FlatBuffers .fb; format here is portable npz+json)."""
+        graph = {
+            "variables": [
+                {"name": n, "type": v.variableType,
+                 "phShape": list(getattr(v, "_ph_shape", ()) or ()),
+                 "phDtype": str(getattr(v, "_ph_dtype", "") or "")}
+                for n, v in self._vars.items()],
+            "ops": [{"op": o.opName, "inputs": o.inputs,
+                     "outputs": o.outputs, "kwargs": o.kwargs}
+                    for o in self._ops],
+            "lossVariables": self._loss_vars,
+            "iteration": self._iteration,
+        }
+        buf = io.BytesIO()
+        np.savez(buf, **{n: np.asarray(a) for n, a in self._arrays.items()})
+        with zipfile.ZipFile(path, "w") as z:
+            z.writestr("graph.json", json.dumps(graph))
+            z.writestr("arrays.npz", buf.getvalue())
+            if saveUpdaterState and getattr(self, "_train_state", None) is not None:
+                sbuf = io.BytesIO()
+                leaves, treedef = jax.tree_util.tree_flatten(self._train_state)
+                np.savez(sbuf, *[np.asarray(l) for l in leaves])
+                z.writestr("updater.npz", sbuf.getvalue())
+
+    @staticmethod
+    def load(path, loadUpdaterState=False):
+        sd = SameDiff()
+        with zipfile.ZipFile(path) as z:
+            graph = json.loads(z.read("graph.json"))
+            npz = np.load(io.BytesIO(z.read("arrays.npz")))
+            arrays = {n: jnp.asarray(npz[n]) for n in npz.files}
+            if loadUpdaterState and "updater.npz" in z.namelist():
+                snpz = np.load(io.BytesIO(z.read("updater.npz")))
+                # leaves in tree_flatten order; restored into the updater's
+                # init structure on the first fit() call
+                sd._pending_updater_leaves = [snpz[k] for k in snpz.files]
+        for vd in graph["variables"]:
+            v = SDVariable(sd, vd["name"], vd["type"])
+            if vd["type"] == VariableType.PLACEHOLDER:
+                v._ph_shape = tuple(vd["phShape"])
+                v._ph_dtype = jnp.dtype(vd["phDtype"])
+            sd._vars[vd["name"]] = v
+        for i, od in enumerate(graph["ops"]):
+            kwargs = od["kwargs"]
+            sd._ops.append(_Op(od["op"], od["inputs"], od["outputs"],
+                               kwargs))
+            for n in od["outputs"]:
+                sd._producer[n] = i
+        sd._arrays = arrays
+        sd._loss_vars = graph.get("lossVariables", [])
+        sd._iteration = graph.get("iteration", 0)
+        return sd
+
+    def summary(self):
+        lines = [f"--- SameDiff: {len(self._vars)} variables, "
+                 f"{len(self._ops)} ops ---"]
+        for n, v in self._vars.items():
+            if v.variableType != VariableType.ARRAY:
+                shp = self._arrays[n].shape if n in self._arrays \
+                    else getattr(v, "_ph_shape", "?")
+                lines.append(f"  {v.variableType:<12} {n:<24} {shp}")
+        for o in self._ops:
+            lines.append(f"  {o.opName}({', '.join(o.inputs)}) -> "
+                         f"{', '.join(o.outputs)}")
+        return "\n".join(lines)
+
+
+class _GradAccessor:
+    def __init__(self, sd, name):
+        self.sd = sd
+        self.name = name.name if isinstance(name, SDVariable) else name
+
+    def eval(self, placeholders=None):
+        return self.sd.calculateGradients(placeholders or {},
+                                          self.name)[self.name]
+
+
+# ---------------- op namespaces ----------------
+class _NS:
+    def __init__(self, sd):
+        self.sd = sd
+
+    def _mk(self, opName, inputs, kwargs=None, nOut=1, name=None):
+        ins = [self.sd._lift(i) for i in inputs]
+        return self.sd._op(opName, ins, kwargs, nOut=nOut, name=name)
+
+
+def _unary(opName):
+    def m(self, x, name=None):
+        return self._mk(opName, [x], name=name)
+    m.__name__ = opName
+    return m
+
+
+def _binary(opName):
+    def m(self, a, b, name=None):
+        return self._mk(opName, [a, b], name=name)
+    m.__name__ = opName
+    return m
+
+
+def _reduction(opName):
+    def m(self, x, *dimensions, keepDims=False, name=None):
+        return self._mk(opName, [x],
+                        {"dimensions": list(dimensions) or None,
+                         "keepDims": keepDims}, name=name)
+    m.__name__ = opName
+    return m
+
+
+class _MathOps(_NS):
+    """Reference: org.nd4j.autodiff.samediff.ops.SDMath."""
+
+    for _n in ("neg abs sign exp expm1 log log1p log2 sqrt square floor ceil "
+               "round sin cos tan asin acos atan sinh cosh tanh asinh acosh "
+               "atanh erf erfc reciprocal rsqrt isnan isinf isfinite").split():
+        locals()[_n] = _unary(_n)
+    for _n in ("add sub mul div pow atan2 squaredDifference maximum minimum "
+               "floordiv mod eq neq gt gte lt lte and or xor").split():
+        locals()[_n] = _binary(_n)
+    for _n in "sum mean prod max min std variance norm1 norm2 normmax".split():
+        locals()[_n] = _reduction(_n)
+    del _n
+
+    def logicalNot(self, x, name=None):
+        return self._mk("not", [x], name=name)
+
+    def where(self, cond, x, y, name=None):
+        return self._mk("where", [cond, x, y], name=name)
+
+    def argmax(self, x, dimension=None, name=None):
+        return self._mk("argmax", [x],
+                        {"dimensions": None if dimension is None
+                         else [dimension]}, name=name)
+
+    def argmin(self, x, dimension=None, name=None):
+        return self._mk("argmin", [x],
+                        {"dimensions": None if dimension is None
+                         else [dimension]}, name=name)
+
+    def cumsum(self, x, axis=0, exclusive=False, reverse=False, name=None):
+        return self._mk("cumsum", [x], {"axis": axis, "exclusive": exclusive,
+                                        "reverse": reverse}, name=name)
+
+    def cumprod(self, x, axis=0, name=None):
+        return self._mk("cumprod", [x], {"axis": axis}, name=name)
+
+    def concat(self, dimension, *xs, name=None):
+        return self._mk("concat", list(xs), {"dimension": dimension},
+                        name=name)
+
+    def stack(self, axis, *xs, name=None):
+        return self._mk("stack", list(xs), {"axis": axis}, name=name)
+
+    def unstack(self, x, axis, num, name=None):
+        return self._mk("unstack", [x], {"axis": axis, "num": num},
+                        nOut=num, name=name)
+
+    def reshape(self, x, shape, name=None):
+        return self._mk("reshape", [x], {"shape": list(shape)}, name=name)
+
+    def permute(self, x, *dims, name=None):
+        return self._mk("permute", [x], {"dimensions": list(dims)}, name=name)
+
+    def expandDims(self, x, axis, name=None):
+        return self._mk("expandDims", [x], {"axis": axis}, name=name)
+
+    def squeeze(self, x, axis, name=None):
+        return self._mk("squeeze", [x], {"axis": axis}, name=name)
+
+    def tile(self, x, reps, name=None):
+        return self._mk("tile", [x], {"reps": list(reps)}, name=name)
+
+    def reverse(self, x, *dimensions, name=None):
+        return self._mk("reverse", [x], {"dimensions": list(dimensions)},
+                        name=name)
+
+    def gather(self, x, indices, axis=0, name=None):
+        return self._mk("gather", [x, indices], {"axis": axis}, name=name)
+
+    def oneHot(self, x, depth, axis=-1, on=1.0, off=0.0, name=None):
+        return self._mk("onehot", [x], {"depth": depth, "axis": axis,
+                                        "on": on, "off": off}, name=name)
+
+    def scatterUpdate(self, ref, indices, updates, name=None):
+        return self._mk("scatterUpdate", [ref, indices, updates], name=name)
+
+    def scatterAdd(self, ref, indices, updates, name=None):
+        return self._mk("scatterAdd", [ref, indices, updates], name=name)
+
+    def pad(self, x, padding, constant=0.0, name=None):
+        return self._mk("pad", [x], {"padding": [list(p) for p in padding],
+                                     "constant": constant}, name=name)
+
+    def identity(self, x, name=None):
+        return self._mk("identity", [x], name=name)
+
+    def cast(self, x, dtype, name=None):
+        return self._mk("cast", [x], {"dtype": str(np.dtype(dtype))},
+                        name=name)
+
+
+class _NNOps(_NS):
+    """Reference: ops.SDNN."""
+
+    for _n in ("relu relu6 sigmoid softplus softsign elu selu gelu swish "
+               "mish hardSigmoid hardTanh").split():
+        locals()[_n] = _unary(_n)
+    del _n
+
+    def leakyRelu(self, x, alpha=0.01, name=None):
+        return self._mk("leakyRelu", [x], {"alpha": alpha}, name=name)
+
+    def softmax(self, x, dimension=-1, name=None):
+        return self._mk("softmax", [x], {"dimension": dimension}, name=name)
+
+    def logSoftmax(self, x, dimension=-1, name=None):
+        return self._mk("logSoftmax", [x], {"dimension": dimension},
+                        name=name)
+
+    def linear(self, x, w, b=None, name=None):
+        ins = [x, w] + ([b] if b is not None else [])
+        return self._mk("linear", ins, name=name)
+
+    def reluLayer(self, x, w, b, name=None):
+        return self.relu(self.linear(x, w, b), name=name)
+
+    def layerNorm(self, x, gain, bias=None, dimensions=(-1,), name=None):
+        ins = [x, gain] + ([bias] if bias is not None else [])
+        return self._mk("layerNorm", ins,
+                        {"dimensions": list(dimensions)}, name=name)
+
+    def batchNorm(self, x, mean, var, gamma=None, beta=None, epsilon=1e-5,
+                  axis=-1, name=None):
+        ins = [x, mean, var] + ([gamma] if gamma is not None else []) \
+            + ([beta] if beta is not None else [])
+        return self._mk("batchNorm", ins, {"epsilon": epsilon, "axis": axis},
+                        name=name)
+
+    def dropout(self, x, rate, name=None):
+        """Active during fit() (train mode + per-step key threaded by
+        _run_graph); identity in output()/eval(), like the reference's
+        inference behavior."""
+        return self._mk("dropout", [x], {"rate": rate}, name=name)
+
+    def embeddingLookup(self, table, ids, name=None):
+        return self._mk("embeddingLookup", [table, ids], name=name)
+
+    def dotProductAttention(self, q, k, v, causal=False, name=None):
+        return self._mk("dotProductAttention", [q, k, v],
+                        {"causal": causal}, name=name)
+
+    def multiHeadDotProductAttention(self, x, wq, wk, wv, wo, nHeads,
+                                     causal=False, name=None):
+        return self._mk("multiHeadDotProductAttention",
+                        [x, wq, wk, wv, wo],
+                        {"nHeads": nHeads, "causal": causal}, name=name)
+
+    def pad(self, x, padding, constant=0.0, name=None):
+        return self.sd.math.pad(x, padding, constant, name=name)
+
+
+class _CNNOps(_NS):
+    """Reference: ops.SDCNN."""
+
+    def conv2d(self, x, w, b=None, stride=(1, 1), padding=((0, 0), (0, 0)),
+               dilation=(1, 1), name=None):
+        ins = [x, w] + ([b] if b is not None else [])
+        return self._mk("conv2d", ins,
+                        {"stride": list(stride),
+                         "padding": [list(p) for p in padding],
+                         "dilation": list(dilation)}, name=name)
+
+    def conv1d(self, x, w, b=None, stride=1, padding=((0, 0),), dilation=1,
+               name=None):
+        ins = [x, w] + ([b] if b is not None else [])
+        return self._mk("conv1d", ins,
+                        {"stride": stride,
+                         "padding": [list(p) for p in padding],
+                         "dilation": dilation}, name=name)
+
+    def deconv2d(self, x, w, b=None, stride=(1, 1), padding=((0, 0), (0, 0)),
+                 name=None):
+        ins = [x, w] + ([b] if b is not None else [])
+        return self._mk("deconv2d", ins,
+                        {"stride": list(stride),
+                         "padding": [list(p) for p in padding]}, name=name)
+
+    def maxPooling2d(self, x, kernel, stride=None, padding=((0, 0), (0, 0)),
+                     name=None):
+        return self._mk("maxPooling2d", [x],
+                        {"kernel": list(kernel),
+                         "stride": list(stride or kernel),
+                         "padding": [list(p) for p in padding]}, name=name)
+
+    def avgPooling2d(self, x, kernel, stride=None, padding=((0, 0), (0, 0)),
+                     name=None):
+        return self._mk("avgPooling2d", [x],
+                        {"kernel": list(kernel),
+                         "stride": list(stride or kernel),
+                         "padding": [list(p) for p in padding]}, name=name)
+
+    def upsampling2d(self, x, size=(2, 2), name=None):
+        return self._mk("upsampling2d", [x], {"size": list(size)}, name=name)
+
+    def im2col(self, x, kernel, stride=(1, 1), padding=((0, 0), (0, 0)),
+               name=None):
+        return self._mk("im2col", [x],
+                        {"kernel": list(kernel), "stride": list(stride),
+                         "padding": [list(p) for p in padding]}, name=name)
+
+
+class _RNNOps(_NS):
+    """Reference: ops.SDRNN."""
+
+    def lstmLayer(self, x, w, u, b, name=None):
+        """-> (h_seq [T,B,H], h_last [B,H], c_last [B,H])."""
+        return self._mk("lstmLayer", [x, w, u, b], nOut=3, name=name)
+
+    def gru(self, x, w, u, b, name=None):
+        return self._mk("gru", [x, w, u, b], name=name)
+
+    def simpleRnn(self, x, w, u, b, name=None):
+        return self._mk("simpleRnn", [x, w, u, b], name=name)
+
+
+class _LossOps(_NS):
+    """Reference: ops.SDLoss. Outputs are auto-marked as loss variables."""
+
+    def _loss(self, opName, inputs, kwargs=None, name=None):
+        v = self._mk(opName, inputs, kwargs, name=name)
+        self.sd._loss_vars.append(v.name)
+        return v
+
+    def meanSquaredError(self, labels, predictions, name=None):
+        return self._loss("lossMSE", [labels, predictions], name=name)
+
+    def absoluteDifference(self, labels, predictions, name=None):
+        return self._loss("lossMAE", [labels, predictions], name=name)
+
+    def logLoss(self, labels, predictions, name=None):
+        return self._loss("lossLog", [labels, predictions], name=name)
+
+    def softmaxCrossEntropy(self, labels, logits, name=None):
+        return self._loss("softmaxCrossEntropy", [labels, logits], name=name)
+
+    def sparseSoftmaxCrossEntropy(self, labels, logits, name=None):
+        return self._loss("sparseSoftmaxCrossEntropy", [labels, logits],
+                          name=name)
+
+    def hingeLoss(self, labels, predictions, name=None):
+        return self._loss("lossHinge", [labels, predictions], name=name)
+
+    def huberLoss(self, labels, predictions, delta=1.0, name=None):
+        return self._loss("lossHuber", [labels, predictions],
+                          {"delta": delta}, name=name)
+
+    def klDivergence(self, labels, predictions, name=None):
+        return self._loss("lossKLD", [labels, predictions], name=name)
+
+    def poissonLoss(self, labels, predictions, name=None):
+        return self._loss("lossPoisson", [labels, predictions], name=name)
+
+    def cosineDistance(self, labels, predictions, dimension=-1, name=None):
+        return self._loss("lossCosine", [labels, predictions],
+                          {"dimension": dimension}, name=name)
+
+
+class _ImageOps(_NS):
+    """Reference: ops.SDImage."""
+
+    def resizeBilinear(self, x, height, width, name=None):
+        return self._mk("resizeBilinear", [x],
+                        {"height": height, "width": width}, name=name)
+
+    def resizeNearest(self, x, height, width, name=None):
+        return self._mk("resizeNearest", [x],
+                        {"height": height, "width": width}, name=name)
+
+    def cropAndResize(self, x, boxes, boxIndices, cropHeight, cropWidth,
+                      name=None):
+        return self._mk("cropAndResize", [x, boxes, boxIndices],
+                        {"cropHeight": cropHeight, "cropWidth": cropWidth},
+                        name=name)
+
+    def adjustContrast(self, x, factor, name=None):
+        return self._mk("adjustContrast", [x], {"factor": factor}, name=name)
+
+    def hsvToRgb(self, x, name=None):
+        return self._mk("hsvToRgb", [x], name=name)
+
+    def rgbToHsv(self, x, name=None):
+        return self._mk("rgbToHsv", [x], name=name)
+
+
+class _LinalgOps(_NS):
+    """Reference: ops.SDLinalg."""
+
+    def mmul(self, a, b, transposeA=False, transposeB=False, name=None):
+        return self._mk("mmul", [a, b], {"transposeA": transposeA,
+                                         "transposeB": transposeB}, name=name)
+
+    def tensorMmul(self, a, b, dimensionsA, dimensionsB, name=None):
+        return self._mk("tensorMmul", [a, b],
+                        {"dimensionsA": list(dimensionsA),
+                         "dimensionsB": list(dimensionsB)}, name=name)
+
+    def matmul(self, a, b, name=None):
+        return self._mk("batchMmul", [a, b], name=name)
+
+    for _n in "cholesky inv det trace cross solve lstsq".split():
+        locals()[_n] = _binary(_n) if _n in ("cross", "solve", "lstsq") \
+            else _unary(_n)
+    del _n
+
+    def svd(self, x, fullUV=False, name=None):
+        return self._mk("svd", [x], {"fullUV": fullUV}, nOut=3, name=name)
+
+    def qr(self, x, name=None):
+        return self._mk("qr", [x], nOut=2, name=name)
+
+
+class _BitwiseOps(_NS):
+    """Reference: ops.SDBitwise."""
+
+    def leftShift(self, a, b, name=None):
+        return self._mk("shiftLeft", [a, b], name=name)
+
+    def rightShift(self, a, b, name=None):
+        return self._mk("shiftRight", [a, b], name=name)
+
+    def bitwiseAnd(self, a, b, name=None):
+        return self._mk("bitwiseAnd", [a, b], name=name)
+
+    def bitwiseOr(self, a, b, name=None):
+        return self._mk("bitwiseOr", [a, b], name=name)
+
+    def bitwiseXor(self, a, b, name=None):
+        return self._mk("bitwiseXor", [a, b], name=name)

@@ -206,10 +206,20 @@ class MultiLayerNetwork:
         return loss, new_states
 
     def _train_step(self, params, upd_states, states, iteration, x, y, key,
-                    fmask, lmask, use_carries=False):
+                    fmask, lmask, use_carries=False, grad_transform=None,
+                    loss_transform=None, state_transform=None):
+        """The fused step. The *_transform hooks let distributed wrappers
+        (parallel.trainer) splice in an explicit cross-shard allreduce /
+        pmean without duplicating the updater loop."""
         (loss, new_states), grads = jax.value_and_grad(
             self._loss_fn, has_aux=True)(params, states, x, y, key, fmask, lmask,
                                          use_carries)
+        if grad_transform is not None:
+            grads = grad_transform(grads)
+        if loss_transform is not None:
+            loss = loss_transform(loss)
+        if state_transform is not None:
+            new_states = state_transform(new_states)
         grads = _grad_normalize(grads, self.conf.gradientNormalization,
                                 self.conf.gradientNormalizationThreshold)
         new_params, new_upd_states = [], []

@@ -37,6 +37,10 @@ def build_mesh(axes=None, devices=None) -> Mesh:
     sizes = list(axes.values())
     if -1 in sizes:
         known = int(np.prod([s for s in sizes if s != -1]))
+        if len(devices) % known != 0:
+            raise ValueError(
+                f"Cannot infer -1 axis: {len(devices)} devices not divisible "
+                f"by fixed axes product {known}")
         sizes[sizes.index(-1)] = len(devices) // known
     total = int(np.prod(sizes))
     if total > len(devices):

@@ -86,31 +86,27 @@ class ParallelWrapper:
         n = self.net
         mesh, ax = self.mesh, self.batch_axis
 
-        grad_fn = jax.value_and_grad(n._loss_fn, has_aux=True)
+        def qall(g):
+            scale = jnp.maximum(jnp.max(jnp.abs(g)), 1e-12)
+            scale = jax.lax.pmax(scale, ax)
+            q = jnp.clip(jnp.round(g / scale * 127.0), -127, 127).astype(jnp.int8)
+            summed = jax.lax.psum(q.astype(jnp.int32), ax)
+            return summed.astype(g.dtype) * (scale / 127.0) / jax.lax.psum(1, ax)
+
+        def sync_states(states):
+            # Per-shard batch stats (BN running mean/var) diverge across the
+            # mesh; pmean keeps the returned "replicated" state consistent on
+            # every device (cross-replica BN, mean-of-shard-stats).
+            return jax.tree_util.tree_map(
+                lambda a: jax.lax.pmean(a, ax)
+                if jnp.issubdtype(a.dtype, jnp.inexact) else a, states)
 
         def shard_step(params_r, upd_r, states_r, it_r, x_s, y_s, key_r, fm_s, lm_s):
-            (loss, new_states), grads = grad_fn(params_r, states_r, x_s, y_s,
-                                                key_r, fm_s, lm_s, False)
-            def qall(g):
-                scale = jnp.maximum(jnp.max(jnp.abs(g)), 1e-12)
-                scale = jax.lax.pmax(scale, ax)
-                q = jnp.clip(jnp.round(g / scale * 127.0), -127, 127).astype(jnp.int8)
-                summed = jax.lax.psum(q.astype(jnp.int32), ax)
-                return summed.astype(g.dtype) * (scale / 127.0) / jax.lax.psum(1, ax)
-
-            grads = jax.tree_util.tree_map(qall, grads)
-            loss = jax.lax.pmean(loss, ax)
-            new_params, new_upd = [], []
-            for i in range(len(n.layers)):
-                if not params_r[i]:
-                    new_params.append(params_r[i])
-                    new_upd.append(upd_r[i])
-                    continue
-                upd, us = n._updaters[i].apply(grads[i], upd_r[i], it_r)
-                new_params.append(jax.tree_util.tree_map(
-                    lambda p, u: (p - u).astype(p.dtype), params_r[i], upd))
-                new_upd.append(us)
-            return new_params, new_upd, new_states, loss
+            return n._train_step(
+                params_r, upd_r, states_r, it_r, x_s, y_s, key_r, fm_s, lm_s,
+                grad_transform=lambda g: jax.tree_util.tree_map(qall, g),
+                loss_transform=lambda l: jax.lax.pmean(l, ax),
+                state_transform=sync_states)
 
         spec_b = P(ax)
         return shard_map(

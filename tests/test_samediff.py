@@ -1,0 +1,226 @@
+"""SameDiff graph tests: build, whole-graph compile, autodiff parity vs a
+jax.grad oracle, training convergence, serialization round-trip.
+
+Mirrors reference tests in nd4j-autodiff samediff test suites
+(SameDiffTests: basic ops, gradients, training)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deeplearning4j_tpu.autodiff import SameDiff, TrainingConfig, VariableType
+from deeplearning4j_tpu.nn.updaters import Sgd, Adam
+
+
+def test_basic_arithmetic_eval():
+    sd = SameDiff.create()
+    a = sd.constant(np.array([1.0, 2.0, 3.0]), name="a")
+    b = sd.constant(np.array([10.0, 20.0, 30.0]), name="b")
+    c = (a + b) * 2.0 - 3.0
+    got = c.eval().toNumpy()
+    np.testing.assert_allclose(got, np.array([19.0, 41.0, 63.0]))
+
+
+def test_placeholder_exec_and_jit_cache():
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", jnp.float64, 2, 3)
+    w = sd.var("w", np.ones((3, 4)))
+    y = sd.nn.linear(x, w, name="y")
+    xv = np.arange(6.0).reshape(2, 3)
+    out = sd.output({"x": xv}, ["y"])["y"].toNumpy()
+    np.testing.assert_allclose(out, xv @ np.ones((3, 4)))
+    # second call hits the jit cache (no retrace needed for same shape)
+    out2 = sd.output({"x": xv + 1}, ["y"])["y"].toNumpy()
+    np.testing.assert_allclose(out2, (xv + 1) @ np.ones((3, 4)))
+
+
+def test_namespaces_cover_op_families():
+    sd = SameDiff.create()
+    x = sd.constant(np.linspace(-1, 1, 12).reshape(3, 4))
+    assert sd.math.exp(x).eval().shape() == (3, 4)
+    assert sd.nn.softmax(x).eval().shape() == (3, 4)
+    assert sd.math.sum(x, 1).eval().shape() == (3,)
+    s = sd.math.reshape(x, (4, 3))
+    assert s.eval().shape() == (4, 3)
+    q, r = sd.linalg.qr(sd.constant(np.random.rand(4, 4)))
+    np.testing.assert_allclose((q.mmul(r)).eval().toNumpy(),
+                               q.eval().toNumpy() @ r.eval().toNumpy())
+
+
+def test_reduction_and_argmax():
+    sd = SameDiff.create()
+    x = sd.constant(np.array([[1.0, 5.0, 2.0], [7.0, 0.0, 3.0]]))
+    assert float(sd.math.max(x).eval().toNumpy()) == 7.0
+    np.testing.assert_array_equal(
+        sd.math.argmax(x, 1).eval().toNumpy(), np.array([1, 0]))
+
+
+def test_gradients_match_jax_oracle():
+    """calculateGradients == jax.grad on the equivalent pure function."""
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", jnp.float64, 4, 3)
+    w = sd.var("w", np.random.RandomState(0).rand(3, 2))
+    b = sd.var("b", np.zeros(2))
+    out = sd.math.tanh(sd.nn.linear(x, w, b))
+    loss = sd.math.sum(sd.math.square(out), name="loss")
+    sd.setLossVariables("loss")
+
+    xv = np.random.RandomState(1).rand(4, 3)
+    grads = sd.calculateGradients({"x": xv}, "w", "b")
+
+    wv = np.random.RandomState(0).rand(3, 2)
+
+    def oracle(w_, b_):
+        return jnp.sum(jnp.square(jnp.tanh(xv @ w_ + b_)))
+
+    gw, gb = jax.grad(oracle, argnums=(0, 1))(wv, np.zeros(2))
+    np.testing.assert_allclose(grads["w"].toNumpy(), gw, rtol=1e-6)
+    np.testing.assert_allclose(grads["b"].toNumpy(), gb, rtol=1e-6)
+
+
+def test_loss_ops_marked_and_graph_slice():
+    sd = SameDiff.create()
+    labels = sd.placeHolder("labels", jnp.float64, 8, 3)
+    logits = sd.placeHolder("logits", jnp.float64, 8, 3)
+    sd.loss.softmaxCrossEntropy(labels, logits, name="sce")
+    assert "sce" in sd._loss_names()
+
+
+def test_training_linear_regression_converges():
+    """fit() drives loss down on y = Xw* synthetic data (reference:
+    SameDiffTrainingTest)."""
+    rs = np.random.RandomState(42)
+    X = rs.rand(64, 5)
+    true_w = np.array([[1.0], [-2.0], [3.0], [0.5], [-1.5]])
+    Y = X @ true_w
+
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", jnp.float64, 64, 5)
+    y = sd.placeHolder("y", jnp.float64, 64, 1)
+    w = sd.var("w", np.zeros((5, 1)))
+    pred = sd.nn.linear(x, w, name="pred")
+    sd.loss.meanSquaredError(y, pred, name="mse")
+
+    sd.setTrainingConfig(TrainingConfig.Builder()
+                         .updater(Adam(learningRate=0.1))
+                         .dataSetFeatureMapping("x")
+                         .dataSetLabelMapping("y")
+                         .build())
+    hist = sd.fit(features=X, labels=Y, epochs=200)
+    assert hist[-1] < 0.01 * hist[0]
+    np.testing.assert_allclose(
+        sd.getVariable("w").getArr().toNumpy(), true_w, atol=0.15)
+
+
+def test_training_l2_regularization_shrinks_weights():
+    X = np.random.RandomState(0).rand(32, 4)
+    Y = np.zeros((32, 1))
+
+    def run(l2):
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", jnp.float64, 32, 4)
+        y = sd.placeHolder("y", jnp.float64, 32, 1)
+        w = sd.var("w", np.full((4, 1), 5.0))
+        sd.loss.meanSquaredError(y, sd.nn.linear(x, w, name="p"), name="l")
+        sd.setTrainingConfig(TrainingConfig.Builder()
+                             .updater(Sgd(learningRate=0.05))
+                             .dataSetFeatureMapping("x")
+                             .dataSetLabelMapping("y")
+                             .l2(l2).build())
+        sd.fit(features=X, labels=Y, epochs=50)
+        return float(np.abs(sd.getVariable("w").getArr().toNumpy()).sum())
+
+    assert run(0.1) < run(0.0) + 1e-9
+
+
+def test_serialization_roundtrip(tmp_path):
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", jnp.float64, 2, 3)
+    w = sd.var("w", np.random.RandomState(3).rand(3, 4))
+    sd.nn.gelu(sd.nn.linear(x, w), name="out")
+
+    xv = np.random.RandomState(4).rand(2, 3)
+    before = sd.output({"x": xv}, ["out"])["out"].toNumpy()
+
+    p = str(tmp_path / "model.sdz")
+    sd.save(p)
+    sd2 = SameDiff.load(p)
+    after = sd2.output({"x": xv}, ["out"])["out"].toNumpy()
+    np.testing.assert_allclose(before, after, rtol=1e-7)
+    assert sd2.getVariable("w").variableType == VariableType.VARIABLE
+
+
+def test_variable_rename_and_summary():
+    sd = SameDiff.create()
+    a = sd.constant(np.ones(3), name="a")
+    b = sd.math.exp(a, name="e")
+    b.rename("expA")
+    assert "expA" in sd.summary()
+    np.testing.assert_allclose(sd.getVariable("expA").eval().toNumpy(),
+                               np.e * np.ones(3), rtol=1e-7)
+
+
+def test_multi_output_unstack():
+    sd = SameDiff.create()
+    x = sd.constant(np.arange(6.0).reshape(3, 2))
+    rows = sd.math.unstack(x, 0, 3)
+    assert len(rows) == 3
+    np.testing.assert_allclose(rows[1].eval().toNumpy(), np.array([2.0, 3.0]))
+
+
+def test_gradient_accessor():
+    sd = SameDiff.create()
+    w = sd.var("w", np.array([2.0]))
+    loss = sd.math.sum(sd.math.square(w), name="loss")
+    sd.setLossVariables("loss")
+    g = sd.grad("w").eval()
+    np.testing.assert_allclose(g.toNumpy(), np.array([4.0]))
+
+
+def test_cnn_namespace_conv_and_pool():
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", jnp.float64, 1, 8, 8, 2)  # NHWC
+    w = sd.var("w", np.random.RandomState(0).rand(3, 3, 2, 4) * 0.1)  # HWIO
+    c = sd.cnn.conv2d(x, w, padding=((1, 1), (1, 1)), name="c")
+    p = sd.cnn.maxPooling2d(c, (2, 2), name="p")
+    out = sd.output({"x": np.random.RandomState(1).rand(1, 8, 8, 2)}, ["p"])
+    assert out["p"].shape() == (1, 4, 4, 4)
+
+
+def test_rnn_namespace_lstm():
+    sd = SameDiff.create()
+    T, B, F, H = 5, 2, 3, 4
+    rs = np.random.RandomState(0)
+    x = sd.placeHolder("x", jnp.float64, T, B, F)
+    w = sd.var("w", rs.rand(F, 4 * H) * 0.1)
+    u = sd.var("u", rs.rand(H, 4 * H) * 0.1)
+    b = sd.var("b", np.zeros(4 * H))
+    h_seq, h_last, c_last = sd.rnn.lstmLayer(x, w, u, b)
+    out = sd.output({"x": rs.rand(T, B, F)}, [h_seq])
+    assert out[h_seq.name].shape() == (T, B, H)
+
+
+def test_dropout_active_in_fit_identity_in_inference():
+    """Dropout must perturb the forward during fit() (train mode + rng
+    threaded by _run_graph) but be identity under output()."""
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", jnp.float64, 16, 8)
+    w = sd.var("w", np.ones((8, 1)))
+    d = sd.nn.dropout(sd.nn.linear(x, w), 0.5, name="d")
+    sd.loss.meanSquaredError(sd.constant(np.zeros((16, 1))), d, name="l")
+
+    xv = np.ones((16, 8))
+    # inference: identity
+    np.testing.assert_allclose(sd.output({"x": xv}, ["d"])["d"].toNumpy(),
+                               xv @ np.ones((8, 1)))
+    # training: two iterations with different rng keys give different losses
+    # than the dropout-free analytic loss of 64.0
+    sd.setTrainingConfig(TrainingConfig.Builder()
+                         .updater(Sgd(learningRate=0.0))
+                         .dataSetFeatureMapping("x")
+                         .dataSetLabelMapping("__unused__")
+                         .build())
+    hist = sd.fit(features=xv, labels=np.zeros((16, 1)), epochs=3)
+    assert any(abs(h - 64.0) > 1e-6 for h in hist), \
+        "dropout was a no-op during training"
