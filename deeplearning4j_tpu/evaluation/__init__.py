@@ -1,6 +1,13 @@
 """Evaluation metrics.
 
-Reference: org.nd4j.evaluation (Evaluation, RegressionEvaluation, ROC).
+Reference: org.nd4j.evaluation (Evaluation, RegressionEvaluation, ROC,
+ROCMultiClass, ROCBinary, EvaluationBinary).
 """
 
 from deeplearning4j_tpu.evaluation.evaluation import Evaluation
+from deeplearning4j_tpu.evaluation.regression import RegressionEvaluation
+from deeplearning4j_tpu.evaluation.roc import ROC, ROCMultiClass, ROCBinary
+from deeplearning4j_tpu.evaluation.binary import EvaluationBinary
+
+__all__ = ["Evaluation", "RegressionEvaluation", "ROC", "ROCMultiClass",
+           "ROCBinary", "EvaluationBinary"]

@@ -10,5 +10,7 @@ from deeplearning4j_tpu.ndarray.ndarray import INDArray
 from deeplearning4j_tpu.ndarray.factory import Nd4j
 from deeplearning4j_tpu.ndarray.indexing import NDArrayIndex
 from deeplearning4j_tpu.ndarray.executioner import XlaExecutioner
+from deeplearning4j_tpu.ndarray.transforms import Transforms
 
-__all__ = ["DataType", "INDArray", "Nd4j", "NDArrayIndex", "XlaExecutioner"]
+__all__ = ["DataType", "INDArray", "Nd4j", "NDArrayIndex", "XlaExecutioner",
+           "Transforms"]

@@ -1,0 +1,152 @@
+"""Transforms — elementwise transform op set as a static utility.
+
+Reference surface: org.nd4j.linalg.ops.transforms.Transforms (nd4j-api).
+In the reference each call dispatches a libnd4j TransformOp kernel; here
+each lowers to one jax.numpy/lax primitive that XLA fuses with its
+neighbours when traced under jit. All functions take INDArray (or anything
+array-like) and return a new INDArray; the reference's `dup=false` in-place
+variants are covered by the caller rebinding, since XLA buffers are
+immutable.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ndarray.ndarray import INDArray, _unwrap
+
+
+def _wrap1(fn):
+    def op(x, *args, **kwargs):
+        return INDArray(fn(jnp.asarray(_unwrap(x)), *args, **kwargs))
+    return op
+
+
+class Transforms:
+    # ----- exponential / log ------------------------------------------
+    exp = staticmethod(_wrap1(jnp.exp))
+    log = staticmethod(_wrap1(jnp.log))
+    log1p = staticmethod(_wrap1(jnp.log1p))
+    expm1 = staticmethod(_wrap1(jnp.expm1))
+    sqrt = staticmethod(_wrap1(jnp.sqrt))
+    cbrt = staticmethod(_wrap1(jnp.cbrt))
+    reciprocal = staticmethod(_wrap1(lambda a: 1.0 / a))
+
+    # ----- trig / hyperbolic ------------------------------------------
+    sin = staticmethod(_wrap1(jnp.sin))
+    cos = staticmethod(_wrap1(jnp.cos))
+    tan = staticmethod(_wrap1(jnp.tan))
+    asin = staticmethod(_wrap1(jnp.arcsin))
+    acos = staticmethod(_wrap1(jnp.arccos))
+    atan = staticmethod(_wrap1(jnp.arctan))
+    sinh = staticmethod(_wrap1(jnp.sinh))
+    cosh = staticmethod(_wrap1(jnp.cosh))
+    tanh = staticmethod(_wrap1(jnp.tanh))
+    atanh = staticmethod(_wrap1(jnp.arctanh))
+
+    # ----- sign / rounding / clipping ---------------------------------
+    abs = staticmethod(_wrap1(jnp.abs))
+    sign = staticmethod(_wrap1(jnp.sign))
+    floor = staticmethod(_wrap1(jnp.floor))
+    ceil = staticmethod(_wrap1(jnp.ceil))
+    round = staticmethod(_wrap1(jnp.round))
+
+    @staticmethod
+    def clip(x, minVal, maxVal) -> INDArray:
+        return INDArray(jnp.clip(jnp.asarray(_unwrap(x)), minVal, maxVal))
+
+    @staticmethod
+    def pow(x, power) -> INDArray:
+        return INDArray(jnp.power(jnp.asarray(_unwrap(x)), _unwrap(power)))
+
+    @staticmethod
+    def max(x, y) -> INDArray:
+        return INDArray(jnp.maximum(jnp.asarray(_unwrap(x)), _unwrap(y)))
+
+    @staticmethod
+    def min(x, y) -> INDArray:
+        return INDArray(jnp.minimum(jnp.asarray(_unwrap(x)), _unwrap(y)))
+
+    # ----- neural activations -----------------------------------------
+    sigmoid = staticmethod(_wrap1(jax.nn.sigmoid))
+    relu = staticmethod(_wrap1(jax.nn.relu))
+    relu6 = staticmethod(_wrap1(jax.nn.relu6))
+    elu = staticmethod(_wrap1(jax.nn.elu))
+    gelu = staticmethod(_wrap1(jax.nn.gelu))
+    softplus = staticmethod(_wrap1(jax.nn.softplus))
+    softsign = staticmethod(_wrap1(jax.nn.soft_sign))
+    mish = staticmethod(_wrap1(lambda a: a * jnp.tanh(jax.nn.softplus(a))))
+    swish = staticmethod(_wrap1(lambda a: a * jax.nn.sigmoid(a)))
+    hardTanh = staticmethod(_wrap1(lambda a: jnp.clip(a, -1.0, 1.0)))
+    # reference HardSigmoid is clip(0.2x + 0.5), not jax.nn's relu6(x+3)/6
+    hardSigmoid = staticmethod(_wrap1(lambda a: jnp.clip(0.2 * a + 0.5, 0.0, 1.0)))
+
+    @staticmethod
+    def leakyRelu(x, alpha=0.01) -> INDArray:
+        return INDArray(jax.nn.leaky_relu(jnp.asarray(_unwrap(x)), alpha))
+
+    @staticmethod
+    def softmax(x, dimension: int = -1) -> INDArray:
+        return INDArray(jax.nn.softmax(jnp.asarray(_unwrap(x)), axis=dimension))
+
+    @staticmethod
+    def logSoftmax(x, dimension: int = -1) -> INDArray:
+        return INDArray(jax.nn.log_softmax(jnp.asarray(_unwrap(x)), axis=dimension))
+
+    @staticmethod
+    def step(x) -> INDArray:  # heaviside, reference: Step
+        return INDArray((jnp.asarray(_unwrap(x)) > 0).astype(jnp.float32))
+
+    # ----- vector geometry --------------------------------------------
+    @staticmethod
+    def unitVec(x) -> INDArray:
+        a = jnp.asarray(_unwrap(x))
+        return INDArray(a / jnp.linalg.norm(a))
+
+    @staticmethod
+    def normalizeZeroMeanAndUnitVariance(x) -> INDArray:
+        a = jnp.asarray(_unwrap(x))
+        return INDArray((a - a.mean()) / jnp.maximum(a.std(), 1e-12))
+
+    @staticmethod
+    def euclideanDistance(x, y) -> float:
+        return float(jnp.linalg.norm(jnp.asarray(_unwrap(x)) - _unwrap(y)))
+
+    @staticmethod
+    def manhattanDistance(x, y) -> float:
+        return float(jnp.abs(jnp.asarray(_unwrap(x)) - _unwrap(y)).sum())
+
+    @staticmethod
+    def cosineSim(x, y) -> float:
+        a, b = jnp.asarray(_unwrap(x)).ravel(), jnp.asarray(_unwrap(y)).ravel()
+        denom = jnp.maximum(jnp.linalg.norm(a) * jnp.linalg.norm(b), 1e-12)
+        return float(jnp.dot(a, b) / denom)
+
+    @staticmethod
+    def cosineDistance(x, y) -> float:
+        return 1.0 - Transforms.cosineSim(x, y)
+
+    @staticmethod
+    def hammingDistance(x, y) -> float:
+        a, b = jnp.asarray(_unwrap(x)), jnp.asarray(_unwrap(y))
+        return float(jnp.mean((a != b).astype(jnp.float32)))
+
+    @staticmethod
+    def jaccardDistance(x, y) -> float:
+        a, b = jnp.asarray(_unwrap(x)), jnp.asarray(_unwrap(y))
+        inter = jnp.minimum(a, b).sum()
+        union = jnp.maximum(a, b).sum()
+        return float(1.0 - inter / jnp.maximum(union, 1e-12))
+
+    # ----- comparisons (reference: Transforms.and/or/xor/not) ---------
+    @staticmethod
+    def isMax(x, dimension: int = None) -> INDArray:
+        # one-hot of argmax (first max on ties), matching the reference IsMax op
+        a = jnp.asarray(_unwrap(x))
+        if dimension is None:
+            flat = jnp.zeros(a.size, a.dtype).at[jnp.argmax(a.ravel())].set(1)
+            return INDArray(flat.reshape(a.shape))
+        idx = jnp.argmax(a, axis=dimension, keepdims=True)
+        iota = jax.lax.broadcasted_iota(idx.dtype, a.shape, dimension % a.ndim)
+        return INDArray((iota == idx).astype(a.dtype))

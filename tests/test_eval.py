@@ -1,0 +1,205 @@
+"""Evaluation metric tests vs hand-computed / numpy oracles.
+
+Mirrors the reference's nd4j evaluation unit tests
+(org.nd4j.evaluation.*Test): known small inputs with closed-form metric
+values, plus streaming (multi-batch) == single-batch equivalence.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.evaluation import (
+    Evaluation, RegressionEvaluation, ROC, ROCMultiClass, ROCBinary,
+    EvaluationBinary,
+)
+
+
+# ---------------------------------------------------------------- regression
+class TestRegressionEvaluation:
+    def test_known_values(self):
+        y = np.array([[1.0], [2.0], [3.0], [4.0]])
+        p = np.array([[1.5], [2.5], [2.5], [4.0]])
+        e = RegressionEvaluation().eval(y, p)
+        err = p - y
+        assert e.meanSquaredError(0) == pytest.approx(np.mean(err ** 2))
+        assert e.meanAbsoluteError(0) == pytest.approx(np.mean(np.abs(err)))
+        assert e.rootMeanSquaredError(0) == pytest.approx(np.sqrt(np.mean(err ** 2)))
+
+    def test_r2_and_correlation_vs_numpy(self):
+        rng = np.random.RandomState(7)
+        y = rng.randn(200, 3)
+        p = y + 0.3 * rng.randn(200, 3)
+        e = RegressionEvaluation(nColumns=3).eval(y, p)
+        for c in range(3):
+            ss_res = np.sum((p[:, c] - y[:, c]) ** 2)
+            ss_tot = np.sum((y[:, c] - y[:, c].mean()) ** 2)
+            assert e.rSquared(c) == pytest.approx(1 - ss_res / ss_tot, abs=1e-9)
+            assert e.pearsonCorrelation(c) == pytest.approx(
+                np.corrcoef(y[:, c], p[:, c])[0, 1], abs=1e-9)
+
+    def test_streaming_equals_single_batch(self):
+        rng = np.random.RandomState(1)
+        y, p = rng.randn(100, 2), rng.randn(100, 2)
+        single = RegressionEvaluation().eval(y, p)
+        stream = RegressionEvaluation()
+        for i in range(0, 100, 17):
+            stream.eval(y[i:i + 17], p[i:i + 17])
+        for c in range(2):
+            assert stream.meanSquaredError(c) == pytest.approx(single.meanSquaredError(c))
+            assert stream.pearsonCorrelation(c) == pytest.approx(single.pearsonCorrelation(c))
+
+    def test_stats_renders(self):
+        e = RegressionEvaluation(columnNames=["a", "b"])
+        e.eval(np.ones((4, 2)), np.zeros((4, 2)))
+        assert "a" in e.stats() and "MSE" in e.stats()
+
+
+# ---------------------------------------------------------------------- ROC
+def _auc_oracle(y, s):
+    """O(n^2) rank-based AUROC oracle (probability a random positive scores
+    above a random negative, ties count half)."""
+    pos, neg = s[y == 1], s[y == 0]
+    wins = (pos[:, None] > neg[None, :]).sum() + 0.5 * (pos[:, None] == neg[None, :]).sum()
+    return wins / (len(pos) * len(neg))
+
+
+class TestROC:
+    def test_perfect_separation(self):
+        y = np.array([0, 0, 1, 1])
+        s = np.array([0.1, 0.2, 0.8, 0.9])
+        assert ROC().eval(y, s).calculateAUC() == pytest.approx(1.0)
+        assert ROC().eval(1 - y, s).calculateAUC() == pytest.approx(0.0)
+
+    def test_exact_auc_vs_rank_oracle(self):
+        rng = np.random.RandomState(3)
+        y = (rng.rand(300) > 0.6).astype(np.int64)
+        s = np.clip(0.35 * rng.randn(300) + 0.5 * y + 0.25, 0, 1)
+        roc = ROC().eval(y, s)
+        assert roc.calculateAUC() == pytest.approx(_auc_oracle(y, s), abs=1e-9)
+
+    def test_thresholded_close_to_exact(self):
+        rng = np.random.RandomState(4)
+        y = (rng.rand(500) > 0.5).astype(np.int64)
+        s = np.clip(0.3 * rng.randn(500) + 0.4 * y + 0.3, 0, 1)
+        exact = ROC().eval(y, s).calculateAUC()
+        binned = ROC(thresholdSteps=200).eval(y, s).calculateAUC()
+        assert binned == pytest.approx(exact, abs=0.01)
+
+    def test_one_hot_two_column_labels(self):
+        y1 = np.array([0, 1, 1, 0])
+        y2 = np.eye(2)[y1]
+        s = np.array([0.2, 0.7, 0.6, 0.4])
+        s2 = np.stack([1 - s, s], axis=1)
+        assert ROC().eval(y1, s).calculateAUC() == pytest.approx(
+            ROC().eval(y2, s2).calculateAUC())
+
+    def test_streaming(self):
+        rng = np.random.RandomState(5)
+        y = (rng.rand(200) > 0.5).astype(np.int64)
+        s = rng.rand(200)
+        single = ROC().eval(y, s).calculateAUC()
+        stream = ROC()
+        for i in range(0, 200, 33):
+            stream.eval(y[i:i + 33], s[i:i + 33])
+        assert stream.calculateAUC() == pytest.approx(single)
+
+    def test_aucpr_bounds(self):
+        y = np.array([0, 0, 1, 1])
+        s = np.array([0.1, 0.2, 0.8, 0.9])
+        assert ROC().eval(y, s).calculateAUCPR() == pytest.approx(1.0)
+
+
+class TestROCMultiClass:
+    def test_matches_binary_one_vs_all(self):
+        rng = np.random.RandomState(6)
+        n, c = 300, 4
+        cls = rng.randint(0, c, n)
+        y = np.eye(c)[cls]
+        logits = rng.randn(n, c) + 2.0 * y
+        p = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        m = ROCMultiClass().eval(y, p)
+        for k in range(c):
+            oracle = _auc_oracle((cls == k).astype(np.int64), p[:, k])
+            assert m.calculateAUC(k) == pytest.approx(oracle, abs=1e-9)
+        assert 0.5 < m.calculateAverageAUC() <= 1.0
+
+
+class TestROCBinary:
+    def test_per_column(self):
+        rng = np.random.RandomState(8)
+        y = (rng.rand(200, 3) > 0.5).astype(np.int64)
+        s = np.clip(rng.rand(200, 3) * 0.5 + 0.5 * y, 0, 1)
+        rb = ROCBinary().eval(y, s)
+        assert rb.numLabels() == 3
+        for c in range(3):
+            assert rb.calculateAUC(c) == pytest.approx(_auc_oracle(y[:, c], s[:, c]), abs=1e-9)
+
+
+# -------------------------------------------------------- EvaluationBinary
+class TestEvaluationBinary:
+    def test_counts_and_metrics(self):
+        y = np.array([[1, 0], [1, 1], [0, 0], [0, 1]])
+        p = np.array([[0.9, 0.2], [0.8, 0.4], [0.3, 0.1], [0.6, 0.9]])
+        e = EvaluationBinary().eval(y, p)
+        # column 0: pred=[1,1,0,1] act=[1,1,0,0] -> tp=2 fp=1 tn=1 fn=0
+        assert (e.truePositives(0), e.falsePositives(0),
+                e.trueNegatives(0), e.falseNegatives(0)) == (2, 1, 1, 0)
+        assert e.accuracy(0) == pytest.approx(0.75)
+        assert e.precision(0) == pytest.approx(2 / 3)
+        assert e.recall(0) == pytest.approx(1.0)
+        # column 1: pred=[0,0,0,1] act=[0,1,0,1] -> tp=1 fp=0 tn=2 fn=1
+        assert e.f1(1) == pytest.approx(2 * 1.0 * 0.5 / 1.5)
+
+    def test_custom_threshold(self):
+        y = np.array([[1], [0]])
+        p = np.array([[0.4], [0.2]])
+        assert EvaluationBinary(decisionThreshold=0.3).eval(y, p).accuracy(0) == 1.0
+        assert EvaluationBinary(decisionThreshold=0.5).eval(y, p).accuracy(0) == 0.5
+
+    def test_mcc_perfect(self):
+        y = np.array([[1], [1], [0], [0]])
+        p = np.array([[0.9], [0.8], [0.1], [0.2]])
+        assert EvaluationBinary().eval(y, p).matthewsCorrelation(0) == pytest.approx(1.0)
+
+
+# ------------------------------------------------- Evaluation (regression)
+class TestEvaluationExisting:
+    def test_eval_with_rnn_mask(self):
+        # [B=1, C=2, T=3], mask drops last step
+        y = np.zeros((1, 2, 3)); y[0, 0, :] = 1.0
+        p = np.zeros((1, 2, 3)); p[0, 0, :2] = 1.0; p[0, 1, 2] = 1.0
+        mask = np.array([[1.0, 1.0, 0.0]])
+        e = Evaluation().eval(y, p, mask)
+        assert e.accuracy() == pytest.approx(1.0)
+
+
+class TestReviewRegressions:
+    def test_binary_per_output_mask(self):
+        y = np.array([[1, 0], [0, 1], [1, 1]])
+        p = np.array([[0.9, 0.9], [0.1, 0.9], [0.9, 0.1]])
+        mask = np.array([[1, 0], [1, 1], [1, 1]])  # drop (0, col1)
+        e = EvaluationBinary().eval(y, p, mask)
+        assert e.truePositives(0) == 2 and e.trueNegatives(0) == 1
+        # col1 after mask: act=[1,1] pred=[1,0]
+        assert (e.truePositives(1), e.falseNegatives(1), e.falsePositives(1)) == (1, 1, 0)
+        rb = ROCBinary().eval(y.astype(float), p, mask)
+        assert rb.numLabels() == 2
+
+    def test_binary_ncols_mismatch_raises(self):
+        with pytest.raises(ValueError, match="outputs"):
+            EvaluationBinary(nOutputs=5).eval(np.ones((4, 3)), np.ones((4, 3)))
+
+    def test_ismax_tie_single_hot(self):
+        from deeplearning4j_tpu.ndarray import Transforms as T
+        from deeplearning4j_tpu import Nd4j
+        m = T.isMax(Nd4j.create([[2.0, 2.0]]), dimension=1)
+        np.testing.assert_allclose(m.toNumpy(), [[1, 0]])
+        g = T.isMax(Nd4j.create([[2.0, 2.0], [1.0, 2.0]]))
+        assert g.toNumpy().sum() == 1.0
+
+    def test_hardsigmoid_reference_formula(self):
+        from deeplearning4j_tpu.ndarray import Transforms as T
+        from deeplearning4j_tpu import Nd4j
+        x = np.array([-3.0, 0.0, 1.0, 3.0])
+        np.testing.assert_allclose(T.hardSigmoid(Nd4j.create(x)).toNumpy(),
+                                   np.clip(0.2 * x + 0.5, 0, 1), rtol=1e-6)

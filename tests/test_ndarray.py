@@ -250,3 +250,55 @@ class TestSort:
         a = Nd4j.create([3.0, 1.0, 2.0])
         np.testing.assert_allclose(Nd4j.sort(a).toNumpy(), [1, 2, 3])
         np.testing.assert_allclose(Nd4j.sort(a, ascending=False).toNumpy(), [3, 2, 1])
+
+
+class TestTransforms:
+    """Reference: org.nd4j.linalg.ops.transforms.Transforms op tests."""
+
+    def test_elementwise_vs_numpy(self):
+        from deeplearning4j_tpu.ndarray import Transforms as T
+        x = np.abs(np.random.RandomState(0).randn(3, 4)) + 0.1
+        a = Nd4j.create(x)
+        for name, oracle in [("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt),
+                             ("abs", np.abs), ("tanh", np.tanh), ("sin", np.sin),
+                             ("floor", np.floor), ("sign", np.sign)]:
+            np.testing.assert_allclose(getattr(T, name)(a).toNumpy(), oracle(x),
+                                       rtol=1e-6, err_msg=name)
+
+    def test_activations(self):
+        from deeplearning4j_tpu.ndarray import Transforms as T
+        x = np.array([-2.0, -0.5, 0.0, 0.5, 2.0])
+        a = Nd4j.create(x)
+        np.testing.assert_allclose(T.sigmoid(a).toNumpy(), 1 / (1 + np.exp(-x)), rtol=1e-6)
+        np.testing.assert_allclose(T.relu(a).toNumpy(), np.maximum(x, 0))
+        np.testing.assert_allclose(T.leakyRelu(a, 0.1).toNumpy(),
+                                   np.where(x > 0, x, 0.1 * x), rtol=1e-6)
+        np.testing.assert_allclose(T.hardTanh(a).toNumpy(), np.clip(x, -1, 1))
+
+    def test_softmax_rows_sum_to_one(self):
+        from deeplearning4j_tpu.ndarray import Transforms as T
+        a = Nd4j.randn(4, 7)
+        s = T.softmax(a)
+        np.testing.assert_allclose(s.toNumpy().sum(-1), np.ones(4), rtol=1e-6)
+        np.testing.assert_allclose(np.exp(T.logSoftmax(a).toNumpy()), s.toNumpy(), rtol=1e-5)
+
+    def test_distances(self):
+        from deeplearning4j_tpu.ndarray import Transforms as T
+        x = Nd4j.create([1.0, 0.0]); y = Nd4j.create([0.0, 1.0])
+        assert T.euclideanDistance(x, y) == pytest.approx(np.sqrt(2), rel=1e-6)
+        assert T.manhattanDistance(x, y) == pytest.approx(2.0)
+        assert T.cosineSim(x, y) == pytest.approx(0.0, abs=1e-6)
+        assert T.cosineSim(x, x) == pytest.approx(1.0, rel=1e-6)
+
+    def test_unitvec_ismax(self):
+        from deeplearning4j_tpu.ndarray import Transforms as T
+        v = T.unitVec(Nd4j.create([3.0, 4.0]))
+        np.testing.assert_allclose(v.toNumpy(), [0.6, 0.8], rtol=1e-6)
+        m = T.isMax(Nd4j.create([[1.0, 3.0], [5.0, 2.0]]), dimension=1)
+        np.testing.assert_allclose(m.toNumpy(), [[0, 1], [1, 0]])
+
+    def test_pow_clip(self):
+        from deeplearning4j_tpu.ndarray import Transforms as T
+        a = Nd4j.create([1.0, 2.0, 3.0])
+        np.testing.assert_allclose(T.pow(a, 2).toNumpy(), [1, 4, 9])
+        np.testing.assert_allclose(T.clip(a, 1.5, 2.5).toNumpy(), [1.5, 2.0, 2.5])
