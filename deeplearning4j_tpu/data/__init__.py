@@ -1,6 +1,6 @@
-"""Datasets, iterators and normalizers.
+"""Datasets, iterators, normalizers and record readers.
 
-Reference: org.nd4j.linalg.dataset + deeplearning4j-datasets.
+Reference: org.nd4j.linalg.dataset + deeplearning4j-datasets + datavec.
 """
 
 from deeplearning4j_tpu.data.dataset import (
@@ -8,3 +8,27 @@ from deeplearning4j_tpu.data.dataset import (
     SplitTestAndTrain,
 )
 from deeplearning4j_tpu.data.multidataset import MultiDataSet, MultiDataSetIterator
+from deeplearning4j_tpu.data.normalizers import (
+    DataNormalization, NormalizerStandardize, NormalizerMinMaxScaler,
+    ImagePreProcessingScaler, VGG16ImagePreProcessor,
+)
+from deeplearning4j_tpu.data.iterators import (
+    IrisDataSetIterator, MnistDataSetIterator, Cifar10DataSetIterator,
+    CifarDataSetIterator, RandomDataSetIterator,
+)
+from deeplearning4j_tpu.data.records import (
+    RecordReader, CSVRecordReader, CollectionRecordReader, ImageRecordReader,
+    Schema, TransformProcess, RecordReaderDataSetIterator,
+)
+
+__all__ = [
+    "DataSet", "DataSetIterator", "ListDataSetIterator",
+    "ExistingDataSetIterator", "SplitTestAndTrain", "MultiDataSet",
+    "MultiDataSetIterator", "DataNormalization", "NormalizerStandardize",
+    "NormalizerMinMaxScaler", "ImagePreProcessingScaler",
+    "VGG16ImagePreProcessor", "IrisDataSetIterator", "MnistDataSetIterator",
+    "Cifar10DataSetIterator", "CifarDataSetIterator", "RandomDataSetIterator",
+    "RecordReader", "CSVRecordReader", "CollectionRecordReader",
+    "ImageRecordReader", "Schema", "TransformProcess",
+    "RecordReaderDataSetIterator",
+]

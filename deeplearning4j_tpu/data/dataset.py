@@ -144,6 +144,15 @@ class DataSetIterator:
         while self.hasNext():
             yield self.next()
 
+    def _raw_batches(self):
+        """Yield (features, labels) numpy batches with NO padding and NO
+        preprocessor — the view statistics-fitting code must see (used by
+        DataNormalization.fit so padded duplicate rows and an already-set
+        preprocessor can't bias the stats)."""
+        for i in range(0, len(self._f), self._batch):
+            idx = self._order[i:i + self._batch]
+            yield self._f[idx], self._l[idx]
+
     def batch(self) -> int:
         return self._batch
 

@@ -1,0 +1,335 @@
+"""Record readers and transform pipelines (the DataVec layer).
+
+Reference: datavec-api (CSVRecordReader, CollectionRecordReader,
+ImageRecordReader, Schema, TransformProcess) and
+deeplearning4j-datavec-iterators (RecordReaderDataSetIterator). ETL runs on
+host in numpy — the TPU sees only the final fixed-shape float batches.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+
+# ----------------------------------------------------------- record readers
+class RecordReader:
+    def hasNext(self) -> bool:
+        raise NotImplementedError
+
+    def next(self) -> list:
+        raise NotImplementedError
+
+    def reset(self):
+        raise NotImplementedError
+
+    def __iter__(self):
+        self.reset()
+        while self.hasNext():
+            yield self.next()
+
+
+class CollectionRecordReader(RecordReader):
+    """In-memory records (reference: CollectionRecordReader)."""
+
+    def __init__(self, records):
+        self._records = [list(r) for r in records]
+        self._i = 0
+
+    def hasNext(self):
+        return self._i < len(self._records)
+
+    def next(self):
+        r = self._records[self._i]
+        self._i += 1
+        return r
+
+    def reset(self):
+        self._i = 0
+
+
+class CSVRecordReader(RecordReader):
+    """Line-per-record CSV (reference: CSVRecordReader). Values come back as
+    parsed floats where possible, else strings."""
+
+    def __init__(self, skipNumLines: int = 0, delimiter: str = ","):
+        self._skip = skipNumLines
+        self._delim = delimiter
+        self._lines = None
+        self._i = 0
+
+    def initialize(self, path):
+        text = Path(path).read_text()
+        lines = [ln for ln in text.splitlines() if ln.strip()]
+        self._lines = lines[self._skip:]
+        self._i = 0
+        return self
+
+    @staticmethod
+    def _parse(tok: str):
+        tok = tok.strip()
+        try:
+            return float(tok) if ("." in tok or "e" in tok.lower()) else int(tok)
+        except ValueError:
+            return tok
+
+    def hasNext(self):
+        return self._lines is not None and self._i < len(self._lines)
+
+    def next(self):
+        vals = [self._parse(t) for t in self._lines[self._i].split(self._delim)]
+        self._i += 1
+        return vals
+
+    def reset(self):
+        self._i = 0
+
+
+class ImageRecordReader(RecordReader):
+    """Images from a labelled directory tree (reference: ImageRecordReader
+    with ParentPathLabelGenerator): ``root/<label>/<file>.png`` ->
+    record ``[CHW float array, labelIndex]``."""
+
+    EXTS = {".png", ".jpg", ".jpeg", ".bmp", ".gif"}
+
+    def __init__(self, height: int, width: int, channels: int = 3):
+        self._h, self._w, self._c = height, width, channels
+        self._files = []
+        self._labels = []
+        self._i = 0
+
+    def initialize(self, root):
+        root = Path(root)
+        classes = sorted(d.name for d in root.iterdir() if d.is_dir())
+        self._label_names = classes
+        self._files = []
+        for ci, cname in enumerate(classes):
+            for f in sorted((root / cname).iterdir()):
+                if f.suffix.lower() in self.EXTS:
+                    self._files.append((f, ci))
+        self._i = 0
+        return self
+
+    def getLabels(self):
+        return list(self._label_names)
+
+    def numLabels(self) -> int:
+        return len(self._label_names)
+
+    def hasNext(self):
+        return self._i < len(self._files)
+
+    def next(self):
+        from PIL import Image
+
+        path, label = self._files[self._i]
+        self._i += 1
+        img = Image.open(path)
+        img = img.convert("L" if self._c == 1 else "RGB")
+        img = img.resize((self._w, self._h))
+        a = np.asarray(img, np.float32)
+        a = a[None, :, :] if self._c == 1 else a.transpose(2, 0, 1)  # CHW
+        return [a, label]
+
+    def reset(self):
+        self._i = 0
+
+
+# ------------------------------------------------------ schema + transforms
+class Schema:
+    """Column schema (reference: org.datavec.api.transform.schema.Schema)."""
+
+    class Builder:
+        def __init__(self):
+            self._cols = []  # (name, kind, meta)
+
+        def addColumnDouble(self, name):
+            self._cols.append((name, "double", None))
+            return self
+
+        def addColumnsDouble(self, *names):
+            for n in names:
+                self.addColumnDouble(n)
+            return self
+
+        def addColumnInteger(self, name):
+            self._cols.append((name, "integer", None))
+            return self
+
+        def addColumnCategorical(self, name, *stateNames):
+            if len(stateNames) == 1 and isinstance(stateNames[0], (list, tuple)):
+                stateNames = tuple(stateNames[0])
+            self._cols.append((name, "categorical", list(stateNames)))
+            return self
+
+        def addColumnString(self, name):
+            self._cols.append((name, "string", None))
+            return self
+
+        def build(self):
+            return Schema(self._cols)
+
+    def __init__(self, cols):
+        self._cols = list(cols)
+
+    def getColumnNames(self):
+        return [c[0] for c in self._cols]
+
+    def getIndexOfColumn(self, name) -> int:
+        return self.getColumnNames().index(name)
+
+    def getType(self, name) -> str:
+        return self._cols[self.getIndexOfColumn(name)][1]
+
+    def getMeta(self, name):
+        return self._cols[self.getIndexOfColumn(name)][2]
+
+    def numColumns(self) -> int:
+        return len(self._cols)
+
+
+class TransformProcess:
+    """Declarative record transform pipeline (reference:
+    org.datavec.api.transform.TransformProcess). Each step maps
+    (schema, records) -> (schema, records); ``execute`` applies the chain."""
+
+    class Builder:
+        def __init__(self, schema: Schema):
+            self._initial = schema
+            self._steps = []
+
+        def removeColumns(self, *names):
+            def step(schema, recs):
+                drop = {schema.getIndexOfColumn(n) for n in names}
+                keep = [i for i in range(schema.numColumns()) if i not in drop]
+                new = Schema([schema._cols[i] for i in keep])
+                return new, [[r[i] for i in keep] for r in recs]
+            self._steps.append(step)
+            return self
+
+        def renameColumn(self, old, new):
+            def step(schema, recs):
+                cols = [(new if n == old else n, k, m) for n, k, m in schema._cols]
+                return Schema(cols), recs
+            self._steps.append(step)
+            return self
+
+        def categoricalToInteger(self, *names):
+            def step(schema, recs):
+                cols = list(schema._cols)
+                for n in names:
+                    i = schema.getIndexOfColumn(n)
+                    states = schema.getMeta(n)
+                    for r in recs:
+                        r[i] = states.index(r[i])
+                    cols[i] = (n, "integer", None)
+                return Schema(cols), recs
+            self._steps.append(step)
+            return self
+
+        def categoricalToOneHot(self, *names):
+            def step(schema, recs):
+                for n in names:
+                    i = schema.getIndexOfColumn(n)
+                    states = schema.getMeta(n)
+                    cols = list(schema._cols)
+                    onehot_cols = [(f"{n}[{s}]", "integer", None) for s in states]
+                    cols[i:i + 1] = onehot_cols
+                    for r in recs:
+                        if r[i] not in states:  # consistent with ToInteger
+                            raise ValueError(f"categoricalToOneHot: value "
+                                             f"{r[i]!r} not in states {states}")
+                        vec = [1 if r[i] == s else 0 for s in states]
+                        r[i:i + 1] = vec
+                    schema = Schema(cols)
+                return schema, recs
+            self._steps.append(step)
+            return self
+
+        def doubleMathOp(self, name, op: str, value: float):
+            import operator
+
+            fn = {"Add": operator.add, "Subtract": operator.sub,
+                  "Multiply": operator.mul, "Divide": operator.truediv}[op]
+
+            def step(schema, recs):
+                i = schema.getIndexOfColumn(name)
+                for r in recs:
+                    r[i] = fn(float(r[i]), value)
+                return schema, recs
+            self._steps.append(step)
+            return self
+
+        def filter(self, predicate):
+            """Keep records where predicate(record_dict) is False (the
+            reference's Filter removes matching examples)."""
+            def step(schema, recs):
+                names = schema.getColumnNames()
+                kept = [r for r in recs
+                        if not predicate(dict(zip(names, r)))]
+                return schema, kept
+            self._steps.append(step)
+            return self
+
+        def build(self):
+            return TransformProcess(self._initial, self._steps)
+
+    def __init__(self, initial, steps):
+        self._initial = initial
+        self._steps = steps
+
+    def getInitialSchema(self) -> Schema:
+        return self._initial
+
+    def getFinalSchema(self) -> Schema:
+        schema = self._initial
+        for s in self._steps:
+            schema, _ = s(schema, [])
+        return schema
+
+    def execute(self, records) -> list:
+        schema = self._initial
+        recs = [list(r) for r in records]
+        for s in self._steps:
+            schema, recs = s(schema, recs)
+        return recs
+
+
+# ----------------------------------------------- reader -> DataSet iterator
+class RecordReaderDataSetIterator:
+    """Reference: org.deeplearning4j.datasets.datavec.RecordReaderDataSetIterator.
+    Materialises the reader once, then behaves as a standard fixed-shape
+    batch iterator (classification one-hot or regression labels)."""
+
+    def __init__(self, recordReader: RecordReader, batchSize: int,
+                 labelIndex: int = -1, numPossibleLabels: int = None,
+                 regression: bool = False, shuffle=False, seed=123):
+        feats, labels = [], []
+        recordReader.reset()
+        image_mode = isinstance(recordReader, ImageRecordReader)
+        while recordReader.hasNext():
+            rec = recordReader.next()
+            if image_mode:
+                feats.append(rec[0])
+                labels.append(rec[1])
+            else:
+                li = labelIndex if labelIndex >= 0 else len(rec) - 1
+                labels.append(rec[li])
+                feats.append([float(v) for j, v in enumerate(rec) if j != li])
+        f = np.asarray(feats, np.float32)
+        if regression:
+            l = np.asarray(labels, np.float32).reshape(len(labels), -1)
+        else:
+            n_cls = numPossibleLabels or (recordReader.numLabels() if image_mode
+                                          else int(max(labels)) + 1)
+            l = np.eye(n_cls, dtype=np.float32)[np.asarray(labels, np.int64)]
+        from deeplearning4j_tpu.data.dataset import DataSetIterator
+
+        self._it = DataSetIterator(f, l, batchSize, shuffle=shuffle, seed=seed)
+
+    def __getattr__(self, name):  # delegate iterator protocol
+        return getattr(self._it, name)
+
+    def __iter__(self):
+        return iter(self._it)

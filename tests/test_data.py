@@ -1,0 +1,306 @@
+"""Data pipeline tests: normalizers, built-in iterators, record readers,
+transform pipelines.
+
+Mirrors the reference's nd4j-dataset / datavec tests
+(NormalizerStandardizeTest, CSVRecordReaderTest, TransformProcessTest...).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import (
+    DataSet, DataSetIterator, NormalizerStandardize, NormalizerMinMaxScaler,
+    ImagePreProcessingScaler, VGG16ImagePreProcessor, IrisDataSetIterator,
+    MnistDataSetIterator, Cifar10DataSetIterator, CSVRecordReader,
+    CollectionRecordReader, Schema, TransformProcess,
+    RecordReaderDataSetIterator,
+)
+
+
+# ------------------------------------------------------------- normalizers
+class TestNormalizerStandardize:
+    def test_zero_mean_unit_var(self):
+        rng = np.random.RandomState(0)
+        f = rng.randn(200, 5) * np.array([1, 2, 3, 4, 5.0]) + np.arange(5)
+        ds = DataSet(f.astype(np.float32), np.zeros((200, 2), np.float32))
+        n = NormalizerStandardize().fit(ds)
+        n.preProcess(ds)
+        out = ds.getFeatures().toNumpy()
+        np.testing.assert_allclose(out.mean(0), 0, atol=1e-4)
+        np.testing.assert_allclose(out.std(0), 1, atol=1e-3)
+
+    def test_streaming_fit_equals_full_fit(self):
+        rng = np.random.RandomState(1)
+        f = rng.randn(120, 3).astype(np.float32) * 4 + 7
+        l = np.zeros((120, 2), np.float32)
+        full = NormalizerStandardize().fit(DataSet(f, l))
+        it = DataSetIterator(f, l, 32, pad_final=False)
+        stream = NormalizerStandardize().fit(it)
+        np.testing.assert_allclose(stream._mean, full._mean, rtol=1e-6)
+        np.testing.assert_allclose(stream._std, full._std, rtol=1e-5)
+
+    def test_revert_round_trip(self):
+        rng = np.random.RandomState(2)
+        f = (rng.randn(50, 4) * 3 + 1).astype(np.float32)
+        ds = DataSet(f.copy(), np.zeros((50, 2), np.float32))
+        n = NormalizerStandardize().fit(ds)
+        n.preProcess(ds)
+        back = n.revertFeatures(ds.getFeatures()).toNumpy()
+        np.testing.assert_allclose(back, f, atol=1e-4)
+
+    def test_cnn_4d_per_channel(self):
+        rng = np.random.RandomState(3)
+        f = rng.rand(20, 3, 8, 8).astype(np.float32) * np.array([1, 10, 100]).reshape(1, 3, 1, 1)
+        ds = DataSet(f, np.zeros((20, 2), np.float32))
+        n = NormalizerStandardize().fit(ds)
+        n.preProcess(ds)
+        out = ds.getFeatures().toNumpy()
+        np.testing.assert_allclose(out.mean((0, 2, 3)), 0, atol=1e-4)
+        np.testing.assert_allclose(out.std((0, 2, 3)), 1, atol=1e-3)
+
+    def test_fit_label(self):
+        rng = np.random.RandomState(4)
+        f = rng.randn(60, 2).astype(np.float32)
+        l = (rng.randn(60, 1) * 9 + 5).astype(np.float32)
+        ds = DataSet(f, l)
+        n = NormalizerStandardize().fitLabel(True).fit(ds)
+        n.preProcess(ds)
+        np.testing.assert_allclose(ds.getLabels().toNumpy().mean(), 0, atol=1e-4)
+
+    def test_save_load(self, tmp_path):
+        rng = np.random.RandomState(5)
+        ds = DataSet(rng.randn(30, 3).astype(np.float32), np.zeros((30, 1), np.float32))
+        n = NormalizerStandardize().fit(ds)
+        p = str(tmp_path / "norm.npz")
+        n.save(p)
+        n2 = NormalizerStandardize.load(p)
+        np.testing.assert_allclose(n2._mean, n._mean)
+        np.testing.assert_allclose(n2._std, n._std)
+
+
+class TestMinMaxAndImageScalers:
+    def test_minmax_range(self):
+        rng = np.random.RandomState(6)
+        f = (rng.randn(100, 4) * 5).astype(np.float32)
+        ds = DataSet(f, np.zeros((100, 1), np.float32))
+        n = NormalizerMinMaxScaler(-1.0, 1.0).fit(ds)
+        n.preProcess(ds)
+        out = ds.getFeatures().toNumpy()
+        np.testing.assert_allclose(out.min(0), -1, atol=1e-5)
+        np.testing.assert_allclose(out.max(0), 1, atol=1e-5)
+        back = n.revertFeatures(ds.getFeatures()).toNumpy()
+        np.testing.assert_allclose(back, f, atol=1e-3)
+
+    def test_image_scaler(self):
+        f = np.array([[0.0, 127.5, 255.0]], np.float32)
+        ds = DataSet(f, None)
+        ImagePreProcessingScaler().fit(ds).preProcess(ds)
+        np.testing.assert_allclose(ds.getFeatures().toNumpy(), [[0, 0.5, 1.0]], atol=1e-5)
+
+    def test_vgg_preprocessor(self):
+        f = np.zeros((2, 3, 4, 4), np.float32)
+        ds = DataSet(f, None)
+        VGG16ImagePreProcessor().preProcess(ds)
+        out = ds.getFeatures().toNumpy()
+        np.testing.assert_allclose(out[0, :, 0, 0], -VGG16ImagePreProcessor.MEANS)
+
+
+# --------------------------------------------------------------- iterators
+class TestBuiltinIterators:
+    def test_iris(self):
+        it = IrisDataSetIterator(batchSize=50)
+        ds = it.next()
+        assert ds.getFeatures().shape() == (50, 4)
+        assert ds.getLabels().shape() == (50, 3)
+        assert it.totalExamples() == 150
+
+    def test_mnist_shapes(self):
+        it = MnistDataSetIterator(batchSize=32, train=True, numExamples=200)
+        ds = it.next()
+        assert ds.getFeatures().shape() == (32, 784)
+        assert ds.getLabels().shape() == (32, 10)
+        f = ds.getFeatures().toNumpy()
+        assert 0.0 <= f.min() and f.max() <= 1.0
+
+    def test_mnist_cnn_shape(self):
+        it = MnistDataSetIterator(batchSize=16, numExamples=64, reshapeToCnn=True)
+        assert it.next().getFeatures().shape() == (16, 1, 28, 28)
+
+    def test_cifar_shapes(self):
+        it = Cifar10DataSetIterator(batchSize=8, numExamples=64)
+        ds = it.next()
+        assert ds.getFeatures().shape() == (8, 3, 32, 32)
+        assert ds.getLabels().shape() == (8, 10)
+
+    def test_mnist_deterministic(self):
+        a = MnistDataSetIterator(batchSize=16, numExamples=32, shuffle=False, seed=7)
+        b = MnistDataSetIterator(batchSize=16, numExamples=32, shuffle=False, seed=7)
+        np.testing.assert_array_equal(a.next().getFeatures().toNumpy(),
+                                      b.next().getFeatures().toNumpy())
+
+    def test_mnist_is_learnable(self):
+        """Synthetic-or-real, a linear probe must beat chance easily —
+        guards the synthetic generator's class-conditional structure."""
+        it = MnistDataSetIterator(batchSize=512, numExamples=512, shuffle=False)
+        ds = it.next()
+        f = ds.getFeatures().toNumpy()
+        y = ds.getLabels().toNumpy().argmax(-1)
+        w = np.linalg.lstsq(np.c_[f, np.ones(len(f))],
+                            np.eye(10)[y], rcond=None)[0]
+        acc = (np.c_[f, np.ones(len(f))].dot(w).argmax(-1) == y).mean()
+        assert acc > 0.5, f"linear probe acc {acc} barely above chance"
+
+
+# ----------------------------------------------------------------- records
+class TestRecordReaders:
+    def test_csv_reader(self, tmp_path):
+        p = tmp_path / "d.csv"
+        p.write_text("# header\n1.5,2,hello\n3.5,4,world\n")
+        rr = CSVRecordReader(skipNumLines=1).initialize(p)
+        assert rr.next() == [1.5, 2, "hello"]
+        assert rr.next() == [3.5, 4, "world"]
+        assert not rr.hasNext()
+        rr.reset()
+        assert rr.hasNext()
+
+    def test_reader_to_dataset_iterator_classification(self, tmp_path):
+        p = tmp_path / "d.csv"
+        rows = ["%f,%f,%d" % (i * 0.1, i * 0.2, i % 3) for i in range(30)]
+        p.write_text("\n".join(rows))
+        rr = CSVRecordReader().initialize(p)
+        it = RecordReaderDataSetIterator(rr, batchSize=10, labelIndex=2,
+                                         numPossibleLabels=3)
+        ds = it.next()
+        assert ds.getFeatures().shape() == (10, 2)
+        assert ds.getLabels().shape() == (10, 3)
+        np.testing.assert_allclose(ds.getLabels().toNumpy().sum(-1), 1.0)
+
+    def test_reader_regression(self):
+        rr = CollectionRecordReader([[1.0, 2.0, 10.0], [3.0, 4.0, 20.0]])
+        it = RecordReaderDataSetIterator(rr, batchSize=2, labelIndex=2,
+                                         regression=True)
+        ds = it.next()
+        np.testing.assert_allclose(ds.getLabels().toNumpy(), [[10.0], [20.0]])
+
+    def test_image_record_reader(self, tmp_path):
+        from PIL import Image
+        from deeplearning4j_tpu.data import ImageRecordReader
+
+        for cls, color in [("cats", (255, 0, 0)), ("dogs", (0, 0, 255))]:
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(3):
+                Image.new("RGB", (10, 12), color).save(d / f"{i}.png")
+        rr = ImageRecordReader(height=8, width=8, channels=3).initialize(tmp_path)
+        assert rr.getLabels() == ["cats", "dogs"]
+        rec = rr.next()
+        assert rec[0].shape == (3, 8, 8) and rec[1] == 0
+        it = RecordReaderDataSetIterator(rr, batchSize=6)
+        ds = it.next()
+        assert ds.getFeatures().shape() == (6, 3, 8, 8)
+        assert ds.getLabels().shape() == (6, 2)
+
+
+class TestTransformProcess:
+    def _schema(self):
+        return (Schema.Builder()
+                .addColumnsDouble("a", "b")
+                .addColumnCategorical("cat", "x", "y", "z")
+                .addColumnString("junk")
+                .build())
+
+    def test_remove_and_math(self):
+        tp = (TransformProcess.Builder(self._schema())
+              .removeColumns("junk")
+              .doubleMathOp("a", "Multiply", 2.0)
+              .categoricalToInteger("cat")
+              .build())
+        out = tp.execute([[1.0, 2.0, "y", "drop"], [3.0, 4.0, "z", "drop"]])
+        assert out == [[2.0, 2.0, 1], [6.0, 4.0, 2]]
+        assert tp.getFinalSchema().getColumnNames() == ["a", "b", "cat"]
+
+    def test_one_hot(self):
+        tp = (TransformProcess.Builder(self._schema())
+              .removeColumns("junk")
+              .categoricalToOneHot("cat")
+              .build())
+        out = tp.execute([[1.0, 2.0, "y"]])
+        assert out == [[1.0, 2.0, 0, 1, 0]]
+        assert tp.getFinalSchema().numColumns() == 5
+
+    def test_filter(self):
+        tp = (TransformProcess.Builder(self._schema())
+              .filter(lambda r: r["a"] > 2.0)
+              .build())
+        out = tp.execute([[1.0, 0.0, "x", ""], [5.0, 0.0, "x", ""]])
+        assert len(out) == 1 and out[0][0] == 1.0
+
+
+# -------------------------------------------- iterator + normalizer wiring
+class TestIteratorPreprocessorWiring:
+    def test_normalizer_as_preprocessor(self):
+        rng = np.random.RandomState(9)
+        f = (rng.randn(64, 3) * 10 + 4).astype(np.float32)
+        l = np.zeros((64, 2), np.float32)
+        it = DataSetIterator(f, l, 16)
+        n = NormalizerStandardize().fit(it)
+        it.setPreProcessor(n)
+        batch = it.next().getFeatures().toNumpy()
+        assert abs(batch.mean()) < 1.0  # roughly centered after transform
+
+
+class TestReviewRegressions:
+    def test_fit_ignores_padding_and_preprocessor(self):
+        rng = np.random.RandomState(10)
+        f = (rng.randn(20, 3) * 5 + 2).astype(np.float32)
+        l = np.zeros((20, 1), np.float32)
+        # batch 16 pads the final 4-row batch to 16 by repeating the last row
+        it = DataSetIterator(f, l, 16)  # pad_final defaults True
+        n = NormalizerStandardize().fit(it)
+        np.testing.assert_allclose(n._mean, f.mean(0), rtol=1e-5)
+        # re-fitting with the preprocessor installed must see RAW data
+        it.setPreProcessor(n)
+        n2 = NormalizerStandardize().fit(it)
+        np.testing.assert_allclose(n2._mean, f.mean(0), rtol=1e-5)
+
+    def test_synthetic_train_test_share_templates(self):
+        tr = MnistDataSetIterator(batchSize=256, numExamples=256, train=True,
+                                  shuffle=False, seed=3)
+        te = MnistDataSetIterator(batchSize=256, numExamples=256, train=False,
+                                  shuffle=False, seed=3)
+        if not tr.isSynthetic:
+            pytest.skip("real MNIST present")
+        dtr = tr._f, tr._l
+        dte = te._f, te._l
+        # linear probe trained on train split must transfer to test split
+        Xtr, Ytr = dtr[0].reshape(256, -1), dtr[1].argmax(-1)
+        Xte, Yte = dte[0].reshape(256, -1), dte[1].argmax(-1)
+        w = np.linalg.lstsq(np.c_[Xtr, np.ones(256)], np.eye(10)[Ytr], rcond=None)[0]
+        acc = (np.c_[Xte, np.ones(256)].dot(w).argmax(-1) == Yte).mean()
+        assert acc > 0.4, f"train->test transfer {acc}: splits use different templates"
+
+    def test_normalizer_promotes_uint8(self):
+        f = np.arange(12, dtype=np.uint8).reshape(4, 3)
+        ds = DataSet(f, np.zeros((4, 1), np.float32))
+        # DataSet wraps to device array; use raw numpy apply path instead
+        n = NormalizerStandardize().fit(DataSet(f.astype(np.float32), np.zeros((4, 1), np.float32)))
+        out = n._apply(f, label=False)
+        assert np.issubdtype(out.dtype, np.floating)
+        assert out.min() < 0  # negatives preserved, not wrapped
+
+    def test_random_iterator_lazy_and_deterministic(self):
+        from deeplearning4j_tpu.data import RandomDataSetIterator
+        it = RandomDataSetIterator(3, (4, 5), (4, 2), seed=9)
+        b1 = [it.next().getFeatures().toNumpy() for _ in range(3)]
+        assert not it.hasNext()
+        it.reset()
+        b2 = [it.next().getFeatures().toNumpy() for _ in range(3)]
+        for a, b in zip(b1, b2):
+            np.testing.assert_array_equal(a, b)
+        assert not np.array_equal(b1[0], b1[1])
+
+    def test_one_hot_unknown_state_raises(self):
+        sch = Schema.Builder().addColumnCategorical("c", "x", "y").build()
+        tp = TransformProcess.Builder(sch).categoricalToOneHot("c").build()
+        with pytest.raises(ValueError, match="not in states"):
+            tp.execute([["X"]])
