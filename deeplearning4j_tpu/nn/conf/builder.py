@@ -51,6 +51,19 @@ class MultiLayerConfiguration:
         # resolved per-layer input types (set during shape inference)
         self.layerInputTypes = []
 
+    def toJson(self) -> str:
+        """Config-only JSON round trip (reference:
+        MultiLayerConfiguration.toJson)."""
+        from deeplearning4j_tpu.util import serde
+
+        return serde.to_json(self)
+
+    @staticmethod
+    def fromJson(text: str) -> "MultiLayerConfiguration":
+        from deeplearning4j_tpu.util import serde
+
+        return serde.from_json(text, MultiLayerConfiguration)
+
     def inferShapes(self):
         """Propagate InputType through layers; auto-insert preprocessors.
 

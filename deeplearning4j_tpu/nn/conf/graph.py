@@ -227,6 +227,18 @@ class ComputationGraphConfiguration:
         self.topoOrder = self._topo_sort()
         self._infer_shapes()
 
+    def toJson(self) -> str:
+        """Reference: ComputationGraphConfiguration.toJson."""
+        from deeplearning4j_tpu.util import serde
+
+        return serde.to_json(self)
+
+    @staticmethod
+    def fromJson(text: str) -> "ComputationGraphConfiguration":
+        from deeplearning4j_tpu.util import serde
+
+        return serde.from_json(text, ComputationGraphConfiguration)
+
     def _topo_sort(self):
         order, seen, temp = [], set(), set()
 

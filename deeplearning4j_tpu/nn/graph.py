@@ -59,6 +59,24 @@ class ComputationGraph:
         self._updaters, self._upd_states = upds, upd_states
         return self
 
+    def initFrom(self, params, states, upd_states=None):
+        """Initialize from existing state (ModelSerializer restore path) —
+        skips the random weight init that init() would immediately discard."""
+        self._params, self._states = params, states
+        self._updaters = {}
+        for name in self._layer_names:
+            payload = self.conf.nodes[name].payload
+            self._updaters[name] = (_upd.resolve(payload.updater)
+                                    if payload.updater is not None else _upd.Sgd())
+        if upd_states is not None:
+            self._upd_states = upd_states
+        else:
+            self._upd_states = {
+                name: (self._updaters[name].init(params[name])
+                       if params[name] else ())
+                for name in self._layer_names}
+        return self
+
     def _require_init(self):
         if self._params is None:
             raise RuntimeError("Call net.init() before fit/output/score")
@@ -325,6 +343,22 @@ class ComputationGraph:
 
     def getIterationCount(self):
         return self._iteration
+
+    def getEpochCount(self):
+        return self._epoch
+
+    def save(self, path, saveUpdater: bool = True):
+        """Reference: ComputationGraph.save(File, saveUpdater)."""
+        from deeplearning4j_tpu.util.serializer import ModelSerializer
+
+        ModelSerializer.writeModel(self, path, saveUpdater)
+        return self
+
+    @staticmethod
+    def load(path, loadUpdater: bool = True) -> "ComputationGraph":
+        from deeplearning4j_tpu.util.serializer import ModelSerializer
+
+        return ModelSerializer.restoreComputationGraph(path, loadUpdater)
 
     def summary(self) -> str:
         lines = [f"{'name':<24}{'type':<26}{'inputs':<30}{'params':<10}"]

@@ -138,6 +138,21 @@ class MultiLayerNetwork:
         self._iteration = 0
         return self
 
+    def initFrom(self, params, states, upd_states=None):
+        """Initialize from existing state (ModelSerializer restore path) —
+        skips the random weight init that init() would immediately discard."""
+        self._params, self._states = params, states
+        self._updaters = [
+            _upd.resolve(l.updater) if l.updater is not None else _upd.Sgd()
+            for l in self.layers]
+        if upd_states is not None:
+            self._upd_states = upd_states
+        else:
+            self._upd_states = [u.init(p) if p else ()
+                                for u, p in zip(self._updaters, params)]
+        self._iteration = 0
+        return self
+
     # ------------------------------------------------------------------
     # pure functions (traced under jit)
     # ------------------------------------------------------------------
@@ -456,6 +471,19 @@ class MultiLayerNetwork:
 
     def getEpochCount(self) -> int:
         return self._epoch
+
+    def save(self, path, saveUpdater: bool = True):
+        """Reference: MultiLayerNetwork.save(File, saveUpdater)."""
+        from deeplearning4j_tpu.util.serializer import ModelSerializer
+
+        ModelSerializer.writeModel(self, path, saveUpdater)
+        return self
+
+    @staticmethod
+    def load(path, loadUpdater: bool = True) -> "MultiLayerNetwork":
+        from deeplearning4j_tpu.util.serializer import ModelSerializer
+
+        return ModelSerializer.restoreMultiLayerNetwork(path, loadUpdater)
 
     def summary(self) -> str:
         lines = [f"{'idx':<4}{'type':<28}{'out shape':<24}{'params':<12}"]

@@ -1,0 +1,15 @@
+"""Utilities: model serialization, workspaces, profiling.
+
+Reference: org.deeplearning4j.util + org.nd4j.linalg.api.memory +
+org.nd4j.linalg.profiler.
+"""
+
+from deeplearning4j_tpu.util.serializer import ModelSerializer, TrainingCheckpoint
+from deeplearning4j_tpu.util.workspace import (
+    MemoryWorkspace, WorkspaceConfiguration, WorkspaceManager,
+)
+from deeplearning4j_tpu.util.profiler import OpProfiler, trace, annotate
+
+__all__ = ["ModelSerializer", "TrainingCheckpoint", "MemoryWorkspace",
+           "WorkspaceConfiguration", "WorkspaceManager", "OpProfiler",
+           "trace", "annotate"]

@@ -1,0 +1,92 @@
+"""Profiling and per-step timing.
+
+Reference: org.nd4j.linalg.profiler.OpProfiler + PerformanceListener's
+timing half. On TPU the unit of work is the jitted step, not the single
+op, so the profiler accounts (a) wall time per named section with
+compile-time (first call) split from steady-state, and (b) optionally
+wraps ``jax.profiler`` traces for inspection in TensorBoard/XProf.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+
+
+class OpProfiler:
+    """Singleton section timer (reference: OpProfiler.getInstance())."""
+
+    _instance = None
+
+    @classmethod
+    def getInstance(cls) -> "OpProfiler":
+        if cls._instance is None:
+            cls._instance = OpProfiler()
+        return cls._instance
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._times = defaultdict(float)
+        self._counts = defaultdict(int)
+        self._first = {}  # first-call wall time ~ compile time under jit
+
+    @contextlib.contextmanager
+    def section(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            if name not in self._first:
+                self._first[name] = dt
+            else:
+                self._times[name] += dt
+                self._counts[name] += 1
+
+    def timeSpent(self, name: str) -> float:
+        """Steady-state seconds (excludes the first, compiling call)."""
+        return self._times[name]
+
+    def invocations(self, name: str) -> int:
+        return self._counts[name] + (1 if name in self._first else 0)
+
+    def compileTime(self, name: str) -> float:
+        return self._first.get(name, 0.0)
+
+    def averageTime(self, name: str) -> float:
+        return self._times[name] / max(self._counts[name], 1)
+
+    def printOutDashboard(self) -> str:
+        lines = [f"{'section':<28}{'calls':>7}{'compile_s':>11}"
+                 f"{'steady_avg_ms':>15}{'total_s':>9}"]
+        for name in sorted(self._first):
+            lines.append(f"{name:<28}{self.invocations(name):>7}"
+                         f"{self.compileTime(name):>11.3f}"
+                         f"{self.averageTime(name) * 1e3:>15.3f}"
+                         f"{self.timeSpent(name):>9.3f}")
+        out = "\n".join(lines)
+        print(out)
+        return out
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """jax.profiler device trace around a block — open the dump with
+    XProf/TensorBoard. (Reference analogue: ProfilerConfig + nvprof.)"""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region inside a trace (maps to jax.profiler.TraceAnnotation)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

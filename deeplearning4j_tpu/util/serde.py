@@ -1,0 +1,112 @@
+"""Tagged-tree codec: framework object graphs <-> JSON + array table.
+
+Reference: the FlatBuffers/JSON config serialization inside
+ModelSerializer / MultiLayerConfiguration.toJson. Configs here are plain
+Python objects (layer configs, updaters, schedules, vertices) whose
+attributes are primitives, tuples, dicts, other config objects, or device
+arrays. The codec walks that graph producing a JSON-able structure; device
+arrays are pulled out into a side table (saved as npz entries) and
+replaced by index placeholders so weights never round-trip through JSON
+text. Decoding only instantiates classes from inside this package —
+loading a checkpoint never executes arbitrary pickled code.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+import numpy as np
+
+_PKG = "deeplearning4j_tpu"
+
+
+def _in_pkg(mod_name: str) -> bool:
+    # exact-package check: "deeplearning4j_tpu_evil" must NOT pass
+    return mod_name == _PKG or mod_name.startswith(_PKG + ".")
+
+
+def encode(obj, arrays: list):
+    """Recursively encode; device/numpy arrays land in `arrays`."""
+    import jax
+
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, (jax.Array, np.ndarray)):
+        arrays.append(np.asarray(obj))
+        return {"__a": len(arrays) - 1}
+    if isinstance(obj, list):
+        return [encode(v, arrays) for v in obj]
+    if isinstance(obj, tuple):
+        return {"__t": [encode(v, arrays) for v in obj]}
+    if isinstance(obj, dict):
+        return {"__d": [[encode(k, arrays), encode(v, arrays)]
+                        for k, v in obj.items()]}
+    from deeplearning4j_tpu.ndarray.dtype import DataType
+
+    if isinstance(obj, DataType):
+        return {"__dt": obj.name}
+    cls = type(obj)
+    if not _in_pkg(cls.__module__):
+        raise TypeError(f"cannot serialize {cls.__module__}.{cls.__name__}: "
+                        f"only {_PKG} config objects are supported")
+    attrs = {k: encode(v, arrays) for k, v in vars(obj).items()}
+    return {"__o": f"{cls.__module__}:{cls.__qualname__}", "attrs": attrs}
+
+
+def decode(node, arrays):
+    import jax.numpy as jnp
+
+    if node is None or isinstance(node, (bool, int, float, str)):
+        return node
+    if isinstance(node, list):
+        return [decode(v, arrays) for v in node]
+    if "__a" in node:
+        a = np.asarray(arrays[node["__a"]])
+        return jnp.asarray(a)
+    if "__t" in node:
+        return tuple(decode(v, arrays) for v in node["__t"])
+    if "__d" in node:
+        return {decode(k, arrays): decode(v, arrays) for k, v in node["__d"]}
+    if "__dt" in node:
+        from deeplearning4j_tpu.ndarray.dtype import DataType
+
+        return DataType._registry[node["__dt"]]
+    if "__o" in node:
+        mod_name, qual = node["__o"].split(":")
+        if not _in_pkg(mod_name):
+            raise ValueError(f"refusing to instantiate {node['__o']}: "
+                             f"outside {_PKG}")
+        target = importlib.import_module(mod_name)
+        for part in qual.split("."):
+            target = getattr(target, part)
+        obj = object.__new__(target)
+        obj.__dict__.update({k: decode(v, arrays)
+                             for k, v in node["attrs"].items()})
+        return obj
+    raise ValueError(f"unknown node {node!r}")
+
+
+def to_json(obj) -> str:
+    """Array-free JSON for configuration objects (shared by
+    MultiLayerConfiguration.toJson / ComputationGraphConfiguration.toJson)."""
+    import json
+
+    arrays: list = []
+    tree = encode(obj, arrays)
+    if arrays:
+        raise ValueError("configuration unexpectedly contains arrays")
+    return json.dumps(tree)
+
+
+def from_json(text: str, expected_cls=None):
+    import json
+
+    obj = decode(json.loads(text), [])
+    if expected_cls is not None and not isinstance(obj, expected_cls):
+        raise TypeError(f"JSON holds a {type(obj).__name__}, expected "
+                        f"{expected_cls.__name__}")
+    return obj

@@ -1,0 +1,152 @@
+"""ModelSerializer — save/restore networks and full training state.
+
+Reference: org.deeplearning4j.util.ModelSerializer (writeModel /
+restoreMultiLayerNetwork / restoreComputationGraph, with updater state and
+an optional attached normalizer) and the CheckpointListener's full
+checkpoint. Format: a single .npz holding one JSON manifest (config +
+structure, via util.serde's tagged codec) plus the flat array table —
+params, updater moments and BN running stats never round-trip through
+text. Restoring re-jits on first use; nothing about XLA executables is
+(or needs to be) persisted.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from deeplearning4j_tpu.util import serde
+
+
+def _net_payload(net, saveUpdater: bool) -> dict:
+    return {
+        "conf": net.conf,
+        "params": net._params,
+        "states": net._strip_carries(net._states),
+        "upd_states": net._upd_states if saveUpdater else None,
+        "iteration": net._iteration,
+        "epoch": net._epoch,
+    }
+
+
+def _norm_path(path) -> str:
+    """np.savez appends '.npz' to extensionless paths; mirror that on load
+    so save(p) / load(p) agree for any p."""
+    path = str(path)
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def _save_npz(path, manifest: dict, arrays: list):
+    np.savez_compressed(_norm_path(path), manifest=np.frombuffer(
+        json.dumps(manifest).encode(), np.uint8),
+        **{f"arr_{i}": a for i, a in enumerate(arrays)})
+
+
+def _load_npz(path):
+    z = np.load(_norm_path(path), allow_pickle=False)
+    manifest = json.loads(bytes(z["manifest"]).decode())
+    n = sum(1 for k in z.files if k.startswith("arr_"))
+    arrays = [z[f"arr_{i}"] for i in range(n)]
+    return manifest, arrays
+
+
+class ModelSerializer:
+    @staticmethod
+    def writeModel(net, path, saveUpdater: bool = True, normalizer=None):
+        """Reference: ModelSerializer.writeModel(model, file, saveUpdater
+        [, dataNormalization])."""
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        arrays: list = []
+        manifest = {
+            "format": 1,
+            "model_type": ("ComputationGraph"
+                           if isinstance(net, ComputationGraph)
+                           else "MultiLayerNetwork"),
+            "net": serde.encode(_net_payload(net, saveUpdater), arrays),
+            "normalizer": (serde.encode(normalizer, arrays)
+                           if normalizer is not None else None),
+        }
+        _save_npz(path, manifest, arrays)
+
+    # -- restore -------------------------------------------------------
+    @staticmethod
+    def _restore(path, expect_type: str, loadUpdater: bool, loaded=None):
+        manifest, arrays = loaded if loaded is not None else _load_npz(path)
+        if manifest["model_type"] != expect_type:
+            raise ValueError(f"{path} holds a {manifest['model_type']}, "
+                             f"not a {expect_type}")
+        payload = serde.decode(manifest["net"], arrays)
+        conf = payload["conf"]
+        if expect_type == "ComputationGraph":
+            from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+            net = ComputationGraph(conf)
+        else:
+            from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+            net = MultiLayerNetwork(conf)
+        upd = payload["upd_states"] if loadUpdater else None
+        net.initFrom(payload["params"], payload["states"], upd)
+        net._iteration = payload["iteration"]
+        net._epoch = payload["epoch"]
+        return net
+
+    @staticmethod
+    def restoreMultiLayerNetwork(path, loadUpdater: bool = True):
+        return ModelSerializer._restore(path, "MultiLayerNetwork", loadUpdater)
+
+    @staticmethod
+    def restoreComputationGraph(path, loadUpdater: bool = True):
+        return ModelSerializer._restore(path, "ComputationGraph", loadUpdater)
+
+    @staticmethod
+    def restoreNormalizer(path):
+        manifest, arrays = _load_npz(path)
+        if manifest.get("normalizer") is None:
+            return None
+        return serde.decode(manifest["normalizer"], arrays)
+
+    @staticmethod
+    def addNormalizerToModel(path, normalizer):
+        """Attach a fitted normalizer to an existing model file."""
+        manifest, arrays = _load_npz(path)
+        manifest["normalizer"] = serde.encode(normalizer, arrays)
+        _save_npz(path, manifest, arrays)
+
+
+class TrainingCheckpoint:
+    """Full fault-tolerance checkpoint (reference: Spark training-master
+    restart + CheckpointListener): model + updater + iteration/epoch —
+    everything needed to resume training bit-for-bit, since the per-step
+    dropout/shuffle rng is derived from (seed, iteration)."""
+
+    @staticmethod
+    def save(net, path, normalizer=None, extra: dict = None):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        arrays: list = []
+        manifest = {
+            "format": 1,
+            "checkpoint": True,
+            "model_type": ("ComputationGraph"
+                           if isinstance(net, ComputationGraph)
+                           else "MultiLayerNetwork"),
+            "net": serde.encode(_net_payload(net, True), arrays),
+            "normalizer": (serde.encode(normalizer, arrays)
+                           if normalizer is not None else None),
+            "extra": extra or {},
+        }
+        _save_npz(path, manifest, arrays)
+
+    @staticmethod
+    def load(path):
+        """Returns (net, normalizer, extra)."""
+        loaded = _load_npz(path)
+        manifest, arrays = loaded
+        net = ModelSerializer._restore(path, manifest["model_type"], True,
+                                       loaded=loaded)
+        norm = (serde.decode(manifest["normalizer"], arrays)
+                if manifest.get("normalizer") is not None else None)
+        return net, norm, manifest.get("extra", {})

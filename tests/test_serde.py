@@ -1,0 +1,307 @@
+"""Serialization tests — config + model round trips, checkpoint/resume,
+workspace shim, profiler.
+
+Mirrors the reference's ModelSerializerTest / config JSON round-trip
+tests: restored network == original network (outputs bit-for-bit), and
+resumed training matches uninterrupted training exactly (the rng is
+derived from (seed, iteration), so a true full-state checkpoint shows
+zero divergence).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import (
+    NeuralNetConfiguration, InputType, MultiLayerNetwork, ComputationGraph,
+    DenseLayer, OutputLayer, ConvolutionLayer, SubsamplingLayer, LSTM,
+    RnnOutputLayer, BatchNormalization, DropoutLayer, ElementWiseVertex,
+    Adam, Nesterovs, WeightInit,
+)
+from deeplearning4j_tpu.data import DataSet, NormalizerStandardize
+from deeplearning4j_tpu.util import (
+    ModelSerializer, TrainingCheckpoint, MemoryWorkspace, WorkspaceManager,
+    OpProfiler,
+)
+
+
+def _data(n=64, nin=4, nout=3, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, nin).astype("float32")
+    w = rng.randn(nin, nout)
+    yi = np.argmax(x @ w, axis=1)
+    return x, np.eye(nout, dtype="float32")[yi]
+
+
+def _mlp_conf(seed=42):
+    return (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(1e-2))
+            .weightInit(WeightInit.XAVIER).activation("relu").list()
+            .layer(DenseLayer(nOut=16))
+            .layer(BatchNormalization())
+            .layer(DropoutLayer(0.9))
+            .layer(OutputLayer(nOut=3, activation="softmax", lossFunction="mcxent"))
+            .setInputType(InputType.feedForward(4)).build())
+
+
+class TestModelSerializerMLN:
+    def test_output_round_trip(self, tmp_path):
+        x, y = _data()
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        for _ in range(5):
+            net.fit(x, y)
+        p = str(tmp_path / "model.npz")
+        ModelSerializer.writeModel(net, p)
+        net2 = ModelSerializer.restoreMultiLayerNetwork(p)
+        np.testing.assert_array_equal(net.output(x).toNumpy(),
+                                      net2.output(x).toNumpy())
+        assert net2.getIterationCount() == net.getIterationCount()
+
+    def test_resumed_training_is_bit_exact(self, tmp_path):
+        """Train 10; vs train 5 + checkpoint + restore + train 5."""
+        x, y = _data()
+        ref = MultiLayerNetwork(_mlp_conf()).init()
+        for _ in range(10):
+            ref.fit(x, y)
+
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        for _ in range(5):
+            net.fit(x, y)
+        p = str(tmp_path / "ckpt.npz")
+        ModelSerializer.writeModel(net, p, saveUpdater=True)
+        resumed = ModelSerializer.restoreMultiLayerNetwork(p)
+        for _ in range(5):
+            resumed.fit(x, y)
+        np.testing.assert_array_equal(ref.output(x).toNumpy(),
+                                      resumed.output(x).toNumpy())
+
+    def test_without_updater_state(self, tmp_path):
+        x, y = _data()
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        net.fit(x, y)
+        p = str(tmp_path / "m.npz")
+        ModelSerializer.writeModel(net, p, saveUpdater=False)
+        net2 = ModelSerializer.restoreMultiLayerNetwork(p, loadUpdater=False)
+        np.testing.assert_array_equal(net.output(x).toNumpy(),
+                                      net2.output(x).toNumpy())
+
+    def test_normalizer_round_trip(self, tmp_path):
+        x, y = _data()
+        ds = DataSet(x, y)
+        norm = NormalizerStandardize().fit(ds)
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        p = str(tmp_path / "m.npz")
+        ModelSerializer.writeModel(net, p, normalizer=norm)
+        norm2 = ModelSerializer.restoreNormalizer(p)
+        np.testing.assert_allclose(norm2._mean, norm._mean)
+        np.testing.assert_allclose(norm2._std, norm._std)
+
+    def test_add_normalizer_later(self, tmp_path):
+        x, y = _data()
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        p = str(tmp_path / "m.npz")
+        ModelSerializer.writeModel(net, p)
+        assert ModelSerializer.restoreNormalizer(p) is None
+        norm = NormalizerStandardize().fit(DataSet(x, y))
+        ModelSerializer.addNormalizerToModel(p, norm)
+        norm2 = ModelSerializer.restoreNormalizer(p)
+        np.testing.assert_allclose(norm2._mean, norm._mean)
+        # model still restores after the rewrite
+        net2 = ModelSerializer.restoreMultiLayerNetwork(p)
+        np.testing.assert_array_equal(net.output(x).toNumpy(),
+                                      net2.output(x).toNumpy())
+
+    def test_wrong_type_raises(self, tmp_path):
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        p = str(tmp_path / "m.npz")
+        ModelSerializer.writeModel(net, p)
+        with pytest.raises(ValueError, match="MultiLayerNetwork"):
+            ModelSerializer.restoreComputationGraph(p)
+
+
+class TestModelSerializerCNNAndRNN:
+    def test_cnn_round_trip(self, tmp_path):
+        rng = np.random.RandomState(0)
+        x = rng.rand(8, 1, 12, 12).astype("float32")
+        y = np.eye(4, dtype="float32")[rng.randint(0, 4, 8)]
+        conf = (NeuralNetConfiguration.Builder().seed(3).updater(Nesterovs(0.01, 0.9))
+                .list()
+                .layer(ConvolutionLayer(nOut=4, kernelSize=(3, 3), activation="relu"))
+                .layer(SubsamplingLayer(kernelSize=(2, 2), stride=(2, 2)))
+                .layer(OutputLayer(nOut=4, activation="softmax"))
+                .setInputType(InputType.convolutional(12, 12, 1)).build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(x, y)
+        p = str(tmp_path / "cnn.npz")
+        ModelSerializer.writeModel(net, p)
+        net2 = ModelSerializer.restoreMultiLayerNetwork(p)
+        np.testing.assert_array_equal(net.output(x).toNumpy(),
+                                      net2.output(x).toNumpy())
+
+    def test_lstm_round_trip(self, tmp_path):
+        rng = np.random.RandomState(1)
+        x = rng.randn(4, 3, 7).astype("float32")
+        y = np.zeros((4, 2, 7), "float32")
+        y[:, 0] = 1.0
+        conf = (NeuralNetConfiguration.Builder().seed(5).updater(Adam(1e-2)).list()
+                .layer(LSTM(nOut=8))
+                .layer(RnnOutputLayer(nOut=2, activation="softmax"))
+                .setInputType(InputType.recurrent(3)).build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(x, y)
+        p = str(tmp_path / "lstm.npz")
+        ModelSerializer.writeModel(net, p)
+        net2 = ModelSerializer.restoreMultiLayerNetwork(p)
+        np.testing.assert_array_equal(net.output(x).toNumpy(),
+                                      net2.output(x).toNumpy())
+
+
+class TestModelSerializerCG:
+    def test_graph_round_trip(self, tmp_path):
+        x, y = _data()
+        conf = (NeuralNetConfiguration.Builder().seed(1).updater(Adam(1e-2))
+                .graphBuilder()
+                .addInputs("in")
+                .addLayer("d1", DenseLayer(nOut=16, activation="relu"), "in")
+                .addLayer("d2", DenseLayer(nOut=16, activation="identity"), "d1")
+                .addVertex("res", ElementWiseVertex("add"), "d1", "d2")
+                .addLayer("out", OutputLayer(nOut=3, activation="softmax"), "res")
+                .setOutputs("out")
+                .setInputTypes(InputType.feedForward(4))
+                .build())
+        net = ComputationGraph(conf).init()
+        for _ in range(3):
+            net.fit(x, y)
+        p = str(tmp_path / "graph.npz")
+        ModelSerializer.writeModel(net, p)
+        net2 = ModelSerializer.restoreComputationGraph(p)
+        np.testing.assert_array_equal(net.outputSingle(x).toNumpy(),
+                                      net2.outputSingle(x).toNumpy())
+        # resumed training matches
+        net.fit(x, y)
+        net2.fit(x, y)
+        np.testing.assert_array_equal(net.outputSingle(x).toNumpy(),
+                                      net2.outputSingle(x).toNumpy())
+
+
+class TestTrainingCheckpoint:
+    def test_full_resume_with_extra(self, tmp_path):
+        x, y = _data()
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        net.fit(x, y)
+        norm = NormalizerStandardize().fit(DataSet(x, y))
+        p = str(tmp_path / "ck.npz")
+        TrainingCheckpoint.save(net, p, normalizer=norm,
+                                extra={"best_score": 0.5, "epoch": 1})
+        net2, norm2, extra = TrainingCheckpoint.load(p)
+        assert extra["best_score"] == 0.5
+        np.testing.assert_allclose(norm2._mean, norm._mean)
+        np.testing.assert_array_equal(net.output(x).toNumpy(),
+                                      net2.output(x).toNumpy())
+
+
+class TestWorkspace:
+    def test_scoping(self):
+        assert WorkspaceManager.getCurrentWorkspace() is None
+        with MemoryWorkspace("A") as a:
+            assert WorkspaceManager.getCurrentWorkspace() is a
+            with MemoryWorkspace("B") as b:
+                assert WorkspaceManager.getCurrentWorkspace() is b
+            assert WorkspaceManager.getCurrentWorkspace() is a
+        assert WorkspaceManager.getCurrentWorkspace() is None
+
+    def test_corruption_detection(self):
+        a = MemoryWorkspace("A").__enter__()
+        b = MemoryWorkspace("B").__enter__()
+        with pytest.raises(RuntimeError, match="corruption"):
+            a.__exit__(None, None, None)
+        b.__exit__(None, None, None)
+        a.__exit__(None, None, None)
+
+    def test_scope_out(self):
+        with WorkspaceManager.scopeOutOfWorkspaces():
+            pass
+
+
+class TestProfiler:
+    def test_sections_and_compile_split(self):
+        prof = OpProfiler.getInstance()
+        prof.reset()
+        import time
+        for _ in range(3):
+            with prof.section("step"):
+                time.sleep(0.001)
+        assert prof.invocations("step") == 3
+        assert prof.compileTime("step") > 0
+        assert prof.timeSpent("step") > 0  # 2 steady calls
+        assert "step" in prof.printOutDashboard()
+
+
+class TestConfigJson:
+    def test_mln_conf_round_trip(self):
+        x, y = _data()
+        conf = _mlp_conf()
+        text = conf.toJson()
+        conf2 = type(conf).fromJson(text)
+        a = MultiLayerNetwork(conf).init()
+        b = MultiLayerNetwork(conf2).init()  # same seed -> same init
+        np.testing.assert_array_equal(a.output(x).toNumpy(), b.output(x).toNumpy())
+
+    def test_graph_conf_round_trip(self):
+        x, y = _data()
+        conf = (NeuralNetConfiguration.Builder().seed(2).updater(Adam(1e-2))
+                .graphBuilder().addInputs("in")
+                .addLayer("d", DenseLayer(nOut=8, activation="relu"), "in")
+                .addLayer("out", OutputLayer(nOut=3, activation="softmax"), "d")
+                .setOutputs("out").setInputTypes(InputType.feedForward(4)).build())
+        conf2 = type(conf).fromJson(conf.toJson())
+        a = ComputationGraph(conf).init()
+        b = ComputationGraph(conf2).init()
+        np.testing.assert_array_equal(a.outputSingle(x).toNumpy(),
+                                      b.outputSingle(x).toNumpy())
+
+    def test_net_save_load_methods(self, tmp_path):
+        x, y = _data()
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        net.fit(x, y)
+        p = str(tmp_path / "n.npz")
+        net.save(p)
+        net2 = MultiLayerNetwork.load(p)
+        np.testing.assert_array_equal(net.output(x).toNumpy(),
+                                      net2.output(x).toNumpy())
+
+
+class TestReviewRegressions:
+    def test_extensionless_path_round_trip(self, tmp_path):
+        x, y = _data()
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        net.save(str(tmp_path / "model"))  # numpy appends .npz on save
+        net2 = MultiLayerNetwork.load(str(tmp_path / "model"))
+        np.testing.assert_array_equal(net.output(x).toNumpy(),
+                                      net2.output(x).toNumpy())
+
+    def test_fromjson_wrong_root_type_raises(self):
+        conf = (NeuralNetConfiguration.Builder().seed(2).updater(Adam(1e-2))
+                .graphBuilder().addInputs("in")
+                .addLayer("out", OutputLayer(nOut=3, activation="softmax"), "in")
+                .setOutputs("out").setInputTypes(InputType.feedForward(4)).build())
+        from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
+        with pytest.raises(TypeError, match="expected MultiLayerConfiguration"):
+            MultiLayerConfiguration.fromJson(conf.toJson())
+
+    def test_decode_rejects_lookalike_package(self):
+        from deeplearning4j_tpu.util import serde
+        with pytest.raises(ValueError, match="refusing"):
+            serde.decode({"__o": "deeplearning4j_tpu_evil.mod:Cls", "attrs": {}}, [])
+
+    def test_restore_skips_random_init(self, tmp_path, monkeypatch):
+        x, y = _data()
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        net.fit(x, y)
+        p = str(tmp_path / "m.npz")
+        ModelSerializer.writeModel(net, p)
+        import deeplearning4j_tpu.nn.multilayer as mln_mod
+        def boom(self):
+            raise AssertionError("restore must not call init()")
+        monkeypatch.setattr(mln_mod.MultiLayerNetwork, "init", boom)
+        net2 = ModelSerializer.restoreMultiLayerNetwork(p)
+        np.testing.assert_array_equal(net.output(x).toNumpy(),
+                                      net2.output(x).toNumpy())
