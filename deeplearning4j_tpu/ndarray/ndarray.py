@@ -122,22 +122,41 @@ class INDArray:
         return self
 
     # ----- scalar access ---------------------------------------------
+    def _checked_index(self, indices) -> tuple:
+        # XLA gather clamps out-of-bounds reads silently; the reference
+        # throws, so bounds-check host-side (mirrors putScalar).
+        idx = tuple(int(i) for i in indices)
+        for i, n in zip(idx, self._jx.shape):
+            if not -n <= i < n:
+                raise IndexError(f"index {idx} out of bounds for shape {self.shape()}")
+        return idx
+
+    def _checked_flat_index(self, i: int) -> int:
+        i = int(i)
+        if not -self._jx.size <= i < self._jx.size:
+            raise IndexError(f"linear index {i} out of bounds for length {self._jx.size}")
+        return i
+
+    def _element(self, indices) -> jax.Array:
+        """One element; a single index into a non-1d array is linear into the
+        flattened array, matching the reference's getDouble(long)/getScalar(long)."""
+        if not indices:
+            return self._jx.reshape(-1)[0]
+        if len(indices) == 1 and self._jx.ndim != 1:
+            return self._jx.reshape(-1)[self._checked_flat_index(indices[0])]
+        return self._jx[self._checked_index(indices)]
+
     def getScalar(self, *indices) -> "INDArray":
-        return INDArray(self._jx[tuple(int(i) for i in indices)])
+        return INDArray(self._element(indices))
 
     def getDouble(self, *indices) -> float:
-        if not indices:
-            return float(self._jx.reshape(-1)[0])
-        if len(indices) == 1 and self._jx.ndim > 1:
-            # linear index, matching the reference's flat getDouble(long)
-            return float(self._jx.reshape(-1)[int(indices[0])])
-        return float(self._jx[tuple(int(i) for i in indices)])
+        return float(self._element(indices))
 
     def getFloat(self, *indices) -> float:
         return self.getDouble(*indices)
 
     def getInt(self, *indices) -> int:
-        return int(self._jx[tuple(int(i) for i in indices)])
+        return int(self._element(indices))
 
     def putScalar(self, *args) -> "INDArray":
         *indices, value = args
@@ -145,19 +164,12 @@ class INDArray:
             indices = list(indices[0])
         if len(indices) == 1 and self._jx.ndim > 1:
             # linear index into the flattened array, like the reference
-            i = int(indices[0])
-            if not -self._jx.size <= i < self._jx.size:
-                raise IndexError(f"putScalar index {i} out of bounds for length {self._jx.size}")
-            flat = self._jx.reshape(-1).at[i].set(value)
+            flat = self._jx.reshape(-1).at[self._checked_flat_index(indices[0])].set(value)
             self._jx = flat.reshape(self._jx.shape)
         else:
             # XLA scatter drops out-of-bounds updates silently; the reference
             # throws, so bounds-check host-side.
-            idx = tuple(int(i) for i in indices)
-            for i, n in zip(idx, self._jx.shape):
-                if not -n <= i < n:
-                    raise IndexError(f"putScalar index {idx} out of bounds for shape {self.shape()}")
-            self._jx = self._jx.at[idx].set(value)
+            self._jx = self._jx.at[self._checked_index(indices)].set(value)
         return self
 
     # ----- elementwise arithmetic ------------------------------------

@@ -21,18 +21,23 @@ class DefaultRandom:
 
     def __init__(self, seed: int = 0):
         self._lock = threading.Lock()
-        self.setSeed(seed)
+        self._seed = int(seed)
+        self._key = None  # materialised lazily: creating a key allocates a
+        # device buffer, which would initialise the backend at import time
+        # (breaking late platform selection, e.g. the multichip dry-run).
 
     def setSeed(self, seed: int) -> None:
         with self._lock:
             self._seed = int(seed)
-            self._key = jax.random.key(int(seed))
+            self._key = None
 
     def getSeed(self) -> int:
         return self._seed
 
     def nextKey(self) -> jax.Array:
         with self._lock:
+            if self._key is None:
+                self._key = jax.random.key(self._seed)
             self._key, sub = jax.random.split(self._key)
             return sub
 
