@@ -1,0 +1,34 @@
+"""Arbiter — hyperparameter optimization.
+
+Reference: the Arbiter module (org.deeplearning4j.arbiter): ParameterSpace,
+CandidateGenerator (random/grid), ScoreFunction, termination conditions and
+LocalOptimizationRunner.
+"""
+
+from deeplearning4j_tpu.arbiter.spaces import (
+    ParameterSpace,
+    ContinuousParameterSpace,
+    DiscreteParameterSpace,
+    IntegerParameterSpace,
+)
+from deeplearning4j_tpu.arbiter.optimize import (
+    RandomSearchGenerator,
+    GridSearchCandidateGenerator,
+    TestSetLossScoreFunction,
+    EvaluationScoreFunction,
+    MaxCandidatesCondition,
+    MaxTimeCondition,
+    OptimizationConfiguration,
+    LocalOptimizationRunner,
+    OptimizationResult,
+    CandidateResult,
+)
+
+__all__ = [
+    "ParameterSpace", "ContinuousParameterSpace", "DiscreteParameterSpace",
+    "IntegerParameterSpace", "RandomSearchGenerator",
+    "GridSearchCandidateGenerator", "TestSetLossScoreFunction",
+    "EvaluationScoreFunction", "MaxCandidatesCondition", "MaxTimeCondition",
+    "OptimizationConfiguration", "LocalOptimizationRunner",
+    "OptimizationResult", "CandidateResult",
+]

@@ -1,0 +1,241 @@
+"""Hyperparameter search: generators, score functions, runner.
+
+Reference: org.deeplearning4j.arbiter.optimize — CandidateGenerator
+(RandomSearchGenerator, GridSearchCandidateGenerator), ScoreFunction
+(TestSetLossScoreFunction, EvaluationScoreFunction), termination conditions
+(MaxCandidatesCondition, MaxTimeCondition) and LocalOptimizationRunner.
+
+Design difference from the reference: instead of the MultiLayerSpace config
+DSL, a candidate is a plain dict sampled from named ParameterSpaces and the
+user supplies `modelBuilder(candidate) -> MultiLayerNetwork/ComputationGraph`.
+That keeps the search loop orthogonal to the (already fluent) config builders
+— and under jit, candidates with identical layer shapes reuse the same
+compiled train step, so a sweep over learning rates costs ONE XLA compile.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+
+from deeplearning4j_tpu.arbiter.spaces import ParameterSpace
+
+
+# ---------------------------------------------------------------------------
+# candidate generators
+# ---------------------------------------------------------------------------
+
+class RandomSearchGenerator:
+    def __init__(self, parameterSpaces: dict, seed: int = 12345):
+        import numpy as np
+
+        for k, v in parameterSpaces.items():
+            if not isinstance(v, ParameterSpace):
+                raise TypeError(f"space '{k}' is not a ParameterSpace")
+        self.spaces = dict(parameterSpaces)
+        self._rng = np.random.RandomState(seed)
+
+    def hasMore(self) -> bool:
+        return True  # bounded by termination conditions
+
+    def next(self) -> dict:
+        return {k: s.sample(self._rng) for k, s in self.spaces.items()}
+
+
+class GridSearchCandidateGenerator:
+    def __init__(self, parameterSpaces: dict, discretizationCount: int = 3):
+        self.spaces = dict(parameterSpaces)
+        axes = [(k, s.grid(discretizationCount)) for k, s in self.spaces.items()]
+        names = [k for k, _ in axes]
+        self._candidates = [dict(zip(names, combo))
+                            for combo in itertools.product(*(vs for _, vs in axes))]
+        self._i = 0
+
+    def __len__(self):
+        return len(self._candidates)
+
+    def hasMore(self) -> bool:
+        return self._i < len(self._candidates)
+
+    def next(self) -> dict:
+        c = self._candidates[self._i]
+        self._i += 1
+        return c
+
+
+# ---------------------------------------------------------------------------
+# score functions
+# ---------------------------------------------------------------------------
+
+class TestSetLossScoreFunction:
+    """Held-out loss; minimized (reference:
+    arbiter.scoring.impl.TestSetLossScoreFunction)."""
+
+    __test__ = False  # not a pytest class despite the Test prefix
+
+    def __init__(self, testData):
+        self.testData = testData
+
+    def minimize(self) -> bool:
+        return True
+
+    def score(self, model) -> float:
+        from deeplearning4j_tpu.optimize.earlystopping import DataSetLossCalculator
+
+        return DataSetLossCalculator(self.testData).calculateScore(model)
+
+
+class EvaluationScoreFunction:
+    """Held-out classification metric; maximized (reference:
+    arbiter.scoring.impl.EvaluationScoreFunction)."""
+
+    def __init__(self, testData, metric: str = "accuracy"):
+        self.testData = testData
+        self.metric = metric
+
+    def minimize(self) -> bool:
+        return False
+
+    def score(self, model) -> float:
+        e = model.evaluate(self.testData)
+        return float(getattr(e, self.metric)())
+
+
+# ---------------------------------------------------------------------------
+# termination conditions
+# ---------------------------------------------------------------------------
+
+class MaxCandidatesCondition:
+    def __init__(self, maxCandidates: int):
+        self.maxCandidates = int(maxCandidates)
+
+    def initialize(self):
+        pass
+
+    def terminate(self, numCandidates: int) -> bool:
+        return numCandidates >= self.maxCandidates
+
+
+class MaxTimeCondition:
+    def __init__(self, duration: float, unit: str = "seconds"):
+        mult = {"seconds": 1.0, "minutes": 60.0, "hours": 3600.0}[unit]
+        self.maxSeconds = float(duration) * mult
+        self._start = None
+
+    def initialize(self):
+        self._start = time.perf_counter()
+
+    def terminate(self, numCandidates: int) -> bool:
+        return (time.perf_counter() - self._start) >= self.maxSeconds
+
+
+# ---------------------------------------------------------------------------
+# configuration + runner
+# ---------------------------------------------------------------------------
+
+class CandidateResult:
+    def __init__(self, index, candidate, score, model=None, error=None):
+        self.index = index
+        self.candidate = candidate
+        self.score = score
+        self.model = model
+        self.error = error
+
+    def __repr__(self):
+        return f"CandidateResult(#{self.index}, {self.candidate}, score={self.score})"
+
+
+class OptimizationResult:
+    def __init__(self, best: CandidateResult, results: list):
+        self.best = best
+        self.results = results
+
+    def bestCandidate(self) -> dict:
+        return self.best.candidate
+
+    def bestScore(self) -> float:
+        return self.best.score
+
+    def bestModel(self):
+        return self.best.model
+
+
+class OptimizationConfiguration:
+    class Builder:
+        def __init__(self):
+            self._gen = None
+            self._score = None
+            self._conds = [MaxCandidatesCondition(10)]
+            self._epochs = 1
+
+        def candidateGenerator(self, gen):
+            self._gen = gen
+            return self
+
+        def scoreFunction(self, fn):
+            self._score = fn
+            return self
+
+        def terminationConditions(self, *conds):
+            self._conds = list(conds)
+            return self
+
+        def epochsPerCandidate(self, n: int):
+            self._epochs = int(n)
+            return self
+
+        def build(self):
+            if self._gen is None or self._score is None:
+                raise ValueError("candidateGenerator and scoreFunction are required")
+            return OptimizationConfiguration(self)
+
+    def __init__(self, b):
+        self.candidateGenerator = b._gen
+        self.scoreFunction = b._score
+        self.terminationConditions = b._conds
+        self.epochsPerCandidate = b._epochs
+
+
+class LocalOptimizationRunner:
+    """Sequential candidate evaluation on the local chip (reference:
+    arbiter LocalOptimizationRunner). A failed candidate records its error
+    and the search continues, like the reference's failed-candidate status."""
+
+    def __init__(self, configuration: OptimizationConfiguration, modelBuilder,
+                 trainData):
+        self.conf = configuration
+        self.modelBuilder = modelBuilder
+        self.trainData = trainData
+
+    def execute(self) -> OptimizationResult:
+        conf = self.conf
+        for c in conf.terminationConditions:
+            c.initialize()
+        results = []
+        best = None
+        minimize = conf.scoreFunction.minimize()
+        n = 0
+        while conf.candidateGenerator.hasMore():
+            if any(c.terminate(n) for c in conf.terminationConditions):
+                break
+            candidate = conf.candidateGenerator.next()
+            try:
+                model = self.modelBuilder(candidate)
+                model.fit(self.trainData, epochs=conf.epochsPerCandidate)
+                score = conf.scoreFunction.score(model)
+                res = CandidateResult(n, candidate, score, model)
+            except Exception as e:  # candidate failure != search failure
+                res = CandidateResult(n, candidate,
+                                      float("inf") if minimize else float("-inf"),
+                                      error=e)
+            results.append(res)
+            if res.error is None and (
+                    best is None or
+                    (res.score < best.score if minimize else res.score > best.score)):
+                best = res
+            n += 1
+        if best is None:
+            raise RuntimeError(
+                "no candidate completed successfully; first error: "
+                f"{results[0].error if results else 'no candidates generated'}")
+        return OptimizationResult(best, results)

@@ -18,6 +18,10 @@ class EvaluationBinary:
         self._thr = float(decisionThreshold)
         self._counts = None  # [n, 4] = tp, fp, tn, fn
 
+    def reset(self):
+        """Clear accumulated statistics (reference: IEvaluation.reset())."""
+        self._counts = None
+
     def eval(self, labels, predictions, mask=None):
         y = _to_np(labels)
         p = _to_np(predictions)

@@ -25,6 +25,10 @@ class Evaluation:
         self._labels = labelsList
         self._conf = None  # confusion matrix [actual, predicted]
 
+    def reset(self):
+        """Clear accumulated statistics (reference: IEvaluation.reset())."""
+        self._conf = None
+
     def eval(self, labels, predictions, mask=None):
         y = _to_np(labels)
         p = _to_np(predictions)

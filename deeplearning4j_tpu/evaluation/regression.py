@@ -21,6 +21,17 @@ class RegressionEvaluation:
         self._n_cols = nColumns
         self._initialized = False
 
+    def reset(self):
+        """Clear accumulated statistics (reference: IEvaluation.reset())."""
+        self._initialized = False
+        # drop the accumulators so a read between reset() and the next
+        # eval() fails loudly instead of returning the discarded stats
+        for a in ("_count", "_sum_err", "_sum_abs_err", "_sum_sq_err",
+                  "_sum_label", "_sum_sq_label", "_sum_pred", "_sum_sq_pred",
+                  "_sum_label_pred"):
+            if hasattr(self, a):
+                delattr(self, a)
+
     def _init(self, n):
         self._n_cols = n
         z = np.zeros(n, np.float64)

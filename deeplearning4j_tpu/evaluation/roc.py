@@ -41,6 +41,10 @@ class ROC:
         self._n_pos = 0
         self._n_neg = 0
 
+    def reset(self):
+        """Clear accumulated statistics (reference: IEvaluation.reset())."""
+        self.__init__(self._steps)
+
     @staticmethod
     def _binary(labels, preds):
         y = _to_np(labels)
@@ -131,6 +135,10 @@ class ROCMultiClass:
         self._steps = thresholdSteps
         self._rocs = None
 
+    def reset(self):
+        """Clear accumulated statistics (reference: IEvaluation.reset())."""
+        self._rocs = None
+
     def eval(self, labels, predictions, mask=None):
         y = _to_np(labels)
         p = _to_np(predictions)
@@ -174,6 +182,10 @@ class ROCBinary:
 
     def __init__(self, thresholdSteps: int = 0):
         self._steps = thresholdSteps
+        self._rocs = None
+
+    def reset(self):
+        """Clear accumulated statistics (reference: IEvaluation.reset())."""
         self._rocs = None
 
     def eval(self, labels, predictions, mask=None):

@@ -32,3 +32,6 @@ from deeplearning4j_tpu.nn.conf.graph import (
 )
 from deeplearning4j_tpu.nn.graph import ComputationGraph
 from deeplearning4j_tpu.nn.conf.layers import CnnLossLayer, RnnLossLayer
+from deeplearning4j_tpu.nn.transfer import (
+    TransferLearning, FineTuneConfiguration, FrozenLayer, TransferLearningHelper,
+)

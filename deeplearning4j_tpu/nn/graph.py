@@ -232,8 +232,12 @@ class ComputationGraph:
             return self
         for _ in range(epochs or 1):
             data.reset()
+            for lst in self._listeners:
+                getattr(lst, "onEpochStart", lambda m: None)(self)
             while data.hasNext():
                 self._fit_ds(data.next())
+            for lst in self._listeners:
+                getattr(lst, "onEpochEnd", lambda m: None)(self)
             self._epoch += 1
         return self
 
@@ -339,6 +343,10 @@ class ComputationGraph:
 
     def setListeners(self, *listeners):
         self._listeners = list(listeners)
+        return self
+
+    def addListeners(self, *listeners):
+        self._listeners.extend(listeners)
         return self
 
     def getIterationCount(self):

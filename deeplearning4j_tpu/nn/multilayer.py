@@ -206,11 +206,17 @@ class MultiLayerNetwork:
     def _regularization(self, params):
         reg = 0.0
         for layer, p in zip(self.layers, params):
-            if p:
+            if p and not getattr(layer, "frozen", False):
                 reg = reg + layer.regularization(p)
         return reg
 
     def _loss_fn(self, params, states, x, y, key, fmask, lmask, use_carries):
+        # frozen layers (transfer learning): structurally zero grads — XLA
+        # dead-code-eliminates their whole backward pass, which is the TPU
+        # equivalent of the reference's FrozenLayer wrapper skipping backprop
+        params = [jax.tree_util.tree_map(jax.lax.stop_gradient, p)
+                  if getattr(l, "frozen", False) else p
+                  for l, p in zip(self.layers, params)]
         run_states = states if use_carries else self._strip_carries(states)
         preact, new_states = self._run_layers(params, run_states, x, True, key, fmask)
         # loss math in >= fp32 (bf16 compute still gets an fp32 loss; fp64
@@ -239,7 +245,7 @@ class MultiLayerNetwork:
                                 self.conf.gradientNormalizationThreshold)
         new_params, new_upd_states = [], []
         for i in range(len(self.layers)):
-            if not params[i]:
+            if not params[i] or getattr(self.layers[i], "frozen", False):
                 new_params.append(params[i])
                 new_upd_states.append(upd_states[i])
                 continue
@@ -299,8 +305,12 @@ class MultiLayerNetwork:
         n_epochs = epochs or 1
         for _ in range(n_epochs):
             data.reset()
+            for lst in self._listeners:
+                getattr(lst, "onEpochStart", lambda m: None)(self)
             while data.hasNext():
                 self._fit_batch(data.next())
+            for lst in self._listeners:
+                getattr(lst, "onEpochEnd", lambda m: None)(self)
             self._epoch += 1
         return self
 

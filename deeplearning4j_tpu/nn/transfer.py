@@ -1,0 +1,275 @@
+"""Transfer learning.
+
+Reference: org.deeplearning4j.nn.transferlearning — TransferLearning.Builder,
+FineTuneConfiguration, FrozenLayer, TransferLearningHelper.
+
+TPU design: freezing is a config flag, not a wrapper layer. The train step
+wraps frozen layers' params in `lax.stop_gradient`, so their backward pass is
+dead code that XLA eliminates from the compiled step — same effect as the
+reference's FrozenLayer skipping backpropGradient, but done by the compiler.
+A rebuilt network recompiles its single fused train step on first fit.
+"""
+
+from __future__ import annotations
+
+import copy
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def FrozenLayer(layer):
+    """Mark a layer config as frozen (reference: layers.misc.FrozenLayer).
+    Returns the same config object with backprop disabled for its params."""
+    layer.frozen = True
+    return layer
+
+
+class FineTuneConfiguration:
+    """Overrides applied to every retained (non-frozen) layer and the global
+    config when transferring (reference:
+    transferlearning.FineTuneConfiguration)."""
+
+    _LAYER_FIELDS = ("activation", "weightInit", "biasInit", "updater",
+                     "biasUpdater", "l1", "l2", "l1Bias", "l2Bias",
+                     "weightDecay", "dropOut")
+
+    class Builder:
+        def __init__(self):
+            self._d = {}
+
+        def seed(self, s):
+            self._d["seed"] = int(s)
+            return self
+
+        def updater(self, u):
+            from deeplearning4j_tpu.nn import updaters as _upd
+
+            self._d["updater"] = _upd.resolve(u)
+            return self
+
+        def activation(self, a):
+            self._d["activation"] = a
+            return self
+
+        def weightInit(self, w):
+            self._d["weightInit"] = w
+            return self
+
+        def biasInit(self, b):
+            self._d["biasInit"] = float(b)
+            return self
+
+        def l1(self, v):
+            self._d["l1"] = float(v)
+            return self
+
+        def l2(self, v):
+            self._d["l2"] = float(v)
+            return self
+
+        def weightDecay(self, v):
+            self._d["weightDecay"] = float(v)
+            return self
+
+        def dropOut(self, v):
+            self._d["dropOut"] = float(v)
+            return self
+
+        def build(self):
+            return FineTuneConfiguration(self._d)
+
+    def __init__(self, overrides: dict):
+        self.overrides = dict(overrides)
+
+    def applyToLayer(self, layer):
+        for f in self._LAYER_FIELDS:
+            if f in self.overrides:
+                setattr(layer, f, self.overrides[f])
+
+
+class TransferLearning:
+    """Reference: transferlearning.TransferLearning.Builder (the
+    MultiLayerNetwork variant)."""
+
+    class Builder:
+        def __init__(self, origNet: MultiLayerNetwork):
+            if origNet._params is None:
+                raise ValueError("original network must be initialized")
+            self._orig = origNet
+            self._ftc = None
+            self._frozenTill = -1
+            self._nOutReplace = {}   # idx -> (nOut, weightInit or None)
+            self._removeFromOutput = 0
+            self._appended = []
+
+        def fineTuneConfiguration(self, ftc: FineTuneConfiguration):
+            self._ftc = ftc
+            return self
+
+        def setFeatureExtractor(self, layerIndex: int):
+            """Freeze layers [0..layerIndex] inclusive."""
+            self._frozenTill = int(layerIndex)
+            return self
+
+        def nOutReplace(self, layerIndex: int, nOut: int, weightInit=None):
+            """Change a layer's output size, re-initializing it and the next
+            layer (whose nIn changes)."""
+            self._nOutReplace[int(layerIndex)] = (int(nOut), weightInit)
+            return self
+
+        def removeOutputLayer(self):
+            return self.removeLayersFromOutput(1)
+
+        def removeLayersFromOutput(self, n: int):
+            self._removeFromOutput += int(n)
+            return self
+
+        def addLayer(self, layer):
+            self._appended.append(layer)
+            return self
+
+        def build(self) -> MultiLayerNetwork:
+            orig = self._orig
+            oconf = orig.conf
+            n_orig = len(oconf.layers)
+            n_keep = n_orig - self._removeFromOutput
+            if n_keep < 0:
+                raise ValueError("removed more layers than the network has")
+
+            layers = [copy.deepcopy(l) for l in oconf.layers[:n_keep]]
+            # fresh params needed at: replaced layers, their successors, and
+            # appended layers. Everything else copies the trained weights.
+            fresh = set(range(n_keep, n_keep + len(self._appended)))
+            for idx, (nOut, winit) in self._nOutReplace.items():
+                if idx >= n_keep:
+                    raise ValueError(f"nOutReplace index {idx} was removed")
+                layers[idx].nOut = nOut
+                if winit is not None:
+                    layers[idx].weightInit = winit
+                fresh.add(idx)
+                if idx + 1 < n_keep:
+                    nxt = layers[idx + 1]
+                    if getattr(nxt, "nIn", None) is not None:
+                        nxt.nIn = None  # re-infer from the new nOut
+                    fresh.add(idx + 1)
+            layers.extend(self._appended)
+
+            defaults = dict(oconf.defaults)
+            seed = oconf.seed
+            if self._ftc is not None:
+                defaults.update(self._ftc.overrides)
+                seed = self._ftc.overrides.get("seed", seed)
+                for i, l in enumerate(layers):
+                    if i > self._frozenTill:
+                        self._ftc.applyToLayer(l)
+            for i in range(min(self._frozenTill + 1, len(layers))):
+                layers[i].frozen = True
+
+            # retained prefix keeps its explicit preprocessors; inferShapes
+            # re-derives the automatic ones for the (possibly new) tail
+            pps = {i: copy.deepcopy(pp) for i, pp in oconf.preprocessors.items()
+                   if i < n_keep}
+            conf = MultiLayerConfiguration(
+                layers=layers,
+                defaults=defaults,
+                seed=seed,
+                dataType=oconf.dataType,
+                inputType=oconf.inputType,
+                preprocessors=pps,
+                backpropType=oconf.backpropType,
+                tbpttFwdLength=oconf.tbpttFwdLength,
+                tbpttBackLength=oconf.tbpttBackLength,
+                gradientNormalization=oconf.gradientNormalization,
+                gradientNormalizationThreshold=oconf.gradientNormalizationThreshold,
+            )
+            conf.inferShapes()
+
+            net = MultiLayerNetwork(conf)
+            net.init()
+            # graft trained weights into retained layers
+            for i in range(n_keep):
+                if i in fresh:
+                    continue
+                old_p, new_p = orig._params[i], net._params[i]
+                for k in new_p:
+                    if old_p[k].shape != new_p[k].shape:
+                        raise ValueError(
+                            f"layer {i} param '{k}' shape changed "
+                            f"{old_p[k].shape} -> {new_p[k].shape}; use nOutReplace")
+                # device copies, not references: the new net's train step
+                # donates its buffers, which would invalidate the original
+                # network's params on TPU
+                import jax.numpy as jnp
+
+                net._params[i] = jax.tree_util.tree_map(jnp.copy, old_p)
+                net._states[i] = jax.tree_util.tree_map(jnp.copy, orig._states[i])
+            return net
+
+
+class TransferLearningHelper:
+    """Featurize once through the frozen bottom, train only the top
+    (reference: transferlearning.TransferLearningHelper). Saves recomputing
+    the frozen forward for every epoch over a static dataset."""
+
+    def __init__(self, net: MultiLayerNetwork, frozenTill: int):
+        self._net = net
+        self._split = int(frozenTill) + 1
+        # unfrozen top as its own network over the featurized input
+        top_conf = MultiLayerConfiguration(
+            layers=net.conf.layers[self._split:],
+            defaults=net.conf.defaults,
+            seed=net.conf.seed,
+            dataType=net.conf.dataType,
+            inputType=net.conf.layerInputTypes[self._split],
+            preprocessors={i - self._split: pp
+                           for i, pp in net.conf.preprocessors.items()
+                           if i >= self._split},
+            backpropType=net.conf.backpropType,
+            tbpttFwdLength=net.conf.tbpttFwdLength,
+            tbpttBackLength=net.conf.tbpttBackLength,
+        )
+        top_conf.layerInputTypes = net.conf.layerInputTypes[self._split:]
+        self._top = MultiLayerNetwork(top_conf)
+        # device copies: the top net's train step donates its buffers, which
+        # must not alias the full network's params (see Builder.build)
+        import jax.numpy as jnp
+
+        cp = lambda t: jax.tree_util.tree_map(jnp.copy, t)
+        self._top.initFrom([cp(net._params[i]) for i in range(self._split, len(net.layers))],
+                           [cp(net._states[i]) for i in range(self._split, len(net.layers))])
+
+    def featurize(self, dataset):
+        """Run the frozen bottom; returns a DataSet of (features at the
+        boundary, original labels)."""
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+        acts = self._net.feedForward(dataset.getFeatures())
+        feat = acts[self._split]
+        # boundary activations are in internal format; CNN is NHWC internally
+        # but NCHW at the API/DataSet boundary — convert back so the top
+        # net's input transpose (and the user-visible DataSet) stay correct
+        if (self._top.conf.inputType.kind == InputType.CNN
+                and feat.rank() == 4):
+            feat = feat.permute(0, 3, 1, 2)
+        return DataSet(feat, dataset.getLabels(),
+                       dataset.getFeaturesMaskArray(),
+                       dataset.getLabelsMaskArray())
+
+    def fitFeaturized(self, dataset):
+        self._top.fit(dataset)
+        # write trained top params back into the full net
+        for j in range(len(self._top.layers)):
+            self._net._params[self._split + j] = self._top._params[j]
+            self._net._states[self._split + j] = self._top._states[j]
+        return self
+
+    def outputFromFeaturized(self, features):
+        return self._top.output(features)
+
+    def unfrozenMLN(self) -> MultiLayerNetwork:
+        return self._top

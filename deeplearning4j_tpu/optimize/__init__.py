@@ -1,0 +1,44 @@
+"""Training-loop orchestration: listeners and early stopping.
+
+Reference: org.deeplearning4j.optimize (listeners, Solver) and
+org.deeplearning4j.earlystopping.
+"""
+
+from deeplearning4j_tpu.optimize.listeners import (
+    TrainingListener,
+    ScoreIterationListener,
+    PerformanceListener,
+    EvaluativeListener,
+    CheckpointListener,
+    CollectScoresListener,
+    TimeIterationListener,
+    StatsListener,
+    NanScoreWatcher,
+)
+from deeplearning4j_tpu.optimize.earlystopping import (
+    EarlyStoppingConfiguration,
+    EarlyStoppingTrainer,
+    EarlyStoppingGraphTrainer,
+    EarlyStoppingResult,
+    TerminationReason,
+    MaxEpochsTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+    BestScoreEpochTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+    DataSetLossCalculator,
+    InMemoryModelSaver,
+    LocalFileModelSaver,
+)
+
+__all__ = [
+    "TrainingListener", "ScoreIterationListener", "PerformanceListener",
+    "EvaluativeListener", "CheckpointListener", "CollectScoresListener",
+    "TimeIterationListener", "StatsListener", "NanScoreWatcher",
+    "EarlyStoppingConfiguration", "EarlyStoppingTrainer",
+    "EarlyStoppingGraphTrainer", "EarlyStoppingResult", "TerminationReason",
+    "MaxEpochsTerminationCondition", "ScoreImprovementEpochTerminationCondition",
+    "BestScoreEpochTerminationCondition", "MaxScoreIterationTerminationCondition",
+    "MaxTimeIterationTerminationCondition", "DataSetLossCalculator",
+    "InMemoryModelSaver", "LocalFileModelSaver",
+]

@@ -1,0 +1,146 @@
+"""Arbiter hyperparameter search (reference: arbiter-deeplearning4j tests)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.arbiter import (
+    ContinuousParameterSpace, DiscreteParameterSpace, IntegerParameterSpace,
+    RandomSearchGenerator, GridSearchCandidateGenerator,
+    TestSetLossScoreFunction, EvaluationScoreFunction,
+    MaxCandidatesCondition, MaxTimeCondition,
+    OptimizationConfiguration, LocalOptimizationRunner,
+)
+from deeplearning4j_tpu.nn import (
+    NeuralNetConfiguration, DenseLayer, OutputLayer, MultiLayerNetwork, Adam,
+)
+from deeplearning4j_tpu.nn.losses import LossFunctions
+from deeplearning4j_tpu.data import DataSetIterator
+
+LF = LossFunctions.LossFunction
+
+
+def _data(seed=0, n=64):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 6).astype("float32")
+    y = (X.sum(1) > 0).astype(int)
+    return DataSetIterator(X, np.eye(2, dtype="float32")[y], 32)
+
+
+def _builder(candidate):
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(7).updater(Adam(candidate["lr"]))
+            .list()
+            .layer(DenseLayer(nIn=6, nOut=candidate.get("hidden", 8),
+                              activation=candidate.get("act", "tanh")))
+            .layer(OutputLayer(nOut=2, activation="softmax", lossFunction=LF.MCXENT))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestSpaces:
+    def test_continuous(self):
+        rng = np.random.RandomState(0)
+        s = ContinuousParameterSpace(0.1, 0.5)
+        vals = [s.sample(rng) for _ in range(100)]
+        assert all(0.1 <= v <= 0.5 for v in vals)
+        assert s.grid(3) == [0.1, pytest.approx(0.3), 0.5]
+
+    def test_continuous_log(self):
+        rng = np.random.RandomState(0)
+        s = ContinuousParameterSpace(1e-4, 1e-1, log=True)
+        vals = [s.sample(rng) for _ in range(200)]
+        assert all(1e-4 <= v <= 1e-1 for v in vals)
+        # log-uniform: ~half the mass below the geometric midpoint
+        mid = 10 ** (-2.5)
+        frac = sum(v < mid for v in vals) / len(vals)
+        assert 0.35 < frac < 0.65
+        g = s.grid(4)
+        assert g[0] == pytest.approx(1e-4) and g[-1] == pytest.approx(1e-1)
+
+    def test_discrete_and_integer(self):
+        rng = np.random.RandomState(0)
+        d = DiscreteParameterSpace("relu", "tanh")
+        assert set(d.sample(rng) for _ in range(50)) == {"relu", "tanh"}
+        i = IntegerParameterSpace(4, 16)
+        vals = [i.sample(rng) for _ in range(100)]
+        assert min(vals) >= 4 and max(vals) <= 16
+        assert i.grid(3) == [4, 10, 16]
+        assert i.grid(100) == list(range(4, 17))
+
+
+class TestGenerators:
+    def test_grid_enumerates_product(self):
+        gen = GridSearchCandidateGenerator(
+            {"lr": ContinuousParameterSpace(1e-3, 1e-1),
+             "act": DiscreteParameterSpace("relu", "tanh")},
+            discretizationCount=3)
+        seen = []
+        while gen.hasMore():
+            seen.append(gen.next())
+        assert len(seen) == 6
+        assert len({(c["lr"], c["act"]) for c in seen}) == 6
+
+    def test_random_reproducible(self):
+        spaces = {"lr": ContinuousParameterSpace(1e-3, 1e-1)}
+        g1 = RandomSearchGenerator(spaces, seed=9)
+        g2 = RandomSearchGenerator(spaces, seed=9)
+        assert [g1.next() for _ in range(5)] == [g2.next() for _ in range(5)]
+
+
+class TestRunner:
+    def test_random_search_finds_working_lr(self):
+        conf = (OptimizationConfiguration.Builder()
+                .candidateGenerator(RandomSearchGenerator(
+                    {"lr": ContinuousParameterSpace(1e-3, 1e-1, log=True)}, seed=1))
+                .scoreFunction(TestSetLossScoreFunction(_data(seed=1)))
+                .terminationConditions(MaxCandidatesCondition(5))
+                .epochsPerCandidate(20)
+                .build())
+        result = LocalOptimizationRunner(conf, _builder, _data(seed=0)).execute()
+        assert len(result.results) == 5
+        assert result.bestScore() == min(r.score for r in result.results)
+        assert result.bestScore() < 0.5
+        assert result.bestModel() is not None
+
+    def test_grid_search_accuracy_maximized(self):
+        conf = (OptimizationConfiguration.Builder()
+                .candidateGenerator(GridSearchCandidateGenerator(
+                    {"lr": DiscreteParameterSpace(1e-9, 3e-2),
+                     "act": DiscreteParameterSpace("relu", "tanh")}))
+                .scoreFunction(EvaluationScoreFunction(_data(seed=1), "accuracy"))
+                .terminationConditions(MaxCandidatesCondition(100))
+                .epochsPerCandidate(15)
+                .build())
+        result = LocalOptimizationRunner(conf, _builder, _data(seed=0)).execute()
+        assert len(result.results) == 4
+        assert result.bestScore() == max(r.score for r in result.results)
+        # the real lr must beat the degenerate one
+        assert result.bestCandidate()["lr"] == pytest.approx(3e-2)
+
+    def test_failed_candidate_does_not_kill_search(self):
+        def builder(candidate):
+            if candidate["hidden"] == 0:
+                raise ValueError("bad config")
+            return _builder({"lr": 1e-2, "hidden": candidate["hidden"]})
+
+        conf = (OptimizationConfiguration.Builder()
+                .candidateGenerator(GridSearchCandidateGenerator(
+                    {"hidden": DiscreteParameterSpace(0, 8)}))
+                .scoreFunction(TestSetLossScoreFunction(_data(seed=1)))
+                .terminationConditions(MaxCandidatesCondition(10))
+                .epochsPerCandidate(3)
+                .build())
+        result = LocalOptimizationRunner(conf, builder, _data(seed=0)).execute()
+        assert len(result.results) == 2
+        assert result.results[0].error is not None
+        assert result.bestCandidate() == {"hidden": 8}
+
+    def test_max_time_condition(self):
+        conf = (OptimizationConfiguration.Builder()
+                .candidateGenerator(RandomSearchGenerator(
+                    {"lr": ContinuousParameterSpace(1e-3, 1e-1)}))
+                .scoreFunction(TestSetLossScoreFunction(_data(seed=1)))
+                .terminationConditions(MaxCandidatesCondition(3), MaxTimeCondition(0.0))
+                .build())
+        with pytest.raises(RuntimeError):
+            LocalOptimizationRunner(conf, _builder, _data(seed=0)).execute()

@@ -203,3 +203,19 @@ class TestReviewRegressions:
         x = np.array([-3.0, 0.0, 1.0, 3.0])
         np.testing.assert_allclose(T.hardSigmoid(Nd4j.create(x)).toNumpy(),
                                    np.clip(0.2 * x + 0.5, 0, 1), rtol=1e-6)
+
+
+class TestResetLoudness:
+    def test_regression_reset_drops_accumulators(self):
+        import numpy as np
+        import pytest
+        from deeplearning4j_tpu.evaluation import RegressionEvaluation
+
+        e = RegressionEvaluation()
+        e.eval(np.ones((4, 2)), np.zeros((4, 2)))
+        assert e.meanSquaredError(0) == 1.0
+        e.reset()
+        with pytest.raises((AttributeError, TypeError)):
+            e.meanSquaredError(0)
+        e.eval(np.ones((4, 2)), np.ones((4, 2)))
+        assert e.meanSquaredError(0) == 0.0

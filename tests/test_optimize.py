@@ -1,0 +1,267 @@
+"""Listeners + early stopping (reference: deeplearning4j-core
+org.deeplearning4j.earlystopping.TestEarlyStopping and listener tests)."""
+
+import math
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import (
+    NeuralNetConfiguration, DenseLayer, OutputLayer, MultiLayerNetwork, Adam, Sgd,
+)
+from deeplearning4j_tpu.nn.losses import LossFunctions
+from deeplearning4j_tpu.data import DataSet, DataSetIterator
+from deeplearning4j_tpu.optimize import (
+    ScoreIterationListener, PerformanceListener, EvaluativeListener,
+    CheckpointListener, CollectScoresListener, StatsListener, NanScoreWatcher,
+    EarlyStoppingConfiguration, EarlyStoppingTrainer, EarlyStoppingResult,
+    TerminationReason, MaxEpochsTerminationCondition,
+    ScoreImprovementEpochTerminationCondition, BestScoreEpochTerminationCondition,
+    MaxScoreIterationTerminationCondition, MaxTimeIterationTerminationCondition,
+    DataSetLossCalculator, InMemoryModelSaver, LocalFileModelSaver,
+)
+
+LF = LossFunctions.LossFunction
+
+
+def _toy_net(lr=5e-2, seed=7):
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(seed).updater(Adam(lr))
+            .list()
+            .layer(DenseLayer(nIn=4, nOut=16, activation="tanh"))
+            .layer(OutputLayer(nIn=16, nOut=2, activation="softmax",
+                               lossFunction=LF.MCXENT))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+def _toy_data(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 4).astype("float32")
+    y = (X.sum(1) > 0).astype(int)
+    Y = np.eye(2, dtype="float32")[y]
+    return DataSet(X, Y)
+
+
+def _iterator(n=64, batch=16, seed=0):
+    ds = _toy_data(n, seed)
+    return DataSetIterator(ds.getFeatures(), ds.getLabels(), batch)
+
+
+class TestListeners:
+    def test_collect_scores(self):
+        net = _toy_net()
+        c = CollectScoresListener()
+        net.setListeners(c)
+        net.fit(_iterator(), epochs=3)
+        assert len(c.scores) == 12  # 4 batches x 3 epochs
+        assert c.iterations == list(range(1, 13))
+        assert all(math.isfinite(s) for s in c.scores)
+        # separable toy data: training should improve the score
+        assert c.scores[-1] < c.scores[0]
+
+    def test_score_iteration_listener_prints(self, capsys):
+        net = _toy_net()
+        net.setListeners(ScoreIterationListener(2))
+        net.fit(_iterator(), epochs=1)
+        out = capsys.readouterr().out
+        assert "Score at iteration 2" in out
+        assert "Score at iteration 4" in out
+
+    def test_performance_listener(self, capsys):
+        net = _toy_net()
+        net.setListeners(PerformanceListener(frequency=2, reportScore=True))
+        net.fit(_iterator(), epochs=2)
+        out = capsys.readouterr().out
+        assert "iterations/sec" in out
+
+    def test_evaluative_listener_epoch(self):
+        net = _toy_net()
+        seen = []
+        lst = EvaluativeListener(_iterator(seed=1), invocationType=EvaluativeListener.EPOCH)
+        lst.callback = lambda e: seen.append(e.accuracy())
+        net.setListeners(lst)
+        net.fit(_iterator(), epochs=3)
+        assert len(seen) == 3
+        assert seen[-1] >= 0.5
+
+    def test_checkpoint_listener_rotation(self, tmp_path):
+        net = _toy_net()
+        cl = CheckpointListener(tmp_path, saveEveryNIterations=2, keepLast=2)
+        net.setListeners(cl)
+        net.fit(_iterator(), epochs=2)  # 8 iterations -> 4 saves, keep 2
+        files = sorted(os.listdir(tmp_path))
+        assert len(files) == 2
+        assert cl.lastCheckpoint().endswith("checkpoint_iter_8.npz")
+        # the rotated checkpoint restores into a working model
+        from deeplearning4j_tpu.util.serializer import ModelSerializer
+
+        restored = ModelSerializer.restoreMultiLayerNetwork(cl.lastCheckpoint())
+        ds = _toy_data()
+        assert math.isfinite(restored.score(ds))
+
+    def test_stats_listener_jsonl(self, tmp_path):
+        log = tmp_path / "stats.jsonl"
+        net = _toy_net()
+        net.setListeners(StatsListener(logFile=log, frequency=1, collectHistograms=True))
+        net.fit(_iterator(), epochs=2)
+        lines = log.read_text().strip().splitlines()
+        import json
+
+        recs = [json.loads(l) for l in lines]
+        assert sum(r["type"] == "stats" for r in recs) == 8
+        assert sum(r["type"] == "epochEnd" for r in recs) == 2
+        assert all("paramMeanAbs" in r for r in recs if r["type"] == "stats")
+        assert "records" in StatsListener(logFile=log).summary()
+
+    def test_nan_watcher_raises(self):
+        net = _toy_net()
+        net.setListeners(NanScoreWatcher())
+        ds = _toy_data()
+        X = np.asarray(ds.getFeatures().toNumpy()).copy()
+        X[0, 0] = np.nan  # poisoned batch -> non-finite loss
+        with pytest.raises(FloatingPointError):
+            net.fit(DataSet(X, ds.getLabels()))
+
+
+class TestEarlyStopping:
+    def test_max_epochs(self):
+        net = _toy_net()
+        conf = (EarlyStoppingConfiguration.Builder()
+                .epochTerminationConditions(MaxEpochsTerminationCondition(3))
+                .scoreCalculator(DataSetLossCalculator(_iterator(seed=1)))
+                .modelSaver(InMemoryModelSaver())
+                .build())
+        result = EarlyStoppingTrainer(conf, net, _iterator()).fit()
+        assert result.terminationReason == TerminationReason.EpochTerminationCondition
+        assert result.totalEpochs == 3
+        assert len(result.scoreVsEpoch) == 3
+        assert result.getBestModel() is not None
+
+    def test_score_improvement_stops_early(self):
+        # lr=0 -> score never improves -> stops after patience epochs
+        net = _toy_net(lr=0.0)
+        conf = (EarlyStoppingConfiguration.Builder()
+                .epochTerminationConditions(
+                    MaxEpochsTerminationCondition(50),
+                    ScoreImprovementEpochTerminationCondition(2, minImprovement=1e-9))
+                .scoreCalculator(DataSetLossCalculator(_iterator(seed=1)))
+                .build())
+        result = EarlyStoppingTrainer(conf, net, _iterator()).fit()
+        assert result.terminationReason == TerminationReason.EpochTerminationCondition
+        assert "ScoreImprovement" in result.terminationDetails
+        assert result.totalEpochs < 50
+
+    def test_best_score_condition(self):
+        net = _toy_net(lr=5e-2)
+        conf = (EarlyStoppingConfiguration.Builder()
+                .epochTerminationConditions(
+                    MaxEpochsTerminationCondition(200),
+                    BestScoreEpochTerminationCondition(0.15))
+                .scoreCalculator(DataSetLossCalculator(_iterator(seed=0)))  # train data
+                .build())
+        result = EarlyStoppingTrainer(conf, net, _iterator()).fit()
+        assert result.terminationReason == TerminationReason.EpochTerminationCondition
+        assert result.bestModelScore <= 0.16
+
+    def test_iteration_condition_score_explosion(self):
+        net = _toy_net(lr=1e9)
+        conf = (EarlyStoppingConfiguration.Builder()
+                .epochTerminationConditions(MaxEpochsTerminationCondition(50))
+                .iterationTerminationConditions(MaxScoreIterationTerminationCondition(100.0))
+                .scoreCalculator(DataSetLossCalculator(_iterator(seed=1)))
+                .build())
+        result = EarlyStoppingTrainer(conf, net, _iterator()).fit()
+        assert result.terminationReason == TerminationReason.IterationTerminationCondition
+        # guard listener must be detached after fit
+        assert net._listeners == []
+
+    def test_max_time_condition(self):
+        net = _toy_net()
+        conf = (EarlyStoppingConfiguration.Builder()
+                .epochTerminationConditions(MaxEpochsTerminationCondition(100000))
+                .iterationTerminationConditions(MaxTimeIterationTerminationCondition(0.0))
+                .scoreCalculator(DataSetLossCalculator(_iterator(seed=1)))
+                .build())
+        result = EarlyStoppingTrainer(conf, net, _iterator()).fit()
+        assert result.terminationReason == TerminationReason.IterationTerminationCondition
+
+    def test_best_model_is_restored_snapshot(self):
+        net = _toy_net()
+        saver = InMemoryModelSaver()
+        val = _iterator(seed=1)
+        conf = (EarlyStoppingConfiguration.Builder()
+                .epochTerminationConditions(MaxEpochsTerminationCondition(5))
+                .scoreCalculator(DataSetLossCalculator(val))
+                .modelSaver(saver)
+                .build())
+        result = EarlyStoppingTrainer(conf, net, _iterator()).fit()
+        best = result.getBestModel()
+        calc = DataSetLossCalculator(val)
+        assert calc.calculateScore(best) == pytest.approx(result.bestModelScore, abs=1e-6)
+
+    def test_snapshot_does_not_alias_live_buffers(self):
+        # the train step donates param buffers: a snapshot holding bare
+        # references would be invalidated by the next fit on TPU
+        net = _toy_net()
+        saver = InMemoryModelSaver()
+        saver.saveBestModel(net, 0.5)
+        snap = saver._best[0]
+        for live, saved in zip(net._params, snap["params"]):
+            for k in live:
+                assert live[k] is not saved[k]
+
+    def test_duck_typed_listener_without_epoch_hooks(self):
+        class Minimal:
+            seen = 0
+
+            def iterationDone(self, model, it, ep):
+                Minimal.seen += 1
+
+        net = _toy_net()
+        net.setListeners(Minimal())
+        net.fit(_iterator(), epochs=1)  # must not raise on epoch hooks
+        assert Minimal.seen == 4
+
+    def test_skipped_eval_epochs_do_not_mix_metrics(self):
+        # maximizing metric + evaluateEveryNEpochs>1: training loss must not
+        # leak into the termination-condition score stream
+        class AccuracyCalc:
+            def __init__(self, it):
+                self.it = it
+
+            def minimizeScore(self):
+                return False
+
+            def calculateScore(self, model):
+                return model.evaluate(self.it).accuracy()
+
+        net = _toy_net(lr=0.0)  # accuracy stays at its initial value < 0.95
+        conf = (EarlyStoppingConfiguration.Builder()
+                .epochTerminationConditions(
+                    MaxEpochsTerminationCondition(6),
+                    BestScoreEpochTerminationCondition(0.95))
+                .scoreCalculator(AccuracyCalc(_iterator(seed=1)))
+                .evaluateEveryNEpochs(5)
+                .build())
+        result = EarlyStoppingTrainer(conf, net, _iterator()).fit()
+        # a leaked training loss (~0.7-2.3) would satisfy >=0.95 immediately
+        assert result.totalEpochs == 6
+        assert "MaxEpochs" in result.terminationDetails
+
+    def test_local_file_saver_roundtrip(self, tmp_path):
+        net = _toy_net()
+        conf = (EarlyStoppingConfiguration.Builder()
+                .epochTerminationConditions(MaxEpochsTerminationCondition(2))
+                .scoreCalculator(DataSetLossCalculator(_iterator(seed=1)))
+                .modelSaver(LocalFileModelSaver(tmp_path))
+                .saveLastModel(True)
+                .build())
+        result = EarlyStoppingTrainer(conf, net, _iterator()).fit()
+        assert os.path.exists(tmp_path / "bestModel.npz")
+        assert os.path.exists(tmp_path / "latestModel.npz")
+        best = result.getBestModel()
+        assert math.isfinite(best.score(_toy_data()))
