@@ -38,6 +38,8 @@ class ContinuousParameterSpace(ParameterSpace):
 
     def grid(self, n):
         if n == 1:
+            if self.log:  # geometric mean is the log-scale center
+                return [float(math.sqrt(self.min * self.max))]
             return [0.5 * (self.min + self.max)]
         if self.log:
             return [float(v) for v in np.geomspace(self.min, self.max, n)]

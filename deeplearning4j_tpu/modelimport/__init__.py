@@ -1,0 +1,17 @@
+"""Keras model import.
+
+Reference: deeplearning4j-modelimport —
+org.deeplearning4j.nn.modelimport.keras.KerasModelImport.
+"""
+
+from deeplearning4j_tpu.modelimport.keras import (
+    KerasModelImport,
+    InvalidKerasConfigurationException,
+    UnsupportedKerasConfigurationException,
+)
+
+__all__ = [
+    "KerasModelImport",
+    "InvalidKerasConfigurationException",
+    "UnsupportedKerasConfigurationException",
+]

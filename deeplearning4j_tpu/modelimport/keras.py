@@ -1,0 +1,484 @@
+"""Keras model import: config JSON (+ optional weights) → native networks.
+
+Reference: org.deeplearning4j.nn.modelimport.keras.KerasModelImport /
+KerasSequentialModel / KerasLayer subclasses. The reference parses Keras 1/2
+model JSON and HDF5 weights into DL4J configurations; this importer parses
+Keras 2 (tf.keras legacy) and Keras 3 `model.to_json()` output into
+MultiLayerConfiguration (Sequential) or ComputationGraphConfiguration
+(Functional), with weights from a legacy Keras HDF5 file, a full legacy
+HDF5 model, or a {layerName: [arrays...]} mapping (e.g. collected from
+`layer.get_weights()`).
+
+Data-format note: imported networks use THIS framework's API conventions —
+CNN inputs NCHW, recurrent inputs NCW [B, F, T] — regardless of Keras'
+channels_last/time-major layout. Weight layouts happen to agree for Dense
+(in,out) and Conv2D (HWIO); LSTM gate columns are reordered from Keras
+[i,f,g,o] to the native [i,f,o,g].
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf import recurrent as R
+from deeplearning4j_tpu.nn.conf.builder import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+
+class InvalidKerasConfigurationException(ValueError):
+    pass
+
+
+class UnsupportedKerasConfigurationException(ValueError):
+    pass
+
+
+_ACTIVATIONS = {
+    "relu": "relu", "sigmoid": "sigmoid", "tanh": "tanh", "softmax": "softmax",
+    "linear": "identity", "elu": "elu", "selu": "selu", "softplus": "softplus",
+    "softsign": "softsign", "swish": "swish", "silu": "swish", "gelu": "gelu",
+    "hard_sigmoid": "hardsigmoid", "leaky_relu": "lrelu", "exponential": "exp",
+    "mish": "mish",
+}
+
+
+def _act(name):
+    if name is None:
+        return "identity"
+    try:
+        return _ACTIVATIONS[str(name)]
+    except KeyError:
+        raise UnsupportedKerasConfigurationException(
+            f"unsupported Keras activation '{name}'") from None
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v), int(v))
+
+
+def _conv_mode(padding):
+    p = str(padding).lower()
+    if p == "valid":
+        return "truncate"
+    if p == "same":
+        return "same"
+    raise UnsupportedKerasConfigurationException(f"unsupported padding '{padding}'")
+
+
+def _input_type_from_shape(shape):
+    """Keras shape tuple (batch dim stripped) → InputType. channels_last:
+    (H,W,C) → CNN; (T,F) → recurrent [F,T]; (N,) → feedForward."""
+    dims = [d for d in shape if d is not None]
+    if len(dims) == 3:
+        h, w, c = dims
+        return InputType.convolutional(h, w, c)
+    if len(dims) == 2:
+        t, f = dims
+        return InputType.recurrent(f, t)
+    if len(dims) == 1:
+        return InputType.feedForward(dims[0])
+    raise UnsupportedKerasConfigurationException(f"unsupported input shape {shape}")
+
+
+class _KerasLayerSpec:
+    """One parsed Keras layer: class name, config, inbound names."""
+
+    def __init__(self, raw):
+        self.className = raw.get("class_name")
+        self.config = raw.get("config", {})
+        self.name = self.config.get("name") or raw.get("name")
+        self.inbound = []
+        for node in raw.get("inbound_nodes", []):
+            if isinstance(node, dict):  # Keras 3: {"args": [...]} history refs
+                for a in _walk_keras3_history(node):
+                    self.inbound.append(a)
+            elif isinstance(node, list):  # Keras 2: [[name, idx, tensor_idx, {}]...]
+                for ref in node:
+                    self.inbound.append(ref[0])
+
+    def inputShape(self):
+        for k in ("batch_input_shape", "batch_shape"):
+            if self.config.get(k):
+                return self.config[k][1:]
+        return None
+
+
+def _walk_keras3_history(node):
+    """Extract inbound layer names from a Keras-3 serialized call node."""
+    out = []
+
+    def rec(obj):
+        if isinstance(obj, dict):
+            if obj.get("class_name") == "__keras_tensor__":
+                hist = obj.get("config", {}).get("keras_history")
+                if hist:
+                    out.append(hist[0])
+            else:
+                for v in obj.values():
+                    rec(v)
+        elif isinstance(obj, (list, tuple)):
+            for v in obj:
+                rec(v)
+
+    rec(node.get("args", []))
+    rec(node.get("kwargs", {}))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# layer conversion
+# ---------------------------------------------------------------------------
+
+def _convert_layer(spec: _KerasLayerSpec, is_last: bool):
+    """Keras layer spec → (native layer | None, activation carried)."""
+    cn, cfg = spec.className, spec.config
+    name = spec.name
+
+    if cn == "InputLayer":
+        return None
+    if cn == "Dense":
+        act = _act(cfg.get("activation"))
+        units = int(cfg["units"])
+        bias = bool(cfg.get("use_bias", True))
+        if is_last:
+            loss = {"softmax": "mcxent", "sigmoid": "xent"}.get(act, "mse")
+            return L.OutputLayer(nOut=units, activation=act, hasBias=bias,
+                                 lossFunction=loss, name=name)
+        return L.DenseLayer(nOut=units, activation=act, hasBias=bias, name=name)
+    if cn == "Conv2D":
+        return L.ConvolutionLayer(
+            nOut=int(cfg["filters"]), kernelSize=_pair(cfg["kernel_size"]),
+            stride=_pair(cfg.get("strides", 1)),
+            dilation=_pair(cfg.get("dilation_rate", 1)),
+            convolutionMode=_conv_mode(cfg.get("padding", "valid")),
+            hasBias=bool(cfg.get("use_bias", True)),
+            activation=_act(cfg.get("activation")), name=name)
+    if cn == "DepthwiseConv2D":
+        return L.DepthwiseConvolution2D(
+            depthMultiplier=int(cfg.get("depth_multiplier", 1)),
+            kernelSize=_pair(cfg["kernel_size"]),
+            stride=_pair(cfg.get("strides", 1)),
+            convolutionMode=_conv_mode(cfg.get("padding", "valid")),
+            hasBias=bool(cfg.get("use_bias", True)),
+            activation=_act(cfg.get("activation")), name=name)
+    if cn in ("MaxPooling2D", "AveragePooling2D"):
+        return L.SubsamplingLayer(
+            poolingType="max" if cn.startswith("Max") else "avg",
+            kernelSize=_pair(cfg.get("pool_size", 2)),
+            stride=_pair(cfg.get("strides") or cfg.get("pool_size", 2)),
+            convolutionMode=_conv_mode(cfg.get("padding", "valid")), name=name)
+    if cn in ("GlobalMaxPooling2D", "GlobalAveragePooling2D",
+              "GlobalMaxPooling1D", "GlobalAveragePooling1D"):
+        return L.GlobalPoolingLayer(
+            poolingType="max" if "Max" in cn else "avg", name=name)
+    if cn == "Flatten":
+        return None  # our shape inference auto-inserts CnnToFeedForward
+    if cn == "Dropout":
+        return L.DropoutLayer(dropOut=1.0 - float(cfg.get("rate", 0.5)), name=name)
+    if cn == "Activation":
+        return L.ActivationLayer(activation=_act(cfg.get("activation")), name=name)
+    if cn == "BatchNormalization":
+        return L.BatchNormalization(
+            decay=float(cfg.get("momentum", 0.99)),
+            eps=float(cfg.get("epsilon", 1e-3)),
+            lockGammaBeta=not (cfg.get("scale", True) or cfg.get("center", True)),
+            name=name)
+    if cn == "ZeroPadding2D":
+        pad = cfg.get("padding", 1)
+        if isinstance(pad, (list, tuple)) and pad and isinstance(pad[0], (list, tuple)):
+            pad = (pad[0][0], pad[1][0])  # symmetric subset
+        return L.ZeroPaddingLayer(padding=_pair(pad), name=name)
+    if cn == "UpSampling2D":
+        return L.Upsampling2D(size=_pair(cfg.get("size", 2))[0], name=name)
+    if cn == "Embedding":
+        return L.EmbeddingSequenceLayer(
+            nIn=int(cfg["input_dim"]), nOut=int(cfg["output_dim"]), name=name)
+    if cn in ("LSTM", "SimpleRNN", "GRU"):
+        cls = {"LSTM": R.LSTM, "SimpleRNN": R.SimpleRnn, "GRU": R.GRU}[cn]
+        inner = cls(nOut=int(cfg["units"]), activation=_act(cfg.get("activation")),
+                    name=name)
+        if cn == "LSTM":
+            inner.gateActivationFn = _act(cfg.get("recurrent_activation", "sigmoid"))
+        if not cfg.get("return_sequences", False):
+            return R.LastTimeStep(inner)
+        return inner
+    if cn == "Bidirectional":
+        inner_spec = _KerasLayerSpec(cfg["layer"])
+        inner = _convert_layer(inner_spec, False)
+        mode = {"concat": "concat", "sum": "add", "ave": "average", "mul": "mul"}[
+            cfg.get("merge_mode", "concat")]
+        return R.Bidirectional(layer=inner, mode=mode, name=name)
+    raise UnsupportedKerasConfigurationException(
+        f"unsupported Keras layer '{cn}' (layer '{name}')")
+
+
+# ---------------------------------------------------------------------------
+# weight conversion
+# ---------------------------------------------------------------------------
+
+def _flatten_reorder(kernel, h, w, c):
+    """Dense kernel rows after a Keras Flatten are in (h,w,c) order; our
+    CnnToFeedForward flattens (c,h,w). Permute rows accordingly."""
+    out = kernel.shape[1]
+    return kernel.reshape(h, w, c, out).transpose(2, 0, 1, 3).reshape(h * w * c, out)
+
+
+def _lstm_reorder(k, H):
+    """Keras gate columns [i, f, g, o] → native [i, f, o, g]."""
+    i, f, g, o = k[..., :H], k[..., H:2 * H], k[..., 2 * H:3 * H], k[..., 3 * H:]
+    return np.concatenate([i, f, o, g], axis=-1)
+
+
+def _apply_weights(layer, weights, params, state):
+    """Write Keras weight arrays into a native layer's param/state dicts.
+    Returns updated (params, state)."""
+    import jax.numpy as jnp
+
+    cn = type(layer).__name__
+    p = dict(params)
+    s = dict(state)
+
+    def put(key, arr):
+        tgt = p[key]
+        arr = np.asarray(arr)
+        if tuple(tgt.shape) != tuple(arr.shape):
+            raise InvalidKerasConfigurationException(
+                f"weight shape mismatch for {cn}.{key}: "
+                f"model {tuple(tgt.shape)} vs h5 {tuple(arr.shape)}")
+        p[key] = jnp.asarray(arr, tgt.dtype)
+
+    if isinstance(layer, R.LastTimeStep):
+        return _apply_weights(layer.layer, weights, params, state)
+    if isinstance(layer, (L.DenseLayer, L.BaseOutputLayer, L.ConvolutionLayer)) \
+            and not isinstance(layer, L.Convolution1DLayer):
+        put("W", weights[0])
+        if len(weights) > 1 and "b" in p:
+            put("b", weights[1])
+        return p, s
+    if isinstance(layer, (L.EmbeddingLayer, L.EmbeddingSequenceLayer)):
+        put("W", weights[0])
+        return p, s
+    if isinstance(layer, L.BatchNormalization):
+        idx = 0
+        if "gamma" in p:
+            put("gamma", weights[idx]); idx += 1
+        if "beta" in p:
+            put("beta", weights[idx]); idx += 1
+        s["mean"] = jnp.asarray(np.asarray(weights[idx]), jnp.float32)
+        s["var"] = jnp.asarray(np.asarray(weights[idx + 1]), jnp.float32)
+        return p, s
+    if isinstance(layer, R.LSTM):
+        H = layer.nOut
+        put("W", _lstm_reorder(np.asarray(weights[0]), H))
+        put("RW", _lstm_reorder(np.asarray(weights[1]), H))
+        if len(weights) > 2:
+            b = np.asarray(weights[2])
+            if b.ndim == 2:  # CuDNN-fused double bias
+                b = b.sum(0)
+            put("b", _lstm_reorder(b, H))
+        return p, s
+    if isinstance(layer, R.SimpleRnn):
+        put("W", weights[0])
+        put("RW", weights[1])
+        if len(weights) > 2:
+            put("b", weights[2])
+        return p, s
+    raise UnsupportedKerasConfigurationException(
+        f"weight import not supported for layer type {cn}")
+
+
+def _load_h5_weights(path):
+    """Legacy Keras HDF5 → {layerName: [np.ndarray, ...]} in weight_names
+    order. Works for both full-model files (model_weights group) and
+    save_weights files (layers at the root)."""
+    import h5py
+
+    out = {}
+    with h5py.File(path, "r") as f:
+        root = f["model_weights"] if "model_weights" in f else f
+        for lname in root:
+            g = root[lname]
+            wnames = [n.decode() if isinstance(n, bytes) else n
+                      for n in g.attrs.get("weight_names", [])]
+            arrs = [np.asarray(g[w]) for w in wnames]
+            if arrs:
+                out[lname.split("/")[0]] = arrs
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the importer
+# ---------------------------------------------------------------------------
+
+class KerasModelImport:
+    @staticmethod
+    def _parse_config(source) -> dict:
+        if isinstance(source, dict):
+            return source
+        text = str(source)
+        if text.lstrip().startswith("{"):
+            return json.loads(text)
+        if text.endswith((".h5", ".hdf5")):
+            import h5py
+
+            with h5py.File(text, "r") as f:
+                raw = f.attrs.get("model_config")
+                if raw is None:
+                    raise InvalidKerasConfigurationException(
+                        f"{text} has no model_config attribute")
+                if isinstance(raw, bytes):
+                    raw = raw.decode()
+                return json.loads(raw)
+        with open(text) as fh:
+            return json.loads(fh.read())
+
+    # ----- Sequential ------------------------------------------------
+    @staticmethod
+    def importKerasSequentialModelAndWeights(configSource, weights=None,
+                                             enforceTrainingConfig=False):
+        """Sequential config (+ optional weights) → MultiLayerNetwork.
+        `weights`: legacy-H5 path or {layerName: [arrays...]} dict.
+        (reference: KerasModelImport.importKerasSequentialModelAndWeights)"""
+        cfg = KerasModelImport._parse_config(configSource)
+        if cfg.get("class_name") != "Sequential":
+            raise InvalidKerasConfigurationException(
+                f"expected a Sequential model, got {cfg.get('class_name')}")
+        layer_cfgs = cfg.get("config", {})
+        if isinstance(layer_cfgs, dict):
+            layer_cfgs = layer_cfgs.get("layers", [])
+        specs = [_KerasLayerSpec(rl) for rl in layer_cfgs]
+
+        input_type = None
+        for sp in specs:
+            shape = sp.inputShape()
+            if shape is not None:
+                input_type = _input_type_from_shape(shape)
+                break
+        if input_type is None:
+            raise InvalidKerasConfigurationException(
+                "no input shape found (batch_input_shape/batch_shape)")
+
+        lb = NeuralNetConfiguration.Builder().list()
+        native_specs = []  # (spec, native_layer) for weight mapping
+        last_real = max((i for i, sp in enumerate(specs)
+                         if sp.className not in ("InputLayer", "Flatten", "Dropout",
+                                                 "Activation")),
+                        default=len(specs) - 1)
+        for i, sp in enumerate(specs):
+            nl = _convert_layer(sp, is_last=(i == last_real))
+            if nl is None:
+                continue
+            lb.layer(nl)
+            native_specs.append((sp, nl))
+        lb.setInputType(input_type)
+        conf = lb.build()
+        net = MultiLayerNetwork(conf).init()
+
+        if weights is not None:
+            from deeplearning4j_tpu.nn.conf.preprocessors import (
+                CnnToFeedForwardPreProcessor,
+            )
+
+            wmap = weights if isinstance(weights, dict) else _load_h5_weights(weights)
+            for li, (sp, nl) in enumerate(native_specs):
+                if sp.name in wmap:
+                    w = list(wmap[sp.name])
+                    pp = conf.preprocessors.get(li)
+                    if (isinstance(pp, CnnToFeedForwardPreProcessor)
+                            and isinstance(nl, (L.DenseLayer, L.BaseOutputLayer))):
+                        # Keras flattened (h,w,c); our preprocessor flattens
+                        # (c,h,w) — permute the kernel rows to match
+                        w[0] = _flatten_reorder(np.asarray(w[0]), pp.inputHeight,
+                                                pp.inputWidth, pp.numChannels)
+                    net._params[li], net._states[li] = _apply_weights(
+                        nl, w, net._params[li], net._states[li])
+                elif nl.hasParams() and net._params[li]:
+                    raise InvalidKerasConfigurationException(
+                        f"no weights found for layer '{sp.name}'")
+        return net
+
+    @staticmethod
+    def importKerasModelConfiguration(configSource):
+        """Config-only Sequential import (reference:
+        KerasModelImport.importKerasSequentialConfiguration)."""
+        return KerasModelImport.importKerasSequentialModelAndWeights(configSource).conf
+
+    # ----- Functional ------------------------------------------------
+    @staticmethod
+    def importKerasModelAndWeights(configSource, weights=None,
+                                   enforceTrainingConfig=False):
+        """Functional-API config (+ optional weights) → ComputationGraph.
+        Supports layer nodes plus Add/Concatenate merge vertices.
+        (reference: KerasModelImport.importKerasModelAndWeights)"""
+        from deeplearning4j_tpu.nn.conf.graph import (
+            ElementWiseVertex, MergeVertex,
+        )
+
+        cfg = KerasModelImport._parse_config(configSource)
+        if cfg.get("class_name") not in ("Model", "Functional"):
+            raise InvalidKerasConfigurationException(
+                f"expected a Functional model, got {cfg.get('class_name')}")
+        mc = cfg["config"]
+        specs = [_KerasLayerSpec(rl) for rl in mc["layers"]]
+        by_name = {sp.name: sp for sp in specs}
+
+        def _refs(v):
+            """input_layers/output_layers: ["name", 0, 0] for a single ref,
+            or a list of such refs / of bare names."""
+            if not v:
+                return []
+            if isinstance(v[0], str):
+                return [v[0]]
+            return [ref[0] if isinstance(ref, (list, tuple)) else ref for ref in v]
+
+        input_names = _refs(mc.get("input_layers", []))
+        output_names = _refs(mc.get("output_layers", []))
+
+        gb = NeuralNetConfiguration.Builder().graphBuilder()
+        gb.addInputs(*input_names)
+        in_types = []
+        for n in input_names:
+            shape = by_name[n].inputShape()
+            if shape is None:
+                raise InvalidKerasConfigurationException(f"input '{n}' has no shape")
+            in_types.append(_input_type_from_shape(shape))
+        gb.setInputTypes(*in_types)
+
+        native_by_name = {}
+        for sp in specs:
+            if sp.name in input_names:
+                continue
+            inputs = sp.inbound
+            if sp.className in ("Add", "Concatenate", "Average", "Maximum",
+                                "Subtract", "Multiply"):
+                vtx = {"Add": ElementWiseVertex("add"),
+                       "Subtract": ElementWiseVertex("subtract"),
+                       "Multiply": ElementWiseVertex("product"),
+                       "Average": ElementWiseVertex("average"),
+                       "Maximum": ElementWiseVertex("max"),
+                       "Concatenate": MergeVertex()}[sp.className]
+                gb.addVertex(sp.name, vtx, *inputs)
+                continue
+            is_out = sp.name in output_names
+            nl = _convert_layer(sp, is_last=is_out)
+            if nl is None:  # Flatten/InputLayer: identity vertex via ActivationLayer
+                nl = L.ActivationLayer(activation="identity", name=sp.name)
+            gb.addLayer(sp.name, nl, *inputs)
+            native_by_name[sp.name] = nl
+        gb.setOutputs(*output_names)
+        graph = ComputationGraph(gb.build()).init()
+
+        if weights is not None:
+            wmap = weights if isinstance(weights, dict) else _load_h5_weights(weights)
+            for lname, nl in native_by_name.items():
+                if lname in wmap:
+                    graph._params[lname], graph._states[lname] = _apply_weights(
+                        nl, wmap[lname], graph._params[lname], graph._states[lname])
+        return graph

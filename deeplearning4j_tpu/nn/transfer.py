@@ -203,10 +203,10 @@ class TransferLearning:
                 # device copies, not references: the new net's train step
                 # donates its buffers, which would invalidate the original
                 # network's params on TPU
-                import jax.numpy as jnp
+                from deeplearning4j_tpu.util.pytree import device_copy_tree
 
-                net._params[i] = jax.tree_util.tree_map(jnp.copy, old_p)
-                net._states[i] = jax.tree_util.tree_map(jnp.copy, orig._states[i])
+                net._params[i] = device_copy_tree(old_p)
+                net._states[i] = device_copy_tree(orig._states[i])
             return net
 
 
@@ -236,9 +236,8 @@ class TransferLearningHelper:
         self._top = MultiLayerNetwork(top_conf)
         # device copies: the top net's train step donates its buffers, which
         # must not alias the full network's params (see Builder.build)
-        import jax.numpy as jnp
+        from deeplearning4j_tpu.util.pytree import device_copy_tree as cp
 
-        cp = lambda t: jax.tree_util.tree_map(jnp.copy, t)
         self._top.initFrom([cp(net._params[i]) for i in range(self._split, len(net.layers))],
                            [cp(net._states[i]) for i in range(self._split, len(net.layers))])
 
@@ -261,11 +260,14 @@ class TransferLearningHelper:
                        dataset.getLabelsMaskArray())
 
     def fitFeaturized(self, dataset):
+        from deeplearning4j_tpu.util.pytree import device_copy_tree
+
         self._top.fit(dataset)
-        # write trained top params back into the full net
+        # write trained top params back into the full net — as copies, so a
+        # later _net.fit() can't donate buffers the top net still holds
         for j in range(len(self._top.layers)):
-            self._net._params[self._split + j] = self._top._params[j]
-            self._net._states[self._split + j] = self._top._states[j]
+            self._net._params[self._split + j] = device_copy_tree(self._top._params[j])
+            self._net._states[self._split + j] = device_copy_tree(self._top._states[j])
         return self
 
     def outputFromFeaturized(self, features):

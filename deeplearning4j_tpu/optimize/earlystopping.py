@@ -167,10 +167,8 @@ class InMemoryModelSaver:
 
     @staticmethod
     def _snapshot(model):
-        import jax
-        import jax.numpy as jnp
+        from deeplearning4j_tpu.util.pytree import device_copy_tree as cp
 
-        cp = lambda t: jax.tree_util.tree_map(jnp.copy, t)
         return {
             "params": cp(model._params),
             "upd_states": cp(model._upd_states),
@@ -392,6 +390,7 @@ class EarlyStoppingTrainer:
                     details = str(h.cond)
                     break
 
+                scored = True
                 if conf.scoreCalculator is not None:
                     if epoch % conf.evaluateEveryNEpochs == 0:
                         score = conf.scoreCalculator.calculateScore(self.model)
@@ -403,11 +402,13 @@ class EarlyStoppingTrainer:
                             best_score, best_epoch = score, epoch
                             conf.modelSaver.saveBestModel(self.model, score)
                     else:
-                        # skipped-evaluation epoch: carry the last validation
-                        # score forward — the training minibatch loss is a
-                        # different metric and must not enter the same
-                        # stream the termination conditions compare against
+                        # skipped-evaluation epoch: no new validation score.
+                        # Carry the last one forward for reporting, but treat
+                        # the epoch as unscored — the training minibatch loss
+                        # is a different metric, and re-feeding a stale score
+                        # would count fake no-improvement epochs.
                         score = last_val_score
+                        scored = False
                 else:
                     score = self.model.score()
                     scoreVsEpoch[epoch] = score
@@ -417,8 +418,10 @@ class EarlyStoppingTrainer:
 
                 stop = None
                 for c in conf.epochTerminationConditions:
-                    if score is None and not isinstance(c, MaxEpochsTerminationCondition):
-                        continue  # no validation score yet to compare
+                    # score-comparing conditions only run on epochs that
+                    # produced a fresh score; epoch-count conditions always run
+                    if not scored and not isinstance(c, MaxEpochsTerminationCondition):
+                        continue
                     if c.terminate(epoch, score, minimize):
                         stop = c
                         break
@@ -431,7 +434,15 @@ class EarlyStoppingTrainer:
             # detach the guard so the model is reusable afterwards
             self.model._listeners = [l for l in self.model._listeners if l is not guard]
 
-        if best_score is None:  # no score calculator: best = final
+        if best_score is None:
+            if (conf.scoreCalculator is not None
+                    and reason == TerminationReason.IterationTerminationCondition):
+                # halted (divergence/time) before the first validation pass:
+                # there is no model worth calling "best" — don't save the
+                # possibly-exploded final state under that name
+                return EarlyStoppingResult(reason, details, scoreVsEpoch, -1,
+                                           None, epoch + 1, None)
+            # no score calculator: best = final
             conf.modelSaver.saveBestModel(self.model, scoreVsEpoch.get(epoch))
             best_epoch = epoch
             best_score = scoreVsEpoch.get(epoch)

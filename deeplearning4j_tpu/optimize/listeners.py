@@ -207,17 +207,16 @@ class StatsListener(TrainingListener):
         self.frequency = max(1, int(frequency))
         self.logFile = str(logFile) if logFile is not None else None
         self.collectHistograms = collectHistograms
-        self._fh = None
         self._last_time = None
         self._last_iter = None
 
     def _write(self, rec: dict):
+        # append-per-record: no held file descriptor to leak, and records
+        # are durable the moment they're written
         if self.logFile is None:
             return
-        if self._fh is None:
-            self._fh = open(self.logFile, "a")
-        self._fh.write(json.dumps(rec) + "\n")
-        self._fh.flush()
+        with open(self.logFile, "a") as fh:
+            fh.write(json.dumps(rec) + "\n")
 
     def _param_stats(self, model):
         import numpy as np

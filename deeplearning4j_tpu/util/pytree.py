@@ -1,0 +1,16 @@
+"""Pytree helpers shared across the framework."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def device_copy_tree(tree):
+    """Device copy (HBM→HBM, no host round-trip) of every array leaf.
+
+    Required wherever saved parameters must outlive a jitted train step:
+    the fused step donates its param/state buffers to XLA
+    (donate_argnums), so bare references are invalidated by the next
+    iteration on TPU."""
+    return jax.tree_util.tree_map(jnp.copy, tree)

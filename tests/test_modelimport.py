@@ -1,0 +1,206 @@
+"""Keras model import with numeric parity against real tf.keras models
+(reference: deeplearning4j-modelimport KerasModelImport tests)."""
+
+import json
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+keras = tf.keras
+
+from deeplearning4j_tpu.modelimport import (
+    KerasModelImport,
+    InvalidKerasConfigurationException,
+    UnsupportedKerasConfigurationException,
+)
+
+
+def _wmap(model):
+    return {l.name: l.get_weights() for l in model.layers if l.get_weights()}
+
+
+def _parity(keras_model, net, x_keras, x_native, rtol=2e-4, atol=2e-5):
+    want = np.asarray(keras_model.predict(x_keras, verbose=0))
+    got = net.output(x_native).toNumpy()
+    np.testing.assert_allclose(got, want, rtol=rtol, atol=atol)
+
+
+class TestSequentialImport:
+    def test_mlp_parity(self):
+        m = keras.Sequential([
+            keras.layers.Input((20,)),
+            keras.layers.Dense(32, activation="relu"),
+            keras.layers.Dense(10, activation="softmax"),
+        ])
+        net = KerasModelImport.importKerasSequentialModelAndWeights(
+            m.to_json(), _wmap(m))
+        x = np.random.RandomState(0).rand(8, 20).astype("float32")
+        _parity(m, net, x, x)
+
+    def test_mlp_with_dropout_and_activation_layers(self):
+        m = keras.Sequential([
+            keras.layers.Input((12,)),
+            keras.layers.Dense(16),
+            keras.layers.Activation("tanh"),
+            keras.layers.Dropout(0.4),
+            keras.layers.Dense(3, activation="softmax"),
+        ])
+        net = KerasModelImport.importKerasSequentialModelAndWeights(
+            m.to_json(), _wmap(m))
+        x = np.random.RandomState(1).rand(4, 12).astype("float32")
+        _parity(m, net, x, x)  # dropout inactive at inference
+
+    def test_cnn_parity_with_flatten_reorder(self):
+        m = keras.Sequential([
+            keras.layers.Input((8, 8, 3)),
+            keras.layers.Conv2D(4, 3, activation="relu", padding="valid"),
+            keras.layers.MaxPooling2D(2),
+            keras.layers.Flatten(),
+            keras.layers.Dense(5, activation="softmax"),
+        ])
+        net = KerasModelImport.importKerasSequentialModelAndWeights(
+            m.to_json(), _wmap(m))
+        x = np.random.RandomState(2).rand(4, 8, 8, 3).astype("float32")
+        _parity(m, net, x, x.transpose(0, 3, 1, 2))  # NHWC -> NCHW
+
+    def test_cnn_same_padding_and_avgpool(self):
+        m = keras.Sequential([
+            keras.layers.Input((6, 6, 2)),
+            keras.layers.Conv2D(3, 3, padding="same", activation="relu"),
+            keras.layers.AveragePooling2D(2),
+            keras.layers.Flatten(),
+            keras.layers.Dense(4, activation="softmax"),
+        ])
+        net = KerasModelImport.importKerasSequentialModelAndWeights(
+            m.to_json(), _wmap(m))
+        x = np.random.RandomState(3).rand(2, 6, 6, 2).astype("float32")
+        _parity(m, net, x, x.transpose(0, 3, 1, 2))
+
+    def test_batchnorm_inference_parity(self):
+        m = keras.Sequential([
+            keras.layers.Input((10,)),
+            keras.layers.Dense(8, activation="relu"),
+            keras.layers.BatchNormalization(),
+            keras.layers.Dense(3, activation="softmax"),
+        ])
+        # give the BN non-trivial moving stats
+        bn = m.layers[1]
+        gamma, beta, mean, var = bn.get_weights()
+        bn.set_weights([gamma * 1.3, beta + 0.2,
+                        mean + 0.5, var * 2.0])
+        net = KerasModelImport.importKerasSequentialModelAndWeights(
+            m.to_json(), _wmap(m))
+        x = np.random.RandomState(4).rand(6, 10).astype("float32")
+        _parity(m, net, x, x)
+
+    def test_lstm_parity(self):
+        m = keras.Sequential([
+            keras.layers.Input((6, 5)),  # [T, F]
+            keras.layers.LSTM(7),
+            keras.layers.Dense(3, activation="softmax"),
+        ])
+        net = KerasModelImport.importKerasSequentialModelAndWeights(
+            m.to_json(), _wmap(m))
+        x = np.random.RandomState(5).rand(4, 6, 5).astype("float32")
+        _parity(m, net, x, x.transpose(0, 2, 1))  # [B,T,F] -> [B,F,T]
+
+    def test_global_pooling(self):
+        m = keras.Sequential([
+            keras.layers.Input((8, 8, 3)),
+            keras.layers.Conv2D(4, 3, activation="relu"),
+            keras.layers.GlobalAveragePooling2D(),
+            keras.layers.Dense(2, activation="softmax"),
+        ])
+        net = KerasModelImport.importKerasSequentialModelAndWeights(
+            m.to_json(), _wmap(m))
+        x = np.random.RandomState(6).rand(2, 8, 8, 3).astype("float32")
+        _parity(m, net, x, x.transpose(0, 3, 1, 2))
+
+    def test_config_only_import(self):
+        m = keras.Sequential([
+            keras.layers.Input((20,)),
+            keras.layers.Dense(32, activation="relu"),
+            keras.layers.Dense(10, activation="softmax"),
+        ])
+        conf = KerasModelImport.importKerasModelConfiguration(m.to_json())
+        assert len(conf.layers) == 2
+        assert conf.layers[0].nIn == 20 and conf.layers[0].nOut == 32
+
+    def test_unsupported_layer_raises(self):
+        raw = {"class_name": "Sequential", "config": {"layers": [
+            {"class_name": "InputLayer", "config": {"batch_shape": [None, 4]}},
+            {"class_name": "Lambda", "config": {"name": "weird"}},
+        ]}}
+        with pytest.raises(UnsupportedKerasConfigurationException):
+            KerasModelImport.importKerasSequentialModelAndWeights(json.dumps(raw))
+
+    def test_missing_weights_raises(self):
+        m = keras.Sequential([
+            keras.layers.Input((4,)),
+            keras.layers.Dense(2, activation="softmax"),
+        ])
+        with pytest.raises(InvalidKerasConfigurationException):
+            KerasModelImport.importKerasSequentialModelAndWeights(m.to_json(), {})
+
+
+class TestLegacyH5:
+    def _write_legacy_h5(self, path, model):
+        """Emulate the legacy tf.keras H5 layout (model_config attr +
+        model_weights/<name> groups with weight_names)."""
+        import h5py
+
+        with h5py.File(path, "w") as f:
+            f.attrs["model_config"] = model.to_json()
+            g = f.create_group("model_weights")
+            for l in model.layers:
+                ws = l.get_weights()
+                if not ws:
+                    continue
+                lg = g.create_group(l.name)
+                names = []
+                for i, w in enumerate(ws):
+                    dname = f"{l.name}/param_{i}:0"
+                    lg.create_dataset(dname, data=w)
+                    names.append(dname.encode())
+                lg.attrs["weight_names"] = names
+
+    def test_h5_roundtrip_parity(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((10,)),
+            keras.layers.Dense(16, activation="relu"),
+            keras.layers.Dense(4, activation="softmax"),
+        ])
+        p = str(tmp_path / "model.h5")
+        self._write_legacy_h5(p, m)
+        net = KerasModelImport.importKerasSequentialModelAndWeights(p, p)
+        x = np.random.RandomState(7).rand(5, 10).astype("float32")
+        _parity(m, net, x, x)
+
+
+class TestFunctionalImport:
+    def test_residual_add_parity(self):
+        inp = keras.layers.Input((16,), name="in0")
+        h1 = keras.layers.Dense(16, activation="relu", name="d1")(inp)
+        h2 = keras.layers.Dense(16, activation="relu", name="d2")(h1)
+        s = keras.layers.Add(name="res")([h1, h2])
+        out = keras.layers.Dense(4, activation="softmax", name="out")(s)
+        m = keras.Model(inp, out)
+        graph = KerasModelImport.importKerasModelAndWeights(m.to_json(), _wmap(m))
+        x = np.random.RandomState(8).rand(6, 16).astype("float32")
+        want = np.asarray(m.predict(x, verbose=0))
+        got = graph.outputSingle(x).toNumpy()
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+    def test_concat_branches_parity(self):
+        inp = keras.layers.Input((10,), name="in0")
+        a = keras.layers.Dense(6, activation="tanh", name="a")(inp)
+        b = keras.layers.Dense(6, activation="relu", name="b")(inp)
+        c = keras.layers.Concatenate(name="cat")([a, b])
+        out = keras.layers.Dense(3, activation="softmax", name="out")(c)
+        m = keras.Model(inp, out)
+        graph = KerasModelImport.importKerasModelAndWeights(m.to_json(), _wmap(m))
+        x = np.random.RandomState(9).rand(4, 10).astype("float32")
+        want = np.asarray(m.predict(x, verbose=0))
+        got = graph.outputSingle(x).toNumpy()
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
