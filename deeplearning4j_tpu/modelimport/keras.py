@@ -74,15 +74,27 @@ def _conv_mode(padding):
 
 def _input_type_from_shape(shape):
     """Keras shape tuple (batch dim stripped) → InputType. channels_last:
-    (H,W,C) → CNN; (T,F) → recurrent [F,T]; (N,) → feedForward."""
-    dims = [d for d in shape if d is not None]
+    (H,W,C) → CNN; (T,F) → recurrent [F,T] (T may be None = variable);
+    (N,) → feedForward. Rank is judged with None dims INCLUDED — (None, F)
+    is a variable-length sequence, not flat features."""
+    dims = list(shape)
     if len(dims) == 3:
         h, w, c = dims
+        if h is None or w is None or c is None:
+            raise UnsupportedKerasConfigurationException(
+                f"variable spatial dims not supported for CNN input {shape} "
+                "(XLA needs static shapes)")
         return InputType.convolutional(h, w, c)
     if len(dims) == 2:
         t, f = dims
+        if f is None:
+            raise UnsupportedKerasConfigurationException(
+                f"variable feature dim in recurrent input {shape}")
         return InputType.recurrent(f, t)
     if len(dims) == 1:
+        if dims[0] is None:
+            raise UnsupportedKerasConfigurationException(
+                f"variable feature dim in input {shape}")
         return InputType.feedForward(dims[0])
     raise UnsupportedKerasConfigurationException(f"unsupported input shape {shape}")
 
@@ -185,18 +197,31 @@ def _convert_layer(spec: _KerasLayerSpec, is_last: bool):
     if cn == "Activation":
         return L.ActivationLayer(activation=_act(cfg.get("activation")), name=name)
     if cn == "BatchNormalization":
-        return L.BatchNormalization(
+        bn = L.BatchNormalization(
             decay=float(cfg.get("momentum", 0.99)),
             eps=float(cfg.get("epsilon", 1e-3)),
             lockGammaBeta=not (cfg.get("scale", True) or cfg.get("center", True)),
             name=name)
+        # weight-list layout depends on these flags (gamma/beta omitted
+        # when off); _apply_weights consults them
+        bn._keras_scale = bool(cfg.get("scale", True))
+        bn._keras_center = bool(cfg.get("center", True))
+        return bn
     if cn == "ZeroPadding2D":
         pad = cfg.get("padding", 1)
         if isinstance(pad, (list, tuple)) and pad and isinstance(pad[0], (list, tuple)):
-            pad = (pad[0][0], pad[1][0])  # symmetric subset
+            (t, b), (l, r) = pad
+            if t != b or l != r:
+                raise UnsupportedKerasConfigurationException(
+                    f"asymmetric ZeroPadding2D {pad} not supported (layer '{name}')")
+            pad = (t, l)
         return L.ZeroPaddingLayer(padding=_pair(pad), name=name)
     if cn == "UpSampling2D":
-        return L.Upsampling2D(size=_pair(cfg.get("size", 2))[0], name=name)
+        size = _pair(cfg.get("size", 2))
+        if size[0] != size[1]:
+            raise UnsupportedKerasConfigurationException(
+                f"non-square UpSampling2D {size} not supported (layer '{name}')")
+        return L.Upsampling2D(size=size[0], name=name)
     if cn == "Embedding":
         return L.EmbeddingSequenceLayer(
             nIn=int(cfg["input_dim"]), nOut=int(cfg["output_dim"]), name=name)
@@ -256,6 +281,15 @@ def _apply_weights(layer, weights, params, state):
 
     if isinstance(layer, R.LastTimeStep):
         return _apply_weights(layer.layer, weights, params, state)
+    if isinstance(layer, L.DepthwiseConvolution2D):
+        # Keras (kh,kw,nIn,mult) → native grouped layout (kh,kw,1,nIn*mult);
+        # channel-major grouping is identical, so reshape suffices
+        k = np.asarray(weights[0])
+        kh, kw, nin, mult = k.shape
+        put("W", k.reshape(kh, kw, 1, nin * mult))
+        if len(weights) > 1 and "b" in p:
+            put("b", weights[1])
+        return p, s
     if isinstance(layer, (L.DenseLayer, L.BaseOutputLayer, L.ConvolutionLayer)) \
             and not isinstance(layer, L.Convolution1DLayer):
         put("W", weights[0])
@@ -266,11 +300,17 @@ def _apply_weights(layer, weights, params, state):
         put("W", weights[0])
         return p, s
     if isinstance(layer, L.BatchNormalization):
+        # Keras omits gamma when scale=False and beta when center=False;
+        # the native layer may still hold both (identity-initialized)
+        has_gamma = getattr(layer, "_keras_scale", True)
+        has_beta = getattr(layer, "_keras_center", True)
         idx = 0
-        if "gamma" in p:
-            put("gamma", weights[idx]); idx += 1
-        if "beta" in p:
-            put("beta", weights[idx]); idx += 1
+        if has_gamma and "gamma" in p:
+            put("gamma", weights[idx])
+        idx += 1 if has_gamma else 0
+        if has_beta and "beta" in p:
+            put("beta", weights[idx])
+        idx += 1 if has_beta else 0
         s["mean"] = jnp.asarray(np.asarray(weights[idx]), jnp.float32)
         s["var"] = jnp.asarray(np.asarray(weights[idx + 1]), jnp.float32)
         return p, s
@@ -371,7 +411,18 @@ class KerasModelImport:
                          if sp.className not in ("InputLayer", "Flatten", "Dropout",
                                                  "Activation")),
                         default=len(specs) - 1)
+        # fold a trailing Activation into the output layer: Dense(10) +
+        # Activation('softmax') must train as softmax+mcxent, not as an
+        # identity OutputLayer (mse) with a layer dangling after it
+        folded = set()
+        for j in range(last_real + 1, len(specs)):
+            if specs[j].className == "Activation":
+                specs[last_real].config["activation"] = \
+                    specs[j].config.get("activation")
+                folded.add(j)
         for i, sp in enumerate(specs):
+            if i in folded:
+                continue
             nl = _convert_layer(sp, is_last=(i == last_real))
             if nl is None:
                 continue
@@ -476,9 +527,20 @@ class KerasModelImport:
         graph = ComputationGraph(gb.build()).init()
 
         if weights is not None:
+            from deeplearning4j_tpu.nn.conf.preprocessors import (
+                CnnToFeedForwardPreProcessor,
+            )
+
             wmap = weights if isinstance(weights, dict) else _load_h5_weights(weights)
             for lname, nl in native_by_name.items():
                 if lname in wmap:
+                    w = list(wmap[lname])
+                    pp = graph.conf.nodes[lname].preprocessor
+                    if (isinstance(pp, CnnToFeedForwardPreProcessor)
+                            and isinstance(nl, (L.DenseLayer, L.BaseOutputLayer))):
+                        # same flatten-order permutation as the Sequential path
+                        w[0] = _flatten_reorder(np.asarray(w[0]), pp.inputHeight,
+                                                pp.inputWidth, pp.numChannels)
                     graph._params[lname], graph._states[lname] = _apply_weights(
-                        nl, wmap[lname], graph._params[lname], graph._states[lname])
+                        nl, w, graph._params[lname], graph._states[lname])
         return graph

@@ -1,0 +1,21 @@
+"""Native runtime: C++ prefetch ring buffer + async iterators.
+
+Reference: the reference's host-side runtime (threaded ETL, async prefetch
+queues of org.nd4j.linalg.dataset.Async*DataSetIterator). The compute path
+is XLA's; this package covers the host machinery around it.
+"""
+
+from deeplearning4j_tpu.runtime.ringbuffer import (
+    NativeRingBuffer, PythonRingBuffer, make_ring, native_lib,
+    PF_OK, PF_TIMEOUT, PF_CLOSED, PF_TOO_BIG,
+)
+from deeplearning4j_tpu.runtime.async_iterator import (
+    AsyncDataSetIterator, AsyncMultiDataSetIterator, pack_arrays, unpack_arrays,
+)
+
+__all__ = [
+    "NativeRingBuffer", "PythonRingBuffer", "make_ring", "native_lib",
+    "AsyncDataSetIterator", "AsyncMultiDataSetIterator",
+    "pack_arrays", "unpack_arrays",
+    "PF_OK", "PF_TIMEOUT", "PF_CLOSED", "PF_TOO_BIG",
+]

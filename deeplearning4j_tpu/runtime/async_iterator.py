@@ -1,0 +1,298 @@
+"""Async prefetching iterators.
+
+Reference: org.nd4j.linalg.dataset.AsyncDataSetIterator /
+AsyncMultiDataSetIterator — a background ETL thread keeps a bounded queue of
+ready batches so `fit()` never waits on host-side data work. Here the queue
+is the native C++ ring (runtime/prefetch.cpp); batches cross it as packed
+bytes, memcpy'd outside the GIL, then unpacked zero-copy with numpy views
+on the consumer side and handed to jax.device_put.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+
+import numpy as np
+
+from deeplearning4j_tpu.runtime.ringbuffer import PF_CLOSED, PF_TOO_BIG, make_ring
+
+_DTYPES = [np.float32, np.float64, np.int32, np.int64, np.uint8, np.bool_,
+           np.float16, np.int16, np.int8, np.uint32, np.uint64]
+_DTYPE_CODE = {np.dtype(d): i for i, d in enumerate(_DTYPES)}
+
+
+def pack_arrays(arrays) -> bytes:
+    """[np.ndarray | None, ...] -> bytes. Header: u32 count; per array:
+    u8 present, u8 dtype, u8 ndim, u32 dims[ndim]; payloads follow in order."""
+    head = [struct.pack("<I", len(arrays))]
+    body = []
+    for a in arrays:
+        if a is None:
+            head.append(struct.pack("<B", 0))
+            continue
+        a = np.ascontiguousarray(a)
+        code = _DTYPE_CODE.get(a.dtype)
+        if code is None:
+            raise TypeError(f"unsupported dtype {a.dtype}")
+        head.append(struct.pack(f"<BBB{a.ndim}I", 1, code, a.ndim, *a.shape))
+        body.append(a.tobytes())
+    return b"".join(head + body)
+
+
+def unpack_arrays(buf: bytes):
+    """Inverse of pack_arrays; array payloads are zero-copy views of buf."""
+    (count,) = struct.unpack_from("<I", buf, 0)
+    off = 4
+    metas = []
+    for _ in range(count):
+        (present,) = struct.unpack_from("<B", buf, off)
+        off += 1
+        if not present:
+            metas.append(None)
+            continue
+        code, ndim = struct.unpack_from("<BB", buf, off)
+        off += 2
+        shape = struct.unpack_from(f"<{ndim}I", buf, off)
+        off += 4 * ndim
+        metas.append((np.dtype(_DTYPES[code]), tuple(shape)))
+    out = []
+    for m in metas:
+        if m is None:
+            out.append(None)
+            continue
+        dt, shape = m
+        n = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+        out.append(np.frombuffer(buf, dt, count=int(np.prod(shape, dtype=np.int64)),
+                                 offset=off).reshape(shape))
+        off += n
+    return out
+
+
+def _pack_dataset(ds) -> bytes:
+    def to_np(a):
+        return None if a is None else np.asarray(
+            a.toNumpy() if hasattr(a, "toNumpy") else a)
+
+    return pack_arrays([to_np(ds.getFeatures()), to_np(ds.getLabels()),
+                        to_np(ds.getFeaturesMaskArray()),
+                        to_np(ds.getLabelsMaskArray())])
+
+
+def _unpack_dataset(buf: bytes):
+    from deeplearning4j_tpu.data.dataset import DataSet
+
+    f, l, fm, lm = unpack_arrays(buf)
+    return DataSet(f, l, fm, lm)
+
+
+class AsyncDataSetIterator:
+    """Wraps any DataSetIterator with background prefetch
+    (reference: AsyncDataSetIterator(backedIterator, queueSize)).
+
+    The producer thread runs the wrapped iterator (record reading,
+    normalization, augmentation — arbitrary Python/C++ ETL) and pushes
+    packed batches into the ring; the training loop pops ready batches.
+    An end-of-epoch sentinel (empty payload) closes each pass.
+    """
+
+    _SENTINEL = b""
+
+    def __init__(self, backedIterator, queueSize: int = 4, forcePython: bool = False):
+        self._base = backedIterator
+        self._queueSize = max(2, int(queueSize))
+        self._forcePython = forcePython
+        self._ring = None
+        self._thread = None
+        self._error = None
+        self._pending = None
+        self._exhausted = False
+        self._start_epoch()
+
+    # ----- producer ---------------------------------------------------
+    def _producer(self, ring):
+        try:
+            while self._base.hasNext():
+                payload = _pack_dataset(self._base.next())
+                rc = ring.push(payload)
+                if rc == PF_CLOSED:
+                    return  # consumer reset/shut down
+                if rc == PF_TOO_BIG:
+                    raise ValueError(
+                        f"batch of {len(payload)} bytes exceeds ring slot "
+                        f"{ring.slot_bytes}")
+            ring.push(self._SENTINEL)
+        except Exception as e:  # surface in the consumer
+            self._error = e
+            ring.close()
+
+    def _start_epoch(self):
+        self._base.reset()
+        self._error = None
+        self._pending = None
+        self._exhausted = False
+        if not self._base.hasNext():
+            self._exhausted = True
+            return
+        # size slots from the first batch (uniform batches; the final
+        # partial batch is only ever smaller)
+        first = _pack_dataset(self._base.next())
+        if self._ring is None:
+            # 2x + header margin: a padded final minibatch can carry mask
+            # arrays the first batch lacks
+            self._ring = make_ring(self._queueSize, 2 * len(first) + 1024,
+                                   force_python=self._forcePython)
+        else:
+            self._ring.reopen()
+        self._ring.push(first)
+        self._thread = threading.Thread(target=self._producer,
+                                        args=(self._ring,), daemon=True)
+        self._thread.start()
+
+    # ----- consumer (DataSetIterator surface) -------------------------
+    def _fill(self):
+        if self._pending is not None or self._exhausted:
+            return
+        got = self._ring.pop()
+        if isinstance(got, int):  # PF_CLOSED after error/shutdown
+            self._exhausted = True
+            if self._error is not None:
+                raise self._error
+            return
+        if got == self._SENTINEL:
+            self._exhausted = True
+            if self._error is not None:
+                raise self._error
+            return
+        self._pending = got
+
+    def hasNext(self) -> bool:
+        self._fill()
+        return self._pending is not None
+
+    def next(self, num=None):
+        self._fill()
+        if self._pending is None:
+            if self._error is not None:
+                raise self._error
+            raise StopIteration("iterator exhausted")
+        ds = _unpack_dataset(self._pending)
+        self._pending = None
+        return ds
+
+    def reset(self):
+        self._shutdown()
+        self._start_epoch()
+
+    def _shutdown(self):
+        if self._ring is not None:
+            self._ring.close()
+        if self._thread is not None and self._thread.is_alive():
+            # drain so a blocked producer can observe the close
+            while self._thread.is_alive():
+                self._ring.pop(timeout_ms=10)
+                self._thread.join(timeout=0.05)
+        self._thread = None
+
+    def close(self):
+        self._shutdown()
+
+    def __iter__(self):
+        self.reset()
+        while self.hasNext():
+            yield self.next()
+
+    # passthrough metadata
+    def batch(self):
+        return self._base.batch()
+
+    def totalExamples(self):
+        return self._base.totalExamples()
+
+    def inputColumns(self):
+        return self._base.inputColumns()
+
+    def totalOutcomes(self):
+        return self._base.totalOutcomes()
+
+    def setPreProcessor(self, pp):
+        self._base.setPreProcessor(pp)
+
+    def getPreProcessor(self):
+        return self._base.getPreProcessor()
+
+
+class AsyncMultiDataSetIterator(AsyncDataSetIterator):
+    """Async wrapper for MultiDataSetIterator (reference:
+    AsyncMultiDataSetIterator). Packs the flattened feature/label/mask
+    lists instead of the 4-slot DataSet layout."""
+
+    def _producer(self, ring):  # same loop, different pack
+        try:
+            while self._base.hasNext():
+                payload = self._pack_mds(self._base.next())
+                rc = ring.push(payload)
+                if rc == PF_CLOSED:
+                    return
+                if rc == PF_TOO_BIG:
+                    raise ValueError("multidataset exceeds ring slot")
+            ring.push(self._SENTINEL)
+        except Exception as e:
+            self._error = e
+            ring.close()
+
+    @staticmethod
+    def _pack_mds(mds) -> bytes:
+        def to_np_list(xs):
+            return [None if x is None else np.asarray(
+                x.toNumpy() if hasattr(x, "toNumpy") else x) for x in (xs or [])]
+
+        feats = to_np_list(mds.getFeatures())
+        labs = to_np_list(mds.getLabels())
+        fmasks = to_np_list(mds.getFeaturesMaskArrays())
+        lmasks = to_np_list(mds.getLabelsMaskArrays())
+        # mask lists are positional: pad with None slots to the arity of
+        # their array lists so unpacking stays index-aligned
+        fmasks += [None] * (len(feats) - len(fmasks))
+        lmasks += [None] * (len(labs) - len(lmasks))
+        counts = np.array([len(feats), len(labs)], np.uint32)
+        return pack_arrays([counts] + feats + labs + fmasks + lmasks)
+
+    def _start_epoch(self):
+        # identical to the base, but measure with the MDS packer
+        self._base.reset()
+        self._error = None
+        self._pending = None
+        self._exhausted = False
+        if not self._base.hasNext():
+            self._exhausted = True
+            return
+        first = self._pack_mds(self._base.next())
+        if self._ring is None:
+            self._ring = make_ring(self._queueSize, 2 * len(first) + 1024,
+                                   force_python=self._forcePython)
+        else:
+            self._ring.reopen()
+        self._ring.push(first)
+        self._thread = threading.Thread(target=self._producer,
+                                        args=(self._ring,), daemon=True)
+        self._thread.start()
+
+    def next(self, num=None):
+        from deeplearning4j_tpu.data.multidataset import MultiDataSet
+
+        self._fill()
+        if self._pending is None:
+            if self._error is not None:
+                raise self._error
+            raise StopIteration("iterator exhausted")
+        arrays = unpack_arrays(self._pending)
+        self._pending = None
+        nf, nl = int(arrays[0][0]), int(arrays[0][1])
+        feats = arrays[1:1 + nf]
+        labs = arrays[1 + nf:1 + nf + nl]
+        fmasks = arrays[1 + nf + nl:1 + 2 * nf + nl]
+        lmasks = arrays[1 + 2 * nf + nl:1 + 2 * nf + 2 * nl]
+        return MultiDataSet(feats, labs,
+                            fmasks if any(m is not None for m in fmasks) else None,
+                            lmasks if any(m is not None for m in lmasks) else None)

@@ -1,0 +1,160 @@
+"""ctypes binding for the native prefetch ring buffer, with build-on-first-use
+and a pure-Python fallback.
+
+Reference: the bounded blocking queue inside
+org.nd4j.linalg.dataset.AsyncDataSetIterator. The native ring
+(runtime/prefetch.cpp) memcpys payloads outside the GIL so the ETL thread
+and the device-feed loop overlap; the Python fallback keeps the same
+interface when no compiler is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import queue
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "prefetch.cpp")
+_SO = os.path.join(_HERE, "build", "libprefetch.so")
+
+PF_OK, PF_TIMEOUT, PF_CLOSED, PF_TOO_BIG = 0, -1, -2, -3
+
+_lib = None
+_lib_err = None
+_lib_lock = threading.Lock()
+
+
+def _build_so():
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           _SRC, "-o", _SO]
+    subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+
+
+def native_lib():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _lib_err
+    with _lib_lock:
+        if _lib is not None or _lib_err is not None:
+            return _lib
+        try:
+            if not os.path.exists(_SO) or (
+                    os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                _build_so()
+            lib = ctypes.CDLL(_SO)
+            lib.pf_create.restype = ctypes.c_void_p
+            lib.pf_create.argtypes = [ctypes.c_size_t, ctypes.c_size_t]
+            lib.pf_destroy.argtypes = [ctypes.c_void_p]
+            lib.pf_capacity.restype = ctypes.c_size_t
+            lib.pf_capacity.argtypes = [ctypes.c_void_p]
+            lib.pf_slot_bytes.restype = ctypes.c_size_t
+            lib.pf_slot_bytes.argtypes = [ctypes.c_void_p]
+            lib.pf_count.restype = ctypes.c_size_t
+            lib.pf_count.argtypes = [ctypes.c_void_p]
+            lib.pf_push.restype = ctypes.c_long
+            lib.pf_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_size_t, ctypes.c_long]
+            lib.pf_pop.restype = ctypes.c_long
+            lib.pf_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                   ctypes.c_size_t, ctypes.c_long]
+            lib.pf_close.argtypes = [ctypes.c_void_p]
+            lib.pf_reopen.argtypes = [ctypes.c_void_p]
+            _lib = lib
+        except Exception as e:  # no compiler / load failure -> fallback
+            _lib_err = e
+        return _lib
+
+
+class NativeRingBuffer:
+    """Bounded blocking byte-payload ring over the C++ implementation."""
+
+    def __init__(self, capacity: int, slot_bytes: int):
+        lib = native_lib()
+        if lib is None:
+            raise RuntimeError(f"native prefetch unavailable: {_lib_err!r}")
+        self._lib = lib
+        self._h = lib.pf_create(capacity, slot_bytes)
+        if not self._h:
+            raise ValueError("bad ring parameters")
+        self.slot_bytes = slot_bytes
+        self._out = ctypes.create_string_buffer(slot_bytes)
+
+    def push(self, payload: bytes, timeout_ms: int = -1) -> int:
+        return self._lib.pf_push(self._h, payload, len(payload), timeout_ms)
+
+    def pop(self, timeout_ms: int = -1):
+        """bytes | PF_TIMEOUT | PF_CLOSED."""
+        n = self._lib.pf_pop(self._h, self._out, self.slot_bytes, timeout_ms)
+        if n < 0:
+            return int(n)
+        return self._out.raw[:n]
+
+    def count(self) -> int:
+        return int(self._lib.pf_count(self._h))
+
+    def close(self):
+        self._lib.pf_close(self._h)
+
+    def reopen(self):
+        self._lib.pf_reopen(self._h)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.pf_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
+class PythonRingBuffer:
+    """queue.Queue fallback with the same interface/semantics."""
+
+    def __init__(self, capacity: int, slot_bytes: int):
+        self.slot_bytes = slot_bytes
+        self._cap = capacity
+        self._q = queue.Queue(maxsize=capacity)
+        self._closed = threading.Event()
+
+    def push(self, payload: bytes, timeout_ms: int = -1) -> int:
+        if len(payload) > self.slot_bytes:
+            return PF_TOO_BIG
+        deadline = None if timeout_ms < 0 else timeout_ms / 1000.0
+        while not self._closed.is_set():
+            try:
+                self._q.put(payload, timeout=0.05 if deadline is None else deadline)
+                return PF_OK
+            except queue.Full:
+                if deadline is not None:
+                    return PF_TIMEOUT
+        return PF_CLOSED
+
+    def pop(self, timeout_ms: int = -1):
+        deadline = None if timeout_ms < 0 else timeout_ms / 1000.0
+        while True:
+            try:
+                return self._q.get(timeout=0.05 if deadline is None else deadline)
+            except queue.Empty:
+                if self._closed.is_set():
+                    return PF_CLOSED
+                if deadline is not None:
+                    return PF_TIMEOUT
+
+    def count(self) -> int:
+        return self._q.qsize()
+
+    def close(self):
+        self._closed.set()
+
+    def reopen(self):
+        self._closed.clear()
+        self._q = queue.Queue(maxsize=self._cap)
+
+
+def make_ring(capacity: int, slot_bytes: int, force_python: bool = False):
+    if not force_python and native_lib() is not None:
+        return NativeRingBuffer(capacity, slot_bytes)
+    return PythonRingBuffer(capacity, slot_bytes)

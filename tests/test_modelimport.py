@@ -204,3 +204,80 @@ class TestFunctionalImport:
         want = np.asarray(m.predict(x, verbose=0))
         got = graph.outputSingle(x).toNumpy()
         np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+class TestReviewRegressions:
+    def test_variable_length_lstm_input(self):
+        raw = {"class_name": "Sequential", "config": {"layers": [
+            {"class_name": "InputLayer", "config": {"batch_shape": [None, None, 5]}},
+            {"class_name": "LSTM", "config": {"name": "l", "units": 4,
+                                              "return_sequences": False,
+                                              "activation": "tanh"}},
+            {"class_name": "Dense", "config": {"name": "d", "units": 2,
+                                               "activation": "softmax"}},
+        ]}}
+        net = KerasModelImport.importKerasSequentialModelAndWeights(json.dumps(raw))
+        x = np.random.RandomState(0).rand(2, 5, 9).astype("float32")  # [B,F,T]
+        assert net.output(x).shape() == (2, 2)
+
+    def test_trailing_activation_folds_into_output(self):
+        m = keras.Sequential([
+            keras.layers.Input((6,)),
+            keras.layers.Dense(8, activation="relu"),
+            keras.layers.Dense(3),
+            keras.layers.Activation("softmax"),
+        ])
+        net = KerasModelImport.importKerasSequentialModelAndWeights(
+            m.to_json(), _wmap(m))
+        assert len(net.layers) == 2
+        assert net.layers[-1].activation == "softmax"
+        assert net.layers[-1].lossFunction == "mcxent"
+        x = np.random.RandomState(1).rand(4, 6).astype("float32")
+        _parity(m, net, x, x)
+
+    def test_batchnorm_scale_false(self):
+        m = keras.Sequential([
+            keras.layers.Input((5,)),
+            keras.layers.BatchNormalization(scale=False),
+            keras.layers.Dense(2, activation="softmax"),
+        ])
+        bn = m.layers[0]
+        beta, mean, var = bn.get_weights()
+        bn.set_weights([beta + 0.3, mean + 0.1, var * 1.7])
+        net = KerasModelImport.importKerasSequentialModelAndWeights(
+            m.to_json(), _wmap(m))
+        x = np.random.RandomState(2).rand(6, 5).astype("float32")
+        _parity(m, net, x, x)
+
+    def test_asymmetric_padding_rejected(self):
+        raw = {"class_name": "Sequential", "config": {"layers": [
+            {"class_name": "InputLayer", "config": {"batch_shape": [None, 8, 8, 1]}},
+            {"class_name": "ZeroPadding2D",
+             "config": {"name": "zp", "padding": [[0, 1], [0, 1]]}},
+        ]}}
+        with pytest.raises(UnsupportedKerasConfigurationException):
+            KerasModelImport.importKerasSequentialModelAndWeights(json.dumps(raw))
+
+    def test_functional_cnn_flatten_parity(self):
+        inp = keras.layers.Input((6, 6, 2), name="in0")
+        c = keras.layers.Conv2D(3, 3, activation="relu", name="c")(inp)
+        f = keras.layers.Flatten(name="fl")(c)
+        out = keras.layers.Dense(4, activation="softmax", name="out")(f)
+        m = keras.Model(inp, out)
+        graph = KerasModelImport.importKerasModelAndWeights(m.to_json(), _wmap(m))
+        x = np.random.RandomState(3).rand(2, 6, 6, 2).astype("float32")
+        want = np.asarray(m.predict(x, verbose=0))
+        got = graph.outputSingle(x.transpose(0, 3, 1, 2)).toNumpy()
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+    def test_depthwise_conv_weights(self):
+        m = keras.Sequential([
+            keras.layers.Input((6, 6, 3)),
+            keras.layers.DepthwiseConv2D(3, depth_multiplier=2, activation="relu"),
+            keras.layers.Flatten(),
+            keras.layers.Dense(2, activation="softmax"),
+        ])
+        net = KerasModelImport.importKerasSequentialModelAndWeights(
+            m.to_json(), _wmap(m))
+        x = np.random.RandomState(4).rand(2, 6, 6, 3).astype("float32")
+        _parity(m, net, x, x.transpose(0, 3, 1, 2))

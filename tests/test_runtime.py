@@ -1,0 +1,274 @@
+"""Native prefetch runtime (reference: AsyncDataSetIterator tests in
+nd4j / deeplearning4j-core)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.runtime import (
+    AsyncDataSetIterator, AsyncMultiDataSetIterator, NativeRingBuffer,
+    PythonRingBuffer, make_ring, native_lib, pack_arrays, unpack_arrays,
+    PF_CLOSED, PF_TIMEOUT, PF_TOO_BIG,
+)
+from deeplearning4j_tpu.data import DataSet, DataSetIterator
+
+
+class TestPacking:
+    def test_roundtrip_mixed(self):
+        arrs = [np.arange(12, dtype=np.float32).reshape(3, 4),
+                None,
+                np.array([[True, False]]),
+                np.arange(6, dtype=np.int64).reshape(1, 2, 3)]
+        out = unpack_arrays(pack_arrays(arrs))
+        assert out[1] is None
+        np.testing.assert_array_equal(out[0], arrs[0])
+        np.testing.assert_array_equal(out[2], arrs[2])
+        np.testing.assert_array_equal(out[3], arrs[3])
+        assert out[0].dtype == np.float32 and out[3].dtype == np.int64
+
+    def test_empty_and_scalarish(self):
+        out = unpack_arrays(pack_arrays([np.zeros((0, 4), np.float32)]))
+        assert out[0].shape == (0, 4)
+
+
+@pytest.mark.parametrize("ring_cls", [NativeRingBuffer, PythonRingBuffer])
+class TestRingBuffer:
+    def _make(self, ring_cls, cap=3, slot=1024):
+        if ring_cls is NativeRingBuffer and native_lib() is None:
+            pytest.skip("no native toolchain")
+        return ring_cls(cap, slot)
+
+    def test_fifo_order_and_wrap(self, ring_cls):
+        r = self._make(ring_cls)
+        for round_ in range(3):  # force wrap-around
+            for i in range(3):
+                assert r.push(f"item-{round_}-{i}".encode()) == 0
+            for i in range(3):
+                assert r.pop() == f"item-{round_}-{i}".encode()
+
+    def test_too_big_payload(self, ring_cls):
+        r = self._make(ring_cls, slot=16)
+        assert r.push(b"x" * 17) == PF_TOO_BIG
+
+    def test_pop_timeout(self, ring_cls):
+        r = self._make(ring_cls)
+        assert r.pop(timeout_ms=30) == PF_TIMEOUT
+
+    def test_backpressure_blocks_until_pop(self, ring_cls):
+        r = self._make(ring_cls, cap=2)
+        assert r.push(b"a") == 0
+        assert r.push(b"b") == 0
+        done = threading.Event()
+
+        def blocked_push():
+            r.push(b"c")
+            done.set()
+
+        t = threading.Thread(target=blocked_push, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        assert not done.is_set()  # full -> producer blocked
+        assert r.pop() == b"a"
+        assert done.wait(2.0)
+        assert r.pop() == b"b"
+        assert r.pop() == b"c"
+
+    def test_close_drains_then_reports_closed(self, ring_cls):
+        r = self._make(ring_cls)
+        r.push(b"left-over")
+        r.close()
+        assert r.pop() == b"left-over"
+        assert r.pop(timeout_ms=100) == PF_CLOSED
+        r.reopen()
+        assert r.push(b"fresh") == 0
+        assert r.pop() == b"fresh"
+
+
+def _iter(n=50, batch=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 6).astype("float32")
+    Y = np.eye(3, dtype="float32")[rng.randint(0, 3, n)]
+    return DataSetIterator(X, Y, batch)
+
+
+@pytest.mark.parametrize("force_python", [False, True])
+class TestAsyncDataSetIterator:
+    def test_matches_sync_iterator(self, force_python):
+        sync, base = _iter(), _iter()
+        async_it = AsyncDataSetIterator(base, queueSize=3, forcePython=force_python)
+        n = 0
+        while sync.hasNext():
+            assert async_it.hasNext()
+            a, b = sync.next(), async_it.next()
+            np.testing.assert_array_equal(a.getFeatures().toNumpy(),
+                                          b.getFeatures().toNumpy())
+            np.testing.assert_array_equal(a.getLabels().toNumpy(),
+                                          b.getLabels().toNumpy())
+            n += 1
+        assert not async_it.hasNext()
+        assert n == 7  # 50/8 -> 6 full + 1 partial batch
+
+    def test_reset_for_multiple_epochs(self, force_python):
+        async_it = AsyncDataSetIterator(_iter(), queueSize=2, forcePython=force_python)
+        for _ in range(3):
+            count = sum(1 for _ in iter(async_it.next, None) if False) if False else 0
+            async_it.reset()
+            while async_it.hasNext():
+                async_it.next()
+                count += 1
+            assert count == 7
+
+    def test_masks_survive(self, force_python):
+        n, batch = 12, 4
+        rng = np.random.RandomState(1)
+        base = _iter(n, batch)
+        # splice masks into the produced batches via a wrapper
+        fm = (rng.rand(n, 5) > 0.3).astype("float32")
+
+        class Masked:
+            def __init__(self):
+                self.it = _iter(n, batch)
+                self.i = 0
+
+            def reset(self):
+                self.it.reset()
+                self.i = 0
+
+            def hasNext(self):
+                return self.it.hasNext()
+
+            def next(self):
+                ds = self.it.next()
+                sl = slice(self.i * batch, (self.i + 1) * batch)
+                self.i += 1
+                return DataSet(ds.getFeatures(), ds.getLabels(), fm[sl], None)
+
+        ait = AsyncDataSetIterator(Masked(), forcePython=force_python)
+        got = []
+        while ait.hasNext():
+            got.append(ait.next().getFeaturesMaskArray().toNumpy())
+        np.testing.assert_array_equal(np.concatenate(got), fm)
+
+    def test_producer_exception_propagates(self, force_python):
+        class Exploding:
+            def __init__(self):
+                self.n = 0
+
+            def reset(self):
+                self.n = 0
+
+            def hasNext(self):
+                return True
+
+            def next(self):
+                self.n += 1
+                if self.n > 2:
+                    raise RuntimeError("ETL failed")
+                return DataSet(np.zeros((4, 2), np.float32),
+                               np.zeros((4, 2), np.float32))
+
+        ait = AsyncDataSetIterator(Exploding(), forcePython=force_python)
+        with pytest.raises(RuntimeError, match="ETL failed"):
+            while ait.hasNext():
+                ait.next()
+
+    def test_fit_through_async(self, force_python):
+        from deeplearning4j_tpu.nn import (
+            NeuralNetConfiguration, DenseLayer, OutputLayer, MultiLayerNetwork, Adam,
+        )
+        from deeplearning4j_tpu.nn.losses import LossFunctions
+
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(3).updater(Adam(1e-2)).list()
+                .layer(DenseLayer(nIn=6, nOut=16, activation="tanh"))
+                .layer(OutputLayer(nOut=3, activation="softmax",
+                                   lossFunction=LossFunctions.LossFunction.MCXENT))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        ait = AsyncDataSetIterator(_iter(), forcePython=force_python)
+        s0 = None
+        for ep in range(5):
+            net.fit(ait)
+            s0 = s0 or net.score()
+        assert net.score() < s0
+
+
+class TestAsyncMulti:
+    def test_multidataset_roundtrip(self):
+        from deeplearning4j_tpu.data.multidataset import MultiDataSet
+
+        class MDSIter:
+            def __init__(self):
+                self.i = 0
+
+            def reset(self):
+                self.i = 0
+
+            def hasNext(self):
+                return self.i < 4
+
+            def next(self):
+                self.i += 1
+                rng = np.random.RandomState(self.i)
+                return MultiDataSet(
+                    [rng.rand(4, 3).astype("f4"), rng.rand(4, 2).astype("f4")],
+                    [rng.rand(4, 1).astype("f4")])
+
+        ait = AsyncMultiDataSetIterator(MDSIter())
+        seen = 0
+        while ait.hasNext():
+            mds = ait.next()
+            rng = np.random.RandomState(seen + 1)
+            np.testing.assert_array_equal(mds.getFeatures()[0].toNumpy(),
+                                          rng.rand(4, 3).astype("f4"))
+            seen += 1
+        assert seen == 4
+
+
+def test_native_lib_builds():
+    lib = native_lib()
+    if lib is None:
+        pytest.skip("no native toolchain available")
+    r = make_ring(2, 128)
+    assert isinstance(r, NativeRingBuffer)
+
+
+class TestAsyncMultiMasks:
+    def test_masks_preserved(self):
+        from deeplearning4j_tpu.data.multidataset import MultiDataSet
+
+        class MaskedMDS:
+            def __init__(self):
+                self.i = 0
+
+            def reset(self):
+                self.i = 0
+
+            def hasNext(self):
+                return self.i < 3
+
+            def next(self):
+                self.i += 1
+                rng = np.random.RandomState(self.i)
+                return MultiDataSet(
+                    [rng.rand(4, 2, 5).astype("f4")],
+                    [rng.rand(4, 1, 5).astype("f4")],
+                    [(rng.rand(4, 5) > 0.5).astype("f4")],
+                    [(rng.rand(4, 5) > 0.5).astype("f4")])
+
+        ait = AsyncMultiDataSetIterator(MaskedMDS())
+        n = 0
+        while ait.hasNext():
+            mds = ait.next()
+            n += 1
+            rng = np.random.RandomState(n)
+            rng.rand(4, 2, 5); rng.rand(4, 1, 5)
+            np.testing.assert_array_equal(
+                mds.getFeaturesMaskArrays()[0].toNumpy(),
+                (rng.rand(4, 5) > 0.5).astype("f4"))
+            np.testing.assert_array_equal(
+                mds.getLabelsMaskArrays()[0].toNumpy(),
+                (rng.rand(4, 5) > 0.5).astype("f4"))
+        assert n == 3
