@@ -42,7 +42,7 @@ _ACTIVATIONS = {
     "relu": "relu", "sigmoid": "sigmoid", "tanh": "tanh", "softmax": "softmax",
     "linear": "identity", "elu": "elu", "selu": "selu", "softplus": "softplus",
     "softsign": "softsign", "swish": "swish", "silu": "swish", "gelu": "gelu",
-    "hard_sigmoid": "hardsigmoid", "leaky_relu": "lrelu", "exponential": "exp",
+    "hard_sigmoid": "hardsigmoid", "leaky_relu": "leakyrelu",
     "mish": "mish",
 }
 

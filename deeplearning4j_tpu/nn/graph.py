@@ -154,6 +154,10 @@ class ComputationGraph:
             ldt = jnp.promote_types(pre.dtype, jnp.float32)
             pre = pre.astype(ldt)
             y = y.astype(ldt)
+            if hasattr(layer, "computeLoss"):
+                # composite-loss heads (e.g. objdetect.Yolo2OutputLayer)
+                total = total + layer.computeLoss(pre, y, lmask)
+                continue
             if pre.ndim == 3:  # NCW preact: loss over [B,T,O]
                 pre = jnp.transpose(pre, (0, 2, 1))
                 y = jnp.transpose(y, (0, 2, 1))

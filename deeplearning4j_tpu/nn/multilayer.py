@@ -189,6 +189,10 @@ class MultiLayerNetwork:
 
     def _loss_from_preact(self, preact, labels, lmask):
         last = self.layers[-1]
+        if hasattr(last, "computeLoss"):
+            # composite-loss heads (e.g. objdetect.Yolo2OutputLayer) own
+            # their full loss computation
+            return last.computeLoss(preact, labels, lmask)
         if isinstance(last, (L.BaseOutputLayer, L.LossLayer)):
             if preact.ndim == 3:  # RnnOutputLayer: [B,O,T] -> loss over [B,T,O]
                 pre = jnp.transpose(preact, (0, 2, 1))

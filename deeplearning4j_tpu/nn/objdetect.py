@@ -1,0 +1,229 @@
+"""Object detection: YOLOv2 output layer and inference utilities.
+
+Reference: org.deeplearning4j.nn.layers.objdetect —
+Yolo2OutputLayer (conf.layers.objdetect.Yolo2OutputLayer.Builder),
+DetectedObject, YoloUtils (getPredictedObjects / non-max suppression).
+
+Label format matches the reference: [minibatch, 4+C, H, W] where the 4 are
+(x1, y1, x2, y2) corner coordinates in GRID units and C is a per-cell
+one-hot class map; a cell contains an object iff its class vector is
+non-zero. Network output is a conv map with A*(5+C) channels for A anchors.
+
+TPU design: the whole loss — responsible-anchor IOU matching, coordinate /
+confidence / class terms — is one vectorized jnp expression over
+[B,H,W,A,...]; no per-box host loops, so it fuses into the same XLA
+computation as the backbone's forward+backward.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.layers import LossLayer
+
+
+class Yolo2OutputLayer(LossLayer):
+    """YOLOv2 detection loss head (reference:
+    conf.layers.objdetect.Yolo2OutputLayer).
+
+    boundingBoxes: [A, 2] anchor priors (w, h) in grid units.
+    """
+
+    def __init__(self, boundingBoxes=None, lambdaCoord=5.0, lambdaNoObj=0.5,
+                 **kw):
+        super().__init__(lossFunction="yolo2", **kw)
+        if boundingBoxes is None:
+            raise ValueError("Yolo2OutputLayer requires anchor boundingBoxes")
+        self.anchors = np.asarray(boundingBoxes, np.float32).reshape(-1, 2)
+        self.lambdaCoord = float(lambdaCoord)
+        self.lambdaNoObj = float(lambdaNoObj)
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def boundingBoxePriors(self, priors):
+            self._kw["boundingBoxes"] = (
+                priors.toNumpy() if hasattr(priors, "toNumpy") else priors)
+            return self
+
+        def lambdaCoord(self, v):
+            self._kw["lambdaCoord"] = v
+            return self
+
+        def lambdaNoObj(self, v):
+            self._kw["lambdaNoObj"] = v
+            return self
+
+        def build(self):
+            return Yolo2OutputLayer(**self._kw)
+
+    # ----- geometry ---------------------------------------------------
+    def _decode(self, pre):
+        """Raw conv map [B,H,W,A*(5+C)] -> (xy in grid units, wh in grid
+        units, conf, class logits), each [B,H,W,A,...]."""
+        B, H, W, D = pre.shape
+        A = self.anchors.shape[0]
+        p = pre.reshape(B, H, W, A, D // A)
+        cx = jnp.arange(W, dtype=p.dtype)[None, None, :, None]
+        cy = jnp.arange(H, dtype=p.dtype)[None, :, None, None]
+        xy = jnp.stack([jax.nn.sigmoid(p[..., 0]) + cx,
+                        jax.nn.sigmoid(p[..., 1]) + cy], axis=-1)
+        anchors = jnp.asarray(self.anchors, p.dtype)
+        wh = anchors * jnp.exp(jnp.clip(p[..., 2:4], -10.0, 10.0))
+        conf = jax.nn.sigmoid(p[..., 4])
+        cls = p[..., 5:]
+        return xy, wh, conf, cls
+
+    @staticmethod
+    def _iou_wh(wh_a, wh_b):
+        """IOU of boxes sharing a center; shapes broadcast to [..., 2]."""
+        inter = jnp.minimum(wh_a[..., 0], wh_b[..., 0]) * \
+            jnp.minimum(wh_a[..., 1], wh_b[..., 1])
+        union = wh_a[..., 0] * wh_a[..., 1] + wh_b[..., 0] * wh_b[..., 1] - inter
+        return inter / jnp.maximum(union, 1e-9)
+
+    @staticmethod
+    def _iou_boxes(xy_a, wh_a, xy_b, wh_b):
+        lo = jnp.maximum(xy_a - wh_a / 2, xy_b - wh_b / 2)
+        hi = jnp.minimum(xy_a + wh_a / 2, xy_b + wh_b / 2)
+        inter = jnp.prod(jnp.clip(hi - lo, 0.0), axis=-1)
+        union = jnp.prod(wh_a, -1) + jnp.prod(wh_b, -1) - inter
+        return inter / jnp.maximum(union, 1e-9)
+
+    # ----- loss -------------------------------------------------------
+    def computeLoss(self, pre, labels, mask=None):
+        """labels NCHW [B, 4+C, H, W] (reference layout); pre NHWC."""
+        lab = jnp.transpose(labels, (0, 2, 3, 1)).astype(pre.dtype)  # [B,H,W,4+C]
+        box, cls_lab = lab[..., :4], lab[..., 4:]
+        obj = (jnp.sum(cls_lab, -1) > 0).astype(pre.dtype)  # [B,H,W]
+
+        xy_p, wh_p, conf, cls_logits = self._decode(pre)
+
+        # label geometry (grid units)
+        xy_l = jnp.stack([(box[..., 0] + box[..., 2]) / 2,
+                          (box[..., 1] + box[..., 3]) / 2], -1)   # [B,H,W,2]
+        wh_l = jnp.stack([box[..., 2] - box[..., 0],
+                          box[..., 3] - box[..., 1]], -1)
+
+        # responsible anchor per labelled cell: best shape-IOU prior
+        anchors = jnp.asarray(self.anchors, pre.dtype)              # [A,2]
+        prior_iou = self._iou_wh(wh_l[..., None, :], anchors)       # [B,H,W,A]
+        resp = jax.nn.one_hot(jnp.argmax(prior_iou, -1),
+                              anchors.shape[0], dtype=pre.dtype)    # [B,H,W,A]
+        resp = resp * obj[..., None]
+
+        n_obj = jnp.maximum(jnp.sum(obj), 1.0)
+
+        # coordinate loss (sqrt-wh, as in the paper / reference)
+        d_xy = jnp.sum(jnp.square(xy_p - xy_l[..., None, :]), -1)
+        d_wh = jnp.sum(jnp.square(jnp.sqrt(jnp.maximum(wh_p, 1e-9)) -
+                                  jnp.sqrt(jnp.maximum(wh_l[..., None, :], 1e-9))), -1)
+        loss_coord = self.lambdaCoord * jnp.sum(resp * (d_xy + d_wh)) / n_obj
+
+        # confidence: responsible -> IOU target (stop-grad), others -> 0
+        iou = self._iou_boxes(xy_p, wh_p, xy_l[..., None, :], wh_l[..., None, :])
+        iou = jax.lax.stop_gradient(iou)
+        loss_obj = jnp.sum(resp * jnp.square(conf - iou)) / n_obj
+        loss_noobj = self.lambdaNoObj * \
+            jnp.sum((1.0 - resp) * jnp.square(conf)) / jnp.maximum(
+                jnp.sum(1.0 - resp), 1.0)
+
+        # class loss: softmax cross-entropy at responsible predictors
+        logp = jax.nn.log_softmax(cls_logits, -1)
+        ce = -jnp.sum(cls_lab[..., None, :] * logp, -1)             # [B,H,W,A]
+        loss_cls = jnp.sum(resp * ce) / n_obj
+
+        return loss_coord + loss_obj + loss_noobj + loss_cls
+
+    def forward(self, params, state, x, train, key, mask=None):
+        return x, state  # raw map; decoding happens in YoloUtils
+
+
+class DetectedObject:
+    """One detection (reference: objdetect.DetectedObject); coordinates in
+    grid units, like the reference."""
+
+    def __init__(self, exampleNumber, centerX, centerY, width, height,
+                 predictedClass, classPredictions, confidence):
+        self.exampleNumber = exampleNumber
+        self.centerX, self.centerY = centerX, centerY
+        self.width, self.height = width, height
+        self.predictedClass = predictedClass
+        self.classPredictions = classPredictions
+        self.confidence = confidence
+
+    def getTopLeftXY(self):
+        return (self.centerX - self.width / 2, self.centerY - self.height / 2)
+
+    def getBottomRightXY(self):
+        return (self.centerX + self.width / 2, self.centerY + self.height / 2)
+
+    def getPredictedClass(self):
+        return self.predictedClass
+
+    def getConfidence(self):
+        return self.confidence
+
+    def __repr__(self):
+        return (f"DetectedObject(ex={self.exampleNumber}, cls={self.predictedClass}, "
+                f"conf={self.confidence:.3f}, xywh=({self.centerX:.2f}, "
+                f"{self.centerY:.2f}, {self.width:.2f}, {self.height:.2f}))")
+
+
+class YoloUtils:
+    """Host-side decode + NMS (reference: objdetect.YoloUtils)."""
+
+    @staticmethod
+    def getPredictedObjects(layer: Yolo2OutputLayer, networkOutput,
+                            threshold: float = 0.5, nmsThreshold: float = 0.4):
+        """networkOutput: raw map [B,H,W,A*(5+C)] (the net's output for a
+        Yolo2 head). Returns a list of DetectedObject over all examples."""
+        out = np.asarray(networkOutput.toNumpy()
+                         if hasattr(networkOutput, "toNumpy") else networkOutput)
+        xy, wh, conf, cls_logits = (np.asarray(v) for v in
+                                    layer._decode(jnp.asarray(out)))
+        cls_prob = np.asarray(jax.nn.softmax(jnp.asarray(cls_logits), -1))
+        B = out.shape[0]
+        dets = []
+        for b in range(B):
+            mask = conf[b] >= threshold               # [H,W,A]
+            idxs = np.argwhere(mask)
+            cand = []
+            for (i, j, a) in idxs:
+                cand.append(DetectedObject(
+                    b, float(xy[b, i, j, a, 0]), float(xy[b, i, j, a, 1]),
+                    float(wh[b, i, j, a, 0]), float(wh[b, i, j, a, 1]),
+                    int(np.argmax(cls_prob[b, i, j, a])),
+                    cls_prob[b, i, j, a], float(conf[b, i, j, a])))
+            dets.extend(YoloUtils.nonMaxSuppression(cand, nmsThreshold))
+        return dets
+
+    @staticmethod
+    def iou(d1: DetectedObject, d2: DetectedObject) -> float:
+        x1, y1 = d1.getTopLeftXY()
+        x2, y2 = d1.getBottomRightXY()
+        u1, v1 = d2.getTopLeftXY()
+        u2, v2 = d2.getBottomRightXY()
+        iw = max(0.0, min(x2, u2) - max(x1, u1))
+        ih = max(0.0, min(y2, v2) - max(y1, v1))
+        inter = iw * ih
+        union = d1.width * d1.height + d2.width * d2.height - inter
+        return inter / union if union > 0 else 0.0
+
+    @staticmethod
+    def nonMaxSuppression(dets, nmsThreshold: float = 0.4):
+        """Greedy per-class NMS (reference: YoloUtils.nms)."""
+        keep = []
+        by_class = {}
+        for d in dets:
+            by_class.setdefault(d.predictedClass, []).append(d)
+        for ds in by_class.values():
+            ds = sorted(ds, key=lambda d: -d.confidence)
+            while ds:
+                best = ds.pop(0)
+                keep.append(best)
+                ds = [d for d in ds if YoloUtils.iou(best, d) < nmsThreshold]
+        return keep

@@ -9,8 +9,9 @@ ZooModel.initPretrained) — initPretrained raises with a clear message.
 
 from deeplearning4j_tpu.zoo.models import (
     ZooModel, LeNet, SimpleCNN, AlexNet, VGG16, VGG19, ResNet50, UNet,
-    TextGenerationLSTM,
+    TextGenerationLSTM, Darknet19, TinyYOLO, SqueezeNet, Xception,
 )
 
 __all__ = ["ZooModel", "LeNet", "SimpleCNN", "AlexNet", "VGG16", "VGG19",
-           "ResNet50", "UNet", "TextGenerationLSTM"]
+           "ResNet50", "UNet", "TextGenerationLSTM", "Darknet19", "TinyYOLO",
+           "SqueezeNet", "Xception"]

@@ -18,7 +18,7 @@ from deeplearning4j_tpu.nn import (
     LSTM, ElementWiseVertex, MergeVertex, Upsampling2D, ActivationLayer,
     Adam, Nesterovs, Sgd, WeightInit,
 )
-from deeplearning4j_tpu.nn.conf.layers import CnnLossLayer
+from deeplearning4j_tpu.nn.conf.layers import CnnLossLayer, LossLayer
 
 
 class ZooModel:
@@ -326,3 +326,221 @@ class TextGenerationLSTM(ZooModel):
                 .setInputType(InputType.recurrent(self.vocab, self.maxLength))
                 .build())
 
+
+class Darknet19(ZooModel):
+    """Reference: zoo.model.Darknet19 (Redmon's 19-conv classifier, the
+    YOLOv2 backbone)."""
+
+    def conf(self):
+        c, h, w = self.inputShape
+        lb = (NeuralNetConfiguration.Builder()
+              .seed(self.seed)
+              .updater(self.updater or Nesterovs(1e-3, 0.9))
+              .weightInit(WeightInit.RELU)
+              .dataType(self.dataType)
+              .list())
+
+        def conv_bn(nout, k):
+            lb.layer(ConvolutionLayer(nOut=nout, kernelSize=(k, k),
+                                      convolutionMode="same",
+                                      activation="identity", hasBias=False))
+            lb.layer(BatchNormalization(activation="leakyrelu"))
+
+        def pool():
+            lb.layer(SubsamplingLayer(poolingType="max", kernelSize=(2, 2),
+                                      stride=(2, 2)))
+
+        conv_bn(32, 3); pool()
+        conv_bn(64, 3); pool()
+        conv_bn(128, 3); conv_bn(64, 1); conv_bn(128, 3); pool()
+        conv_bn(256, 3); conv_bn(128, 1); conv_bn(256, 3); pool()
+        conv_bn(512, 3); conv_bn(256, 1); conv_bn(512, 3)
+        conv_bn(256, 1); conv_bn(512, 3); pool()
+        conv_bn(1024, 3); conv_bn(512, 1); conv_bn(1024, 3)
+        conv_bn(512, 1); conv_bn(1024, 3)
+        lb.layer(ConvolutionLayer(nOut=self.numClasses, kernelSize=(1, 1),
+                                  convolutionMode="same", activation="identity"))
+        lb.layer(GlobalPoolingLayer(poolingType="avg"))
+        lb.layer(LossLayer(lossFunction="mcxent", activation="softmax"))
+        return (lb.setInputType(InputType.convolutional(h, w, c)).build())
+
+
+class TinyYOLO(ZooModel):
+    """Reference: zoo.model.TinyYOLO — tiny-Darknet backbone + YOLOv2
+    detection head (objdetect.Yolo2OutputLayer). Default anchors are the
+    reference's VOC priors in 13x13-grid units."""
+
+    DEFAULT_ANCHORS = ((1.08, 1.19), (3.42, 4.41), (6.63, 11.38),
+                       (9.42, 5.11), (16.62, 10.52))
+
+    def __init__(self, numClasses=20, anchors=None, **kw):
+        kw.setdefault("inputShape", (3, 416, 416))
+        super().__init__(numClasses=numClasses, **kw)
+        self.anchors = anchors or self.DEFAULT_ANCHORS
+
+    @staticmethod
+    def defaultInputShape():
+        return (3, 416, 416)
+
+    def conf(self):
+        from deeplearning4j_tpu.nn.objdetect import Yolo2OutputLayer
+
+        c, h, w = self.inputShape
+        A = len(self.anchors)
+        lb = (NeuralNetConfiguration.Builder()
+              .seed(self.seed)
+              .updater(self.updater or Adam(1e-3))
+              .weightInit(WeightInit.RELU)
+              .dataType(self.dataType)
+              .list())
+
+        def conv_bn(nout):
+            lb.layer(ConvolutionLayer(nOut=nout, kernelSize=(3, 3),
+                                      convolutionMode="same",
+                                      activation="identity", hasBias=False))
+            lb.layer(BatchNormalization(activation="leakyrelu"))
+
+        for i, nout in enumerate((16, 32, 64, 128, 256)):
+            conv_bn(nout)
+            lb.layer(SubsamplingLayer(poolingType="max", kernelSize=(2, 2),
+                                      stride=(2, 2)))
+        conv_bn(512)
+        # reference keeps 13x13 from here: stride-1 'same' max pool
+        lb.layer(SubsamplingLayer(poolingType="max", kernelSize=(2, 2),
+                                  stride=(1, 1), convolutionMode="same"))
+        conv_bn(1024)
+        lb.layer(ConvolutionLayer(nOut=A * (5 + self.numClasses),
+                                  kernelSize=(1, 1), activation="identity"))
+        lb.layer(Yolo2OutputLayer(boundingBoxes=self.anchors))
+        return (lb.setInputType(InputType.convolutional(h, w, c)).build())
+
+
+class SqueezeNet(ZooModel):
+    """Reference: zoo.model.SqueezeNet (v1.1 fire modules)."""
+
+    def conf(self):
+        c, h, w = self.inputShape
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(self.updater or Adam(1e-3))
+             .weightInit(WeightInit.RELU)
+             .dataType(self.dataType)
+             .graphBuilder()
+             .addInputs("input"))
+        g.addLayer("conv1", ConvolutionLayer(nOut=64, kernelSize=(3, 3),
+                                             stride=(2, 2), activation="relu"),
+                   "input")
+        g.addLayer("pool1", SubsamplingLayer(poolingType="max", kernelSize=(3, 3),
+                                             stride=(2, 2)), "conv1")
+
+        def fire(name, inp, squeeze, expand):
+            g.addLayer(f"{name}_sq", ConvolutionLayer(nOut=squeeze, kernelSize=(1, 1),
+                                                      activation="relu"), inp)
+            g.addLayer(f"{name}_e1", ConvolutionLayer(nOut=expand, kernelSize=(1, 1),
+                                                      activation="relu"), f"{name}_sq")
+            g.addLayer(f"{name}_e3", ConvolutionLayer(nOut=expand, kernelSize=(3, 3),
+                                                      convolutionMode="same",
+                                                      activation="relu"), f"{name}_sq")
+            g.addVertex(f"{name}_cat", MergeVertex(), f"{name}_e1", f"{name}_e3")
+            return f"{name}_cat"
+
+        x = fire("fire2", "pool1", 16, 64)
+        x = fire("fire3", x, 16, 64)
+        g.addLayer("pool3", SubsamplingLayer(poolingType="max", kernelSize=(3, 3),
+                                             stride=(2, 2)), x)
+        x = fire("fire4", "pool3", 32, 128)
+        x = fire("fire5", x, 32, 128)
+        g.addLayer("pool5", SubsamplingLayer(poolingType="max", kernelSize=(3, 3),
+                                             stride=(2, 2)), x)
+        x = fire("fire6", "pool5", 48, 192)
+        x = fire("fire7", x, 48, 192)
+        x = fire("fire8", x, 64, 256)
+        x = fire("fire9", x, 64, 256)
+        g.addLayer("drop", DropoutLayer(dropOut=0.5), x)
+        g.addLayer("conv10", ConvolutionLayer(nOut=self.numClasses, kernelSize=(1, 1),
+                                              activation="relu"), "drop")
+        g.addLayer("gap", GlobalPoolingLayer(poolingType="avg"), "conv10")
+        g.addLayer("out", LossLayer(lossFunction="mcxent", activation="softmax"), "gap")
+        return (g.setOutputs("out")
+                 .setInputTypes(InputType.convolutional(h, w, c))
+                 .build())
+
+
+class Xception(ZooModel):
+    """Reference: zoo.model.Xception (Chollet; depthwise-separable towers).
+    Entry/middle/exit flow with residual connections; middle-flow depth is
+    configurable (reference uses 8)."""
+
+    def __init__(self, middleFlowBlocks=8, **kw):
+        kw.setdefault("inputShape", (3, 299, 299))
+        super().__init__(**kw)
+        self.middleFlowBlocks = middleFlowBlocks
+
+    @staticmethod
+    def defaultInputShape():
+        return (3, 299, 299)
+
+    def conf(self):
+        from deeplearning4j_tpu.nn.conf.layers import SeparableConvolution2D
+
+        c, h, w = self.inputShape
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(self.updater or Adam(1e-3))
+             .weightInit(WeightInit.RELU)
+             .dataType(self.dataType)
+             .graphBuilder()
+             .addInputs("input"))
+
+        def conv_bn(name, inp, nout, k, stride=1, act="relu"):
+            g.addLayer(f"{name}_c", ConvolutionLayer(
+                nOut=nout, kernelSize=(k, k), stride=(stride, stride),
+                convolutionMode="same", activation="identity", hasBias=False), inp)
+            g.addLayer(f"{name}_bn", BatchNormalization(activation=act), f"{name}_c")
+            return f"{name}_bn"
+
+        def sepconv_bn(name, inp, nout, act="relu"):
+            g.addLayer(f"{name}_s", SeparableConvolution2D(
+                nOut=nout, kernelSize=(3, 3), convolutionMode="same",
+                activation="identity", hasBias=False), inp)
+            g.addLayer(f"{name}_bn", BatchNormalization(activation=act), f"{name}_s")
+            return f"{name}_bn"
+
+        def entry_block(name, inp, nout, first_relu=True):
+            x = inp
+            if first_relu:
+                g.addLayer(f"{name}_r", ActivationLayer(activation="relu"), x)
+                x = f"{name}_r"
+            x = sepconv_bn(f"{name}_s1", x, nout)
+            x = sepconv_bn(f"{name}_s2", x, nout, act="identity")
+            g.addLayer(f"{name}_p", SubsamplingLayer(
+                poolingType="max", kernelSize=(3, 3), stride=(2, 2),
+                convolutionMode="same"), x)
+            proj = conv_bn(f"{name}_proj", inp, nout, 1, stride=2, act="identity")
+            g.addVertex(f"{name}_add", ElementWiseVertex("add"), f"{name}_p", proj)
+            return f"{name}_add"
+
+        x = conv_bn("stem1", "input", 32, 3, stride=2)
+        x = conv_bn("stem2", x, 64, 3)
+        x = entry_block("entry1", x, 128, first_relu=False)
+        x = entry_block("entry2", x, 256)
+        x = entry_block("entry3", x, 728)
+
+        for i in range(self.middleFlowBlocks):
+            inp = x
+            y = x
+            for j in range(3):
+                g.addLayer(f"mid{i}_r{j}", ActivationLayer(activation="relu"), y)
+                y = sepconv_bn(f"mid{i}_s{j}", f"mid{i}_r{j}", 728, act="identity")
+            g.addVertex(f"mid{i}_add", ElementWiseVertex("add"), y, inp)
+            x = f"mid{i}_add"
+
+        x = entry_block("exit1", x, 1024)
+        x = sepconv_bn("exit2", x, 1536)
+        x = sepconv_bn("exit3", x, 2048)
+        g.addLayer("gap", GlobalPoolingLayer(poolingType="avg"), x)
+        g.addLayer("out", OutputLayer(nOut=self.numClasses, activation="softmax",
+                                      lossFunction="mcxent"), "gap")
+        return (g.setOutputs("out")
+                 .setInputTypes(InputType.convolutional(h, w, c))
+                 .build())

@@ -75,3 +75,53 @@ class TestZoo:
         # construction-only at reduced size (full VGG too heavy for CPU CI)
         conf = VGG16(numClasses=5, inputShape=(3, 32, 32)).conf()
         assert len(conf.layers) == 13 + 5 + 2 + 1  # convs + pools + dense + out
+
+
+class TestZooDetectionAndSeparable:
+    def test_darknet19(self):
+        from deeplearning4j_tpu.zoo import Darknet19
+
+        net = Darknet19(numClasses=10, inputShape=(3, 32, 32)).init()
+        x = np.random.RandomState(0).rand(2, 3, 32, 32).astype("float32")
+        y = np.eye(10, dtype="float32")[np.random.RandomState(1).randint(0, 10, 2)]
+        net.fit(x, y)
+        out = net.output(x)
+        assert out.shape() == (2, 10)
+        np.testing.assert_allclose(out.toNumpy().sum(1), np.ones(2), rtol=1e-3)
+
+    def test_tiny_yolo(self):
+        from deeplearning4j_tpu.zoo import TinyYOLO
+
+        net = TinyYOLO(numClasses=4, inputShape=(3, 64, 64)).init()
+        # 64/32 = 2x2 grid; head channels = A*(5+C) = 5*9
+        x = np.random.RandomState(0).rand(2, 3, 64, 64).astype("float32")
+        out = net.output(x)
+        assert out.shape() == (2, 2, 2, 5 * 9)
+        lab = np.zeros((2, 4 + 4, 2, 2), np.float32)
+        lab[0, 0:4, 0, 0] = (0.1, 0.1, 0.9, 0.9)
+        lab[0, 4, 0, 0] = 1.0
+        from deeplearning4j_tpu.data import DataSet
+
+        ds = DataSet(x, lab)
+        s0 = net.score(ds)
+        net.fit(ds)
+        assert np.isfinite(s0) and np.isfinite(net.score(ds))
+
+    def test_squeezenet(self):
+        from deeplearning4j_tpu.zoo import SqueezeNet
+
+        net = SqueezeNet(numClasses=7, inputShape=(3, 64, 64)).init()
+        x = np.random.RandomState(0).rand(2, 3, 64, 64).astype("float32")
+        out = net.outputSingle(x)
+        assert out.shape() == (2, 7)
+        np.testing.assert_allclose(out.toNumpy().sum(1), np.ones(2), rtol=1e-3)
+
+    def test_xception(self):
+        from deeplearning4j_tpu.zoo import Xception
+
+        # tiny middle flow to keep the CPU test fast
+        net = Xception(numClasses=5, inputShape=(3, 64, 64), middleFlowBlocks=1).init()
+        x = np.random.RandomState(0).rand(2, 3, 64, 64).astype("float32")
+        out = net.outputSingle(x)
+        assert out.shape() == (2, 5)
+        np.testing.assert_allclose(out.toNumpy().sum(1), np.ones(2), rtol=1e-3)
