@@ -200,12 +200,12 @@ def _convert_layer(spec: _KerasLayerSpec, is_last: bool):
         bn = L.BatchNormalization(
             decay=float(cfg.get("momentum", 0.99)),
             eps=float(cfg.get("epsilon", 1e-3)),
-            lockGammaBeta=not (cfg.get("scale", True) or cfg.get("center", True)),
+            # per-param locking: a Keras BN with scale=False keeps a trainable
+            # beta but NO gamma — creating a trainable identity gamma would
+            # add degrees of freedom Keras omitted and diverge on fine-tune
+            lockGamma=not cfg.get("scale", True),
+            lockBeta=not cfg.get("center", True),
             name=name)
-        # weight-list layout depends on these flags (gamma/beta omitted
-        # when off); _apply_weights consults them
-        bn._keras_scale = bool(cfg.get("scale", True))
-        bn._keras_center = bool(cfg.get("center", True))
         return bn
     if cn == "ZeroPadding2D":
         pad = cfg.get("padding", 1)
@@ -301,9 +301,10 @@ def _apply_weights(layer, weights, params, state):
         return p, s
     if isinstance(layer, L.BatchNormalization):
         # Keras omits gamma when scale=False and beta when center=False;
-        # the native layer may still hold both (identity-initialized)
-        has_gamma = getattr(layer, "_keras_scale", True)
-        has_beta = getattr(layer, "_keras_center", True)
+        # lockGamma/lockBeta mirror those flags exactly (set at conversion),
+        # so the weight-list layout follows from them
+        has_gamma = not (layer.lockGammaBeta or layer.lockGamma)
+        has_beta = not (layer.lockGammaBeta or layer.lockBeta)
         idx = 0
         if has_gamma and "gamma" in p:
             put("gamma", weights[idx])
@@ -329,6 +330,24 @@ def _apply_weights(layer, weights, params, state):
         put("RW", weights[1])
         if len(weights) > 2:
             put("b", weights[2])
+        return p, s
+    from deeplearning4j_tpu.nn.conf.attention import AttentionVertex as _AV
+    if isinstance(layer, _AV):
+        # Keras MHA weight order: query/kernel [E,H,hs] (+bias [H,hs]),
+        # key/kernel, value/kernel, attention_output/kernel [H,hs,E] (+bias
+        # [E]); our projections are flat [E, H*hs] / [H*hs, E]
+        has_b = layer.hasBias
+        step = 2 if has_b else 1
+        qk, kk, vk, ok = (np.asarray(weights[i * step]) for i in range(4))
+        put("Wq", qk.reshape(qk.shape[0], -1))
+        put("Wk", kk.reshape(kk.shape[0], -1))
+        put("Wv", vk.reshape(vk.shape[0], -1))
+        put("Wo", ok.reshape(-1, ok.shape[-1]))
+        if has_b:
+            put("bq", np.asarray(weights[1]).reshape(-1))
+            put("bk", np.asarray(weights[3]).reshape(-1))
+            put("bv", np.asarray(weights[5]).reshape(-1))
+            put("bo", np.asarray(weights[7]).reshape(-1))
         return p, s
     raise UnsupportedKerasConfigurationException(
         f"weight import not supported for layer type {cn}")
@@ -516,6 +535,28 @@ class KerasModelImport:
                        "Maximum": ElementWiseVertex("max"),
                        "Concatenate": MergeVertex()}[sp.className]
                 gb.addVertex(sp.name, vtx, *inputs)
+                continue
+            if sp.className == "MultiHeadAttention":
+                from deeplearning4j_tpu.nn.conf.attention import AttentionVertex
+
+                c = sp.config
+                if c.get("value_dim") not in (None, c.get("key_dim")):
+                    raise UnsupportedKerasConfigurationException(
+                        f"MultiHeadAttention with value_dim != key_dim not "
+                        f"supported (layer '{sp.name}')")
+                if c.get("output_shape") is not None:
+                    raise UnsupportedKerasConfigurationException(
+                        f"MultiHeadAttention custom output_shape not supported "
+                        f"(layer '{sp.name}')")
+                av = AttentionVertex(
+                    nHeads=int(c["num_heads"]), headSize=int(c["key_dim"]),
+                    hasBias=bool(c.get("use_bias", True)), name=sp.name)
+                # Keras call order is (query, value[, key]); the vertex wants
+                # (query[, keys[, values]])
+                if len(inputs) == 3:
+                    inputs = [inputs[0], inputs[2], inputs[1]]
+                gb.addVertex(sp.name, av, *inputs)
+                native_by_name[sp.name] = av
                 continue
             is_out = sp.name in output_names
             nl = _convert_layer(sp, is_last=is_out)

@@ -24,6 +24,10 @@ from deeplearning4j_tpu.nn.conf.layers import (
 from deeplearning4j_tpu.nn.conf.recurrent import (
     LSTM, GravesLSTM, SimpleRnn, GRU, Bidirectional, LastTimeStep,
 )
+from deeplearning4j_tpu.nn.conf.attention import (
+    SelfAttentionLayer, LearnedSelfAttentionLayer, RecurrentAttentionLayer,
+    AttentionVertex,
+)
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.nn.conf.graph import (
     GraphBuilder, ComputationGraphConfiguration, MergeVertex, ElementWiseVertex,

@@ -1,0 +1,358 @@
+"""Attention layer configurations.
+
+Reference: org.deeplearning4j.nn.conf.layers.{SelfAttentionLayer,
+LearnedSelfAttentionLayer, RecurrentAttentionLayer} and
+org.deeplearning4j.nn.conf.graph.AttentionVertex — all implemented
+upstream by lowering to SameDiff's sd.nn.multiHeadDotProductAttention
+(scaled dot-product attention, Vaswani et al.).
+
+TPU design: the layers lower to ops/attention.py — a fused XLA
+dot-product attention for typical sequence lengths and the flash-style
+blockwise scan for long ones; the MXU does the QK^T and PV matmuls in
+bf16. Data format between layers stays the reference's NCW [B, F, T];
+the attention math runs [B, T, F] internally.
+
+Masks follow the reference's semantics: the feature mask [B, T] marks
+valid KEY timesteps; masked keys receive -inf scores, and masked query
+positions are zeroed in the output.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import activations as _act
+from deeplearning4j_tpu.nn import weights as _winit
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import FeedForwardLayer
+from deeplearning4j_tpu.ops import attention as _attn
+
+
+def _mha_params(key, nIn, nHeads, headSize, nOut, weightInit, dtype,
+                distribution, with_bias=False, query_nIn=None):
+    """Wq/Wk/Wv project to [nHeads*headSize]; Wo projects back to nOut."""
+    kq, kk, kv, ko = jax.random.split(key, 4)
+    E, P = nIn, nHeads * headSize
+    Eq = query_nIn if query_nIn is not None else nIn
+    p = {
+        "Wq": _winit.init(kq, weightInit, (Eq, P), Eq, P, dtype, distribution),
+        "Wk": _winit.init(kk, weightInit, (E, P), E, P, dtype, distribution),
+        "Wv": _winit.init(kv, weightInit, (E, P), E, P, dtype, distribution),
+        "Wo": _winit.init(ko, weightInit, (P, nOut), P, nOut, dtype, distribution),
+    }
+    if with_bias:
+        p["bq"] = jnp.zeros((P,), dtype)
+        p["bk"] = jnp.zeros((P,), dtype)
+        p["bv"] = jnp.zeros((P,), dtype)
+        p["bo"] = jnp.zeros((nOut,), dtype)
+    return p
+
+
+def _project(x, W, b):
+    y = x @ W
+    return y if b is None else y + b
+
+
+def _mha_apply(params, q_btf, kv_btf, nHeads, mask=None, block_size=None):
+    """q [B,Tq,Eq], kv [B,Tk,E] -> [B,Tq,nOut]. mask: [B,Tk] key validity."""
+    B, Tq, _ = q_btf.shape
+    Tk = kv_btf.shape[1]
+    q = _project(q_btf, params["Wq"], params.get("bq"))
+    k = _project(kv_btf, params["Wk"], params.get("bk"))
+    v = _project(kv_btf, params["Wv"], params.get("bv"))
+    q = q.reshape(B, Tq, nHeads, -1).transpose(0, 2, 1, 3)
+    k = k.reshape(B, Tk, nHeads, -1).transpose(0, 2, 1, 3)
+    v = v.reshape(B, Tk, nHeads, -1).transpose(0, 2, 1, 3)
+    amask = None if mask is None else (mask > 0)[:, None, None, :]  # [B,1,1,Tk]
+    if block_size:
+        o = _attn.blockwise_attention(q, k, v, block_size=block_size,
+                                      key_mask=None if mask is None else mask > 0)
+    else:
+        o = _attn.dot_product_attention(q, k, v, mask=amask)
+    o = o.transpose(0, 2, 1, 3).reshape(B, Tq, -1)
+    return _project(o, params["Wo"], params.get("bo"))
+
+
+class SelfAttentionLayer(FeedForwardLayer):
+    """Multi-head dot-product self-attention over the input sequence
+    (reference: conf.layers.SelfAttentionLayer). Input/output NCW
+    [B, F, T] -> [B, nOut, T].
+
+    projectInput=False requires nHeads==1 and nOut==nIn (raw attention,
+    no parameters) — same constraint as the reference.
+    """
+
+    def __init__(self, nHeads=1, headSize=None, projectInput=True,
+                 hasBias=False, blockSize=None, **kw):
+        super().__init__(**kw)
+        self.nHeads = int(nHeads)
+        self.headSize = headSize
+        self.projectInput = projectInput
+        self.hasBias = hasBias
+        self.blockSize = blockSize  # None = fused XLA; int = flash blockwise
+
+    def getOutputType(self, inputType):
+        n = self.nOut if (self.projectInput and self.nOut) else inputType.size
+        self.nOut = n
+        return InputType.recurrent(n, inputType.dims.get("timeSeriesLength"))
+
+    def initialize(self, key, inputType, dtype):
+        if self.nIn is None:
+            self.nIn = inputType.size
+        if not self.projectInput:
+            if self.nHeads != 1:
+                raise ValueError("projectInput=False requires nHeads=1 "
+                                 "(reference: SelfAttentionLayer)")
+            self.nOut = self.nIn
+            return {}, {}
+        if self.nOut is None:
+            self.nOut = self.nIn
+        if self.headSize is None:
+            if self.nOut % self.nHeads:
+                raise ValueError(f"nOut={self.nOut} not divisible by "
+                                 f"nHeads={self.nHeads}; set headSize")
+            self.headSize = self.nOut // self.nHeads
+        return _mha_params(key, self.nIn, self.nHeads, self.headSize, self.nOut,
+                           self.weightInit, dtype, self.distribution,
+                           self.hasBias), {}
+
+    def hasParams(self):
+        return self.projectInput
+
+    def forward(self, params, state, x, train, key, mask=None):
+        x = self._dropout_input(x, train, key)
+        xt = jnp.transpose(x, (0, 2, 1))  # NCW -> [B,T,F]
+        if self.projectInput:
+            y = _mha_apply(params, xt, xt, self.nHeads, mask=mask,
+                           block_size=self.blockSize)
+        else:
+            q = xt[:, None]  # [B,1,T,F]: single "head"
+            amask = None if mask is None else (mask > 0)[:, None, None, :]
+            y = _attn.dot_product_attention(q, q, q, mask=amask)[:, 0]
+        if mask is not None:
+            y = y * mask[:, :, None]  # zero masked query positions
+        y = _act.get(self.activation)(y)
+        return jnp.transpose(y, (0, 2, 1)), state
+
+
+class LearnedSelfAttentionLayer(SelfAttentionLayer):
+    """Attention with nQueries LEARNED query vectors pooling the sequence
+    to a fixed-length output (reference:
+    conf.layers.LearnedSelfAttentionLayer). [B, F, T] -> [B, nOut, nQueries].
+    """
+
+    def __init__(self, nQueries=1, **kw):
+        super().__init__(**kw)
+        self.nQueries = int(nQueries)
+
+    def getOutputType(self, inputType):
+        n = self.nOut if (self.projectInput and self.nOut) else inputType.size
+        self.nOut = n
+        return InputType.recurrent(n, self.nQueries)
+
+    def initialize(self, key, inputType, dtype):
+        kq, kp = jax.random.split(key)
+        params, state = super().initialize(kp, inputType, dtype)
+        # learned queries live in input space, like the reference's Q param
+        params = dict(params)
+        params["Q"] = _winit.init(kq, self.weightInit,
+                                  (self.nQueries, self.nIn), self.nIn,
+                                  self.nQueries, dtype, self.distribution)
+        return params, state
+
+    def hasParams(self):
+        return True
+
+    def forward(self, params, state, x, train, key, mask=None):
+        x = self._dropout_input(x, train, key)
+        xt = jnp.transpose(x, (0, 2, 1))  # [B,T,F]
+        B = xt.shape[0]
+        q = jnp.broadcast_to(params["Q"][None], (B,) + params["Q"].shape)
+        if self.projectInput:
+            y = _mha_apply(params, q, xt, self.nHeads, mask=mask,
+                           block_size=self.blockSize)
+        else:
+            qh = q[:, None]
+            kh = xt[:, None]
+            amask = None if mask is None else (mask > 0)[:, None, None, :]
+            y = _attn.dot_product_attention(qh, kh, kh, mask=amask)[:, 0]
+        y = _act.get(self.activation)(y)
+        return jnp.transpose(y, (0, 2, 1)), state  # [B,nOut,nQueries]
+
+
+class RecurrentAttentionLayer(FeedForwardLayer):
+    """Recurrent layer whose step combines the current input with
+    attention over the full input sequence, queried by the previous
+    hidden state (reference: conf.layers.RecurrentAttentionLayer):
+
+        attn_t = MHA(q = a_{t-1}, k = v = x)
+        a_t    = activation(x_t @ W + attn_t @ R + b)
+
+    [B, F, T] -> [B, nOut, T]. The scan carries only a_{t-1}; the K/V
+    projections of the whole sequence are hoisted out of the loop (one
+    big MXU matmul instead of T small ones).
+    """
+
+    def __init__(self, nHeads=1, headSize=None, hasBias=True, **kw):
+        super().__init__(**kw)
+        self.nHeads = int(nHeads)
+        self.headSize = headSize
+        self.hasBias = hasBias
+        if self.activation is None:
+            self.activation = "tanh"
+
+    def mergeGlobals(self, defaults):
+        act_before = self.activation
+        super().mergeGlobals(defaults)
+        if act_before is not None:
+            self.activation = act_before
+
+    def getOutputType(self, inputType):
+        return InputType.recurrent(self.nOut, inputType.dims.get("timeSeriesLength"))
+
+    def initialize(self, key, inputType, dtype):
+        if self.nIn is None:
+            self.nIn = inputType.size
+        H = self.nOut
+        if self.headSize is None:
+            if H % self.nHeads:
+                raise ValueError(f"nOut={H} not divisible by nHeads={self.nHeads}")
+            self.headSize = H // self.nHeads
+        kw_, kr, ka = jax.random.split(key, 3)
+        params = _mha_params(ka, self.nIn, self.nHeads, self.headSize, H,
+                             self.weightInit, dtype, self.distribution,
+                             query_nIn=H)
+        params["W"] = _winit.init(kw_, self.weightInit, (self.nIn, H),
+                                  self.nIn, H, dtype, self.distribution)
+        params["R"] = _winit.init(kr, self.weightInit, (H, H), H, H, dtype,
+                                  self.distribution)
+        if self.hasBias:
+            params["b"] = jnp.zeros((H,), dtype)
+        return params, {}
+
+    def forward(self, params, state, x, train, key, mask=None):
+        x = self._dropout_input(x, train, key)
+        xt = jnp.transpose(x, (0, 2, 1))          # [B,T,F]
+        B, T, _ = xt.shape
+        H, nh = self.nOut, self.nHeads
+        # hoist K/V projection of the whole sequence out of the scan
+        k = (xt @ params["Wk"]).reshape(B, T, nh, -1).transpose(0, 2, 1, 3)
+        v = (xt @ params["Wv"]).reshape(B, T, nh, -1).transpose(0, 2, 1, 3)
+        xW = xt @ params["W"]                     # [B,T,H]
+        if self.hasBias:
+            xW = xW + params["b"]
+        amask = None if mask is None else (mask > 0)[:, None, None, :]
+        act = _act.get(self.activation)
+
+        def step(a_prev, xWt):
+            q = (a_prev @ params["Wq"]).reshape(B, 1, nh, -1).transpose(0, 2, 1, 3)
+            o = _attn.dot_product_attention(q, k, v, mask=amask)
+            o = o.transpose(0, 2, 1, 3).reshape(B, -1) @ params["Wo"]  # [B,H]
+            a = act(xWt + o @ params["R"])
+            return a, a
+
+        a0 = state.get("h") if state else None
+        if a0 is None:
+            a0 = jnp.zeros((B, H), xt.dtype)
+        a_last, ys = jax.lax.scan(step, a0, jnp.transpose(xW, (1, 0, 2)))
+        y = jnp.transpose(ys, (1, 2, 0))          # [T,B,H] -> [B,H,T]
+        if mask is not None:
+            y = y * mask[:, None, :]
+        return y, {**(state or {}), "h": a_last}
+
+
+class AttentionVertex(FeedForwardLayer):
+    """General multi-head attention DAG vertex (reference:
+    conf.graph.AttentionVertex). Used via
+    ``addVertex("attn", AttentionVertex(...), queries, keys, values)``
+    with 1 input (self-attention), 2 (queries, keyvalues) or 3
+    (queries, keys, values). Sequence inputs are NCW; output is
+    [B, nOut, Tq].
+
+    Unlike the parameterless vertices this one owns projection weights,
+    so the executor treats it as a (multi-input) layer node.
+    """
+
+    multiInput = True
+
+    def __init__(self, nInQueries=None, nInKeys=None, nInValues=None,
+                 nHeads=1, headSize=None, projectInput=True, nOut=None,
+                 hasBias=False, blockSize=None, **kw):
+        super().__init__(nOut=nOut, **kw)
+        self.nInQueries, self.nInKeys, self.nInValues = nInQueries, nInKeys, nInValues
+        self.nHeads = int(nHeads)
+        self.headSize = headSize
+        self.projectInput = projectInput
+        self.hasBias = hasBias
+        self.blockSize = blockSize
+
+    def getOutputType(self, *inputTypes):
+        qt = inputTypes[0]
+        n = self.nOut if (self.projectInput and self.nOut) else qt.size
+        self.nOut = n
+        return InputType.recurrent(n, qt.dims.get("timeSeriesLength"))
+
+    def inferNIn(self, *inputTypes):
+        qt = inputTypes[0]
+        kt = inputTypes[1] if len(inputTypes) > 1 else qt
+        if self.nInQueries is None:
+            self.nInQueries = qt.size
+        if self.nInKeys is None:
+            self.nInKeys = kt.size
+        if self.nInValues is None:
+            self.nInValues = (inputTypes[2] if len(inputTypes) > 2 else kt).size
+
+    def initialize(self, key, inputType, dtype):
+        its = inputType if isinstance(inputType, (list, tuple)) else [inputType]
+        self.inferNIn(*its)
+        if not self.projectInput:
+            if self.nHeads != 1:
+                raise ValueError("projectInput=False requires nHeads=1")
+            self.nOut = self.nInQueries
+            return {}, {}
+        if self.nOut is None:
+            self.nOut = self.nInQueries
+        if self.headSize is None:
+            if self.nOut % self.nHeads:
+                raise ValueError(f"nOut={self.nOut} not divisible by "
+                                 f"nHeads={self.nHeads}; set headSize")
+            self.headSize = self.nOut // self.nHeads
+        return _mha_params(key, self.nInKeys, self.nHeads, self.headSize,
+                           self.nOut, self.weightInit, dtype, self.distribution,
+                           self.hasBias, query_nIn=self.nInQueries), {}
+
+    def hasParams(self):
+        return self.projectInput
+
+    def forward(self, params, state, xs, train, key, mask=None):
+        xs = xs if isinstance(xs, (list, tuple)) else [xs]
+        q_ncw = xs[0]
+        kv_ncw = xs[1] if len(xs) > 1 else xs[0]
+        qt = jnp.transpose(q_ncw, (0, 2, 1))
+        kvt = jnp.transpose(kv_ncw, (0, 2, 1))
+        if len(xs) > 2:
+            # distinct values input: project V from it, K from keys input
+            vt = jnp.transpose(xs[2], (0, 2, 1))
+            B, Tq = qt.shape[0], qt.shape[1]
+            Tk = kvt.shape[1]
+            nh = self.nHeads
+            qp = _project(qt, params["Wq"], params.get("bq"))
+            kp = _project(kvt, params["Wk"], params.get("bk"))
+            vp = _project(vt, params["Wv"], params.get("bv"))
+            qp = qp.reshape(B, Tq, nh, -1).transpose(0, 2, 1, 3)
+            kp = kp.reshape(B, Tk, nh, -1).transpose(0, 2, 1, 3)
+            vp = vp.reshape(B, Tk, nh, -1).transpose(0, 2, 1, 3)
+            amask = None if mask is None else (mask > 0)[:, None, None, :]
+            o = _attn.dot_product_attention(qp, kp, vp, mask=amask)
+            y = _project(o.transpose(0, 2, 1, 3).reshape(B, Tq, -1),
+                         params["Wo"], params.get("bo"))
+        elif self.projectInput:
+            y = _mha_apply(params, qt, kvt, self.nHeads, mask=mask,
+                           block_size=self.blockSize)
+        else:
+            qh, kh = qt[:, None], kvt[:, None]
+            amask = None if mask is None else (mask > 0)[:, None, None, :]
+            y = _attn.dot_product_attention(qh, kh, kh, mask=amask)[:, 0]
+        y = _act.get(self.activation)(y)
+        return jnp.transpose(y, (0, 2, 1)), state

@@ -275,6 +275,14 @@ class ComputationGraphConfiguration:
                 continue
             layer = node.payload
             layer.mergeGlobals(self.defaults)
+            if getattr(layer, "multiInput", False):
+                # multi-input layer node (AttentionVertex): all input types
+                # flow through; no auto preprocessor between sequences
+                if hasattr(layer, "inferNIn"):
+                    layer.inferNIn(*in_types)
+                node.layerInputType = list(in_types)
+                node.inputType = layer.getOutputType(*in_types)
+                continue
             cur = in_types[0]
             if node.preprocessor is None:
                 pp, cur2 = self._auto_pp(layer, cur)
@@ -323,7 +331,11 @@ class GraphBuilder:
         return self.addLayer(name, layer, *inputs)
 
     def addVertex(self, name, vertex, *inputs):
-        self._nodes[name] = _Node(name, "vertex", vertex, inputs)
+        # parameterized vertices (AttentionVertex) carry the Layer interface;
+        # the executor runs them as (multi-input) layer nodes so they join
+        # the params/updater pytrees
+        kind = "layer" if isinstance(vertex, L.Layer) else "vertex"
+        self._nodes[name] = _Node(name, kind, vertex, inputs)
         return self
 
     def setOutputs(self, *names):

@@ -647,11 +647,17 @@ class BatchNormalization(Layer):
     conf.layers.BatchNormalization + CudnnBatchNormalizationHelper)."""
 
     def __init__(self, decay=0.9, eps=1e-5, gamma=1.0, beta=0.0, lockGammaBeta=False,
-                 useLogStd=False, nOut=None, nIn=None, **kw):
+                 lockGamma=False, lockBeta=False, useLogStd=False, nOut=None,
+                 nIn=None, **kw):
         super().__init__(**kw)
         self.decay, self.eps = decay, eps
         self.gammaInit, self.betaInit = gamma, beta
         self.lockGammaBeta = lockGammaBeta
+        # per-param locking beyond the reference's all-or-nothing flag:
+        # Keras allows scale=False with center=True (and vice versa), so an
+        # imported model must be able to freeze exactly the absent parameter
+        self.lockGamma = lockGamma
+        self.lockBeta = lockBeta
         self.nIn, self.nOut = nIn, nOut
 
     def getOutputType(self, inputType):
@@ -668,8 +674,9 @@ class BatchNormalization(Layer):
         n = self.nOut or self._nfeat(inputType)
         self.nOut = self.nIn = n
         params = {}
-        if not self.lockGammaBeta:
+        if not (self.lockGammaBeta or self.lockGamma):
             params["gamma"] = jnp.full((n,), self.gammaInit, dtype)
+        if not (self.lockGammaBeta or self.lockBeta):
             params["beta"] = jnp.full((n,), self.betaInit, dtype)
         state = {"mean": jnp.zeros((n,), jnp.float32), "var": jnp.ones((n,), jnp.float32)}
         return params, state

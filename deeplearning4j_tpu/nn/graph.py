@@ -38,7 +38,9 @@ class ComputationGraph:
         self._listeners = []
         self._compute_dtype = conf.dataType.np_dtype
         self._param_dtype = jnp.float64 if self._compute_dtype == jnp.float64 else jnp.float32
-        self._jit_train = jax.jit(self._train_step, donate_argnums=(0, 1, 2))
+        self._jit_train = jax.jit(self._train_step,
+                                  static_argnames=("use_carries",),
+                                  donate_argnums=(0, 1, 2))
         self._jit_forward = jax.jit(self._forward_infer)
         self._jit_loss = jax.jit(self._loss_only)
 
@@ -114,21 +116,38 @@ class ComputationGraph:
             if node.kind == "input":
                 continue
             if node.kind == "vertex":
+                pp = getattr(node.payload, "pp", None)
+                if pp is not None and hasattr(pp, "batch"):
+                    pp.batch = B  # FeedForwardToRnn needs B to un-flatten
                 acts[name] = node.payload.apply([acts[i] for i in node.inputs])
                 masks[name] = masks.get(node.inputs[0])
                 continue
             layer = node.payload
-            h = acts[node.inputs[0]]
-            fmask = masks.get(node.inputs[0])
+            out_mask = masks.get(node.inputs[0])
+            if getattr(layer, "multiInput", False):
+                h = [acts[i] for i in node.inputs]
+                # the KEYS' mask governs score masking (2nd input if distinct,
+                # else the single self-attention input); the node's OUTPUT is
+                # aligned to the query axis, so out_mask stays the first
+                # input's mask
+                fmask = masks.get(node.inputs[1 if len(node.inputs) > 1 else 0])
+            else:
+                h = acts[node.inputs[0]]
+                fmask = out_mask
             if node.preprocessor is not None:
                 if hasattr(node.preprocessor, "batch"):
                     node.preprocessor.batch = B
                 h = node.preprocessor.preProcess(h)
-            lk = None if key is None else jax.random.fold_in(key, self._layer_idx[name])
+            # frozen layers run in inference mode (no dropout, BN keeps its
+            # running stats) — mirrors MultiLayerNetwork._run_layers and the
+            # reference's FrozenLayer/FrozenVertex
+            l_train = train and not getattr(layer, "frozen", False)
+            lk = None if (key is None or not l_train) else \
+                jax.random.fold_in(key, self._layer_idx[name])
             p = self._cast_params(params[name])
             if name in self.conf.networkOutputs and isinstance(
                     layer, (L.BaseOutputLayer, L.LossLayer)):
-                h = layer._dropout_input(h, train, lk)
+                h = layer._dropout_input(h, l_train, lk)
                 pre = layer.preoutput(p, h)
                 preacts[name] = pre
                 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
@@ -138,9 +157,9 @@ class ComputationGraph:
                 acts[name] = out
                 new_states[name] = states[name]
                 continue
-            h, s = layer.forward(p, states[name], h, train, lk, fmask)
+            h, s = layer.forward(p, states[name], h, l_train, lk, fmask)
             acts[name] = h
-            masks[name] = fmask
+            masks[name] = out_mask
             new_states[name] = s
         return acts, preacts, new_states
 
@@ -171,27 +190,35 @@ class ComputationGraph:
         reg = 0.0
         for name in self._layer_names:
             p = params[name]
-            if p:
+            if p and not getattr(self.conf.nodes[name].payload, "frozen", False):
                 reg = reg + self.conf.nodes[name].payload.regularization(p)
         return reg
 
-    def _loss_fn(self, params, states, inputs, labels, key, fmasks, lmasks):
+    def _loss_fn(self, params, states, inputs, labels, key, fmasks, lmasks,
+                 use_carries=False):
+        # frozen layers: structurally zero grads so XLA eliminates their
+        # backward pass (see MultiLayerNetwork._loss_fn)
+        params = {n: jax.tree_util.tree_map(jax.lax.stop_gradient, p)
+                  if getattr(self.conf.nodes[n].payload, "frozen", False) else p
+                  for n, p in params.items()}
+        run_states = states if use_carries else self._strip_carries(states)
         _, preacts, new_states = self._run_graph(
-            params, self._strip_carries(states), inputs, True, key, fmasks)
+            params, run_states, inputs, True, key, fmasks)
         loss = self._loss(preacts, labels, lmasks) + self._regularization(params)
         return loss, new_states
 
     def _train_step(self, params, upd_states, states, iteration, inputs, labels,
-                    key, fmasks, lmasks):
+                    key, fmasks, lmasks, use_carries=False):
         (loss, new_states), grads = jax.value_and_grad(
             self._loss_fn, has_aux=True)(params, states, inputs, labels, key,
-                                         fmasks, lmasks)
+                                         fmasks, lmasks, use_carries)
         glist = _grad_normalize([grads[n] for n in self._layer_names],
                                 self.conf.gradientNormalization,
                                 self.conf.gradientNormalizationThreshold)
         new_params, new_upd = dict(params), dict(upd_states)
         for name, g in zip(self._layer_names, glist):
-            if not params[name]:
+            if not params[name] or getattr(self.conf.nodes[name].payload,
+                                           "frozen", False):
                 continue
             upd, us = self._updaters[name].apply(g, upd_states[name], iteration)
             new_params[name] = jax.tree_util.tree_map(
@@ -272,10 +299,10 @@ class ComputationGraph:
         self._step(inputs, labs, fmasks, lmasks)
 
     def _step(self, inputs, labels, fmasks, lmasks):
-        if self.conf.backpropType == "tbptt":
-            raise NotImplementedError(
-                "Truncated BPTT is not yet supported on ComputationGraph; "
-                "use MultiLayerNetwork or standard backprop")
+        if self.conf.backpropType == "tbptt" and any(
+                v.ndim == 3 for v in inputs.values()):
+            self._fit_tbptt(inputs, labels, fmasks, lmasks)
+            return
         key = jax.random.fold_in(jax.random.key(self.conf.seed ^ 0x5EED), self._iteration)
         self._params, self._upd_states, self._states, loss = self._jit_train(
             self._params, self._upd_states, self._states,
@@ -285,6 +312,36 @@ class ComputationGraph:
         self._iteration += 1
         for lst in self._listeners:
             lst.iterationDone(self, self._iteration, self._epoch)
+
+    def _fit_tbptt(self, inputs, labels, fmasks, lmasks):
+        """Truncated BPTT over the DAG: split time ([B,C,T] axis 2) into
+        tbpttFwdLength windows, carrying recurrent h/c across windows
+        (reference: ComputationGraph.doTruncatedBPTT). The chunk loop is
+        the shared run_tbptt driver."""
+        from deeplearning4j_tpu.nn.multilayer import run_tbptt
+
+        T = max(v.shape[2] for v in inputs.values() if v.ndim == 3)
+
+        def tseq(a, sl):
+            # only sequence ([B,C,T]) arrays are time-sliced; feedforward
+            # inputs/labels in a mixed graph pass through whole
+            return a[:, :, sl] if (a is not None and a.ndim == 3) else a
+
+        def tmask(m, sl):
+            return None if m is None else m[:, sl]
+
+        def jit_call(sl, key, it, use_carries):
+            ic = {n: tseq(v, sl) for n, v in inputs.items()}
+            lc = [tseq(l, sl) for l in labels]
+            fc = None if fmasks is None else {n: tmask(m, sl)
+                                              for n, m in fmasks.items()}
+            mc = None if lmasks is None else [tmask(m, sl) for m in lmasks]
+            self._params, self._upd_states, self._states, loss = self._jit_train(
+                self._params, self._upd_states, self._states, it, ic, lc, key,
+                fc, mc, use_carries=use_carries)
+            return loss
+
+        run_tbptt(self, T, self.conf.tbpttFwdLength, jit_call)
 
     def output(self, *features):
         self._require_init()

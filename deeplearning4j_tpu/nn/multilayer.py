@@ -61,6 +61,27 @@ def cast_params(p, compute_dtype, param_dtype):
         if jnp.issubdtype(a.dtype, jnp.floating) else a, p)
 
 
+def run_tbptt(net, T, L, jit_call):
+    """Shared truncated-BPTT chunk driver for MultiLayerNetwork and
+    ComputationGraph (reference: doTruncatedBPTT in both classes).
+
+    jit_call(sl, key, iteration, use_carries) must run the network's
+    donating jit step, REASSIGN the net's params/states in the same
+    statement (listeners fire right after and may read them — the old
+    buffers are already invalidated by donation), and return the loss.
+    """
+    for c in range(math.ceil(T / L)):
+        sl = slice(c * L, min((c + 1) * L, T))
+        key = jax.random.fold_in(jax.random.key(net.conf.seed ^ 0x5EED),
+                                 net._iteration)
+        loss = jit_call(sl, key, jnp.asarray(net._iteration, jnp.int32), c > 0)
+        net._score = float(loss)
+        net._iteration += 1
+        for lst in net._listeners:
+            lst.iterationDone(net, net._iteration, net._epoch)
+    net._states = net._strip_carries(net._states)
+
+
 def _grad_normalize(grads_per_layer, mode, threshold):
     """Gradient clipping/normalization (reference:
     org.deeplearning4j.nn.conf.GradientNormalization, applied in
@@ -175,15 +196,20 @@ class MultiLayerNetwork:
                 if hasattr(pp, "batch"):
                     pp.batch = x.shape[0]
                 h = pp.preProcess(h, fmask)
-            lk = None if key is None else jax.random.fold_in(key, i)
+            # frozen layers (transfer learning) run in inference mode: no
+            # dropout, and BN uses+preserves its stored running stats — the
+            # reference's FrozenLayer forces the wrapped layer into inference
+            # the same way, so the frozen feature extractor cannot drift
+            l_train = train and not getattr(layer, "frozen", False)
+            lk = None if (key is None or not l_train) else jax.random.fold_in(key, i)
             p = self._cast_params(params[i])
             if i == len(self.layers) - 1 and isinstance(layer, (L.BaseOutputLayer, L.LossLayer)):
                 # dropout applies to the output layer's input too
-                h = layer._dropout_input(h, train, lk)
+                h = layer._dropout_input(h, l_train, lk)
                 preact = layer.preoutput(p, h)
                 new_states.append(states[i])
                 return preact, new_states
-            h, s = layer.forward(p, states[i], h, train, lk, fmask)
+            h, s = layer.forward(p, states[i], h, l_train, lk, fmask)
             new_states.append(s)
         return h, new_states
 
@@ -345,26 +371,17 @@ class MultiLayerNetwork:
     def _fit_tbptt(self, x, y, fmask, lmask):
         """Truncated BPTT: split time into tbpttFwdLength chunks, carrying
         h/c across chunks (reference: MultiLayerNetwork.doTruncatedBPTT)."""
-        T = x.shape[2]
-        L_ = self.conf.tbpttFwdLength
-        n_chunks = math.ceil(T / L_)
-        states = self._states
-        for c in range(n_chunks):
-            sl = slice(c * L_, min((c + 1) * L_, T))
-            xc, yc = x[:, :, sl], y[:, :, sl]
-            fm = None if fmask is None else fmask[:, sl]
-            lm = None if lmask is None else lmask[:, sl]
-            key = jax.random.fold_in(jax.random.key(self.conf.seed ^ 0x5EED), self._iteration)
-            self._params, self._upd_states, states, loss = self._jit_train(
-                self._params, self._upd_states, states,
-                jnp.asarray(self._iteration, jnp.int32), xc, yc, key, fm, lm,
-                use_carries=c > 0)
-            # stop gradients/carries from being donated stale on last chunk
-            self._score = float(loss)
-            self._iteration += 1
-            for lst in self._listeners:
-                lst.iterationDone(self, self._iteration, self._epoch)
-        self._states = self._strip_carries(states)
+
+        def jit_call(sl, key, it, use_carries):
+            self._params, self._upd_states, self._states, loss = self._jit_train(
+                self._params, self._upd_states, self._states, it,
+                x[:, :, sl], y[:, :, sl], key,
+                None if fmask is None else fmask[:, sl],
+                None if lmask is None else lmask[:, sl],
+                use_carries=use_carries)
+            return loss
+
+        run_tbptt(self, x.shape[2], self.conf.tbpttFwdLength, jit_call)
 
     def output(self, x, train=False) -> INDArray:
         self._require_init()

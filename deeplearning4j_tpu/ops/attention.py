@@ -42,8 +42,9 @@ def _block_attn(q, k, v, carry, mask_value=-1e30, mask=None):
     return acc_new, m_new, l_new
 
 
-def blockwise_attention(q, k, v, block_size=512, causal=False):
-    """Flash-style attention over KV blocks. q,k,v: [B,H,T,D]."""
+def blockwise_attention(q, k, v, block_size=512, causal=False, key_mask=None):
+    """Flash-style attention over KV blocks. q,k,v: [B,H,T,D].
+    key_mask: optional [B,Tk] bool validity of key positions."""
     B, H, T, D = q.shape
     Tk = k.shape[2]
     bs = min(block_size, Tk)
@@ -54,11 +55,15 @@ def blockwise_attention(q, k, v, block_size=512, causal=False):
         v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
     kb = k.reshape(B, H, n_blocks, bs, D).transpose(2, 0, 1, 3, 4)
     vb = v.reshape(B, H, n_blocks, bs, D).transpose(2, 0, 1, 3, 4)
+    kmb = None
+    if key_mask is not None:
+        km = key_mask if pad == 0 else jnp.pad(key_mask, ((0, 0), (0, pad)))
+        kmb = km.reshape(B, n_blocks, bs).transpose(1, 0, 2)  # [nb,B,bs]
 
     q_pos = jnp.arange(T)[:, None]
 
     def scan_fn(carry, blk):
-        kj, vj, j = blk
+        kj, vj, j, kmj = blk
         mask = None
         k_pos = j * bs + jnp.arange(bs)[None, :]
         valid = k_pos < Tk
@@ -68,14 +73,23 @@ def blockwise_attention(q, k, v, block_size=512, causal=False):
             mask = jnp.broadcast_to(valid, (T, bs))
         if mask is not None:
             mask = mask[None, None]
+        if kmj is not None:
+            km4 = kmj[:, None, None, :]  # [B,1,1,bs]
+            mask = km4 if mask is None else mask & km4
         return _block_attn(q, kj, vj, carry, mask=mask), None
 
     acc0 = jnp.zeros_like(q)
     m0 = jnp.full((B, H, T), -jnp.inf, q.dtype)
     l0 = jnp.zeros((B, H, T), q.dtype)
-    (acc, m, l), _ = lax.scan(scan_fn, (acc0, m0, l0),
-                              (kb, vb, jnp.arange(n_blocks)))
-    return acc / l[..., None]
+    xs = (kb, vb, jnp.arange(n_blocks)) if kmb is None \
+        else (kb, vb, jnp.arange(n_blocks), kmb)
+    if kmb is None:
+        (acc, m, l), _ = lax.scan(
+            lambda c, b: scan_fn(c, (b[0], b[1], b[2], None)), (acc0, m0, l0), xs)
+    else:
+        (acc, m, l), _ = lax.scan(scan_fn, (acc0, m0, l0), xs)
+    # fully-masked rows have l == 0; emit 0 instead of NaN
+    return acc / jnp.where(l == 0, 1.0, l)[..., None]
 
 
 def dot_product_attention(q, k, v, mask=None, causal=False):

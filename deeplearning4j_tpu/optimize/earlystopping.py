@@ -388,6 +388,7 @@ class EarlyStoppingTrainer:
                 except _IterationGuard.Halt as h:
                     reason = TerminationReason.IterationTerminationCondition
                     details = str(h.cond)
+                    halt_cond = h.cond
                     break
 
                 scored = True
@@ -435,14 +436,18 @@ class EarlyStoppingTrainer:
             self.model._listeners = [l for l in self.model._listeners if l is not guard]
 
         if best_score is None:
-            if (conf.scoreCalculator is not None
-                    and reason == TerminationReason.IterationTerminationCondition):
-                # halted (divergence/time) before the first validation pass:
-                # there is no model worth calling "best" — don't save the
-                # possibly-exploded final state under that name
+            if reason == TerminationReason.IterationTerminationCondition and (
+                    conf.scoreCalculator is not None
+                    or isinstance(halt_cond, MaxScoreIterationTerminationCondition)):
+                # halted on divergence/NaN before any validation pass: the
+                # final state is the exploded one that triggered the halt —
+                # never save it as "best". A pure time-budget halt
+                # (MaxTimeIterationTerminationCondition) without a score
+                # calculator is benign: fall through and keep the final model.
                 return EarlyStoppingResult(reason, details, scoreVsEpoch, -1,
                                            None, epoch + 1, None)
-            # no score calculator: best = final
+            # no score calculator, epoch-condition or time-budget stop:
+            # best = final
             conf.modelSaver.saveBestModel(self.model, scoreVsEpoch.get(epoch))
             best_epoch = epoch
             best_score = scoreVsEpoch.get(epoch)

@@ -281,3 +281,29 @@ class TestReviewRegressions:
             m.to_json(), _wmap(m))
         x = np.random.RandomState(4).rand(2, 6, 6, 3).astype("float32")
         _parity(m, net, x, x.transpose(0, 3, 1, 2))
+
+
+class TestMultiHeadAttentionImport:
+    def test_mha_self_attention_parity(self):
+        inp = keras.layers.Input((6, 8), name="seq")  # [T, E]
+        att = keras.layers.MultiHeadAttention(
+            num_heads=2, key_dim=4, name="mha")(inp, inp)
+        pool = keras.layers.GlobalAveragePooling1D(name="gp")(att)
+        out = keras.layers.Dense(3, activation="softmax", name="out")(pool)
+        m = keras.Model(inp, out)
+        wmap = _wmap(m)
+        graph = KerasModelImport.importKerasModelAndWeights(m.to_json(), wmap)
+        x = np.random.RandomState(11).rand(4, 6, 8).astype("float32")
+        want = np.asarray(m.predict(x, verbose=0))
+        got = graph.outputSingle(x.transpose(0, 2, 1)).toNumpy()
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+    def test_mha_value_dim_mismatch_rejected(self):
+        inp = keras.layers.Input((6, 8), name="seq")
+        att = keras.layers.MultiHeadAttention(
+            num_heads=2, key_dim=4, value_dim=5, name="mha")(inp, inp)
+        out = keras.layers.Dense(3, name="out")(
+            keras.layers.GlobalAveragePooling1D(name="gp")(att))
+        m = keras.Model(inp, out)
+        with pytest.raises(UnsupportedKerasConfigurationException, match="value_dim"):
+            KerasModelImport.importKerasModelAndWeights(m.to_json(), _wmap(m))

@@ -198,3 +198,52 @@ class TestTransferLearningHelper:
         out_full = net.output(x).toNumpy()
         out_feat = helper.outputFromFeaturized(feat.getFeatures()).toNumpy()
         np.testing.assert_allclose(out_full, out_feat, rtol=2e-5, atol=2e-6)
+
+
+class TestFrozenInferenceMode:
+    def test_frozen_bn_stats_do_not_drift(self):
+        """A frozen BatchNormalization must run in inference mode during
+        fine-tuning: its running mean/var stay exactly as they were
+        (reference: FrozenLayer forces the wrapped layer to inference)."""
+        from deeplearning4j_tpu.nn import BatchNormalization
+
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(3).updater(Adam(5e-2)).list()
+                .layer(DenseLayer(nIn=8, nOut=16, activation="relu"))
+                .layer(BatchNormalization())
+                .layer(OutputLayer(nOut=3, activation="softmax", lossFunction=LF.MCXENT))
+                .setInputType(InputType.feedForward(8))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        ds = _data()
+        net.fit(ds)  # move running stats off their init values
+        tl = TransferLearning.Builder(net).setFeatureExtractor(1).build()
+        m0 = np.asarray(tl._states[1]["mean"]).copy()
+        v0 = np.asarray(tl._states[1]["var"]).copy()
+        assert not np.allclose(m0, 0.0)  # stats actually moved pre-freeze
+        for _ in range(5):
+            tl.fit(ds)
+        np.testing.assert_array_equal(m0, np.asarray(tl._states[1]["mean"]))
+        np.testing.assert_array_equal(v0, np.asarray(tl._states[1]["var"]))
+
+    def test_frozen_dropout_inactive(self):
+        """Dropout in the frozen prefix must be off during fine-tune: two
+        fits from identical initial state produce identical top-layer
+        updates regardless of the dropout rng."""
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(3).updater(Sgd(0.1)).list()
+                .layer(DenseLayer(nIn=8, nOut=16, activation="relu", dropOut=0.5))
+                .layer(OutputLayer(nOut=3, activation="softmax", lossFunction=LF.MCXENT))
+                .setInputType(InputType.feedForward(8))
+                .build())
+        ds = _data()
+        outs = []
+        for _ in range(2):
+            net = MultiLayerNetwork(conf).init()
+            tl = TransferLearning.Builder(net).setFeatureExtractor(0).build()
+            # different iteration counters => different dropout keys if the
+            # frozen layer's dropout were (wrongly) active
+            tl._iteration = 7 * len(outs)
+            tl.fit(ds)
+            outs.append(_p(tl, 1, "W").copy())
+        np.testing.assert_array_equal(outs[0], outs[1])
