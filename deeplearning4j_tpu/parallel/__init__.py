@@ -9,12 +9,15 @@ meshes + XLA ICI collectives.
 from deeplearning4j_tpu.parallel.mesh import (
     build_mesh, data_parallel_mesh, DATA_AXIS, MODEL_AXIS, SEQ_AXIS, PIPE_AXIS,
 )
-from deeplearning4j_tpu.parallel.trainer import ParallelWrapper, SharedTrainingMaster
+from deeplearning4j_tpu.parallel.trainer import (
+    ParallelWrapper, SharedTrainingMaster, ParameterAveragingTrainingMaster,
+)
 from deeplearning4j_tpu.parallel.sharding import shard_params, replicate_params, spec_for_param
 from deeplearning4j_tpu.parallel.sequence import ring_attention, ulysses_attention
 
 __all__ = [
     "build_mesh", "data_parallel_mesh", "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS",
-    "PIPE_AXIS", "ParallelWrapper", "SharedTrainingMaster", "shard_params",
+    "PIPE_AXIS", "ParallelWrapper", "SharedTrainingMaster",
+    "ParameterAveragingTrainingMaster", "shard_params",
     "replicate_params", "spec_for_param", "ring_attention", "ulysses_attention",
 ]

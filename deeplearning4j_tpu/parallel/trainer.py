@@ -165,7 +165,10 @@ class ParallelWrapper:
             lst.iterationDone(n, n._iteration, n._epoch)
 
     def averagingFrequency(self, *_):
-        return self  # parameter averaging is obsolete under synchronous psum
+        # synchronous psum makes per-step averaging exact already; the
+        # reference's periodic-averaging semantics live in
+        # ParameterAveragingTrainingMaster below
+        return self
 
     def workers(self, *_):
         return self
@@ -175,8 +178,140 @@ class SharedTrainingMaster(ParallelWrapper):
     """Gradient-sharing distributed trainer (reference: Spark
     SharedTrainingMaster). Alias of ParallelWrapper with the quantized
     all-reduce enabled by default — the ICI-native analog of the
-    reference's threshold-encoded sparse updates."""
+    reference's threshold-encoded sparse updates. Pass
+    ``gradient_compression=None`` to opt out into the dense bf16 psum."""
 
     def __init__(self, net, mesh=None, thresholdAlgorithm=None, **kw):
         # thresholdAlgorithm accepted for parity; quantization replaces it
+        kw.setdefault("gradient_compression", "int8")
         super().__init__(net, mesh=mesh, **kw)
+
+
+class ParameterAveragingTrainingMaster(ParallelWrapper):
+    """Parameter-averaging distributed trainer (reference: Spark
+    ParameterAveragingTrainingMaster.java). Each data-shard replica takes
+    LOCAL updater steps on its own copy of the parameters — no per-step
+    gradient allreduce — and every ``averagingFrequency`` iterations the
+    parameters, updater state and layer state are averaged across the mesh
+    (``pmean`` over ICI plays the role of the Spark driver's aggregate).
+
+    With ``averagingFrequency=1`` and plain SGD this is mathematically
+    identical to synchronous gradient sharing; larger frequencies trade
+    fidelity for fewer collectives, exactly the reference's knob.
+    """
+
+    def __init__(self, net, mesh=None, averagingFrequency=5,
+                 batch_axis=_mesh.DATA_AXIS):
+        super().__init__(net, mesh=mesh, batch_axis=batch_axis)
+        if int(averagingFrequency) < 1:
+            raise ValueError("averagingFrequency must be >= 1")
+        self._avg_freq = int(averagingFrequency)
+        self._stacked = None  # (params, upd_states, states) + replica axis
+
+    def averagingFrequency(self, k):
+        if self._jit is not None:
+            raise RuntimeError("set averagingFrequency before the first fit()")
+        if int(k) < 1:
+            raise ValueError("averagingFrequency must be >= 1")
+        self._avg_freq = int(k)
+        return self
+
+    # ------------------------------------------------------------------
+    def _place_replicated(self):
+        """Give every replica its own (initially identical) copy: stack each
+        leaf along a leading replica axis sharded over the data axis."""
+        n, dp = self.net, self.mesh.shape[self.batch_axis]
+
+        def stack(tree):
+            def one(a):
+                a = jnp.asarray(a)
+                sh = NamedSharding(self.mesh,
+                                   P(self.batch_axis, *([None] * a.ndim)))
+                return jax.device_put(jnp.stack([a] * dp), sh)
+            return jax.tree_util.tree_map(one, tree)
+
+        self._stacked = (stack(n._params), stack(n._upd_states),
+                         stack(n._states))
+
+    def _build_jit(self):
+        from jax import shard_map
+
+        n, mesh, ax, freq = self.net, self.mesh, self.batch_axis, self._avg_freq
+
+        def shard_step(params, upd, states, it, x, y, key, fm, lm):
+            sq = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
+            params, upd, states = sq(params), sq(upd), sq(states)
+            # decorrelate per-replica dropout/noise like distinct Spark workers
+            key = jax.random.fold_in(key, jax.lax.axis_index(ax))
+            p, u, s, loss = n._train_step(params, upd, states, it, x, y, key,
+                                          fm, lm)
+            do_avg = ((it + 1) % freq) == 0
+
+            def avg(tree):
+                return jax.tree_util.tree_map(
+                    lambda a: jnp.where(do_avg, jax.lax.pmean(a, ax), a)
+                    if jnp.issubdtype(a.dtype, jnp.inexact) else a, tree)
+
+            p, u, s = avg(p), avg(u), avg(s)
+            loss = jax.lax.pmean(loss, ax)
+            ex = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
+            return ex(p), ex(u), ex(s), loss
+
+        def step(params, upd, states, it, x, y, key, fm, lm):
+            spec_b = P(ax)
+            return shard_map(
+                shard_step, mesh=mesh,
+                in_specs=(spec_b, spec_b, spec_b, P(), spec_b, spec_b, P(),
+                          spec_b if fm is not None else P(),
+                          spec_b if lm is not None else P()),
+                out_specs=(spec_b, spec_b, spec_b, P()),
+                check_vma=False,
+            )(params, upd, states, it, x, y, key, fm, lm)
+
+        self._jit = jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _fit_batch(self, ds):
+        from deeplearning4j_tpu.nn.multilayer import _unwrap as unw
+
+        n = self.net
+        x, y = unw(ds.getFeatures()), unw(ds.getLabels())
+        fmask, lmask = unw(ds.getFeaturesMaskArray()), unw(ds.getLabelsMaskArray())
+        if x.shape[0] % self.mesh.shape[self.batch_axis] != 0:
+            raise ValueError(
+                f"Global batch {x.shape[0]} not divisible by data-parallel "
+                f"width {self.mesh.shape[self.batch_axis]}")
+        x = jax.device_put(x, self._batch_sharding(x))
+        y = jax.device_put(y, self._batch_sharding(y))
+        if fmask is not None:
+            fmask = jax.device_put(fmask, self._batch_sharding(fmask))
+        if lmask is not None:
+            lmask = jax.device_put(lmask, self._batch_sharding(lmask))
+        key = jax.random.fold_in(jax.random.key(n.conf.seed ^ 0x5EED), n._iteration)
+        p, u, s = self._stacked
+        p, u, s, loss = self._jit(p, u, s, jnp.asarray(n._iteration, jnp.int32),
+                                  x, y, key, fmask, lmask)
+        self._stacked = (p, u, s)
+        n._score = float(loss)
+        n._iteration += 1
+        for lst in n._listeners:
+            lst.iterationDone(n, n._iteration, n._epoch)
+
+    def fit(self, data, labels=None, epochs=None):
+        super().fit(data, labels, epochs)
+        self._sync_to_net()
+        return self
+
+    def _sync_to_net(self):
+        """Expose the replica-average as the net's canonical model (the
+        reference's driver-side aggregated model)."""
+        if self._stacked is None:
+            return
+
+        def collapse(tree):
+            return jax.tree_util.tree_map(
+                lambda a: a.mean(0) if jnp.issubdtype(a.dtype, jnp.inexact)
+                else a[0], tree)
+
+        n = self.net
+        p, u, s = self._stacked
+        n._params, n._upd_states, n._states = collapse(p), collapse(u), collapse(s)

@@ -18,6 +18,7 @@ from deeplearning4j_tpu.nn import (
 from deeplearning4j_tpu.data import DataSetIterator
 from deeplearning4j_tpu.parallel import (
     build_mesh, data_parallel_mesh, ParallelWrapper, SharedTrainingMaster,
+    ParameterAveragingTrainingMaster,
     shard_params, spec_for_param, ring_attention, ulysses_attention,
     DATA_AXIS, MODEL_AXIS, SEQ_AXIS,
 )
@@ -103,18 +104,93 @@ class TestDataParallel:
         assert leaf.sharding.is_fully_replicated
 
     def test_quantized_allreduce_close_to_exact(self):
+        """SharedTrainingMaster enables int8 gradient compression by
+        default — the caller must NOT need to opt in."""
         x, y, _ = _data(64)
         net_a = MultiLayerNetwork(_mlp()).init()
         for _ in range(3):
             net_a.fit(x, y)
         net_b = MultiLayerNetwork(_mlp()).init()
-        pw = SharedTrainingMaster(net_b, gradient_compression="int8")
+        pw = SharedTrainingMaster(net_b)
+        assert pw.gradient_compression == "int8"
         for _ in range(3):
             pw.fit(x, y)
         pa, pb = net_a.params().toNumpy(), net_b.params().toNumpy()
         # int8 quantization: close but not exact
         assert np.max(np.abs(pa - pb)) < 5e-2
         assert not np.allclose(pa, pb, atol=0)
+
+    def test_shared_master_dense_opt_out(self):
+        x, y, _ = _data(64)
+        net_a = MultiLayerNetwork(_mlp()).init()
+        for _ in range(3):
+            net_a.fit(x, y)
+        net_b = MultiLayerNetwork(_mlp()).init()
+        pw = SharedTrainingMaster(net_b, gradient_compression=None)
+        assert pw.gradient_compression is None
+        for _ in range(3):
+            pw.fit(x, y)
+        np.testing.assert_allclose(net_a.params().toNumpy(),
+                                   net_b.params().toNumpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestParameterAveraging:
+    def _sgd_mlp(self, seed=42):
+        return (NeuralNetConfiguration.Builder()
+                .seed(seed).updater(Sgd(0.1)).activation("relu")
+                .list()
+                .layer(DenseLayer(nOut=32))
+                .layer(OutputLayer(nOut=3, activation="softmax"))
+                .setInputType(InputType.feedForward(4))
+                .build())
+
+    def test_freq1_sgd_matches_sync(self):
+        """averagingFrequency=1 + plain SGD: mean of one-local-step params
+        equals the synchronous gradient-sharing step exactly."""
+        x, y, _ = _data(64)
+        net_a = MultiLayerNetwork(self._sgd_mlp()).init()
+        for _ in range(4):
+            net_a.fit(x, y)
+        net_b = MultiLayerNetwork(self._sgd_mlp()).init()
+        pm = ParameterAveragingTrainingMaster(net_b, averagingFrequency=1)
+        for _ in range(4):
+            pm.fit(x, y)
+        np.testing.assert_allclose(net_a.params().toNumpy(),
+                                   net_b.params().toNumpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_replicas_diverge_then_average(self):
+        """Between averaging points replicas drift apart (local steps);
+        right after an averaging step all replicas are identical."""
+        x, y, _ = _data(64, seed=3)
+        net = MultiLayerNetwork(_mlp()).init()
+        pm = ParameterAveragingTrainingMaster(net, averagingFrequency=5)
+        for _ in range(3):  # its 0,1,2 — no averaging yet
+            pm.fit(x, y)
+        leaf = jax.tree_util.tree_leaves(pm._stacked[0])[0]
+        spread = float(jnp.max(jnp.abs(leaf - leaf.mean(0, keepdims=True))))
+        assert spread > 0, "replicas should drift between averaging points"
+        for _ in range(2):  # it 4 triggers (it+1) % 5 == 0
+            pm.fit(x, y)
+        leaf = jax.tree_util.tree_leaves(pm._stacked[0])[0]
+        spread = float(jnp.max(jnp.abs(leaf - leaf.mean(0, keepdims=True))))
+        assert spread < 1e-6, "replicas must coincide right after averaging"
+
+    def test_averaging_converges(self):
+        x, y, yi = _data(256)
+        net = MultiLayerNetwork(_mlp()).init()
+        pm = ParameterAveragingTrainingMaster(net, averagingFrequency=4)
+        it = DataSetIterator(x, y, 64, shuffle=True)
+        for _ in range(20):
+            pm.fit(it)
+        acc = (net.output(x).argMax(1).toNumpy() == yi).mean()
+        assert acc > 0.9
+
+    def test_bad_frequency_raises(self):
+        net = MultiLayerNetwork(_mlp()).init()
+        with pytest.raises(ValueError, match="averagingFrequency"):
+            ParameterAveragingTrainingMaster(net, averagingFrequency=0)
 
 
 class TestTensorParallel:
