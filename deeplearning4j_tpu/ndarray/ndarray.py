@@ -42,30 +42,64 @@ def _dims(dimension) -> tuple[int, ...] | None:
 class INDArray:
     """N-dimensional array backed by an XLA device buffer."""
 
-    __slots__ = ("_jx",)
+    __slots__ = ("_jx_", "_np_")
     # Let INDArray win in  np_array + indarray  style expressions.
     __array_priority__ = 100
 
     def __init__(self, data):
+        # numpy input is adopted LAZILY: the HBM buffer materialises on
+        # first device use, so host-side pipelines (ETL producers, the C++
+        # prefetch ring) can build DataSets without paying a host->device
+        # transfer per wrap. dtype is canonicalised eagerly (f64->f32 when
+        # x64 is off) so toNumpy() round-trips see jnp.asarray semantics.
+        self._np_ = None
         if isinstance(data, INDArray):
-            self._jx = data._jx
+            self._jx_ = data._jx_
+            self._np_ = data._np_
         elif isinstance(data, jax.Array):
-            self._jx = data
+            self._jx_ = data
+        elif isinstance(data, np.ndarray):
+            self._jx_ = None
+            # snapshot (copy) so later caller mutations of their buffer
+            # can't change this tensor — matches the old eager
+            # jnp.asarray's value semantics; still far cheaper than the
+            # host->device transfer it defers
+            self._np_ = np.array(
+                data, jax.dtypes.canonicalize_dtype(data.dtype), copy=True)
+            self._np_.flags.writeable = False
         else:
-            self._jx = jnp.asarray(data)
+            self._jx_ = jnp.asarray(data)
+
+    @property
+    def _jx(self) -> jax.Array:
+        if self._jx_ is None:
+            self._jx_ = jnp.asarray(self._np_)
+            self._np_ = None  # single owner once device-resident
+        return self._jx_
+
+    @_jx.setter
+    def _jx(self, value):
+        self._jx_ = value
+        self._np_ = None
+
+    @property
+    def _ref(self):
+        """Backing array (host numpy before first device use) — metadata
+        reads must not force the HBM transfer."""
+        return self._np_ if self._jx_ is None else self._jx_
 
     # ----- structure -------------------------------------------------
     def shape(self) -> tuple[int, ...]:
-        return tuple(self._jx.shape)
+        return tuple(self._ref.shape)
 
     def rank(self) -> int:
-        return self._jx.ndim
+        return self._ref.ndim
 
     def length(self) -> int:
-        return int(self._jx.size)
+        return int(self._ref.size)
 
     def size(self, dimension: int) -> int:
-        return int(self._jx.shape[dimension])
+        return int(self._ref.shape[dimension])
 
     def rows(self) -> int:
         return self.size(0)
@@ -74,34 +108,36 @@ class INDArray:
         return self.size(1)
 
     def dataType(self) -> DataType:
-        return DataType.from_dtype(self._jx.dtype)
+        return DataType.from_dtype(self._ref.dtype)
 
     def isScalar(self) -> bool:
-        return self._jx.ndim == 0 or self._jx.size == 1
+        return self._ref.ndim == 0 or self._ref.size == 1
 
     def isVector(self) -> bool:
-        return self._jx.ndim == 1 or (
-            self._jx.ndim == 2 and 1 in self._jx.shape
+        return self._ref.ndim == 1 or (
+            self._ref.ndim == 2 and 1 in self._ref.shape
         )
 
     def isRowVector(self) -> bool:
-        return self._jx.ndim == 1 or (self._jx.ndim == 2 and self._jx.shape[0] == 1)
+        return self._ref.ndim == 1 or (self._ref.ndim == 2 and self._ref.shape[0] == 1)
 
     def isColumnVector(self) -> bool:
-        return self._jx.ndim == 2 and self._jx.shape[1] == 1
+        return self._ref.ndim == 2 and self._ref.shape[1] == 1
 
     def isMatrix(self) -> bool:
-        return self._jx.ndim == 2
+        return self._ref.ndim == 2
 
     def isEmpty(self) -> bool:
-        return self._jx.size == 0
+        return self._ref.size == 0
 
     def ordering(self) -> str:
         return "c"
 
     # ----- conversion ------------------------------------------------
     def toNumpy(self) -> np.ndarray:
-        return np.asarray(self._jx)
+        if self._jx_ is None:
+            return np.asarray(self._np_)  # still host-side: no device trip
+        return np.asarray(self._jx_)
 
     def jax(self) -> jax.Array:
         """Escape hatch to the underlying buffer (TPU-native extension)."""

@@ -20,7 +20,18 @@ from deeplearning4j_tpu.nn.conf.layers import (
     SeparableConvolution2D, SubsamplingLayer, Upsampling2D, ZeroPaddingLayer,
     Cropping2D, GlobalPoolingLayer, BatchNormalization, LocalResponseNormalization,
     EmbeddingLayer, EmbeddingSequenceLayer,
+    Convolution3D, Cropping1D, Cropping3D, Upsampling1D, Upsampling3D,
+    SpaceToDepth, SpaceToBatch, LocallyConnected1D, LocallyConnected2D,
+    PReLULayer, CenterLossOutputLayer,
 )
+from deeplearning4j_tpu.nn.conf.dropout import (
+    Dropout, GaussianDropout, GaussianNoise, AlphaDropout, SpatialDropout,
+)
+from deeplearning4j_tpu.nn.conf.constraint import (
+    MaxNormConstraint, MinMaxNormConstraint, NonNegativeConstraint,
+    UnitNormConstraint,
+)
+from deeplearning4j_tpu.nn.conf.variational import VariationalAutoencoder
 from deeplearning4j_tpu.nn.conf.recurrent import (
     LSTM, GravesLSTM, SimpleRnn, GRU, Bidirectional, LastTimeStep,
 )

@@ -27,6 +27,7 @@ from deeplearning4j_tpu.nn import weights as _winit
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers import FeedForwardLayer
 from deeplearning4j_tpu.ops import attention as _attn
+from deeplearning4j_tpu.ops import pallas_attention as _pallas
 
 
 def _mha_params(key, nIn, nHeads, headSize, nOut, weightInit, dtype,
@@ -64,12 +65,16 @@ def _mha_apply(params, q_btf, kv_btf, nHeads, mask=None, block_size=None):
     q = q.reshape(B, Tq, nHeads, -1).transpose(0, 2, 1, 3)
     k = k.reshape(B, Tk, nHeads, -1).transpose(0, 2, 1, 3)
     v = v.reshape(B, Tk, nHeads, -1).transpose(0, 2, 1, 3)
-    amask = None if mask is None else (mask > 0)[:, None, None, :]  # [B,1,1,Tk]
+    # flash_attention dispatches: Pallas kernel on TPU for long T, fused
+    # XLA for short T, blockwise scan for ragged masks / other backends
+    key_mask = None if mask is None else mask > 0
     if block_size:
-        o = _attn.blockwise_attention(q, k, v, block_size=block_size,
-                                      key_mask=None if mask is None else mask > 0)
+        # explicit blockSize = the caller bounded attention memory; never
+        # fall back to the O(T^2) fused form
+        o = _pallas.flash_attention(q, k, v, key_mask=key_mask,
+                                    block_k=block_size, force_streaming=True)
     else:
-        o = _attn.dot_product_attention(q, k, v, mask=amask)
+        o = _pallas.flash_attention(q, k, v, key_mask=key_mask)
     o = o.transpose(0, 2, 1, 3).reshape(B, Tq, -1)
     return _project(o, params["Wo"], params.get("bo"))
 

@@ -267,7 +267,28 @@ class NeuralNetConfiguration:
             return self
 
         def dropOut(self, v):
-            self._d["dropOut"] = float(v)
+            # float (retain prob) or an nn.conf.dropout.IDropout strategy
+            self._d["dropOut"] = v if not isinstance(v, (int, float)) else float(v)
+            return self
+
+        def constrainWeights(self, *constraints):
+            """Apply constraints to every layer's weights after each update
+            (reference: NeuralNetConfiguration.Builder.constrainWeights)."""
+            for c in constraints:
+                c.applyToWeights, c.applyToBiases = True, False
+            self._d["constraints"] = (self._d.get("constraints") or []) + list(constraints)
+            return self
+
+        def constrainBias(self, *constraints):
+            for c in constraints:
+                c.applyToWeights, c.applyToBiases = False, True
+            self._d["constraints"] = (self._d.get("constraints") or []) + list(constraints)
+            return self
+
+        def constrainAllParameters(self, *constraints):
+            for c in constraints:
+                c.applyToWeights = c.applyToBiases = True
+            self._d["constraints"] = (self._d.get("constraints") or []) + list(constraints)
             return self
 
         def dataType(self, dt):

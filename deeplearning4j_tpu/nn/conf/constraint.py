@@ -1,0 +1,88 @@
+"""Parameter constraints, applied after each update step.
+
+Reference: org.deeplearning4j.nn.conf.constraint.{MaxNormConstraint,
+MinMaxNormConstraint, NonNegativeConstraint, UnitNormConstraint}
+(BaseConstraint.applyConstraint, run by BaseMultiLayerUpdater after the
+updater step). Here the projection happens INSIDE the jitted train step,
+right after the parameter update, so it fuses with the updater math.
+
+Each constraint projects a single parameter tensor. Norms are computed
+over all axes except the OUTPUT axis (last), matching the reference's
+per-output-neuron norms with default dimensions."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class BaseConstraint:
+    """params to touch: weights ("W"-like) by default, like the reference's
+    constrainWeights; set via applyToWeights/applyToBiases."""
+
+    def __init__(self, applyToWeights=True, applyToBiases=False):
+        self.applyToWeights = applyToWeights
+        self.applyToBiases = applyToBiases
+
+    def appliesTo(self, name: str) -> bool:
+        if name in ("centers", "alpha"):
+            # class centers / PReLU alpha: neither weight nor bias —
+            # projecting them would corrupt their own dynamics
+            return False
+        is_bias = name in ("b", "beta")
+        return self.applyToBiases if is_bias else self.applyToWeights
+
+    def apply(self, p):
+        raise NotImplementedError
+
+    def _norms(self, p):
+        axes = tuple(range(p.ndim - 1)) if p.ndim > 1 else ()
+        return jnp.sqrt(jnp.sum(jnp.square(p), axis=axes, keepdims=True)
+                        + 1e-12)
+
+
+class MaxNormConstraint(BaseConstraint):
+    def __init__(self, maxNorm=2.0, **kw):
+        super().__init__(**kw)
+        self.maxNorm = float(maxNorm)
+
+    def apply(self, p):
+        n = self._norms(p)
+        return p * jnp.minimum(1.0, self.maxNorm / n).astype(p.dtype)
+
+
+class MinMaxNormConstraint(BaseConstraint):
+    """Clamp per-output norms into [min, max] with interpolation rate
+    (reference: MinMaxNormConstraint; rate=1 snaps hard)."""
+
+    def __init__(self, minNorm=0.0, maxNorm=2.0, rate=1.0, **kw):
+        super().__init__(**kw)
+        self.minNorm, self.maxNorm = float(minNorm), float(maxNorm)
+        self.rate = float(rate)
+
+    def apply(self, p):
+        n = self._norms(p)
+        target = jnp.clip(n, self.minNorm, self.maxNorm)
+        scale = 1.0 + self.rate * (target / n - 1.0)
+        return (p * scale).astype(p.dtype)
+
+
+class NonNegativeConstraint(BaseConstraint):
+    def apply(self, p):
+        return jnp.maximum(p, 0.0)
+
+
+class UnitNormConstraint(BaseConstraint):
+    def apply(self, p):
+        return (p / self._norms(p)).astype(p.dtype)
+
+
+def apply_constraints(constraints, params):
+    """Project a layer's param dict through its constraint list."""
+    if not constraints or not params:
+        return params
+    out = dict(params)
+    for c in constraints:
+        for name, p in out.items():
+            if c.appliesTo(name):
+                out[name] = c.apply(p)
+    return out

@@ -1,0 +1,117 @@
+"""Dropout strategies.
+
+Reference: org.deeplearning4j.nn.conf.dropout.{Dropout, GaussianDropout,
+GaussianNoise, AlphaDropout, SpatialDropout} (the IDropout hierarchy).
+Any layer's ``dropOut=`` accepts a float (plain dropout retain
+probability, reference convention) or one of these objects. All are pure
+functions of (x, key) so they trace into the jitted step; the reference's
+mutable mask buffers are unnecessary under XLA.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class IDropout:
+    def apply(self, x, key):
+        raise NotImplementedError
+
+
+class Dropout(IDropout):
+    """Inverted dropout with retain probability p (reference: Dropout)."""
+
+    def __init__(self, p=0.5):
+        if not 0.0 < p <= 1.0:
+            raise ValueError(f"retain probability must be in (0,1], got {p}")
+        self.p = float(p)
+
+    def apply(self, x, key):
+        if self.p == 1.0:
+            return x
+        keep = jax.random.bernoulli(key, self.p, x.shape)
+        return jnp.where(keep, x / self.p, 0.0)
+
+
+class GaussianDropout(IDropout):
+    """Multiplicative N(1, sqrt((1-rate)/rate)) noise (reference:
+    GaussianDropout, Srivastava et al. §10)."""
+
+    def __init__(self, rate=0.5):
+        if not 0.0 < rate < 1.0:
+            raise ValueError(f"rate must be in (0,1), got {rate}")
+        self.rate = float(rate)
+
+    def apply(self, x, key):
+        std = ((1.0 - self.rate) / self.rate) ** 0.5
+        return x * (1.0 + std * jax.random.normal(key, x.shape, x.dtype))
+
+
+class GaussianNoise(IDropout):
+    """Additive N(0, stddev) noise (reference: GaussianNoise)."""
+
+    def __init__(self, stddev=0.1):
+        self.stddev = float(stddev)
+
+    def apply(self, x, key):
+        return x + self.stddev * jax.random.normal(key, x.shape, x.dtype)
+
+
+class AlphaDropout(IDropout):
+    """SELU-preserving dropout (reference: AlphaDropout; Klambauer et al.
+    2017). Keeps self-normalizing mean/variance by dropping to alpha' and
+    applying the affine correction."""
+
+    _ALPHA = 1.6732632423543772
+    _SCALE = 1.0507009873554805
+
+    def __init__(self, p=0.5):
+        if not 0.0 < p < 1.0:
+            raise ValueError(f"retain probability must be in (0,1), got {p}")
+        self.p = float(p)
+        self.alphaPrime = -self._SCALE * self._ALPHA
+        q = self.p
+        self.a = (q + self.alphaPrime ** 2 * q * (1 - q)) ** -0.5
+        self.b = -self.a * self.alphaPrime * (1 - q)
+
+    def apply(self, x, key):
+        keep = jax.random.bernoulli(key, self.p, x.shape)
+        y = jnp.where(keep, x, jnp.asarray(self.alphaPrime, x.dtype))
+        return self.a * y + self.b
+
+
+class SpatialDropout(IDropout):
+    """Drop whole channels/feature-maps (reference: SpatialDropout;
+    Tompson et al. 2015). NHWC input drops [B,1,1,C] masks; NCW sequence
+    input drops [B,C,1] masks; 2d falls back to plain dropout."""
+
+    def __init__(self, p=0.5):
+        if not 0.0 < p <= 1.0:
+            raise ValueError(f"retain probability must be in (0,1], got {p}")
+        self.p = float(p)
+
+    def apply(self, x, key):
+        if self.p == 1.0:
+            return x
+        if x.ndim == 4:      # NHWC
+            shape = (x.shape[0], 1, 1, x.shape[3])
+        elif x.ndim == 3:    # NCW
+            shape = (x.shape[0], x.shape[1], 1)
+        elif x.ndim == 5:    # NDHWC
+            shape = (x.shape[0], 1, 1, 1, x.shape[4])
+        else:
+            shape = x.shape
+        keep = jax.random.bernoulli(key, self.p, shape)
+        return jnp.where(keep, x / self.p, 0.0)
+
+
+def resolve(d):
+    """float|IDropout|None -> IDropout|None (floats keep the reference's
+    retain-probability reading)."""
+    if d is None or isinstance(d, IDropout):
+        return d
+    p = float(d)
+    if p in (0.0, 1.0):
+        return None
+    return Dropout(p)

@@ -44,6 +44,12 @@ class InputType:
     def convolutionalFlat(height: int, width: int, depth: int) -> "InputType":
         return InputType(InputType.CNN_FLAT, height=int(height), width=int(width), channels=int(depth))
 
+    @staticmethod
+    def convolutional3D(depth: int, height: int, width: int, channels: int) -> "InputType":
+        """Reference: InputType.convolutional3D (NCDHW per-example)."""
+        return InputType(InputType.CNN3D, depth=int(depth), height=int(height),
+                         width=int(width), channels=int(channels))
+
     # ----- helpers ----------------------------------------------------
     def arrayElementsPerExample(self) -> int:
         if self.kind == InputType.FF:
@@ -51,7 +57,10 @@ class InputType:
         if self.kind == InputType.RNN:
             t = self.dims.get("timeSeriesLength") or 1
             return self.dims["size"] * t
-        return self.dims["height"] * self.dims["width"] * self.dims["channels"]
+        n = self.dims["height"] * self.dims["width"] * self.dims["channels"]
+        if self.kind == InputType.CNN3D:
+            n *= self.dims["depth"]
+        return n
 
     def __getattr__(self, item):
         try:

@@ -65,11 +65,12 @@ class Layer:
     # fields that fall back to globals when None
     _GLOBAL_FIELDS = ("activation", "weightInit", "biasInit", "updater",
                       "biasUpdater", "l1", "l2", "l1Bias", "l2Bias",
-                      "weightDecay", "dropOut", "distribution")
+                      "weightDecay", "dropOut", "distribution", "constraints")
 
     def __init__(self, name=None, activation=None, weightInit=None, biasInit=None,
                  updater=None, biasUpdater=None, l1=None, l2=None, l1Bias=None,
-                 l2Bias=None, weightDecay=None, dropOut=None, distribution=None):
+                 l2Bias=None, weightDecay=None, dropOut=None, distribution=None,
+                 constraints=None):
         self.name = name
         self.activation = activation
         self.weightInit = weightInit
@@ -81,6 +82,7 @@ class Layer:
         self.weightDecay = weightDecay
         self.dropOut = dropOut
         self.distribution = distribution
+        self.constraints = constraints
 
     @classmethod
     def Builder(cls, **kw):
@@ -113,16 +115,22 @@ class Layer:
         return True
 
     def _dropout_input(self, x, train, key):
-        p = self.dropOut
-        if not train or p is None or p in (0.0, 1.0) or key is None:
+        from deeplearning4j_tpu.nn.conf import dropout as _do
+
+        d = _do.resolve(self.dropOut)
+        if not train or d is None or key is None:
             return x
-        keep = jax.random.bernoulli(key, p, x.shape)
-        return jnp.where(keep, x / p, 0.0)
+        return d.apply(x, key)
+
+    # params that are neither weights nor biases: never regularized or
+    # constrained (reference: class centers and PReLU alpha have their own
+    # dynamics; l2 shrinkage would fight them)
+    _NON_WEIGHT_PARAMS = ("b", "beta", "centers", "alpha")
 
     def regularization(self, params):
         """Scalar l1/l2/weight-decay penalty for this layer's params."""
         total = 0.0
-        w_keys = [k for k in params if k not in ("b", "beta")]
+        w_keys = [k for k in params if k not in self._NON_WEIGHT_PARAMS]
         l1 = self.l1 or 0.0
         l2 = self.l2 or 0.0
         wd = self.weightDecay or 0.0
@@ -625,6 +633,9 @@ class GlobalPoolingLayer(Layer):
         if inputType.kind == InputType.CNN:
             self._mode = "cnn"
             return InputType.feedForward(inputType.channels)
+        if inputType.kind == InputType.CNN3D:
+            self._mode = "cnn3d"
+            return InputType.feedForward(inputType.channels)
         if inputType.kind == InputType.RNN:
             self._mode = "rnn"
             return InputType.feedForward(inputType.size)
@@ -632,7 +643,9 @@ class GlobalPoolingLayer(Layer):
         return inputType
 
     def forward(self, params, state, x, train, key, mask=None):
-        if x.ndim == 4:      # [B,H,W,C]
+        if x.ndim == 5:      # [B,D,H,W,C]
+            y = _pool.global_pool(x, self.poolingType, (1, 2, 3), None, self.pnorm)
+        elif x.ndim == 4:    # [B,H,W,C]
             y = _pool.global_pool(x, self.poolingType, (1, 2), None, self.pnorm)
         elif x.ndim == 3:    # [B,F,T]
             m = None if mask is None else mask[:, None, :]
@@ -703,3 +716,418 @@ class LocalResponseNormalization(Layer):
 
     def forward(self, params, state, x, train, key, mask=None):
         return _norm.lrn(x, self.k, self.n, self.alpha, self.beta), state
+
+
+# ======================================================================
+# 3D convolution / spatial reshaping layers
+# ======================================================================
+
+class Convolution3D(FeedForwardLayer):
+    """3D convolution (reference: conf.layers.Convolution3D). API data is
+    NCDHW; internal layout is NDHWC so the contraction hits the MXU the
+    same way the 2D NHWC path does."""
+
+    def __init__(self, kernelSize=(2, 2, 2), stride=(1, 1, 1),
+                 padding=(0, 0, 0), dilation=(1, 1, 1),
+                 convolutionMode="truncate", **kw):
+        super().__init__(**kw)
+        t3 = lambda v: (v, v, v) if isinstance(v, int) else tuple(v)
+        self.kernelSize = t3(kernelSize)
+        self.stride = t3(stride)
+        self.padding = t3(padding)
+        self.dilation = t3(dilation)
+        self.convolutionMode = convolutionMode
+
+    def inferNIn(self, inputType):
+        if self.nIn is None:
+            self.nIn = inputType.channels
+
+    def _out_dims(self, inputType):
+        dims = (inputType.depth, inputType.height, inputType.width)
+        return tuple(
+            _conv.conv_output_size(d, self.kernelSize[i], self.stride[i],
+                                   self.padding[i], self.dilation[i],
+                                   self.convolutionMode)
+            for i, d in enumerate(dims))
+
+    def getOutputType(self, inputType):
+        d, h, w = self._out_dims(inputType)
+        return InputType.convolutional3D(d, h, w, self.nOut)
+
+    def initialize(self, key, inputType, dtype):
+        self.inferNIn(inputType)
+        fan_in = self.nIn * int(jnp.prod(jnp.asarray(self.kernelSize)))
+        fan_out = self.nOut * int(jnp.prod(jnp.asarray(self.kernelSize)))
+        W = _winit.init(key, self.weightInit,
+                        (*self.kernelSize, self.nIn, self.nOut),
+                        fan_in, fan_out, dtype, self.distribution)
+        params = {"W": W}
+        if self.hasBias:
+            params["b"] = jnp.full((self.nOut,), self.biasInit, dtype)
+        return params, {}
+
+    def forward(self, params, state, x, train, key, mask=None):
+        x = self._dropout_input(x, train, key)
+        mode = str(self.convolutionMode).lower()
+        pad = "SAME" if mode == "same" else tuple(
+            (p, p) for p in self.padding)
+        y = _conv.conv3d(x, params["W"], params.get("b"), self.stride, pad,
+                         self.dilation)
+        return _act.get(self.activation)(y), state
+
+
+class Cropping1D(Layer):
+    """Crop the time axis of NCW data (reference: conf.layers.Cropping1D)."""
+
+    def __init__(self, cropping=(0, 0), **kw):
+        super().__init__(**kw)
+        c = (cropping, cropping) if isinstance(cropping, int) else tuple(cropping)
+        self.crop = (int(c[0]), int(c[1]))
+
+    def hasParams(self):
+        return False
+
+    def getOutputType(self, inputType):
+        t = inputType.dims.get("timeSeriesLength")
+        return InputType.recurrent(
+            inputType.size, None if t is None else t - sum(self.crop))
+
+    def forward(self, params, state, x, train, key, mask=None):
+        a, b = self.crop
+        return x[:, :, a:x.shape[2] - b], state
+
+
+class Cropping3D(Layer):
+    """Crop D/H/W of NDHWC data (reference: conf.layers.Cropping3D)."""
+
+    def __init__(self, cropping=(0, 0, 0, 0, 0, 0), **kw):
+        super().__init__(**kw)
+        c = cropping
+        if isinstance(c, int):
+            c = (c,) * 6
+        elif len(c) == 3:
+            c = (c[0], c[0], c[1], c[1], c[2], c[2])
+        self.crop = tuple(int(v) for v in c)  # d0,d1,h0,h1,w0,w1
+
+    def hasParams(self):
+        return False
+
+    def getOutputType(self, inputType):
+        d0, d1, h0, h1, w0, w1 = self.crop
+        return InputType.convolutional3D(
+            inputType.depth - d0 - d1, inputType.height - h0 - h1,
+            inputType.width - w0 - w1, inputType.channels)
+
+    def forward(self, params, state, x, train, key, mask=None):
+        d0, d1, h0, h1, w0, w1 = self.crop
+        D, H, W = x.shape[1], x.shape[2], x.shape[3]
+        return x[:, d0:D - d1, h0:H - h1, w0:W - w1, :], state
+
+
+class Upsampling1D(Layer):
+    """Repeat along the time axis of NCW data (reference: Upsampling1D)."""
+
+    def __init__(self, size=2, **kw):
+        super().__init__(**kw)
+        self.sizev = int(size if not isinstance(size, (tuple, list)) else size[0])
+
+    def hasParams(self):
+        return False
+
+    def getOutputType(self, inputType):
+        t = inputType.dims.get("timeSeriesLength")
+        return InputType.recurrent(
+            inputType.size, None if t is None else t * self.sizev)
+
+    def forward(self, params, state, x, train, key, mask=None):
+        return jnp.repeat(x, self.sizev, axis=2), state
+
+
+class Upsampling3D(Layer):
+    """Repeat along D/H/W of NDHWC data (reference: Upsampling3D)."""
+
+    def __init__(self, size=2, **kw):
+        super().__init__(**kw)
+        s = (size,) * 3 if isinstance(size, int) else tuple(size)
+        self.sizev = tuple(int(v) for v in s)
+
+    def hasParams(self):
+        return False
+
+    def getOutputType(self, inputType):
+        return InputType.convolutional3D(
+            inputType.depth * self.sizev[0], inputType.height * self.sizev[1],
+            inputType.width * self.sizev[2], inputType.channels)
+
+    def forward(self, params, state, x, train, key, mask=None):
+        for ax, s in zip((1, 2, 3), self.sizev):
+            x = jnp.repeat(x, s, axis=ax)
+        return x, state
+
+
+class SpaceToDepth(Layer):
+    """[B,H,W,C] -> [B,H/b,W/b,C*b*b] (reference: conf.layers.SpaceToDepth;
+    the YOLO2 passthrough vertex). blocks must divide H and W."""
+
+    def __init__(self, blocks=2, dataFormat="NCHW", **kw):
+        super().__init__(**kw)
+        self.blocks = int(blocks)
+
+    def hasParams(self):
+        return False
+
+    def getOutputType(self, inputType):
+        b = self.blocks
+        if inputType.height % b or inputType.width % b:
+            raise ValueError(
+                f"SpaceToDepth blocks={b} must divide H={inputType.height}, "
+                f"W={inputType.width}")
+        return InputType.convolutional(inputType.height // b,
+                                       inputType.width // b,
+                                       inputType.channels * b * b)
+
+    def forward(self, params, state, x, train, key, mask=None):
+        B, H, W, C = x.shape
+        b = self.blocks
+        x = x.reshape(B, H // b, b, W // b, b, C)
+        x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+        return x.reshape(B, H // b, W // b, C * b * b), state
+
+
+class SpaceToBatch(Layer):
+    """[B,H,W,C] -> [B*b*b, H/b, W/b, C] (reference: conf.layers.
+    SpaceToBatchLayer). Optional pre-padding [[pt,pb],[pl,pr]]."""
+
+    def __init__(self, blocks=2, padding=((0, 0), (0, 0)), **kw):
+        super().__init__(**kw)
+        self.blocks = int(blocks)
+        self.pad2 = tuple((int(p[0]), int(p[1])) for p in padding)
+
+    def hasParams(self):
+        return False
+
+    def getOutputType(self, inputType):
+        b = self.blocks
+        h = inputType.height + sum(self.pad2[0])
+        w = inputType.width + sum(self.pad2[1])
+        if h % b or w % b:
+            raise ValueError(f"SpaceToBatch blocks={b} must divide padded "
+                             f"H={h}, W={w}")
+        return InputType.convolutional(h // b, w // b, inputType.channels)
+
+    def forward(self, params, state, x, train, key, mask=None):
+        b = self.blocks
+        x = jnp.pad(x, ((0, 0), self.pad2[0], self.pad2[1], (0, 0)))
+        B, H, W, C = x.shape
+        x = x.reshape(B, H // b, b, W // b, b, C)
+        x = jnp.transpose(x, (2, 4, 0, 1, 3, 5))
+        return x.reshape(B * b * b, H // b, W // b, C), state
+
+
+# ======================================================================
+# Locally connected + parametric activation layers
+# ======================================================================
+
+class LocallyConnected2D(FeedForwardLayer):
+    """Convolution with UNSHARED weights per output position (reference:
+    conf.layers.LocallyConnected2D). W: [oh, ow, kh*kw*Cin, Cout]; the
+    patch-gather + einsum contraction keeps the matmul on the MXU."""
+
+    def __init__(self, kernelSize=(2, 2), stride=(1, 1), padding=(0, 0),
+                 convolutionMode="truncate", **kw):
+        super().__init__(**kw)
+        self.kernelSize = _pair(kernelSize)
+        self.stride = _pair(stride)
+        self.padding = _pair(padding)
+        if str(convolutionMode).lower() == "same":
+            raise ValueError("LocallyConnected2D supports truncate/explicit "
+                             "padding only (reference parity)")
+        self.convolutionMode = convolutionMode
+
+    def inferNIn(self, inputType):
+        if self.nIn is None:
+            self.nIn = inputType.channels
+
+    def _out_hw(self, inputType):
+        return (
+            _conv.conv_output_size(inputType.height, self.kernelSize[0],
+                                   self.stride[0], self.padding[0], 1,
+                                   self.convolutionMode),
+            _conv.conv_output_size(inputType.width, self.kernelSize[1],
+                                   self.stride[1], self.padding[1], 1,
+                                   self.convolutionMode))
+
+    def getOutputType(self, inputType):
+        oh, ow = self._out_hw(inputType)
+        return InputType.convolutional(oh, ow, self.nOut)
+
+    def initialize(self, key, inputType, dtype):
+        self.inferNIn(inputType)
+        self._oh, self._ow = self._out_hw(inputType)
+        kh, kw = self.kernelSize
+        fan_in = self.nIn * kh * kw
+        W = _winit.init(key, self.weightInit,
+                        (self._oh, self._ow, kh * kw * self.nIn, self.nOut),
+                        fan_in, self.nOut, dtype, self.distribution)
+        params = {"W": W}
+        if self.hasBias:
+            params["b"] = jnp.full((self._oh, self._ow, self.nOut),
+                                   self.biasInit, dtype)
+        return params, {}
+
+    def _patches(self, x):
+        """[B,H,W,C] -> [B, oh, ow, kh*kw*C]."""
+        kh, kw = self.kernelSize
+        sh, sw = self.stride
+        ph, pw = self.padding
+        if ph or pw:
+            x = jnp.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+        cols = []
+        for i in range(kh):
+            for j in range(kw):
+                sl = x[:, i:i + (self._oh - 1) * sh + 1:sh,
+                       j:j + (self._ow - 1) * sw + 1:sw, :]
+                cols.append(sl)
+        return jnp.concatenate(cols, axis=-1)  # [B,oh,ow,kh*kw*C]
+
+    def forward(self, params, state, x, train, key, mask=None):
+        x = self._dropout_input(x, train, key)
+        p = self._patches(x)
+        y = jnp.einsum("bhwk,hwko->bhwo", p, params["W"])
+        if self.hasBias:
+            y = y + params["b"]
+        return _act.get(self.activation)(y), state
+
+
+class LocallyConnected1D(FeedForwardLayer):
+    """Unshared-weight 1D convolution over NCW data (reference:
+    conf.layers.LocallyConnected1D). W: [ot, k*Cin, Cout]."""
+
+    def __init__(self, kernelSize=2, stride=1, padding=0, **kw):
+        super().__init__(**kw)
+        one = lambda v: int(v[0] if isinstance(v, (tuple, list)) else v)
+        self.kernelSize = one(kernelSize)
+        self.stride = one(stride)
+        self.padding = one(padding)
+
+    def inferNIn(self, inputType):
+        if self.nIn is None:
+            self.nIn = inputType.size
+
+    def _out_t(self, inputType):
+        t = inputType.dims.get("timeSeriesLength")
+        if t is None:
+            raise ValueError("LocallyConnected1D needs a fixed "
+                             "timeSeriesLength (unshared weights are "
+                             "per-position)")
+        return _conv.conv_output_size(t, self.kernelSize, self.stride,
+                                      self.padding, 1, "truncate")
+
+    def getOutputType(self, inputType):
+        return InputType.recurrent(self.nOut, self._out_t(inputType))
+
+    def initialize(self, key, inputType, dtype):
+        self.inferNIn(inputType)
+        self._ot = self._out_t(inputType)
+        k = self.kernelSize
+        W = _winit.init(key, self.weightInit, (self._ot, k * self.nIn, self.nOut),
+                        k * self.nIn, self.nOut, dtype, self.distribution)
+        params = {"W": W}
+        if self.hasBias:
+            params["b"] = jnp.full((self._ot, self.nOut), self.biasInit, dtype)
+        return params, {}
+
+    def forward(self, params, state, x, train, key, mask=None):
+        x = self._dropout_input(x, train, key)
+        xt = jnp.transpose(x, (0, 2, 1))  # [B,T,C]
+        if self.padding:
+            xt = jnp.pad(xt, ((0, 0), (self.padding, self.padding), (0, 0)))
+        k, s = self.kernelSize, self.stride
+        cols = [xt[:, i:i + (self._ot - 1) * s + 1:s, :] for i in range(k)]
+        p = jnp.concatenate(cols, axis=-1)  # [B,ot,k*C]
+        y = jnp.einsum("btk,tko->bto", p, params["W"])
+        if self.hasBias:
+            y = y + params["b"]
+        y = _act.get(self.activation)(y)
+        return jnp.transpose(y, (0, 2, 1)), state
+
+
+class PReLULayer(Layer):
+    """Parametric ReLU: y = max(x,0) + alpha*min(x,0) with learned alpha
+    (reference: conf.layers.PReLULayer). alpha is per-channel for CNN
+    input, per-feature otherwise; `sharedAxes` collapses alpha dims."""
+
+    def __init__(self, sharedAxes=None, alphaInit=0.0, **kw):
+        super().__init__(**kw)
+        self.sharedAxes = sharedAxes
+        self.alphaInit = float(alphaInit)
+
+    def initialize(self, key, inputType, dtype):
+        if inputType.kind == InputType.CNN:
+            shape = [inputType.height, inputType.width, inputType.channels]
+            # reference sharedAxes are 1-based over [C,H,W]; map to HWC
+            if self.sharedAxes:
+                m = {1: 2, 2: 0, 3: 1}  # ref axis -> HWC index
+                for a in self.sharedAxes:
+                    shape[m[int(a)]] = 1
+        elif inputType.kind == InputType.RNN:
+            shape = [inputType.size, 1]
+        else:
+            shape = [inputType.size]
+        self._alpha_shape = tuple(shape)
+        return {"alpha": jnp.full(self._alpha_shape, self.alphaInit, dtype)}, {}
+
+    def forward(self, params, state, x, train, key, mask=None):
+        a = params["alpha"]
+        return jnp.maximum(x, 0) + a * jnp.minimum(x, 0), state
+
+
+class CenterLossOutputLayer(BaseOutputLayer):
+    """Softmax output + center loss (reference:
+    conf.layers.CenterLossOutputLayer, Wen et al. 2016):
+
+        L = L_softmax + lambda/2 * ||f - c_{y}||^2
+
+    Class centers are a parameter tensor [nClasses, nIn] trained by the
+    same jitted step (gradient dL/dc = lambda*(c_y - f) reproduces the
+    reference's  c += alpha*(f - c)  update with alpha = lr*lambda)."""
+
+    def __init__(self, alpha=0.05, lambda_=2e-4, lambdaCoeff=None, **kw):
+        super().__init__(**kw)
+        self.alpha = float(alpha)
+        self.lambda_ = float(lambdaCoeff if lambdaCoeff is not None else lambda_)
+
+    def initialize(self, key, inputType, dtype):
+        params, state = super().initialize(key, inputType, dtype)
+        params["centers"] = jnp.zeros((self.nOut, self.nIn), dtype)
+        return params, state
+
+    def preoutput(self, params, x):
+        # features ride along in the preact for computeLoss; the params are
+        # stashed for the same-trace computeLoss call (centers gradient)
+        self._params_ref = params
+        y = x @ params["W"]
+        if self.hasBias:
+            y = y + params["b"]
+        return jnp.concatenate([y, x], axis=-1)  # [B, nOut + nIn]
+
+    def outputFromPreact(self, pre):
+        return _act.get(self.activation)(pre[:, : self.nOut])
+
+    def forward(self, params, state, x, train, key, mask=None):
+        x = self._dropout_input(x, train, key)
+        pre = (x @ params["W"] + params["b"]) if self.hasBias else x @ params["W"]
+        return _act.get(self.activation)(pre), state
+
+    def computeLoss(self, preact, labels, lmask):
+        from deeplearning4j_tpu.nn import losses as _losses
+
+        logits = preact[:, : self.nOut]
+        feats = preact[:, self.nOut:]
+        base = _losses.compute(self.lossFunction, labels, logits,
+                               self.activation, lmask)
+        centers = self._params_ref["centers"].astype(feats.dtype)
+        cy = labels @ centers  # one-hot gather of each example's center
+        center = 0.5 * self.lambda_ * jnp.mean(
+            jnp.sum(jnp.square(feats - cy), axis=-1))
+        return base + center

@@ -1,0 +1,152 @@
+"""Variational autoencoder layer.
+
+Reference: org.deeplearning4j.nn.conf.layers.variational.
+VariationalAutoencoder (+ GaussianReconstructionDistribution /
+BernoulliReconstructionDistribution), Kingma & Welling 2014. Upstream the
+VAE trains via MultiLayerNetwork.pretrain(iterator) — layerwise
+unsupervised ELBO maximisation — and acts as a deterministic feature
+encoder (mean of q(z|x)) inside a supervised stack.
+
+TPU design: encoder/decoder are plain MLP param stacks inside one layer;
+the ELBO (one reparameterised sample by default, numSamples to average
+more) is a pure function of (params, x, key), so pretraining reuses the
+same donated-buffer jitted-step machinery as supervised fit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import activations as _act
+from deeplearning4j_tpu.nn import weights as _winit
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import FeedForwardLayer
+
+
+class VariationalAutoencoder(FeedForwardLayer):
+    """nOut = latent size. encoderLayerSizes/decoderLayerSizes are the
+    hidden MLP widths (reference builder names kept)."""
+
+    def __init__(self, encoderLayerSizes=(100,), decoderLayerSizes=(100,),
+                 pzxActivationFunction="identity",
+                 reconstructionDistribution="gaussian", numSamples=1, **kw):
+        super().__init__(**kw)
+        self.encoderLayerSizes = tuple(int(s) for s in encoderLayerSizes)
+        self.decoderLayerSizes = tuple(int(s) for s in decoderLayerSizes)
+        self.pzxActivationFunction = pzxActivationFunction
+        rd = str(reconstructionDistribution).lower()
+        if rd not in ("gaussian", "bernoulli"):
+            raise ValueError("reconstructionDistribution must be 'gaussian' "
+                             "or 'bernoulli'")
+        self.reconstructionDistribution = rd
+        self.numSamples = int(numSamples)
+        self.pretrainable = True
+
+    def getOutputType(self, inputType):
+        return InputType.feedForward(self.nOut)
+
+    def _mlp_params(self, key, sizes, dtype):
+        ps = []
+        for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+            k = jax.random.fold_in(key, i)
+            ps.append({
+                "W": _winit.init(k, self.weightInit, (a, b), a, b, dtype,
+                                 self.distribution),
+                "b": jnp.full((b,), self.biasInit, dtype),
+            })
+        return ps
+
+    def initialize(self, key, inputType, dtype):
+        self.inferNIn(inputType)
+        nZ = self.nOut
+        ke, km, kd, ko = jax.random.split(key, 4)
+        enc_sizes = (self.nIn,) + self.encoderLayerSizes
+        dec_sizes = (nZ,) + self.decoderLayerSizes
+        out_dim = 2 * self.nIn if self.reconstructionDistribution == "gaussian" \
+            else self.nIn
+        eh = enc_sizes[-1]
+        params = {
+            "enc": self._mlp_params(ke, enc_sizes, dtype),
+            "pZXMeanW": _winit.init(km, self.weightInit, (eh, nZ), eh, nZ,
+                                    dtype, self.distribution),
+            "pZXMeanB": jnp.zeros((nZ,), dtype),
+            "pZXLogStdW": _winit.init(jax.random.fold_in(km, 1),
+                                      self.weightInit, (eh, nZ), eh, nZ,
+                                      dtype, self.distribution),
+            "pZXLogStdB": jnp.zeros((nZ,), dtype),
+            "dec": self._mlp_params(kd, dec_sizes, dtype),
+            "pXZW": _winit.init(ko, self.weightInit,
+                                (dec_sizes[-1], out_dim), dec_sizes[-1],
+                                out_dim, dtype, self.distribution),
+            "pXZB": jnp.zeros((out_dim,), dtype),
+        }
+        return params, {}
+
+    # ------------------------------------------------------------------
+    def _mlp(self, ps, x):
+        act = _act.get(self.activation)
+        for p in ps:
+            x = act(x @ p["W"] + p["b"])
+        return x
+
+    def encode(self, params, x):
+        """q(z|x) -> (mean, logstd), both [B, nZ]."""
+        h = self._mlp(params["enc"], x)
+        mean = _act.get(self.pzxActivationFunction)(
+            h @ params["pZXMeanW"] + params["pZXMeanB"])
+        logstd = h @ params["pZXLogStdW"] + params["pZXLogStdB"]
+        return mean, logstd
+
+    def decode(self, params, z):
+        """p(x|z) distribution params: gaussian -> (mean, logstd) each
+        [B, nIn]; bernoulli -> logits [B, nIn]."""
+        h = self._mlp(params["dec"], z)
+        out = h @ params["pXZW"] + params["pXZB"]
+        if self.reconstructionDistribution == "gaussian":
+            return out[:, : self.nIn], out[:, self.nIn:]
+        return out
+
+    def forward(self, params, state, x, train, key, mask=None):
+        # supervised stack use: deterministic encoder, mean of q(z|x)
+        x = self._dropout_input(x, train, key)
+        mean, _ = self.encode(params, x)
+        return mean, state
+
+    # ------------------------------------------------------------------
+    def pretrain_loss(self, params, x, key):
+        """Negative ELBO, mean over the batch (the quantity
+        MultiLayerNetwork.pretrain minimises)."""
+        mean, logstd = self.encode(params, x)
+        kl = 0.5 * jnp.sum(jnp.square(mean) + jnp.exp(2.0 * logstd)
+                           - 1.0 - 2.0 * logstd, axis=-1)
+        recon = 0.0
+        for i in range(self.numSamples):
+            eps = jax.random.normal(jax.random.fold_in(key, i), mean.shape,
+                                    mean.dtype)
+            z = mean + jnp.exp(logstd) * eps
+            if self.reconstructionDistribution == "gaussian":
+                rmean, rlogstd = self.decode(params, z)
+                nll = 0.5 * jnp.sum(
+                    jnp.square((x - rmean) * jnp.exp(-rlogstd))
+                    + 2.0 * rlogstd + jnp.log(2.0 * jnp.pi), axis=-1)
+            else:
+                logits = self.decode(params, z)
+                nll = jnp.sum(
+                    jnp.maximum(logits, 0) - logits * x
+                    + jnp.log1p(jnp.exp(-jnp.abs(logits))), axis=-1)
+            recon = recon + nll / self.numSamples
+        return jnp.mean(recon + kl)
+
+    def reconstruct(self, params, x):
+        mean, _ = self.encode(params, x)
+        out = self.decode(params, mean)
+        if self.reconstructionDistribution == "gaussian":
+            return out[0]
+        return jax.nn.sigmoid(out)
+
+    def generate(self, params, z):
+        out = self.decode(params, z)
+        if self.reconstructionDistribution == "gaussian":
+            return out[0]
+        return jax.nn.sigmoid(out)

@@ -221,8 +221,13 @@ class ComputationGraph:
                                            "frozen", False):
                 continue
             upd, us = self._updaters[name].apply(g, upd_states[name], iteration)
-            new_params[name] = jax.tree_util.tree_map(
+            np_n = jax.tree_util.tree_map(
                 lambda p, u: (p - u).astype(p.dtype), params[name], upd)
+            cs = getattr(self.conf.nodes[name].payload, "constraints", None)
+            if cs:
+                from deeplearning4j_tpu.nn.conf.constraint import apply_constraints
+                np_n = apply_constraints(cs, np_n)
+            new_params[name] = np_n
             new_upd[name] = us
         return new_params, new_upd, new_states, loss
 

@@ -182,6 +182,8 @@ class MultiLayerNetwork:
         it = self.conf.inputType
         if it.kind == InputType.CNN and x.ndim == 4:
             x = jnp.transpose(x, (0, 2, 3, 1))  # NCHW -> NHWC
+        elif it.kind == InputType.CNN3D and x.ndim == 5:
+            x = jnp.transpose(x, (0, 2, 3, 4, 1))  # NCDHW -> NDHWC
         return x.astype(self._compute_dtype)
 
     def _cast_params(self, p):
@@ -282,8 +284,13 @@ class MultiLayerNetwork:
             upd, us = self._updaters[i].apply(grads[i], upd_states[i], iteration)
             # cast keeps param dtype stable (python-float hyperparams would
             # otherwise promote under x64)
-            new_params.append(jax.tree_util.tree_map(
-                lambda p, u: (p - u).astype(p.dtype), params[i], upd))
+            np_i = jax.tree_util.tree_map(
+                lambda p, u: (p - u).astype(p.dtype), params[i], upd)
+            cs = getattr(self.layers[i], "constraints", None)
+            if cs:
+                from deeplearning4j_tpu.nn.conf.constraint import apply_constraints
+                np_i = apply_constraints(cs, np_i)
+            new_params.append(np_i)
             new_upd_states.append(us)
         return new_params, new_upd_states, new_states, loss
 
@@ -293,6 +300,10 @@ class MultiLayerNetwork:
         recurrent output needs softmax over O, not the trailing time axis."""
         from deeplearning4j_tpu.nn import activations as _act
 
+        if hasattr(layer, "outputFromPreact"):
+            # composite heads (CenterLossOutputLayer) carry extra channels
+            # in the preact that the user-visible output must drop
+            return layer.outputFromPreact(pre)
         act = _act.get(layer.activation)
         if pre.ndim == 3:
             return jnp.transpose(act(jnp.transpose(pre, (0, 2, 1))), (0, 2, 1))
@@ -382,6 +393,86 @@ class MultiLayerNetwork:
             return loss
 
         run_tbptt(self, x.shape[2], self.conf.tbpttFwdLength, jit_call)
+
+    # ----- unsupervised layerwise pretraining (VAE etc.) --------------
+    def pretrain(self, iterator, epochs=1):
+        """Layerwise unsupervised pretraining of every pretrainable layer
+        (reference: MultiLayerNetwork.pretrain(DataSetIterator) — upstream
+        this is how VariationalAutoencoder layers train)."""
+        for i, layer in enumerate(self.layers):
+            if getattr(layer, "pretrainable", False):
+                self.pretrainLayer(i, iterator, epochs)
+        return self
+
+    def pretrainLayer(self, layerIdx, data, epochs=1):
+        """Unsupervised pretraining of one layer: its input is the frozen
+        forward of the preceding layers; its params train against the
+        layer's own pretrain_loss (negative ELBO for VAE) in a donated
+        jitted step (reference: MultiLayerNetwork.pretrainLayer)."""
+        self._require_init()
+        layer = self.layers[layerIdx]
+        if not getattr(layer, "pretrainable", False):
+            raise ValueError(f"Layer {layerIdx} "
+                             f"({type(layer).__name__}) is not pretrainable")
+
+        def feed(x):
+            h = self._entry(x)
+            states = self._strip_carries(self._states)
+            for j in range(layerIdx):
+                pp = self.conf.preprocessors.get(j)
+                if pp is not None:
+                    if hasattr(pp, "batch"):
+                        pp.batch = x.shape[0]
+                    h = pp.preProcess(h, None)
+                h, _ = self.layers[j].forward(
+                    self._cast_params(self._params[j]), states[j], h,
+                    False, None, None)
+            return h
+
+        upd = self._updaters[layerIdx]
+
+        @jax.jit
+        def pre_step(p, us, it, x, key):
+            loss, g = jax.value_and_grad(
+                lambda p_: layer.pretrain_loss(self._cast_params(p_),
+                                               feed(x), key))(p)
+            d, us = upd.apply(g, us, it)
+            p = jax.tree_util.tree_map(
+                lambda a, b: (a - b).astype(a.dtype), p, d)
+            return p, us, loss
+
+        from deeplearning4j_tpu.data.dataset import DataSet
+
+        batches = None
+        if isinstance(data, DataSet):
+            batches = [data]
+        elif hasattr(data, "hasNext"):
+            pass  # iterator: re-drawn per epoch below
+        else:
+            batches = [DataSet(data, None)]
+        p, us = self._params[layerIdx], self._upd_states[layerIdx]
+        loss = float("nan")
+
+        def one(ds, p, us):
+            x = _unwrap(ds.getFeatures())
+            key = jax.random.fold_in(
+                jax.random.key(self.conf.seed ^ 0xE1B0), self._iteration)
+            p, us, loss = pre_step(
+                p, us, jnp.asarray(self._iteration, jnp.int32), x, key)
+            self._iteration += 1
+            return p, us, loss
+
+        for _ in range(epochs):
+            if batches is None:
+                data.reset()
+                while data.hasNext():
+                    p, us, loss = one(data.next(), p, us)
+            else:
+                for ds in batches:
+                    p, us, loss = one(ds, p, us)
+        self._params[layerIdx], self._upd_states[layerIdx] = p, us
+        self._score = float(loss)
+        return self
 
     def output(self, x, train=False) -> INDArray:
         self._require_init()

@@ -108,15 +108,25 @@ def dot_product_attention(q, k, v, mask=None, causal=False):
 
 def multi_head_attention(x, Wq, Wk, Wv, Wo, nHeads, causal=False,
                          block_size=None, kv=None):
-    """Full MHA: x [B, T, E]; Wq/Wk/Wv [E, H*D]; Wo [H*D, E]."""
+    """Full MHA: x [B, T, E]; Wq/Wk/Wv [E, H*D]; Wo [H*D, E].
+
+    The attention core goes through flash_attention's dispatcher: Pallas
+    flash kernel on TPU for long T, fused XLA for short T, blockwise scan
+    elsewhere. An explicit block_size forces the blockwise form (and sets
+    the flash KV block on TPU)."""
+    from deeplearning4j_tpu.ops.pallas_attention import flash_attention
+
     B, T, E = x.shape
     src = x if kv is None else kv
     q = (x @ Wq).reshape(B, T, nHeads, -1).transpose(0, 2, 1, 3)
     k = (src @ Wk).reshape(B, src.shape[1], nHeads, -1).transpose(0, 2, 1, 3)
     v = (src @ Wv).reshape(B, src.shape[1], nHeads, -1).transpose(0, 2, 1, 3)
     if block_size:
-        o = blockwise_attention(q, k, v, block_size=block_size, causal=causal)
+        # explicit block_size = the caller bounded attention memory; never
+        # fall back to the O(T^2) fused form
+        o = flash_attention(q, k, v, causal=causal, block_k=block_size,
+                            force_streaming=True)
     else:
-        o = dot_product_attention(q, k, v, causal=causal)
+        o = flash_attention(q, k, v, causal=causal)
     o = o.transpose(0, 2, 1, 3).reshape(B, T, -1)
     return o @ Wo

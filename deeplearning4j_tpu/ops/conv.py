@@ -80,6 +80,22 @@ def conv1d(x, w, b=None, stride=1, padding=((0, 0),), dilation=1):
     return out
 
 
+def conv3d(x, w, b=None, stride=(1, 1, 1), padding=((0, 0),) * 3,
+           dilation=(1, 1, 1)):
+    """x: [B,D,H,W,Cin], w: [kd,kh,kw,Cin,Cout] -> [B,D',H',W',Cout].
+    Reference: Convolution3D (NDHWC internal, like the 2D NHWC path)."""
+    out = lax.conv_general_dilated(
+        x, w,
+        window_strides=tuple(stride),
+        padding=padding,
+        rhs_dilation=tuple(dilation),
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+    )
+    if b is not None:
+        out = out + b
+    return out
+
+
 def conv_output_size(size, kernel, stride, pad, dilation, mode):
     """Spatial shape inference, matching the reference's
     ConvolutionUtils.getOutputSize."""

@@ -83,9 +83,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, Tk: int,
     o_ref[0] = (acc / jnp.where(l == 0, 1.0, l)[:, None]).astype(o_ref.dtype)
 
 
-# test hook: run the pallas kernel in interpreter mode (works on CPU);
-# exercised by tests/test_attention.py so the kernel logic is CI-verified
-# without a TPU
+# test hook: when True, pallas_call runs in interpreter mode (works on CPU)
+# and flash_attention always takes the kernel path regardless of backend
+# (tests/test_attention.py::TestFlashKernel sets this to check the kernel
+# against the fused reference, forward and backward)
 _INTERPRET = False
 
 
@@ -155,16 +156,42 @@ def _on_tpu() -> bool:
         return False
 
 
-def flash_attention(q, k, v, causal=False, key_mask=None,
-                    block_q=256, block_k=256):
-    """Flash attention [B,H,T,D] with automatic fallback.
+# below this sequence length the fused XLA attention wins: the [T,T]
+# score tile fits comfortably on-chip and pallas_call launch overhead
+# isn't amortised
+_MIN_FLASH_SEQ = 512
 
-    Pallas path: TPU backend, no ragged key mask. Otherwise the lax.scan
-    blockwise form (same math, same O(T) memory).
+
+def flash_attention(q, k, v, causal=False, key_mask=None,
+                    block_q=512, block_k=512, force_streaming=False):
+    """Attention [B,H,T,D] with automatic kernel dispatch.
+
+    Pallas flash kernel: TPU backend, no ragged key mask, T >= 512.
+    Short sequences use the fused XLA form (scores fit on-chip); ragged
+    masks and non-TPU backends use the lax.scan blockwise form (same
+    online-softmax math, same O(T) memory).
+
+    force_streaming=True (set when the caller passed an explicit
+    block_size, i.e. asked for bounded memory) never takes the fused
+    O(T^2)-score path — only the pallas kernel or the blockwise scan.
     """
-    if key_mask is not None or not (_on_tpu() or _INTERPRET):
+    from deeplearning4j_tpu.ops.attention import dot_product_attention
+
+    if key_mask is not None:
         return blockwise_attention(q, k, v, block_size=block_k, causal=causal,
                                    key_mask=key_mask)
+    if _INTERPRET:  # tests: force the kernel path on any backend
+        return _flash(q, k, v, causal, block_q, block_k)
+    T = max(q.shape[2], k.shape[2])
+    if not _on_tpu():
+        if not force_streaming and T <= 2048:
+            return dot_product_attention(q, k, v, causal=causal)
+        return blockwise_attention(q, k, v, block_size=block_k, causal=causal)
+    if T < _MIN_FLASH_SEQ:
+        if force_streaming:
+            return blockwise_attention(q, k, v, block_size=block_k,
+                                       causal=causal)
+        return dot_product_attention(q, k, v, causal=causal)
     try:
         return _flash(q, k, v, causal, block_q, block_k)
     except Exception:

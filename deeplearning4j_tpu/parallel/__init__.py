@@ -14,10 +14,12 @@ from deeplearning4j_tpu.parallel.trainer import (
 )
 from deeplearning4j_tpu.parallel.sharding import shard_params, replicate_params, spec_for_param
 from deeplearning4j_tpu.parallel.sequence import ring_attention, ulysses_attention
+from deeplearning4j_tpu.parallel.pipeline import PipelineParallel, partition_stages
 
 __all__ = [
     "build_mesh", "data_parallel_mesh", "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS",
     "PIPE_AXIS", "ParallelWrapper", "SharedTrainingMaster",
     "ParameterAveragingTrainingMaster", "shard_params",
     "replicate_params", "spec_for_param", "ring_attention", "ulysses_attention",
+    "PipelineParallel", "partition_stages",
 ]

@@ -236,39 +236,45 @@ class ParameterAveragingTrainingMaster(ParallelWrapper):
     def _build_jit(self):
         from jax import shard_map
 
-        n, mesh, ax, freq = self.net, self.mesh, self.batch_axis, self._avg_freq
+        n, mesh, ax = self.net, self.mesh, self.batch_axis
 
-        def shard_step(params, upd, states, it, x, y, key, fm, lm):
-            sq = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
-            params, upd, states = sq(params), sq(upd), sq(states)
-            # decorrelate per-replica dropout/noise like distinct Spark workers
-            key = jax.random.fold_in(key, jax.lax.axis_index(ax))
-            p, u, s, loss = n._train_step(params, upd, states, it, x, y, key,
-                                          fm, lm)
-            do_avg = ((it + 1) % freq) == 0
+        def make_step(do_avg):
+            # two step variants chosen HOST-side by the iteration counter:
+            # the averaging collective only exists in the executable that
+            # runs at averaging points — a traced jnp.where would make XLA
+            # pay the full pmean of params+opt+state every single step,
+            # which is exactly the traffic this mode exists to avoid
+            def shard_step(params, upd, states, it, x, y, key, fm, lm):
+                sq = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
+                params, upd, states = sq(params), sq(upd), sq(states)
+                # decorrelate per-replica dropout like distinct Spark workers
+                key = jax.random.fold_in(key, jax.lax.axis_index(ax))
+                p, u, s, loss = n._train_step(params, upd, states, it, x, y,
+                                              key, fm, lm)
+                if do_avg:
+                    avg = lambda t: jax.tree_util.tree_map(
+                        lambda a: jax.lax.pmean(a, ax)
+                        if jnp.issubdtype(a.dtype, jnp.inexact) else a, t)
+                    p, u, s = avg(p), avg(u), avg(s)
+                loss = jax.lax.pmean(loss, ax)
+                ex = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
+                return ex(p), ex(u), ex(s), loss
 
-            def avg(tree):
-                return jax.tree_util.tree_map(
-                    lambda a: jnp.where(do_avg, jax.lax.pmean(a, ax), a)
-                    if jnp.issubdtype(a.dtype, jnp.inexact) else a, tree)
+            def step(params, upd, states, it, x, y, key, fm, lm):
+                spec_b = P(ax)
+                return shard_map(
+                    shard_step, mesh=mesh,
+                    in_specs=(spec_b, spec_b, spec_b, P(), spec_b, spec_b, P(),
+                              spec_b if fm is not None else P(),
+                              spec_b if lm is not None else P()),
+                    out_specs=(spec_b, spec_b, spec_b, P()),
+                    check_vma=False,
+                )(params, upd, states, it, x, y, key, fm, lm)
 
-            p, u, s = avg(p), avg(u), avg(s)
-            loss = jax.lax.pmean(loss, ax)
-            ex = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
-            return ex(p), ex(u), ex(s), loss
+            return jax.jit(step, donate_argnums=(0, 1, 2))
 
-        def step(params, upd, states, it, x, y, key, fm, lm):
-            spec_b = P(ax)
-            return shard_map(
-                shard_step, mesh=mesh,
-                in_specs=(spec_b, spec_b, spec_b, P(), spec_b, spec_b, P(),
-                          spec_b if fm is not None else P(),
-                          spec_b if lm is not None else P()),
-                out_specs=(spec_b, spec_b, spec_b, P()),
-                check_vma=False,
-            )(params, upd, states, it, x, y, key, fm, lm)
-
-        self._jit = jax.jit(step, donate_argnums=(0, 1, 2))
+        self._jit = make_step(False)
+        self._jit_avg = make_step(True)
 
     def _fit_batch(self, ds):
         from deeplearning4j_tpu.nn.multilayer import _unwrap as unw
@@ -288,8 +294,10 @@ class ParameterAveragingTrainingMaster(ParallelWrapper):
             lmask = jax.device_put(lmask, self._batch_sharding(lmask))
         key = jax.random.fold_in(jax.random.key(n.conf.seed ^ 0x5EED), n._iteration)
         p, u, s = self._stacked
-        p, u, s, loss = self._jit(p, u, s, jnp.asarray(n._iteration, jnp.int32),
-                                  x, y, key, fmask, lmask)
+        step = self._jit_avg if (n._iteration + 1) % self._avg_freq == 0 \
+            else self._jit
+        p, u, s, loss = step(p, u, s, jnp.asarray(n._iteration, jnp.int32),
+                             x, y, key, fmask, lmask)
         self._stacked = (p, u, s)
         n._score = float(loss)
         n._iteration += 1

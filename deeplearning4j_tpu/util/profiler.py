@@ -72,6 +72,63 @@ class OpProfiler:
         return out
 
 
+# ----------------------------------------------------------------------
+# FLOP accounting / MFU (reference: OpProfiler's op-level flop counters;
+# on TPU the XLA compiler already knows the whole-step flop count, so we
+# read it from the compiled executable instead of re-deriving per-op)
+# ----------------------------------------------------------------------
+
+# bf16 peak TFLOP/s per chip by device kind substring (public TPU specs)
+_PEAK_BF16_FLOPS = (
+    ("v6", 918e12),        # Trillium / v6e
+    ("v5p", 459e12),
+    ("v5", 197e12),        # v5e / "TPU v5 lite"
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def device_peak_flops(device=None) -> float:
+    """Per-chip peak bf16 FLOP/s for the given (default: first) device.
+    Returns 0.0 when the device kind is unknown (CPU test meshes)."""
+    import jax
+
+    try:
+        d = device or jax.devices()[0]
+        kind = d.device_kind.lower()
+    except Exception:
+        return 0.0
+    for sub, peak in _PEAK_BF16_FLOPS:
+        if sub in kind:
+            return peak
+    return 0.0
+
+
+def compiled_cost(fn, *args, **kwargs) -> dict:
+    """FLOPs + HBM bytes of one call of `fn(*args, **kwargs)` as XLA
+    compiled it: {'flops': float, 'bytes_accessed': float}. `fn` may
+    already be jitted; costs come from lower().compile().cost_analysis()."""
+    import jax
+
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    ca = jitted.lower(*args, **kwargs).compile().cost_analysis()
+    if isinstance(ca, (list, tuple)):  # older jax returns [dict]
+        ca = ca[0] if ca else {}
+    ca = ca or {}
+    return {"flops": float(ca.get("flops", 0.0)),
+            "bytes_accessed": float(ca.get("bytes accessed", 0.0))}
+
+
+def mfu(flops_per_step: float, step_time_s: float, device=None) -> float:
+    """Model FLOP utilization: achieved FLOP/s over the chip's bf16 peak.
+    0.0 when peak is unknown."""
+    peak = device_peak_flops(device)
+    if not peak or step_time_s <= 0:
+        return 0.0
+    return flops_per_step / step_time_s / peak
+
+
 @contextlib.contextmanager
 def trace(log_dir: str):
     """jax.profiler device trace around a block — open the dump with

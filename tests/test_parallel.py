@@ -287,3 +287,159 @@ class TestSequenceParallel:
         out = blockwise_attention(q, k, v, block_size=8, causal=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-4, atol=2e-5)
+
+
+class TestPipelineParallel:
+    """GPipe-style microbatch pipeline over the 'pipe' mesh axis
+    (parallel/pipeline.py). No upstream analog — TPU-first addition."""
+
+    def _deep_mlp(self, seed=5, H=32):
+        from deeplearning4j_tpu.nn import ActivationLayer  # noqa: F401
+
+        b = (NeuralNetConfiguration.Builder()
+             .seed(seed).updater(Sgd(0.05)).activation("tanh").list()
+             .layer(DenseLayer(nOut=H)))           # prologue: 4 -> H
+        for _ in range(4):                          # homogeneous body run
+            b = b.layer(DenseLayer(nOut=H))
+        b = (b.layer(OutputLayer(nOut=3, activation="softmax"))
+             .setInputType(InputType.feedForward(4)))
+        return b.build()
+
+    def test_partition_stages(self):
+        from deeplearning4j_tpu.parallel import partition_stages
+
+        net = MultiLayerNetwork(self._deep_mlp()).init()
+        pro, body, epi = partition_stages(net.layers, net._params, 4)
+        assert pro == [0]            # the 4->H dense has a different W shape
+        assert body == [1, 2, 3, 4]
+        assert epi == [5]
+
+    def test_partition_rejects_heterogeneous(self):
+        from deeplearning4j_tpu.parallel import partition_stages
+
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(1).updater(Sgd(0.1)).list()
+                .layer(DenseLayer(nOut=16))
+                .layer(DenseLayer(nOut=8))
+                .layer(OutputLayer(nOut=3, activation="softmax"))
+                .setInputType(InputType.feedForward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        with pytest.raises(ValueError, match="identical"):
+            partition_stages(net.layers, net._params, 4)
+
+    def test_pipeline_matches_single_device(self):
+        """With SGD the pipelined step computes the same loss/params as
+        plain single-device training on the same batch (microbatching
+        changes nothing without BN; mean-of-microbatch-means == full mean)."""
+        from deeplearning4j_tpu.parallel import PipelineParallel
+
+        x, y, _ = _data(64)
+        ref = MultiLayerNetwork(self._deep_mlp()).init()
+        for _ in range(3):
+            ref.fit(x, y)
+
+        net = MultiLayerNetwork(self._deep_mlp()).init()
+        mesh = build_mesh({"pipe": 4})
+        pp = PipelineParallel(net, mesh, n_microbatches=4)
+        for _ in range(3):
+            pp.fit(x, y)
+        np.testing.assert_allclose(ref.params().toNumpy(),
+                                   net.params().toNumpy(),
+                                   rtol=1e-4, atol=1e-5)
+        assert abs(ref.score() - net.score()) < 1e-4
+
+    def test_pipeline_composes_with_dp(self):
+        from deeplearning4j_tpu.parallel import PipelineParallel
+
+        x, y, _ = _data(64)
+        ref = MultiLayerNetwork(self._deep_mlp()).init()
+        for _ in range(2):
+            ref.fit(x, y)
+
+        net = MultiLayerNetwork(self._deep_mlp()).init()
+        mesh = build_mesh({DATA_AXIS: 2, "pipe": 4})
+        pp = PipelineParallel(net, mesh, n_microbatches=4)
+        for _ in range(2):
+            pp.fit(x, y)
+        np.testing.assert_allclose(ref.params().toNumpy(),
+                                   net.params().toNumpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_pipeline_converges(self):
+        from deeplearning4j_tpu.parallel import PipelineParallel
+
+        x, y, yi = _data(128, seed=4)
+        net = MultiLayerNetwork(self._deep_mlp()).init()
+        mesh = build_mesh({"pipe": 4})
+        pp = PipelineParallel(net, mesh, n_microbatches=4)
+        first = None
+        for _ in range(30):
+            pp.fit(x, y)
+            first = first if first is not None else net.score()
+        assert net.score() < 0.7 * first
+
+    def test_bad_microbatch_divisibility(self):
+        from deeplearning4j_tpu.parallel import PipelineParallel
+
+        x, y, _ = _data(30)
+        net = MultiLayerNetwork(self._deep_mlp()).init()
+        pp = PipelineParallel(net, build_mesh({"pipe": 4}), n_microbatches=4)
+        with pytest.raises(ValueError, match="divisible"):
+            pp.fit(x, y)
+
+
+class TestPipelineRegressions:
+    def test_equal_dropout_objects_are_homogeneous(self):
+        """Separately constructed but equal Dropout objects must not break
+        stage partitioning (value-based config comparison)."""
+        from deeplearning4j_tpu.nn import Dropout
+        from deeplearning4j_tpu.parallel import partition_stages
+
+        b = (NeuralNetConfiguration.Builder()
+             .seed(5).updater(Sgd(0.05)).activation("tanh").list()
+             .layer(DenseLayer(nOut=16)))
+        for _ in range(4):
+            b = b.layer(DenseLayer(nOut=16, dropOut=Dropout(0.9)))
+        conf = (b.layer(OutputLayer(nOut=3, activation="softmax"))
+                .setInputType(InputType.feedForward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        pro, body, epi = partition_stages(net.layers, net._params, 4)
+        assert body == [1, 2, 3, 4]
+
+    def test_heterogeneous_activation_rejected(self):
+        from deeplearning4j_tpu.parallel import partition_stages
+
+        b = (NeuralNetConfiguration.Builder()
+             .seed(5).updater(Sgd(0.05)).list()
+             .layer(DenseLayer(nOut=16, activation="tanh")))
+        for i in range(4):
+            b = b.layer(DenseLayer(nOut=16,
+                                   activation="relu" if i % 2 else "tanh"))
+        conf = (b.layer(OutputLayer(nOut=3, activation="softmax"))
+                .setInputType(InputType.feedForward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        with pytest.raises(ValueError, match="identical"):
+            partition_stages(net.layers, net._params, 4)
+
+    def test_pipeline_applies_constraints(self):
+        """A constrained net must keep its weight norms bounded under
+        PipelineParallel just like under net.fit()."""
+        from deeplearning4j_tpu.nn import MaxNormConstraint
+        from deeplearning4j_tpu.parallel import PipelineParallel
+
+        x, y, _ = _data(64)
+        b = (NeuralNetConfiguration.Builder()
+             .seed(5).updater(Sgd(0.5)).activation("tanh")
+             .constrainWeights(MaxNormConstraint(0.3)).list()
+             .layer(DenseLayer(nOut=16)))
+        for _ in range(4):
+            b = b.layer(DenseLayer(nOut=16))
+        conf = (b.layer(OutputLayer(nOut=3, activation="softmax"))
+                .setInputType(InputType.feedForward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        pp = PipelineParallel(net, build_mesh({"pipe": 4}), n_microbatches=4)
+        for _ in range(5):
+            pp.fit(x, y)
+        for p in net._params:
+            norms = np.sqrt((np.asarray(p["W"]) ** 2).sum(0))
+            assert np.all(norms <= 0.3 + 1e-4)
