@@ -629,3 +629,10 @@ def _rgb_to_hsv(x):
                             (r - g) / (d + 1e-12) + 4))) / 6.0
     s = jnp.where(mx == 0, 0.0, d / (mx + 1e-12))
     return jnp.stack([h, s, mx], axis=-1)
+
+
+# control-flow sentinels: registered so SameDiff._op accepts the names;
+# execution is dispatched specially by SameDiff._run_graph (the bodies are
+# sub-SameDiff graphs lowered to lax.cond / lax.while_loop / masked scan)
+OPS["if_cond"] = None
+OPS["while_loop"] = None

@@ -353,6 +353,98 @@ class SameDiff:
         self._loss_vars = [n.name if isinstance(n, SDVariable) else n
                            for n in names]
 
+    # ---------- control flow (reference: nd4j-autodiff If / While ops) ----
+    def ifCond(self, pred, trueBody, falseBody, inputs=(), nOut=1, name=None):
+        """Conditional subgraph (reference: SameDiff.ifCond / the If op).
+
+        pred: scalar SDVariable. trueBody/falseBody: ``lambda sd, *vars:
+        SDVariable`` (or tuple of them) built on a fresh sub-SameDiff whose
+        placeholders mirror ``inputs``. Lowered to ``lax.cond`` — both
+        branches compile into the single XLA computation, one executes.
+        Fully differentiable (jax.grad flows through lax.cond)."""
+        ins = [self._lift(pred)] + [self._lift(v) for v in inputs]
+        return self._op("if_cond", ins,
+                        kwargs={"trueBody": trueBody, "falseBody": falseBody},
+                        nOut=nOut, name=name)
+
+    def whileLoop(self, condBody, loopBody, loopVars, maxIterations=None,
+                  name=None):
+        """While loop over subgraphs (reference: SameDiff.whileLoop / the
+        While op). condBody(sd, *vars) -> scalar; loopBody(sd, *vars) ->
+        updated vars (same structure as ``loopVars``).
+
+        maxIterations=None lowers to ``lax.while_loop`` — a true dynamic
+        trip count, inference-only (reverse-mode AD through an unbounded
+        while is impossible). With maxIterations=N it lowers to a bounded
+        ``lax.scan`` whose body is masked by the predicate — the TPU-
+        idiomatic differentiable form: the EFFECTIVE iteration count stays
+        data-dependent while the compiled program is static, so the loop
+        trains under jit."""
+        ins = [self._lift(v) for v in loopVars]
+        return self._op("while_loop", ins,
+                        kwargs={"condBody": condBody, "loopBody": loopBody,
+                                "maxIterations": maxIterations},
+                        nOut=len(ins), name=name)
+
+    # aliases in jax idiom
+    cond = ifCond
+    while_loop = whileLoop
+
+    @staticmethod
+    def _subgraph_fn(build_fn, args):
+        """Build `build_fn` as a sub-SameDiff over placeholders shaped like
+        `args` (shapes are concrete at trace time) and return a plain
+        jnp-level function of the arg values."""
+        sub = SameDiff()
+        phs = [sub.placeHolder(f"in{i}", jnp.asarray(a).dtype,
+                               *jnp.asarray(a).shape)
+               for i, a in enumerate(args)]
+        out = build_fn(sub, *phs)
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        names = [o.name for o in outs]
+
+        def f(*vals):
+            env = sub._base_env()
+            for ph, v in zip(phs, vals):
+                env[ph.name] = v
+            r = sub._run_graph(env, names)
+            return [r[n] for n in names]
+
+        return f
+
+    def _exec_if_cond(self, op, env):
+        pred, *args = [env[n] for n in op.inputs]
+        true_f = self._subgraph_fn(op.kwargs["trueBody"], args)
+        false_f = self._subgraph_fn(op.kwargs["falseBody"], args)
+        res = jax.lax.cond(
+            jnp.asarray(pred).reshape(()).astype(bool),
+            lambda a: tuple(true_f(*a)),
+            lambda a: tuple(false_f(*a)),
+            tuple(args))
+        return res[0] if len(op.outputs) == 1 else res
+
+    def _exec_while_loop(self, op, env):
+        args = tuple(env[n] for n in op.inputs)
+        cond_f = self._subgraph_fn(op.kwargs["condBody"], args)
+        body_f = self._subgraph_fn(op.kwargs["loopBody"], args)
+        max_it = op.kwargs["maxIterations"]
+
+        def pred_of(vs):
+            return jnp.asarray(cond_f(*vs)[0]).reshape(()).astype(bool)
+
+        if max_it is None:
+            res = jax.lax.while_loop(pred_of,
+                                     lambda vs: tuple(body_f(*vs)), args)
+        else:
+            def scan_body(vs, _):
+                p = pred_of(vs)
+                new = body_f(*vs)
+                return tuple(jnp.where(p, n, v)
+                             for n, v in zip(new, vs)), None
+
+            res, _ = jax.lax.scan(scan_body, args, None, length=int(max_it))
+        return res[0] if len(op.outputs) == 1 else res
+
     # ---------- trace / execution ----------
     def _slice_for(self, out_names):
         """Backward slice: op indices needed to compute out_names, in order."""
@@ -376,6 +468,18 @@ class SameDiff:
         mode + a per-step PRNG key into stochastic ops (dropout)."""
         for i in self._slice_for(out_names):
             op = self._ops[i]
+            if op.opName == "if_cond":
+                res = self._exec_if_cond(op, env)
+                for n, r in zip(op.outputs, res if len(op.outputs) > 1
+                                else [res]):
+                    env[n] = r
+                continue
+            if op.opName == "while_loop":
+                res = self._exec_while_loop(op, env)
+                for n, r in zip(op.outputs, res if len(op.outputs) > 1
+                                else [res]):
+                    env[n] = r
+                continue
             args = [env[n] for n in op.inputs]
             kwargs = op.kwargs
             if op.opName == "dropout":

@@ -108,12 +108,79 @@ def _synthetic_digits(n: int, classes: int, hw: int, channels: int,
     return (out * 255).astype(np.uint8), labels
 
 
+_MNIST_MIRRORS = (
+    # reference: MnistFetcher downloads from these well-known hosts
+    "https://ossci-datasets.s3.amazonaws.com/mnist/",
+    "https://storage.googleapis.com/cvdf-datasets/mnist/",
+    "http://yann.lecun.com/exdb/mnist/",
+)
+_MNIST_FILES = ("train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz",
+                "t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz")
+
+
+def fetch_mnist(timeout: float = 15.0) -> bool:
+    """Fetch-or-cache real MNIST into ``$DL4J_TPU_DATA_DIR/mnist``
+    (reference: base.MnistFetcher). Returns True when the four idx files
+    are present afterwards (already cached, or downloaded now). Failure is
+    LOUD (warning naming every mirror tried), never an exception —
+    air-gapped hosts fall back to synthetic data visibly."""
+    import warnings
+
+    base = _data_dir() / "mnist"
+    base.mkdir(parents=True, exist_ok=True)
+
+    def have_all():
+        return all(
+            _find_idx(base, [f.replace(".gz", "")]) is not None
+            for f in _MNIST_FILES)
+
+    if have_all():
+        return True
+    import urllib.request
+
+    errors = []
+    for f in _MNIST_FILES:
+        if _find_idx(base, [f.replace(".gz", "")]) is not None:
+            continue
+        ok = False
+        for mirror in _MNIST_MIRRORS:
+            tmp = base / (f + ".part")
+            try:
+                # write to a temp name and rename only on success so an
+                # interrupted download can never poison the cache
+                with urllib.request.urlopen(mirror + f,
+                                            timeout=timeout) as resp, \
+                        open(tmp, "wb") as out:
+                    while True:
+                        chunk = resp.read(1 << 20)
+                        if not chunk:
+                            break
+                        out.write(chunk)
+                tmp.rename(base / f)
+                ok = True
+                break
+            except Exception as e:  # per-mirror: keep trying
+                errors.append(f"{mirror}{f}: {type(e).__name__}")
+                tmp.unlink(missing_ok=True)
+        if not ok:
+            break
+    if not have_all():
+        warnings.warn(
+            "Real MNIST could not be fetched (no network egress?); tried "
+            + "; ".join(errors[:6])
+            + f". Drop the idx files into {base} to use real data — "
+            "synthetic digits will be used instead.", stacklevel=2)
+        return False
+    return True
+
+
 class MnistDataSetIterator(DataSetIterator):
     """Reference: MnistDataSetIterator — features [B, 784] float32 in [0, 1]
     (or [B, 1, 28, 28] with ``reshapeToCnn=True``), one-hot labels [B, 10].
 
     Looks for idx files (train-images-idx3-ubyte[.gz] etc.) under
-    ``$DL4J_TPU_DATA_DIR/mnist``; synthesises digits otherwise."""
+    ``$DL4J_TPU_DATA_DIR/mnist`` (fetch_mnist() downloads and caches them
+    when the host has egress); synthesises digits otherwise — loudly."""
 
     NUM_CLASSES = 10
 

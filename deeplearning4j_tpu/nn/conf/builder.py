@@ -271,25 +271,29 @@ class NeuralNetConfiguration:
             self._d["dropOut"] = v if not isinstance(v, (int, float)) else float(v)
             return self
 
+        def _add_constraints(self, constraints, weights, biases):
+            import copy
+
+            # configured COPIES: mutating the caller's instances would
+            # corrupt a constraint object shared between builders
+            cs = []
+            for c in constraints:
+                c = copy.copy(c)
+                c.applyToWeights, c.applyToBiases = weights, biases
+                cs.append(c)
+            self._d["constraints"] = (self._d.get("constraints") or []) + cs
+            return self
+
         def constrainWeights(self, *constraints):
             """Apply constraints to every layer's weights after each update
             (reference: NeuralNetConfiguration.Builder.constrainWeights)."""
-            for c in constraints:
-                c.applyToWeights, c.applyToBiases = True, False
-            self._d["constraints"] = (self._d.get("constraints") or []) + list(constraints)
-            return self
+            return self._add_constraints(constraints, True, False)
 
         def constrainBias(self, *constraints):
-            for c in constraints:
-                c.applyToWeights, c.applyToBiases = False, True
-            self._d["constraints"] = (self._d.get("constraints") or []) + list(constraints)
-            return self
+            return self._add_constraints(constraints, False, True)
 
         def constrainAllParameters(self, *constraints):
-            for c in constraints:
-                c.applyToWeights = c.applyToBiases = True
-            self._d["constraints"] = (self._d.get("constraints") or []) + list(constraints)
-            return self
+            return self._add_constraints(constraints, True, True)
 
         def dataType(self, dt):
             self._d["dataType"] = DataType.from_dtype(dt) if not isinstance(dt, DataType) else dt

@@ -872,6 +872,11 @@ class SpaceToDepth(Layer):
     def __init__(self, blocks=2, dataFormat="NCHW", **kw):
         super().__init__(**kw)
         self.blocks = int(blocks)
+        if str(dataFormat).upper() != "NCHW":
+            raise ValueError(
+                "SpaceToDepth API data format is NCHW (the framework "
+                "transposes to NHWC internally at the input boundary); "
+                f"got dataFormat={dataFormat!r}")
 
     def hasParams(self):
         return False

@@ -76,7 +76,8 @@ class FineTuneConfiguration:
             return self
 
         def dropOut(self, v):
-            self._d["dropOut"] = float(v)
+            # float (retain prob) or an nn.conf.dropout.IDropout strategy
+            self._d["dropOut"] = v if not isinstance(v, (int, float)) else float(v)
             return self
 
         def build(self):
