@@ -391,31 +391,40 @@ class SameDiff:
     while_loop = whileLoop
 
     @staticmethod
-    def _subgraph_fn(build_fn, args):
+    def _subgraph_fn(build_fn, args, train=False, rng=None, n_expected=None,
+                     what=""):
         """Build `build_fn` as a sub-SameDiff over placeholders shaped like
         `args` (shapes are concrete at trace time) and return a plain
-        jnp-level function of the arg values."""
+        jnp-level function of the arg values. train/rng thread the outer
+        training mode into stochastic ops inside the body."""
         sub = SameDiff()
         phs = [sub.placeHolder(f"in{i}", jnp.asarray(a).dtype,
                                *jnp.asarray(a).shape)
                for i, a in enumerate(args)]
         out = build_fn(sub, *phs)
         outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        if n_expected is not None and len(outs) != n_expected:
+            raise ValueError(
+                f"{what} returned {len(outs)} output(s) but {n_expected} "
+                f"were declared (nOut / len(loopVars))")
         names = [o.name for o in outs]
 
         def f(*vals):
             env = sub._base_env()
             for ph, v in zip(phs, vals):
                 env[ph.name] = v
-            r = sub._run_graph(env, names)
+            r = sub._run_graph(env, names, train=train, rng=rng)
             return [r[n] for n in names]
 
         return f
 
-    def _exec_if_cond(self, op, env):
+    def _exec_if_cond(self, op, env, train=False, rng=None):
         pred, *args = [env[n] for n in op.inputs]
-        true_f = self._subgraph_fn(op.kwargs["trueBody"], args)
-        false_f = self._subgraph_fn(op.kwargs["falseBody"], args)
+        no = len(op.outputs)
+        true_f = self._subgraph_fn(op.kwargs["trueBody"], args, train, rng,
+                                   no, "ifCond trueBody")
+        false_f = self._subgraph_fn(op.kwargs["falseBody"], args, train, rng,
+                                    no, "ifCond falseBody")
         res = jax.lax.cond(
             jnp.asarray(pred).reshape(()).astype(bool),
             lambda a: tuple(true_f(*a)),
@@ -423,10 +432,12 @@ class SameDiff:
             tuple(args))
         return res[0] if len(op.outputs) == 1 else res
 
-    def _exec_while_loop(self, op, env):
+    def _exec_while_loop(self, op, env, train=False, rng=None):
         args = tuple(env[n] for n in op.inputs)
-        cond_f = self._subgraph_fn(op.kwargs["condBody"], args)
-        body_f = self._subgraph_fn(op.kwargs["loopBody"], args)
+        cond_f = self._subgraph_fn(op.kwargs["condBody"], args, train, rng,
+                                   None, "whileLoop condBody")
+        body_f = self._subgraph_fn(op.kwargs["loopBody"], args, train, rng,
+                                   len(op.outputs), "whileLoop loopBody")
         max_it = op.kwargs["maxIterations"]
 
         def pred_of(vs):
@@ -469,13 +480,13 @@ class SameDiff:
         for i in self._slice_for(out_names):
             op = self._ops[i]
             if op.opName == "if_cond":
-                res = self._exec_if_cond(op, env)
+                res = self._exec_if_cond(op, env, train, rng)
                 for n, r in zip(op.outputs, res if len(op.outputs) > 1
                                 else [res]):
                     env[n] = r
                 continue
             if op.opName == "while_loop":
-                res = self._exec_while_loop(op, env)
+                res = self._exec_while_loop(op, env, train, rng)
                 for n, r in zip(op.outputs, res if len(op.outputs) > 1
                                 else [res]):
                     env[n] = r
@@ -729,6 +740,13 @@ class SameDiff:
     def save(self, path, saveUpdaterState=False):
         """Graph → JSON, arrays → npz, both in one zip (reference:
         SameDiff.save FlatBuffers .fb; format here is portable npz+json)."""
+        for o in self._ops:
+            if o.opName in ("if_cond", "while_loop"):
+                raise NotImplementedError(
+                    "Graphs containing ifCond/whileLoop cannot be "
+                    "serialized yet: the branch/body subgraphs are Python "
+                    "callables. Rebuild the graph from code after loading "
+                    "instead.")
         graph = {
             "variables": [
                 {"name": n, "type": v.variableType,

@@ -146,8 +146,9 @@ def fetch_mnist(timeout: float = 15.0) -> bool:
         for mirror in _MNIST_MIRRORS:
             tmp = base / (f + ".part")
             try:
-                # write to a temp name and rename only on success so an
-                # interrupted download can never poison the cache
+                # write to a temp name and rename only after validating so
+                # neither an interrupted download nor a captive portal's
+                # HTML-with-200 can poison the cache
                 with urllib.request.urlopen(mirror + f,
                                             timeout=timeout) as resp, \
                         open(tmp, "wb") as out:
@@ -156,6 +157,15 @@ def fetch_mnist(timeout: float = 15.0) -> bool:
                         if not chunk:
                             break
                         out.write(chunk)
+                with open(tmp, "rb") as fh:
+                    if fh.read(2) != b"\x1f\x8b":
+                        raise ValueError("not gzip (captive portal?)")
+                import gzip
+
+                with gzip.open(tmp, "rb") as gz:  # idx magic: 0x0000 08/01
+                    head = gz.read(4)
+                    if len(head) != 4 or head[:2] != b"\x00\x00":
+                        raise ValueError("not an idx file")
                 tmp.rename(base / f)
                 ok = True
                 break

@@ -18,7 +18,8 @@ from deeplearning4j_tpu.nn import (
     LSTM, ElementWiseVertex, MergeVertex, Upsampling2D, ActivationLayer,
     Adam, Nesterovs, Sgd, WeightInit,
 )
-from deeplearning4j_tpu.nn.conf.layers import CnnLossLayer, LossLayer
+from deeplearning4j_tpu.nn.conf.layers import (CnnLossLayer, LossLayer,
+                                               SpaceToDepth, ZeroPaddingLayer)
 
 
 class ZooModel:
@@ -193,7 +194,35 @@ class ResNet50(ZooModel):
     ComputationGraph whose whole train step fuses to one XLA program; convs
     map to MXU with NHWC layouts; run with dataType=BFLOAT16 for the bf16
     compute path.
+
+    stemMode="space_to_depth" replaces the 7x7/s2 stem conv with the
+    MLPerf-style equivalent: pad 3 -> space-to-depth(2) -> 4x4/s1 conv on
+    12 channels. Mathematically identical function class (an 8x8-padded
+    7x7 kernel rearranged; see stem_weights_to_s2d for the exact map) but
+    the MXU sees 12 input channels instead of 3 and no strided window.
     """
+
+    def __init__(self, stemMode="standard", **kw):
+        super().__init__(**kw)
+        if stemMode not in ("standard", "space_to_depth"):
+            raise ValueError(f"unknown stemMode {stemMode!r}")
+        self.stemMode = stemMode
+
+    @staticmethod
+    def stem_weights_to_s2d(W):
+        """[7,7,C,O] standard conv1 weights -> [4,4,4*C,O] space-to-depth
+        stem weights computing the SAME function (zero-pad to 8x8, then
+        regroup 2x2 pixel blocks into channels in SpaceToDepth's
+        (s, t, c) channel order)."""
+        import numpy as _np
+
+        W = _np.asarray(W)
+        C, O = W.shape[2], W.shape[3]
+        W8 = _np.zeros((8, 8, C, O), W.dtype)
+        W8[:7, :7] = W
+        # [8,8,C,O] -> [p,s,q,t,C,O] -> [p,q,s,t,C,O] -> [4,4,4C,O]
+        W8 = W8.reshape(4, 2, 4, 2, C, O).transpose(0, 2, 1, 3, 4, 5)
+        return W8.reshape(4, 4, 4 * C, O)
 
     def conf(self):
         c, h, w = self.inputShape
@@ -204,9 +233,17 @@ class ResNet50(ZooModel):
              .dataType(self.dataType)
              .graphBuilder()
              .addInputs("input"))
-        g.addLayer("conv1", ConvolutionLayer(nOut=64, kernelSize=(7, 7), stride=(2, 2),
-                                             padding=(3, 3), activation="identity",
-                                             hasBias=False), "input")
+        if self.stemMode == "space_to_depth":
+            g.addLayer("pad1", ZeroPaddingLayer(padding=(3, 3)), "input")
+            g.addLayer("s2d", SpaceToDepth(blocks=2), "pad1")
+            g.addLayer("conv1", ConvolutionLayer(nOut=64, kernelSize=(4, 4),
+                                                 stride=(1, 1), padding=(0, 0),
+                                                 activation="identity",
+                                                 hasBias=False), "s2d")
+        else:
+            g.addLayer("conv1", ConvolutionLayer(nOut=64, kernelSize=(7, 7), stride=(2, 2),
+                                                 padding=(3, 3), activation="identity",
+                                                 hasBias=False), "input")
         g.addLayer("bn1", BatchNormalization(activation="relu"), "conv1")
         g.addLayer("pool1", SubsamplingLayer(poolingType="max", kernelSize=(3, 3),
                                              stride=(2, 2), padding=(1, 1)), "bn1")

@@ -323,3 +323,30 @@ class TestControlFlow:
         assert hist[-1] < 0.05 * hist[0], f"loss {hist[0]} -> {hist[-1]}"
         w_fit = float(sd.getVariable("w").getArr().toNumpy())
         assert abs(w_fit - w_true) < 0.1, f"w learned {w_fit} vs {w_true}"
+
+    def test_dropout_inside_cond_respects_train_mode(self):
+        """Stochastic ops inside control-flow bodies must see the outer
+        train/rng: dropout in a branch is active during training and
+        identity at inference."""
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", jnp.float32, 1000)
+        p = sd.placeHolder("p", jnp.float32)
+        out = sd.ifCond(p, lambda s, a: s.nn.dropout(a, 0.5),
+                        lambda s, a: a, inputs=[x], name="cf")
+        xv = np.ones(1000, "float32")
+        env = dict(sd._base_env()); env.update({"x": xv, "p": np.float32(1)})
+        train_out = np.asarray(sd._run_graph(
+            env, ["cf"], train=True, rng=jax.random.key(7))["cf"])
+        env = dict(sd._base_env()); env.update({"x": xv, "p": np.float32(1)})
+        infer_out = np.asarray(sd._run_graph(env, ["cf"])["cf"])
+        assert (train_out == 0).mean() > 0.3, "dropout inactive in training"
+        np.testing.assert_allclose(infer_out, xv)
+
+    def test_if_cond_output_count_validated(self):
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", jnp.float32, 2)
+        p = sd.placeHolder("p", jnp.float32)
+        out = sd.ifCond(p, lambda s, a: (a, a * 2.0), lambda s, a: (a, a),
+                        inputs=[x], name="bad")  # nOut defaults to 1
+        with pytest.raises(ValueError, match="declared"):
+            sd.output({"x": np.ones(2, "float32"), "p": np.float32(1)}, [out])

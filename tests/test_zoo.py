@@ -200,3 +200,47 @@ class TestZooTailConvergence:
         # balances; judge convergence by the best loss reached
         assert min(losses) < 0.7 * losses[0], \
             f"yolo loss {losses[0]} -> best {min(losses)}"
+
+
+class TestSpaceToDepthStem:
+    def test_s2d_stem_exact_parity_with_standard(self):
+        """The space-to-depth stem with mapped weights computes EXACTLY the
+        standard 7x7/s2 stem's function (MLPerf conv1 rewrite)."""
+        from deeplearning4j_tpu.zoo import ResNet50
+
+        std = ResNet50(numClasses=4, inputShape=(3, 64, 64)).init()
+        s2d = ResNet50(numClasses=4, inputShape=(3, 64, 64),
+                       stemMode="space_to_depth").init()
+        # port every param across; conv1 gets the rearranged kernel
+        import jax.numpy as jnp
+
+        for name, p in std._params.items():
+            if name == "conv1":
+                s2d._params["conv1"]["W"] = jnp.asarray(
+                    ResNet50.stem_weights_to_s2d(p["W"]))
+            elif name in s2d._params:
+                s2d._params[name] = p
+        s2d._states = {n: (std._states[n] if n in std._states else s)
+                       for n, s in s2d._states.items()}
+        x = np.random.RandomState(0).rand(2, 3, 64, 64).astype("float32")
+        a = std.outputSingle(x).toNumpy()
+        b = s2d.outputSingle(x).toNumpy()
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+    def test_s2d_stem_trains(self):
+        from deeplearning4j_tpu.zoo import ResNet50
+        from deeplearning4j_tpu.nn import Adam
+
+        net = ResNet50(numClasses=3, inputShape=(3, 32, 32),
+                       stemMode="space_to_depth", updater=Adam(1e-4)).init()
+        rng = np.random.RandomState(0)
+        x = rng.rand(2, 3, 32, 32).astype("float32")
+        y = np.eye(3, dtype="float32")[rng.randint(0, 3, 2)]
+        net.fit(x, y)
+        assert np.isfinite(net.score())
+
+    def test_bad_stem_mode(self):
+        from deeplearning4j_tpu.zoo import ResNet50
+
+        with pytest.raises(ValueError, match="stemMode"):
+            ResNet50(stemMode="nope")
