@@ -78,6 +78,13 @@ def _input_type_from_shape(shape):
     (N,) → feedForward. Rank is judged with None dims INCLUDED — (None, F)
     is a variable-length sequence, not flat features."""
     dims = list(shape)
+    if len(dims) == 4:
+        d, h, w, c = dims
+        if None in (d, h, w, c):
+            raise UnsupportedKerasConfigurationException(
+                f"variable spatial dims not supported for 3D-CNN input "
+                f"{shape} (XLA needs static shapes)")
+        return InputType.convolutional3D(d, h, w, c)
     if len(dims) == 3:
         h, w, c = dims
         if h is None or w is None or c is None:
@@ -187,13 +194,75 @@ def _convert_layer(spec: _KerasLayerSpec, is_last: bool):
             stride=_pair(cfg.get("strides") or cfg.get("pool_size", 2)),
             convolutionMode=_conv_mode(cfg.get("padding", "valid")), name=name)
     if cn in ("GlobalMaxPooling2D", "GlobalAveragePooling2D",
-              "GlobalMaxPooling1D", "GlobalAveragePooling1D"):
+              "GlobalMaxPooling1D", "GlobalAveragePooling1D",
+              "GlobalMaxPooling3D", "GlobalAveragePooling3D"):
         return L.GlobalPoolingLayer(
             poolingType="max" if "Max" in cn else "avg", name=name)
     if cn == "Flatten":
         return None  # our shape inference auto-inserts CnnToFeedForward
     if cn == "Dropout":
         return L.DropoutLayer(dropOut=1.0 - float(cfg.get("rate", 0.5)), name=name)
+    if cn in ("SpatialDropout1D", "SpatialDropout2D", "SpatialDropout3D"):
+        from deeplearning4j_tpu.nn.conf.dropout import SpatialDropout
+        return L.DropoutLayer(
+            dropOut=SpatialDropout(1.0 - float(cfg.get("rate", 0.5))), name=name)
+    if cn == "GaussianDropout":
+        from deeplearning4j_tpu.nn.conf.dropout import GaussianDropout
+        return L.DropoutLayer(dropOut=GaussianDropout(float(cfg.get("rate", 0.5))),
+                              name=name)
+    if cn == "GaussianNoise":
+        from deeplearning4j_tpu.nn.conf.dropout import GaussianNoise
+        return L.DropoutLayer(dropOut=GaussianNoise(float(cfg.get("stddev", 0.1))),
+                              name=name)
+    if cn == "AlphaDropout":
+        from deeplearning4j_tpu.nn.conf.dropout import AlphaDropout
+        return L.DropoutLayer(dropOut=AlphaDropout(1.0 - float(cfg.get("rate", 0.5))),
+                              name=name)
+    if cn == "PReLU":
+        # Keras shared_axes are 1-based over the NHWC input's (H, W, C) =
+        # (1, 2, 3); native sharedAxes use the reference's (C, H, W) order.
+        # Only the 2D-CNN axis set is supported (a 3D-CNN PReLU would need
+        # NDHWC axes 1-4).
+        shared = cfg.get("shared_axes") or ()
+        if any(int(a) not in (1, 2, 3) for a in shared):
+            raise UnsupportedKerasConfigurationException(
+                f"PReLU shared_axes {list(shared)} not supported "
+                f"(only 2D-CNN axes 1-3; layer '{name}')")
+        mapped = tuple({1: 2, 2: 3, 3: 1}[int(a)] for a in shared) or None
+        return L.PReLULayer(sharedAxes=mapped, name=name)
+    if cn == "Conv3D":
+        t3 = lambda v: (v, v, v) if isinstance(v, int) else tuple(v)
+        return L.Convolution3D(
+            nOut=int(cfg["filters"]), kernelSize=t3(cfg["kernel_size"]),
+            stride=t3(cfg.get("strides", 1)),
+            dilation=t3(cfg.get("dilation_rate", 1)),
+            convolutionMode=_conv_mode(cfg.get("padding", "valid")),
+            hasBias=bool(cfg.get("use_bias", True)),
+            activation=_act(cfg.get("activation")), name=name)
+    if cn == "SeparableConv2D":
+        return L.SeparableConvolution2D(
+            nOut=int(cfg["filters"]),
+            depthMultiplier=int(cfg.get("depth_multiplier", 1)),
+            kernelSize=_pair(cfg["kernel_size"]),
+            stride=_pair(cfg.get("strides", 1)),
+            dilation=_pair(cfg.get("dilation_rate", 1)),
+            convolutionMode=_conv_mode(cfg.get("padding", "valid")),
+            hasBias=bool(cfg.get("use_bias", True)),
+            activation=_act(cfg.get("activation")), name=name)
+    if cn == "Cropping2D":
+        crop = cfg.get("cropping", 0)
+        if isinstance(crop, int):
+            crop = (crop, crop, crop, crop)
+        elif crop and isinstance(crop[0], (list, tuple)):
+            (t, b), (l, r) = crop
+            crop = (t, b, l, r)
+        return L.Cropping2D(cropping=tuple(int(v) for v in crop), name=name)
+    if cn == "UpSampling1D":
+        return L.Upsampling1D(size=int(cfg.get("size", 2)), name=name)
+    if cn == "UpSampling3D":
+        s = cfg.get("size", 2)
+        return L.Upsampling3D(size=s if isinstance(s, int) else tuple(s),
+                              name=name)
     if cn == "Activation":
         return L.ActivationLayer(activation=_act(cfg.get("activation")), name=name)
     if cn == "BatchNormalization":
@@ -290,7 +359,20 @@ def _apply_weights(layer, weights, params, state):
         if len(weights) > 1 and "b" in p:
             put("b", weights[1])
         return p, s
-    if isinstance(layer, (L.DenseLayer, L.BaseOutputLayer, L.ConvolutionLayer)) \
+    if isinstance(layer, L.SeparableConvolution2D):
+        # Keras: depthwise (kh,kw,nIn,mult) + pointwise (1,1,nIn*mult,out)
+        k = np.asarray(weights[0])
+        kh, kw, nin, mult = k.shape
+        put("W", k.reshape(kh, kw, 1, nin * mult))
+        put("pW", weights[1])
+        if len(weights) > 2 and "b" in p:
+            put("b", weights[2])
+        return p, s
+    if isinstance(layer, L.PReLULayer):
+        put("alpha", weights[0])
+        return p, s
+    if isinstance(layer, (L.DenseLayer, L.BaseOutputLayer, L.ConvolutionLayer,
+                          L.Convolution3D)) \
             and not isinstance(layer, L.Convolution1DLayer):
         put("W", weights[0])
         if len(weights) > 1 and "b" in p:
@@ -426,9 +508,12 @@ class KerasModelImport:
 
         lb = NeuralNetConfiguration.Builder().list()
         native_specs = []  # (spec, native_layer) for weight mapping
+        _NOT_OUTPUT = ("InputLayer", "Flatten", "Dropout", "Activation",
+                       "SpatialDropout1D", "SpatialDropout2D",
+                       "SpatialDropout3D", "GaussianDropout", "GaussianNoise",
+                       "AlphaDropout")
         last_real = max((i for i, sp in enumerate(specs)
-                         if sp.className not in ("InputLayer", "Flatten", "Dropout",
-                                                 "Activation")),
+                         if sp.className not in _NOT_OUTPUT),
                         default=len(specs) - 1)
         # fold a trailing Activation into the output layer: Dense(10) +
         # Activation('softmax') must train as softmax+mcxent, not as an
@@ -438,6 +523,18 @@ class KerasModelImport:
             if specs[j].className == "Activation":
                 specs[last_real].config["activation"] = \
                     specs[j].config.get("activation")
+                folded.add(j)
+            elif specs[j].className in _NOT_OUTPUT and \
+                    specs[j].className != "InputLayer":
+                # trailing train-time noise after the output head has no
+                # DL4J representation (loss attaches to the output layer);
+                # inference is unchanged, so drop it loudly
+                import warnings
+
+                warnings.warn(
+                    f"dropping trailing {specs[j].className} layer "
+                    f"'{specs[j].name}' (after the output head; "
+                    "inference-equivalent)", stacklevel=2)
                 folded.add(j)
         for i, sp in enumerate(specs):
             if i in folded:

@@ -35,8 +35,9 @@ class Dropout(IDropout):
 
 
 class GaussianDropout(IDropout):
-    """Multiplicative N(1, sqrt((1-rate)/rate)) noise (reference:
-    GaussianDropout, Srivastava et al. §10)."""
+    """Multiplicative N(1, sqrt(rate/(1-rate))) noise, `rate` being the
+    DROP rate exactly like the reference's GaussianDropout(double rate)
+    (and Keras) — Srivastava et al. §10."""
 
     def __init__(self, rate=0.5):
         if not 0.0 < rate < 1.0:
@@ -44,7 +45,7 @@ class GaussianDropout(IDropout):
         self.rate = float(rate)
 
     def apply(self, x, key):
-        std = ((1.0 - self.rate) / self.rate) ** 0.5
+        std = (self.rate / (1.0 - self.rate)) ** 0.5
         return x * (1.0 + std * jax.random.normal(key, x.shape, x.dtype))
 
 

