@@ -8,6 +8,7 @@ from deeplearning4j_tpu.evaluation.evaluation import Evaluation
 from deeplearning4j_tpu.evaluation.regression import RegressionEvaluation
 from deeplearning4j_tpu.evaluation.roc import ROC, ROCMultiClass, ROCBinary
 from deeplearning4j_tpu.evaluation.binary import EvaluationBinary
+from deeplearning4j_tpu.evaluation.calibration import EvaluationCalibration
 
 __all__ = ["Evaluation", "RegressionEvaluation", "ROC", "ROCMultiClass",
-           "ROCBinary", "EvaluationBinary"]
+           "ROCBinary", "EvaluationBinary", "EvaluationCalibration"]

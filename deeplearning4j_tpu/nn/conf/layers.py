@@ -1075,6 +1075,13 @@ class PReLULayer(Layer):
                 m = {1: 2, 2: 0, 3: 1}  # ref axis -> HWC index
                 for a in self.sharedAxes:
                     shape[m[int(a)]] = 1
+        elif inputType.kind == InputType.CNN3D:
+            if self.sharedAxes:
+                raise ValueError(
+                    "PReLULayer sharedAxes are defined for 2D CNN input "
+                    "only; 3D input gets a full per-element alpha")
+            shape = [inputType.depth, inputType.height, inputType.width,
+                     inputType.channels]
         elif inputType.kind == InputType.RNN:
             shape = [inputType.size, 1]
         else:

@@ -348,6 +348,75 @@ class ComputationGraph:
 
         run_tbptt(self, T, self.conf.tbpttFwdLength, jit_call)
 
+    # ----- unsupervised layerwise pretraining (VAE etc.) --------------
+    def pretrain(self, iterator, epochs=1):
+        """Layerwise unsupervised pretraining of every pretrainable layer
+        (reference: ComputationGraph.pretrain(DataSetIterator))."""
+        for name in self._layer_names:
+            if getattr(self.conf.nodes[name].payload, "pretrainable", False):
+                self.pretrainLayer(name, iterator, epochs)
+        return self
+
+    def pretrainLayer(self, layerName, data, epochs=1):
+        """Unsupervised pretraining of one named layer against its own
+        pretrain_loss, fed by the frozen forward of its ancestors
+        (reference: ComputationGraph.pretrainLayer)."""
+        self._require_init()
+        node = self.conf.nodes[layerName]
+        layer = node.payload
+        if not getattr(layer, "pretrainable", False):
+            raise ValueError(f"Layer '{layerName}' "
+                             f"({type(layer).__name__}) is not pretrainable")
+        src = node.inputs[0]
+        upd = self._updaters[layerName]
+
+        def feed(inputs):
+            acts, _, _ = self._run_graph(
+                self._params, self._strip_carries(self._states), inputs,
+                False, None, None)
+            h = acts[src]
+            if node.preprocessor is not None:
+                h = node.preprocessor.preProcess(h)
+            return h
+
+        @jax.jit
+        def pre_step(p, us, it, inputs, key):
+            loss, g = jax.value_and_grad(
+                lambda p_: layer.pretrain_loss(self._cast_params(p_),
+                                               feed(inputs), key))(p)
+            d, us = upd.apply(g, us, it)
+            p = jax.tree_util.tree_map(
+                lambda a, b: (a - b).astype(a.dtype), p, d)
+            return p, us, loss
+
+        from deeplearning4j_tpu.data.dataset import DataSet
+
+        p, us = self._params[layerName], self._upd_states[layerName]
+        loss = float("nan")
+
+        def one(features, p, us):
+            inputs = self._coerce_inputs(features)
+            key = jax.random.fold_in(
+                jax.random.key(self.conf.seed ^ 0xE1B0), self._iteration)
+            p, us, loss = pre_step(p, us,
+                                   jnp.asarray(self._iteration, jnp.int32),
+                                   inputs, key)
+            self._iteration += 1
+            return p, us, loss
+
+        for _ in range(epochs):
+            if isinstance(data, DataSet):
+                p, us, loss = one(data.getFeatures(), p, us)
+            elif hasattr(data, "hasNext"):
+                data.reset()
+                while data.hasNext():
+                    p, us, loss = one(data.next().getFeatures(), p, us)
+            else:
+                p, us, loss = one(data, p, us)
+        self._params[layerName], self._upd_states[layerName] = p, us
+        self._score = float(loss)
+        return self
+
     def output(self, *features):
         self._require_init()
         inputs = self._coerce_inputs(features if len(features) > 1 else features[0])

@@ -15,6 +15,9 @@ from deeplearning4j_tpu.parallel.trainer import (
 from deeplearning4j_tpu.parallel.sharding import shard_params, replicate_params, spec_for_param
 from deeplearning4j_tpu.parallel.sequence import ring_attention, ulysses_attention
 from deeplearning4j_tpu.parallel.pipeline import PipelineParallel, partition_stages
+from deeplearning4j_tpu.parallel.multihost import (
+    initialize as initializeMultiHost, hybrid_mesh, is_coordinator, num_hosts,
+)
 
 __all__ = [
     "build_mesh", "data_parallel_mesh", "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS",
@@ -22,4 +25,5 @@ __all__ = [
     "ParameterAveragingTrainingMaster", "shard_params",
     "replicate_params", "spec_for_param", "ring_attention", "ulysses_attention",
     "PipelineParallel", "partition_stages",
+    "initializeMultiHost", "hybrid_mesh", "is_coordinator", "num_hosts",
 ]

@@ -1,0 +1,90 @@
+"""Multi-host (pod / pod-slice) bootstrap.
+
+Reference: the Spark side of the reference — SharedTrainingMaster's
+cluster bootstrap (driver + executors discovering each other over
+Aeron/Spark) — and its NCCL/MPI transports. TPU-native design: hosts
+join one JAX distributed runtime (`jax.distributed.initialize`, the
+PJRT-level analog of the Spark driver handshake), after which
+`jax.devices()` spans every chip in the pod and ALL the single-host
+machinery in this package (ParallelWrapper, SharedTrainingMaster,
+ParameterAveragingTrainingMaster, PipelineParallel, ring attention)
+works unchanged — XLA routes collectives over ICI within a slice and
+DCN across slices.
+
+The one multi-host-specific concern is AXIS PLACEMENT: axes that
+communicate every step (model/sequence parallel) must ride ICI, and
+only the gradient/averaging axis should cross DCN. `hybrid_mesh`
+encodes that: DCN axes outermost over slices, ICI axes innermost within
+a slice (jax mesh_utils.create_hybrid_device_mesh ordering).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu.parallel import mesh as _mesh
+
+
+def initialize(coordinator_address=None, num_processes=None,
+               process_id=None, **kw):
+    """Join this host to the pod's distributed runtime (reference: the
+    Spark/Aeron cluster join). On Cloud TPU the arguments are
+    auto-detected from the environment; pass them explicitly elsewhere.
+    Call once, before any jax computation, on EVERY host."""
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id, **kw)
+
+
+def is_coordinator() -> bool:
+    """True on the process that should write checkpoints/logs
+    (reference: the Spark driver role)."""
+    return jax.process_index() == 0
+
+
+def num_hosts() -> int:
+    return jax.process_count()
+
+
+def hybrid_mesh(dcn_axes: dict, ici_axes: dict, devices=None) -> Mesh:
+    """Mesh spanning pod slices: ``dcn_axes`` partition across slices
+    (cheap, infrequent communication — data parallel / parameter
+    averaging), ``ici_axes`` partition within a slice (model / sequence /
+    pipeline axes that talk every layer).
+
+    hybrid_mesh({"data": 4}, {"model": 4, "seq": 2}) on 4 slices of 8
+    chips -> Mesh("data"=4, "model"=4, "seq"=2) with every "model"/"seq"
+    group fully inside one slice.
+
+    With a single slice (or CPU test devices) this degrades to an
+    ordinary build_mesh over dcn+ici axes in that order."""
+    devices = list(devices if devices is not None else jax.devices())
+    dcn_total = int(np.prod(list(dcn_axes.values()))) if dcn_axes else 1
+    n_slices = len({getattr(d, "slice_index", 0) for d in devices})
+    names = tuple(list(dcn_axes) + list(ici_axes))
+    if n_slices <= 1:
+        if dcn_total > 1 and n_slices == 1 and len(devices) < dcn_total * int(
+                np.prod(list(ici_axes.values()) or [1])):
+            raise ValueError(
+                f"dcn axes {dcn_axes} need {dcn_total} slices; "
+                f"found {n_slices}")
+        return _mesh.build_mesh({**dcn_axes, **ici_axes}, devices)
+    from jax.experimental import mesh_utils
+
+    total = dcn_total * int(np.prod(list(ici_axes.values()) or [1]))
+    if total != len(devices):
+        raise ValueError(
+            f"hybrid mesh axes {dcn_axes} x {ici_axes} cover {total} "
+            f"devices but the pod has {len(devices)}; every in-slice chip "
+            "must be covered by an ici axis (add e.g. a 'model' or inner "
+            "'data' axis)")
+    # canonical usage: both shapes span the SAME combined axis list, with
+    # 1s where an axis doesn't partition that network level; the result's
+    # shape is their elementwise product, ici axes contiguous in-slice
+    ici_shape = tuple([1] * len(dcn_axes) + list(ici_axes.values()))
+    dcn_shape = tuple(list(dcn_axes.values()) + [1] * len(ici_axes))
+    arr = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=ici_shape, dcn_mesh_shape=dcn_shape, devices=devices)
+    return Mesh(arr, names)

@@ -264,3 +264,51 @@ class TestGraphTBPTT:
         # same layer inits come from different fold_in streams, so exact
         # equality is not expected — but both must converge equivalently
         assert abs(mln.score() - cg.score()) < 0.2
+
+
+class TestGraphPretrain:
+    """ComputationGraph.pretrain/pretrainLayer (reference parity with the
+    MultiLayerNetwork VAE pretraining path)."""
+
+    def test_vae_vertex_pretrains(self):
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           ComputationGraph,
+                                           VariationalAutoencoder,
+                                           OutputLayer, Adam)
+        import jax
+        import jax.numpy as jnp
+
+        conf = (NeuralNetConfiguration.Builder().seed(3).updater(Adam(5e-3))
+                .activation("tanh").graphBuilder()
+                .addInputs("in")
+                .addLayer("vae", VariationalAutoencoder(
+                    nOut=2, encoderLayerSizes=(16,), decoderLayerSizes=(16,)),
+                    "in")
+                .addLayer("out", OutputLayer(nOut=2, activation="softmax"), "vae")
+                .setOutputs("out")
+                .setInputTypes(InputType.feedForward(8)).build())
+        net = ComputationGraph(conf).init()
+        rng = np.random.RandomState(0)
+        x = np.concatenate([rng.randn(64, 8) * 0.3 + 2,
+                            rng.randn(64, 8) * 0.3 - 2]).astype("float32")
+        vae = conf.nodes["vae"].payload
+        key = jax.random.key(0)
+        l0 = float(vae.pretrain_loss(net._params["vae"], jnp.asarray(x), key))
+        net.pretrainLayer("vae", x, epochs=120)
+        l1 = float(vae.pretrain_loss(net._params["vae"], jnp.asarray(x), key))
+        assert l1 < l0 - 1.0, f"ELBO should improve: {l0} -> {l1}"
+
+    def test_pretrain_rejects_non_pretrainable(self):
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           ComputationGraph, DenseLayer,
+                                           OutputLayer, Sgd)
+
+        conf = (NeuralNetConfiguration.Builder().seed(1).updater(Sgd(0.1))
+                .graphBuilder().addInputs("in")
+                .addLayer("d", DenseLayer(nOut=4), "in")
+                .addLayer("out", OutputLayer(nOut=2), "d")
+                .setOutputs("out")
+                .setInputTypes(InputType.feedForward(3)).build())
+        net = ComputationGraph(conf).init()
+        with pytest.raises(ValueError, match="pretrainable"):
+            net.pretrainLayer("d", np.zeros((2, 3), "float32"))

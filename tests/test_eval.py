@@ -219,3 +219,59 @@ class TestResetLoudness:
             e.meanSquaredError(0)
         e.eval(np.ones((4, 2)), np.ones((4, 2)))
         assert e.meanSquaredError(0) == 0.0
+
+
+class TestEvaluationCalibration:
+    """Reference: org.nd4j.evaluation.classification.EvaluationCalibration."""
+
+    def test_perfectly_calibrated_predictions(self):
+        from deeplearning4j_tpu.evaluation import EvaluationCalibration
+
+        rng = np.random.RandomState(0)
+        n = 50000
+        p1 = rng.rand(n)
+        y1 = (rng.rand(n) < p1).astype("float32")  # labels drawn AT p => calibrated
+        preds = np.stack([1 - p1, p1], 1).astype("float32")
+        labels = np.stack([1 - y1, y1], 1)
+        ec = EvaluationCalibration()
+        ec.eval(labels, preds)
+        assert ec.expectedCalibrationError() < 0.02
+        meanp, freq = ec.getReliabilityDiagram(1)
+        valid = ~np.isnan(meanp)
+        np.testing.assert_allclose(meanp[valid], freq[valid], atol=0.05)
+
+    def test_overconfident_predictions_flagged(self):
+        from deeplearning4j_tpu.evaluation import EvaluationCalibration
+
+        rng = np.random.RandomState(1)
+        n = 20000
+        y1 = (rng.rand(n) < 0.5).astype("float32")  # truth is a coin flip
+        p1 = np.where(rng.rand(n) < 0.5, 0.95, 0.05)  # but model says 95/5
+        preds = np.stack([1 - p1, p1], 1).astype("float32")
+        labels = np.stack([1 - y1, y1], 1)
+        ec = EvaluationCalibration()
+        ec.eval(labels, preds)
+        assert ec.expectedCalibrationError() > 0.3
+
+    def test_histograms_and_stats(self):
+        from deeplearning4j_tpu.evaluation import EvaluationCalibration
+
+        preds = np.array([[0.9, 0.1], [0.2, 0.8]], "float32")
+        labels = np.array([[1.0, 0.0], [0.0, 1.0]], "float32")
+        ec = EvaluationCalibration(histogramNumBins=5)
+        ec.eval(labels, preds)
+        assert ec.getProbabilityHistogram(0).sum() == 2
+        assert ec.getResidualPlot().sum() == 4  # 2 examples x 2 classes
+        assert "ECE" in ec.stats()
+
+    def test_accumulates_and_resets(self):
+        from deeplearning4j_tpu.evaluation import EvaluationCalibration
+
+        preds = np.array([[0.7, 0.3]], "float32")
+        labels = np.array([[1.0, 0.0]], "float32")
+        ec = EvaluationCalibration()
+        ec.eval(labels, preds).eval(labels, preds)
+        assert ec.getProbabilityHistogram(0).sum() == 2
+        ec.reset()
+        ec.eval(labels, preds)
+        assert ec.getProbabilityHistogram(0).sum() == 1

@@ -443,3 +443,84 @@ class TestPipelineRegressions:
         for p in net._params:
             norms = np.sqrt((np.asarray(p["W"]) ** 2).sum(0))
             assert np.all(norms <= 0.3 + 1e-4)
+
+
+class TestMultiHost:
+    """Multi-host bootstrap plumbing (parallel/multihost.py). Real DCN
+    behavior needs a pod; here we certify the single-slice degradation,
+    axis ordering, and coordinator role on the virtual mesh."""
+
+    def test_hybrid_mesh_single_slice_fallback(self):
+        from deeplearning4j_tpu.parallel import hybrid_mesh
+
+        mesh = hybrid_mesh({"data": 2}, {"model": 4})
+        assert mesh.shape == {"data": 2, "model": 4}
+        # ici axis innermost: each model group is 4 contiguous devices
+        dev = np.array(mesh.devices)
+        assert dev.shape == (2, 4)
+
+    def test_hybrid_mesh_trains_dp(self):
+        from deeplearning4j_tpu.parallel import hybrid_mesh
+
+        x, y, _ = _data(64)
+        net = MultiLayerNetwork(_mlp()).init()
+        mesh = hybrid_mesh({"data": 8}, {})
+        pw = ParallelWrapper(net, mesh=mesh)
+        pw.fit(x, y)
+        assert np.isfinite(net.score())
+
+    def test_coordinator_and_host_count(self):
+        from deeplearning4j_tpu.parallel import is_coordinator, num_hosts
+
+        assert is_coordinator()  # single-process test runtime
+        assert num_hosts() == 1
+
+    def test_dcn_axes_without_slices_raises(self):
+        from deeplearning4j_tpu.parallel import hybrid_mesh
+
+        with pytest.raises(ValueError, match="devices|slices"):
+            hybrid_mesh({"data": 16}, {"model": 4})
+
+    def _fake_slices(self, n_slices, per_slice):
+        real = jax.devices()
+
+        class FakeDev:
+            def __init__(self, d, s, i):
+                self._d = d
+                self.slice_index = s
+                self.id = i
+                self.process_index = getattr(d, "process_index", 0)
+                self.platform = d.platform
+                self.device_kind = d.device_kind
+
+            def __getattr__(self, a):
+                return getattr(object.__getattribute__(self, "_d"), a)
+
+        return [FakeDev(real[i], i // per_slice, i)
+                for i in range(n_slices * per_slice)]
+
+    def test_hybrid_mesh_multi_slice_keeps_ici_in_slice(self):
+        """Simulated 2 slices x 4 devices: dcn axis spans slices, every
+        ici group stays inside one slice."""
+        from deeplearning4j_tpu.parallel import hybrid_mesh
+
+        devs = self._fake_slices(2, 4)
+        m = hybrid_mesh({"data": 2}, {"model": 4}, devices=devs)
+        assert m.shape == {"data": 2, "model": 4}
+        arr = np.array(m.devices, dtype=object)
+        for row in arr:
+            assert len({d.slice_index for d in row}) == 1
+
+    def test_hybrid_mesh_multi_slice_two_ici_axes(self):
+        from deeplearning4j_tpu.parallel import hybrid_mesh
+
+        devs = self._fake_slices(2, 4)
+        m = hybrid_mesh({"data": 2}, {"model": 2, "seq": 2}, devices=devs)
+        assert m.shape == {"data": 2, "model": 2, "seq": 2}
+
+    def test_hybrid_mesh_uncovered_devices_rejected(self):
+        from deeplearning4j_tpu.parallel import hybrid_mesh
+
+        devs = self._fake_slices(2, 4)
+        with pytest.raises(ValueError, match="cover"):
+            hybrid_mesh({"data": 2}, {}, devices=devs)
