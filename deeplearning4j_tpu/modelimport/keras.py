@@ -193,6 +193,20 @@ def _convert_layer(spec: _KerasLayerSpec, is_last: bool):
             kernelSize=_pair(cfg.get("pool_size", 2)),
             stride=_pair(cfg.get("strides") or cfg.get("pool_size", 2)),
             convolutionMode=_conv_mode(cfg.get("padding", "valid")), name=name)
+    if cn in ("MaxPooling1D", "AveragePooling1D"):
+        if str(cfg.get("padding", "valid")).lower() == "same":
+            raise UnsupportedKerasConfigurationException(
+                f"{cn} padding='same' not supported (layer '{name}'); "
+                "pad explicitly with ZeroPadding1D")
+        return L.Subsampling1DLayer(
+            poolingType="max" if cn.startswith("Max") else "avg",
+            kernelSize=cfg.get("pool_size", 2),
+            stride=cfg.get("strides") or cfg.get("pool_size", 2),
+            name=name)
+    if cn == "ZeroPadding1D":
+        return L.ZeroPadding1DLayer(padding=cfg.get("padding", 1), name=name)
+    if cn == "Cropping1D":
+        return L.Cropping1D(cropping=cfg.get("cropping", 0), name=name)
     if cn in ("GlobalMaxPooling2D", "GlobalAveragePooling2D",
               "GlobalMaxPooling1D", "GlobalAveragePooling1D",
               "GlobalMaxPooling3D", "GlobalAveragePooling3D"):

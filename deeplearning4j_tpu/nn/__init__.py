@@ -23,6 +23,8 @@ from deeplearning4j_tpu.nn.conf.layers import (
     Convolution3D, Cropping1D, Cropping3D, Upsampling1D, Upsampling3D,
     SpaceToDepth, SpaceToBatch, LocallyConnected1D, LocallyConnected2D,
     PReLULayer, CenterLossOutputLayer,
+    Subsampling1DLayer, ZeroPadding1DLayer, RepeatVector,
+    ElementWiseMultiplicationLayer, AutoEncoder,
 )
 from deeplearning4j_tpu.nn.conf.dropout import (
     Dropout, GaussianDropout, GaussianNoise, AlphaDropout, SpatialDropout,

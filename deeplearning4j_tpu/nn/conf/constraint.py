@@ -28,7 +28,7 @@ class BaseConstraint:
             # class centers / PReLU alpha: neither weight nor bias —
             # projecting them would corrupt their own dynamics
             return False
-        is_bias = name in ("b", "beta")
+        is_bias = name in ("b", "beta", "vb")  # vb: AutoEncoder visible bias
         return self.applyToBiases if is_bias else self.applyToWeights
 
     def apply(self, p):

@@ -124,8 +124,9 @@ class Layer:
 
     # params that are neither weights nor biases: never regularized or
     # constrained (reference: class centers and PReLU alpha have their own
-    # dynamics; l2 shrinkage would fight them)
-    _NON_WEIGHT_PARAMS = ("b", "beta", "centers", "alpha")
+    # dynamics; l2 shrinkage would fight them). "vb" is the AutoEncoder's
+    # decoder (visible) bias — a bias, not a weight.
+    _NON_WEIGHT_PARAMS = ("b", "beta", "centers", "alpha", "vb")
 
     def regularization(self, params):
         """Scalar l1/l2/weight-decay penalty for this layer's params."""
@@ -1143,3 +1144,152 @@ class CenterLossOutputLayer(BaseOutputLayer):
         center = 0.5 * self.lambda_ * jnp.mean(
             jnp.sum(jnp.square(feats - cy), axis=-1))
         return base + center
+
+
+# ======================================================================
+# Small sequence/utility layers (upstream long tail)
+# ======================================================================
+
+class Subsampling1DLayer(Layer):
+    """Max/avg pooling over the time axis of NCW data (reference:
+    conf.layers.Subsampling1DLayer)."""
+
+    def __init__(self, poolingType="max", kernelSize=2, stride=2, padding=0,
+                 **kw):
+        super().__init__(**kw)
+        one = lambda v: int(v[0] if isinstance(v, (tuple, list)) else v)
+        self.poolingType = poolingType
+        self.kernelSize = one(kernelSize)
+        self.stride = one(stride)
+        self.padding = one(padding)
+
+    def hasParams(self):
+        return False
+
+    def getOutputType(self, inputType):
+        t = inputType.dims.get("timeSeriesLength")
+        if t is not None:
+            t = _conv.conv_output_size(t, self.kernelSize, self.stride,
+                                       self.padding, 1, "truncate")
+        return InputType.recurrent(inputType.size, t)
+
+    def forward(self, params, state, x, train, key, mask=None):
+        # NCW [B,C,T]: the NHWC pool helpers want channels last, so pool
+        # over a [B,T,1,C] view
+        pad = ((self.padding, self.padding), (0, 0))
+        k, s = (self.kernelSize, 1), (self.stride, 1)
+        t = str(self.poolingType).lower()
+        xn = jnp.transpose(x, (0, 2, 1))[:, :, None, :]  # [B,T,1,C]
+        if t == "max":
+            y = _pool.max_pool2d(xn, k, s, pad)
+        elif t == "avg":
+            y = _pool.avg_pool2d(xn, k, s, pad)
+        else:
+            raise ValueError(f"Unknown poolingType {self.poolingType}")
+        return jnp.transpose(y[:, :, 0, :], (0, 2, 1)), state
+
+
+class ZeroPadding1DLayer(Layer):
+    """Pad the time axis of NCW data (reference: ZeroPadding1DLayer)."""
+
+    def __init__(self, padding=1, **kw):
+        super().__init__(**kw)
+        p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+        self.pad1 = (int(p[0]), int(p[1]))
+
+    def hasParams(self):
+        return False
+
+    def getOutputType(self, inputType):
+        t = inputType.dims.get("timeSeriesLength")
+        return InputType.recurrent(
+            inputType.size, None if t is None else t + sum(self.pad1))
+
+    def forward(self, params, state, x, train, key, mask=None):
+        return jnp.pad(x, ((0, 0), (0, 0), self.pad1)), state
+
+
+class RepeatVector(Layer):
+    """[B, F] -> [B, F, n] by repetition (reference: conf.layers.
+    RepeatVector; the decoder-seed layer in seq2seq autoencoders)."""
+
+    def __init__(self, repetitionFactor=2, n=None, **kw):
+        super().__init__(**kw)
+        self.n = int(n if n is not None else repetitionFactor)
+
+    def hasParams(self):
+        return False
+
+    def getOutputType(self, inputType):
+        return InputType.recurrent(inputType.size, self.n)
+
+    def forward(self, params, state, x, train, key, mask=None):
+        return jnp.repeat(x[:, :, None], self.n, axis=2), state
+
+
+class ElementWiseMultiplicationLayer(FeedForwardLayer):
+    """out = activation(x * w + b) with a LEARNED per-feature scale
+    (reference: conf.layers.ElementWiseMultiplicationLayer)."""
+
+    def getOutputType(self, inputType):
+        if self.nOut is not None and self.nOut != inputType.size:
+            raise ValueError(
+                f"ElementWiseMultiplicationLayer requires nIn == nOut; got "
+                f"nOut={self.nOut} on a {inputType.size}-feature input "
+                "(reference parity: the layer cannot change width)")
+        self.nOut = inputType.size
+        return InputType.feedForward(self.nOut)
+
+    def initialize(self, key, inputType, dtype):
+        self.inferNIn(inputType)
+        self.nOut = self.nIn
+        params = {"W": jnp.ones((self.nIn,), dtype)}
+        if self.hasBias:
+            params["b"] = jnp.full((self.nIn,), self.biasInit, dtype)
+        return params, {}
+
+    def forward(self, params, state, x, train, key, mask=None):
+        x = self._dropout_input(x, train, key)
+        y = x * params["W"]
+        if self.hasBias:
+            y = y + params["b"]
+        return _act.get(self.activation)(y), state
+
+
+class AutoEncoder(FeedForwardLayer):
+    """Plain (denoising) autoencoder layer, pretrained layerwise with MSE
+    reconstruction (reference: conf.layers.AutoEncoder; corruptionLevel =
+    input dropout noise during pretraining). In a supervised stack its
+    forward is the encoder half."""
+
+    def __init__(self, corruptionLevel=0.0, **kw):
+        super().__init__(**kw)
+        self.corruptionLevel = float(corruptionLevel)
+        self.pretrainable = True
+
+    def initialize(self, key, inputType, dtype):
+        params, state = super().initialize(key, inputType, dtype)
+        params["vb"] = jnp.zeros((self.nIn,), dtype)  # decoder (visible) bias
+        return params, state
+
+    def forward(self, params, state, x, train, key, mask=None):
+        x = self._dropout_input(x, train, key)
+        y = x @ params["W"]
+        if self.hasBias:
+            y = y + params["b"]
+        return _act.get(self.activation)(y), state
+
+    def decode(self, params, h):
+        # tied weights, like the reference's default
+        return h @ params["W"].T + params["vb"]
+
+    def pretrain_loss(self, params, x, key):
+        xin = x
+        if self.corruptionLevel > 0.0 and key is not None:
+            keep = jax.random.bernoulli(key, 1.0 - self.corruptionLevel,
+                                        x.shape)
+            xin = jnp.where(keep, x, 0.0)
+        h = _act.get(self.activation)(
+            xin @ params["W"] + (params["b"] if self.hasBias else 0.0))
+        rec = self.decode(params, h)
+        return jnp.mean(jnp.sum(jnp.square(rec - x), axis=-1))

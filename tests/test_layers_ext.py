@@ -436,3 +436,82 @@ class TestReviewRegressions:
         assert not c.appliesTo("centers")
         assert not c.appliesTo("alpha")
         assert c.appliesTo("W")
+
+
+class TestSmallUtilityLayers:
+    """Subsampling1D / ZeroPadding1D / RepeatVector /
+    ElementWiseMultiplication / plain AutoEncoder (upstream long tail)."""
+
+    def test_subsampling1d_max(self):
+        from deeplearning4j_tpu.nn import Subsampling1DLayer, GlobalPoolingLayer
+
+        net = _net(Subsampling1DLayer(poolingType="max", kernelSize=2, stride=2),
+                   GlobalPoolingLayer(poolingType="avg"),
+                   OutputLayer(nOut=2, activation="softmax"),
+                   inputType=InputType.recurrent(3, 8))
+        x = np.arange(2 * 3 * 8, dtype="float64").reshape(2, 3, 8)
+        acts = net.feedForward(x)
+        assert acts[1].shape() == (2, 3, 4)
+        np.testing.assert_allclose(acts[1].toNumpy(),
+                                   x.reshape(2, 3, 4, 2).max(-1))
+
+    def test_zeropadding1d(self):
+        from deeplearning4j_tpu.nn import ZeroPadding1DLayer, GlobalPoolingLayer
+
+        net = _net(ZeroPadding1DLayer(padding=(1, 2)),
+                   GlobalPoolingLayer(poolingType="avg"),
+                   OutputLayer(nOut=2, activation="softmax"),
+                   inputType=InputType.recurrent(2, 5))
+        x = np.random.RandomState(0).randn(1, 2, 5)
+        acts = net.feedForward(x)
+        assert acts[1].shape() == (1, 2, 8)
+        np.testing.assert_allclose(acts[1].toNumpy()[:, :, 0], 0.0)
+        np.testing.assert_allclose(acts[1].toNumpy()[:, :, -2:], 0.0)
+
+    def test_repeat_vector(self):
+        from deeplearning4j_tpu.nn import RepeatVector, RnnOutputLayer
+
+        net = _net(DenseLayer(nOut=4), RepeatVector(n=6),
+                   RnnOutputLayer(nOut=2, activation="softmax"),
+                   inputType=InputType.feedForward(3))
+        x = np.random.RandomState(0).randn(2, 3)
+        acts = net.feedForward(x)
+        assert acts[2].shape() == (2, 4, 6)
+        for t in range(6):
+            np.testing.assert_allclose(acts[2].toNumpy()[:, :, t],
+                                       acts[2].toNumpy()[:, :, 0])
+
+    def test_elementwise_multiplication_learns_scale(self):
+        from deeplearning4j_tpu.nn import ElementWiseMultiplicationLayer
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 4).astype("float32")
+        y = np.eye(2, dtype="float32")[(x[:, 0] > 0).astype(int)]
+        net = _net(ElementWiseMultiplicationLayer(),
+                   OutputLayer(nOut=2, activation="softmax"),
+                   inputType=InputType.feedForward(4),
+                   updater=Adam(5e-2), dtype=DataType.FLOAT)
+        w0 = np.asarray(net._params[0]["W"]).copy()
+        for _ in range(20):
+            net.fit(x, y)
+        assert not np.allclose(w0, np.asarray(net._params[0]["W"]))
+        assert np.isfinite(net.score())
+
+    def test_autoencoder_pretrains_and_reconstructs(self):
+        from deeplearning4j_tpu.nn import AutoEncoder
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(0)
+        # data on a 2-d manifold inside 8-d
+        z = rng.randn(128, 2)
+        x = np.tanh(z @ rng.randn(2, 8)).astype("float32")
+        net = _net(AutoEncoder(nOut=3, activation="tanh",
+                               corruptionLevel=0.1),
+                   OutputLayer(nOut=2, activation="softmax"),
+                   inputType=InputType.feedForward(8),
+                   updater=Adam(1e-2), dtype=DataType.FLOAT)
+        ae = net.layers[0]
+        l0 = float(ae.pretrain_loss(net._params[0], jnp.asarray(x), None))
+        net.pretrainLayer(0, x, epochs=200)
+        l1 = float(ae.pretrain_loss(net._params[0], jnp.asarray(x), None))
+        assert l1 < 0.5 * l0, f"reconstruction should improve: {l0} -> {l1}"

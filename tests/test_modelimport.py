@@ -415,3 +415,17 @@ class TestExtendedLayerImport:
                            match="shared_axes"):
             KerasModelImport.importKerasSequentialModelAndWeights(
                 json.dumps(raw), {})
+
+    def test_1d_pooling_and_padding_parity(self):
+        m = keras.Sequential([
+            keras.layers.Input((12, 4)),          # [B, T, F]
+            keras.layers.ZeroPadding1D(2),
+            keras.layers.Cropping1D((1, 1)),
+            keras.layers.MaxPooling1D(2),
+            keras.layers.LSTM(8),
+            keras.layers.Dense(3, activation="softmax"),
+        ])
+        net = KerasModelImport.importKerasSequentialModelAndWeights(
+            m.to_json(), _wmap(m))
+        x = np.random.RandomState(6).rand(2, 12, 4).astype("float32")
+        _parity(m, net, x, x.transpose(0, 2, 1), rtol=1e-3, atol=1e-4)
