@@ -636,3 +636,36 @@ def _rgb_to_hsv(x):
 # sub-SameDiff graphs lowered to lax.cond / lax.while_loop / masked scan)
 OPS["if_cond"] = None
 OPS["while_loop"] = None
+
+
+@op("clipByValue")
+def _clip_by_value(x, clipValueMin=None, clipValueMax=None):
+    # cast bounds to x's dtype: weak-float bounds would silently promote
+    # integer tensors to float (DL4J preserves dtype)
+    lo = jnp.asarray(clipValueMin, x.dtype)
+    hi = jnp.asarray(clipValueMax, x.dtype)
+    return jnp.clip(x, lo, hi)
+
+
+@op("clipByNorm")
+def _clip_by_norm(x, clipValue=None, dimensions=None):
+    axes = None if not dimensions else tuple(dimensions)
+    n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True) + 1e-12)
+    return x * jnp.minimum(1.0, clipValue / n)
+
+
+@op("sort")
+def _sort(x, axis=-1, descending=False):
+    y = jnp.sort(x, axis=axis)
+    return jnp.flip(y, axis) if descending else y
+
+
+@op("topK")
+def _topk(x, k=1, sorted=True):
+    vals, idx = jax.lax.top_k(x, k)
+    return vals, idx.astype(jnp.int32)
+
+
+@op("split")
+def _split(x, numSplit=2, axis=0):
+    return tuple(jnp.split(x, numSplit, axis=axis))

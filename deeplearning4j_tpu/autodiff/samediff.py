@@ -873,6 +873,33 @@ class _MathOps(_NS):
     def logicalNot(self, x, name=None):
         return self._mk("not", [x], name=name)
 
+    def clipByValue(self, x, clipValueMin, clipValueMax, name=None):
+        # bounds kept as-is; the op casts them to x's dtype (int tensors
+        # must stay int)
+        return self._mk("clipByValue", [x],
+                        {"clipValueMin": clipValueMin,
+                         "clipValueMax": clipValueMax}, name=name)
+
+    def clipByNorm(self, x, clipValue, *dimensions, name=None):
+        return self._mk("clipByNorm", [x],
+                        {"clipValue": float(clipValue),
+                         "dimensions": list(dimensions) or None}, name=name)
+
+    def sort(self, x, axis=-1, descending=False, name=None):
+        return self._mk("sort", [x], {"axis": axis,
+                                      "descending": descending}, name=name)
+
+    def topK(self, x, k, sorted=True, name=None):
+        """(values, indices) of the k largest along the last axis
+        (reference: sd.math.topK → lax.top_k on TPU)."""
+        return self._mk("topK", [x], {"k": int(k), "sorted": sorted},
+                        nOut=2, name=name)
+
+    def split(self, x, numSplit, axis=0, name=None):
+        return self._mk("split", [x], {"numSplit": int(numSplit),
+                                       "axis": axis}, nOut=int(numSplit),
+                        name=name)
+
     def where(self, cond, x, y, name=None):
         return self._mk("where", [cond, x, y], name=name)
 

@@ -350,3 +350,40 @@ class TestControlFlow:
                         inputs=[x], name="bad")  # nOut defaults to 1
         with pytest.raises(ValueError, match="declared"):
             sd.output({"x": np.ones(2, "float32"), "p": np.float32(1)}, [out])
+
+
+class TestExtraMathOps:
+    def test_clip_sort_topk_split(self):
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", jnp.float32, 2, 6)
+        c = sd.math.clipByValue(x, -1.0, 1.0, name="clip")
+        s = sd.math.sort(x, descending=True, name="srt")
+        tv, ti = sd.math.topK(x, 2, name="tk")
+        a, b, cc = sd.math.split(x, 3, axis=1, name="sp")
+        xv = np.array([[3., -5., 1., 0.5, 2., -2.],
+                       [0., 1., -1., 4., -4., 2.]], "float32")
+        r = sd.output({"x": xv}, [c, s, tv, ti, a])
+        np.testing.assert_allclose(r["clip"].toNumpy(), np.clip(xv, -1, 1))
+        np.testing.assert_allclose(r["srt"].toNumpy(), -np.sort(-xv, -1))
+        np.testing.assert_allclose(r[tv.name].toNumpy(),
+                                   -np.sort(-xv, -1)[:, :2])
+        np.testing.assert_allclose(r[ti.name].toNumpy(),
+                                   np.argsort(-xv, -1)[:, :2])
+        np.testing.assert_allclose(r[a.name].toNumpy(), xv[:, :2])
+
+    def test_clip_by_norm(self):
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", jnp.float32, 4)
+        y = sd.math.clipByNorm(x, 2.0, name="cn")
+        xv = np.array([3.0, 4.0, 0.0, 0.0], "float32")  # norm 5
+        r = sd.output({"x": xv}, [y])["cn"].toNumpy()
+        np.testing.assert_allclose(np.linalg.norm(r), 2.0, rtol=1e-5)
+        np.testing.assert_allclose(r, xv * 0.4, rtol=1e-4)
+
+    def test_clip_preserves_integer_dtype(self):
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", jnp.int32, 5)
+        y = sd.math.clipByValue(x, 0, 3, name="ci")
+        r = sd.output({"x": np.array([-2, 1, 9, 3, 0], "int32")}, [y])["ci"]
+        assert r.toNumpy().dtype == np.int32
+        np.testing.assert_array_equal(r.toNumpy(), [0, 1, 3, 3, 0])
