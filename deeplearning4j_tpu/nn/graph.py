@@ -428,6 +428,26 @@ class ComputationGraph:
         out = self.output(*features)
         return out if isinstance(out, INDArray) else out[0]
 
+    def feedForward(self, *features, train=False):
+        """Every vertex/layer activation by name (reference:
+        ComputationGraph.feedForward() -> Map<String,INDArray>). CNN
+        activations come back in the API's NCHW layout. Inspection API:
+        runs the graph eagerly (outside the jitted inference path)."""
+        self._require_init()
+        inputs = self._coerce_inputs(
+            features if len(features) > 1 else features[0])
+        key = jax.random.key(self.conf.seed ^ 0xFEED) if train else None
+        acts, _, _ = self._run_graph(
+            self._params, self._strip_carries(self._states), inputs,
+            train, key, None)
+        out = {}
+        for name, a in acts.items():
+            if hasattr(a, "ndim") and a.ndim == 4 and \
+                    name not in self.conf.networkOutputs:
+                a = jnp.transpose(a, (0, 3, 1, 2))
+            out[name] = INDArray(a)
+        return out
+
     def score(self, ds=None) -> float:
         if ds is None:
             return getattr(self, "_score", float("nan"))

@@ -18,6 +18,10 @@ from deeplearning4j_tpu.parallel.pipeline import PipelineParallel, partition_sta
 from deeplearning4j_tpu.parallel.multihost import (
     initialize as initializeMultiHost, hybrid_mesh, is_coordinator, num_hosts,
 )
+from deeplearning4j_tpu.parallel.costmodel import (
+    CHIPS, ChipSpec, DataParallelModel, all_reduce_time, all_gather_time,
+    reduce_scatter_time, ppermute_time, resnet50_scaling,
+)
 
 __all__ = [
     "build_mesh", "data_parallel_mesh", "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS",
@@ -26,4 +30,7 @@ __all__ = [
     "replicate_params", "spec_for_param", "ring_attention", "ulysses_attention",
     "PipelineParallel", "partition_stages",
     "initializeMultiHost", "hybrid_mesh", "is_coordinator", "num_hosts",
+    "CHIPS", "ChipSpec", "DataParallelModel", "all_reduce_time",
+    "all_gather_time", "reduce_scatter_time", "ppermute_time",
+    "resnet50_scaling",
 ]

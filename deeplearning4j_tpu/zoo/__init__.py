@@ -1,17 +1,21 @@
 """Model zoo.
 
 Reference: org.deeplearning4j.zoo.model.* (ZooModel subclasses LeNet,
-SimpleCNN, AlexNet, VGG16, ResNet50, UNet, TextGenerationLSTM). Each model
-is a configuration factory; init() returns a ready network. Pretrained
-weight download is not available in this zero-egress build (reference:
-ZooModel.initPretrained) — initPretrained raises with a clear message.
+SimpleCNN, AlexNet, VGG16/19, ResNet50, UNet, TextGenerationLSTM,
+Darknet19, TinyYOLO, YOLO2, SqueezeNet, Xception, InceptionResNetV1,
+FaceNetNN4Small2, NASNet). Each model is a configuration factory;
+init() returns a ready network. Pretrained weight download is not
+available in this zero-egress build (reference: ZooModel.initPretrained)
+— initPretrained raises with a clear message.
 """
 
 from deeplearning4j_tpu.zoo.models import (
     ZooModel, LeNet, SimpleCNN, AlexNet, VGG16, VGG19, ResNet50, UNet,
-    TextGenerationLSTM, Darknet19, TinyYOLO, SqueezeNet, Xception,
+    TextGenerationLSTM, Darknet19, TinyYOLO, YOLO2, SqueezeNet, Xception,
+    InceptionResNetV1, FaceNetNN4Small2, NASNet,
 )
 
 __all__ = ["ZooModel", "LeNet", "SimpleCNN", "AlexNet", "VGG16", "VGG19",
            "ResNet50", "UNet", "TextGenerationLSTM", "Darknet19", "TinyYOLO",
-           "SqueezeNet", "Xception"]
+           "YOLO2", "SqueezeNet", "Xception", "InceptionResNetV1",
+           "FaceNetNN4Small2", "NASNet"]

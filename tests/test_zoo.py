@@ -244,3 +244,85 @@ class TestSpaceToDepthStem:
 
         with pytest.raises(ValueError, match="stemMode"):
             ResNet50(stemMode="nope")
+
+
+class TestZooUpstreamTail:
+    """The remaining upstream zoo entries (reference:
+    org.deeplearning4j.zoo.model.{YOLO2, InceptionResNetV1,
+    FaceNetNN4Small2, NASNet}), built at reduced size for the CPU mesh:
+    construction, forward shape, and a finite fit step each."""
+
+    def test_yolo2_builds_and_fits(self):
+        from deeplearning4j_tpu.zoo import YOLO2
+        from deeplearning4j_tpu.data import DataSet
+
+        net = YOLO2(numClasses=3, inputShape=(3, 64, 64),
+                    anchors=((1.0, 1.0), (2.0, 2.0))).init()
+        x = np.random.RandomState(0).rand(2, 3, 64, 64).astype("float32")
+        # 64px / 32 stride = 2x2 grid; head = A*(5+C) = 2*8 channels
+        # (ComputationGraph API boundary is NCHW)
+        out = net.output(x)
+        assert out.shape() == (2, 2 * 8, 2, 2)
+        lab = np.zeros((2, 4 + 3, 2, 2), np.float32)
+        # box center (1.5, 0.25) in grid units lies in cell row 0, col 1 —
+        # the cell the label occupies (labels-at-center-cell convention)
+        lab[0, 0:4, 0, 1] = (1.1, 0.1, 1.9, 0.4)
+        lab[0, 5, 0, 1] = 1.0
+        ds = DataSet(x, lab)
+        net.fit(ds)
+        assert np.isfinite(net.score(ds))
+
+    def test_yolo2_passthrough_wiring(self):
+        from deeplearning4j_tpu.zoo import YOLO2
+
+        conf = YOLO2(numClasses=3, inputShape=(3, 64, 64),
+                     anchors=((1.0, 1.0),)).conf()
+        names = set(conf.nodes)
+        assert {"route_s2d", "route_cat"} <= names
+
+    def test_inception_resnet_v1(self):
+        from deeplearning4j_tpu.zoo import InceptionResNetV1
+
+        net = InceptionResNetV1(numClasses=5, embeddingSize=16,
+                                inputShape=(3, 96, 96)).init()
+        x = np.random.RandomState(0).rand(2, 3, 96, 96).astype("float32")
+        out = net.outputSingle(x)
+        assert out.shape() == (2, 5)
+        np.testing.assert_allclose(out.toNumpy().sum(1), np.ones(2),
+                                   rtol=1e-3)
+        # L2-normalized embedding feeds the center-loss head
+        emb = net.feedForward(x)["embeddings"]
+        np.testing.assert_allclose(
+            np.linalg.norm(emb.toNumpy(), axis=1), np.ones(2), rtol=1e-3)
+        y = np.eye(5, dtype="float32")[np.random.RandomState(1).randint(0, 5, 2)]
+        net.fit(x, y)
+        assert np.isfinite(net.score())
+
+    def test_facenet_nn4_small2(self):
+        from deeplearning4j_tpu.zoo import FaceNetNN4Small2
+
+        net = FaceNetNN4Small2(numClasses=6, embeddingSize=16,
+                               inputShape=(3, 64, 64)).init()
+        x = np.random.RandomState(0).rand(2, 3, 64, 64).astype("float32")
+        out = net.outputSingle(x)
+        assert out.shape() == (2, 6)
+        emb = net.feedForward(x)["embeddings"]
+        np.testing.assert_allclose(
+            np.linalg.norm(emb.toNumpy(), axis=1), np.ones(2), rtol=1e-3)
+        y = np.eye(6, dtype="float32")[np.random.RandomState(1).randint(0, 6, 2)]
+        net.fit(x, y)
+        assert np.isfinite(net.score())
+
+    def test_nasnet(self):
+        from deeplearning4j_tpu.zoo import NASNet
+
+        net = NASNet(numClasses=4, numCells=1, penultimateFilters=96,
+                     stemFilters=8, inputShape=(3, 64, 64)).init()
+        x = np.random.RandomState(0).rand(2, 3, 64, 64).astype("float32")
+        out = net.outputSingle(x)
+        assert out.shape() == (2, 4)
+        np.testing.assert_allclose(out.toNumpy().sum(1), np.ones(2),
+                                   rtol=1e-3)
+        y = np.eye(4, dtype="float32")[np.random.RandomState(1).randint(0, 4, 2)]
+        net.fit(x, y)
+        assert np.isfinite(net.score())
