@@ -669,3 +669,32 @@ def _topk(x, k=1, sorted=True):
 @op("split")
 def _split(x, numSplit=2, axis=0):
     return tuple(jnp.split(x, numSplit, axis=axis))
+
+
+# ---- random ops (reference: ops.SDRandom / legacy random ops in libnd4j;
+# here: counter-based jax.random keyed by the executor — see
+# SameDiff._run_graph, which injects `key` per stochastic op) ----
+
+@op("randomNormal")
+def _random_normal(shape=None, mean=0.0, stddev=1.0, key=None,
+                   dtype="float32"):
+    dt = jnp.dtype(dtype)
+    return mean + stddev * jax.random.normal(key, tuple(shape), dt)
+
+
+@op("randomUniform")
+def _random_uniform(shape=None, min=0.0, max=1.0, key=None,
+                    dtype="float32"):
+    dt = jnp.dtype(dtype)
+    return jax.random.uniform(key, tuple(shape), dt, minval=min, maxval=max)
+
+
+@op("randomBernoulli")
+def _random_bernoulli(shape=None, p=0.5, key=None, dtype="float32"):
+    return jax.random.bernoulli(key, p, tuple(shape)).astype(jnp.dtype(dtype))
+
+
+@op("randomExponential")
+def _random_exponential(shape=None, lambda_=1.0, key=None, dtype="float32"):
+    dt = jnp.dtype(dtype)
+    return jax.random.exponential(key, tuple(shape)).astype(dt) / lambda_

@@ -23,6 +23,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.autodiff.ops_impl import OPS
+
+_STOCHASTIC_OPS = frozenset(
+    {"randomNormal", "randomUniform", "randomBernoulli",
+     "randomExponential"})
 from deeplearning4j_tpu.ndarray import INDArray
 from deeplearning4j_tpu.nn import updaters as _upd
 from deeplearning4j_tpu.nn import weights as _weights
@@ -253,6 +257,7 @@ class SameDiff:
         self.image = _ImageOps(self)
         self.linalg = _LinalgOps(self)
         self.bitwise = _BitwiseOps(self)
+        self.random = _RandomOps(self)
 
     @staticmethod
     def create():
@@ -364,7 +369,12 @@ class SameDiff:
         Fully differentiable (jax.grad flows through lax.cond)."""
         ins = [self._lift(pred)] + [self._lift(v) for v in inputs]
         return self._op("if_cond", ins,
-                        kwargs={"trueBody": trueBody, "falseBody": falseBody},
+                        kwargs={"trueBody": trueBody, "falseBody": falseBody,
+                                "trueGraph": self._record_body(
+                                    trueBody, len(ins) - 1, "ifCond trueBody"),
+                                "falseGraph": self._record_body(
+                                    falseBody, len(ins) - 1,
+                                    "ifCond falseBody")},
                         nOut=nOut, name=name)
 
     def whileLoop(self, condBody, loopBody, loopVars, maxIterations=None,
@@ -383,6 +393,10 @@ class SameDiff:
         ins = [self._lift(v) for v in loopVars]
         return self._op("while_loop", ins,
                         kwargs={"condBody": condBody, "loopBody": loopBody,
+                                "condGraph": self._record_body(
+                                    condBody, len(ins), "whileLoop condBody"),
+                                "loopGraph": self._record_body(
+                                    loopBody, len(ins), "whileLoop loopBody"),
                                 "maxIterations": maxIterations},
                         nOut=len(ins), name=name)
 
@@ -390,13 +404,135 @@ class SameDiff:
     cond = ifCond
     while_loop = whileLoop
 
+    _BODY_CALLABLE_KEYS = ("trueBody", "falseBody", "condBody", "loopBody")
+
+    @staticmethod
+    def _serializable_kwargs(kwargs):
+        """Op kwargs minus the in-memory body callables (their recorded
+        graph specs — *Graph keys — are the serialized form)."""
+        return {k: v for k, v in kwargs.items()
+                if k not in SameDiff._BODY_CALLABLE_KEYS}
+
+    @staticmethod
+    def _clean_spec_kwargs(kwargs, path, body_store):
+        """Deep-copy op kwargs for graph.json: drop body callables,
+        validate every (arbitrarily nested) recorded body, and move its
+        constant arrays into `body_store` for the npz (JSON holds only
+        the npz key — reference: FlatBuffers stores subgraph arrays in
+        the same buffer as the main graph's)."""
+        out = {}
+        for k, v in kwargs.items():
+            if k in SameDiff._BODY_CALLABLE_KEYS:
+                continue
+            if k.endswith("Graph") and isinstance(v, dict):
+                if "unrecordable" in v:
+                    raise NotImplementedError(
+                        "Graph cannot be serialized: a control-flow body "
+                        "could not be recorded as a subgraph "
+                        f"({v['unrecordable']}). Bodies must be pure "
+                        "graph-builders over their SDVariable arguments.")
+                spec = dict(v)
+                refs = {}
+                for n, a in spec["arrays"].items():
+                    npz_key = f"__body__/{path}/{k}/{n}"
+                    body_store[npz_key] = np.asarray(a)
+                    refs[n] = npz_key
+                spec["arrays"] = refs
+                spec["ops"] = [
+                    {"op": o["op"], "inputs": o["inputs"],
+                     "outputs": o["outputs"],
+                     "kwargs": SameDiff._clean_spec_kwargs(
+                         o["kwargs"], f"{path}/{k}/{j}", body_store)}
+                    for j, o in enumerate(spec["ops"])]
+                out[k] = spec
+            else:
+                out[k] = v
+        return out
+
+    @staticmethod
+    def _resolve_spec_kwargs(kwargs, npz):
+        """Inverse of _clean_spec_kwargs at load: swap npz keys back to
+        arrays, recursively. Mutates the loaded dicts in place."""
+        for k, v in kwargs.items():
+            if k.endswith("Graph") and isinstance(v, dict) and "arrays" in v:
+                v["arrays"] = {n: np.asarray(npz[ref])
+                               for n, ref in v["arrays"].items()}
+                for o in v["ops"]:
+                    SameDiff._resolve_spec_kwargs(o["kwargs"], npz)
+
+    @staticmethod
+    def _record_body(build_fn, n_inputs, what=""):
+        """Record a control-flow body as a serializable graph spec.
+
+        The body is a graph-builder (it only appends symbolic ops), so it
+        can be run once at definition time against shapeless placeholders
+        named in0..in{k-1} — the same names _subgraph_fn uses at
+        execution, which is what makes replay (_body_from_spec) exact.
+        Reference: SameDiff's If/While store their subgraphs in the
+        FlatBuffers file; this is the npz+json equivalent."""
+        sub = SameDiff()
+        phs = [sub.placeHolder(f"in{i}") for i in range(n_inputs)]
+        try:
+            out = build_fn(sub, *phs)
+        except Exception as e:
+            # definition must not fail just because the graph won't be
+            # serializable; save() raises the clear error instead
+            return {"unrecordable": f"{what}: {type(e).__name__}: {e}"}
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        return {
+            "inputs": [p.name for p in phs],
+            "outputs": [o.name for o in outs],
+            "variables": [{"name": n, "type": v.variableType}
+                          for n, v in sub._vars.items()],
+            "ops": [{"op": o.opName, "inputs": o.inputs,
+                     "outputs": o.outputs,
+                     "kwargs": SameDiff._serializable_kwargs(o.kwargs)}
+                    for o in sub._ops],
+            "arrays": {n: np.asarray(a) for n, a in sub._arrays.items()},
+        }
+
+    @staticmethod
+    def _body_from_spec(spec):
+        """Inverse of _record_body: a build_fn that replays the recorded
+        ops verbatim into the fresh sub-SameDiff _subgraph_fn provides
+        (placeholder names match by construction)."""
+        def build(sub, *phs):
+            for vd in spec["variables"]:
+                if vd["name"] not in sub._vars:
+                    sub._vars[vd["name"]] = SDVariable(sub, vd["name"],
+                                                       vd["type"])
+            for n, a in spec["arrays"].items():
+                sub._arrays[n] = jnp.asarray(a)
+            for od in spec["ops"]:
+                sub._ops.append(_Op(od["op"], list(od["inputs"]),
+                                    list(od["outputs"]), od["kwargs"]))
+                for n in od["outputs"]:
+                    sub._producer[n] = len(sub._ops) - 1
+            outs = [sub._vars[n] for n in spec["outputs"]]
+            return outs[0] if len(outs) == 1 else tuple(outs)
+
+        return build
+
+    def _body(self, op, key):
+        """The executable for a control-flow body: the original callable
+        if this graph was built in-process, else the recorded spec
+        (loaded graphs)."""
+        fn = op.kwargs.get(key)
+        if fn is not None:
+            return fn
+        return self._body_from_spec(op.kwargs[key.replace("Body", "Graph")])
+
     @staticmethod
     def _subgraph_fn(build_fn, args, train=False, rng=None, n_expected=None,
-                     what=""):
+                     what="", dynamic_rng=False):
         """Build `build_fn` as a sub-SameDiff over placeholders shaped like
         `args` (shapes are concrete at trace time) and return a plain
         jnp-level function of the arg values. train/rng thread the outer
-        training mode into stochastic ops inside the body."""
+        training mode into stochastic ops inside the body.
+
+        dynamic_rng=True: the returned function takes a trailing PRNG-key
+        argument instead of closing over `rng` — loop executors thread the
+        key through the carry so stochastic ops redraw every iteration."""
         sub = SameDiff()
         phs = [sub.placeHolder(f"in{i}", jnp.asarray(a).dtype,
                                *jnp.asarray(a).shape)
@@ -409,11 +545,12 @@ class SameDiff:
                 f"were declared (nOut / len(loopVars))")
         names = [o.name for o in outs]
 
-        def f(*vals):
+        def f(*vals, key=None):
             env = sub._base_env()
             for ph, v in zip(phs, vals):
                 env[ph.name] = v
-            r = sub._run_graph(env, names, train=train, rng=rng)
+            r = sub._run_graph(env, names, train=train,
+                               rng=key if dynamic_rng else rng)
             return [r[n] for n in names]
 
         return f
@@ -421,10 +558,10 @@ class SameDiff:
     def _exec_if_cond(self, op, env, train=False, rng=None):
         pred, *args = [env[n] for n in op.inputs]
         no = len(op.outputs)
-        true_f = self._subgraph_fn(op.kwargs["trueBody"], args, train, rng,
-                                   no, "ifCond trueBody")
-        false_f = self._subgraph_fn(op.kwargs["falseBody"], args, train, rng,
-                                    no, "ifCond falseBody")
+        true_f = self._subgraph_fn(self._body(op, "trueBody"), args, train,
+                                   rng, no, "ifCond trueBody")
+        false_f = self._subgraph_fn(self._body(op, "falseBody"), args, train,
+                                    rng, no, "ifCond falseBody")
         res = jax.lax.cond(
             jnp.asarray(pred).reshape(()).astype(bool),
             lambda a: tuple(true_f(*a)),
@@ -434,26 +571,40 @@ class SameDiff:
 
     def _exec_while_loop(self, op, env, train=False, rng=None):
         args = tuple(env[n] for n in op.inputs)
-        cond_f = self._subgraph_fn(op.kwargs["condBody"], args, train, rng,
-                                   None, "whileLoop condBody")
-        body_f = self._subgraph_fn(op.kwargs["loopBody"], args, train, rng,
-                                   len(op.outputs), "whileLoop loopBody")
+        cond_f = self._subgraph_fn(self._body(op, "condBody"), args, train,
+                                   rng, None, "whileLoop condBody",
+                                   dynamic_rng=True)
+        body_f = self._subgraph_fn(self._body(op, "loopBody"), args, train,
+                                   rng, len(op.outputs), "whileLoop loopBody",
+                                   dynamic_rng=True)
         max_it = op.kwargs["maxIterations"]
+        # the PRNG key rides in the carry so stochastic ops inside the
+        # body draw fresh values EVERY iteration (a closure-captured key
+        # would replay one sample N times)
+        key0 = rng if rng is not None else jax.random.key(0)
+        carry0 = args + (key0,)
 
-        def pred_of(vs):
-            return jnp.asarray(cond_f(*vs)[0]).reshape(()).astype(bool)
+        def pred_of(carry):
+            vs, k = carry[:-1], carry[-1]
+            return jnp.asarray(cond_f(*vs, key=k)[0]).reshape(()).astype(bool)
+
+        def step(carry):
+            vs, k = carry[:-1], carry[-1]
+            return tuple(body_f(*vs, key=k)) + (jax.random.fold_in(k, 1),)
 
         if max_it is None:
-            res = jax.lax.while_loop(pred_of,
-                                     lambda vs: tuple(body_f(*vs)), args)
+            res = jax.lax.while_loop(pred_of, step, carry0)[:-1]
         else:
-            def scan_body(vs, _):
-                p = pred_of(vs)
-                new = body_f(*vs)
-                return tuple(jnp.where(p, n, v)
-                             for n, v in zip(new, vs)), None
+            def scan_body(carry, _):
+                p = pred_of(carry)
+                new = step(carry)
+                vs = tuple(jnp.where(p, n, v)
+                           for n, v in zip(new[:-1], carry[:-1]))
+                return vs + (new[-1],), None
 
-            res, _ = jax.lax.scan(scan_body, args, None, length=int(max_it))
+            carry, _ = jax.lax.scan(scan_body, carry0, None,
+                                    length=int(max_it))
+            res = carry[:-1]
         return res[0] if len(op.outputs) == 1 else res
 
     # ---------- trace / execution ----------
@@ -497,6 +648,12 @@ class SameDiff:
                 kwargs = dict(kwargs, train=train and rng is not None,
                               key=(jax.random.fold_in(rng, i)
                                    if rng is not None else None))
+            elif op.opName in _STOCHASTIC_OPS:
+                # random-generator ops draw on every execution: per-step
+                # rng during fit(), a fixed seeded key for output()
+                # (deterministic inference, reference: Nd4j seeded RNG)
+                base = rng if rng is not None else jax.random.key(0)
+                kwargs = dict(kwargs, key=jax.random.fold_in(base, i))
             res = OPS[op.opName](*args, **kwargs)
             if len(op.outputs) == 1:
                 env[op.outputs[0]] = res
@@ -740,13 +897,9 @@ class SameDiff:
     def save(self, path, saveUpdaterState=False):
         """Graph → JSON, arrays → npz, both in one zip (reference:
         SameDiff.save FlatBuffers .fb; format here is portable npz+json)."""
-        for o in self._ops:
-            if o.opName in ("if_cond", "while_loop"):
-                raise NotImplementedError(
-                    "Graphs containing ifCond/whileLoop cannot be "
-                    "serialized yet: the branch/body subgraphs are Python "
-                    "callables. Rebuild the graph from code after loading "
-                    "instead.")
+        body_store = {}  # recorded-body constants -> arrays.npz entries
+        op_kwargs = [self._clean_spec_kwargs(o.kwargs, f"op{i}", body_store)
+                     for i, o in enumerate(self._ops)]
         graph = {
             "variables": [
                 {"name": n, "type": v.variableType,
@@ -754,13 +907,14 @@ class SameDiff:
                  "phDtype": str(getattr(v, "_ph_dtype", "") or "")}
                 for n, v in self._vars.items()],
             "ops": [{"op": o.opName, "inputs": o.inputs,
-                     "outputs": o.outputs, "kwargs": o.kwargs}
-                    for o in self._ops],
+                     "outputs": o.outputs, "kwargs": kw}
+                    for o, kw in zip(self._ops, op_kwargs)],
             "lossVariables": self._loss_vars,
             "iteration": self._iteration,
         }
         buf = io.BytesIO()
-        np.savez(buf, **{n: np.asarray(a) for n, a in self._arrays.items()})
+        np.savez(buf, **{n: np.asarray(a) for n, a in self._arrays.items()},
+                 **body_store)
         with zipfile.ZipFile(path, "w") as z:
             z.writestr("graph.json", json.dumps(graph))
             z.writestr("arrays.npz", buf.getvalue())
@@ -776,7 +930,8 @@ class SameDiff:
         with zipfile.ZipFile(path) as z:
             graph = json.loads(z.read("graph.json"))
             npz = np.load(io.BytesIO(z.read("arrays.npz")))
-            arrays = {n: jnp.asarray(npz[n]) for n in npz.files}
+            arrays = {n: jnp.asarray(npz[n]) for n in npz.files
+                      if not n.startswith("__body__/")}
             if loadUpdaterState and "updater.npz" in z.namelist():
                 snpz = np.load(io.BytesIO(z.read("updater.npz")))
                 # leaves in tree_flatten order; restored into the updater's
@@ -790,6 +945,7 @@ class SameDiff:
             sd._vars[vd["name"]] = v
         for i, od in enumerate(graph["ops"]):
             kwargs = od["kwargs"]
+            SameDiff._resolve_spec_kwargs(kwargs, npz)
             sd._ops.append(_Op(od["op"], od["inputs"], od["outputs"],
                                kwargs))
             for n in od["outputs"]:
@@ -1193,6 +1349,33 @@ class _LinalgOps(_NS):
 
     def qr(self, x, name=None):
         return self._mk("qr", [x], nOut=2, name=name)
+
+
+class _RandomOps(_NS):
+    """Reference: ops.SDRandom. Draws are refreshed per fit() step (the
+    trainer's rng threads in) and fixed-seed deterministic for output().
+    Non-differentiable leaves, like the reference's random ops."""
+
+    def normal(self, mean, stddev, *shape, name=None):
+        return self._mk("randomNormal", [],
+                        {"shape": tuple(int(s) for s in shape),
+                         "mean": float(mean), "stddev": float(stddev)},
+                        name=name)
+
+    def uniform(self, min, max, *shape, name=None):
+        return self._mk("randomUniform", [],
+                        {"shape": tuple(int(s) for s in shape),
+                         "min": float(min), "max": float(max)}, name=name)
+
+    def bernoulli(self, p, *shape, name=None):
+        return self._mk("randomBernoulli", [],
+                        {"shape": tuple(int(s) for s in shape),
+                         "p": float(p)}, name=name)
+
+    def exponential(self, lambda_, *shape, name=None):
+        return self._mk("randomExponential", [],
+                        {"shape": tuple(int(s) for s in shape),
+                         "lambda_": float(lambda_)}, name=name)
 
 
 class _BitwiseOps(_NS):

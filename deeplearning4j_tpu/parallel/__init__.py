@@ -18,6 +18,7 @@ from deeplearning4j_tpu.parallel.pipeline import PipelineParallel, partition_sta
 from deeplearning4j_tpu.parallel.multihost import (
     initialize as initializeMultiHost, hybrid_mesh, is_coordinator, num_hosts,
 )
+from deeplearning4j_tpu.parallel.inference import ParallelInference
 from deeplearning4j_tpu.parallel.costmodel import (
     CHIPS, ChipSpec, DataParallelModel, all_reduce_time, all_gather_time,
     reduce_scatter_time, ppermute_time, resnet50_scaling,
@@ -30,6 +31,7 @@ __all__ = [
     "replicate_params", "spec_for_param", "ring_attention", "ulysses_attention",
     "PipelineParallel", "partition_stages",
     "initializeMultiHost", "hybrid_mesh", "is_coordinator", "num_hosts",
+    "ParallelInference",
     "CHIPS", "ChipSpec", "DataParallelModel", "all_reduce_time",
     "all_gather_time", "reduce_scatter_time", "ppermute_time",
     "resnet50_scaling",

@@ -524,3 +524,66 @@ class TestMultiHost:
         devs = self._fake_slices(2, 4)
         with pytest.raises(ValueError, match="cover"):
             hybrid_mesh({"data": 2}, {}, devices=devs)
+
+
+class TestParallelInference:
+    """Reference: org.deeplearning4j.parallelism.ParallelInference —
+    here the worker pool is a data-axis mesh and one SPMD dispatch."""
+
+    def _mlp(self, nIn=12, nOut=5, seed=3):
+        conf = (NeuralNetConfiguration.Builder().seed(seed)
+                .updater(Adam(1e-2)).activation("tanh").list()
+                .layer(DenseLayer(nOut=16))
+                .layer(OutputLayer(nOut=nOut, activation="softmax"))
+                .setInputType(InputType.feedForward(nIn)).build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_parity_with_single_device_output(self):
+        from deeplearning4j_tpu.parallel import ParallelInference
+
+        net = self._mlp()
+        pi = ParallelInference(net)
+        x = np.random.RandomState(0).randn(24, 12).astype("float32")
+        np.testing.assert_allclose(pi.output(x).toNumpy(),
+                                   net.output(x).toNumpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_ragged_batch_padding(self):
+        from deeplearning4j_tpu.parallel import ParallelInference
+
+        net = self._mlp()
+        pi = ParallelInference(net)
+        # B=13 not divisible by the 8-device mesh: pad + slice path
+        x = np.random.RandomState(1).randn(13, 12).astype("float32")
+        out = pi.output(x)
+        assert out.shape() == (13, 5)
+        np.testing.assert_allclose(out.toNumpy(), net.output(x).toNumpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_batch_limit_chunking(self):
+        from deeplearning4j_tpu.parallel import ParallelInference
+
+        net = self._mlp()
+        pi = ParallelInference(net, batchLimit=16)
+        x = np.random.RandomState(2).randn(40, 12).astype("float32")
+        np.testing.assert_allclose(pi.output(x).toNumpy(),
+                                   net.output(x).toNumpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_builder_and_computation_graph(self):
+        from deeplearning4j_tpu.parallel import ParallelInference
+        from deeplearning4j_tpu.nn import ComputationGraph
+
+        g = (NeuralNetConfiguration.Builder().seed(7).updater(Adam(1e-2))
+             .graphBuilder().addInputs("in")
+             .addLayer("h", DenseLayer(nOut=8, activation="relu"), "in")
+             .addLayer("out", OutputLayer(nOut=3, activation="softmax"), "h")
+             .setOutputs("out")
+             .setInputTypes(InputType.feedForward(6)).build())
+        net = ComputationGraph(g).init()
+        pi = (ParallelInference.Builder(net).workers(4).batchLimit(32)
+              .inferenceMode("BATCHED").queueLimit(64).build())
+        x = np.random.RandomState(3).randn(10, 6).astype("float32")
+        np.testing.assert_allclose(pi.output(x).toNumpy(),
+                                   net.outputSingle(x).toNumpy(),
+                                   rtol=1e-5, atol=1e-6)
