@@ -555,9 +555,14 @@ class SameDiff:
 
         return f
 
-    def _exec_if_cond(self, op, env, train=False, rng=None):
+    def _exec_if_cond(self, op, env, train=False, rng=None, op_idx=0):
         pred, *args = [env[n] for n in op.inputs]
         no = len(op.outputs)
+        # decorrelate body draws from outer-graph stochastic ops: a body
+        # op at sub-index i would otherwise fold the SAME (rng, i) as an
+        # outer op at index i
+        if rng is not None:
+            rng = jax.random.fold_in(rng, 1_000_000 + op_idx)
         true_f = self._subgraph_fn(self._body(op, "trueBody"), args, train,
                                    rng, no, "ifCond trueBody")
         false_f = self._subgraph_fn(self._body(op, "falseBody"), args, train,
@@ -569,7 +574,7 @@ class SameDiff:
             tuple(args))
         return res[0] if len(op.outputs) == 1 else res
 
-    def _exec_while_loop(self, op, env, train=False, rng=None):
+    def _exec_while_loop(self, op, env, train=False, rng=None, op_idx=0):
         args = tuple(env[n] for n in op.inputs)
         cond_f = self._subgraph_fn(self._body(op, "condBody"), args, train,
                                    rng, None, "whileLoop condBody",
@@ -580,17 +585,25 @@ class SameDiff:
         max_it = op.kwargs["maxIterations"]
         # the PRNG key rides in the carry so stochastic ops inside the
         # body draw fresh values EVERY iteration (a closure-captured key
-        # would replay one sample N times)
-        key0 = rng if rng is not None else jax.random.key(0)
+        # would replay one sample N times). The carry key is folded with
+        # a while-op tag so body draws never collide with outer-graph
+        # stochastic ops at the same sub-index, and cond/body fold
+        # distinct lanes off it per iteration.
+        key0 = jax.random.fold_in(
+            rng if rng is not None else jax.random.key(0),
+            1_000_000 + op_idx)
         carry0 = args + (key0,)
 
         def pred_of(carry):
             vs, k = carry[:-1], carry[-1]
-            return jnp.asarray(cond_f(*vs, key=k)[0]).reshape(()).astype(bool)
+            return jnp.asarray(
+                cond_f(*vs, key=jax.random.fold_in(k, 2))[0]
+            ).reshape(()).astype(bool)
 
         def step(carry):
             vs, k = carry[:-1], carry[-1]
-            return tuple(body_f(*vs, key=k)) + (jax.random.fold_in(k, 1),)
+            return tuple(body_f(*vs, key=jax.random.fold_in(k, 3))) + (
+                jax.random.fold_in(k, 1),)
 
         if max_it is None:
             res = jax.lax.while_loop(pred_of, step, carry0)[:-1]
@@ -631,13 +644,13 @@ class SameDiff:
         for i in self._slice_for(out_names):
             op = self._ops[i]
             if op.opName == "if_cond":
-                res = self._exec_if_cond(op, env, train, rng)
+                res = self._exec_if_cond(op, env, train, rng, i)
                 for n, r in zip(op.outputs, res if len(op.outputs) > 1
                                 else [res]):
                     env[n] = r
                 continue
             if op.opName == "while_loop":
-                res = self._exec_while_loop(op, env, train, rng)
+                res = self._exec_while_loop(op, env, train, rng, i)
                 for n, r in zip(op.outputs, res if len(op.outputs) > 1
                                 else [res]):
                     env[n] = r

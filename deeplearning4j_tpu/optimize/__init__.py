@@ -15,6 +15,7 @@ from deeplearning4j_tpu.optimize.listeners import (
     StatsListener,
     NanScoreWatcher,
 )
+from deeplearning4j_tpu.optimize.ui import UIServer, render_report
 from deeplearning4j_tpu.optimize.earlystopping import (
     EarlyStoppingConfiguration,
     EarlyStoppingTrainer,
@@ -41,4 +42,5 @@ __all__ = [
     "BestScoreEpochTerminationCondition", "MaxScoreIterationTerminationCondition",
     "MaxTimeIterationTerminationCondition", "DataSetLossCalculator",
     "InMemoryModelSaver", "LocalFileModelSaver",
+    "UIServer", "render_report",
 ]

@@ -1,0 +1,154 @@
+"""Training dashboard rendering.
+
+Reference: deeplearning4j-ui — `UIServer.getInstance().attach(storage)`
+serves a live play-framework dashboard fed by StatsListener. That design
+assumes a long-lived JVM webserver next to the trainer; in this
+zero-egress TPU build the equivalent is (a) the StatsListener JSONL
+stream, which any live dashboard can tail, and (b) this module, which
+renders that stream into a single self-contained HTML report (inline
+SVG, no external assets, no server) — the artifact you keep from a run.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import time
+
+
+def _read_records(logFile):
+    recs = []
+    with open(logFile) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                recs.append(json.loads(line))
+            except ValueError:
+                continue  # torn write at the tail of a live file
+    return recs
+
+
+def _svg_line_chart(points, title, width=640, height=220, fmt="{:.4g}"):
+    """One series as an inline SVG polyline with min/max axis labels."""
+    if len(points) < 2:
+        return (f"<div class='chart'><h3>{html.escape(title)}</h3>"
+                f"<p class='empty'>not enough data</p></div>")
+    xs = [p[0] for p in points]
+    ys = [p[1] for p in points]
+    x0, x1 = min(xs), max(xs)
+    y0, y1 = min(ys), max(ys)
+    xr = (x1 - x0) or 1.0
+    yr = (y1 - y0) or 1.0
+    pad, w, h = 8, width, height
+    pts = " ".join(
+        f"{pad + (x - x0) / xr * (w - 2 * pad):.1f},"
+        f"{h - pad - (y - y0) / yr * (h - 2 * pad):.1f}"
+        for x, y in points)
+    return f"""<div class='chart'><h3>{html.escape(title)}</h3>
+<svg viewBox='0 0 {w} {h}' width='{w}' height='{h}'
+     style='background:#fafafa;border:1px solid #ddd'>
+  <polyline fill='none' stroke='#2b6cb0' stroke-width='1.5' points='{pts}'/>
+  <text x='{pad}' y='{h - 2}' font-size='10' fill='#666'>{fmt.format(y0)} … {fmt.format(y1)}</text>
+  <text x='{w - 140}' y='{h - 2}' font-size='10' fill='#666'>iter {int(x0)} … {int(x1)}</text>
+</svg></div>"""
+
+
+def render_report(logFile, outFile=None, title="Training report"):
+    """StatsListener JSONL -> self-contained HTML. Returns the HTML; if
+    outFile is given, also writes it there."""
+    recs = _read_records(logFile)
+    stats = [r for r in recs if r.get("type") == "stats"
+             and r.get("score") is not None]
+    epochs = [r for r in recs if r.get("type") == "epochEnd"]
+
+    score_pts = [(r["iteration"], float(r["score"])) for r in stats]
+    rate_pts = [(r["iteration"], float(r["iterationsPerSec"]))
+                for r in stats if "iterationsPerSec" in r]
+    pmean_pts = [(r["iteration"], float(r["paramMeanAbs"]))
+                 for r in stats if "paramMeanAbs" in r]
+
+    rows = []
+    if score_pts:
+        rows.append(("final score", f"{score_pts[-1][1]:.6g}"))
+        rows.append(("best score", f"{min(p[1] for p in score_pts):.6g}"))
+        rows.append(("iterations", str(int(score_pts[-1][0]))))
+    if rate_pts:
+        rows.append(("mean iterations/sec",
+                     f"{sum(p[1] for p in rate_pts) / len(rate_pts):.3g}"))
+    if epochs:
+        rows.append(("epochs", str(len(epochs))))
+    if stats and "time" in stats[0] and "time" in stats[-1]:
+        rows.append(("wall time",
+                     f"{stats[-1]['time'] - stats[0]['time']:.1f} s"))
+
+    table = "".join(f"<tr><td>{html.escape(k)}</td><td>{html.escape(v)}</td></tr>"
+                    for k, v in rows)
+    charts = _svg_line_chart(score_pts, "score vs iteration")
+    if rate_pts:
+        charts += _svg_line_chart(rate_pts, "iterations/sec")
+    if pmean_pts:
+        charts += _svg_line_chart(pmean_pts, "mean |param|")
+
+    doc = f"""<!doctype html><html><head><meta charset='utf-8'>
+<title>{html.escape(title)}</title>
+<style>body{{font:14px system-ui,sans-serif;margin:2em;color:#222}}
+table{{border-collapse:collapse;margin:1em 0}}
+td{{border:1px solid #ddd;padding:4px 12px}}
+.chart{{margin:1.2em 0}} .empty{{color:#999}}</style></head><body>
+<h1>{html.escape(title)}</h1>
+<p>generated {time.strftime('%Y-%m-%d %H:%M:%S')} from
+{html.escape(str(logFile))} ({len(stats)} stat records)</p>
+<table>{table}</table>
+{charts}
+</body></html>"""
+    if outFile is not None:
+        with open(outFile, "w") as fh:
+            fh.write(doc)
+    return doc
+
+
+class UIServer:
+    """API-compatible shim for the reference's UIServer singleton.
+
+    attach() takes a StatsListener (or a JSONL path); render() produces
+    the HTML report for every attached source. There is deliberately no
+    live HTTP server in this build — the report is the artifact.
+    """
+
+    _instance = None
+
+    @classmethod
+    def getInstance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def __init__(self):
+        self._sources = []
+
+    def attach(self, source):
+        path = getattr(source, "logFile", source)
+        if path is None:
+            raise ValueError(
+                "StatsListener has no logFile — construct it with "
+                "StatsListener(logFile=...) to collect a report")
+        self._sources.append(str(path))
+        return self
+
+    def detach(self, source):
+        path = str(getattr(source, "logFile", source))
+        self._sources = [s for s in self._sources if s != path]
+
+    def render(self, outFile=None, title="Training report"):
+        """Render all attached sources; returns a list of HTML strings
+        (or writes `outFile` / numbered siblings when given)."""
+        docs = []
+        for i, src in enumerate(self._sources):
+            out = None
+            if outFile is not None:
+                out = str(outFile) if len(self._sources) == 1 else \
+                    f"{outFile}.{i}.html"
+            docs.append(render_report(src, out, title=title))
+        return docs

@@ -265,3 +265,62 @@ class TestEarlyStopping:
         assert os.path.exists(tmp_path / "latestModel.npz")
         best = result.getBestModel()
         assert math.isfinite(best.score(_toy_data()))
+
+
+class TestUIReport:
+    """UIServer/render_report (reference: deeplearning4j-ui dashboard —
+    here a self-contained HTML artifact rendered from StatsListener
+    JSONL)."""
+
+    def _train_with_stats(self, tmp_path):
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           MultiLayerNetwork, DenseLayer,
+                                           OutputLayer, Adam)
+        from deeplearning4j_tpu.optimize import StatsListener
+
+        log = str(tmp_path / "stats.jsonl")
+        conf = (NeuralNetConfiguration.Builder().seed(1).updater(Adam(1e-2))
+                .list()
+                .layer(DenseLayer(nOut=8, activation="tanh"))
+                .layer(OutputLayer(nOut=2, activation="softmax"))
+                .setInputType(InputType.feedForward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        net.setListeners(StatsListener(logFile=log, frequency=1,
+                                       collectHistograms=True))
+        rng = np.random.RandomState(0)
+        x = rng.randn(16, 4).astype("float32")
+        y = np.eye(2, dtype="float32")[rng.randint(0, 2, 16)]
+        for _ in range(12):
+            net.fit(x, y)
+        return log
+
+    def test_render_from_training_run(self, tmp_path):
+        from deeplearning4j_tpu.optimize import UIServer
+
+        log = self._train_with_stats(tmp_path)
+        out = str(tmp_path / "report.html")
+        srv = UIServer.getInstance()
+        srv._sources = []  # isolate the singleton across tests
+        docs = srv.attach(log).render(outFile=out)
+        assert len(docs) == 1
+        html_doc = open(out).read()
+        assert "<polyline" in html_doc           # score chart drawn
+        assert "score vs iteration" in html_doc
+        assert "final score" in html_doc
+        assert "mean |param|" in html_doc        # histograms collected
+
+    def test_attach_listener_object_and_empty_log(self, tmp_path):
+        from deeplearning4j_tpu.optimize import StatsListener, UIServer, \
+            render_report
+
+        log = str(tmp_path / "empty.jsonl")
+        open(log, "w").close()
+        doc = render_report(log)
+        assert "not enough data" in doc
+        lst = StatsListener(logFile=log)
+        srv = UIServer.getInstance()
+        srv._sources = []
+        srv.attach(lst)
+        assert srv._sources == [log]
+        with pytest.raises(ValueError, match="logFile"):
+            srv.attach(StatsListener())

@@ -587,3 +587,89 @@ class TestParallelInference:
         np.testing.assert_allclose(pi.output(x).toNumpy(),
                                    net.outputSingle(x).toNumpy(),
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestThresholdGradientSharing:
+    """gradient_compression='threshold' (reference: Strom 2015 — the
+    sparse, error-compensated update algorithm behind upstream
+    SharedTrainingMaster's threshold encoding)."""
+
+    def _mlp(self, seed=5):
+        conf = (NeuralNetConfiguration.Builder().seed(seed)
+                .updater(Sgd(0.5)).activation("tanh").list()
+                .layer(DenseLayer(nOut=16))
+                .layer(OutputLayer(nOut=3, activation="softmax"))
+                .setInputType(InputType.feedForward(8)).build())
+        return MultiLayerNetwork(conf).init()
+
+    def _data(self, n=32, seed=0):
+        rng = np.random.RandomState(seed)
+        yi = rng.randint(0, 3, n)
+        x = (np.eye(3)[yi] @ np.array([[2.0] * 8, [-2.0] * 8, [0.0] * 8])
+             + 0.1 * rng.randn(n, 8)).astype("float32")
+        return x, np.eye(3, dtype="float32")[yi]
+
+    def test_huge_threshold_transmits_nothing(self):
+        from deeplearning4j_tpu.parallel import ParallelWrapper
+
+        net = self._mlp()
+        before = jax.tree_util.tree_map(np.asarray, net._params)
+        pw = ParallelWrapper(net, gradient_compression="threshold",
+                             threshold=1e9)
+        x, y = self._data()
+        pw.fit(x, y)
+        after = net._params
+        for b, a in zip(jax.tree_util.tree_leaves(before),
+                        jax.tree_util.tree_leaves(after)):
+            np.testing.assert_array_equal(np.asarray(b), np.asarray(a))
+        # ...but the gradient is not lost: it sits in the residual
+        assert max(float(jnp.max(jnp.abs(l))) for l in
+                   jax.tree_util.tree_leaves(pw._residual)) > 0
+
+    def test_error_feedback_flushes_small_gradients(self):
+        """Per-step gradients below the threshold still reach the params
+        once their residual accumulates past it — without error feedback
+        a too-large threshold would stall training forever."""
+        from deeplearning4j_tpu.parallel import ParallelWrapper
+
+        net = self._mlp()
+        pw = ParallelWrapper(net, gradient_compression="threshold",
+                             threshold=0.05)
+        x, y = self._data()
+        first = None
+        for _ in range(40):
+            pw.fit(x, y)
+            first = first if first is not None else net.score()
+        assert np.isfinite(net.score())
+        assert net.score() < 0.5 * first, (first, net.score())
+
+    def test_threshold_converges_comparable_to_dense(self):
+        from deeplearning4j_tpu.parallel import ParallelWrapper
+
+        x, y = self._data()
+        dense = self._mlp(seed=5)
+        ParallelWrapper(dense).fit(x, y)
+        net = self._mlp(seed=5)
+        pw = ParallelWrapper(net, gradient_compression="threshold",
+                             threshold=1e-2)
+        for _ in range(100):
+            pw.fit(x, y)
+        # sign-style +-t updates converge slower than dense psum per step
+        # (the trade upstream makes for sparse wire traffic), but must
+        # still reach a good fit on separable data
+        assert net.score() < 0.25, net.score()
+
+    def test_bad_compression_name_rejected(self):
+        from deeplearning4j_tpu.parallel import ParallelWrapper
+
+        with pytest.raises(ValueError, match="gradient_compression"):
+            ParallelWrapper(self._mlp(), gradient_compression="sparse")
+
+    def test_shared_master_threshold_algorithm_arg(self):
+        from deeplearning4j_tpu.parallel import SharedTrainingMaster
+
+        m = SharedTrainingMaster(self._mlp(), thresholdAlgorithm=1e-2)
+        assert m.gradient_compression == "threshold"
+        assert m.threshold == 1e-2
+        # default (no algorithm given) stays int8
+        assert SharedTrainingMaster(self._mlp()).gradient_compression == "int8"
