@@ -67,7 +67,6 @@ def bench_resnet50():
                                       ("images_per_sec", "step_ms", "mfu")}
     except Exception as e:
         rec["stem_space_to_depth"] = {"error": f"{type(e).__name__}: {e}"[:200]}
-    rec["stem"] = "standard"
     return rec
 
 

@@ -9,6 +9,8 @@ serializable (graph JSON stores the op name, not the callable).
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -698,3 +700,43 @@ def _random_bernoulli(shape=None, p=0.5, key=None, dtype="float32"):
 def _random_exponential(shape=None, lambda_=1.0, key=None, dtype="float32"):
     dt = jnp.dtype(dtype)
     return jax.random.exponential(key, tuple(shape)).astype(dt) / lambda_
+
+
+@op("nonMaxSuppression")
+def _non_max_suppression(boxes, scores, maxOutputSize=10, iouThreshold=0.5,
+                         scoreThreshold=float("-inf")):
+    """Greedy NMS as a fixed-size jittable program (reference: libnd4j
+    non_max_suppression / SDImage.nonMaxSuppression). boxes [N,4] as
+    (y1,x1,y2,x2), scores [N] -> selected indices [maxOutputSize] int32,
+    -1-padded. Data-dependent selection count becomes a static
+    maxOutputSize loop with masking — the TPU-compatible form of the
+    reference's dynamic-length output."""
+    boxes = boxes.astype(jnp.float32)
+    n = boxes.shape[0]
+    y1, x1, y2, x2 = (boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3])
+    area = jnp.maximum(y2 - y1, 0.0) * jnp.maximum(x2 - x1, 0.0)
+
+    def iou_with(j):
+        iy1 = jnp.maximum(y1, y1[j])
+        ix1 = jnp.maximum(x1, x1[j])
+        iy2 = jnp.minimum(y2, y2[j])
+        ix2 = jnp.minimum(x2, x2[j])
+        inter = jnp.maximum(iy2 - iy1, 0.0) * jnp.maximum(ix2 - ix1, 0.0)
+        return inter / jnp.maximum(area + area[j] - inter, 1e-10)
+
+    def body(i, state):
+        sel, alive = state
+        masked = jnp.where(alive, scores.astype(jnp.float32), -jnp.inf)
+        j = jnp.argmax(masked)
+        valid = jnp.isfinite(masked[j])  # anything left to select?
+        alive = alive & (iou_with(j) <= iouThreshold) & \
+            (jnp.arange(n) != j)
+        sel = sel.at[i].set(jnp.where(valid, j, -1).astype(jnp.int32))
+        return sel, alive
+
+    sel0 = jnp.full((int(maxOutputSize),), -1, jnp.int32)
+    alive0 = jnp.ones((n,), bool)
+    if math.isfinite(scoreThreshold):
+        alive0 = alive0 & (scores > scoreThreshold)
+    sel, _ = lax.fori_loop(0, int(maxOutputSize), body, (sel0, alive0))
+    return sel

@@ -1336,6 +1336,15 @@ class _ImageOps(_NS):
     def rgbToHsv(self, x, name=None):
         return self._mk("rgbToHsv", [x], name=name)
 
+    def nonMaxSuppression(self, boxes, scores, maxOutputSize=10,
+                          iouThreshold=0.5, scoreThreshold=float("-inf"),
+                          name=None):
+        return self._mk("nonMaxSuppression", [boxes, scores],
+                        {"maxOutputSize": int(maxOutputSize),
+                         "iouThreshold": float(iouThreshold),
+                         "scoreThreshold": float(scoreThreshold)},
+                        name=name)
+
 
 class _LinalgOps(_NS):
     """Reference: ops.SDLinalg."""

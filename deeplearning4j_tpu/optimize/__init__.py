@@ -17,6 +17,7 @@ from deeplearning4j_tpu.optimize.listeners import (
 )
 from deeplearning4j_tpu.optimize.ui import UIServer, render_report
 from deeplearning4j_tpu.optimize.earlystopping import (
+    EarlyStoppingParallelTrainer,
     EarlyStoppingConfiguration,
     EarlyStoppingTrainer,
     EarlyStoppingGraphTrainer,
@@ -42,5 +43,5 @@ __all__ = [
     "BestScoreEpochTerminationCondition", "MaxScoreIterationTerminationCondition",
     "MaxTimeIterationTerminationCondition", "DataSetLossCalculator",
     "InMemoryModelSaver", "LocalFileModelSaver",
-    "UIServer", "render_report",
+    "UIServer", "render_report", "EarlyStoppingParallelTrainer",
 ]

@@ -458,3 +458,62 @@ class EarlyStoppingTrainer:
 
 class EarlyStoppingGraphTrainer(EarlyStoppingTrainer):
     """Reference API parity alias (earlystopping.trainer.EarlyStoppingGraphTrainer)."""
+
+
+class _ParallelModelFacade:
+    """Model-shaped view of a parallel trainer: fit() dispatches the
+    sharded step, every other attribute (score, listeners, params,
+    snapshot state) comes from the wrapped network, which the wrapper
+    keeps replicated across the mesh."""
+
+    def __init__(self, wrapper):
+        object.__setattr__(self, "_wrapper", wrapper)
+        object.__setattr__(self, "_net", wrapper.net)
+
+    def fit(self, data, *a, **kw):
+        return self._wrapper.fit(data, *a, **kw)
+
+    def __getattr__(self, name):
+        if name in ("_net", "_wrapper"):
+            # copy/pickle can materialize the facade without __init__;
+            # a bare lookup must fail instead of recursing
+            raise AttributeError(name)
+        return getattr(self._net, name)
+
+    def __setattr__(self, name, value):
+        # writes must reach the real net too (model savers restore
+        # _params/_states onto "the model", trainers reset _listeners);
+        # a facade-local write would leave methods reading live weights
+        if name in ("_net", "_wrapper"):
+            object.__setattr__(self, name, value)
+        else:
+            setattr(self._net, name, value)
+
+    def __copy__(self):
+        # model savers copy.copy "the model" and restore a snapshot onto
+        # the copy; unwrap so that lands on a detached net copy, not on
+        # the live net shared through the facade
+        import copy
+
+        return copy.copy(self._net)
+
+
+class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
+    """Early stopping over a data-parallel trainer (reference:
+    org.deeplearning4j.parallelism.EarlyStoppingParallelTrainer — there a
+    ParallelWrapper of per-GPU replicas, here one mesh-sharded SPMD step).
+
+    Pass an existing ParallelWrapper/SharedTrainingMaster as `wrapper`,
+    or let it build a dense ParallelWrapper over `mesh`/all devices.
+    """
+
+    def __init__(self, earlyStoppingConfiguration, model, trainData,
+                 wrapper=None, mesh=None, **wrapper_kw):
+        from deeplearning4j_tpu.parallel import ParallelWrapper
+
+        if wrapper is None:
+            wrapper = ParallelWrapper(model, mesh=mesh, **wrapper_kw)
+        elif wrapper.net is not model:
+            raise ValueError("wrapper must wrap the same model instance")
+        super().__init__(earlyStoppingConfiguration,
+                         _ParallelModelFacade(wrapper), trainData)

@@ -324,3 +324,63 @@ class TestUIReport:
         assert srv._sources == [log]
         with pytest.raises(ValueError, match="logFile"):
             srv.attach(StatsListener())
+
+
+class TestEarlyStoppingParallel:
+    """EarlyStoppingParallelTrainer (reference: parallelism.
+    EarlyStoppingParallelTrainer): epoch loop drives the mesh-sharded DP
+    step, scoring/selection sees the replicated net."""
+
+    def test_parallel_early_stopping_max_epochs(self):
+        from deeplearning4j_tpu.optimize import EarlyStoppingParallelTrainer
+
+        net = _toy_net()
+        conf = (EarlyStoppingConfiguration.Builder()
+                .epochTerminationConditions(MaxEpochsTerminationCondition(3))
+                .scoreCalculator(DataSetLossCalculator(_iterator(seed=1)))
+                .modelSaver(InMemoryModelSaver())
+                .build())
+        result = EarlyStoppingParallelTrainer(conf, net, _iterator()).fit()
+        assert result.terminationReason == \
+            TerminationReason.EpochTerminationCondition
+        assert result.totalEpochs == 3
+        assert result.getBestModel() is not None
+        assert all(np.isfinite(s) for s in result.scoreVsEpoch.values())
+
+    def test_wrapper_mismatch_rejected(self):
+        from deeplearning4j_tpu.optimize import EarlyStoppingParallelTrainer
+        from deeplearning4j_tpu.parallel import ParallelWrapper
+
+        conf = (EarlyStoppingConfiguration.Builder()
+                .epochTerminationConditions(MaxEpochsTerminationCondition(1))
+                .modelSaver(InMemoryModelSaver())
+                .build())
+        other = ParallelWrapper(_toy_net())
+        with pytest.raises(ValueError, match="same model"):
+            EarlyStoppingParallelTrainer(conf, _toy_net(), _iterator(),
+                                         wrapper=other)
+
+    def test_best_model_snapshot_detached_from_live_net(self):
+        """getBestModel() must return BEST-epoch weights even when later
+        epochs are worse, and restoring it must not clobber the live
+        net (write-through facade + unwrap-on-copy)."""
+        from deeplearning4j_tpu.optimize import EarlyStoppingParallelTrainer
+
+        net = _toy_net(lr=0.5)  # big lr: score moves every epoch
+        conf = (EarlyStoppingConfiguration.Builder()
+                .epochTerminationConditions(MaxEpochsTerminationCondition(4))
+                .scoreCalculator(DataSetLossCalculator(_iterator(seed=1)))
+                .modelSaver(InMemoryModelSaver())
+                .build())
+        result = EarlyStoppingParallelTrainer(conf, net, _iterator()).fit()
+        best = result.getBestModel()
+        assert best is not net
+        calc = DataSetLossCalculator(_iterator(seed=1))
+        best_score = calc.calculateScore(best)
+        # the returned model must reproduce the recorded best score, not
+        # whatever the live net ended on
+        np.testing.assert_allclose(best_score, result.bestModelScore,
+                                   rtol=1e-5)
+        # and the guard listener must not linger on the live net
+        assert all(type(l).__name__ != "_IterationGuard"
+                   for l in net._listeners)

@@ -577,3 +577,43 @@ class TestControlFlowSerializationHardening:
 def io_bytes(b):
     import io
     return io.BytesIO(b)
+
+
+class TestNonMaxSuppression:
+    """sd.image.nonMaxSuppression (reference: SDImage / libnd4j
+    non_max_suppression) — fixed-size jittable greedy NMS."""
+
+    def _boxes(self):
+        boxes = np.array([[0, 0, 1, 1],        # top score
+                          [0, 0, 1.05, 1.05],  # IoU ~0.9 with 0: suppressed
+                          [2, 2, 3, 3],        # disjoint: kept
+                          [0, 0, 0.4, 0.4]],   # inside 0, IoU 0.16: kept
+                         "float32")
+        scores = np.array([0.9, 0.8, 0.7, 0.6], "float32")
+        return boxes, scores
+
+    def test_greedy_selection_and_padding(self):
+        sd = SameDiff.create()
+        boxes, scores = self._boxes()
+        out = sd.image.nonMaxSuppression(sd.constant(boxes),
+                                         sd.constant(scores),
+                                         maxOutputSize=4, iouThreshold=0.5,
+                                         name="nms")
+        np.testing.assert_array_equal(out.eval().toNumpy(), [0, 2, 3, -1])
+
+    def test_score_threshold_filters(self):
+        sd = SameDiff.create()
+        boxes, scores = self._boxes()
+        out = sd.image.nonMaxSuppression(sd.constant(boxes),
+                                         sd.constant(scores),
+                                         maxOutputSize=4, iouThreshold=0.5,
+                                         scoreThreshold=0.65, name="nms")
+        np.testing.assert_array_equal(out.eval().toNumpy(), [0, 2, -1, -1])
+
+    def test_max_output_truncates(self):
+        sd = SameDiff.create()
+        boxes, scores = self._boxes()
+        out = sd.image.nonMaxSuppression(sd.constant(boxes),
+                                         sd.constant(scores),
+                                         maxOutputSize=1, name="nms")
+        np.testing.assert_array_equal(out.eval().toNumpy(), [0])
