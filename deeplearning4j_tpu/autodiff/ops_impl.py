@@ -405,6 +405,14 @@ def _conv1d(x, w, b=None, stride=1, padding=((0, 0),), dilation=1):
                         dilation=dilation)
 
 
+@op("conv3d")
+def _conv3d(x, w, b=None, stride=(1, 1, 1), padding=((0, 0),) * 3,
+            dilation=(1, 1, 1)):
+    return _conv.conv3d(x, w, b, stride=tuple(stride),
+                        padding=tuple(tuple(p) for p in padding),
+                        dilation=tuple(dilation))
+
+
 @op("deconv2d")
 def _deconv2d(x, w, b=None, stride=(1, 1), padding=((0, 0), (0, 0)),
               dilation=(1, 1)):
@@ -713,6 +721,8 @@ def _non_max_suppression(boxes, scores, maxOutputSize=10, iouThreshold=0.5,
     reference's dynamic-length output."""
     boxes = boxes.astype(jnp.float32)
     n = boxes.shape[0]
+    if n == 0:  # no candidates is a normal detection outcome, not an error
+        return jnp.full((int(maxOutputSize),), -1, jnp.int32)
     y1, x1, y2, x2 = (boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3])
     area = jnp.maximum(y2 - y1, 0.0) * jnp.maximum(x2 - x1, 0.0)
 
@@ -735,7 +745,9 @@ def _non_max_suppression(boxes, scores, maxOutputSize=10, iouThreshold=0.5,
         return sel, alive
 
     sel0 = jnp.full((int(maxOutputSize),), -1, jnp.int32)
-    alive0 = jnp.ones((n,), bool)
+    # NaN scores (a diverged detector head) must not poison argmax and
+    # suppress the valid boxes — drop them up front
+    alive0 = jnp.isfinite(scores)
     if math.isfinite(scoreThreshold):
         alive0 = alive0 & (scores > scoreThreshold)
     sel, _ = lax.fori_loop(0, int(maxOutputSize), body, (sel0, alive0))

@@ -1223,6 +1223,17 @@ class _CNNOps(_NS):
                          "padding": [list(p) for p in padding],
                          "dilation": dilation}, name=name)
 
+    def conv3d(self, x, w, b=None, stride=(1, 1, 1),
+               padding=((0, 0), (0, 0), (0, 0)), dilation=(1, 1, 1),
+               name=None):
+        """NDHWC x [B,D,H,W,C], w [kd,kh,kw,I,O] (reference: SDCNN.conv3d
+        / libnd4j conv3dnew)."""
+        ins = [x, w] + ([b] if b is not None else [])
+        return self._mk("conv3d", ins,
+                        {"stride": list(stride),
+                         "padding": [list(p) for p in padding],
+                         "dilation": list(dilation)}, name=name)
+
     def deconv2d(self, x, w, b=None, stride=(1, 1), padding=((0, 0), (0, 0)),
                  name=None):
         ins = [x, w] + ([b] if b is not None else [])

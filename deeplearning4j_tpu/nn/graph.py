@@ -208,10 +208,21 @@ class ComputationGraph:
         return loss, new_states
 
     def _train_step(self, params, upd_states, states, iteration, inputs, labels,
-                    key, fmasks, lmasks, use_carries=False):
+                    key, fmasks, lmasks, use_carries=False,
+                    grad_transform=None, loss_transform=None,
+                    state_transform=None):
+        """The *_transform hooks mirror MultiLayerNetwork._train_step:
+        distributed wrappers (parallel.trainer) splice in cross-shard
+        allreduce/pmean without duplicating the updater loop."""
         (loss, new_states), grads = jax.value_and_grad(
             self._loss_fn, has_aux=True)(params, states, inputs, labels, key,
                                          fmasks, lmasks, use_carries)
+        if grad_transform is not None:
+            grads = grad_transform(grads)
+        if loss_transform is not None:
+            loss = loss_transform(loss)
+        if state_transform is not None:
+            new_states = state_transform(new_states)
         glist = _grad_normalize([grads[n] for n in self._layer_names],
                                 self.conf.gradientNormalization,
                                 self.conf.gradientNormalizationThreshold)

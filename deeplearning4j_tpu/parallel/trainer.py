@@ -45,15 +45,20 @@ class ParallelWrapper:
     """
 
     def __init__(self, net, mesh=None, gradient_compression=None,
-                 batch_axis=_mesh.DATA_AXIS, threshold=1e-3):
+                 batch_axis=_mesh.DATA_AXIS, threshold=1e-3,
+                 targetSparsity=None):
         self.net = net
         self.mesh = mesh or _mesh.data_parallel_mesh()
         self.batch_axis = batch_axis
         self.gradient_compression = gradient_compression
         self.threshold = float(threshold)
+        # reference: AdaptiveThresholdAlgorithm — adapt the threshold so
+        # the transmitted fraction tracks this target (None = fixed)
+        self.targetSparsity = None if targetSparsity is None \
+            else float(targetSparsity)
         self._repl = NamedSharding(self.mesh, P())
         self._jit = None
-        self._residual = None  # threshold mode: per-replica error feedback
+        self._residual = None  # threshold mode: (error feedback, threshold)
         if gradient_compression not in (None, "int8", "threshold"):
             raise ValueError(
                 "gradient_compression must be None, 'int8' or 'threshold'")
@@ -76,13 +81,17 @@ class ParallelWrapper:
         n = self.net
         if self.gradient_compression == "threshold":
             # per-replica residuals: leading device axis, sharded over the
-            # mesh so each replica carries its own error feedback
+            # mesh so each replica carries its own error feedback; the
+            # (possibly adaptive) threshold rides along replicated
             ndev = self.mesh.shape[self.batch_axis]
-            self._residual = jax.device_put(
+            res = jax.device_put(
                 jax.tree_util.tree_map(
                     lambda p: jnp.zeros((ndev,) + p.shape, p.dtype),
                     n._params),
                 NamedSharding(self.mesh, P(self.batch_axis)))
+            t = jax.device_put(jnp.asarray(self.threshold, jnp.float32),
+                               self._repl)
+            self._residual = (res, t)
             self._jit = jax.jit(self._threshold_step,
                                 donate_argnums=(0, 1, 2, 3))
             return
@@ -147,30 +156,47 @@ class ParallelWrapper:
         from jax import shard_map
 
         n = self.net
-        mesh, ax, t = self.mesh, self.batch_axis, self.threshold
+        mesh, ax = self.mesh, self.batch_axis
+        target = self.targetSparsity
 
         def sync_states(states):
             return jax.tree_util.tree_map(
                 lambda a: jax.lax.pmean(a, ax)
                 if jnp.issubdtype(a.dtype, jnp.inexact) else a, states)
 
-        def shard_step(params_r, upd_r, states_r, res_s, it_r, x_s, y_s,
+        def shard_step(params_r, upd_r, states_r, res_in, it_r, x_s, y_s,
                        key_r, fm_s, lm_s):
+            res_s, t = res_in
             new_res_cell = []
 
             def encode_all(grads):
                 g_leaves, treedef = jax.tree_util.tree_flatten(grads)
                 r_leaves = jax.tree_util.tree_flatten(res_s)[0]
                 means, new_rs = [], []
+                sent = total = 0.0
                 for g, r in zip(g_leaves, r_leaves):
                     acc = g + r[0].astype(g.dtype)  # drop local dev axis
-                    enc = jnp.where(jnp.abs(acc) >= t,
-                                    jnp.sign(acc) * jnp.asarray(t, g.dtype),
+                    hit = jnp.abs(acc) >= t.astype(g.dtype)
+                    enc = jnp.where(hit,
+                                    jnp.sign(acc) * t.astype(g.dtype),
                                     jnp.zeros((), g.dtype))
                     new_rs.append((acc - enc)[None].astype(r.dtype))
                     means.append(jax.lax.psum(enc, ax) / jax.lax.psum(1, ax))
+                    sent = sent + jnp.sum(hit)
+                    total = total + hit.size
+                if target is None:
+                    new_t = t
+                else:
+                    # adaptive threshold (reference:
+                    # AdaptiveThresholdAlgorithm): multiplicative steps
+                    # keep the mean transmitted fraction near the target
+                    frac = jax.lax.pmean(sent / total, ax)
+                    new_t = jnp.where(
+                        frac > 1.25 * target, t * 1.1,
+                        jnp.where(frac < 0.8 * target, t / 1.1, t))
                 new_res_cell.append(
-                    jax.tree_util.tree_unflatten(treedef, new_rs))
+                    (jax.tree_util.tree_unflatten(treedef, new_rs),
+                     new_t.astype(jnp.float32)))
                 return jax.tree_util.tree_unflatten(treedef, means)
 
             out = n._train_step(
@@ -183,10 +209,11 @@ class ParallelWrapper:
         spec_b = P(ax)
         return shard_map(
             shard_step, mesh=mesh,
-            in_specs=(P(), P(), P(), spec_b, P(), spec_b, spec_b, P(),
+            in_specs=(P(), P(), P(), (spec_b, P()), P(), spec_b, spec_b,
+                      P(),
                       spec_b if fmask is not None else P(),
                       spec_b if lmask is not None else P()),
-            out_specs=(P(), P(), P(), P(), spec_b),
+            out_specs=(P(), P(), P(), P(), (spec_b, P())),
             check_vma=False,
         )(params, upd_states, states, residual, iteration, x, y, key,
           fmask, lmask)
@@ -213,6 +240,11 @@ class ParallelWrapper:
             n._epoch += 1
         return self
 
+    def _is_graph(self):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        return isinstance(self.net, ComputationGraph)
+
     def _fit_batch(self, ds):
         n = self.net
         x = _unwrap(ds.getFeatures())
@@ -229,6 +261,18 @@ class ParallelWrapper:
             fmask = jax.device_put(fmask, self._batch_sharding(fmask))
         if lmask is not None:
             lmask = jax.device_put(lmask, self._batch_sharding(lmask))
+        if self._is_graph():
+            # ComputationGraph._train_step takes an inputs dict + labels
+            # list (single-input/-output graphs through this wrapper)
+            if len(n.conf.networkInputs) != 1 or len(n.conf.networkOutputs) != 1:
+                raise ValueError(
+                    "ParallelWrapper supports single-input/single-output "
+                    "ComputationGraphs; use MultiDataSet-aware training "
+                    "directly for multi-IO graphs")
+            x = {n.conf.networkInputs[0]: x}
+            y = [y]
+            fmask = None if fmask is None else {n.conf.networkInputs[0]: fmask}
+            lmask = None if lmask is None else [lmask]
         key = jax.random.fold_in(jax.random.key(n.conf.seed ^ 0x5EED), n._iteration)
         if self._residual is not None:
             (n._params, n._upd_states, n._states, loss,

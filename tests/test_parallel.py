@@ -624,7 +624,7 @@ class TestThresholdGradientSharing:
             np.testing.assert_array_equal(np.asarray(b), np.asarray(a))
         # ...but the gradient is not lost: it sits in the residual
         assert max(float(jnp.max(jnp.abs(l))) for l in
-                   jax.tree_util.tree_leaves(pw._residual)) > 0
+                   jax.tree_util.tree_leaves(pw._residual[0])) > 0
 
     def test_error_feedback_flushes_small_gradients(self):
         """Per-step gradients below the threshold still reach the params
@@ -673,3 +673,86 @@ class TestThresholdGradientSharing:
         assert m.threshold == 1e-2
         # default (no algorithm given) stays int8
         assert SharedTrainingMaster(self._mlp()).gradient_compression == "int8"
+
+    def test_adaptive_threshold_tracks_target_sparsity(self):
+        """targetSparsity (reference: AdaptiveThresholdAlgorithm): a
+        wildly-too-large starting threshold must adapt DOWN until real
+        transmission resumes; a tiny one must adapt UP."""
+        from deeplearning4j_tpu.parallel import ParallelWrapper
+
+        x, y = self._data()
+
+        net = self._mlp()
+        pw = ParallelWrapper(net, gradient_compression="threshold",
+                             threshold=100.0, targetSparsity=0.2)
+        for _ in range(30):
+            pw.fit(x, y)
+        t_down = float(pw._residual[1])
+        assert t_down < 100.0 / 5, t_down  # decayed by >5x
+
+        net2 = self._mlp()
+        pw2 = ParallelWrapper(net2, gradient_compression="threshold",
+                              threshold=1e-8, targetSparsity=0.2)
+        for _ in range(30):
+            pw2.fit(x, y)
+        t_up = float(pw2._residual[1])
+        assert t_up > 1e-8 * 5, t_up  # grew by >5x
+        assert np.isfinite(net.score()) and np.isfinite(net2.score())
+
+
+class TestComputationGraphDataParallel:
+    """ParallelWrapper over a ComputationGraph (single-IO): dense parity
+    with single-device training, compressed modes via the graph-side
+    transform hooks."""
+
+    def _graph(self, seed=11):
+        from deeplearning4j_tpu.nn import ComputationGraph
+
+        g = (NeuralNetConfiguration.Builder().seed(seed).updater(Sgd(0.1))
+             .activation("tanh").graphBuilder().addInputs("in")
+             .addLayer("h", DenseLayer(nOut=16), "in")
+             .addLayer("out", OutputLayer(nOut=3, activation="softmax"), "h")
+             .setOutputs("out")
+             .setInputTypes(InputType.feedForward(4)).build())
+        return ComputationGraph(g).init()
+
+    def test_dense_matches_single_device(self):
+        x, y, _ = _data(64)
+        a = self._graph()
+        for _ in range(4):
+            a.fit(x, y)
+        b = self._graph()
+        pw = ParallelWrapper(b)
+        for _ in range(4):
+            pw.fit(x, y)
+        pa = np.concatenate([np.asarray(l).ravel() for l in
+                             jax.tree_util.tree_leaves(a._params)])
+        pb = np.concatenate([np.asarray(l).ravel() for l in
+                             jax.tree_util.tree_leaves(b._params)])
+        np.testing.assert_allclose(pa, pb, rtol=1e-5, atol=1e-6)
+
+    def test_threshold_mode_trains_graph(self):
+        x, y, _ = _data(64)
+        net = self._graph()
+        pw = ParallelWrapper(net, gradient_compression="threshold",
+                             threshold=1e-2)
+        first = None
+        for _ in range(30):
+            pw.fit(x, y)
+            first = first if first is not None else net.score()
+        assert np.isfinite(net.score()) and net.score() < first
+
+    def test_multi_io_graph_rejected_clearly(self):
+        from deeplearning4j_tpu.nn import ComputationGraph, MergeVertex
+
+        g = (NeuralNetConfiguration.Builder().seed(1).updater(Sgd(0.1))
+             .graphBuilder().addInputs("a", "b")
+             .addVertex("m", MergeVertex(), "a", "b")
+             .addLayer("out", OutputLayer(nOut=2, activation="softmax"), "m")
+             .setOutputs("out")
+             .setInputTypes(InputType.feedForward(2), InputType.feedForward(2))
+             .build())
+        net = ComputationGraph(g).init()
+        x, y, _ = _data(64)
+        with pytest.raises(ValueError, match="single-input"):
+            ParallelWrapper(net).fit(x[:, :2], y)

@@ -617,3 +617,38 @@ class TestNonMaxSuppression:
                                          sd.constant(scores),
                                          maxOutputSize=1, name="nms")
         np.testing.assert_array_equal(out.eval().toNumpy(), [0])
+
+
+def test_cnn_namespace_conv3d():
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", jnp.float64, 1, 5, 6, 7, 2)  # NDHWC
+    rs = np.random.RandomState(0)
+    w = sd.var("w", rs.rand(3, 3, 3, 2, 4) * 0.1)  # DHWIO
+    c = sd.cnn.conv3d(x, w, padding=((1, 1), (1, 1), (1, 1)), name="c")
+    xv = rs.rand(1, 5, 6, 7, 2)
+    out = sd.output({"x": xv}, ["c"])
+    assert out["c"].shape() == (1, 5, 6, 7, 4)
+    # numeric oracle at one output position: pure correlation sum
+    import jax.numpy as _jnp
+    from jax import lax as _lax
+    ref = _lax.conv_general_dilated(
+        _jnp.asarray(xv), _jnp.asarray(sd.getVariable("w").getArr().toNumpy()),
+        (1, 1, 1), ((1, 1),) * 3,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+    np.testing.assert_allclose(out["c"].toNumpy(), np.asarray(ref), rtol=1e-6)
+
+
+def test_nms_nan_scores_and_empty_input():
+    # a NaN score (diverged head) must not poison selection
+    sd = SameDiff.create()
+    boxes = np.array([[0, 0, 1, 1], [5, 5, 6, 6], [2, 2, 3, 3]], "float32")
+    scores = np.array([0.9, np.nan, 0.7], "float32")
+    out = sd.image.nonMaxSuppression(sd.constant(boxes), sd.constant(scores),
+                                     maxOutputSize=3, name="nms")
+    np.testing.assert_array_equal(out.eval().toNumpy(), [0, 2, -1])
+    # zero candidates is a normal outcome, not a crash
+    sd2 = SameDiff.create()
+    out2 = sd2.image.nonMaxSuppression(
+        sd2.constant(np.zeros((0, 4), "float32")),
+        sd2.constant(np.zeros((0,), "float32")), maxOutputSize=2, name="nms")
+    np.testing.assert_array_equal(out2.eval().toNumpy(), [-1, -1])

@@ -326,3 +326,26 @@ class TestZooUpstreamTail:
         y = np.eye(4, dtype="float32")[np.random.RandomState(1).randint(0, 4, 2)]
         net.fit(x, y)
         assert np.isfinite(net.score())
+
+    def test_facenet_converges(self):
+        """Convergence depth for the round-3 zoo additions: the center-
+        loss inception trunk must FIT, not merely construct (the other
+        three new models are covered by fit-smoke above; their per-iter
+        CPU cost is too high for a convergence loop in CI)."""
+        from deeplearning4j_tpu.zoo import FaceNetNN4Small2
+        from deeplearning4j_tpu.nn import Adam
+
+        rng = np.random.RandomState(0)
+        templates = rng.rand(3, 3, 64, 64).astype("float32")
+        yi = rng.randint(0, 3, 8)
+        x = 0.8 * templates[yi] + 0.2 * rng.rand(8, 3, 64, 64).astype("float32")
+        y = np.eye(3, dtype="float32")[yi]
+        net = FaceNetNN4Small2(numClasses=3, embeddingSize=16,
+                               inputShape=(3, 64, 64),
+                               updater=Adam(3e-4)).init()
+        first = None
+        for _ in range(10):
+            net.fit(x, y)
+            first = first if first is not None else net.score()
+        assert np.isfinite(net.score())
+        assert net.score() < 0.6 * first, (first, net.score())
