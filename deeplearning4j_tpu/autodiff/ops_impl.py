@@ -752,3 +752,162 @@ def _non_max_suppression(boxes, scores, maxOutputSize=10, iouThreshold=0.5,
         alive0 = alive0 & (scores > scoreThreshold)
     sel, _ = lax.fori_loop(0, int(maxOutputSize), body, (sel0, alive0))
     return sel
+
+
+# ---- reduction-style math long tail (reference: ops.SDMath — distance,
+# segment, counting and entropy ops backed by libnd4j reduce3 /
+# broadcastable kernels; here they are jnp compositions XLA fuses) ----
+
+def _axes(dimensions):
+    return tuple(dimensions) if dimensions else None
+
+
+@op("cosineSimilarity")
+def _cosine_sim(x, y, dimensions=None):
+    d = _axes(dimensions)
+    num = jnp.sum(x * y, axis=d)
+    den = jnp.sqrt(jnp.sum(jnp.square(x), axis=d)) * \
+        jnp.sqrt(jnp.sum(jnp.square(y), axis=d))
+    return num / jnp.maximum(den, 1e-12)
+
+
+@op("cosineDistance")
+def _cosine_dist(x, y, dimensions=None):
+    return 1.0 - _cosine_sim(x, y, dimensions)
+
+
+@op("euclideanDistance")
+def _euclidean(x, y, dimensions=None):
+    return jnp.sqrt(jnp.sum(jnp.square(x - y), axis=_axes(dimensions)))
+
+
+@op("manhattanDistance")
+def _manhattan(x, y, dimensions=None):
+    return jnp.sum(jnp.abs(x - y), axis=_axes(dimensions))
+
+
+@op("hammingDistance")
+def _hamming(x, y, dimensions=None):
+    return jnp.sum((x != y).astype(jnp.float32), axis=_axes(dimensions))
+
+
+@op("jaccardDistance")
+def _jaccard(x, y, dimensions=None):
+    d = _axes(dimensions)
+    mins = jnp.sum(jnp.minimum(x, y), axis=d)
+    maxs = jnp.sum(jnp.maximum(x, y), axis=d)
+    return 1.0 - mins / jnp.maximum(maxs, 1e-12)
+
+
+def _segment(reducer):
+    def run(data, segmentIds, numSegments=None):
+        if numSegments is None:
+            # the executor compiles every graph (static shapes); a
+            # data-dependent segment count cannot exist under trace
+            raise ValueError(
+                "segment ops require numSegments (the SameDiff executor "
+                "compiles graphs with static output shapes)")
+        ids = segmentIds.astype(jnp.int32)
+        return reducer(data, ids, num_segments=int(numSegments))
+    return run
+
+
+for _n, _f in {
+    "segmentSum": jax.ops.segment_sum, "segmentMax": jax.ops.segment_max,
+    "segmentMin": jax.ops.segment_min, "segmentProd": jax.ops.segment_prod,
+}.items():
+    _reg(_n, _segment(_f))
+
+
+@op("segmentMean")
+def _segment_mean(data, segmentIds, numSegments=None):
+    if numSegments is None:
+        raise ValueError(
+            "segment ops require numSegments (the SameDiff executor "
+            "compiles graphs with static output shapes)")
+    ids = segmentIds.astype(jnp.int32)
+    s = jax.ops.segment_sum(data, ids, num_segments=int(numSegments))
+    c = jax.ops.segment_sum(jnp.ones_like(data), ids,
+                            num_segments=int(numSegments))
+    return s / jnp.maximum(c, 1.0)
+
+
+@op("confusionMatrix")
+def _confusion_matrix(labels, pred, numClasses=None, weights=None):
+    if numClasses is None:
+        raise ValueError(
+            "confusionMatrix requires numClasses (the SameDiff executor "
+            "compiles graphs with static output shapes)")
+    lab = labels.astype(jnp.int32).reshape(-1)
+    prd = pred.astype(jnp.int32).reshape(-1)
+    w = jnp.ones_like(lab, jnp.float32) if weights is None \
+        else weights.reshape(-1).astype(jnp.float32)
+    cm = jnp.zeros((int(numClasses), int(numClasses)), jnp.float32)
+    return cm.at[lab, prd].add(w)
+
+
+@op("confusionMatrixWeighted")
+def _confusion_matrix_weighted(labels, pred, weights, numClasses=None):
+    return _confusion_matrix(labels, pred, numClasses=numClasses,
+                             weights=weights)
+
+
+@op("zeroFraction")
+def _zero_fraction(x):
+    return jnp.mean((x == 0).astype(jnp.float32))
+
+
+@op("countNonZero")
+def _count_nonzero(x, dimensions=None, keepDims=False):
+    return jnp.sum((x != 0).astype(jnp.int64), axis=_axes(dimensions),
+                   keepdims=keepDims)
+
+
+@op("countZero")
+def _count_zero(x, dimensions=None, keepDims=False):
+    return jnp.sum((x == 0).astype(jnp.int64), axis=_axes(dimensions),
+                   keepdims=keepDims)
+
+
+@op("entropy")
+def _entropy(x, dimensions=None):
+    xs = jnp.where(x > 0, x, 1.0)  # 0*log(0) = 0 convention
+    return -jnp.sum(x * jnp.log(xs), axis=_axes(dimensions))
+
+
+@op("shannonEntropy")
+def _shannon_entropy(x, dimensions=None):
+    xs = jnp.where(x > 0, x, 1.0)
+    return -jnp.sum(x * jnp.log2(xs), axis=_axes(dimensions))
+
+
+@op("matchConditionCount")
+def _match_condition_count(x, condition="eq", value=0.0,
+                           dimensions=None, keepDims=False):
+    cmp = {"eq": jnp.equal, "neq": jnp.not_equal, "gt": jnp.greater,
+           "gte": jnp.greater_equal, "lt": jnp.less,
+           "lte": jnp.less_equal}[condition]
+    return jnp.sum(cmp(x, value).astype(jnp.int64),
+                   axis=_axes(dimensions), keepdims=keepDims)
+
+
+@op("iamax")
+def _iamax(x, dimensions=None):
+    axis = dimensions[0] if dimensions else None
+    return jnp.argmax(jnp.abs(x), axis=axis)
+
+
+@op("linspace")
+def _linspace(start=0.0, stop=1.0, num=10, dtype="float32"):
+    return jnp.linspace(start, stop, int(num), dtype=jnp.dtype(dtype))
+
+
+@op("range")
+def _range(start=0, limit=None, delta=1, dtype="float32"):
+    return jnp.arange(start, limit, delta, dtype=jnp.dtype(dtype))
+
+
+@op("meshgrid")
+def _meshgrid(*xs, indexing="xy"):
+    r = jnp.meshgrid(*xs, indexing=indexing)
+    return r[0] if len(r) == 1 else tuple(r)

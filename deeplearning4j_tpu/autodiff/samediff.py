@@ -1042,6 +1042,110 @@ class _MathOps(_NS):
     def logicalNot(self, x, name=None):
         return self._mk("not", [x], name=name)
 
+    # -- reduce3-style distance ops (reference: SDMath distance family) --
+    def _dist(self, opName, x, y, dimensions, name):
+        return self._mk(opName, [x, y],
+                        {"dimensions": list(dimensions) or None}, name=name)
+
+    def cosineSimilarity(self, x, y, *dimensions, name=None):
+        return self._dist("cosineSimilarity", x, y, dimensions, name)
+
+    def cosineDistance(self, x, y, *dimensions, name=None):
+        return self._dist("cosineDistance", x, y, dimensions, name)
+
+    def euclideanDistance(self, x, y, *dimensions, name=None):
+        return self._dist("euclideanDistance", x, y, dimensions, name)
+
+    def manhattanDistance(self, x, y, *dimensions, name=None):
+        return self._dist("manhattanDistance", x, y, dimensions, name)
+
+    def hammingDistance(self, x, y, *dimensions, name=None):
+        return self._dist("hammingDistance", x, y, dimensions, name)
+
+    def jaccardDistance(self, x, y, *dimensions, name=None):
+        return self._dist("jaccardDistance", x, y, dimensions, name)
+
+    # -- segment reductions (pass numSegments for jit: static shapes) --
+    def _seg(self, opName, data, ids, numSegments, name):
+        return self._mk(opName, [data, ids],
+                        {"numSegments": numSegments}, name=name)
+
+    def segmentSum(self, data, segmentIds, numSegments=None, name=None):
+        return self._seg("segmentSum", data, segmentIds, numSegments, name)
+
+    def segmentMax(self, data, segmentIds, numSegments=None, name=None):
+        return self._seg("segmentMax", data, segmentIds, numSegments, name)
+
+    def segmentMin(self, data, segmentIds, numSegments=None, name=None):
+        return self._seg("segmentMin", data, segmentIds, numSegments, name)
+
+    def segmentMean(self, data, segmentIds, numSegments=None, name=None):
+        return self._seg("segmentMean", data, segmentIds, numSegments, name)
+
+    def segmentProd(self, data, segmentIds, numSegments=None, name=None):
+        return self._seg("segmentProd", data, segmentIds, numSegments, name)
+
+    unsortedSegmentSum = segmentSum    # jax segment ops accept any order
+    unsortedSegmentMax = segmentMax
+    unsortedSegmentMin = segmentMin
+    unsortedSegmentMean = segmentMean
+    unsortedSegmentProd = segmentProd
+
+    def confusionMatrix(self, labels, pred, numClasses=None, weights=None,
+                        name=None):
+        ins = [labels, pred] + ([weights] if weights is not None else [])
+        kw = {"numClasses": numClasses}
+        if weights is None:
+            return self._mk("confusionMatrix", ins, kw, name=name)
+        return self._mk("confusionMatrixWeighted", ins, kw, name=name)
+
+    def zeroFraction(self, x, name=None):
+        return self._mk("zeroFraction", [x], name=name)
+
+    def countNonZero(self, x, *dimensions, keepDims=False, name=None):
+        return self._mk("countNonZero", [x],
+                        {"dimensions": list(dimensions) or None,
+                         "keepDims": keepDims}, name=name)
+
+    def countZero(self, x, *dimensions, keepDims=False, name=None):
+        return self._mk("countZero", [x],
+                        {"dimensions": list(dimensions) or None,
+                         "keepDims": keepDims}, name=name)
+
+    def entropy(self, x, *dimensions, name=None):
+        return self._mk("entropy", [x],
+                        {"dimensions": list(dimensions) or None}, name=name)
+
+    def shannonEntropy(self, x, *dimensions, name=None):
+        return self._mk("shannonEntropy", [x],
+                        {"dimensions": list(dimensions) or None}, name=name)
+
+    def matchConditionCount(self, x, condition, value, *dimensions,
+                            keepDims=False, name=None):
+        return self._mk("matchConditionCount", [x],
+                        {"condition": condition, "value": float(value),
+                         "dimensions": list(dimensions) or None,
+                         "keepDims": keepDims}, name=name)
+
+    def iamax(self, x, dimension=None, name=None):
+        return self._mk("iamax", [x],
+                        {"dimensions": None if dimension is None
+                         else [dimension]}, name=name)
+
+    def linspace(self, start, stop, num, dtype="float32", name=None):
+        return self._mk("linspace", [],
+                        {"start": float(start), "stop": float(stop),
+                         "num": int(num), "dtype": str(dtype)}, name=name)
+
+    def range(self, start, limit, delta=1, dtype="float32", name=None):
+        return self._mk("range", [],
+                        {"start": start, "limit": limit, "delta": delta,
+                         "dtype": str(dtype)}, name=name)
+
+    def meshgrid(self, *xs, indexing="xy", name=None):
+        return self._mk("meshgrid", list(xs), {"indexing": indexing},
+                        nOut=len(xs), name=name)
+
     def clipByValue(self, x, clipValueMin, clipValueMax, name=None):
         # bounds kept as-is; the op casts them to x's dtype (int tensors
         # must stay int)

@@ -335,6 +335,14 @@ class ParameterAveragingTrainingMaster(ParallelWrapper):
 
     def __init__(self, net, mesh=None, averagingFrequency=5,
                  batch_axis=_mesh.DATA_AXIS):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        if isinstance(net, ComputationGraph):
+            raise ValueError(
+                "ParameterAveragingTrainingMaster supports "
+                "MultiLayerNetwork; for ComputationGraph data-parallel "
+                "training use ParallelWrapper/SharedTrainingMaster "
+                "(single-input/-output graphs)")
         super().__init__(net, mesh=mesh, batch_axis=batch_axis)
         if int(averagingFrequency) < 1:
             raise ValueError("averagingFrequency must be >= 1")

@@ -652,3 +652,92 @@ def test_nms_nan_scores_and_empty_input():
         sd2.constant(np.zeros((0, 4), "float32")),
         sd2.constant(np.zeros((0,), "float32")), maxOutputSize=2, name="nms")
     np.testing.assert_array_equal(out2.eval().toNumpy(), [-1, -1])
+
+
+class TestMathLongTail:
+    """SDMath distance/segment/counting/entropy families (reference:
+    libnd4j reduce3 + segment kernels), each vs a numpy oracle."""
+
+    def test_distances(self):
+        rs = np.random.RandomState(0)
+        a, b = rs.rand(4, 6), rs.rand(4, 6)
+        sd = SameDiff.create()
+        x, y = sd.constant(a), sd.constant(b)
+        np.testing.assert_allclose(
+            sd.math.cosineSimilarity(x, y, 1).eval().toNumpy(),
+            np.sum(a * b, 1) / (np.linalg.norm(a, axis=1)
+                                * np.linalg.norm(b, axis=1)), rtol=1e-6)
+        np.testing.assert_allclose(
+            sd.math.euclideanDistance(x, y, 1).eval().toNumpy(),
+            np.linalg.norm(a - b, axis=1), rtol=1e-6)
+        np.testing.assert_allclose(
+            sd.math.manhattanDistance(x, y, 1).eval().toNumpy(),
+            np.abs(a - b).sum(1), rtol=1e-6)
+        np.testing.assert_allclose(
+            sd.math.cosineDistance(x, y, 1).eval().toNumpy(),
+            1 - sd.math.cosineSimilarity(x, y, 1).eval().toNumpy(), rtol=1e-6)
+        np.testing.assert_allclose(
+            sd.math.jaccardDistance(x, y, 1).eval().toNumpy(),
+            1 - np.minimum(a, b).sum(1) / np.maximum(a, b).sum(1), rtol=1e-6)
+        ai = (a > 0.5).astype(float)
+        bi = (b > 0.5).astype(float)
+        np.testing.assert_allclose(
+            sd.math.hammingDistance(sd.constant(ai), sd.constant(bi),
+                                    1).eval().toNumpy(),
+            (ai != bi).sum(1))
+
+    def test_segment_reductions(self):
+        data = np.array([3.0, 1.0, 4.0, 1.0, 5.0, 9.0])
+        ids = np.array([0, 0, 1, 1, 1, 2])
+        sd = SameDiff.create()
+        d, i = sd.constant(data), sd.constant(ids)
+        np.testing.assert_allclose(
+            sd.math.segmentSum(d, i, numSegments=3).eval().toNumpy(),
+            [4.0, 10.0, 9.0])
+        np.testing.assert_allclose(
+            sd.math.segmentMax(d, i, numSegments=3).eval().toNumpy(),
+            [3.0, 5.0, 9.0])
+        np.testing.assert_allclose(
+            sd.math.segmentMean(d, i, numSegments=3).eval().toNumpy(),
+            [2.0, 10.0 / 3, 9.0])
+        # unsorted alias accepts permuted ids
+        np.testing.assert_allclose(
+            sd.math.unsortedSegmentSum(
+                sd.constant(data), sd.constant(np.array([2, 0, 1, 0, 1, 2])),
+                numSegments=3).eval().toNumpy(),
+            [2.0, 9.0, 12.0])
+
+    def test_confusion_and_counts(self):
+        sd = SameDiff.create()
+        lab = sd.constant(np.array([0, 1, 1, 2]))
+        prd = sd.constant(np.array([0, 1, 0, 2]))
+        cm = sd.math.confusionMatrix(lab, prd, numClasses=3).eval().toNumpy()
+        np.testing.assert_array_equal(cm, [[1, 0, 0], [1, 1, 0], [0, 0, 1]])
+        x = sd.constant(np.array([[0.0, 1.0, 0.0], [2.0, 0.0, 3.0]]))
+        assert float(sd.math.zeroFraction(x).eval().toNumpy()) == 0.5
+        np.testing.assert_array_equal(
+            sd.math.countNonZero(x, 1).eval().toNumpy(), [1, 2])
+        np.testing.assert_array_equal(
+            sd.math.countZero(x, 1).eval().toNumpy(), [2, 1])
+        assert float(sd.math.matchConditionCount(
+            x, "gt", 0.5).eval().toNumpy()) == 3
+
+    def test_entropy_iamax_creation(self):
+        p = np.array([0.5, 0.25, 0.25, 0.0])
+        sd = SameDiff.create()
+        x = sd.constant(p)
+        np.testing.assert_allclose(
+            sd.math.shannonEntropy(x).eval().toNumpy(), 1.5, rtol=1e-6)
+        np.testing.assert_allclose(
+            sd.math.entropy(x).eval().toNumpy(),
+            -(p[p > 0] * np.log(p[p > 0])).sum(), rtol=1e-6)
+        assert int(sd.math.iamax(sd.constant(
+            np.array([1.0, -7.0, 3.0]))).eval().toNumpy()) == 1
+        np.testing.assert_allclose(
+            sd.math.linspace(0, 1, 5).eval().toNumpy(), np.linspace(0, 1, 5))
+        np.testing.assert_array_equal(
+            sd.math.range(2, 10, 3, dtype="int32").eval().toNumpy(),
+            [2, 5, 8])
+        gx, gy = sd.math.meshgrid(sd.constant(np.arange(2.0)),
+                                  sd.constant(np.arange(3.0)))
+        assert gx.eval().shape() == (3, 2) and gy.eval().shape() == (3, 2)
