@@ -762,13 +762,21 @@ def _axes(dimensions):
     return tuple(dimensions) if dimensions else None
 
 
+def _safe_sqrt(s):
+    """sqrt with a zero-safe gradient: d/ds sqrt(0) is inf and the usual
+    maximum()-clamp does NOT stop the inf*0=NaN chain under autodiff —
+    the sqrt INPUT must be where-guarded."""
+    return jnp.where(s > 0, jnp.sqrt(jnp.where(s > 0, s, 1.0)), 0.0)
+
+
 @op("cosineSimilarity")
 def _cosine_sim(x, y, dimensions=None):
     d = _axes(dimensions)
     num = jnp.sum(x * y, axis=d)
-    den = jnp.sqrt(jnp.sum(jnp.square(x), axis=d)) * \
-        jnp.sqrt(jnp.sum(jnp.square(y), axis=d))
-    return num / jnp.maximum(den, 1e-12)
+    den = _safe_sqrt(jnp.sum(jnp.square(x), axis=d)) * \
+        _safe_sqrt(jnp.sum(jnp.square(y), axis=d))
+    return jnp.where(den > 1e-12, num / jnp.where(den > 1e-12, den, 1.0),
+                     0.0)
 
 
 @op("cosineDistance")
@@ -778,7 +786,8 @@ def _cosine_dist(x, y, dimensions=None):
 
 @op("euclideanDistance")
 def _euclidean(x, y, dimensions=None):
-    return jnp.sqrt(jnp.sum(jnp.square(x - y), axis=_axes(dimensions)))
+    # zero-distance rows (converged embeddings) take the 0 subgradient
+    return _safe_sqrt(jnp.sum(jnp.square(x - y), axis=_axes(dimensions)))
 
 
 @op("manhattanDistance")
@@ -911,3 +920,46 @@ def _range(start=0, limit=None, delta=1, dtype="float32"):
 def _meshgrid(*xs, indexing="xy"):
     r = jnp.meshgrid(*xs, indexing=indexing)
     return r[0] if len(r) == 1 else tuple(r)
+
+
+@op("sigmoidCrossEntropy")
+def _loss_sigmoid_ce(labels, logits, reduction="MEAN", labelSmoothing=0.0):
+    if labelSmoothing:
+        labels = labels * (1.0 - labelSmoothing) + 0.5 * labelSmoothing
+    # numerically stable BCE-with-logits
+    per = jnp.maximum(logits, 0.0) - logits * labels + \
+        jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    return _reduce_loss(jnp.mean(per, axis=-1), reduction)
+
+
+@op("weightedCrossEntropyWithLogits")
+def _loss_weighted_ce(labels, logits, weights, reduction="MEAN"):
+    """Per-class positive weighting of sigmoid CE (reference:
+    SDLoss.weightedCrossEntropyWithLogits / TF semantics: loss =
+    (1-l)*x + (1 + l*(w-1)) * log(1+exp(-x)) for x>=0 form)."""
+    log_weight = 1.0 + (weights - 1.0) * labels
+    per = (1.0 - labels) * logits + log_weight * (
+        jnp.log1p(jnp.exp(-jnp.abs(logits))) +
+        jnp.maximum(-logits, 0.0))
+    return _reduce_loss(jnp.mean(per, axis=-1), reduction)
+
+
+@op("l2Loss")
+def _loss_l2(x):
+    return jnp.sum(jnp.square(x)) / 2.0
+
+
+@op("meanPairwiseSquaredError")
+def _loss_mpwse(labels, predictions, reduction="MEAN"):
+    """Mean over all within-example pairs of (d_i - d_j)^2 where
+    d = predictions - labels (reference: SDLoss.meanPairwiseSquaredError).
+    Closed form avoids materialising the NxN pair grid."""
+    d = (predictions - labels).reshape(labels.shape[0], -1)
+    n = d.shape[-1]
+    sum_d = jnp.sum(d, axis=-1)
+    sum_d2 = jnp.sum(jnp.square(d), axis=-1)
+    # sum over ORDERED pairs: sum_{i,j}(d_i-d_j)^2 = 2n*sum(d^2)-2(sum d)^2
+    pair_sum = 2.0 * (n * sum_d2 - jnp.square(sum_d))
+    num_pairs = max(n * (n - 1), 1)
+    per = pair_sum / num_pairs
+    return _reduce_loss(per, reduction)

@@ -849,7 +849,7 @@ class SameDiff:
                 if tc.weightDecay:
                     grads = {n: g + tc.weightDecay * params[n]
                              for n, g in grads.items()}
-                upd, new_state = updater.apply(grads, ustate, it)
+                upd, new_state = updater.apply(grads, ustate, it, params=params)
                 new_params = {n: params[n] - upd[n] for n in params}
                 return loss, new_params, new_state
 
@@ -1419,6 +1419,24 @@ class _LossOps(_NS):
 
     def poissonLoss(self, labels, predictions, name=None):
         return self._loss("lossPoisson", [labels, predictions], name=name)
+
+    def sigmoidCrossEntropy(self, labels, logits, labelSmoothing=0.0,
+                            name=None):
+        return self._loss("sigmoidCrossEntropy", [labels, logits],
+                          {"labelSmoothing": float(labelSmoothing)},
+                          name=name)
+
+    def weightedCrossEntropyWithLogits(self, labels, logits, weights,
+                                       name=None):
+        return self._loss("weightedCrossEntropyWithLogits",
+                          [labels, logits, weights], name=name)
+
+    def l2Loss(self, x, name=None):
+        return self._loss("l2Loss", [x], name=name)
+
+    def meanPairwiseSquaredError(self, labels, predictions, name=None):
+        return self._loss("meanPairwiseSquaredError",
+                          [labels, predictions], name=name)
 
     def cosineDistance(self, labels, predictions, dimension=-1, name=None):
         return self._loss("lossCosine", [labels, predictions],

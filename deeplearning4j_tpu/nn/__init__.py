@@ -8,7 +8,7 @@ from deeplearning4j_tpu.nn.weights import WeightInit, NormalDistribution, Unifor
 from deeplearning4j_tpu.nn.losses import LossFunctions
 from deeplearning4j_tpu.nn import updaters
 from deeplearning4j_tpu.nn.updaters import (
-    Sgd, Adam, AdaMax, Nadam, AMSGrad, AdaGrad, AdaDelta, RmsProp, Nesterovs, NoOp,
+    Sgd, Adam, AdamW, AdaMax, Nadam, AMSGrad, AdaGrad, AdaDelta, RmsProp, Nesterovs, NoOp,
 )
 from deeplearning4j_tpu.nn.conf.builder import (
     NeuralNetConfiguration, MultiLayerConfiguration, BackpropType, GradientNormalization,

@@ -231,7 +231,8 @@ class ComputationGraph:
             if not params[name] or getattr(self.conf.nodes[name].payload,
                                            "frozen", False):
                 continue
-            upd, us = self._updaters[name].apply(g, upd_states[name], iteration)
+            upd, us = self._updaters[name].apply(g, upd_states[name], iteration,
+                                                 params=params[name])
             np_n = jax.tree_util.tree_map(
                 lambda p, u: (p - u).astype(p.dtype), params[name], upd)
             cs = getattr(self.conf.nodes[name].payload, "constraints", None)
@@ -395,7 +396,7 @@ class ComputationGraph:
             loss, g = jax.value_and_grad(
                 lambda p_: layer.pretrain_loss(self._cast_params(p_),
                                                feed(inputs), key))(p)
-            d, us = upd.apply(g, us, it)
+            d, us = upd.apply(g, us, it, params=p)
             p = jax.tree_util.tree_map(
                 lambda a, b: (a - b).astype(a.dtype), p, d)
             return p, us, loss

@@ -281,7 +281,8 @@ class MultiLayerNetwork:
                 new_params.append(params[i])
                 new_upd_states.append(upd_states[i])
                 continue
-            upd, us = self._updaters[i].apply(grads[i], upd_states[i], iteration)
+            upd, us = self._updaters[i].apply(grads[i], upd_states[i], iteration,
+                                              params=params[i])
             # cast keeps param dtype stable (python-float hyperparams would
             # otherwise promote under x64)
             np_i = jax.tree_util.tree_map(
@@ -436,7 +437,7 @@ class MultiLayerNetwork:
             loss, g = jax.value_and_grad(
                 lambda p_: layer.pretrain_loss(self._cast_params(p_),
                                                feed(x), key))(p)
-            d, us = upd.apply(g, us, it)
+            d, us = upd.apply(g, us, it, params=p)
             p = jax.tree_util.tree_map(
                 lambda a, b: (a - b).astype(a.dtype), p, d)
             return p, us, loss

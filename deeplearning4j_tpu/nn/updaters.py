@@ -28,7 +28,7 @@ class IUpdater:
     def init(self, params):
         raise NotImplementedError
 
-    def apply(self, grads, state, iteration, epoch=0):
+    def apply(self, grads, state, iteration, epoch=0, params=None):
         """-> (updates_to_subtract, new_state)"""
         raise NotImplementedError
 
@@ -43,7 +43,7 @@ class NoOp(IUpdater):
     def init(self, params):
         return ()
 
-    def apply(self, grads, state, iteration, epoch=0):
+    def apply(self, grads, state, iteration, epoch=0, params=None):
         return _tmap(jnp.zeros_like, grads), state
 
 
@@ -54,7 +54,7 @@ class Sgd(IUpdater):
     def init(self, params):
         return ()
 
-    def apply(self, grads, state, iteration, epoch=0):
+    def apply(self, grads, state, iteration, epoch=0, params=None):
         lr = self._lr(iteration, epoch)
         return _tmap(lambda g: lr * g, grads), state
 
@@ -66,7 +66,7 @@ class Nesterovs(IUpdater):
     def init(self, params):
         return _tmap(jnp.zeros_like, params)
 
-    def apply(self, grads, state, iteration, epoch=0):
+    def apply(self, grads, state, iteration, epoch=0, params=None):
         lr = self._lr(iteration, epoch)
         mu = _sched.resolve(self.momentum).valueAt(iteration, epoch)
         v_new = _tmap(lambda v, g: mu * v - lr * g, state, grads)
@@ -84,7 +84,7 @@ class Adam(IUpdater):
         z = _tmap(jnp.zeros_like, params)
         return {"m": z, "v": _tmap(jnp.zeros_like, params)}
 
-    def apply(self, grads, state, iteration, epoch=0):
+    def apply(self, grads, state, iteration, epoch=0, params=None):
         lr = self._lr(iteration, epoch)
         t = iteration + 1
         b1, b2 = self.beta1, self.beta2
@@ -95,6 +95,26 @@ class Adam(IUpdater):
         return updates, {"m": m, "v": v}
 
 
+class AdamW(Adam):
+    """Adam with DECOUPLED weight decay (Loshchilov & Hutter; the fork's
+    AdamW): decay is applied to the params directly, scaled by lr, not
+    folded into the gradient like plain l2/weightDecay regularization."""
+
+    def __init__(self, learningRate=1e-3, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, weightDecay=0.01):
+        super().__init__(learningRate, beta1, beta2, epsilon)
+        self.weightDecay = float(weightDecay)
+
+    def apply(self, grads, state, iteration, epoch=0, params=None):
+        updates, new_state = super().apply(grads, state, iteration, epoch)
+        if params is not None and self.weightDecay:
+            lr = self._lr(iteration, epoch)
+            wd = self.weightDecay
+            updates = jax.tree_util.tree_map(
+                lambda u, p: u + lr * wd * p, updates, params)
+        return updates, new_state
+
+
 class AdaMax(IUpdater):
     def __init__(self, learningRate=1e-3, beta1=0.9, beta2=0.999, epsilon=1e-8):
         self.learningRate, self.beta1, self.beta2, self.epsilon = learningRate, beta1, beta2, epsilon
@@ -102,7 +122,7 @@ class AdaMax(IUpdater):
     def init(self, params):
         return {"m": _tmap(jnp.zeros_like, params), "u": _tmap(jnp.zeros_like, params)}
 
-    def apply(self, grads, state, iteration, epoch=0):
+    def apply(self, grads, state, iteration, epoch=0, params=None):
         lr = self._lr(iteration, epoch)
         t = iteration + 1
         b1, b2 = self.beta1, self.beta2
@@ -120,7 +140,7 @@ class Nadam(IUpdater):
     def init(self, params):
         return {"m": _tmap(jnp.zeros_like, params), "v": _tmap(jnp.zeros_like, params)}
 
-    def apply(self, grads, state, iteration, epoch=0):
+    def apply(self, grads, state, iteration, epoch=0, params=None):
         lr = self._lr(iteration, epoch)
         t = iteration + 1
         b1, b2 = self.beta1, self.beta2
@@ -140,7 +160,7 @@ class AMSGrad(IUpdater):
         z = lambda: _tmap(jnp.zeros_like, params)
         return {"m": z(), "v": z(), "vhat": z()}
 
-    def apply(self, grads, state, iteration, epoch=0):
+    def apply(self, grads, state, iteration, epoch=0, params=None):
         lr = self._lr(iteration, epoch)
         t = iteration + 1
         b1, b2 = self.beta1, self.beta2
@@ -159,7 +179,7 @@ class AdaGrad(IUpdater):
     def init(self, params):
         return _tmap(jnp.zeros_like, params)
 
-    def apply(self, grads, state, iteration, epoch=0):
+    def apply(self, grads, state, iteration, epoch=0, params=None):
         lr = self._lr(iteration, epoch)
         h = _tmap(lambda h, g: h + g * g, state, grads)
         updates = _tmap(lambda g, h: lr * g / (jnp.sqrt(h) + self.epsilon), grads, h)
@@ -174,7 +194,7 @@ class AdaDelta(IUpdater):
     def init(self, params):
         return {"g2": _tmap(jnp.zeros_like, params), "dx2": _tmap(jnp.zeros_like, params)}
 
-    def apply(self, grads, state, iteration, epoch=0):
+    def apply(self, grads, state, iteration, epoch=0, params=None):
         rho, eps = self.rho, self.epsilon
         g2 = _tmap(lambda a, g: rho * a + (1 - rho) * g * g, state["g2"], grads)
         dx = _tmap(
@@ -191,7 +211,7 @@ class RmsProp(IUpdater):
     def init(self, params):
         return _tmap(jnp.zeros_like, params)
 
-    def apply(self, grads, state, iteration, epoch=0):
+    def apply(self, grads, state, iteration, epoch=0, params=None):
         lr = self._lr(iteration, epoch)
         d = self.rmsDecay
         h = _tmap(lambda h, g: d * h + (1 - d) * g * g, state, grads)
@@ -206,6 +226,7 @@ def resolve(u) -> IUpdater:
         table = {
             "sgd": Sgd, "adam": Adam, "adamax": AdaMax, "nadam": Nadam,
             "amsgrad": AMSGrad, "adagrad": AdaGrad, "adadelta": AdaDelta,
+            "adamw": AdamW,
             "rmsprop": RmsProp, "nesterovs": Nesterovs, "noop": NoOp,
         }
         if u.lower() in table:

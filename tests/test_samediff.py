@@ -741,3 +741,123 @@ class TestMathLongTail:
         gx, gy = sd.math.meshgrid(sd.constant(np.arange(2.0)),
                                   sd.constant(np.arange(3.0)))
         assert gx.eval().shape() == (3, 2) and gy.eval().shape() == (3, 2)
+
+
+class TestLossLongTail:
+    """SDLoss additions vs independent oracles (torch for the CE family,
+    brute force for pairwise)."""
+
+    def test_sigmoid_ce_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        import torch.nn.functional as F
+
+        rs = np.random.RandomState(0)
+        lab = (rs.rand(4, 5) > 0.5).astype("float32")
+        log = rs.randn(4, 5).astype("float32")
+        sd = SameDiff.create()
+        v = sd.loss.sigmoidCrossEntropy(sd.constant(lab), sd.constant(log),
+                                        name="l")
+        ref = float(F.binary_cross_entropy_with_logits(
+            torch.tensor(log), torch.tensor(lab)))
+        np.testing.assert_allclose(float(v.eval().toNumpy()), ref, rtol=1e-5)
+
+    def test_weighted_ce_matches_torch_pos_weight(self):
+        torch = pytest.importorskip("torch")
+        import torch.nn.functional as F
+
+        rs = np.random.RandomState(1)
+        lab = (rs.rand(6, 3) > 0.5).astype("float32")
+        log = rs.randn(6, 3).astype("float32")
+        w = np.array([0.5, 2.0, 3.0], "float32")
+        sd = SameDiff.create()
+        v = sd.loss.weightedCrossEntropyWithLogits(
+            sd.constant(lab), sd.constant(log), sd.constant(w), name="l")
+        ref = float(F.binary_cross_entropy_with_logits(
+            torch.tensor(log), torch.tensor(lab),
+            pos_weight=torch.tensor(w)))
+        np.testing.assert_allclose(float(v.eval().toNumpy()), ref, rtol=1e-5)
+
+    def test_l2_and_pairwise(self):
+        rs = np.random.RandomState(2)
+        x = rs.randn(3, 4)
+        sd = SameDiff.create()
+        np.testing.assert_allclose(
+            float(sd.loss.l2Loss(sd.constant(x), name="a").eval().toNumpy()),
+            np.sum(x ** 2) / 2, rtol=1e-6)
+        lab, pred = rs.randn(3, 4), rs.randn(3, 4)
+        v = sd.loss.meanPairwiseSquaredError(
+            sd.constant(lab), sd.constant(pred), name="b")
+        d = pred - lab
+        per = []
+        for k in range(3):
+            s = 0.0
+            for i in range(4):
+                for j in range(4):
+                    s += (d[k, i] - d[k, j]) ** 2
+            per.append(s / (4 * 3))
+        np.testing.assert_allclose(float(v.eval().toNumpy()),
+                                   np.mean(per), rtol=1e-6)
+
+
+class TestAdamW:
+    def test_decoupled_decay_equals_adam_plus_wd(self):
+        from deeplearning4j_tpu.nn.updaters import Adam, AdamW
+
+        rs = np.random.RandomState(0)
+        p = {"W": jnp.asarray(rs.randn(4, 3), jnp.float32)}
+        g = {"W": jnp.asarray(rs.randn(4, 3), jnp.float32)}
+        a, w = Adam(1e-2), AdamW(1e-2, weightDecay=0.1)
+        ua, _ = a.apply(g, a.init(p), 0, params=p)
+        uw, _ = w.apply(g, w.init(p), 0, params=p)
+        np.testing.assert_allclose(
+            np.asarray(uw["W"]),
+            np.asarray(ua["W"]) + 1e-2 * 0.1 * np.asarray(p["W"]),
+            rtol=1e-6)
+
+    def test_adamw_trains_and_shrinks_unused_weights(self):
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           MultiLayerNetwork, DenseLayer,
+                                           OutputLayer, AdamW)
+
+        conf = (NeuralNetConfiguration.Builder().seed(1)
+                .updater(AdamW(1e-2, weightDecay=0.2)).list()
+                .layer(DenseLayer(nOut=8, activation="tanh"))
+                .layer(OutputLayer(nOut=2, activation="softmax"))
+                .setInputType(InputType.feedForward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        rs = np.random.RandomState(0)
+        x = rs.randn(32, 4).astype("float32")
+        y = np.eye(2, dtype="float32")[(x.sum(1) > 0).astype(int)]
+        def total_norm(n):
+            return float(sum(np.linalg.norm(np.asarray(l)) for l in
+                             jax.tree_util.tree_leaves(n._params)))
+
+        base_conf = (NeuralNetConfiguration.Builder().seed(1)
+                     .updater(AdamW(1e-2, weightDecay=0.0)).list()
+                     .layer(DenseLayer(nOut=8, activation="tanh"))
+                     .layer(OutputLayer(nOut=2, activation="softmax"))
+                     .setInputType(InputType.feedForward(4)).build())
+        base = MultiLayerNetwork(base_conf).init()
+        for _ in range(20):
+            net.fit(x, y)
+            base.fit(x, y)
+        assert np.isfinite(net.score())
+        # the decay must actually bite: wd=0.2 weights end smaller than
+        # the wd=0 twin (catches params= being dropped at a call site)
+        assert total_norm(net) < 0.97 * total_norm(base), \
+            (total_norm(net), total_norm(base))
+
+
+def test_distance_ops_finite_gradients_at_degenerate_points():
+    """d/dx sqrt(0) is inf under autodiff; the distance ops must take the
+    zero subgradient at converged/zero inputs instead of emitting NaN."""
+    from deeplearning4j_tpu.autodiff.ops_impl import OPS
+
+    g1 = jax.grad(lambda x: jnp.sum(
+        OPS["euclideanDistance"](x, jnp.zeros(3), dimensions=None)))(
+            jnp.zeros(3))
+    g2 = jax.grad(lambda x: jnp.sum(
+        OPS["cosineSimilarity"](x, jnp.ones(3), dimensions=None)))(
+            jnp.zeros(3))
+    assert bool(jnp.all(jnp.isfinite(g1)))
+    assert bool(jnp.all(jnp.isfinite(g2)))
