@@ -956,10 +956,11 @@ def _loss_mpwse(labels, predictions, reduction="MEAN"):
     Closed form avoids materialising the NxN pair grid."""
     d = (predictions - labels).reshape(labels.shape[0], -1)
     n = d.shape[-1]
-    sum_d = jnp.sum(d, axis=-1)
-    sum_d2 = jnp.sum(jnp.square(d), axis=-1)
-    # sum over ORDERED pairs: sum_{i,j}(d_i-d_j)^2 = 2n*sum(d^2)-2(sum d)^2
-    pair_sum = 2.0 * (n * sum_d2 - jnp.square(sum_d))
+    # centered identity: sum_{i,j}(d_i-d_j)^2 = 2n*sum((d_i-dbar)^2).
+    # The raw n*sum(d^2)-(sum d)^2 form cancels catastrophically when d
+    # carries a large common offset (uniform bias -> true loss 0)
+    dc = d - jnp.mean(d, axis=-1, keepdims=True)
+    pair_sum = 2.0 * n * jnp.sum(jnp.square(dc), axis=-1)
     num_pairs = max(n * (n - 1), 1)
     per = pair_sum / num_pairs
     return _reduce_loss(per, reduction)

@@ -48,6 +48,8 @@ class MultiLayerConfiguration:
         self.tbpttBackLength = tbpttBackLength
         self.gradientNormalization = gradientNormalization
         self.gradientNormalizationThreshold = gradientNormalizationThreshold
+        self.activationCheckpointing = defaults.get(
+            "activationCheckpointing", False)
         # resolved per-layer input types (set during shape inference)
         self.layerInputTypes = []
 
@@ -227,6 +229,17 @@ class NeuralNetConfiguration:
 
         def updater(self, u):
             self._d["updater"] = _upd.resolve(u) if not isinstance(u, _upd.IUpdater) else u
+            return self
+
+        def activationCheckpointing(self, flag=True):
+            """Rematerialize layer activations in the backward pass
+            (jax.checkpoint): activations are recomputed instead of
+            stored, trading ~1 extra forward of FLOPs for O(depth) ->
+            O(1) activation memory. TPU-first feature (no upstream
+            analog; the reference's workspaces manage allocator reuse,
+            not recomputation). Most useful for deep nets / long
+            sequences that overflow HBM."""
+            self._d["activationCheckpointing"] = bool(flag)
             return self
 
         def biasUpdater(self, u):

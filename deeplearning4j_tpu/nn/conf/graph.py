@@ -224,6 +224,8 @@ class ComputationGraphConfiguration:
         self.tbpttBackLength = tbpttBackLength
         self.gradientNormalization = defaults.get("gradientNormalization")
         self.gradientNormalizationThreshold = defaults.get("gradientNormalizationThreshold", 1.0)
+        self.activationCheckpointing = defaults.get(
+            "activationCheckpointing", False)
         self.topoOrder = self._topo_sort()
         self._infer_shapes()
 

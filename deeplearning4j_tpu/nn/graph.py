@@ -19,7 +19,8 @@ from deeplearning4j_tpu.nn import updaters as _upd
 from deeplearning4j_tpu.nn.conf import layers as L
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.multilayer import (_grad_normalize, _unwrap,
-                                               cast_params, strip_carries)
+                                               cast_params, strip_carries,
+                                               checkpointed_forward)
 
 
 class ComputationGraph:
@@ -157,7 +158,15 @@ class ComputationGraph:
                 acts[name] = out
                 new_states[name] = states[name]
                 continue
-            h, s = layer.forward(p, states[name], h, l_train, lk, fmask)
+            if train and not getattr(layer, "multiInput", False) and \
+                    getattr(self.conf, "activationCheckpointing", False):
+                # rematerialize in backward (jax.checkpoint); multi-input
+                # layers (attention) keep the plain path — their inputs
+                # list is heterogeneous and they are few per graph
+                h, s = checkpointed_forward(layer, l_train)(
+                    p, states[name], h, lk, fmask)
+            else:
+                h, s = layer.forward(p, states[name], h, l_train, lk, fmask)
             acts[name] = h
             masks[name] = out_mask
             new_states[name] = s

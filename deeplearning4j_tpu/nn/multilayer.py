@@ -38,6 +38,15 @@ def _unwrap(x):
     return jnp.asarray(x)
 
 
+def checkpointed_forward(layer, l_train):
+    """layer.forward wrapped in jax.checkpoint (activation remat); layer
+    and the static train flag ride as closures, array args (params,
+    state, x, key, mask — Nones allowed) cross the remat boundary.
+    Shared by MultiLayerNetwork._run_layers and ComputationGraph."""
+    return jax.checkpoint(
+        lambda p_, s_, x_, k_, m_: layer.forward(p_, s_, x_, l_train, k_, m_))
+
+
 def strip_carries(states):
     """Drop transient rnn carries (h/c) from a state container (list or
     dict of per-layer state dicts); keep persistent state like BN stats."""
@@ -211,7 +220,14 @@ class MultiLayerNetwork:
                 preact = layer.preoutput(p, h)
                 new_states.append(states[i])
                 return preact, new_states
-            h, s = layer.forward(p, states[i], h, l_train, lk, fmask)
+            if train and getattr(self.conf, "activationCheckpointing", False):
+                # rematerialize this layer's activations in the backward
+                # pass (jax.checkpoint): l_train/layer are static closures,
+                # array args flow through the checkpointed boundary
+                h, s = checkpointed_forward(layer, l_train)(
+                    p, states[i], h, lk, fmask)
+            else:
+                h, s = layer.forward(p, states[i], h, l_train, lk, fmask)
             new_states.append(s)
         return h, new_states
 

@@ -107,7 +107,12 @@ class AdamW(Adam):
 
     def apply(self, grads, state, iteration, epoch=0, params=None):
         updates, new_state = super().apply(grads, state, iteration, epoch)
-        if params is not None and self.weightDecay:
+        if self.weightDecay:
+            if params is None:
+                # silent no-decay would be wrong training, not a default
+                raise ValueError(
+                    "AdamW with weightDecay needs the current params: "
+                    "call apply(..., params=params)")
             lr = self._lr(iteration, epoch)
             wd = self.weightDecay
             updates = jax.tree_util.tree_map(

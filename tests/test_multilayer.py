@@ -467,3 +467,89 @@ class TestFusedBatchNormVJP:
         y, _, _ = batch_norm(x, None, None, rm, rv, train=True)
         np.testing.assert_allclose(np.asarray(y).mean(0), 0.0, atol=1e-5)
         np.testing.assert_allclose(np.asarray(y).std(0), 1.0, atol=1e-2)
+
+
+class TestActivationCheckpointing:
+    """activationCheckpointing (jax.checkpoint remat): identical numerics,
+    different memory/FLOPs schedule. TPU-first feature — trajectory parity
+    is the testable contract on CPU."""
+
+    def _conf(self, ck):
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           DenseLayer, OutputLayer, Adam)
+        b = (NeuralNetConfiguration.Builder().seed(7).updater(Adam(1e-2))
+             .activation("tanh"))
+        if ck:
+            b = b.activationCheckpointing(True)
+        return (b.list()
+                .layer(DenseLayer(nOut=16))
+                .layer(DenseLayer(nOut=16))
+                .layer(DenseLayer(nOut=16))
+                .layer(OutputLayer(nOut=3, activation="softmax"))
+                .setInputType(InputType.feedForward(6)).build())
+
+    def test_mln_trajectory_parity(self):
+        from deeplearning4j_tpu.nn import MultiLayerNetwork
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(16, 6).astype("float32")
+        y = np.eye(3, dtype="float32")[rng.randint(0, 3, 16)]
+        plain = MultiLayerNetwork(self._conf(False)).init()
+        remat = MultiLayerNetwork(self._conf(True)).init()
+        assert remat.conf.activationCheckpointing
+        for _ in range(5):
+            plain.fit(x, y)
+            remat.fit(x, y)
+        np.testing.assert_allclose(plain.params().toNumpy(),
+                                   remat.params().toNumpy(),
+                                   rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(plain.score(), remat.score(), rtol=1e-6)
+
+    def test_graph_trajectory_parity(self):
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           ComputationGraph, DenseLayer,
+                                           OutputLayer, Adam)
+
+        def gconf(ck):
+            b = (NeuralNetConfiguration.Builder().seed(3).updater(Adam(1e-2))
+                 .activation("relu"))
+            if ck:
+                b = b.activationCheckpointing(True)
+            return (b.graphBuilder().addInputs("in")
+                    .addLayer("h1", DenseLayer(nOut=12), "in")
+                    .addLayer("h2", DenseLayer(nOut=12), "h1")
+                    .addLayer("out", OutputLayer(nOut=2, activation="softmax"),
+                              "h2")
+                    .setOutputs("out")
+                    .setInputTypes(InputType.feedForward(5)).build())
+
+        rng = np.random.RandomState(1)
+        x = rng.randn(8, 5).astype("float32")
+        y = np.eye(2, dtype="float32")[rng.randint(0, 2, 8)]
+        a = ComputationGraph(gconf(False)).init()
+        b = ComputationGraph(gconf(True)).init()
+        for _ in range(5):
+            a.fit(x, y)
+            b.fit(x, y)
+        np.testing.assert_allclose(a.score(), b.score(), rtol=1e-6)
+        for la, lb in zip(jax.tree_util.tree_leaves(a._params),
+                          jax.tree_util.tree_leaves(b._params)):
+            np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                       rtol=1e-5, atol=1e-7)
+
+    def test_remat_actually_in_the_traced_program(self):
+        """Parity alone would pass if the flag were ignored; the remat
+        primitive must be present in the jaxpr iff the flag is set."""
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn import MultiLayerNetwork
+
+        x = np.zeros((4, 6), "float32")
+        y = np.eye(3, dtype="float32")[[0, 1, 2, 0]]
+        for ck in (False, True):
+            net = MultiLayerNetwork(self._conf(ck)).init()
+            jpr = jax.make_jaxpr(
+                lambda p, s: net._loss_fn(p, s, jnp.asarray(x),
+                                          jnp.asarray(y), jax.random.key(0),
+                                          None, None, False))(
+                net._params, net._states)
+            assert ("remat" in str(jpr)) == ck

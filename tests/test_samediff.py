@@ -797,6 +797,12 @@ class TestLossLongTail:
             per.append(s / (4 * 3))
         np.testing.assert_allclose(float(v.eval().toNumpy()),
                                    np.mean(per), rtol=1e-6)
+        # uniform-offset case: the centered form is EXACTLY zero where the
+        # naive n*sum(d^2)-(sum d)^2 form cancels catastrophically
+        v0 = sd.loss.meanPairwiseSquaredError(
+            sd.constant(np.zeros((2, 4), "float32")),
+            sd.constant(np.full((2, 4), 1e3, "float32")), name="c")
+        assert float(v0.eval().toNumpy()) == 0.0
 
 
 class TestAdamW:
