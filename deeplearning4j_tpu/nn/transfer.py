@@ -276,3 +276,189 @@ class TransferLearningHelper:
 
     def unfrozenMLN(self) -> MultiLayerNetwork:
         return self._top
+
+
+class _TransferGraphBuilder:
+    """Reference: transferlearning.TransferLearning.GraphBuilder — the
+    ComputationGraph variant (the one that matters for fine-tuning the
+    zoo's CG models, ResNet-50 included). Supports the classic flow:
+    freeze a trunk, remove/replace the head, graft trained weights."""
+
+    def __init__(self, origGraph):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        if not isinstance(origGraph, ComputationGraph):
+            raise TypeError("GraphBuilder wraps a ComputationGraph; use "
+                            "TransferLearning.Builder for MultiLayerNetwork")
+        origGraph._require_init()
+        self._orig = origGraph
+        self._ftc = None
+        self._frozen_upto = None       # vertex name: freeze its ancestors+it
+        self._removed = set()
+        self._keep_connections = set()
+        self._added = []               # (name, payload, inputs)
+        self._nOutReplace = {}         # layer name -> (nOut, weightInit)
+        self._outputs = None
+
+    def fineTuneConfiguration(self, ftc):
+        self._ftc = ftc
+        return self
+
+    def setFeatureExtractor(self, vertexName):
+        """Freeze `vertexName` and every node it (transitively) depends
+        on — the trunk up to and including the named vertex."""
+        if vertexName not in self._orig.conf.nodes:
+            raise ValueError(f"unknown vertex '{vertexName}'")
+        self._frozen_upto = vertexName
+        return self
+
+    def removeVertexAndConnections(self, name):
+        self._removed.add(name)
+        return self
+
+    def removeVertexKeepConnections(self, name):
+        """Remove `name` but keep edges referencing it: a re-added node
+        with the same name takes its place in the graph."""
+        self._removed.add(name)
+        self._keep_connections.add(name)
+        return self
+
+    def addLayer(self, name, layer, *inputs):
+        self._added.append((name, layer, inputs))
+        return self
+
+    def addVertex(self, name, vertex, *inputs):
+        self._added.append((name, vertex, inputs))
+        return self
+
+    def nOutReplace(self, layerName, nOut, weightInit=None):
+        node = self._orig.conf.nodes.get(layerName)
+        if node is None or node.kind != "layer":
+            raise ValueError(f"unknown layer '{layerName}' (nOutReplace "
+                             f"takes a layer node of the original graph)")
+        self._nOutReplace[layerName] = (int(nOut), weightInit)
+        return self
+
+    def setOutputs(self, *names):
+        self._outputs = list(names)
+        return self
+
+    def _frozen_set(self, nodes):
+        if self._frozen_upto is None:
+            return set()
+        frozen, stack = set(), [self._frozen_upto]
+        while stack:
+            n = stack.pop()
+            if n in frozen or n not in nodes:
+                continue
+            frozen.add(n)
+            stack.extend(nodes[n].inputs)
+        return frozen
+
+    def build(self):
+        from deeplearning4j_tpu.nn.conf.graph import GraphBuilder
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.util.pytree import device_copy_tree
+
+        orig = self._orig
+        oconf = orig.conf
+        added_names = {n for n, _, _ in self._added}
+        for r in self._keep_connections:
+            if r not in added_names:
+                raise ValueError(
+                    f"removeVertexKeepConnections('{r}') needs a "
+                    f"same-named replacement via addLayer/addVertex")
+
+        defaults = dict(oconf.defaults)
+        if self._ftc is not None:
+            defaults.update(self._ftc.overrides)
+        gb = GraphBuilder(defaults)
+        gb.addInputs(*oconf.networkInputs)
+        gb.setInputTypes(*[oconf.inputTypes[n] for n in oconf.networkInputs])
+
+        frozen = self._frozen_set(oconf.nodes)
+        fresh = set()  # layer names needing re-init (replaced or new nIn)
+        kept = []
+        for name in oconf.topoOrder:
+            node = oconf.nodes[name]
+            if node.kind == "input" or name in self._removed:
+                continue
+            for dep in node.inputs:
+                if dep in self._removed and dep not in self._keep_connections:
+                    raise ValueError(
+                        f"node '{name}' references removed vertex '{dep}'; "
+                        f"remove it too or re-add '{dep}'")
+            payload = copy.deepcopy(node.payload)
+            if name in self._nOutReplace:
+                nOut, winit = self._nOutReplace[name]
+                payload.nOut = nOut
+                if winit is not None:
+                    payload.weightInit = winit
+                fresh.add(name)
+            if name in frozen:
+                payload.frozen = True
+            elif self._ftc is not None and node.kind == "layer":
+                self._ftc.applyToLayer(payload)
+            if node.kind == "layer":
+                gb.addLayer(name, payload, *node.inputs,
+                            preprocessor=copy.deepcopy(node.preprocessor))
+            else:
+                gb.addVertex(name, payload, *node.inputs)
+            kept.append(name)
+        for name, payload, inputs in self._added:
+            # addVertex dispatches Layer payloads to layer nodes itself
+            gb.addVertex(name, payload, *inputs)
+            fresh.add(name)
+        # Width changes flow THROUGH parameterless vertices (Scale/Merge/
+        # ElementWise — the residual-graph case): any layer downstream of
+        # a replaced layer or a keep-connections replacement re-infers
+        # nIn; whether its grafted weights survive is decided by shape at
+        # graft time (maybe_resized), not guessed here.
+        width_changed = set(self._nOutReplace) | set(self._keep_connections)
+        maybe_resized = set()
+        for name in list(gb._nodes):
+            node = gb._nodes[name]
+            if node.kind == "input" or not any(
+                    d in width_changed for d in node.inputs):
+                continue
+            if node.kind == "vertex":
+                width_changed.add(name)  # shape passes through
+                continue
+            p = node.payload
+            if getattr(p, "nIn", None) is not None:
+                p.nIn = None
+            maybe_resized.add(name)
+        outputs = self._outputs or oconf.networkOutputs
+        for o in outputs:
+            if o not in gb._nodes:
+                raise ValueError(
+                    f"output '{o}' does not exist in the new graph — call "
+                    f"setOutputs(...) after removing/renaming an output "
+                    f"vertex")
+        gb.setOutputs(*outputs)
+        gb.backpropType(oconf.backpropType)
+        gb.tBPTTForwardLength(oconf.tbpttFwdLength)
+        gb.tBPTTBackwardLength(oconf.tbpttBackLength)
+
+        net = ComputationGraph(gb.build()).init()
+        for name in kept:
+            if name in fresh or name not in orig._params:
+                continue
+            old_p, new_p = orig._params[name], net._params.get(name)
+            if new_p is None or not new_p:
+                continue
+            mismatch = any(old_p[k].shape != new_p[k].shape for k in new_p)
+            if mismatch:
+                if name in maybe_resized:
+                    continue  # width changed upstream: keep the fresh init
+                k = next(k for k in new_p
+                         if old_p[k].shape != new_p[k].shape)
+                raise ValueError(
+                    f"'{name}' param '{k}' shape changed "
+                    f"{old_p[k].shape} -> {new_p[k].shape}; use nOutReplace")
+            net._params[name] = device_copy_tree(old_p)
+            net._states[name] = device_copy_tree(orig._states[name])
+        return net
+
+
+TransferLearning.GraphBuilder = _TransferGraphBuilder

@@ -247,3 +247,139 @@ class TestFrozenInferenceMode:
             tl.fit(ds)
             outs.append(_p(tl, 1, "W").copy())
         np.testing.assert_array_equal(outs[0], outs[1])
+
+
+class TestTransferGraphBuilder:
+    """TransferLearning.GraphBuilder (reference: the ComputationGraph
+    variant) — the classic fine-tune flow on a DAG: freeze the trunk,
+    replace the head, graft trained weights."""
+
+    def _graph(self):
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           ComputationGraph, DenseLayer,
+                                           OutputLayer, Adam)
+
+        g = (NeuralNetConfiguration.Builder().seed(5).updater(Adam(1e-2))
+             .activation("tanh").graphBuilder().addInputs("in")
+             .addLayer("trunk1", DenseLayer(nOut=12), "in")
+             .addLayer("trunk2", DenseLayer(nOut=10), "trunk1")
+             .addLayer("head", OutputLayer(nOut=3, activation="softmax"),
+                       "trunk2")
+             .setOutputs("head")
+             .setInputTypes(InputType.feedForward(6)).build())
+        net = ComputationGraph(g).init()
+        rng = np.random.RandomState(0)
+        x = rng.randn(16, 6).astype("float32")
+        y = np.eye(3, dtype="float32")[rng.randint(0, 3, 16)]
+        for _ in range(3):
+            net.fit(x, y)
+        return net
+
+    def test_replace_head_grafts_trunk_and_freezes(self):
+        from deeplearning4j_tpu.nn import TransferLearning, OutputLayer
+
+        orig = self._graph()
+        t1 = np.asarray(orig._params["trunk1"]["W"]).copy()
+        net = (TransferLearning.GraphBuilder(orig)
+               .setFeatureExtractor("trunk2")
+               .removeVertexKeepConnections("head")
+               .addLayer("head", OutputLayer(nOut=5, activation="softmax"),
+                         "trunk2")
+               .build())
+        # trunk weights grafted, head fresh with the new width
+        np.testing.assert_array_equal(
+            np.asarray(net._params["trunk1"]["W"]), t1)
+        assert net._params["head"]["W"].shape[-1] == 5
+        assert net.conf.nodes["trunk1"].payload.frozen
+        assert net.conf.nodes["trunk2"].payload.frozen
+        assert not getattr(net.conf.nodes["head"].payload, "frozen", False)
+        # frozen trunk must not move under training; the new head must
+        rng = np.random.RandomState(1)
+        x = rng.randn(8, 6).astype("float32")
+        y = np.eye(5, dtype="float32")[rng.randint(0, 5, 8)]
+        h0 = np.asarray(net._params["head"]["W"]).copy()
+        for _ in range(3):
+            net.fit(x, y)
+        np.testing.assert_array_equal(
+            np.asarray(net._params["trunk1"]["W"]), t1)
+        assert np.abs(np.asarray(net._params["head"]["W"]) - h0).max() > 0
+
+    def test_nout_replace_refreshes_successor(self):
+        from deeplearning4j_tpu.nn import TransferLearning
+
+        orig = self._graph()
+        net = (TransferLearning.GraphBuilder(orig)
+               .nOutReplace("trunk2", 20)
+               .build())
+        assert net._params["trunk2"]["W"].shape[-1] == 20
+        assert net._params["head"]["W"].shape[0] == 20
+        # trunk1 untouched -> grafted
+        np.testing.assert_array_equal(
+            np.asarray(net._params["trunk1"]["W"]),
+            np.asarray(orig._params["trunk1"]["W"]))
+
+    def test_dangling_reference_rejected(self):
+        from deeplearning4j_tpu.nn import TransferLearning
+
+        orig = self._graph()
+        with pytest.raises(ValueError, match="removed vertex"):
+            (TransferLearning.GraphBuilder(orig)
+             .removeVertexAndConnections("trunk2").build())
+
+    def test_mln_rejected_with_clear_error(self):
+        from deeplearning4j_tpu.nn import (TransferLearning,
+                                           NeuralNetConfiguration, InputType,
+                                           MultiLayerNetwork, DenseLayer,
+                                           OutputLayer)
+
+        conf = (NeuralNetConfiguration.Builder().list()
+                .layer(DenseLayer(nOut=4))
+                .layer(OutputLayer(nOut=2))
+                .setInputType(InputType.feedForward(3)).build())
+        with pytest.raises(TypeError, match="ComputationGraph"):
+            TransferLearning.GraphBuilder(MultiLayerNetwork(conf).init())
+
+    def test_width_change_propagates_through_vertex(self):
+        """nOutReplace upstream of a parameterless vertex (the residual
+        case) must re-infer the downstream layer's nIn, not crash in XLA."""
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           ComputationGraph, DenseLayer,
+                                           OutputLayer, Adam,
+                                           TransferLearning)
+        from deeplearning4j_tpu.nn.conf.graph import ScaleVertex
+
+        g = (NeuralNetConfiguration.Builder().seed(2).updater(Adam(1e-2))
+             .graphBuilder().addInputs("in")
+             .addLayer("trunk1", DenseLayer(nOut=12, activation="tanh"), "in")
+             .addVertex("scale", ScaleVertex(0.5), "trunk1")
+             .addLayer("head", OutputLayer(nOut=3, activation="softmax"),
+                       "scale")
+             .setOutputs("head")
+             .setInputTypes(InputType.feedForward(6)).build())
+        orig = ComputationGraph(g).init()
+        net = (TransferLearning.GraphBuilder(orig)
+               .nOutReplace("trunk1", 20).build())
+        assert net._params["head"]["W"].shape[0] == 20
+        rng = np.random.RandomState(0)
+        x = rng.randn(8, 6).astype("float32")
+        y = np.eye(3, dtype="float32")[rng.randint(0, 3, 8)]
+        net.fit(x, y)  # would raise a dot_general shape error before
+        assert np.isfinite(net.score())
+
+    def test_removed_output_without_set_outputs_rejected(self):
+        from deeplearning4j_tpu.nn import TransferLearning, OutputLayer
+
+        orig = self._graph()
+        with pytest.raises(ValueError, match="setOutputs"):
+            (TransferLearning.GraphBuilder(orig)
+             .removeVertexAndConnections("head")
+             .addLayer("newhead", OutputLayer(nOut=2, activation="softmax"),
+                       "trunk2")
+             .build())
+
+    def test_unknown_nout_replace_name_rejected(self):
+        from deeplearning4j_tpu.nn import TransferLearning
+
+        orig = self._graph()
+        with pytest.raises(ValueError, match="unknown layer"):
+            TransferLearning.GraphBuilder(orig).nOutReplace("trnk1", 20)
