@@ -493,22 +493,51 @@ class ComputationGraph:
         return float(self._jit_loss(self._params, self._states, inputs, labs,
                                     fmasks, lmasks))
 
-    def evaluate(self, iterator):
-        from deeplearning4j_tpu.evaluation.evaluation import Evaluation
+    def doEvaluation(self, iterator, *evaluations):
+        """Stream the iterator through outputSingle() into any number of
+        IEvaluation instances (reference: ComputationGraph.doEvaluation)."""
         from deeplearning4j_tpu.data.multidataset import MultiDataSet
 
-        e = Evaluation()
+        if not evaluations:
+            raise ValueError("doEvaluation needs at least one IEvaluation")
+        if len(self.conf.networkOutputs) > 1:
+            raise ValueError(
+                "doEvaluation evaluates a single-output graph; score "
+                "multi-output graphs per-output via output() directly "
+                "(reference throws here too)")
         iterator.reset()
         while iterator.hasNext():
             ds = iterator.next()
+            out = self.outputSingle(ds.getFeatures())
             if isinstance(ds, MultiDataSet):
-                out = self.outputSingle(ds.getFeatures())
                 lm = ds.getLabelsMaskArrays()
-                e.eval(ds.getLabels(0), out, mask=None if lm is None else lm[0])
+                lab, m = ds.getLabels(0), None if lm is None else lm[0]
             else:
-                out = self.outputSingle(ds.getFeatures())
-                e.eval(ds.getLabels(), out, mask=ds.getLabelsMaskArray())
-        return e
+                lab, m = ds.getLabels(), ds.getLabelsMaskArray()
+            for e in evaluations:
+                e.eval(lab, out, mask=m)
+        return evaluations if len(evaluations) > 1 else evaluations[0]
+
+    def evaluateRegression(self, iterator):
+        from deeplearning4j_tpu.evaluation.regression import RegressionEvaluation
+
+        return self.doEvaluation(iterator, RegressionEvaluation())
+
+    def evaluateROC(self, iterator, thresholdSteps=0):
+        from deeplearning4j_tpu.evaluation.roc import ROC
+
+        return self.doEvaluation(iterator, ROC(thresholdSteps))
+
+    def evaluateROCMultiClass(self, iterator, thresholdSteps=0):
+        from deeplearning4j_tpu.evaluation.roc import ROCMultiClass
+
+        return self.doEvaluation(iterator, ROCMultiClass(thresholdSteps))
+
+    def evaluate(self, iterator):
+        from deeplearning4j_tpu.evaluation.evaluation import Evaluation
+
+        return self.doEvaluation(iterator, Evaluation())
+
 
     def params(self) -> INDArray:
         leaves = jax.tree_util.tree_leaves(self._params)

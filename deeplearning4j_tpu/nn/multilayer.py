@@ -529,16 +529,38 @@ class MultiLayerNetwork:
             self._params, self._states, _unwrap(x), _unwrap(y), None, None, None, False)
         return grads, float(loss)
 
-    def evaluate(self, iterator):
-        from deeplearning4j_tpu.evaluation.evaluation import Evaluation
-
-        e = Evaluation()
+    def doEvaluation(self, iterator, *evaluations):
+        """Stream the iterator through output() into any number of
+        IEvaluation instances (reference: MultiLayerNetwork.doEvaluation)."""
+        if not evaluations:
+            raise ValueError("doEvaluation needs at least one IEvaluation")
         iterator.reset()
         while iterator.hasNext():
             ds = iterator.next()
             out = self.output(ds.getFeatures())
-            e.eval(ds.getLabels(), out, mask=ds.getLabelsMaskArray())
-        return e
+            for e in evaluations:
+                e.eval(ds.getLabels(), out, mask=ds.getLabelsMaskArray())
+        return evaluations if len(evaluations) > 1 else evaluations[0]
+
+    def evaluate(self, iterator):
+        from deeplearning4j_tpu.evaluation.evaluation import Evaluation
+
+        return self.doEvaluation(iterator, Evaluation())
+
+    def evaluateRegression(self, iterator):
+        from deeplearning4j_tpu.evaluation.regression import RegressionEvaluation
+
+        return self.doEvaluation(iterator, RegressionEvaluation())
+
+    def evaluateROC(self, iterator, thresholdSteps=0):
+        from deeplearning4j_tpu.evaluation.roc import ROC
+
+        return self.doEvaluation(iterator, ROC(thresholdSteps))
+
+    def evaluateROCMultiClass(self, iterator, thresholdSteps=0):
+        from deeplearning4j_tpu.evaluation.roc import ROCMultiClass
+
+        return self.doEvaluation(iterator, ROCMultiClass(thresholdSteps))
 
     # ----- rnn stateful inference -------------------------------------
     def rnnTimeStep(self, x) -> INDArray:

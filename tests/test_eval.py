@@ -275,3 +275,103 @@ class TestEvaluationCalibration:
         ec.reset()
         ec.eval(labels, preds)
         assert ec.getProbabilityHistogram(0).sum() == 1
+
+
+class TestNetEvaluationVariants:
+    """doEvaluation / evaluateRegression / evaluateROC on the executors
+    (reference: MultiLayerNetwork.doEvaluation and friends)."""
+
+    def _cls_net_and_iter(self):
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           MultiLayerNetwork, DenseLayer,
+                                           OutputLayer, Adam)
+        from deeplearning4j_tpu.data import DataSetIterator
+
+        conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(5e-2))
+                .list().layer(DenseLayer(nOut=16, activation="tanh"))
+                .layer(OutputLayer(nOut=2, activation="softmax"))
+                .setInputType(InputType.feedForward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 4).astype("float32")
+        y = np.eye(2, dtype="float32")[(x.sum(1) > 0).astype(int)]
+        it = DataSetIterator(x, y, 16)
+        for _ in range(30):
+            net.fit(it)
+        return net, it
+
+    def test_do_evaluation_multiple_and_roc(self):
+        from deeplearning4j_tpu.evaluation import Evaluation
+        from deeplearning4j_tpu.evaluation.roc import ROC
+
+        net, it = self._cls_net_and_iter()
+        e, roc = net.doEvaluation(it, Evaluation(), ROC())
+        assert e.accuracy() > 0.9
+        assert net.evaluateROC(it).calculateAUC() > 0.9
+        assert roc.calculateAUC() > 0.9
+
+    def test_evaluate_regression(self):
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           MultiLayerNetwork, DenseLayer,
+                                           OutputLayer, Adam)
+        from deeplearning4j_tpu.data import DataSetIterator
+
+        conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(5e-2))
+                .list().layer(DenseLayer(nOut=8, activation="tanh"))
+                .layer(OutputLayer(nOut=1, activation="identity",
+                                   lossFunction="mse"))
+                .setInputType(InputType.feedForward(3)).build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(1)
+        x = rng.randn(64, 3).astype("float32")
+        y = (x @ np.array([[1.0], [-2.0], [0.5]])).astype("float32")
+        it = DataSetIterator(x, y, 16)
+        for _ in range(60):
+            net.fit(it)
+        r = net.evaluateRegression(it)
+        assert r.averageMeanSquaredError() < 0.1
+
+    def test_graph_do_evaluation(self):
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           ComputationGraph, DenseLayer,
+                                           OutputLayer, Adam)
+        from deeplearning4j_tpu.data import DataSetIterator
+        from deeplearning4j_tpu.evaluation import Evaluation
+
+        g = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(5e-2))
+             .graphBuilder().addInputs("in")
+             .addLayer("h", DenseLayer(nOut=16, activation="tanh"), "in")
+             .addLayer("out", OutputLayer(nOut=2, activation="softmax"), "h")
+             .setOutputs("out").setInputTypes(InputType.feedForward(4))
+             .build())
+        net = ComputationGraph(g).init()
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 4).astype("float32")
+        y = np.eye(2, dtype="float32")[(x.sum(1) > 0).astype(int)]
+        it = DataSetIterator(x, y, 16)
+        for _ in range(30):
+            net.fit(it)
+        e = net.doEvaluation(it, Evaluation())
+        assert e.accuracy() > 0.9
+        assert net.evaluateROC(it).calculateAUC() > 0.9
+
+    def test_do_evaluation_rejects_empty_and_multi_output(self):
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           ComputationGraph, DenseLayer,
+                                           OutputLayer, Adam)
+        from deeplearning4j_tpu.data import DataSetIterator
+
+        net, it = self._cls_net_and_iter()
+        with pytest.raises(ValueError, match="at least one"):
+            net.doEvaluation(it)
+        g = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-2))
+             .graphBuilder().addInputs("in")
+             .addLayer("h", DenseLayer(nOut=4), "in")
+             .addLayer("o1", OutputLayer(nOut=2, activation="softmax"), "h")
+             .addLayer("o2", OutputLayer(nOut=3, activation="softmax"), "h")
+             .setOutputs("o1", "o2")
+             .setInputTypes(InputType.feedForward(4)).build())
+        multi = ComputationGraph(g).init()
+        from deeplearning4j_tpu.evaluation import Evaluation
+        with pytest.raises(ValueError, match="single-output"):
+            multi.doEvaluation(it, Evaluation())
