@@ -19,6 +19,7 @@ from deeplearning4j_tpu.data.iterators import (
 from deeplearning4j_tpu.data.records import (
     RecordReader, CSVRecordReader, CollectionRecordReader, ImageRecordReader,
     Schema, TransformProcess, RecordReaderDataSetIterator,
+    CSVSequenceRecordReader, SequenceRecordReaderDataSetIterator,
 )
 
 __all__ = [
@@ -30,5 +31,6 @@ __all__ = [
     "Cifar10DataSetIterator", "CifarDataSetIterator", "RandomDataSetIterator",
     "RecordReader", "CSVRecordReader", "CollectionRecordReader",
     "ImageRecordReader", "Schema", "TransformProcess",
-    "RecordReaderDataSetIterator",
+    "RecordReaderDataSetIterator", "CSVSequenceRecordReader",
+    "SequenceRecordReaderDataSetIterator",
 ]

@@ -333,3 +333,138 @@ class RecordReaderDataSetIterator:
 
     def __iter__(self):
         return iter(self._it)
+
+
+class CSVSequenceRecordReader(RecordReader):
+    """Time-series reader: each FILE is one sequence, each line one time
+    step (reference: datavec CSVSequenceRecordReader). initialize() takes
+    a directory (files sorted by name) or an explicit list of paths;
+    next() returns the sequence as a list of per-step value lists."""
+
+    def __init__(self, skipNumLines: int = 0, delimiter: str = ","):
+        self.skip = int(skipNumLines)
+        self.delim = delimiter
+        self._files = []
+        self._i = 0
+
+    def initialize(self, source):
+        import os
+
+        if isinstance(source, (list, tuple)):
+            self._files = [str(p) for p in source]
+        elif os.path.isdir(source):
+            self._files = sorted(
+                p for p in (os.path.join(source, f)
+                            for f in os.listdir(source)
+                            if not f.startswith("."))
+                if os.path.isfile(p))
+        else:
+            self._files = [str(source)]
+        self._i = 0
+        return self
+
+    def hasNext(self):
+        return self._i < len(self._files)
+
+    def next(self):
+        path = self._files[self._i]
+        self._i += 1
+        seq = []
+        with open(path) as fh:
+            for li, line in enumerate(fh):
+                if li < self.skip:
+                    continue
+                line = line.strip()
+                if not line:
+                    continue
+                seq.append([CSVRecordReader._parse(t)
+                            for t in line.split(self.delim)])
+        return seq
+
+    def reset(self):
+        self._i = 0
+
+
+class SequenceRecordReaderDataSetIterator:
+    """Zip a features sequence reader with a labels sequence reader into
+    padded+masked recurrent DataSets (reference:
+    SequenceRecordReaderDataSetIterator, ALIGN_END-free equal-length or
+    padded variable-length batches).
+
+    Output layout matches the recurrent layers' NCW convention:
+    features [B, F, T], labels [B, C, T] (one-hot classification when
+    numPossibleLabels is set, raw values for regression=True), masks
+    [B, T] marking real steps. Sequences in a batch are padded to the
+    batch's longest sequence — static shapes per batch, mask-correct
+    losses (the XLA-friendly form of the reference's variable-length
+    handling)."""
+
+    def __init__(self, featureReader, labelReader, miniBatchSize,
+                 numPossibleLabels=-1, regression=False):
+        if (numPossibleLabels is None or numPossibleLabels < 1) \
+                and not regression:
+            raise ValueError(
+                "classification needs numPossibleLabels >= 1 "
+                "(or pass regression=True)")
+        self._fr = featureReader
+        self._lr = labelReader
+        self.batch = int(miniBatchSize)
+        self.numLabels = -1 if numPossibleLabels is None \
+            else int(numPossibleLabels)
+        self.regression = bool(regression)
+
+    def reset(self):
+        self._fr.reset()
+        self._lr.reset()
+
+    def hasNext(self):
+        return self._fr.hasNext() and self._lr.hasNext()
+
+    def next(self, num=None):
+        from deeplearning4j_tpu.data.dataset import DataSet
+
+        n = num or self.batch
+        fseqs, lseqs = [], []
+        while len(fseqs) < n and self.hasNext():
+            f = self._fr.next()
+            l = self._lr.next()
+            if len(f) != len(l):
+                raise ValueError(
+                    f"feature sequence length {len(f)} != label sequence "
+                    f"length {len(l)} (readers must be aligned)")
+            fseqs.append(np.asarray(f, dtype="float32"))
+            lseqs.append(np.asarray(l, dtype="float32"))
+        if not fseqs:
+            raise ValueError("iterator exhausted (or empty readers); "
+                             "call reset() or check the source paths")
+        if self._fr.hasNext() != self._lr.hasNext():
+            raise ValueError(
+                "feature and label readers hold different sequence counts "
+                "— a file pair is missing on one side")
+        B = len(fseqs)
+        T = max(s.shape[0] for s in fseqs)
+        F = fseqs[0].shape[1]
+        C = self.numLabels if not self.regression else lseqs[0].shape[1]
+        x = np.zeros((B, F, T), "float32")
+        y = np.zeros((B, C, T), "float32")
+        mask = np.zeros((B, T), "float32")
+        for i, (f, l) in enumerate(zip(fseqs, lseqs)):
+            t = f.shape[0]
+            x[i, :, :t] = f.T
+            mask[i, :t] = 1.0
+            if self.regression:
+                y[i, :, :t] = l.T
+            else:
+                ids = l.astype(int).reshape(t, -1)[:, 0]
+                if ids.min() < 0 or ids.max() >= C:
+                    bad = ids[(ids < 0) | (ids >= C)][0]
+                    raise ValueError(
+                        f"label value {bad} outside [0, {C}) "
+                        f"(numPossibleLabels={C})")
+                y[i, ids, np.arange(t)] = 1.0
+        return DataSet(x, y, featuresMask=mask, labelsMask=mask)
+
+    def __iter__(self):
+        self.reset()
+        while self.hasNext():
+            yield self.next()

@@ -304,3 +304,133 @@ class TestReviewRegressions:
         tp = TransformProcess.Builder(sch).categoricalToOneHot("c").build()
         with pytest.raises(ValueError, match="not in states"):
             tp.execute([["X"]])
+
+
+class TestSequenceRecords:
+    """CSVSequenceRecordReader + SequenceRecordReaderDataSetIterator
+    (reference: datavec sequence readers feeding recurrent nets)."""
+
+    def _write_seqs(self, tmp_path, lengths, nfeat=3):
+        fdir = tmp_path / "features"
+        ldir = tmp_path / "labels"
+        fdir.mkdir()
+        ldir.mkdir()
+        rng = np.random.RandomState(0)
+        for i, T in enumerate(lengths):
+            feats = rng.rand(T, nfeat)
+            labs = rng.randint(0, 2, (T, 1))
+            (fdir / f"seq_{i}.csv").write_text(
+                "\n".join(",".join(f"{v:.6f}" for v in row) for row in feats))
+            (ldir / f"seq_{i}.csv").write_text(
+                "\n".join(str(int(v[0])) for v in labs))
+        return str(fdir), str(ldir)
+
+    def test_reader_per_file_sequences(self, tmp_path):
+        from deeplearning4j_tpu.data import CSVSequenceRecordReader
+
+        fdir, _ = self._write_seqs(tmp_path, [4, 6])
+        rr = CSVSequenceRecordReader().initialize(fdir)
+        s0 = rr.next()
+        s1 = rr.next()
+        assert len(s0) == 4 and len(s1) == 6 and len(s0[0]) == 3
+        assert not rr.hasNext()
+        rr.reset()
+        assert rr.hasNext()
+
+    def test_iterator_pads_and_masks(self, tmp_path):
+        from deeplearning4j_tpu.data import (CSVSequenceRecordReader,
+                                             SequenceRecordReaderDataSetIterator)
+
+        fdir, ldir = self._write_seqs(tmp_path, [4, 6, 5])
+        it = SequenceRecordReaderDataSetIterator(
+            CSVSequenceRecordReader().initialize(fdir),
+            CSVSequenceRecordReader().initialize(ldir),
+            miniBatchSize=3, numPossibleLabels=2)
+        ds = it.next()
+        x = ds.getFeatures().toNumpy()
+        y = ds.getLabels().toNumpy()
+        m = ds.getFeaturesMaskArray().toNumpy()
+        assert x.shape == (3, 3, 6) and y.shape == (3, 2, 6)
+        np.testing.assert_array_equal(m.sum(1), [4, 6, 5])
+        # padding region is zero and one-hot labels sum to 1 on real steps
+        assert x[0, :, 4:].sum() == 0
+        np.testing.assert_array_equal(y[0, :, :4].sum(0), np.ones(4))
+        assert y[0, :, 4:].sum() == 0
+
+    def test_trains_masked_rnn(self, tmp_path):
+        from deeplearning4j_tpu.data import (CSVSequenceRecordReader,
+                                             SequenceRecordReaderDataSetIterator)
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           MultiLayerNetwork, LSTM,
+                                           RnnOutputLayer, Adam)
+
+        fdir, ldir = self._write_seqs(tmp_path, [4, 6, 5, 7])
+        conf = (NeuralNetConfiguration.Builder().seed(1).updater(Adam(1e-2))
+                .list().layer(LSTM(nOut=8))
+                .layer(RnnOutputLayer(nOut=2, activation="softmax",
+                                      lossFunction="mcxent"))
+                .setInputType(InputType.recurrent(3)).build())
+        net = MultiLayerNetwork(conf).init()
+        it = SequenceRecordReaderDataSetIterator(
+            CSVSequenceRecordReader().initialize(fdir),
+            CSVSequenceRecordReader().initialize(ldir),
+            miniBatchSize=4, numPossibleLabels=2)
+        for _ in range(3):
+            net.fit(it)
+        assert np.isfinite(net.score())
+
+    def test_misaligned_readers_rejected(self, tmp_path):
+        from deeplearning4j_tpu.data import (CSVSequenceRecordReader,
+                                             SequenceRecordReaderDataSetIterator)
+
+        fdir, _ = self._write_seqs(tmp_path, [4, 6])
+        (tmp_path / "b").mkdir()
+        _, ldir = self._write_seqs(tmp_path / "b", [5, 6])
+        it = SequenceRecordReaderDataSetIterator(
+            CSVSequenceRecordReader().initialize(fdir),
+            CSVSequenceRecordReader().initialize(ldir),
+            miniBatchSize=2, numPossibleLabels=2)
+        with pytest.raises(ValueError, match="aligned"):
+            it.next()
+
+    def test_edge_cases_rejected_clearly(self, tmp_path):
+        from deeplearning4j_tpu.data import (CSVSequenceRecordReader,
+                                             SequenceRecordReaderDataSetIterator)
+
+        fdir, ldir = self._write_seqs(tmp_path, [3, 3])
+        # subdirectory in the source dir is skipped, not opened
+        (tmp_path / "features" / "sub").mkdir()
+        rr = CSVSequenceRecordReader().initialize(fdir)
+        assert len(rr._files) == 2
+        # exhausted next() is loud
+        it = SequenceRecordReaderDataSetIterator(
+            CSVSequenceRecordReader().initialize(fdir),
+            CSVSequenceRecordReader().initialize(ldir),
+            miniBatchSize=2, numPossibleLabels=2)
+        it.next()
+        with pytest.raises(ValueError, match="exhausted"):
+            it.next()
+        # out-of-range label is loud
+        (tmp_path / "l2").mkdir()
+        for i in range(2):
+            (tmp_path / "l2" / f"seq_{i}.csv").write_text("7\n0\n1")
+        it2 = SequenceRecordReaderDataSetIterator(
+            CSVSequenceRecordReader().initialize(fdir),
+            CSVSequenceRecordReader().initialize(str(tmp_path / "l2")),
+            miniBatchSize=2, numPossibleLabels=2)
+        with pytest.raises(ValueError, match="outside"):
+            it2.next()
+        # mismatched file counts are loud
+        (tmp_path / "l3").mkdir()
+        (tmp_path / "l3" / "seq_0.csv").write_text("0\n1\n0")
+        it3 = SequenceRecordReaderDataSetIterator(
+            CSVSequenceRecordReader().initialize(fdir),
+            CSVSequenceRecordReader().initialize(str(tmp_path / "l3")),
+            miniBatchSize=1, numPossibleLabels=2)
+        with pytest.raises(ValueError, match="different sequence counts"):
+            it3.next()
+        # regression + numPossibleLabels=None constructs fine
+        SequenceRecordReaderDataSetIterator(
+            CSVSequenceRecordReader().initialize(fdir),
+            CSVSequenceRecordReader().initialize(ldir),
+            miniBatchSize=2, numPossibleLabels=None, regression=True)
