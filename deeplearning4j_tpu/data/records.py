@@ -379,6 +379,8 @@ class CSVSequenceRecordReader(RecordReader):
                     continue
                 seq.append([CSVRecordReader._parse(t)
                             for t in line.split(self.delim)])
+        if not seq:
+            raise ValueError(f"empty sequence file: {path}")
         return seq
 
     def reset(self):
@@ -423,7 +425,9 @@ class SequenceRecordReaderDataSetIterator:
     def next(self, num=None):
         from deeplearning4j_tpu.data.dataset import DataSet
 
-        n = num or self.batch
+        n = self.batch if num is None else int(num)
+        if n <= 0:
+            raise ValueError(f"batch size must be positive, got {n}")
         fseqs, lseqs = [], []
         while len(fseqs) < n and self.hasNext():
             f = self._fr.next()

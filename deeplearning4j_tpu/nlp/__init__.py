@@ -1,0 +1,10 @@
+"""NLP: word embeddings (reference: deeplearning4j-nlp Word2Vec /
+ParagraphVectors + tokenizers). Compute path is one jitted SGNS step."""
+
+from deeplearning4j_tpu.nlp.word2vec import (
+    Word2Vec, DefaultTokenizerFactory, CollectionSentenceIterator,
+    LineSentenceIterator,
+)
+
+__all__ = ["Word2Vec", "DefaultTokenizerFactory",
+           "CollectionSentenceIterator", "LineSentenceIterator"]

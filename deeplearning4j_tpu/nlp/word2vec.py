@@ -1,0 +1,254 @@
+"""Word2Vec — skip-gram with negative sampling.
+
+Reference: deeplearning4j-nlp org.deeplearning4j.models.word2vec.Word2Vec
+(Builder: minWordFrequency/layerSize/windowSize/negativeSample/seed/
+iterations/learningRate; API: getWordVector, wordsNearest, similarity)
+with SentenceIterator + TokenizerFactory feeding it. Upstream trains
+with per-thread Hogwild updates over a JVM float array; TPU-native
+design: vocab scan + pair extraction happen host-side ONCE, then
+training is a single jitted SGNS step over minibatches of
+(center, context, negatives) index triples — two embedding gathers, a
+sigmoid loss, scatter-add gradients — donated buffers, counter-based
+negative sampling keyed per step.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class DefaultTokenizerFactory:
+    """Lowercasing word tokenizer (reference:
+    text.tokenization.tokenizerfactory.DefaultTokenizerFactory)."""
+
+    _RE = re.compile(r"[A-Za-z0-9']+")
+
+    def create(self, sentence):
+        return self._RE.findall(sentence.lower())
+
+
+class CollectionSentenceIterator:
+    """Sentences from an in-memory collection (reference:
+    text.sentenceiterator.CollectionSentenceIterator)."""
+
+    def __init__(self, sentences):
+        self._s = list(sentences)
+        self._i = 0
+
+    def hasNext(self):
+        return self._i < len(self._s)
+
+    def nextSentence(self):
+        s = self._s[self._i]
+        self._i += 1
+        return s
+
+    def reset(self):
+        self._i = 0
+
+
+class LineSentenceIterator(CollectionSentenceIterator):
+    """One sentence per line of a file (reference:
+    text.sentenceiterator.LineSentenceIterator)."""
+
+    def __init__(self, path):
+        with open(path) as fh:
+            super().__init__([l.strip() for l in fh if l.strip()])
+
+
+class Word2Vec:
+    """Builder-constructed SGNS model (reference: Word2Vec.Builder)."""
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def minWordFrequency(self, n):
+            self._kw["minWordFrequency"] = int(n)
+            return self
+
+        def layerSize(self, n):
+            self._kw["layerSize"] = int(n)
+            return self
+
+        def windowSize(self, n):
+            self._kw["windowSize"] = int(n)
+            return self
+
+        def negativeSample(self, n):
+            self._kw["negative"] = int(n)
+            return self
+
+        def seed(self, s):
+            self._kw["seed"] = int(s)
+            return self
+
+        def iterations(self, n):  # epochs over the extracted pairs
+            self._kw["iterations"] = int(n)
+            return self
+
+        def learningRate(self, lr):
+            self._kw["learningRate"] = float(lr)
+            return self
+
+        def batchSize(self, n):
+            self._kw["batchSize"] = int(n)
+            return self
+
+        def iterate(self, sentenceIterator):
+            self._kw["iterator"] = sentenceIterator
+            return self
+
+        def tokenizerFactory(self, tf):
+            self._kw["tokenizer"] = tf
+            return self
+
+        def build(self):
+            return Word2Vec(**self._kw)
+
+    def __init__(self, iterator=None, tokenizer=None, minWordFrequency=5,
+                 layerSize=100, windowSize=5, negative=5, seed=42,
+                 iterations=1, learningRate=0.025, batchSize=1024):
+        self.iterator = iterator
+        self.tokenizer = tokenizer or DefaultTokenizerFactory()
+        self.minWordFrequency = minWordFrequency
+        self.layerSize = layerSize
+        self.windowSize = windowSize
+        self.negative = negative
+        self.seed = seed
+        self.iterations = iterations
+        self.learningRate = learningRate
+        self.batchSize = batchSize
+        self.vocab = {}            # word -> index
+        self._ivocab = []          # index -> word
+        self._freq = None          # unigram^0.75 sampling weights
+        self._W = None             # [V, D] input embeddings (the vectors)
+        self._C = None             # [V, D] context (output) embeddings
+
+    # ---------------- vocab + pair extraction (host side, once) --------
+    def _scan(self):
+        counts = Counter()
+        sents = []
+        self.iterator.reset()
+        while self.iterator.hasNext():
+            toks = self.tokenizer.create(self.iterator.nextSentence())
+            sents.append(toks)
+            counts.update(toks)
+        vocab_words = sorted(
+            (w for w, c in counts.items() if c >= self.minWordFrequency),
+            key=lambda w: (-counts[w], w))
+        if not vocab_words:
+            raise ValueError(
+                f"empty vocabulary: no token reached minWordFrequency="
+                f"{self.minWordFrequency}")
+        self.vocab = {w: i for i, w in enumerate(vocab_words)}
+        self._ivocab = vocab_words
+        f = np.array([counts[w] for w in vocab_words], "float64") ** 0.75
+        self._freq = (f / f.sum()).astype("float32")
+        centers, contexts = [], []
+        for toks in sents:
+            ids = [self.vocab[t] for t in toks if t in self.vocab]
+            for i, c in enumerate(ids):
+                lo = max(0, i - self.windowSize)
+                hi = min(len(ids), i + self.windowSize + 1)
+                for j in range(lo, hi):
+                    if j != i:
+                        centers.append(c)
+                        contexts.append(ids[j])
+        if not centers:
+            raise ValueError("no training pairs (sentences too short?)")
+        return (np.asarray(centers, "int32"), np.asarray(contexts, "int32"))
+
+    # ---------------- training -------------------------------------
+    def fit(self):
+        centers, contexts = self._scan()
+        V, D, K = len(self.vocab), self.layerSize, self.negative
+        rng = jax.random.key(self.seed)
+        init_k, shuf_k = jax.random.split(rng)
+        W = (jax.random.uniform(init_k, (V, D), jnp.float32) - 0.5) / D
+        C = jnp.zeros((V, D), jnp.float32)
+        freq = jnp.asarray(self._freq)
+        lr = self.learningRate
+
+        def step(W, C, ctr, ctx, key):
+            neg = jax.random.choice(key, V, (ctr.shape[0], K), p=freq)
+
+            def loss_fn(W, C):
+                w = W[ctr]                       # [B, D]
+                pos = jnp.sum(w * C[ctx], -1)    # [B]
+                negs = jnp.einsum("bd,bkd->bk", w, C[neg])
+                return -(jnp.mean(jax.nn.log_sigmoid(pos)) +
+                         jnp.mean(jnp.sum(jax.nn.log_sigmoid(-negs), -1)))
+
+            loss, (gW, gC) = jax.value_and_grad(loss_fn, argnums=(0, 1))(W, C)
+            return W - lr * gW, C - lr * gC, loss
+
+        jstep = jax.jit(step, donate_argnums=(0, 1))
+        n = centers.shape[0]
+        B = min(self.batchSize, n)
+        loss = jnp.float32(0)
+        for epoch in range(self.iterations):
+            perm = np.asarray(jax.random.permutation(
+                jax.random.fold_in(shuf_k, epoch), n))
+            ctr_e, ctx_e = centers[perm], contexts[perm]
+            for s in range(0, n, B):  # the tail batch trains too (one
+                # extra jit specialization for its shape, compiled once)
+                key = jax.random.fold_in(rng, epoch * 100003 + s)
+                W, C, loss = jstep(W, C, jnp.asarray(ctr_e[s:s + B]),
+                                   jnp.asarray(ctx_e[s:s + B]), key)
+        self._W, self._C = W, C
+        self._score = float(loss)
+        return self
+
+    # ---------------- query API ----------------------------------
+    def _require_fit(self):
+        if self._W is None:
+            raise RuntimeError("call fit() first")
+
+    def hasWord(self, word):
+        return word in self.vocab
+
+    def getWordVector(self, word):
+        self._require_fit()
+        return np.asarray(self._W[self.vocab[word]])
+
+    def similarity(self, w1, w2):
+        a, b = self.getWordVector(w1), self.getWordVector(w2)
+        return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
+
+    def wordsNearest(self, word, n=10):
+        self._require_fit()
+        W = np.asarray(self._W)
+        v = W[self.vocab[word]]
+        sims = W @ v / (np.linalg.norm(W, axis=1) * np.linalg.norm(v) + 1e-12)
+        order = np.argsort(-sims)
+        out = [self._ivocab[i] for i in order if self._ivocab[i] != word]
+        return out[:n]
+
+    # ---------------- serde --------------------------------------
+    @staticmethod
+    def _npz(path):
+        p = str(path)
+        return p if p.endswith(".npz") else p + ".npz"
+
+    def save(self, path):
+        self._require_fit()
+        np.savez(self._npz(path), words=np.array(self._ivocab, dtype=object),
+                 W=np.asarray(self._W), C=np.asarray(self._C))
+
+    @staticmethod
+    def load(path):
+        z = np.load(Word2Vec._npz(path), allow_pickle=True)
+        m = Word2Vec()
+        m._ivocab = [str(w) for w in z["words"]]
+        m.vocab = {w: i for i, w in enumerate(m._ivocab)}
+        m._W = jnp.asarray(z["W"])
+        m._C = jnp.asarray(z["C"])
+        m.layerSize = int(z["W"].shape[1])
+        return m

@@ -434,3 +434,20 @@ class TestSequenceRecords:
             CSVSequenceRecordReader().initialize(fdir),
             CSVSequenceRecordReader().initialize(ldir),
             miniBatchSize=2, numPossibleLabels=None, regression=True)
+
+    def test_empty_sequence_file_and_zero_batch_rejected(self, tmp_path):
+        from deeplearning4j_tpu.data import (CSVSequenceRecordReader,
+                                             SequenceRecordReaderDataSetIterator)
+
+        fdir, ldir = self._write_seqs(tmp_path, [3])
+        (tmp_path / "features" / "seq_z.csv").write_text("")
+        rr = CSVSequenceRecordReader().initialize(fdir)
+        rr.next()  # seq_0 fine
+        with pytest.raises(ValueError, match="empty sequence file"):
+            rr.next()
+        it = SequenceRecordReaderDataSetIterator(
+            CSVSequenceRecordReader().initialize(ldir),
+            CSVSequenceRecordReader().initialize(ldir),
+            miniBatchSize=1, numPossibleLabels=2)
+        with pytest.raises(ValueError, match="positive"):
+            it.next(0)

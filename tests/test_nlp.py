@@ -1,0 +1,72 @@
+"""Word2Vec SGNS (reference: deeplearning4j-nlp Word2Vec): vocab rules,
+semantic clustering on a structured synthetic corpus, API parity, serde.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (Word2Vec, DefaultTokenizerFactory,
+                                    CollectionSentenceIterator)
+
+
+def _corpus(n=300, seed=0):
+    """Two 'topics' whose words co-occur only within their topic; an
+    embedding that captures co-occurrence must cluster them."""
+    rng = np.random.RandomState(seed)
+    animals = ["cat", "dog", "horse", "sheep", "cow"]
+    tech = ["cpu", "gpu", "ram", "disk", "cache"]
+    sents = []
+    for _ in range(n):
+        topic = animals if rng.rand() < 0.5 else tech
+        sents.append(" ".join(rng.choice(topic, 6)))
+    return sents
+
+
+class TestWord2Vec:
+    def _fit(self):
+        return (Word2Vec.Builder()
+                .minWordFrequency(2).layerSize(16).windowSize(3)
+                .negativeSample(4).seed(7).iterations(40)
+                .learningRate(0.5)
+                .iterate(CollectionSentenceIterator(_corpus()))
+                .tokenizerFactory(DefaultTokenizerFactory())
+                .build().fit())
+
+    def test_topic_words_cluster(self):
+        m = self._fit()
+        intra = m.similarity("cat", "dog")
+        inter = m.similarity("cat", "gpu")
+        assert intra > inter + 0.2, (intra, inter)
+        near = m.wordsNearest("cpu", 4)
+        assert set(near) <= {"gpu", "ram", "disk", "cache"}, near
+
+    def test_vocab_rules_and_vector_shape(self):
+        m = self._fit()
+        assert m.hasWord("cat") and not m.hasWord("zebra")
+        assert m.getWordVector("cat").shape == (16,)
+        with pytest.raises(ValueError, match="empty vocabulary"):
+            (Word2Vec.Builder().minWordFrequency(10_000)
+             .iterate(CollectionSentenceIterator(_corpus(20)))
+             .build().fit())
+
+    def test_save_load_roundtrip(self, tmp_path):
+        m = self._fit()
+        p = str(tmp_path / "w2v.npz")
+        m.save(p)
+        m2 = Word2Vec.load(p)
+        np.testing.assert_array_equal(m2.getWordVector("dog"),
+                                      m.getWordVector("dog"))
+        assert m2.wordsNearest("cat", 3) == m.wordsNearest("cat", 3)
+
+    def test_requires_fit(self):
+        m = (Word2Vec.Builder()
+             .iterate(CollectionSentenceIterator(_corpus(10))).build())
+        with pytest.raises(RuntimeError, match="fit"):
+            m.getWordVector("cat")
+
+    def test_save_without_extension_roundtrips(self, tmp_path):
+        m = self._fit()
+        p = str(tmp_path / "vectors")  # no .npz: np.savez appends it
+        m.save(p)
+        np.testing.assert_array_equal(Word2Vec.load(p).getWordVector("dog"),
+                                      m.getWordVector("dog"))
