@@ -2,9 +2,9 @@
 ParagraphVectors + tokenizers). Compute path is one jitted SGNS step."""
 
 from deeplearning4j_tpu.nlp.word2vec import (
-    Word2Vec, DefaultTokenizerFactory, CollectionSentenceIterator,
-    LineSentenceIterator,
+    Word2Vec, ParagraphVectors, DefaultTokenizerFactory,
+    CollectionSentenceIterator, LineSentenceIterator,
 )
 
-__all__ = ["Word2Vec", "DefaultTokenizerFactory",
+__all__ = ["Word2Vec", "ParagraphVectors", "DefaultTokenizerFactory",
            "CollectionSentenceIterator", "LineSentenceIterator"]

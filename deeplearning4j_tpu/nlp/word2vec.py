@@ -130,6 +130,7 @@ class Word2Vec:
         self._freq = None          # unigram^0.75 sampling weights
         self._W = None             # [V, D] input embeddings (the vectors)
         self._C = None             # [V, D] context (output) embeddings
+        self._doc_trained = None   # ParagraphVectors: bool per doc
 
     # ---------------- vocab + pair extraction (host side, once) --------
     def _scan(self):
@@ -140,6 +141,7 @@ class Word2Vec:
             toks = self.tokenizer.create(self.iterator.nextSentence())
             sents.append(toks)
             counts.update(toks)
+        self._sents = sents  # reused by ParagraphVectors._doc_pairs
         vocab_words = sorted(
             (w for w, c in counts.items() if c >= self.minWordFrequency),
             key=lambda w: (-counts[w], w))
@@ -252,3 +254,167 @@ class Word2Vec:
         m._C = jnp.asarray(z["C"])
         m.layerSize = int(z["W"].shape[1])
         return m
+
+
+class ParagraphVectors(Word2Vec):
+    """Doc embeddings via PV-DBOW (reference: deeplearning4j-nlp
+    models.paragraphvectors.ParagraphVectors, dm=0 mode): each document
+    vector is trained to predict the words it contains, against the
+    same negative-sampling objective and context table as Word2Vec.
+    Labels are the document indices ("DOC_i" upstream LabelsSource);
+    inferVector() fits a fresh vector for unseen text with the trained
+    context table frozen."""
+
+    class Builder(Word2Vec.Builder):
+        def build(self):
+            return ParagraphVectors(**self._kw)
+
+    def _doc_pairs(self):
+        """(doc_id, word_id) for every in-vocab token of every doc; uses
+        the token lists _scan already produced (no second tokenize
+        pass). Docs with zero in-vocab tokens are recorded so queries
+        against their untrained (noise) rows fail loudly."""
+        d, w, trained = [], [], []
+        for doc_id, toks in enumerate(self._sents):
+            ids = [self.vocab[t] for t in toks if t in self.vocab]
+            trained.append(bool(ids))
+            for i in ids:
+                d.append(doc_id)
+                w.append(i)
+        self._n_docs = len(self._sents)
+        self._doc_trained = np.asarray(trained, bool)
+        return np.asarray(d, "int32"), np.asarray(w, "int32")
+
+    def fit(self):
+        super().fit()  # word/context tables first (standard SGNS)
+        d_idx, w_idx = self._doc_pairs()
+        V, D, K = len(self.vocab), self.layerSize, self.negative
+        init_k, shuf_k, step_k = jax.random.split(
+            jax.random.key(self.seed ^ 0xD0C), 3)
+        Dv = (jax.random.uniform(init_k, (self._n_docs, D), jnp.float32)
+              - 0.5) / D
+        C = self._C  # frozen context table
+        freq = jnp.asarray(self._freq)
+        lr = self.learningRate
+
+        def step(Dv, dids, wids, key):
+            neg = jax.random.choice(key, V, (dids.shape[0], K), p=freq)
+
+            def loss_fn(Dv):
+                v = Dv[dids]
+                pos = jnp.sum(v * C[wids], -1)
+                negs = jnp.einsum("bd,bkd->bk", v, C[neg])
+                return -(jnp.mean(jax.nn.log_sigmoid(pos)) +
+                         jnp.mean(jnp.sum(jax.nn.log_sigmoid(-negs), -1)))
+
+            loss, g = jax.value_and_grad(loss_fn)(Dv)
+            return Dv - lr * g, loss
+
+        jstep = jax.jit(step, donate_argnums=(0,))
+        n = d_idx.shape[0]
+        B = min(self.batchSize, n)
+        for epoch in range(self.iterations):
+            perm = np.asarray(jax.random.permutation(
+                jax.random.fold_in(shuf_k, epoch), n))
+            de, we = d_idx[perm], w_idx[perm]
+            for s in range(0, n, B):
+                key = jax.random.fold_in(step_k, epoch * 99991 + s)
+                Dv, _ = jstep(Dv, jnp.asarray(de[s:s + B]),
+                              jnp.asarray(we[s:s + B]), key)
+        self._D = Dv
+        return self
+
+    def getParagraphVector(self, docIndex):
+        if getattr(self, "_D", None) is None:
+            raise RuntimeError("call fit() first")
+        i = int(docIndex)
+        if self._doc_trained is not None and not self._doc_trained[i]:
+            raise ValueError(
+                f"document {i} has no in-vocabulary tokens — its vector "
+                f"was never trained")
+        return np.asarray(self._D[i])
+
+    def inferVector(self, text, steps=50):
+        """Fit a vector for unseen text against the frozen context table
+        (reference: ParagraphVectors.inferVector)."""
+        if getattr(self, "_D", None) is None:
+            raise RuntimeError("call fit() first")
+        ids = [self.vocab[t] for t in self.tokenizer.create(text)
+               if t in self.vocab]
+        if not ids:
+            raise ValueError("no in-vocabulary tokens in text")
+        wids = jnp.asarray(np.asarray(ids, "int32"))
+        V, K = len(self.vocab), self.negative
+        C, freq, lr = self._C, jnp.asarray(self._freq), self.learningRate
+        init_k, samp_k = jax.random.split(jax.random.key(self.seed ^ 0x1FE12))
+        v0 = (jax.random.uniform(init_k, (self.layerSize,), jnp.float32)
+              - 0.5) / self.layerSize
+
+        # jitted once per (token count, steps); repeat queries hit the
+        # cache instead of paying a fresh XLA compile per call
+        cache = getattr(self, "_infer_cache", None)
+        if cache is None:
+            cache = self._infer_cache = {}
+        ck = (int(wids.shape[0]), int(steps))
+        run = cache.get(ck)
+        if run is None:
+            def run_fn(v, wids, key):
+                def body(i, carry):
+                    v, k = carry
+                    kk = jax.random.fold_in(k, i)
+                    neg = jax.random.choice(kk, V, (wids.shape[0], K),
+                                            p=freq)
+
+                    def loss_fn(v):
+                        pos = C[wids] @ v
+                        negs = jnp.einsum("bkd,d->bk", C[neg], v)
+                        return -(jnp.mean(jax.nn.log_sigmoid(pos)) +
+                                 jnp.mean(jnp.sum(
+                                     jax.nn.log_sigmoid(-negs), -1)))
+
+                    return v - lr * jax.grad(loss_fn)(v), k
+
+                v, _ = jax.lax.fori_loop(0, steps, body, (v, key))
+                return v
+
+            run = cache[ck] = jax.jit(run_fn)
+        return np.asarray(run(v0, wids, samp_k))
+
+    def save(self, path):
+        self._require_fit()
+        if getattr(self, "_D", None) is None:
+            raise RuntimeError("call fit() first")
+        np.savez(self._npz(path),
+                 words=np.array(self._ivocab, dtype=object),
+                 W=np.asarray(self._W), C=np.asarray(self._C),
+                 D=np.asarray(self._D), freq=np.asarray(self._freq),
+                 doc_trained=np.asarray(self._doc_trained),
+                 hyper=np.asarray([self.negative, self.seed,
+                                   self.learningRate], "float64"))
+
+    @staticmethod
+    def load(path):
+        z = np.load(Word2Vec._npz(path), allow_pickle=True)
+        if "D" not in z.files:
+            raise ValueError(
+                "file holds a Word2Vec model (no doc vectors); load it "
+                "with Word2Vec.load")
+        m = ParagraphVectors()
+        m._ivocab = [str(w) for w in z["words"]]
+        m.vocab = {w: i for i, w in enumerate(m._ivocab)}
+        m._W = jnp.asarray(z["W"])
+        m._C = jnp.asarray(z["C"])
+        m._D = jnp.asarray(z["D"])
+        m._freq = np.asarray(z["freq"])
+        m._doc_trained = np.asarray(z["doc_trained"])
+        m.layerSize = int(z["W"].shape[1])
+        # inferVector depends on these — restore what fit() used
+        m.negative = int(z["hyper"][0])
+        m.seed = int(z["hyper"][1])
+        m.learningRate = float(z["hyper"][2])
+        return m
+
+    def similarityToDoc(self, text, docIndex):
+        a = self.inferVector(text)
+        b = self.getParagraphVector(docIndex)
+        return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))

@@ -70,3 +70,85 @@ class TestWord2Vec:
         m.save(p)
         np.testing.assert_array_equal(Word2Vec.load(p).getWordVector("dog"),
                                       m.getWordVector("dog"))
+
+
+class TestParagraphVectors:
+    """PV-DBOW (reference: ParagraphVectors, dm=0): doc vectors cluster
+    by topic and inferVector lands near same-topic documents."""
+
+    def _fit(self):
+        from deeplearning4j_tpu.nlp import ParagraphVectors
+
+        return (ParagraphVectors.Builder()
+                .minWordFrequency(2).layerSize(16).windowSize(3)
+                .negativeSample(4).seed(7).iterations(40).learningRate(0.5)
+                .iterate(CollectionSentenceIterator(_corpus(100)))
+                .build().fit())
+
+    def test_doc_vectors_cluster_by_topic(self):
+        m = self._fit()
+        # reconstruct each doc's topic from the corpus generator
+        docs = _corpus(100)
+        animal = [i for i, d in enumerate(docs) if "cat" in d or "dog" in d
+                  or "horse" in d or "sheep" in d or "cow" in d]
+        tech = [i for i, d in enumerate(docs) if i not in animal]
+        # center first: SGNS embeddings share a large mean component that
+        # masks topic structure under raw cosine
+        mu = np.stack([m.getParagraphVector(i)
+                       for i in range(len(docs))]).mean(0)
+        va = np.stack([m.getParagraphVector(i) for i in animal[:20]]) - mu
+        vt = np.stack([m.getParagraphVector(i) for i in tech[:20]]) - mu
+
+        def cos(a, b):
+            return (a @ b.T / (np.linalg.norm(a, axis=1)[:, None]
+                               * np.linalg.norm(b, axis=1)[None, :] + 1e-12))
+
+        intra = (cos(va, va).mean() + cos(vt, vt).mean()) / 2
+        inter = cos(va, vt).mean()
+        assert intra > inter + 0.3, (intra, inter)
+
+    def test_infer_vector_matches_topic(self):
+        m = self._fit()
+        s_animal = m.similarityToDoc("the cat and the dog and the cow", 0)
+        docs = _corpus(100)
+        # find one doc per topic
+        ai = next(i for i, d in enumerate(docs) if "cat" in d or "dog" in d)
+        ti = next(i for i, d in enumerate(docs) if "cpu" in d or "gpu" in d)
+        # centered cosine (the shared SGNS mean component masks topics)
+        mu = np.stack([m.getParagraphVector(i)
+                       for i in range(len(docs))]).mean(0)
+        v = m.inferVector("the cat and the dog and the cow") - mu
+        pa = m.getParagraphVector(ai) - mu
+        pt = m.getParagraphVector(ti) - mu
+        sa = v @ pa / (np.linalg.norm(v) * np.linalg.norm(pa) + 1e-12)
+        st = v @ pt / (np.linalg.norm(v) * np.linalg.norm(pt) + 1e-12)
+        assert sa > st + 0.2, (sa, st)
+        assert np.isfinite(s_animal)
+
+    def test_no_vocab_text_rejected(self):
+        m = self._fit()
+        with pytest.raises(ValueError, match="no in-vocabulary"):
+            m.inferVector("zzz qqq")
+
+    def test_pv_save_load_roundtrip_and_untrained_doc(self, tmp_path):
+        from deeplearning4j_tpu.nlp import ParagraphVectors
+
+        m = self._fit()
+        p = str(tmp_path / "pv")
+        m.save(p)
+        m2 = ParagraphVectors.load(p)
+        np.testing.assert_array_equal(m2.getParagraphVector(3),
+                                      m.getParagraphVector(3))
+        v1 = m.inferVector("cat dog cow")
+        v2 = m2.inferVector("cat dog cow")
+        np.testing.assert_allclose(v1, v2, rtol=1e-6)
+        # OOV-only doc: trained-row guard
+        from deeplearning4j_tpu.nlp import CollectionSentenceIterator
+        docs = _corpus(50) + ["zzz qqq xxx"]
+        pv = (ParagraphVectors.Builder().minWordFrequency(2).layerSize(8)
+              .windowSize(2).negativeSample(2).seed(1).iterations(2)
+              .learningRate(0.3)
+              .iterate(CollectionSentenceIterator(docs)).build().fit())
+        with pytest.raises(ValueError, match="no in-vocabulary tokens"):
+            pv.getParagraphVector(50)
+        pv.getParagraphVector(0)  # trained docs still fine
