@@ -23,6 +23,7 @@ from deeplearning4j_tpu.nn.conf.layers import (
     Convolution3D, Cropping1D, Cropping3D, Upsampling1D, Upsampling3D,
     SpaceToDepth, SpaceToBatch, LocallyConnected1D, LocallyConnected2D,
     PReLULayer, CenterLossOutputLayer,
+    PrimaryCapsules, CapsuleLayer, CapsuleStrengthLayer,
     Subsampling1DLayer, ZeroPadding1DLayer, RepeatVector,
     ElementWiseMultiplicationLayer, AutoEncoder,
 )

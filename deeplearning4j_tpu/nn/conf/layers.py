@@ -1293,3 +1293,131 @@ class AutoEncoder(FeedForwardLayer):
             xin @ params["W"] + (params["b"] if self.hasBias else 0.0))
         rec = self.decode(params, h)
         return jnp.mean(jnp.sum(jnp.square(rec - x), axis=-1))
+
+
+# ======================================================================
+# Capsule network layers (reference: conf.layers.{PrimaryCapsules,
+# CapsuleLayer, CapsuleStrengthLayer}, Sabour et al. 2017)
+# ======================================================================
+
+def _squash(s, axis=-1):
+    """v = |s|^2/(1+|s|^2) * s/|s| — the capsule nonlinearity. The norm
+    uses a where-guarded sqrt so zero vectors take the zero subgradient."""
+    sq = jnp.sum(jnp.square(s), axis=axis, keepdims=True)
+    norm = jnp.sqrt(jnp.where(sq > 0, sq, 1.0))
+    unit = jnp.where(sq > 0, s / norm, jnp.zeros_like(s))
+    return (sq / (1.0 + sq)) * unit
+
+
+class PrimaryCapsules(Layer):
+    """Conv features regrouped into capsule vectors and squashed
+    (reference: conf.layers.PrimaryCapsules): a [kh,kw] conv with
+    channels*capsuleDimensions output maps, reshaped to
+    [B, nCaps, capsDim]. Output rides as InputType.recurrent(capsDim,
+    nCaps) — the framework's NCW [B, capsDim, nCaps] sequence layout."""
+
+    def __init__(self, capsules=8, capsuleDimensions=8, kernelSize=(9, 9),
+                 stride=(2, 2), **kw):
+        super().__init__(**kw)
+        self.channels = int(capsules)  # conv channel groups, upstream name
+        self.capsuleDimensions = int(capsuleDimensions)
+        self.kernelSize = tuple(kernelSize) if not isinstance(
+            kernelSize, int) else (kernelSize, kernelSize)
+        self.stride = tuple(stride) if not isinstance(stride, int) \
+            else (stride, stride)
+
+    def _conv_hw(self, inputType):
+        kh, kw = self.kernelSize
+        sh, sw = self.stride
+        h = (inputType.height - kh) // sh + 1
+        w = (inputType.width - kw) // sw + 1
+        return h, w
+
+    def getOutputType(self, inputType):
+        if inputType.kind != InputType.CNN:
+            raise ValueError("PrimaryCapsules needs convolutional input")
+        h, w = self._conv_hw(inputType)
+        return InputType.recurrent(self.capsuleDimensions,
+                                   h * w * self.channels)
+
+    def initialize(self, key, inputType, dtype):
+        kh, kw = self.kernelSize
+        cin = inputType.channels
+        cout = self.channels * self.capsuleDimensions
+        W = _winit.init(key, self.weightInit, (kh, kw, cin, cout),
+                        kh * kw * cin, kh * kw * cout, dtype,
+                        self.distribution)
+        return {"W": W, "b": jnp.full((cout,), self.biasInit, dtype)}, {}
+
+    def forward(self, params, state, x, train, key, mask=None):
+        x = self._dropout_input(x, train, key)
+        y = _conv.conv2d(x, params["W"], params["b"],
+                         stride=self.stride, padding=((0, 0), (0, 0)))
+        B, H, W_, C = y.shape
+        caps = y.reshape(B, H * W_ * self.channels, self.capsuleDimensions)
+        caps = _squash(caps, axis=-1)
+        return jnp.transpose(caps, (0, 2, 1)), state  # NCW [B, dim, nCaps]
+
+
+class CapsuleLayer(Layer):
+    """Fully-connected capsules with dynamic routing (reference:
+    conf.layers.CapsuleLayer). Each input capsule votes for each output
+    capsule through a learned [dIn -> dOut] map; `routings` iterations
+    of routing-by-agreement weight the votes. The routing loop is a
+    fixed-trip lax.fori_loop — static shapes, jit-compiled whole."""
+
+    def __init__(self, capsules=10, capsuleDimensions=16, routings=3, **kw):
+        super().__init__(**kw)
+        self.capsules = int(capsules)
+        self.capsuleDimensions = int(capsuleDimensions)
+        self.routings = int(routings)
+
+    def getOutputType(self, inputType):
+        if inputType.kind != InputType.RNN or \
+                inputType.timeSeriesLength is None:
+            raise ValueError(
+                "CapsuleLayer consumes capsule input with a known capsule "
+                "count (InputType.recurrent from PrimaryCapsules/"
+                "CapsuleLayer)")
+        return InputType.recurrent(self.capsuleDimensions, self.capsules)
+
+    def initialize(self, key, inputType, dtype):
+        nIn, dIn = inputType.timeSeriesLength, inputType.size
+        k, dOut = self.capsules, self.capsuleDimensions
+        W = _winit.init(key, self.weightInit, (nIn, k, dOut, dIn),
+                        dIn, dOut, dtype, self.distribution)
+        return {"W": W}, {}
+
+    def forward(self, params, state, x, train, key, mask=None):
+        x = self._dropout_input(x, train, key)
+        u = jnp.transpose(x, (0, 2, 1))          # [B, nIn, dIn]
+        # votes: u_hat[b,i,k,dOut] = W[i,k,dOut,dIn] @ u[b,i,dIn]
+        u_hat = jnp.einsum("ikoj,bij->biko", params["W"], u)
+
+        def route(_, b):
+            c = jax.nn.softmax(b, axis=2)        # over output capsules
+            s = jnp.einsum("bik,biko->bko", c, u_hat)
+            v = _squash(s, axis=-1)
+            return b + jnp.einsum("biko,bko->bik", u_hat, v)
+
+        b0 = jnp.zeros(u_hat.shape[:3], u_hat.dtype)
+        b = jax.lax.fori_loop(0, max(self.routings - 1, 0), route, b0)
+        c = jax.nn.softmax(b, axis=2)
+        v = _squash(jnp.einsum("bik,biko->bko", c, u_hat), axis=-1)
+        return jnp.transpose(v, (0, 2, 1)), state  # [B, dOut, k]
+
+
+class CapsuleStrengthLayer(Layer):
+    """Capsule lengths as class scores (reference:
+    conf.layers.CapsuleStrengthLayer): [B, dim, k] -> [B, k]."""
+
+    def getOutputType(self, inputType):
+        if inputType.kind != InputType.RNN or \
+                inputType.timeSeriesLength is None:
+            raise ValueError("CapsuleStrengthLayer consumes capsule input "
+                             "with a known capsule count")
+        return InputType.feedForward(inputType.timeSeriesLength)
+
+    def forward(self, params, state, x, train, key, mask=None):
+        sq = jnp.sum(jnp.square(x), axis=1)      # over capsule dim
+        return jnp.sqrt(jnp.where(sq > 0, sq, 1.0)) * (sq > 0), state

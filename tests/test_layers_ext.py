@@ -515,3 +515,112 @@ class TestSmallUtilityLayers:
         net.pretrainLayer(0, x, epochs=200)
         l1 = float(ae.pretrain_loss(net._params[0], jnp.asarray(x), None))
         assert l1 < 0.5 * l0, f"reconstruction should improve: {l0} -> {l1}"
+
+
+class TestCapsNet:
+    """Capsule layers (reference: conf.layers.{PrimaryCapsules,
+    CapsuleLayer, CapsuleStrengthLayer}, Sabour 2017): shapes, squash
+    norm bound, routing convergence on separable data."""
+
+    def _net(self, routings=3):
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           MultiLayerNetwork, ConvolutionLayer,
+                                           PrimaryCapsules, CapsuleLayer,
+                                           CapsuleStrengthLayer, Adam)
+        from deeplearning4j_tpu.nn.conf.layers import LossLayer
+
+        conf = (NeuralNetConfiguration.Builder().seed(3).updater(Adam(2e-3))
+                .list()
+                .layer(ConvolutionLayer(nOut=16, kernelSize=(5, 5),
+                                        activation="relu"))
+                .layer(PrimaryCapsules(capsules=4, capsuleDimensions=6,
+                                       kernelSize=(5, 5), stride=(2, 2)))
+                .layer(CapsuleLayer(capsules=3, capsuleDimensions=8,
+                                    routings=routings))
+                .layer(CapsuleStrengthLayer())
+                .layer(LossLayer(lossFunction="mcxent",
+                                 activation="softmax"))
+                .setInputType(InputType.convolutional(20, 20, 1)).build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_shapes_and_squash_bound(self):
+        net = self._net()
+        x = np.random.RandomState(0).rand(2, 1, 20, 20).astype("float32")
+        out = net.output(x)
+        assert out.shape() == (2, 3)
+        np.testing.assert_allclose(out.toNumpy().sum(1), np.ones(2),
+                                   rtol=1e-3)
+        # capsule outputs are squashed: every capsule length < 1
+        import jax.numpy as jnp
+        h, _ = net._run_layers(net._params, net._strip_carries(net._states),
+                               net._entry_raw(x) if hasattr(net, "_entry_raw")
+                               else jnp.asarray(x), False, None, None)
+        # (h is the loss-layer preact [B,3]: strengths in [0,1))
+        assert float(jnp.max(h)) < 1.0 + 1e-5
+
+    def test_capsnet_converges(self):
+        net = self._net()
+        rng = np.random.RandomState(0)
+        templates = rng.rand(3, 1, 20, 20).astype("float32")
+        yi = rng.randint(0, 3, 12)
+        x = 0.85 * templates[yi] + 0.15 * rng.rand(12, 1, 20, 20).astype("float32")
+        y = np.eye(3, dtype="float32")[yi]
+        first = None
+        for _ in range(25):
+            net.fit(x, y)
+            first = first if first is not None else net.score()
+        assert np.isfinite(net.score())
+        assert net.score() < 0.6 * first, (first, net.score())
+
+    def test_routing_iterations_change_output(self):
+        a = self._net(routings=1)
+        b = self._net(routings=3)
+        b._params = a._params  # same weights, different routing depth
+        x = np.random.RandomState(1).rand(2, 1, 20, 20).astype("float32")
+        oa = a.output(x).toNumpy()
+        ob = b.output(x).toNumpy()
+        assert not np.allclose(oa, ob), "routing must refine agreement"
+
+    def test_unknown_capsule_count_rejected(self):
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           CapsuleLayer, LSTM)
+        from deeplearning4j_tpu.nn.conf.layers import LossLayer
+
+        with pytest.raises(ValueError, match="capsule"):
+            (NeuralNetConfiguration.Builder().list()
+             .layer(LSTM(nOut=8))
+             .layer(CapsuleLayer(capsules=3, capsuleDimensions=4))
+             .layer(LossLayer(lossFunction="mse", activation="identity"))
+             .setInputType(InputType.recurrent(5))  # no length known
+             .build())
+
+    def test_global_weight_init_and_dropout_respected(self):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           MultiLayerNetwork,
+                                           PrimaryCapsules, CapsuleLayer,
+                                           CapsuleStrengthLayer, Adam)
+        from deeplearning4j_tpu.nn.conf.layers import LossLayer
+
+        conf = (NeuralNetConfiguration.Builder().seed(3).updater(Adam(1e-3))
+                .weightInit("normal").dropOut(0.5)
+                .list()
+                .layer(PrimaryCapsules(capsules=2, capsuleDimensions=4,
+                                       kernelSize=(3, 3), stride=(2, 2)))
+                .layer(CapsuleLayer(capsules=2, capsuleDimensions=4,
+                                    routings=2))
+                .layer(CapsuleStrengthLayer())
+                .layer(LossLayer(lossFunction="mcxent",
+                                 activation="softmax"))
+                .setInputType(InputType.convolutional(12, 12, 1)).build())
+        net = MultiLayerNetwork(conf).init()
+        # per-layer biasInit flows through (set on the layer config)
+        assert np.asarray(net._params[0]["b"]).shape == (8,)
+        # dropout active in train mode: two train-mode losses with the
+        # same data differ across iterations only via dropout masks
+        x = np.random.RandomState(0).rand(4, 1, 12, 12).astype("float32")
+        y = np.eye(2, dtype="float32")[[0, 1, 0, 1]]
+        net.fit(x, y)
+        s1 = net.score()
+        net.fit(x, y)
+        assert np.isfinite(s1) and np.isfinite(net.score())
