@@ -964,3 +964,65 @@ def _loss_mpwse(labels, predictions, reduction="MEAN"):
     num_pairs = max(n * (n - 1), 1)
     per = pair_sum / num_pairs
     return _reduce_loss(per, reduction)
+
+
+# ---- block rearrangement ops over NHWC (reference: libnd4j
+# space_to_depth / depth_to_space / space_to_batch / batch_to_space;
+# pure reshapes+transposes, free under XLA fusion) ----
+
+@op("spaceToDepth")
+def _space_to_depth(x, blockSize=2):
+    B, H, W, C = x.shape
+    b = int(blockSize)
+    x = x.reshape(B, H // b, b, W // b, b, C)
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(B, H // b, W // b, C * b * b)
+
+
+@op("depthToSpace")
+def _depth_to_space(x, blockSize=2):
+    B, H, W, C = x.shape
+    b = int(blockSize)
+    x = x.reshape(B, H, W, b, b, C // (b * b))
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(B, H * b, W * b, C // (b * b))
+
+
+@op("spaceToBatch")
+def _space_to_batch(x, blockSize=2, padding=((0, 0), (0, 0))):
+    b = int(blockSize)
+    p = tuple(tuple(q) for q in padding)
+    x = jnp.pad(x, ((0, 0), p[0], p[1], (0, 0)))
+    B, H, W, C = x.shape
+    x = x.reshape(B, H // b, b, W // b, b, C)
+    x = jnp.transpose(x, (2, 4, 0, 1, 3, 5))
+    return x.reshape(B * b * b, H // b, W // b, C)
+
+
+@op("batchToSpace")
+def _batch_to_space(x, blockSize=2, crops=((0, 0), (0, 0))):
+    b = int(blockSize)
+    Bb, H, W, C = x.shape
+    B = Bb // (b * b)
+    x = x.reshape(b, b, B, H, W, C)
+    x = jnp.transpose(x, (2, 3, 0, 4, 1, 5))
+    x = x.reshape(B, H * b, W * b, C)
+    (ct, cb), (cl, cr) = tuple(tuple(q) for q in crops)
+    if ct + cb > x.shape[1] or cl + cr > x.shape[2]:
+        raise ValueError(f"crops {crops} exceed the expanded spatial dims "
+                         f"{x.shape[1]}x{x.shape[2]}")
+    return x[:, ct:x.shape[1] - cb, cl:x.shape[2] - cr, :]
+
+
+@op("lu")
+def _lu(x):
+    import jax.scipy.linalg as jsl
+
+    p, l, u = jsl.lu(x)
+    return p, l, u
+
+
+@op("eigh")
+def _eigh(x):
+    w, v = jnp.linalg.eigh(x)
+    return w, v

@@ -1345,6 +1345,26 @@ class _CNNOps(_NS):
                         {"stride": list(stride),
                          "padding": [list(p) for p in padding]}, name=name)
 
+    def spaceToDepth(self, x, blockSize=2, name=None):
+        return self._mk("spaceToDepth", [x], {"blockSize": int(blockSize)},
+                        name=name)
+
+    def depthToSpace(self, x, blockSize=2, name=None):
+        return self._mk("depthToSpace", [x], {"blockSize": int(blockSize)},
+                        name=name)
+
+    def spaceToBatch(self, x, blockSize=2, padding=((0, 0), (0, 0)),
+                     name=None):
+        return self._mk("spaceToBatch", [x],
+                        {"blockSize": int(blockSize),
+                         "padding": [list(q) for q in padding]}, name=name)
+
+    def batchToSpace(self, x, blockSize=2, crops=((0, 0), (0, 0)),
+                     name=None):
+        return self._mk("batchToSpace", [x],
+                        {"blockSize": int(blockSize),
+                         "crops": [list(q) for q in crops]}, name=name)
+
     def maxPooling2d(self, x, kernel, stride=None, padding=((0, 0), (0, 0)),
                      name=None):
         return self._mk("maxPooling2d", [x],
@@ -1469,6 +1489,13 @@ class _ImageOps(_NS):
     def rgbToHsv(self, x, name=None):
         return self._mk("rgbToHsv", [x], name=name)
 
+    # block ops live in sd.cnn (reference: SDCNN); aliased here for
+    # discoverability alongside the other image transforms
+    spaceToDepth = _CNNOps.spaceToDepth
+    depthToSpace = _CNNOps.depthToSpace
+    spaceToBatch = _CNNOps.spaceToBatch
+    batchToSpace = _CNNOps.batchToSpace
+
     def nonMaxSuppression(self, boxes, scores, maxOutputSize=10,
                           iouThreshold=0.5, scoreThreshold=float("-inf"),
                           name=None):
@@ -1498,6 +1525,19 @@ class _LinalgOps(_NS):
         locals()[_n] = _binary(_n) if _n in ("cross", "solve", "lstsq") \
             else _unary(_n)
     del _n
+
+    def lu(self, x, name=None):
+        """P, L, U factors. DELIBERATE API change vs SDLinalg.lu (which
+        returns a packed LU matrix + permutation-index vector): explicit
+        factors reconstruct as P @ L @ U with plain matmuls and avoid
+        host-side unpacking."""
+        return self._mk("lu", [x], nOut=3, name=name)
+
+    def eigh(self, x, name=None):
+        """Eigenvalues + eigenvectors of a symmetric matrix (reference:
+        SDLinalg.eig for the self-adjoint case — general eig has no
+        TPU-lowerable kernel)."""
+        return self._mk("eigh", [x], nOut=2, name=name)
 
     def svd(self, x, fullUV=False, name=None):
         return self._mk("svd", [x], {"fullUV": fullUV}, nOut=3, name=name)

@@ -893,11 +893,9 @@ class SpaceToDepth(Layer):
                                        inputType.channels * b * b)
 
     def forward(self, params, state, x, train, key, mask=None):
-        B, H, W, C = x.shape
-        b = self.blocks
-        x = x.reshape(B, H // b, b, W // b, b, C)
-        x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
-        return x.reshape(B, H // b, W // b, C * b * b), state
+        from deeplearning4j_tpu.autodiff.ops_impl import OPS
+
+        return OPS["spaceToDepth"](x, blockSize=self.blocks), state
 
 
 class SpaceToBatch(Layer):
@@ -922,12 +920,10 @@ class SpaceToBatch(Layer):
         return InputType.convolutional(h // b, w // b, inputType.channels)
 
     def forward(self, params, state, x, train, key, mask=None):
-        b = self.blocks
-        x = jnp.pad(x, ((0, 0), self.pad2[0], self.pad2[1], (0, 0)))
-        B, H, W, C = x.shape
-        x = x.reshape(B, H // b, b, W // b, b, C)
-        x = jnp.transpose(x, (2, 4, 0, 1, 3, 5))
-        return x.reshape(B * b * b, H // b, W // b, C), state
+        from deeplearning4j_tpu.autodiff.ops_impl import OPS
+
+        return OPS["spaceToBatch"](x, blockSize=self.blocks,
+                                   padding=self.pad2), state
 
 
 # ======================================================================

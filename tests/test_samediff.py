@@ -867,3 +867,49 @@ def test_distance_ops_finite_gradients_at_degenerate_points():
             jnp.zeros(3))
     assert bool(jnp.all(jnp.isfinite(g1)))
     assert bool(jnp.all(jnp.isfinite(g2)))
+
+
+class TestBlockOpsAndLinalgTail:
+    """spaceToDepth/depthToSpace/spaceToBatch/batchToSpace (block
+    rearrangement, NHWC) and linalg lu/eigh — inverse/reconstruction
+    round trips as the oracle."""
+
+    def test_space_depth_batch_roundtrips(self):
+        rs = np.random.RandomState(0)
+        sd = SameDiff.create()
+        x = sd.constant(rs.rand(2, 4, 4, 3))
+        rt = sd.image.depthToSpace(sd.image.spaceToDepth(x, 2), 2, name="a")
+        np.testing.assert_allclose(rt.eval().toNumpy(), x.eval().toNumpy())
+        bt = sd.image.batchToSpace(sd.image.spaceToBatch(x, 2), 2, name="b")
+        np.testing.assert_allclose(bt.eval().toNumpy(), x.eval().toNumpy())
+        # shape semantics
+        s2d = sd.image.spaceToDepth(x, 2, name="c")
+        assert s2d.eval().shape() == (2, 2, 2, 12)
+        s2b = sd.image.spaceToBatch(x, 2, name="d")
+        assert s2b.eval().shape() == (8, 2, 2, 3)
+
+    def test_space_to_batch_padding_and_crops(self):
+        rs = np.random.RandomState(1)
+        sd = SameDiff.create()
+        x = sd.constant(rs.rand(1, 2, 2, 1))
+        padded = sd.image.spaceToBatch(x, 2, padding=((1, 1), (1, 1)),
+                                       name="p")
+        assert padded.eval().shape() == (4, 2, 2, 1)
+        back = sd.image.batchToSpace(padded, 2, crops=((1, 1), (1, 1)),
+                                     name="q")
+        np.testing.assert_allclose(back.eval().toNumpy(),
+                                   x.eval().toNumpy())
+
+    def test_lu_and_eigh_reconstruct(self):
+        rs = np.random.RandomState(2)
+        A = rs.rand(4, 4)
+        sd = SameDiff.create()
+        p, l, u = sd.linalg.lu(sd.constant(A))
+        plu = (p.eval().toNumpy() @ l.eval().toNumpy()
+               @ u.eval().toNumpy())
+        np.testing.assert_allclose(plu, A, atol=1e-6)
+        S = A + A.T
+        w, v = sd.linalg.eigh(sd.constant(S))
+        V = v.eval().toNumpy()
+        np.testing.assert_allclose(V @ np.diag(w.eval().toNumpy()) @ V.T,
+                                   S, atol=1e-5)
