@@ -1003,6 +1003,10 @@ def _space_to_batch(x, blockSize=2, padding=((0, 0), (0, 0))):
 def _batch_to_space(x, blockSize=2, crops=((0, 0), (0, 0))):
     b = int(blockSize)
     Bb, H, W, C = x.shape
+    if Bb % (b * b):
+        raise ValueError(
+            f"batchToSpace needs batch ({Bb}) divisible by "
+            f"blockSize^2 ({b * b})")
     B = Bb // (b * b)
     x = x.reshape(b, b, B, H, W, C)
     x = jnp.transpose(x, (2, 3, 0, 4, 1, 5))

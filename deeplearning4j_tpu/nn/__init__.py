@@ -24,6 +24,7 @@ from deeplearning4j_tpu.nn.conf.layers import (
     SpaceToDepth, SpaceToBatch, LocallyConnected1D, LocallyConnected2D,
     PReLULayer, CenterLossOutputLayer,
     PrimaryCapsules, CapsuleLayer, CapsuleStrengthLayer,
+    SameDiffLayer, SameDiffLambdaLayer,
     Subsampling1DLayer, ZeroPadding1DLayer, RepeatVector,
     ElementWiseMultiplicationLayer, AutoEncoder,
 )

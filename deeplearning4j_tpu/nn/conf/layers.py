@@ -1417,3 +1417,134 @@ class CapsuleStrengthLayer(Layer):
     def forward(self, params, state, x, train, key, mask=None):
         sq = jnp.sum(jnp.square(x), axis=1)      # over capsule dim
         return jnp.sqrt(jnp.where(sq > 0, sq, 1.0)) * (sq > 0), state
+
+
+# ======================================================================
+# User-defined layers via SameDiff (reference:
+# conf.layers.samediff.{SameDiffLayer, SameDiffLambdaLayer} — the
+# upstream extension point for custom layers inside MLN/ComputationGraph)
+# ======================================================================
+
+def _infer_type_from_shape(shape, inputType):
+    if len(shape) == 2:
+        return InputType.feedForward(shape[1])
+    if len(shape) == 3:  # NCW recurrent
+        return InputType.recurrent(shape[1], shape[2])
+    if len(shape) == 4:  # internal NHWC
+        return InputType.convolutional(shape[1], shape[2], shape[3])
+    raise ValueError(f"cannot map output shape {shape} to an InputType")
+
+
+def _dummy_input(inputType):
+    if inputType.kind == InputType.CNN:
+        return (1, inputType.height, inputType.width, inputType.channels)
+    if inputType.kind == InputType.RNN:
+        return (1, inputType.size, inputType.timeSeriesLength or 1)
+    if inputType.kind == InputType.FF:
+        return (1, inputType.size)
+    raise ValueError(
+        f"SameDiff custom layers support FF/RNN/CNN input; got "
+        f"{inputType.kind} (add a preprocessor to convert first)")
+
+
+class SameDiffLambdaLayer(Layer):
+    """Parameterless custom layer defined as a SameDiff expression
+    (reference: conf.layers.samediff.SameDiffLambdaLayer). Subclass and
+    override defineLayer(sd, x), or pass ``lambdaFn=lambda sd, x: ...``.
+    The expression is traced into the SAME jitted train step as every
+    built-in layer — no interpreter, full autodiff through it."""
+
+    def __init__(self, lambdaFn=None, **kw):
+        super().__init__(**kw)
+        self._fn = lambdaFn
+
+    def hasParams(self):
+        return False
+
+    def defineLayer(self, sd, x):
+        if self._fn is None:
+            raise NotImplementedError(
+                "override defineLayer(sd, x) or pass lambdaFn=")
+        return self._fn(sd, x)
+
+    def _traced(self, x, train=False, key=None):
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+        f = SameDiff._subgraph_fn(
+            lambda s, a: self.defineLayer(s, a), [x], train=train, rng=key,
+            n_expected=1, what=type(self).__name__)
+        return f(x)[0]
+
+    def getOutputType(self, inputType):
+        shape = jax.eval_shape(
+            self._traced,
+            jax.ShapeDtypeStruct(_dummy_input(inputType), jnp.float32)).shape
+        return _infer_type_from_shape(shape, inputType)
+
+    def forward(self, params, state, x, train, key, mask=None):
+        # train/key thread into the expression: stochastic ops (dropout,
+        # sd.random) behave exactly as in built-in layers
+        return self._traced(x, train, key), state
+
+
+class SameDiffLayer(Layer):
+    """Parameterized custom layer defined as a SameDiff expression
+    (reference: conf.layers.samediff.SameDiffLayer). Subclasses provide
+
+        defineParameters(inputType) -> {name: shape tuple}
+        defineLayer(sd, x, params)  -> SDVariable
+
+    Parameters join the network's pytree: same updaters, regularization,
+    serialization and donation as built-in layers; gradients flow
+    through the traced expression."""
+
+    def defineParameters(self, inputType):
+        raise NotImplementedError
+
+    def defineLayer(self, sd, x, params):
+        raise NotImplementedError
+
+    def _param_shapes(self, inputType):
+        shapes = self.defineParameters(inputType)
+        if not isinstance(shapes, dict) or not shapes:
+            raise ValueError("defineParameters must return a non-empty "
+                             "{name: shape} dict")
+        return {n: tuple(int(d) for d in shp) for n, shp in shapes.items()}
+
+    def _traced(self, x, params, train=False, key=None):
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+        names = sorted(params)
+        f = SameDiff._subgraph_fn(
+            lambda s, a, *ps: self.defineLayer(s, a,
+                                               dict(zip(names, ps))),
+            [x] + [params[n] for n in names], train=train, rng=key,
+            n_expected=1, what=type(self).__name__)
+        return f(x, *[params[n] for n in names])[0]
+
+    def getOutputType(self, inputType):
+        shapes = self._param_shapes(inputType)
+        dummy = {n: jax.ShapeDtypeStruct(s, jnp.float32)
+                 for n, s in shapes.items()}
+        shape = jax.eval_shape(
+            self._traced,
+            jax.ShapeDtypeStruct(_dummy_input(inputType), jnp.float32),
+            dummy).shape
+        return _infer_type_from_shape(shape, inputType)
+
+    def initialize(self, key, inputType, dtype):
+        shapes = self._param_shapes(inputType)
+        params = {}
+        for i, (n, shp) in enumerate(sorted(shapes.items())):
+            k = jax.random.fold_in(key, i)
+            if len(shp) >= 2:
+                params[n] = _winit.init(k, self.weightInit, shp,
+                                        shp[0], shp[-1], dtype,
+                                        self.distribution)
+            else:  # vectors default to the bias init
+                params[n] = jnp.full(shp, self.biasInit, dtype)
+        return params, {}
+
+    def forward(self, params, state, x, train, key, mask=None):
+        x = self._dropout_input(x, train, key)
+        return self._traced(x, params, train, key), state

@@ -50,6 +50,16 @@ def encode(obj, arrays: list):
     if isinstance(obj, DataType):
         return {"__dt": obj.name}
     cls = type(obj)
+    import types
+
+    if isinstance(obj, (types.FunctionType, types.LambdaType,
+                        types.BuiltinFunctionType, types.MethodType)):
+        raise TypeError(
+            "cannot serialize a Python function inside a network config "
+            "(e.g. SameDiffLambdaLayer(lambdaFn=...)): custom-code layers "
+            "have no portable serialized form. Rebuild the net from code "
+            "and restore the trained weights with initFrom / "
+            "ModelSerializer's params-only path")
     if not _in_pkg(cls.__module__):
         raise TypeError(f"cannot serialize {cls.__module__}.{cls.__name__}: "
                         f"only {_PKG} config objects are supported")
