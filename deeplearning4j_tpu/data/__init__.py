@@ -13,7 +13,8 @@ from deeplearning4j_tpu.data.normalizers import (
     ImagePreProcessingScaler, VGG16ImagePreProcessor,
 )
 from deeplearning4j_tpu.data.iterators import (
-    IrisDataSetIterator, MnistDataSetIterator, Cifar10DataSetIterator,
+    IrisDataSetIterator, MnistDataSetIterator, FashionMnistDataSetIterator,
+    EmnistDataSetIterator, Cifar10DataSetIterator,
     CifarDataSetIterator, RandomDataSetIterator,
 )
 from deeplearning4j_tpu.data.records import (
@@ -27,7 +28,8 @@ __all__ = [
     "ExistingDataSetIterator", "SplitTestAndTrain", "MultiDataSet",
     "MultiDataSetIterator", "DataNormalization", "NormalizerStandardize",
     "NormalizerMinMaxScaler", "ImagePreProcessingScaler",
-    "VGG16ImagePreProcessor", "IrisDataSetIterator", "MnistDataSetIterator",
+    "VGG16ImagePreProcessor", "IrisDataSetIterator", "MnistDataSetIterator", "FashionMnistDataSetIterator",
+    "EmnistDataSetIterator",
     "Cifar10DataSetIterator", "CifarDataSetIterator", "RandomDataSetIterator",
     "RecordReader", "CSVRecordReader", "CollectionRecordReader",
     "ImageRecordReader", "Schema", "TransformProcess",

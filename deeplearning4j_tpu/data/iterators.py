@@ -184,6 +184,28 @@ def fetch_mnist(timeout: float = 15.0) -> bool:
     return True
 
 
+def _load_idx_or_synth(base, img_names, lbl_names, num_classes,
+                       numExamples, seed, train, what):
+    """Shared idx-or-synthetic loader behind the MNIST-family iterators:
+    returns (uint8 images [N,1,28,28], int labels, isSynthetic)."""
+    img_p = _find_idx(base, img_names)
+    lbl_p = _find_idx(base, lbl_names)
+    if img_p is not None and lbl_p is not None:
+        return (_read_idx(img_p)[:, None, :, :],
+                _read_idx(lbl_p).astype(np.int64), False)
+    n = numExamples or 10000
+    if not numExamples and train:
+        import warnings
+
+        warnings.warn(f"{what} idx files not found; using {n} synthetic "
+                      f"examples (pass numExamples to override)",
+                      stacklevel=3)
+    imgs, labels = _synthetic_digits(n, num_classes, 28, 1,
+                                     template_seed=seed,
+                                     noise_seed=seed + (1 if train else 2))
+    return imgs, labels, True
+
+
 class MnistDataSetIterator(DataSetIterator):
     """Reference: MnistDataSetIterator — features [B, 784] float32 in [0, 1]
     (or [B, 1, 28, 28] with ``reshapeToCnn=True``), one-hot labels [B, 10].
@@ -194,37 +216,24 @@ class MnistDataSetIterator(DataSetIterator):
 
     NUM_CLASSES = 10
 
+    _DIR = "mnist"
+
     def __init__(self, batchSize: int, train: bool = True, seed: int = 123,
                  numExamples: int = None, shuffle: bool = None,
                  reshapeToCnn: bool = False):
-        base = _data_dir() / "mnist"
+        base = _data_dir() / self._DIR
         tag = "train" if train else "t10k"
-        img_p = _find_idx(base, [f"{tag}-images-idx3-ubyte", f"{tag}-images.idx3-ubyte"])
-        lbl_p = _find_idx(base, [f"{tag}-labels-idx1-ubyte", f"{tag}-labels.idx1-ubyte"])
-        if img_p is not None and lbl_p is not None:
-            imgs = _read_idx(img_p)[:, None, :, :]  # [N, 1, 28, 28] uint8
-            labels = _read_idx(lbl_p)
-            self.isSynthetic = False
-        else:
-            if numExamples:
-                n = numExamples  # honour an explicit request exactly
-            else:
-                n = 10000  # full 60k synthesis is pointless noise; warn
-                if train:
-                    import warnings
-
-                    warnings.warn("MNIST idx files not found; using 10000 "
-                                  "synthetic examples (pass numExamples to "
-                                  "override)", stacklevel=2)
-            imgs, labels = _synthetic_digits(n, 10, 28, 1, template_seed=seed,
-                                             noise_seed=seed + (1 if train else 2))
-            self.isSynthetic = True
+        imgs, labels, self.isSynthetic = _load_idx_or_synth(
+            base,
+            [f"{tag}-images-idx3-ubyte", f"{tag}-images.idx3-ubyte"],
+            [f"{tag}-labels-idx1-ubyte", f"{tag}-labels.idx1-ubyte"],
+            self.NUM_CLASSES, numExamples, seed, train, self._DIR)
         if numExamples:
             imgs, labels = imgs[:numExamples], labels[:numExamples]
         f = imgs.astype(np.float32) / 255.0
         if not reshapeToCnn:
             f = f.reshape(len(f), -1)  # [N, 784]
-        onehot = np.eye(10, dtype=np.float32)[labels]
+        onehot = np.eye(self.NUM_CLASSES, dtype=np.float32)[labels]
         super().__init__(f, onehot, batchSize,
                          shuffle=(train if shuffle is None else shuffle), seed=seed)
 
@@ -325,3 +334,50 @@ class RandomDataSetIterator:
 
     def getPreProcessor(self):
         return self._preprocessor
+
+
+class FashionMnistDataSetIterator(MnistDataSetIterator):
+    """Reference: FashionMnistDataSetIterator — identical idx format to
+    MNIST (28x28, 10 classes), read from
+    ``$DL4J_TPU_DATA_DIR/fashion-mnist``; synthesises loudly otherwise."""
+
+    _DIR = "fashion-mnist"
+
+
+class EmnistDataSetIterator(DataSetIterator):
+    """Reference: EmnistDataSetIterator with its Set enum — the EMNIST
+    splits share MNIST's idx format but differ in class count. Files
+    ``emnist-<set>-{train,test}-{images,labels}-idx?-ubyte[.gz]`` under
+    ``$DL4J_TPU_DATA_DIR/emnist``; synthetic fallback is loud."""
+
+    SETS = {"complete": 62, "byclass": 62, "bymerge": 47, "balanced": 47,
+            "letters": 26, "digits": 10, "mnist": 10}
+
+    def __init__(self, dataSet: str, batchSize: int, train: bool = True,
+                 seed: int = 123, numExamples: int = None,
+                 shuffle: bool = None, reshapeToCnn: bool = False):
+        key = str(dataSet).lower()
+        if key not in self.SETS:
+            raise ValueError(f"unknown EMNIST set {dataSet!r}; one of "
+                             f"{sorted(self.SETS)}")
+        self.numClasses = self.SETS[key]
+        base = _data_dir() / "emnist"
+        tag = "train" if train else "test"
+        # "complete" is upstream's alias for the byclass files
+        filekey = "byclass" if key == "complete" else key
+        imgs, labels, self.isSynthetic = _load_idx_or_synth(
+            base,
+            [f"emnist-{filekey}-{tag}-images-idx3-ubyte"],
+            [f"emnist-{filekey}-{tag}-labels-idx1-ubyte"],
+            self.numClasses, numExamples, seed, train, f"EMNIST({key})")
+        if key == "letters" and not self.isSynthetic:
+            labels = labels - 1  # letters labels are 1-based in the format
+        if numExamples:
+            imgs, labels = imgs[:numExamples], labels[:numExamples]
+        f = imgs.astype(np.float32) / 255.0
+        if not reshapeToCnn:
+            f = f.reshape(len(f), -1)
+        onehot = np.eye(self.numClasses, dtype=np.float32)[labels]
+        super().__init__(f, onehot, batchSize,
+                         shuffle=(train if shuffle is None else shuffle),
+                         seed=seed)

@@ -1,0 +1,7 @@
+"""Graph vertex embeddings (reference: deeplearning4j-graph —
+org.deeplearning4j.graph: Graph + DeepWalk). Walk generation is host
+side; embedding training reuses the jitted SGNS step from nlp/."""
+
+from deeplearning4j_tpu.graph.deepwalk import Graph, DeepWalk
+
+__all__ = ["Graph", "DeepWalk"]

@@ -1,0 +1,129 @@
+"""DeepWalk vertex embeddings.
+
+Reference: deeplearning4j-graph org.deeplearning4j.graph.models.deepwalk
+.DeepWalk (Builder: windowSize/vectorSize/learningRate/seed; fit over a
+RandomWalkIterator on graph.api.Graph) — truncated random walks treated
+as sentences, embedded with skip-gram. Upstream trains per-walk with a
+hierarchical-softmax tree on the JVM; here walks are generated host-side
+once and the embedding trains through nlp.word2vec's single jitted SGNS
+step (negative sampling — same objective family, TPU-shaped compute).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.word2vec import (Word2Vec,
+                                             CollectionSentenceIterator)
+
+
+class Graph:
+    """Undirected-by-default adjacency graph (reference:
+    org.deeplearning4j.graph.graph.Graph)."""
+
+    def __init__(self, numVertices: int):
+        if int(numVertices) <= 0:
+            raise ValueError("numVertices must be positive")
+        self._adj = [[] for _ in range(int(numVertices))]
+
+    def numVertices(self) -> int:
+        return len(self._adj)
+
+    def addEdge(self, a: int, b: int, directed: bool = False):
+        n = self.numVertices()
+        if not (0 <= a < n and 0 <= b < n):
+            raise ValueError(f"edge ({a},{b}) outside [0,{n})")
+        self._adj[a].append(b)
+        if not directed:
+            self._adj[b].append(a)
+        return self
+
+    def getConnectedVertices(self, v: int):
+        return list(self._adj[v])
+
+
+class _IdentityTokenizer:
+    """Vertex-id 'sentences' must not be lowercased/regex-split."""
+
+    def create(self, sentence):
+        return sentence.split()
+
+
+class DeepWalk:
+    """Builder-constructed DeepWalk (reference: DeepWalk.Builder)."""
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def windowSize(self, n):
+            self._kw["windowSize"] = int(n)
+            return self
+
+        def vectorSize(self, n):
+            self._kw["vectorSize"] = int(n)
+            return self
+
+        def learningRate(self, lr):
+            self._kw["learningRate"] = float(lr)
+            return self
+
+        def seed(self, s):
+            self._kw["seed"] = int(s)
+            return self
+
+        def build(self):
+            return DeepWalk(**self._kw)
+
+    def __init__(self, windowSize=5, vectorSize=100, learningRate=0.025,
+                 seed=42):
+        self.windowSize = windowSize
+        self.vectorSize = vectorSize
+        self.learningRate = learningRate
+        self.seed = seed
+        self._w2v = None
+
+    def _walks(self, graph, walkLength, walksPerVertex, rng):
+        walks = []
+        n = graph.numVertices()
+        for _ in range(walksPerVertex):
+            for start in rng.permutation(n):
+                v = int(start)
+                walk = [v]
+                for _ in range(walkLength - 1):
+                    nbrs = graph._adj[v]
+                    if not nbrs:
+                        break  # dead end: truncate like upstream
+                    v = int(nbrs[rng.randint(len(nbrs))])
+                    walk.append(v)
+                walks.append(" ".join(map(str, walk)))
+        return walks
+
+    def fit(self, graph, walkLength=40, walksPerVertex=10, iterations=5):
+        rng = np.random.RandomState(self.seed)
+        walks = self._walks(graph, int(walkLength), int(walksPerVertex), rng)
+        self._w2v = Word2Vec(
+            iterator=CollectionSentenceIterator(walks),
+            tokenizer=_IdentityTokenizer(),
+            minWordFrequency=1, layerSize=self.vectorSize,
+            windowSize=self.windowSize, negative=5, seed=self.seed,
+            iterations=int(iterations), learningRate=self.learningRate,
+        ).fit()
+        return self
+
+    # ---- query API (reference: DeepWalk/GraphVectors methods) ----
+    def _require_fit(self):
+        if self._w2v is None:
+            raise RuntimeError("call fit() first")
+
+    def getVertexVector(self, v: int):
+        self._require_fit()
+        return self._w2v.getWordVector(str(int(v)))
+
+    def similarity(self, a: int, b: int) -> float:
+        self._require_fit()
+        return self._w2v.similarity(str(int(a)), str(int(b)))
+
+    def verticesNearest(self, v: int, top: int = 10):
+        self._require_fit()
+        return [int(w) for w in self._w2v.wordsNearest(str(int(v)), top)]

@@ -152,3 +152,83 @@ class TestParagraphVectors:
         with pytest.raises(ValueError, match="no in-vocabulary tokens"):
             pv.getParagraphVector(50)
         pv.getParagraphVector(0)  # trained docs still fine
+
+
+class TestDeepWalk:
+    """DeepWalk (reference: deeplearning4j-graph): vertex embeddings from
+    truncated random walks. Two densely-connected clusters joined by a
+    single bridge edge must embed as two clusters."""
+
+    def _two_cluster_graph(self):
+        from deeplearning4j_tpu.graph import Graph
+
+        g = Graph(12)
+        for c in (range(0, 6), range(6, 12)):
+            c = list(c)
+            for i in c:
+                for j in c:
+                    if i < j:
+                        g.addEdge(i, j)
+        g.addEdge(5, 6)  # bridge
+        return g
+
+    def test_clusters_separate(self):
+        from deeplearning4j_tpu.graph import DeepWalk
+
+        dw = (DeepWalk.Builder().windowSize(4).vectorSize(16)
+              .learningRate(0.5).seed(7).build())
+        dw.fit(self._two_cluster_graph(), walkLength=20, walksPerVertex=8,
+               iterations=25)
+        intra = dw.similarity(0, 3)
+        inter = dw.similarity(0, 9)
+        assert intra > inter + 0.1, (intra, inter)
+        near = dw.verticesNearest(1, 4)
+        assert sum(1 for v in near if v < 6) >= 3, near
+
+    def test_api_guards(self):
+        from deeplearning4j_tpu.graph import Graph, DeepWalk
+
+        with pytest.raises(ValueError, match="positive"):
+            Graph(0)
+        g = Graph(3)
+        with pytest.raises(ValueError, match="outside"):
+            g.addEdge(0, 5)
+        with pytest.raises(RuntimeError, match="fit"):
+            DeepWalk.Builder().build().getVertexVector(0)
+
+    def test_dead_end_truncates(self):
+        from deeplearning4j_tpu.graph import Graph, DeepWalk
+
+        g = Graph(4)
+        g.addEdge(0, 1, directed=True)  # 1 is a sink for walks from 0
+        g.addEdge(2, 3)
+        dw = DeepWalk.Builder().windowSize(2).vectorSize(8).seed(1).build()
+        dw.fit(g, walkLength=10, walksPerVertex=3, iterations=2)
+        assert dw.getVertexVector(0).shape == (8,)
+
+
+class TestDatasetIteratorVariants:
+    """FashionMnist/Emnist iterators (reference: the corresponding
+    deeplearning4j-datasets iterators): idx-or-synthetic loading with
+    the right class counts."""
+
+    def test_fashion_mnist_shapes(self):
+        from deeplearning4j_tpu.data import FashionMnistDataSetIterator
+
+        it = FashionMnistDataSetIterator(32, train=True, numExamples=96)
+        ds = it.next()
+        assert ds.getFeatures().shape() == (32, 784)
+        assert ds.getLabels().shape() == (32, 10)
+
+    def test_emnist_class_counts_and_validation(self):
+        from deeplearning4j_tpu.data import EmnistDataSetIterator
+
+        it = EmnistDataSetIterator("letters", 16, numExamples=64,
+                                   reshapeToCnn=True)
+        ds = it.next()
+        assert ds.getFeatures().shape() == (16, 1, 28, 28)
+        assert ds.getLabels().shape() == (16, 26)
+        assert EmnistDataSetIterator("balanced", 8, numExamples=16
+                                     ).next().getLabels().shape() == (8, 47)
+        with pytest.raises(ValueError, match="unknown EMNIST"):
+            EmnistDataSetIterator("bogus", 8)
