@@ -206,6 +206,21 @@ def _load_idx_or_synth(base, img_names, lbl_names, num_classes,
     return imgs, labels, True
 
 
+def _finish_mnist_like(self, imgs, labels, num_classes, numExamples,
+                       batchSize, train, shuffle, seed, reshapeToCnn):
+    """Shared truncate/scale/flatten/one-hot tail of the MNIST-family
+    iterators."""
+    if numExamples:
+        imgs, labels = imgs[:numExamples], labels[:numExamples]
+    f = imgs.astype(np.float32) / 255.0
+    if not reshapeToCnn:
+        f = f.reshape(len(f), -1)
+    onehot = np.eye(num_classes, dtype=np.float32)[labels]
+    DataSetIterator.__init__(
+        self, f, onehot, batchSize,
+        shuffle=(train if shuffle is None else shuffle), seed=seed)
+
+
 class MnistDataSetIterator(DataSetIterator):
     """Reference: MnistDataSetIterator — features [B, 784] float32 in [0, 1]
     (or [B, 1, 28, 28] with ``reshapeToCnn=True``), one-hot labels [B, 10].
@@ -228,14 +243,9 @@ class MnistDataSetIterator(DataSetIterator):
             [f"{tag}-images-idx3-ubyte", f"{tag}-images.idx3-ubyte"],
             [f"{tag}-labels-idx1-ubyte", f"{tag}-labels.idx1-ubyte"],
             self.NUM_CLASSES, numExamples, seed, train, self._DIR)
-        if numExamples:
-            imgs, labels = imgs[:numExamples], labels[:numExamples]
-        f = imgs.astype(np.float32) / 255.0
-        if not reshapeToCnn:
-            f = f.reshape(len(f), -1)  # [N, 784]
-        onehot = np.eye(self.NUM_CLASSES, dtype=np.float32)[labels]
-        super().__init__(f, onehot, batchSize,
-                         shuffle=(train if shuffle is None else shuffle), seed=seed)
+        _finish_mnist_like(self, imgs, labels, self.NUM_CLASSES,
+                           numExamples, batchSize, train, shuffle, seed,
+                           reshapeToCnn)
 
 
 class Cifar10DataSetIterator(DataSetIterator):
@@ -370,14 +380,13 @@ class EmnistDataSetIterator(DataSetIterator):
             [f"emnist-{filekey}-{tag}-images-idx3-ubyte"],
             [f"emnist-{filekey}-{tag}-labels-idx1-ubyte"],
             self.numClasses, numExamples, seed, train, f"EMNIST({key})")
-        if key == "letters" and not self.isSynthetic:
-            labels = labels - 1  # letters labels are 1-based in the format
-        if numExamples:
-            imgs, labels = imgs[:numExamples], labels[:numExamples]
-        f = imgs.astype(np.float32) / 255.0
-        if not reshapeToCnn:
-            f = f.reshape(len(f), -1)
-        onehot = np.eye(self.numClasses, dtype=np.float32)[labels]
-        super().__init__(f, onehot, batchSize,
-                         shuffle=(train if shuffle is None else shuffle),
-                         seed=seed)
+        if not self.isSynthetic:
+            if key == "letters":
+                labels = labels - 1  # 1-based in the format
+            # the official EMNIST idx files store images TRANSPOSED
+            # relative to MNIST orientation; undo it so models/visuals
+            # are orientation-compatible with MNIST (upstream does too)
+            imgs = imgs.transpose(0, 1, 3, 2)
+        _finish_mnist_like(self, imgs, labels, self.numClasses,
+                           numExamples, batchSize, train, shuffle, seed,
+                           reshapeToCnn)

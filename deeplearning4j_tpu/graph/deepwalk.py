@@ -100,6 +100,7 @@ class DeepWalk:
         return walks
 
     def fit(self, graph, walkLength=40, walksPerVertex=10, iterations=5):
+        self._n = graph.numVertices()
         rng = np.random.RandomState(self.seed)
         walks = self._walks(graph, int(walkLength), int(walksPerVertex), rng)
         self._w2v = Word2Vec(
@@ -116,14 +117,22 @@ class DeepWalk:
         if self._w2v is None:
             raise RuntimeError("call fit() first")
 
+    def _check_vertex(self, v):
+        if not (0 <= int(v) < self._n):
+            raise ValueError(f"vertex {v} outside [0,{self._n})")
+
     def getVertexVector(self, v: int):
         self._require_fit()
+        self._check_vertex(v)
         return self._w2v.getWordVector(str(int(v)))
 
     def similarity(self, a: int, b: int) -> float:
         self._require_fit()
+        self._check_vertex(a)
+        self._check_vertex(b)
         return self._w2v.similarity(str(int(a)), str(int(b)))
 
     def verticesNearest(self, v: int, top: int = 10):
         self._require_fit()
+        self._check_vertex(v)
         return [int(w) for w in self._w2v.wordsNearest(str(int(v)), top)]

@@ -1,0 +1,5 @@
+"""Embedding visualization (reference: org.deeplearning4j.plot)."""
+
+from deeplearning4j_tpu.plot.tsne import BarnesHutTsne
+
+__all__ = ["BarnesHutTsne"]

@@ -1,0 +1,147 @@
+"""t-SNE dimensionality reduction.
+
+Reference: org.deeplearning4j.plot.BarnesHutTsne (Builder: setMaxIter /
+perplexity / theta / learningRate; fit(INDArray) then getData()) — the
+standard companion to Word2Vec for embedding plots. Upstream uses the
+Barnes-Hut quad-tree approximation because exact t-SNE is O(N^2) on a
+JVM; on TPU the O(N^2) pairwise kernels ARE the fast path (dense
+matmul-shaped work on the MXU), so this implementation is exact and
+`theta` is accepted for API parity but unused. Per-point bandwidths are
+binary-searched for the target perplexity once on the host; the
+gradient loop (early exaggeration + momentum, van der Maaten 2008) runs
+as a single jitted lax.fori_loop.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _p_conditional(X, perplexity, tol=1e-5, max_tries=50):
+    """Symmetrized joint probabilities P from a host-side per-point
+    binary search over Gaussian bandwidths (one-time setup cost)."""
+    X = np.asarray(X, np.float64)
+    n = X.shape[0]
+    sq = np.sum(X ** 2, 1)
+    D = np.maximum(sq[:, None] + sq[None, :] - 2.0 * (X @ X.T), 0.0)
+    target = np.log(perplexity)
+    P = np.zeros((n, n))
+    for i in range(n):
+        lo, hi, beta = -np.inf, np.inf, 1.0
+        Di = np.delete(D[i], i)
+        for _ in range(max_tries):
+            expD = np.exp(-Di * beta)
+            sumP = max(expD.sum(), 1e-12)
+            H = np.log(sumP) + beta * np.sum(Di * expD) / sumP
+            if abs(H - target) < tol:
+                break
+            if H > target:
+                lo = beta
+                beta = beta * 2 if hi == np.inf else (beta + hi) / 2
+            else:
+                hi = beta
+                beta = beta / 2 if lo == -np.inf else (beta + lo) / 2
+        row = np.exp(-Di * beta)
+        row = row / max(row.sum(), 1e-12)
+        P[i, np.arange(n) != i] = row
+    P = (P + P.T) / (2.0 * n)
+    return np.maximum(P, 1e-12)
+
+
+class BarnesHutTsne:
+    """Builder-constructed t-SNE (reference: BarnesHutTsne.Builder)."""
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def setMaxIter(self, n):
+            self._kw["maxIter"] = int(n)
+            return self
+
+        def perplexity(self, p):
+            self._kw["perplexity"] = float(p)
+            return self
+
+        def theta(self, t):  # accepted for parity; exact solver ignores it
+            self._kw["theta"] = float(t)
+            return self
+
+        def learningRate(self, lr):
+            self._kw["learningRate"] = float(lr)
+            return self
+
+        def numDimension(self, d):
+            self._kw["numDimensions"] = int(d)
+            return self
+
+        def seed(self, s):
+            self._kw["seed"] = int(s)
+            return self
+
+        def build(self):
+            return BarnesHutTsne(**self._kw)
+
+    def __init__(self, maxIter=1000, perplexity=30.0, theta=0.5,
+                 learningRate=200.0, numDimensions=2, seed=42):
+        self.maxIter = maxIter
+        self.perplexity = perplexity
+        self.theta = theta
+        self.learningRate = learningRate
+        self.numDimensions = numDimensions
+        self.seed = seed
+        self._Y = None
+
+    def fit(self, X):
+        X = np.asarray(getattr(X, "toNumpy", lambda: X)())
+        n = X.shape[0]
+        if n < 3 * self.perplexity + 1:
+            raise ValueError(
+                f"perplexity {self.perplexity} too large for {n} points "
+                f"(needs n > 3*perplexity)")
+        P = jnp.asarray(_p_conditional(X, self.perplexity), jnp.float32)
+        key = jax.random.key(self.seed)
+        Y0 = 1e-4 * jax.random.normal(key, (n, self.numDimensions),
+                                      jnp.float32)
+        lr = self.learningRate
+        exag_iters = min(100, self.maxIter // 4)
+
+        def kl_grad(Y, Pm):
+            dt = Y.dtype  # pin f32 even under x64 test mode
+            sq = jnp.sum(Y ** 2, 1)
+            num = 1.0 / (1.0 + jnp.maximum(
+                sq[:, None] + sq[None, :] - 2.0 * (Y @ Y.T), 0.0))
+            num = num * (1.0 - jnp.eye(Y.shape[0], dtype=dt))
+            Q = jnp.maximum(num / jnp.sum(num), 1e-12)
+            PQ = (Pm - Q) * num
+            return (4.0 * (jnp.diag(jnp.sum(PQ, 1)) - PQ) @ Y).astype(dt)
+
+        def body(i, carry):
+            Y, V = carry
+            Pm = jnp.where(i < exag_iters, P * 12.0, P)  # early exaggeration
+            g = kl_grad(Y, Pm)
+            mom = jnp.where(i < exag_iters, 0.5, 0.8).astype(Y.dtype)
+            V = mom * V - lr * g
+            Y = Y + V
+            return Y - jnp.mean(Y, 0, keepdims=True), V
+
+        Y, _ = jax.jit(lambda y0: jax.lax.fori_loop(
+            0, self.maxIter, body, (y0, jnp.zeros_like(y0))))(Y0)
+        self._Y = np.asarray(Y)
+        return self
+
+    def getData(self):
+        if self._Y is None:
+            raise RuntimeError("call fit() first")
+        return self._Y
+
+    def saveAsFile(self, labels, path):
+        """Rows of 'y0,y1,...,label' (reference: BarnesHutTsne.saveAsFile
+        feeding the upstream plotting utilities)."""
+        Y = self.getData()
+        with open(path, "w") as fh:
+            for row, lab in zip(Y, labels):
+                fh.write(",".join(f"{v:.6f}" for v in row) + f",{lab}\n")

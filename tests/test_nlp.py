@@ -205,30 +205,3 @@ class TestDeepWalk:
         dw = DeepWalk.Builder().windowSize(2).vectorSize(8).seed(1).build()
         dw.fit(g, walkLength=10, walksPerVertex=3, iterations=2)
         assert dw.getVertexVector(0).shape == (8,)
-
-
-class TestDatasetIteratorVariants:
-    """FashionMnist/Emnist iterators (reference: the corresponding
-    deeplearning4j-datasets iterators): idx-or-synthetic loading with
-    the right class counts."""
-
-    def test_fashion_mnist_shapes(self):
-        from deeplearning4j_tpu.data import FashionMnistDataSetIterator
-
-        it = FashionMnistDataSetIterator(32, train=True, numExamples=96)
-        ds = it.next()
-        assert ds.getFeatures().shape() == (32, 784)
-        assert ds.getLabels().shape() == (32, 10)
-
-    def test_emnist_class_counts_and_validation(self):
-        from deeplearning4j_tpu.data import EmnistDataSetIterator
-
-        it = EmnistDataSetIterator("letters", 16, numExamples=64,
-                                   reshapeToCnn=True)
-        ds = it.next()
-        assert ds.getFeatures().shape() == (16, 1, 28, 28)
-        assert ds.getLabels().shape() == (16, 26)
-        assert EmnistDataSetIterator("balanced", 8, numExamples=16
-                                     ).next().getLabels().shape() == (8, 47)
-        with pytest.raises(ValueError, match="unknown EMNIST"):
-            EmnistDataSetIterator("bogus", 8)

@@ -1,0 +1,48 @@
+"""t-SNE tests (deeplearning4j_tpu.plot; reference:
+org.deeplearning4j.plot.BarnesHutTsne)."""
+
+import numpy as np
+import pytest
+
+
+class TestTsne:
+    """BarnesHutTsne (reference: org.deeplearning4j.plot) — exact t-SNE;
+    well-separated high-dimensional clusters must stay separated in 2D."""
+
+    def _clusters(self, n_per=25, d=10, k=3, seed=0):
+        rng = np.random.RandomState(seed)
+        centers = rng.randn(k, d) * 8.0
+        X = np.concatenate([centers[i] + rng.randn(n_per, d)
+                            for i in range(k)])
+        y = np.repeat(np.arange(k), n_per)
+        return X.astype("float32"), y
+
+    def test_clusters_stay_separated(self):
+        from deeplearning4j_tpu.plot import BarnesHutTsne
+
+        X, y = self._clusters()
+        t = (BarnesHutTsne.Builder().setMaxIter(400).perplexity(12)
+             .learningRate(100.0).seed(3).build())
+        Y = t.fit(X).getData()
+        assert Y.shape == (75, 2)
+        cent = np.stack([Y[y == i].mean(0) for i in range(3)])
+        intra = max(np.linalg.norm(Y[y == i] - cent[i], axis=1).mean()
+                    for i in range(3))
+        inter = min(np.linalg.norm(cent[i] - cent[j])
+                    for i in range(3) for j in range(i + 1, 3))
+        assert inter > 2.0 * intra, (intra, inter)
+
+    def test_validation_and_save(self, tmp_path):
+        from deeplearning4j_tpu.plot import BarnesHutTsne
+
+        X, y = self._clusters(n_per=4)  # 12 points
+        with pytest.raises(ValueError, match="perplexity"):
+            BarnesHutTsne.Builder().perplexity(30).build().fit(X)
+        t = (BarnesHutTsne.Builder().setMaxIter(50).perplexity(3)
+             .seed(1).build().fit(X))
+        p = str(tmp_path / "tsne.csv")
+        t.saveAsFile(y, p)
+        lines = open(p).read().strip().splitlines()
+        assert len(lines) == 12 and lines[0].count(",") == 2
+        with pytest.raises(RuntimeError, match="fit"):
+            BarnesHutTsne.Builder().build().getData()
