@@ -1,0 +1,10 @@
+"""Clustering + nearest neighbors (reference: the
+deeplearning4j-nearestneighbors-parent / nd4j clustering modules:
+org.deeplearning4j.clustering.kmeans.KMeansClustering and the VPTree
+nearest-neighbor stack)."""
+
+from deeplearning4j_tpu.clustering.kmeans import (KMeansClustering,
+                                                  ClusterSet,
+                                                  NearestNeighbors)
+
+__all__ = ["KMeansClustering", "ClusterSet", "NearestNeighbors"]

@@ -1,0 +1,147 @@
+"""KMeans + exact nearest neighbors.
+
+Reference: org.deeplearning4j.clustering.kmeans.KMeansClustering
+(setup(clusterCount, maxIterationCount, distanceFunction) →
+applyTo(points) → ClusterSet) and the VPTree behind
+NearestNeighborsServer. The JVM needs a vantage-point tree because
+brute-force distance scans are slow there; on TPU the brute-force
+distance matrix IS a matmul on the MXU, so NearestNeighbors is exact
+brute force and KMeans runs Lloyd iterations as one jitted fori_loop
+(k-means++ style farthest-point seeding, empty clusters re-seeded to
+the farthest point).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _sq_dists(a, b):
+    """[n,d]x[m,d] -> [n,m] squared euclidean distances (matmul-shaped)."""
+    return jnp.maximum(
+        jnp.sum(a * a, 1)[:, None] + jnp.sum(b * b, 1)[None, :]
+        - 2.0 * (a @ b.T), 0.0)
+
+
+class ClusterSet:
+    """Fitted result (reference: clustering.cluster.ClusterSet)."""
+
+    def __init__(self, centers, assignments, inertia):
+        self._centers = np.asarray(centers)
+        self._assign = np.asarray(assignments)
+        self.inertia = float(inertia)
+
+    def getClusterCount(self):
+        return self._centers.shape[0]
+
+    def getCenters(self):
+        return self._centers
+
+    def getAssignments(self):
+        return self._assign
+
+    def classifyPoint(self, point):
+        d = np.sum((self._centers - np.asarray(point)) ** 2, 1)
+        return int(np.argmin(d))
+
+
+class KMeansClustering:
+    """Reference: KMeansClustering.setup(...).applyTo(points)."""
+
+    def __init__(self, clusterCount, maxIterationCount=100,
+                 distanceFunction="euclidean", seed=42):
+        if str(distanceFunction).lower() not in ("euclidean", "sqeuclidean"):
+            raise ValueError(
+                f"distanceFunction {distanceFunction!r} unsupported "
+                "(euclidean)")
+        self.k = int(clusterCount)
+        if self.k < 1:
+            raise ValueError(f"clusterCount must be >= 1, got {clusterCount}")
+        self.maxIter = int(maxIterationCount)
+        self.seed = int(seed)
+
+    @staticmethod
+    def setup(clusterCount, maxIterationCount=100,
+              distanceFunction="euclidean", seed=42):
+        return KMeansClustering(clusterCount, maxIterationCount,
+                                distanceFunction, seed)
+
+    def applyTo(self, points) -> ClusterSet:
+        X = jnp.asarray(
+            np.asarray(getattr(points, "toNumpy", lambda: points)(),
+                       np.float32))
+        n, d = X.shape
+        if n < self.k:
+            raise ValueError(f"{n} points cannot form {self.k} clusters")
+        key = jax.random.key(self.seed)
+
+        # farthest-point (k-means++-style) seeding, jit-unrolled: k is
+        # small and static
+        first = jax.random.randint(key, (), 0, n)
+        centers = [X[first]]
+        for _ in range(self.k - 1):
+            D = _sq_dists(X, jnp.stack(centers))
+            centers.append(X[jnp.argmax(jnp.min(D, 1))])
+        C0 = jnp.stack(centers)
+
+        C, a, inertia = _lloyd(X, C0, self.k, self.maxIter)
+        return ClusterSet(C, a, inertia)
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def _lloyd(X, C0, k, maxIter):
+    """Module-level jit: repeat fits with the same shapes/k hit the
+    compile cache instead of retracing a per-call closure."""
+
+    def body(_, C):
+        D = _sq_dists(X, C)
+        a = jnp.argmin(D, 1)
+        onehot = jax.nn.one_hot(a, k, dtype=X.dtype)
+        counts = jnp.sum(onehot, 0)
+        sums = onehot.T @ X
+        newC = sums / jnp.maximum(counts, 1.0)[:, None]
+        # empty clusters re-seed to DISTINCT farthest points (slot i
+        # takes the i-th farthest) — one shared point would leave
+        # duplicate centers when several clusters empty at once
+        far_idx = jax.lax.top_k(jnp.min(D, 1), k)[1]
+        cand = X[far_idx]
+        return jnp.where((counts > 0)[:, None], newC, cand)
+
+    C = jax.lax.fori_loop(0, int(maxIter), body, C0)
+    D = _sq_dists(X, C)
+    a = jnp.argmin(D, 1)
+    return C, a, jnp.sum(jnp.min(D, 1))
+
+
+class NearestNeighbors:
+    """Exact k-NN (reference: the VPTree/NearestNeighborsServer stack;
+    brute force is the TPU-native choice — one matmul per query batch)."""
+
+    def __init__(self, points):
+        self._X = jnp.asarray(
+            np.asarray(getattr(points, "toNumpy", lambda: points)(),
+                       np.float32))
+        if self._X.ndim != 2 or self._X.shape[0] == 0:
+            raise ValueError("points must be a non-empty [n, d] matrix")
+
+    def search(self, query, k):
+        """-> (indices [q, k], distances [q, k]) for a [q, d] (or [d])
+        query batch; euclidean, exact."""
+        q = jnp.asarray(np.asarray(
+            getattr(query, "toNumpy", lambda: query)(), np.float32))
+        single = q.ndim == 1
+        if single:
+            q = q[None, :]
+        k = int(k)
+        if not (1 <= k <= self._X.shape[0]):
+            raise ValueError(f"k={k} outside [1, {self._X.shape[0]}]")
+        D = _sq_dists(q, self._X)
+        negd, idx = jax.lax.top_k(-D, k)
+        dist = np.sqrt(np.asarray(-negd))
+        idx = np.asarray(idx)
+        return (idx[0], dist[0]) if single else (idx, dist)

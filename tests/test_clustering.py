@@ -1,0 +1,103 @@
+"""KMeans + NearestNeighbors (reference: deeplearning4j clustering /
+nearestneighbors modules) — numpy oracles and blob recovery."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (KMeansClustering, ClusterSet,
+                                           NearestNeighbors)
+
+
+def _blobs(n_per=40, k=3, d=5, seed=0, spread=6.0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(k, d) * spread
+    X = np.concatenate([centers[i] + rng.randn(n_per, d)
+                        for i in range(k)]).astype("float32")
+    y = np.repeat(np.arange(k), n_per)
+    return X, y, centers
+
+
+class TestKMeans:
+    def test_recovers_blobs(self):
+        X, y, _ = _blobs()
+        cs = KMeansClustering.setup(3, 50, "euclidean", seed=1).applyTo(X)
+        assert cs.getClusterCount() == 3
+        a = cs.getAssignments()
+        # each true blob maps (almost) entirely to one found cluster
+        for i in range(3):
+            counts = np.bincount(a[y == i], minlength=3)
+            assert counts.max() / counts.sum() > 0.95
+        # the three dominant labels are distinct
+        dom = [np.bincount(a[y == i], minlength=3).argmax() for i in range(3)]
+        assert len(set(dom)) == 3
+
+    def test_classify_point_and_inertia(self):
+        X, y, centers = _blobs()
+        cs = KMeansClustering.setup(3, 50).applyTo(X)
+        assert np.isfinite(cs.inertia) and cs.inertia > 0
+        # a point at a true center classifies with its blob's majority
+        i = cs.classifyPoint(centers[0])
+        dom = np.bincount(cs.getAssignments()[y == 0], minlength=3).argmax()
+        assert i == dom
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="unsupported"):
+            KMeansClustering(2, distanceFunction="cosine")
+        with pytest.raises(ValueError, match="clusters"):
+            KMeansClustering(10).applyTo(np.zeros((3, 2), "float32"))
+
+    def test_more_clusters_never_increase_inertia(self):
+        X, _, _ = _blobs()
+        i2 = KMeansClustering.setup(2, 50, seed=3).applyTo(X).inertia
+        i6 = KMeansClustering.setup(6, 50, seed=3).applyTo(X).inertia
+        assert i6 <= i2
+
+
+class TestNearestNeighbors:
+    def test_exact_vs_numpy_oracle(self):
+        rng = np.random.RandomState(0)
+        X = rng.randn(50, 7).astype("float32")
+        q = rng.randn(4, 7).astype("float32")
+        nn = NearestNeighbors(X)
+        idx, dist = nn.search(q, 5)
+        assert idx.shape == (4, 5) and dist.shape == (4, 5)
+        D = np.linalg.norm(q[:, None, :] - X[None, :, :], axis=-1)
+        ref = np.argsort(D, axis=1)[:, :5]
+        np.testing.assert_array_equal(np.sort(idx, 1), np.sort(ref, 1))
+        np.testing.assert_allclose(np.sort(dist, 1),
+                                   np.sort(D, axis=1)[:, :5], rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_single_query_and_validation(self):
+        X = np.eye(4, dtype="float32")
+        nn = NearestNeighbors(X)
+        idx, dist = nn.search(X[2], 1)
+        assert idx[0] == 2 and dist[0] < 1e-4
+        with pytest.raises(ValueError, match="k="):
+            nn.search(X[0], 9)
+        with pytest.raises(ValueError, match="non-empty"):
+            NearestNeighbors(np.zeros((0, 3), "float32"))
+
+
+class TestKMeansEdgeCases:
+    def test_k_zero_rejected(self):
+        with pytest.raises(ValueError, match="clusterCount"):
+            KMeansClustering(0)
+
+    def test_simultaneous_empty_clusters_get_distinct_centers(self):
+        """Force 3 empty clusters in one Lloyd step: the reseed must
+        place DISTINCT points, not one shared farthest point."""
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.clustering.kmeans import _lloyd
+
+        rng = np.random.RandomState(0)
+        X = jnp.asarray(rng.randn(20, 2).astype("float32"))
+        # one center near the data, three absurdly far: everything
+        # assigns to slot 0, slots 1-3 are empty simultaneously
+        C0 = jnp.asarray(np.array(
+            [[0.0, 0.0], [1e3, 1e3], [2e3, 2e3], [-1e3, 1e3]], "float32"))
+        C, a, _ = _lloyd(X, C0, 4, 1)
+        C = np.asarray(C)
+        d = np.linalg.norm(C[:, None, :] - C[None, :, :], axis=-1)
+        np.fill_diagonal(d, np.inf)
+        assert d.min() > 1e-6, C  # all four centers distinct
