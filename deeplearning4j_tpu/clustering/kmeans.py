@@ -22,7 +22,10 @@ import jax.numpy as jnp
 
 
 def _sq_dists(a, b):
-    """[n,d]x[m,d] -> [n,m] squared euclidean distances (matmul-shaped)."""
+    """[n,d]x[m,d] -> [n,m] squared euclidean distances via the
+    quadratic form (matmul-shaped for the MXU). fp32 precision of this
+    form degrades with the data's distance from the origin, so callers
+    mean-center their data first (distances are translation-invariant)."""
     return jnp.maximum(
         jnp.sum(a * a, 1)[:, None] + jnp.sum(b * b, 1)[None, :]
         - 2.0 * (a @ b.T), 0.0)
@@ -72,47 +75,65 @@ class KMeansClustering:
                                 distanceFunction, seed)
 
     def applyTo(self, points) -> ClusterSet:
-        X = jnp.asarray(
-            np.asarray(getattr(points, "toNumpy", lambda: points)(),
-                       np.float32))
-        n, d = X.shape
+        Xh = np.asarray(getattr(points, "toNumpy", lambda: points)(),
+                        np.float32)
+        n, d = Xh.shape
         if n < self.k:
             raise ValueError(f"{n} points cannot form {self.k} clusters")
+        # mean-center: keeps the fp32 quadratic distance form accurate
+        # for data far from the origin (translation-invariant)
+        mean = Xh.mean(0, keepdims=True)
+        X = jnp.asarray(Xh - mean)
         key = jax.random.key(self.seed)
 
-        # farthest-point (k-means++-style) seeding, jit-unrolled: k is
-        # small and static
-        first = jax.random.randint(key, (), 0, n)
-        centers = [X[first]]
+        # farthest-point seeding with a running min-distance vector:
+        # O(k*n*d) total, one distance column per new center
+        first = int(jax.random.randint(key, (), 0, n))
+        idxs = [first]
+        dmin = _sq_dists(X, X[first][None, :])[:, 0]
         for _ in range(self.k - 1):
-            D = _sq_dists(X, jnp.stack(centers))
-            centers.append(X[jnp.argmax(jnp.min(D, 1))])
-        C0 = jnp.stack(centers)
+            nxt = int(jnp.argmax(dmin))
+            idxs.append(nxt)
+            dmin = jnp.minimum(dmin, _sq_dists(X, X[nxt][None, :])[:, 0])
+        C0 = X[jnp.asarray(idxs)]
 
         C, a, inertia = _lloyd(X, C0, self.k, self.maxIter)
-        return ClusterSet(C, a, inertia)
+        return ClusterSet(np.asarray(C) + mean, a, inertia)
 
 
 @partial(jax.jit, static_argnums=(2, 3))
 def _lloyd(X, C0, k, maxIter):
-    """Module-level jit: repeat fits with the same shapes/k hit the
-    compile cache instead of retracing a per-call closure."""
+    """Module-level jit (repeat fits hit the compile cache). Iterates
+    until assignments stop changing, bounded by maxIter — the reference
+    terminates on convergence too; a fixed-trip loop would pay full
+    O(n*k*d) matmuls for every wasted iteration."""
 
-    def body(_, C):
+    def step(C):
         D = _sq_dists(X, C)
         a = jnp.argmin(D, 1)
         onehot = jax.nn.one_hot(a, k, dtype=X.dtype)
         counts = jnp.sum(onehot, 0)
-        sums = onehot.T @ X
-        newC = sums / jnp.maximum(counts, 1.0)[:, None]
+        newC = (onehot.T @ X) / jnp.maximum(counts, 1.0)[:, None]
         # empty clusters re-seed to DISTINCT farthest points (slot i
         # takes the i-th farthest) — one shared point would leave
         # duplicate centers when several clusters empty at once
         far_idx = jax.lax.top_k(jnp.min(D, 1), k)[1]
-        cand = X[far_idx]
-        return jnp.where((counts > 0)[:, None], newC, cand)
+        return (jnp.where((counts > 0)[:, None], newC, X[far_idx]),
+                a.astype(jnp.int32))  # pinned: x64 mode must not widen
 
-    C = jax.lax.fori_loop(0, int(maxIter), body, C0)
+    def cond(carry):
+        _, a_prev, a, i = carry
+        return (i < maxIter) & jnp.any(a_prev != a)
+
+    def body(carry):
+        C, _, a, i = carry
+        C2, a2 = step(C)
+        return C2, a, a2, i + jnp.asarray(1, jnp.int32)
+
+    a0 = jnp.full((X.shape[0],), -1, jnp.int32)
+    C1, a1 = step(C0)
+    C, _, a, _ = jax.lax.while_loop(
+        cond, body, (C1, a0, a1, jnp.asarray(1, jnp.int32)))
     D = _sq_dists(X, C)
     a = jnp.argmin(D, 1)
     return C, a, jnp.sum(jnp.min(D, 1))
@@ -123,20 +144,24 @@ class NearestNeighbors:
     brute force is the TPU-native choice — one matmul per query batch)."""
 
     def __init__(self, points):
-        self._X = jnp.asarray(
-            np.asarray(getattr(points, "toNumpy", lambda: points)(),
-                       np.float32))
-        if self._X.ndim != 2 or self._X.shape[0] == 0:
+        Xh = np.asarray(getattr(points, "toNumpy", lambda: points)(),
+                        np.float32)
+        if Xh.ndim != 2 or Xh.shape[0] == 0:
             raise ValueError("points must be a non-empty [n, d] matrix")
+        # mean-center (see _sq_dists): fp32 quadratic distances stay
+        # accurate for corpora far from the origin
+        self._mean = Xh.mean(0, keepdims=True)
+        self._X = jnp.asarray(Xh - self._mean)
 
     def search(self, query, k):
         """-> (indices [q, k], distances [q, k]) for a [q, d] (or [d])
         query batch; euclidean, exact."""
-        q = jnp.asarray(np.asarray(
-            getattr(query, "toNumpy", lambda: query)(), np.float32))
+        q = np.asarray(getattr(query, "toNumpy", lambda: query)(),
+                       np.float32)
         single = q.ndim == 1
         if single:
             q = q[None, :]
+        q = jnp.asarray(q - self._mean)
         k = int(k)
         if not (1 <= k <= self._X.shape[0]):
             raise ValueError(f"k={k} outside [1, {self._X.shape[0]}]")

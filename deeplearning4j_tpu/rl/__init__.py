@@ -1,0 +1,8 @@
+"""Reinforcement learning (reference: the rl4j sub-project of the
+deeplearning4j monorepo — org.deeplearning4j.rl4j). The Q-network is a
+regular MultiLayerNetwork whose jitted fit() consumes TD targets."""
+
+from deeplearning4j_tpu.rl.qlearning import (MDP, QLearningConfiguration,
+                                             QLearningDiscreteDense)
+
+__all__ = ["MDP", "QLearningConfiguration", "QLearningDiscreteDense"]

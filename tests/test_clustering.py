@@ -101,3 +101,16 @@ class TestKMeansEdgeCases:
         d = np.linalg.norm(C[:, None, :] - C[None, :, :], axis=-1)
         np.fill_diagonal(d, np.inf)
         assert d.min() > 1e-6, C  # all four centers distinct
+
+    def test_offset_data_precision(self):
+        """fp32 quadratic-form distances degrade far from the origin;
+        mean-centering must keep neighbors exact at large offsets."""
+        rng = np.random.RandomState(0)
+        X = (rng.randn(30, 4) * 0.01 + 1e4).astype("float32")
+        nn = NearestNeighbors(X)
+        idx, dist = nn.search(X[7], 2)
+        assert idx[0] == 7 and dist[0] < 1e-4
+        assert dist[1] > 0  # second neighbor is NOT collapsed to zero
+        cs = KMeansClustering.setup(2, 30, seed=1).applyTo(
+            np.concatenate([X, X + 0.5]))
+        assert len(set(cs.getAssignments()[:30])) == 1
