@@ -448,7 +448,23 @@ class SequenceRecordReaderDataSetIterator:
         B = len(fseqs)
         T = max(s.shape[0] for s in fseqs)
         F = fseqs[0].shape[1]
-        C = self.numLabels if not self.regression else lseqs[0].shape[1]
+        if self.regression:
+            # pin the label width on first use and validate every sequence
+            # against it — otherwise a ragged sequence surfaces later as an
+            # opaque numpy broadcast error (and the width could silently
+            # differ between batches)
+            if getattr(self, "_label_width", None) is None:
+                self._label_width = lseqs[0].shape[1]
+            for i, l in enumerate(lseqs):
+                if l.shape[1] != self._label_width:
+                    raise ValueError(
+                        f"regression label width {l.shape[1]} for sequence "
+                        f"{i} of this batch does not match the iterator's "
+                        f"established width {self._label_width}; all label "
+                        "sequences must have the same number of columns")
+            C = self._label_width
+        else:
+            C = self.numLabels
         x = np.zeros((B, F, T), "float32")
         y = np.zeros((B, C, T), "float32")
         mask = np.zeros((B, T), "float32")

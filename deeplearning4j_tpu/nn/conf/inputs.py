@@ -37,8 +37,22 @@ class InputType:
                          timeSeriesLength=None if timeSeriesLength is None else int(timeSeriesLength))
 
     @staticmethod
-    def convolutional(height: int, width: int, channels: int) -> "InputType":
-        return InputType(InputType.CNN, height=int(height), width=int(width), channels=int(channels))
+    def convolutional(height: int, width: int, channels: int,
+                      format: str = "NCHW") -> "InputType":
+        """`format` mirrors the reference's CNN2DFormat
+        (InputType.convolutional(h, w, d, CNN2DFormat)): it declares the
+        layout the USER feeds — "NHWC" skips the entry transpose entirely
+        (the TPU-preferred feed: host supplies NHWC bf16 and the input
+        param binds directly to the internal layout). Logical dims are
+        layout-independent, so `format` does not participate in dims/
+        equality."""
+        fmt = str(format).upper()
+        if fmt not in ("NCHW", "NHWC"):
+            raise ValueError(f"format must be NCHW or NHWC, got {format!r}")
+        it = InputType(InputType.CNN, height=int(height), width=int(width),
+                       channels=int(channels))
+        it.format = fmt
+        return it
 
     @staticmethod
     def convolutionalFlat(height: int, width: int, depth: int) -> "InputType":

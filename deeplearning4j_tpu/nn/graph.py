@@ -88,10 +88,22 @@ class ComputationGraph:
     def _cast_params(self, p):
         return cast_params(p, self._compute_dtype, self._param_dtype)
 
+    @property
+    def _api_nhwc(self):
+        """True when every declared CNN input is NHWC-format: then ALL 4-d
+        arrays at the API boundary (features, labels, outputs, feedForward
+        activations) are NHWC and no layout transposes happen anywhere
+        (reference: CNN2DFormat.NHWC)."""
+        its = [it for it in self.conf.inputTypes.values()
+               if it is not None and it.kind == InputType.CNN]
+        return bool(its) and all(
+            getattr(it, "format", "NCHW") == "NHWC" for it in its)
+
     def _entry(self, name, x):
         it = self.conf.inputTypes.get(name)
         if it is not None and it.kind == InputType.CNN and x.ndim == 4:
-            x = jnp.transpose(x, (0, 2, 3, 1))
+            if getattr(it, "format", "NCHW") != "NHWC":
+                x = jnp.transpose(x, (0, 2, 3, 1))
         if it is not None and it.kind == InputType.CNN_FLAT and x.ndim == 2:
             x = x.reshape(x.shape[0], it.channels, it.height, it.width)
             x = jnp.transpose(x, (0, 2, 3, 1))
@@ -153,7 +165,8 @@ class ComputationGraph:
                 preacts[name] = pre
                 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
                 out = MultiLayerNetwork._out_act(layer, pre)
-                if out.ndim == 4:  # NHWC internal -> NCHW at the API boundary
+                if out.ndim == 4 and not self._api_nhwc:
+                    # NHWC internal -> NCHW at the API boundary
                     out = jnp.transpose(out, (0, 3, 1, 2))
                 acts[name] = out
                 new_states[name] = states[name]
@@ -183,14 +196,20 @@ class ComputationGraph:
             pre = pre.astype(ldt)
             y = y.astype(ldt)
             if hasattr(layer, "computeLoss"):
-                # composite-loss heads (e.g. objdetect.Yolo2OutputLayer)
+                # composite-loss heads (e.g. objdetect.Yolo2OutputLayer) own
+                # their full loss computation and expect the reference's
+                # NCHW label layout — restore it for NHWC-format networks
+                if self._api_nhwc and y.ndim == 4:
+                    y = jnp.transpose(y, (0, 3, 1, 2))
                 total = total + layer.computeLoss(pre, y, lmask)
                 continue
             if pre.ndim == 3:  # NCW preact: loss over [B,T,O]
                 pre = jnp.transpose(pre, (0, 2, 1))
                 y = jnp.transpose(y, (0, 2, 1))
-            elif pre.ndim == 4:  # NHWC preact, NCHW labels from the API
-                y = jnp.transpose(y, (0, 2, 3, 1))
+            elif pre.ndim == 4:  # NHWC preact; labels are NCHW from the
+                # API unless the net declares NHWC
+                if not self._api_nhwc:
+                    y = jnp.transpose(y, (0, 2, 3, 1))
             total = total + _losses.compute(layer.lossFunction, y, pre,
                                             layer.activation, lmask)
         return total
@@ -462,8 +481,9 @@ class ComputationGraph:
             self._params, self._strip_carries(self._states), inputs,
             train, key, None)
         out = {}
+        nhwc = self._api_nhwc
         for name, a in acts.items():
-            if hasattr(a, "ndim") and a.ndim == 4 and \
+            if hasattr(a, "ndim") and a.ndim == 4 and not nhwc and \
                     name not in self.conf.networkOutputs:
                 a = jnp.transpose(a, (0, 3, 1, 2))
             out[name] = INDArray(a)

@@ -186,11 +186,21 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     # pure functions (traced under jit)
     # ------------------------------------------------------------------
+    @property
+    def _api_nhwc(self):
+        """True when the declared input format is NHWC: then ALL 4-d arrays
+        at the API boundary (features, labels, outputs) are NHWC and no
+        layout transposes happen anywhere (reference: CNN2DFormat.NHWC)."""
+        it = self.conf.inputType
+        return (it is not None and it.kind == InputType.CNN
+                and getattr(it, "format", "NCHW") == "NHWC")
+
     def _entry(self, x):
         """API-format input -> internal format (one transpose at entry)."""
         it = self.conf.inputType
         if it.kind == InputType.CNN and x.ndim == 4:
-            x = jnp.transpose(x, (0, 2, 3, 1))  # NCHW -> NHWC
+            if getattr(it, "format", "NCHW") != "NHWC":
+                x = jnp.transpose(x, (0, 2, 3, 1))  # NCHW -> NHWC
         elif it.kind == InputType.CNN3D and x.ndim == 5:
             x = jnp.transpose(x, (0, 2, 3, 4, 1))  # NCDHW -> NDHWC
         return x.astype(self._compute_dtype)
@@ -235,7 +245,10 @@ class MultiLayerNetwork:
         last = self.layers[-1]
         if hasattr(last, "computeLoss"):
             # composite-loss heads (e.g. objdetect.Yolo2OutputLayer) own
-            # their full loss computation
+            # their full loss computation and expect the reference's NCHW
+            # label layout — restore it for NHWC-format networks
+            if self._api_nhwc and labels.ndim == 4:
+                labels = jnp.transpose(labels, (0, 3, 1, 2))
             return last.computeLoss(preact, labels, lmask)
         if isinstance(last, (L.BaseOutputLayer, L.LossLayer)):
             if preact.ndim == 3:  # RnnOutputLayer: [B,O,T] -> loss over [B,T,O]
@@ -243,8 +256,10 @@ class MultiLayerNetwork:
                 lab = jnp.transpose(labels, (0, 2, 1))
                 return _losses.compute(last.lossFunction, lab, pre,
                                        last.activation, lmask)
-            if preact.ndim == 4:  # CnnLossLayer: NHWC preact, NCHW labels
-                lab = jnp.transpose(labels, (0, 2, 3, 1))
+            if preact.ndim == 4:  # CnnLossLayer: NHWC preact; labels are
+                # NCHW from the API unless the net declares NHWC
+                lab = labels if self._api_nhwc else \
+                    jnp.transpose(labels, (0, 2, 3, 1))
                 return _losses.compute(last.lossFunction, lab, preact,
                                        last.activation, lmask)
             return _losses.compute(last.lossFunction, labels, preact,

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import html
 import json
+import math
 import time
 
 
@@ -63,13 +64,23 @@ def render_report(logFile, outFile=None, title="Training report"):
              and r.get("score") is not None]
     epochs = [r for r in recs if r.get("type") == "epochEnd"]
 
-    score_pts = [(r["iteration"], float(r["score"])) for r in stats]
+    # A diverged run writes NaN/inf scores — exactly when the report gets
+    # read. Non-finite points would poison min/max and every polyline
+    # coordinate; drop them and say how many were dropped.
+    score_pts = [(r["iteration"], float(r["score"])) for r in stats
+                 if math.isfinite(float(r["score"]))]
+    dropped = len(stats) - len(score_pts)
     rate_pts = [(r["iteration"], float(r["iterationsPerSec"]))
-                for r in stats if "iterationsPerSec" in r]
+                for r in stats if "iterationsPerSec" in r
+                and math.isfinite(float(r["iterationsPerSec"]))]
     pmean_pts = [(r["iteration"], float(r["paramMeanAbs"]))
-                 for r in stats if "paramMeanAbs" in r]
+                 for r in stats if "paramMeanAbs" in r
+                 and math.isfinite(float(r["paramMeanAbs"]))]
 
     rows = []
+    if dropped:
+        rows.append(("non-finite scores dropped",
+                     f"{dropped} (run diverged?)"))
     if score_pts:
         rows.append(("final score", f"{score_pts[-1][1]:.6g}"))
         rows.append(("best score", f"{min(p[1] for p in score_pts):.6g}"))

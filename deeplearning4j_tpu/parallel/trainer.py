@@ -312,6 +312,13 @@ class SharedTrainingMaster(ParallelWrapper):
         if thresholdAlgorithm is not None:
             # parity with upstream's ThresholdAlgorithm arg: a number (or
             # object with .threshold) selects the Strom encoding
+            gc = kw.get("gradient_compression", "threshold")
+            if gc != "threshold":
+                raise ValueError(
+                    f"thresholdAlgorithm given together with "
+                    f"gradient_compression={gc!r}: the threshold algorithm "
+                    "only applies to the 'threshold' (Strom-2015) encoding; "
+                    "drop one of the two arguments")
             kw.setdefault("gradient_compression", "threshold")
             kw.setdefault("threshold",
                           getattr(thresholdAlgorithm, "threshold",

@@ -65,7 +65,11 @@ class QLearningDiscreteDense:
         self.net = qNetwork
         self.conf = config
         self._rng = np.random.RandomState(config.seed)
+        # ring buffer: O(1) eviction (a list with pop(0) degrades to O(n)
+        # per environment step once full) AND O(1) random indexing for
+        # minibatch sampling (which a deque would not give)
         self._replay = []  # (s, a, r, s2, done)
+        self._replay_pos = 0  # next overwrite slot once at capacity
         self._target = self._snapshot()
         self._step = 0
 
@@ -122,10 +126,12 @@ class QLearningDiscreteDense:
                 a = self._act(obs)
                 obs2, reward, done = self.mdp.step(a)
                 obs2 = np.asarray(obs2, "float32")
-                self._replay.append(
-                    (obs, a, float(reward), obs2, float(done)))
-                if len(self._replay) > c.expRepMaxSize:
-                    self._replay.pop(0)
+                item = (obs, a, float(reward), obs2, float(done))
+                if len(self._replay) < c.expRepMaxSize:
+                    self._replay.append(item)
+                else:
+                    self._replay[self._replay_pos] = item
+                    self._replay_pos = (self._replay_pos + 1) % c.expRepMaxSize
                 obs = obs2
                 self._step += 1
                 if self._step >= c.updateStart and \

@@ -24,12 +24,19 @@ from deeplearning4j_tpu.nn.conf.layers import (CnnLossLayer, LossLayer,
 
 class ZooModel:
     def __init__(self, numClasses=1000, seed=123, inputShape=None, updater=None,
-                 cacheMode=None, workspaceMode=None, dataType=None):
+                 cacheMode=None, workspaceMode=None, dataType=None,
+                 dataFormat="NCHW"):
         self.numClasses = numClasses
         self.seed = seed
         self.inputShape = inputShape or self.defaultInputShape()
         self.updater = updater
         self.dataType = dataType or DataType.FLOAT
+        # Feed layout (reference: CNN2DFormat). inputShape stays the logical
+        # (C, H, W) triple either way; dataFormat="NHWC" means fit/output
+        # receive [B,H,W,C] arrays and the entry transpose disappears —
+        # the TPU-preferred host feed (NHWC bf16 binds straight to the
+        # internal conv layout; see BENCH_NOTES.md round-4 input-feed work).
+        self.dataFormat = str(dataFormat).upper()
 
     @staticmethod
     def defaultInputShape():
@@ -112,7 +119,7 @@ class SimpleCNN(ZooModel):
                 .layer(DropoutLayer(dropOut=0.5))
                 .layer(GlobalPoolingLayer(poolingType="avg"))
                 .layer(OutputLayer(nOut=self.numClasses, activation="softmax"))
-                .setInputType(InputType.convolutional(h, w, c))
+                .setInputType(InputType.convolutional(h, w, c, format=self.dataFormat))
                 .build())
 
 
@@ -145,7 +152,7 @@ class AlexNet(ZooModel):
                 .layer(DenseLayer(nOut=4096, activation="relu", dropOut=0.5))
                 .layer(DenseLayer(nOut=4096, activation="relu", dropOut=0.5))
                 .layer(OutputLayer(nOut=self.numClasses, activation="softmax"))
-                .setInputType(InputType.convolutional(h, w, c))
+                .setInputType(InputType.convolutional(h, w, c, format=self.dataFormat))
                 .build())
 
 
@@ -178,7 +185,7 @@ class VGG16(ZooModel):
         return (b.layer(DenseLayer(nOut=4096, activation="relu", dropOut=0.5))
                  .layer(DenseLayer(nOut=4096, activation="relu", dropOut=0.5))
                  .layer(OutputLayer(nOut=self.numClasses, activation="softmax"))
-                 .setInputType(InputType.convolutional(h, w, c))
+                 .setInputType(InputType.convolutional(h, w, c, format=self.dataFormat))
                  .build())
 
 
@@ -258,7 +265,7 @@ class ResNet50(ZooModel):
         g.addLayer("fc", OutputLayer(nOut=self.numClasses, activation="softmax",
                                      lossFunction="mcxent"), "gap")
         return (g.setOutputs("fc")
-                 .setInputTypes(InputType.convolutional(h, w, c))
+                 .setInputTypes(InputType.convolutional(h, w, c, format=self.dataFormat))
                  .build())
 
     @staticmethod
@@ -332,7 +339,7 @@ class UNet(ZooModel):
                                                activation="identity"), dec1)
         g.addLayer("out", CnnLossLayer(lossFunction="xent", activation="sigmoid"), "segment")
         return (g.setOutputs("out")
-                 .setInputTypes(InputType.convolutional(h, w, c))
+                 .setInputTypes(InputType.convolutional(h, w, c, format=self.dataFormat))
                  .build())
 
 
@@ -399,7 +406,7 @@ class Darknet19(ZooModel):
                                   convolutionMode="same", activation="identity"))
         lb.layer(GlobalPoolingLayer(poolingType="avg"))
         lb.layer(LossLayer(lossFunction="mcxent", activation="softmax"))
-        return (lb.setInputType(InputType.convolutional(h, w, c)).build())
+        return (lb.setInputType(InputType.convolutional(h, w, c, format=self.dataFormat)).build())
 
 
 class TinyYOLO(ZooModel):
@@ -449,7 +456,7 @@ class TinyYOLO(ZooModel):
         lb.layer(ConvolutionLayer(nOut=A * (5 + self.numClasses),
                                   kernelSize=(1, 1), activation="identity"))
         lb.layer(Yolo2OutputLayer(boundingBoxes=self.anchors))
-        return (lb.setInputType(InputType.convolutional(h, w, c)).build())
+        return (lb.setInputType(InputType.convolutional(h, w, c, format=self.dataFormat)).build())
 
 
 class SqueezeNet(ZooModel):
@@ -499,7 +506,7 @@ class SqueezeNet(ZooModel):
         g.addLayer("gap", GlobalPoolingLayer(poolingType="avg"), "conv10")
         g.addLayer("out", LossLayer(lossFunction="mcxent", activation="softmax"), "gap")
         return (g.setOutputs("out")
-                 .setInputTypes(InputType.convolutional(h, w, c))
+                 .setInputTypes(InputType.convolutional(h, w, c, format=self.dataFormat))
                  .build())
 
 
@@ -579,7 +586,7 @@ class Xception(ZooModel):
         g.addLayer("out", OutputLayer(nOut=self.numClasses, activation="softmax",
                                       lossFunction="mcxent"), "gap")
         return (g.setOutputs("out")
-                 .setInputTypes(InputType.convolutional(h, w, c))
+                 .setInputTypes(InputType.convolutional(h, w, c, format=self.dataFormat))
                  .build())
 
 
@@ -660,7 +667,7 @@ class YOLO2(ZooModel):
         g.addLayer("out", Yolo2OutputLayer(boundingBoxes=self.anchors),
                    "pred")
         return (g.setOutputs("out")
-                 .setInputTypes(InputType.convolutional(h, w, c))
+                 .setInputTypes(InputType.convolutional(h, w, c, format=self.dataFormat))
                  .build())
 
 
@@ -800,7 +807,7 @@ class InceptionResNetV1(ZooModel):
             nOut=self.numClasses, activation="softmax",
             lossFunction="mcxent"), "embeddings")
         return (g.setOutputs("out")
-                 .setInputTypes(InputType.convolutional(h, w, c))
+                 .setInputTypes(InputType.convolutional(h, w, c, format=self.dataFormat))
                  .build())
 
 
@@ -894,7 +901,7 @@ class FaceNetNN4Small2(ZooModel):
             nOut=self.numClasses, activation="softmax",
             lossFunction="mcxent"), "embeddings")
         return (g.setOutputs("out")
-                 .setInputTypes(InputType.convolutional(h, w, c))
+                 .setInputTypes(InputType.convolutional(h, w, c, format=self.dataFormat))
                  .build())
 
 
@@ -1034,5 +1041,5 @@ class NASNet(ZooModel):
                                       activation="softmax",
                                       lossFunction="mcxent"), "gap")
         return (g.setOutputs("out")
-                 .setInputTypes(InputType.convolutional(h, w, c))
+                 .setInputTypes(InputType.convolutional(h, w, c, format=self.dataFormat))
                  .build())

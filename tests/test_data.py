@@ -393,6 +393,24 @@ class TestSequenceRecords:
         with pytest.raises(ValueError, match="aligned"):
             it.next()
 
+    def test_ragged_regression_label_width_rejected(self, tmp_path):
+        from deeplearning4j_tpu.data import (CSVSequenceRecordReader,
+                                             SequenceRecordReaderDataSetIterator)
+
+        fdir, _ = self._write_seqs(tmp_path, [3, 3])
+        ldir = tmp_path / "rlabels"
+        ldir.mkdir()
+        # sequence 0 has 2 label columns, sequence 1 has 3 — must raise
+        # the iterator's descriptive error, not a numpy broadcast error
+        (ldir / "seq_0.csv").write_text("0.1,0.2\n0.3,0.4\n0.5,0.6")
+        (ldir / "seq_1.csv").write_text("0.1,0.2,0.9\n0.3,0.4,0.9\n0.5,0.6,0.9")
+        it = SequenceRecordReaderDataSetIterator(
+            CSVSequenceRecordReader().initialize(fdir),
+            CSVSequenceRecordReader().initialize(str(ldir)),
+            miniBatchSize=2, regression=True)
+        with pytest.raises(ValueError, match="label width"):
+            it.next()
+
     def test_edge_cases_rejected_clearly(self, tmp_path):
         from deeplearning4j_tpu.data import (CSVSequenceRecordReader,
                                              SequenceRecordReaderDataSetIterator)

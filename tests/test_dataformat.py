@@ -1,0 +1,151 @@
+"""NHWC input-format parity (reference: CNN2DFormat on InputType).
+
+format="NHWC" must be a pure layout change: identical math to the NCHW
+feed of the same logical data, with the entry transpose gone from the
+lowered program.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import (
+    NeuralNetConfiguration, InputType, MultiLayerNetwork, ConvolutionLayer,
+    SubsamplingLayer, BatchNormalization, OutputLayer, Adam,
+)
+from deeplearning4j_tpu.zoo import ResNet50
+
+
+def _small_cnn(fmt):
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(7).updater(Adam(1e-3)).activation("relu")
+            .list()
+            .layer(ConvolutionLayer(nOut=8, kernelSize=(3, 3),
+                                    convolutionMode="same"))
+            .layer(BatchNormalization())
+            .layer(SubsamplingLayer(poolingType="max", kernelSize=(2, 2),
+                                    stride=(2, 2)))
+            .layer(ConvolutionLayer(nOut=8, kernelSize=(3, 3)))
+            .layer(OutputLayer(nOut=5, activation="softmax",
+                               lossFunction="mcxent"))
+            .setInputType(InputType.convolutional(12, 10, 3, format=fmt))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_nhwc_output_parity_with_nchw():
+    rng = np.random.RandomState(0)
+    x_nchw = rng.rand(4, 3, 12, 10).astype("float32")
+    x_nhwc = np.ascontiguousarray(x_nchw.transpose(0, 2, 3, 1))
+    out_a = np.asarray(_small_cnn("NCHW").output(x_nchw).jax())
+    out_b = np.asarray(_small_cnn("NHWC").output(x_nhwc).jax())
+    np.testing.assert_allclose(out_a, out_b, rtol=1e-6, atol=1e-6)
+
+
+def test_nhwc_fit_parity_with_nchw():
+    rng = np.random.RandomState(1)
+    x_nchw = rng.rand(8, 3, 12, 10).astype("float32")
+    x_nhwc = np.ascontiguousarray(x_nchw.transpose(0, 2, 3, 1))
+    y = np.eye(5, dtype="float32")[rng.randint(0, 5, 8)]
+    a, b = _small_cnn("NCHW"), _small_cnn("NHWC")
+    for _ in range(3):
+        a.fit(x_nchw, y)
+        b.fit(x_nhwc, y)
+    assert a.score() == pytest.approx(b.score(), rel=1e-6)
+
+
+def test_invalid_format_rejected():
+    with pytest.raises(ValueError, match="NCHW or NHWC"):
+        InputType.convolutional(8, 8, 3, format="CHWN")
+
+
+def test_resnet50_nhwc_graph_runs():
+    net = ResNet50(numClasses=10, inputShape=(3, 32, 32),
+                   dataFormat="NHWC").init()
+    rng = np.random.RandomState(2)
+    x = rng.rand(2, 32, 32, 3).astype("float32")
+    y = np.eye(10, dtype="float32")[rng.randint(0, 10, 2)]
+    net.fit(x, [y])
+    assert np.isfinite(net.score())
+
+
+def _dense_head_cnn(fmt):
+    # CnnLossLayer head: per-pixel predictions, so the 4-d LABEL layout
+    # contract matters, not just the feature layout
+    from deeplearning4j_tpu.nn.conf.layers import CnnLossLayer
+
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(3).updater(Adam(1e-3))
+            .list()
+            .layer(ConvolutionLayer(nOut=6, kernelSize=(3, 3),
+                                    convolutionMode="same",
+                                    activation="relu"))
+            .layer(ConvolutionLayer(nOut=2, kernelSize=(1, 1),
+                                    activation="identity"))
+            .layer(CnnLossLayer(activation="softmax", lossFunction="mcxent"))
+            .setInputType(InputType.convolutional(8, 6, 3, format=fmt))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_nhwc_dense_head_label_parity():
+    rng = np.random.RandomState(4)
+    x_nchw = rng.rand(4, 3, 8, 6).astype("float32")
+    lab_ids = rng.randint(0, 2, (4, 8, 6))
+    y_nchw = np.eye(2, dtype="float32")[lab_ids].transpose(0, 3, 1, 2)
+    x_nhwc = np.ascontiguousarray(x_nchw.transpose(0, 2, 3, 1))
+    y_nhwc = np.ascontiguousarray(y_nchw.transpose(0, 2, 3, 1))
+    a, b = _dense_head_cnn("NCHW"), _dense_head_cnn("NHWC")
+    for _ in range(2):
+        a.fit(x_nchw, y_nchw)
+        b.fit(x_nhwc, y_nhwc)
+    assert a.score() == pytest.approx(b.score(), rel=1e-6)
+
+
+def test_nhwc_graph_output_layout():
+    # ComputationGraph with a 4-d output: NCHW nets return NCHW at the
+    # boundary, NHWC nets return NHWC untouched.
+    from deeplearning4j_tpu.nn.conf.layers import CnnLossLayer
+
+    def build(fmt):
+        g = (NeuralNetConfiguration.Builder().seed(5).updater(Adam(1e-3))
+             .graphBuilder().addInputs("in"))
+        g.addLayer("c1", ConvolutionLayer(nOut=4, kernelSize=(3, 3),
+                                          convolutionMode="same",
+                                          activation="relu"), "in")
+        g.addLayer("out", CnnLossLayer(activation="sigmoid",
+                                       lossFunction="xent"), "c1")
+        from deeplearning4j_tpu.nn import ComputationGraph
+        return ComputationGraph(
+            g.setOutputs("out")
+             .setInputTypes(InputType.convolutional(10, 8, 3, format=fmt))
+             .build()).init()
+
+    rng = np.random.RandomState(6)
+    x_nchw = rng.rand(2, 3, 10, 8).astype("float32")
+    x_nhwc = np.ascontiguousarray(x_nchw.transpose(0, 2, 3, 1))
+    out_nchw = np.asarray(build("NCHW").output(x_nchw).jax())
+    out_nhwc = np.asarray(build("NHWC").output(x_nhwc).jax())
+    assert out_nchw.shape == (2, 4, 10, 8)
+    assert out_nhwc.shape == (2, 10, 8, 4)
+    np.testing.assert_allclose(out_nchw, out_nhwc.transpose(0, 3, 1, 2),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_nhwc_entry_has_no_transpose():
+    # The point of the feature: the lowered forward must not contain a
+    # 4-d input transpose (NCHW networks have exactly that at entry).
+    net = _small_cnn("NHWC")
+    x = jnp.zeros((2, 12, 10, 3), jnp.float32)
+
+    def fwd(params, states, xx):
+        h, _ = net._run_layers(params, states, xx, False, None, None)
+        return h
+
+    txt = jax.jit(fwd).lower(net._params, net._states, x).as_text()
+    # conv itself may carry internal transposes on CPU; assert on the
+    # specific entry pattern instead: a transpose whose operand is the
+    # input argument shape 2x3x12x10 cannot appear since no such shape
+    # exists in the NHWC program at all.
+    assert "2x3x12x10" not in txt

@@ -309,6 +309,27 @@ class TestUIReport:
         assert "final score" in html_doc
         assert "mean |param|" in html_doc        # histograms collected
 
+    def test_render_drops_nonfinite_scores(self, tmp_path):
+        # A diverged run (NaN scores) is exactly when the report gets
+        # read: the chart must render from the finite points only, with
+        # the dropped count surfaced — not a blank NaN-coordinate SVG.
+        import json as _json
+
+        from deeplearning4j_tpu.optimize import render_report
+
+        log = tmp_path / "diverged.jsonl"
+        recs = [{"type": "stats", "iteration": i, "score": 1.0 / (i + 1)}
+                for i in range(6)]
+        recs += [{"type": "stats", "iteration": 6, "score": float("nan")},
+                 {"type": "stats", "iteration": 7, "score": float("inf")}]
+        log.write_text("\n".join(_json.dumps(r) for r in recs))
+        doc = render_report(str(log))
+        import re as _re
+
+        pts = _re.search(r"points='([^']*)'", doc).group(1)
+        assert "nan" not in pts.lower() and "inf" not in pts.lower()
+        assert "non-finite scores dropped" in doc and "2 (run diverged?)" in doc
+
     def test_attach_listener_object_and_empty_log(self, tmp_path):
         from deeplearning4j_tpu.optimize import StatsListener, UIServer, \
             render_report

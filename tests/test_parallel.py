@@ -673,6 +673,15 @@ class TestThresholdGradientSharing:
         assert m.threshold == 1e-2
         # default (no algorithm given) stays int8
         assert SharedTrainingMaster(self._mlp()).gradient_compression == "int8"
+        # conflicting args: a threshold algorithm cannot silently lose to
+        # an explicit non-threshold compression
+        with pytest.raises(ValueError, match="thresholdAlgorithm"):
+            SharedTrainingMaster(self._mlp(), thresholdAlgorithm=1e-2,
+                                 gradient_compression="int8")
+        # explicit "threshold" alongside the algorithm is fine
+        m2 = SharedTrainingMaster(self._mlp(), thresholdAlgorithm=1e-3,
+                                  gradient_compression="threshold")
+        assert m2.threshold == 1e-3
 
     def test_adaptive_threshold_tracks_target_sparsity(self):
         """targetSparsity (reference: AdaptiveThresholdAlgorithm): a

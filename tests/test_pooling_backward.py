@@ -1,0 +1,159 @@
+"""Argmax-routed maxpool backward vs the select-and-scatter oracle.
+
+The custom VJP in ops/pooling.py exists to kill the single largest HBM
+consumer in the ResNet-50 train step (206 MB select-and-scatter, see
+BENCH_NOTES.md). These tests pin (a) forward parity, (b) exact gradient
+parity with JAX's stock reduce_window gradient — including on tied inputs,
+where both sides must route to the FIRST maximal window element — and
+(c) that the compiled gradient HLO actually contains no select-and-scatter
+(anti-silent-fallback, same pattern as tests/test_attention.py's routing
+assertion).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops import pooling
+
+
+CASES = [
+    # kernel, stride, padding  (ResNet stem pool = 3x3/2 SAME is the target)
+    ((3, 3), (2, 2), "SAME"),
+    ((2, 2), (2, 2), "SAME"),
+    ((3, 3), (2, 2), ((1, 1), (1, 1))),
+    ((2, 2), (2, 2), ((0, 0), (0, 0))),
+    ((3, 2), (1, 2), ((0, 1), (1, 0))),  # asymmetric everything
+    ((3, 3), (1, 1), "SAME"),            # fully overlapping windows
+]
+
+
+def _loss_pair(kernel, stride, padding):
+    def loss_new(x, dy):
+        return jnp.sum(pooling.max_pool2d(x, kernel, stride, padding) * dy)
+
+    def loss_ref(x, dy):
+        return jnp.sum(
+            pooling.max_pool2d_reference(x, kernel, stride, padding) * dy)
+
+    return loss_new, loss_ref
+
+
+@pytest.mark.parametrize("kernel,stride,padding", CASES)
+def test_forward_matches_reference(kernel, stride, padding):
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 13, 11, 5))
+    y = pooling.max_pool2d(x, kernel, stride, padding)
+    y_ref = pooling.max_pool2d_reference(x, kernel, stride, padding)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(y_ref))
+
+
+@pytest.mark.parametrize("kernel,stride,padding", CASES)
+def test_gradient_matches_select_and_scatter(kernel, stride, padding):
+    key = jax.random.PRNGKey(1)
+    x = jax.random.normal(key, (2, 13, 11, 5), dtype=jnp.float64)
+    loss_new, loss_ref = _loss_pair(kernel, stride, padding)
+    dy_shape = pooling.max_pool2d_reference(x, kernel, stride, padding).shape
+    dy = jax.random.normal(jax.random.PRNGKey(2), dy_shape, dtype=jnp.float64)
+    g_new = jax.grad(loss_new)(x, dy)
+    g_ref = jax.grad(loss_ref)(x, dy)
+    # atol floor: overlapping windows sum several dy terms in a different
+    # association order than select-and-scatter — fp64 ulps, nothing more.
+    np.testing.assert_allclose(np.asarray(g_new), np.asarray(g_ref),
+                               rtol=0, atol=1e-12)
+
+
+@pytest.mark.parametrize("kernel,stride,padding", CASES)
+def test_gradient_tie_routing_matches(kernel, stride, padding):
+    # Integer-valued floats force many intra-window ties (the post-relu
+    # regime the ResNet stem pool actually sees: lots of equal zeros).
+    # XLA's select-and-scatter ge-select routes to the first maximal
+    # element in window order; the argmax backward must do the same.
+    key = jax.random.PRNGKey(3)
+    x = jnp.floor(
+        jax.random.uniform(key, (2, 12, 10, 4), dtype=jnp.float64) * 3.0)
+    x = jnp.maximum(x - 1.0, 0.0)  # plenty of exact zeros
+    loss_new, loss_ref = _loss_pair(kernel, stride, padding)
+    dy_shape = pooling.max_pool2d_reference(x, kernel, stride, padding).shape
+    dy = jax.random.normal(jax.random.PRNGKey(4), dy_shape, dtype=jnp.float64)
+    g_new = jax.grad(loss_new)(x, dy)
+    g_ref = jax.grad(loss_ref)(x, dy)
+    # A routing (tie-break) divergence would show up as a FULL dy-sized
+    # mismatch at some element, not an ulp — atol=1e-12 still catches it.
+    np.testing.assert_allclose(np.asarray(g_new), np.asarray(g_ref),
+                               rtol=0, atol=1e-12)
+
+
+def test_finite_difference_gradcheck():
+    # fp64 central differences at a tie-free point.
+    rng = np.random.default_rng(7)
+    x = np.asarray(
+        jax.random.permutation(jax.random.PRNGKey(5), 1 * 8 * 7 * 3),
+        dtype=np.float64).reshape(1, 8, 7, 3) * 0.01  # distinct values, no ties
+
+    def loss(xx):
+        return jnp.sum(jnp.sin(pooling.max_pool2d(xx, (3, 3), (2, 2), "SAME")))
+
+    g = np.asarray(jax.grad(loss)(jnp.asarray(x)))
+    eps = 1e-6
+    for _ in range(20):
+        i = tuple(rng.integers(0, d) for d in x.shape)
+        xp = x.copy(); xp[i] += eps
+        xm = x.copy(); xm[i] -= eps
+        fd = (float(loss(jnp.asarray(xp))) - float(loss(jnp.asarray(xm)))) / (2 * eps)
+        assert abs(fd - g[i]) < 1e-5, (i, fd, g[i])
+
+
+def test_no_select_and_scatter_in_grad_hlo():
+    # The point of the custom VJP: the compiled backward must not contain
+    # select-and-scatter. Fails loudly if the routing ever regresses to
+    # the stock gradient (e.g. wrapper bypass).
+    def loss(x):
+        return jnp.sum(pooling.max_pool2d(x, (3, 3), (2, 2), "SAME") ** 2)
+
+    # Check the pre-optimization StableHLO: the CPU backend later rewrites
+    # select_and_scatter into scatter, which would mask the distinction in
+    # compiled text (TPU keeps it, and there it is the expensive op).
+    x = jnp.ones((2, 16, 16, 4), jnp.float32)
+    hlo = jax.jit(jax.grad(loss)).lower(x).as_text()
+    assert "select_and_scatter" not in hlo and "scatter" not in hlo
+
+    def loss_ref(x):
+        return jnp.sum(
+            pooling.max_pool2d_reference(x, (3, 3), (2, 2), "SAME") ** 2)
+
+    hlo_ref = jax.jit(jax.grad(loss_ref)).lower(x).as_text()
+    assert "select_and_scatter" in hlo_ref, (
+        "oracle lost its select-and-scatter — parity tests no longer "
+        "compare against the stock path")
+
+
+def test_large_window_falls_back_to_reference():
+    # >36-element windows route to the stock gradient by design.
+    x = jax.random.normal(jax.random.PRNGKey(6), (1, 32, 32, 2))
+    y = pooling.max_pool2d(x, (7, 7), (7, 7), ((0, 0), (0, 0)))
+    y_ref = pooling.max_pool2d_reference(x, (7, 7), (7, 7), ((0, 0), (0, 0)))
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(y_ref))
+
+
+def test_forward_mode_ad_documented_behavior():
+    # Pinned tradeoff (see max_pool2d docstring): reverse-mode rules out
+    # forward-mode through the custom vjp; the reference path keeps it.
+    x = jnp.ones((1, 4, 4, 1))
+    with pytest.raises(TypeError, match="forward-mode|jvp"):
+        jax.jacfwd(lambda t: pooling.max_pool2d(t, (2, 2), (2, 2), "SAME"))(x)
+    jac = jax.jacfwd(
+        lambda t: pooling.max_pool2d_reference(t, (2, 2), (2, 2), "SAME"))(x)
+    assert np.isfinite(np.asarray(jac)).all()
+
+
+def test_bf16_dtype_preserved():
+    x = jax.random.normal(jax.random.PRNGKey(8), (2, 8, 8, 3)).astype(jnp.bfloat16)
+    y = pooling.max_pool2d(x, (3, 3), (2, 2), "SAME")
+    assert y.dtype == jnp.bfloat16
+
+    def loss(xx):
+        return jnp.sum(pooling.max_pool2d(xx, (3, 3), (2, 2), "SAME").astype(jnp.float32))
+
+    g = jax.grad(loss)(x)
+    assert g.dtype == jnp.bfloat16
