@@ -392,10 +392,10 @@ def _embedding(table, ids):
 # ---- cnn ----
 @op("conv2d")
 def _conv2d(x, w, b=None, stride=(1, 1), padding=((0, 0), (0, 0)),
-            dilation=(1, 1)):
+            dilation=(1, 1), groups=1):
     return _conv.conv2d(x, w, b, stride=tuple(stride),
                         padding=tuple(tuple(p) for p in padding),
-                        dilation=tuple(dilation))
+                        dilation=tuple(dilation), groups=int(groups))
 
 
 @op("conv1d")

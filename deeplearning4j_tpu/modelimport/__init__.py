@@ -1,7 +1,8 @@
-"""Keras model import.
+"""Model import: Keras configs/weights and TF frozen GraphDefs.
 
 Reference: deeplearning4j-modelimport —
-org.deeplearning4j.nn.modelimport.keras.KerasModelImport.
+org.deeplearning4j.nn.modelimport.keras.KerasModelImport — and nd4j-api
+org.nd4j.imports.graphmapper.tf.TFGraphMapper.
 """
 
 from deeplearning4j_tpu.modelimport.keras import (
@@ -9,9 +10,17 @@ from deeplearning4j_tpu.modelimport.keras import (
     InvalidKerasConfigurationException,
     UnsupportedKerasConfigurationException,
 )
+from deeplearning4j_tpu.modelimport.tensorflow import (
+    TFGraphMapper,
+    TFImportException,
+    importFrozenTF,
+)
 
 __all__ = [
     "KerasModelImport",
     "InvalidKerasConfigurationException",
     "UnsupportedKerasConfigurationException",
+    "TFGraphMapper",
+    "TFImportException",
+    "importFrozenTF",
 ]

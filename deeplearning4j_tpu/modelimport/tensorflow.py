@@ -1,0 +1,383 @@
+"""TF frozen-graph (GraphDef) import into SameDiff.
+
+Reference: nd4j-api org.nd4j.imports.graphmapper.tf.TFGraphMapper — maps a
+frozen TensorFlow GraphDef's nodes onto SameDiff ops. Same idea here,
+TPU-first: the imported SameDiff graph traces to ONE jitted XLA
+computation (no per-node interpretation), so an imported model runs
+exactly like a natively-built one — jit, grad, training, serialization.
+
+Scope (the pragmatic op subset frozen inference CNN/MLP graphs use):
+Placeholder, Const, Identity/StopGradient, Conv2D, DepthwiseConv2dNative,
+BiasAdd, FusedBatchNorm(V2/V3), Relu, Relu6, LeakyRelu, Sigmoid, Tanh,
+Softmax, MaxPool, AvgPool, Mean, MatMul, Add/AddV2/AddN, Sub, Mul,
+RealDiv, Maximum, Minimum, Pow, Rsqrt, Sqrt, Exp, Log, Neg, Square, Abs,
+Reshape, Squeeze, Pad, ConcatV2, Cast. NHWC data format only
+(TF's CPU default; NCHW graphs raise). Anything else raises with the node
+name and op type.
+
+Parsing: GraphDef protobuf classes come from the installed tensorflow
+package (gated import — parsing wire format by hand would duplicate the
+schema). Everything downstream of the parsed proto is this framework.
+"""
+
+from __future__ import annotations
+
+import ml_dtypes
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+
+class TFImportException(ValueError):
+    pass
+
+
+def _graph_def_from(source):
+    """Accept a GraphDef message, serialized bytes, or a .pb path."""
+    try:
+        from tensorflow.core.framework import graph_pb2
+    except ImportError as e:  # pragma: no cover - tf is baked into the image
+        raise TFImportException(
+            "TF GraphDef import needs the tensorflow package for the "
+            "protobuf schema (tensorflow.core.framework.graph_pb2); "
+            "it is not importable here") from e
+    if isinstance(source, graph_pb2.GraphDef):
+        return source
+    gd = graph_pb2.GraphDef()
+    if isinstance(source, bytes):
+        gd.ParseFromString(source)
+        return gd
+    path = str(source)
+    with open(path, "rb") as f:
+        data = f.read()
+    if path.endswith((".pbtxt", ".pbtext")):
+        from google.protobuf import text_format
+
+        text_format.Parse(data.decode(), gd)
+    else:
+        gd.ParseFromString(data)
+    return gd
+
+
+_DT = {1: np.float32, 2: np.float64, 3: np.int32, 4: np.uint8, 5: np.int16,
+       6: np.int8, 9: np.int64, 10: np.bool_, 19: np.float16,
+       14: ml_dtypes.bfloat16}  # 14 = DT_BFLOAT16 (NOT fp16 — different layout)
+
+
+def _tensor_to_ndarray(tp):
+    """TensorProto -> numpy (the fields frozen graphs actually use)."""
+    shape = tuple(d.size for d in tp.tensor_shape.dim)
+    dtype = _DT.get(tp.dtype)
+    if dtype is None:
+        raise TFImportException(f"unsupported TensorProto dtype {tp.dtype}")
+    if tp.tensor_content:
+        return np.frombuffer(tp.tensor_content, dtype=dtype).reshape(shape).copy()
+    for field in ("float_val", "double_val", "int_val", "int64_val",
+                  "bool_val", "half_val"):
+        vals = list(getattr(tp, field, []))
+        if vals:
+            if field == "half_val":
+                # half_val holds RAW BIT PATTERNS (uint16) for both
+                # DT_HALF and DT_BFLOAT16, not numeric values
+                arr = np.asarray(vals, np.uint16).view(dtype)
+            else:
+                arr = np.asarray(vals, dtype=dtype)
+            if shape and arr.size == 1:
+                arr = np.full(shape, arr[0], dtype=dtype)
+            return arr.reshape(shape) if shape else arr.reshape(())
+    return np.zeros(shape, dtype=dtype)
+
+
+def _attr(node, name, default=None):
+    if name in node.attr:
+        return node.attr[name]
+    return default
+
+
+def _require_attr(node, name):
+    """Attrs a node is meaningless without (a graph serialized with
+    strip_default_attrs can legitimately omit default-VALUED attrs, but
+    strides/ksize/value have no defaults)."""
+    a = _attr(node, name)
+    if a is None:
+        raise TFImportException(
+            f"node '{node.name}' ({node.op}) is missing required "
+            f"attribute '{name}'")
+    return a
+
+
+def _require_nhwc(node):
+    a = _attr(node, "data_format")
+    fmt = a.s.decode() if (a is not None and a.s) else "NHWC"
+    if fmt != "NHWC":
+        raise TFImportException(
+            f"node '{node.name}' ({node.op}) uses data_format={fmt}; only "
+            "NHWC graphs are supported (TF's CPU freezing default)")
+
+
+def _same_pads(in_h, in_w, k, s, d=(1, 1)):
+    """TF SAME padding -> explicit ((lo,hi),(lo,hi)) for static shapes."""
+    pads = []
+    for size, kk, ss, dd in ((in_h, k[0], s[0], d[0]), (in_w, k[1], s[1], d[1])):
+        eff = (kk - 1) * dd + 1
+        out = -(-size // ss)
+        tot = max((out - 1) * ss + eff - size, 0)
+        pads.append((tot // 2, tot - tot // 2))
+    return tuple(pads)
+
+
+def _conv_padding(node, xshape, k, s, d=(1, 1)):
+    a = _attr(node, "padding")
+    p = a.s.decode() if (a is not None and a.s) else "VALID"
+    if p == "VALID":
+        return ((0, 0), (0, 0))
+    if p == "SAME":
+        return _same_pads(xshape[1], xshape[2], k, s, d)
+    if p == "EXPLICIT":
+        ep = list(_require_attr(node, "explicit_paddings").list.i)
+        return ((ep[2], ep[3]), (ep[4], ep[5]))  # NHWC: [b,b,h,h,w,w,c,c]
+    raise TFImportException(f"node '{node.name}': unsupported padding {p!r}")
+
+
+def _hw(list_attr):
+    v = list(list_attr.list.i)
+    return (v[1], v[2])  # NHWC [1, h, w, 1]
+
+
+class TFGraphMapper:
+    """importGraph(frozen GraphDef) -> SameDiff (reference: TFGraphMapper)."""
+
+    @staticmethod
+    def importGraph(source, inputShapes=None):
+        """`inputShapes`: {placeholderName: shape tuple} overriding/filling
+        unknown dims (TF placeholders routinely have batch=-1; XLA needs
+        static shapes)."""
+        import jax
+
+        from deeplearning4j_tpu.autodiff.ops_impl import OPS
+
+        gd = _graph_def_from(source)
+        sd = SameDiff.create()
+        vars_ = {}  # tf tensor name (output 0, no ":0") -> SDVariable
+        # Static shape/dtype per variable, tracked INCREMENTALLY with a
+        # single-op jax.eval_shape per node — SDVariable.shape re-traces
+        # the whole prefix graph, which is O(n^2) over a deep import.
+        meta = {}
+
+        def emit(opName, inputs, kwargs=None):
+            v = sd._op(opName, inputs, kwargs)
+            try:
+                structs = [meta[i.name] for i in inputs]
+                out = jax.eval_shape(
+                    lambda *a: OPS[opName](*a, **(kwargs or {})), *structs)
+                meta[v.name] = out[0] if isinstance(out, (list, tuple)) else out
+            except Exception:
+                pass  # best-effort: shape_of falls back to graph eval
+            return v
+
+        def shape_of(v):
+            m = meta.get(v.name)
+            return tuple(m.shape) if m is not None else tuple(v.shape)
+
+        def get(ref):
+            name = ref.lstrip("^")
+            if ":" in name:
+                base, idx = name.rsplit(":", 1)
+                if idx not in ("0",):
+                    raise TFImportException(
+                        f"reference '{ref}': only output 0 of multi-output "
+                        "nodes is supported (FusedBatchNorm etc. expose y)")
+                name = base
+            if name not in vars_:
+                raise TFImportException(f"reference to unknown node '{name}'")
+            return vars_[name]
+
+        def const_value(ref):
+            v = get(ref)
+            arr = sd._arrays.get(v.name)
+            if arr is None:
+                raise TFImportException(
+                    f"'{ref}' must be a Const (structural argument)")
+            return np.asarray(arr)
+
+        for node in gd.node:
+            op = node.op
+            ins = [i for i in node.input if not i.startswith("^")]
+            if op == "NoOp":
+                continue
+            if op == "Placeholder":
+                shape = None
+                if inputShapes and node.name in inputShapes:
+                    shape = tuple(int(x) for x in inputShapes[node.name])
+                else:
+                    a = _attr(node, "shape")
+                    if a is not None:
+                        shape = tuple(d.size for d in a.shape.dim)
+                if shape is None or any(s < 0 for s in shape):
+                    raise TFImportException(
+                        f"placeholder '{node.name}' has unknown dims "
+                        f"{shape}; pass inputShapes={{'{node.name}': "
+                        "(...)}} (XLA needs static shapes)")
+                da = _attr(node, "dtype")
+                dt = _DT.get(da.type, np.float32) if da is not None \
+                    else np.float32
+                vars_[node.name] = sd.placeHolder(node.name, dt, *shape)
+                meta[node.name] = jax.ShapeDtypeStruct(shape, np.dtype(dt))
+                continue
+            if op == "Const":
+                arr = _tensor_to_ndarray(_require_attr(node, "value").tensor)
+                vars_[node.name] = sd.constant(arr, node.name)
+                meta[node.name] = jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+                continue
+            if op in ("Identity", "StopGradient"):
+                vars_[node.name] = emit("identity", [get(ins[0])])
+                continue
+            if op == "Conv2D":
+                _require_nhwc(node)
+                x, w = get(ins[0]), get(ins[1])
+                s = _hw(_require_attr(node, "strides"))
+                dil_a = _attr(node, "dilations")
+                d = _hw(dil_a) if dil_a is not None else (1, 1)
+                kshp = shape_of(w)
+                pad = _conv_padding(node, shape_of(x), (kshp[0], kshp[1]), s, d)
+                vars_[node.name] = emit("conv2d", [x, w], {
+                    "stride": s, "padding": pad, "dilation": d})
+                continue
+            if op == "DepthwiseConv2dNative":
+                _require_nhwc(node)
+                x, w = get(ins[0]), get(ins[1])
+                s = _hw(_require_attr(node, "strides"))
+                kh, kw, cin, mult = shape_of(w)
+                pad = _conv_padding(node, shape_of(x), (kh, kw), s)
+                # TF stores (kh,kw,Cin,mult); grouped-conv layout is
+                # (kh,kw,1,Cin*mult) with groups=Cin
+                wg = emit("reshape", [w], {"shape": [kh, kw, 1, cin * mult]})
+                vars_[node.name] = emit("conv2d", [x, wg], {
+                    "stride": s, "padding": pad, "groups": int(cin)})
+                continue
+            if op == "BiasAdd":
+                _require_nhwc(node)
+                vars_[node.name] = emit("add", [get(ins[0]), get(ins[1])])
+                continue
+            if op in ("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3"):
+                _require_nhwc(node)
+                t = _attr(node, "is_training")
+                if t is not None and t.b:
+                    raise TFImportException(
+                        f"node '{node.name}': is_training=true — freeze the "
+                        "graph for inference import")
+                ea = _attr(node, "epsilon")
+                eps = float(ea.f) if ea is not None else 1e-4  # proto default
+                x, gamma, beta, mean, var = (get(i) for i in ins[:5])
+                vars_[node.name] = emit(
+                    "batchNorm", [x, mean, var, gamma, beta],
+                    {"epsilon": eps, "axis": -1})
+                continue
+            if op in ("MaxPool", "AvgPool"):
+                _require_nhwc(node)
+                x = get(ins[0])
+                k = _hw(_require_attr(node, "ksize"))
+                s = _hw(_require_attr(node, "strides"))
+                pad = _conv_padding(node, shape_of(x), k, s)
+                vars_[node.name] = emit(
+                    "maxPooling2d" if op == "MaxPool" else "avgPooling2d",
+                    [x], {"kernel": k, "stride": s, "padding": pad})
+                continue
+            if op == "MatMul":
+                ta = _attr(node, "transpose_a")
+                tb = _attr(node, "transpose_b")
+                vars_[node.name] = emit(
+                    "mmul", [get(ins[0]), get(ins[1])],
+                    {"transposeA": bool(ta.b) if ta else False,
+                     "transposeB": bool(tb.b) if tb else False})
+                continue
+            if op in ("Add", "AddV2"):
+                vars_[node.name] = emit("add", [get(ins[0]), get(ins[1])])
+                continue
+            if op == "AddN":
+                acc = get(ins[0])
+                for r in ins[1:]:
+                    acc = emit("add", [acc, get(r)])
+                vars_[node.name] = emit("identity", [acc])
+                continue
+            if op in ("Sub", "Mul", "RealDiv", "Maximum", "Minimum", "Pow"):
+                nm = {"Sub": "sub", "Mul": "mul", "RealDiv": "div",
+                      "Maximum": "maximum", "Minimum": "minimum",
+                      "Pow": "pow"}[op]
+                vars_[node.name] = emit(nm, [get(ins[0]), get(ins[1])])
+                continue
+            if op in ("Rsqrt", "Sqrt", "Exp", "Log", "Neg", "Square", "Abs"):
+                # Keras-3 freezing decomposes inference BatchNorm into
+                # Rsqrt/Mul/Sub/AddV2 chains — these unaries make those
+                # graphs (and general math tails) importable
+                vars_[node.name] = emit(op.lower(), [get(ins[0])])
+                continue
+            if op in ("Relu", "Sigmoid", "Tanh", "Softmax"):
+                vars_[node.name] = emit(op.lower(), [get(ins[0])])
+                continue
+            if op == "Relu6":
+                vars_[node.name] = emit(
+                    "clipByValue", [get(ins[0])],
+                    {"clipValueMin": 0.0, "clipValueMax": 6.0})
+                continue
+            if op == "LeakyRelu":
+                a = _attr(node, "alpha")
+                vars_[node.name] = emit(
+                    "leakyRelu", [get(ins[0])],
+                    {"alpha": float(a.f) if a else 0.2})
+                continue
+            if op == "Reshape":
+                shape = [int(v) for v in const_value(ins[1])]
+                vars_[node.name] = emit("reshape", [get(ins[0])],
+                                          {"shape": shape})
+                continue
+            if op == "Squeeze":
+                sa = _attr(node, "squeeze_dims")
+                dims = list(sa.list.i) if sa is not None else []
+                vars_[node.name] = emit(
+                    "squeeze", [get(ins[0])],
+                    {"axis": tuple(int(d) for d in dims) if dims else None})
+                continue
+            if op in ("Pad", "PadV2"):
+                pads = const_value(ins[1]).tolist()
+                kw = {"padding": pads}
+                if op == "PadV2" and len(ins) > 2:
+                    kw["constant"] = float(const_value(ins[2]))
+                vars_[node.name] = emit("pad", [get(ins[0])], kw)
+                continue
+            if op == "ConcatV2":
+                axis = int(const_value(ins[-1]))
+                vars_[node.name] = emit(
+                    "concat", [get(i) for i in ins[:-1]], {"dimension": axis})
+                continue
+            if op == "Mean":
+                axes = np.atleast_1d(const_value(ins[1])).tolist()
+                kd = _attr(node, "keep_dims")
+                vars_[node.name] = emit(
+                    "mean", [get(ins[0])],
+                    {"dimensions": [int(a) for a in axes],
+                     "keepDims": bool(kd.b) if kd else False})
+                continue
+            if op == "Cast":
+                dt = _DT.get(_require_attr(node, "DstT").type)
+                if dt is None:
+                    raise TFImportException(
+                        f"node '{node.name}': unsupported Cast target")
+                vars_[node.name] = emit(
+                    "cast", [get(ins[0])], {"dtype": str(np.dtype(dt))})
+                continue
+            raise TFImportException(
+                f"unsupported TF op '{op}' (node '{node.name}'); supported "
+                "subset is documented in modelimport.tensorflow")
+        sd._tf_vars = vars_  # tf node name -> SDVariable (introspection)
+        return sd
+
+    @staticmethod
+    def outputVariable(sd, tfName):
+        """The SDVariable for a TF node name in an imported graph."""
+        return sd._tf_vars[tfName.split(":")[0]]
+
+
+def importFrozenTF(source, inputShapes=None):
+    """Convenience wrapper (reference: TFGraphMapper.importGraph)."""
+    return TFGraphMapper.importGraph(source, inputShapes=inputShapes)

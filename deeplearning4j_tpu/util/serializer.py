@@ -94,6 +94,15 @@ class ModelSerializer:
         return net
 
     @staticmethod
+    def restore(path, loadUpdater: bool = True):
+        """Type-dispatching restore: returns whichever network class the
+        file holds (callers that know the type can use the explicit
+        restoreMultiLayerNetwork/restoreComputationGraph)."""
+        loaded = _load_npz(path)
+        return ModelSerializer._restore(path, loaded[0]["model_type"],
+                                        loadUpdater, loaded=loaded)
+
+    @staticmethod
     def restoreMultiLayerNetwork(path, loadUpdater: bool = True):
         return ModelSerializer._restore(path, "MultiLayerNetwork", loadUpdater)
 

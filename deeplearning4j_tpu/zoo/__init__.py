@@ -4,9 +4,11 @@ Reference: org.deeplearning4j.zoo.model.* (ZooModel subclasses LeNet,
 SimpleCNN, AlexNet, VGG16/19, ResNet50, UNet, TextGenerationLSTM,
 Darknet19, TinyYOLO, YOLO2, SqueezeNet, Xception, InceptionResNetV1,
 FaceNetNN4Small2, NASNet). Each model is a configuration factory;
-init() returns a ready network. Pretrained weight download is not
-available in this zero-egress build (reference: ZooModel.initPretrained)
-— initPretrained raises with a clear message.
+init() returns a ready network. Pretrained weight DOWNLOAD is not
+available in this zero-egress build (reference: ZooModel.initPretrained
+fetches published weights); initPretrained(localFile=...) instead maps a
+locally-supplied Keras-applications h5 or native checkpoint — see
+zoo.pretrained.
 """
 
 from deeplearning4j_tpu.zoo.models import (
@@ -14,8 +16,12 @@ from deeplearning4j_tpu.zoo.models import (
     TextGenerationLSTM, Darknet19, TinyYOLO, YOLO2, SqueezeNet, Xception,
     InceptionResNetV1, FaceNetNN4Small2, NASNet,
 )
+from deeplearning4j_tpu.zoo.pretrained import (
+    convertPretrained, loadKerasApplicationsWeights,
+)
 
 __all__ = ["ZooModel", "LeNet", "SimpleCNN", "AlexNet", "VGG16", "VGG19",
            "ResNet50", "UNet", "TextGenerationLSTM", "Darknet19", "TinyYOLO",
            "YOLO2", "SqueezeNet", "Xception", "InceptionResNetV1",
-           "FaceNetNN4Small2", "NASNet"]
+           "FaceNetNN4Small2", "NASNet", "convertPretrained",
+           "loadKerasApplicationsWeights"]

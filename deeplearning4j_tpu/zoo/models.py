@@ -53,11 +53,37 @@ class ZooModel:
             else MultiLayerNetwork(conf)
         return net.init()
 
-    def initPretrained(self, *_, **__):
-        raise NotImplementedError(
-            "Pretrained weights are not bundled in this build (no network "
-            "egress). Train from scratch or load a checkpoint via "
-            "util.serializer.ModelSerializer.")
+    def initPretrained(self, pretrainedType="imagenet", localFile=None):
+        """Initialise with pretrained weights from a LOCAL file
+        (reference: ZooModel.initPretrained(PretrainedType) — upstream
+        downloads; this build has no egress, so the user supplies the
+        file; the first positional stays the PretrainedType for signature
+        parity and names which published weights localFile holds).
+        Accepts a Keras-applications legacy HDF5 (mapped onto the native
+        graph, see zoo.pretrained) or a native ModelSerializer
+        checkpoint. `zoo.pretrained.convertPretrained` banks the h5 as a
+        native checkpoint for faster subsequent loads."""
+        import os
+
+        if localFile is None:
+            raise NotImplementedError(
+                f"Pretrained '{pretrainedType}' weights are not bundled in "
+                "this build (no network egress). Pass localFile=<path> to "
+                "a locally-supplied Keras-applications .h5 or a native "
+                "checkpoint, or train from scratch.")
+        path = str(localFile)
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"initPretrained localFile does not exist: {path}")
+        if path.endswith((".h5", ".hdf5")):
+            from deeplearning4j_tpu.zoo.pretrained import (
+                loadKerasApplicationsWeights,
+            )
+
+            return loadKerasApplicationsWeights(self, self.init(), path)
+        from deeplearning4j_tpu.util.serializer import ModelSerializer
+
+        return ModelSerializer.restore(path)
 
 
 class LeNet(ZooModel):

@@ -1,0 +1,193 @@
+"""TF frozen-graph import with numeric parity against live TF execution.
+
+Reference: nd4j TFGraphMapper tests — import a GraphDef, run both sides on
+the same input, compare. Graphs are produced the way real frozen models
+are: tf.function -> get_concrete_function -> convert_variables_to_constants_v2.
+"""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from tensorflow.python.framework.convert_to_constants import (  # noqa: E402
+    convert_variables_to_constants_v2,
+)
+
+from deeplearning4j_tpu.modelimport.tensorflow import (  # noqa: E402
+    TFGraphMapper, TFImportException, importFrozenTF,
+)
+
+
+def _freeze(model, spec):
+    fn = tf.function(model).get_concrete_function(spec)
+    frozen = convert_variables_to_constants_v2(fn)
+    return frozen.graph.as_graph_def(), frozen
+
+
+def _placeholder_name(gd):
+    return [n.name for n in gd.node if n.op == "Placeholder"][0]
+
+
+def _last_name(gd):
+    consumed = {i.split(":")[0].lstrip("^") for n in gd.node for i in n.input}
+    sinks = [n.name for n in gd.node
+             if n.op not in ("Const", "NoOp") and n.name not in consumed]
+    return sinks[-1]
+
+
+def _parity(gd, frozen, x, atol=1e-5, rtol=1e-4):
+    sd = importFrozenTF(gd.SerializeToString())
+    golden = frozen(tf.constant(x))
+    golden = np.asarray(golden[0] if isinstance(golden, (list, tuple)) else golden)
+    out = TFGraphMapper.outputVariable(sd, _last_name(gd))
+    ours = np.asarray(
+        out.eval({_placeholder_name(gd): x}).jax())
+    np.testing.assert_allclose(ours, golden, atol=atol, rtol=rtol)
+    return sd
+
+
+class TestMLPImport:
+    def test_dense_mlp_parity(self):
+        tf.keras.utils.set_random_seed(3)
+        model = tf.keras.Sequential([
+            tf.keras.layers.Dense(32, activation="relu"),
+            tf.keras.layers.Dense(16, activation="tanh"),
+            tf.keras.layers.Dense(5, activation="softmax"),
+        ])
+        model.build((4, 12))
+        gd, frozen = _freeze(
+            model, tf.TensorSpec((4, 12), tf.float32))
+        x = np.random.RandomState(0).rand(4, 12).astype("float32")
+        _parity(gd, frozen, x)
+
+    def test_imported_graph_is_trainable(self):
+        # The import target is a full SameDiff graph: jit, grad, training
+        # all work on it — not an inference-only shim.
+        tf.keras.utils.set_random_seed(4)
+        model = tf.keras.Sequential(
+            [tf.keras.layers.Dense(8, activation="relu"),
+             tf.keras.layers.Dense(3)])
+        model.build((8, 6))
+        gd, _ = _freeze(model, tf.TensorSpec((8, 6), tf.float32))
+        sd = importFrozenTF(gd.SerializeToString())
+        out = TFGraphMapper.outputVariable(sd, _last_name(gd))
+        # constants imported from the frozen graph can be promoted and
+        # trained against a loss
+        g = sd.math.square(out).mean()
+        g.rename("loss")
+        sd.setLossVariables("loss")
+        x = np.random.RandomState(1).rand(8, 6).astype("float32")
+        grads = sd.calculateGradients({_placeholder_name(gd): x},
+                                      *[v.name for v in sd.variables()])
+        assert isinstance(grads, dict)
+
+
+class TestCNNImport:
+    def _cnn(self):
+        tf.keras.utils.set_random_seed(5)
+        return tf.keras.Sequential([
+            tf.keras.layers.Conv2D(8, 3, padding="same", activation="relu"),
+            tf.keras.layers.MaxPool2D(2),
+            tf.keras.layers.Conv2D(12, 3, strides=2, padding="valid"),
+            tf.keras.layers.BatchNormalization(),
+            tf.keras.layers.ReLU(),
+            tf.keras.layers.GlobalAveragePooling2D(),
+            tf.keras.layers.Dense(4, activation="softmax"),
+        ])
+
+    def test_small_cnn_parity(self):
+        model = self._cnn()
+        model.build((2, 16, 16, 3))
+        gd, frozen = _freeze(model, tf.TensorSpec((2, 16, 16, 3), tf.float32))
+        ops = {n.op for n in gd.node}
+        # Keras 3 freezes inference BN into a Rsqrt/Mul/Sub/Add chain
+        assert "Conv2D" in ops and "Rsqrt" in ops, ops
+        x = np.random.RandomState(2).rand(2, 16, 16, 3).astype("float32")
+        _parity(gd, frozen, x)
+
+    def test_fused_batchnorm_parity(self):
+        # Keras 3 decomposes BN at freeze time, so drive the FusedBatchNormV3
+        # import path with the raw op directly (what older frozen graphs —
+        # the ones people actually have .pb files of — contain).
+        g, b = np.float32([1.2, 0.8]), np.float32([0.1, -0.2])
+        m, v = np.float32([0.3, -0.1]), np.float32([1.5, 0.7])
+
+        @tf.function
+        def f(x):
+            y, _, _ = tf.raw_ops.FusedBatchNormV3(
+                x=x, scale=g, offset=b, mean=m, variance=v,
+                epsilon=1e-3, is_training=False)[:3]
+            return tf.nn.relu(y)
+
+        gd = f.get_concrete_function(
+            tf.TensorSpec((2, 4, 4, 2), tf.float32)).graph.as_graph_def()
+        assert "FusedBatchNormV3" in {n.op for n in gd.node}
+        x = np.random.RandomState(7).randn(2, 4, 4, 2).astype("float32")
+        golden = np.asarray(f(tf.constant(x)))
+        sd = importFrozenTF(gd.SerializeToString())
+        out = TFGraphMapper.outputVariable(sd, _last_name(gd))
+        ours = np.asarray(out.eval({_placeholder_name(gd): x}).jax())
+        np.testing.assert_allclose(ours, golden, atol=1e-5, rtol=1e-4)
+
+    def test_depthwise_and_relu6_parity(self):
+        tf.keras.utils.set_random_seed(6)
+        model = tf.keras.Sequential([
+            tf.keras.layers.DepthwiseConv2D(3, padding="same"),
+            tf.keras.layers.ReLU(max_value=6.0),
+            tf.keras.layers.AveragePooling2D(2),
+        ])
+        model.build((1, 8, 8, 4))
+        gd, frozen = _freeze(model, tf.TensorSpec((1, 8, 8, 4), tf.float32))
+        x = (np.random.RandomState(3).rand(1, 8, 8, 4) * 8).astype("float32")
+        _parity(gd, frozen, x)
+
+
+class TestConstDtypes:
+    def test_bfloat16_and_half_consts_decode_correctly(self):
+        # DT_BFLOAT16 (enum 14) is NOT fp16 — and small fp16/bf16 consts
+        # are serialized as raw bit patterns in half_val, not values.
+        vals = np.array([1.0, 2.5, -3.0], dtype=np.float32)
+
+        @tf.function
+        def f(x):
+            b16 = tf.constant(vals, dtype=tf.bfloat16)
+            h16 = tf.constant(vals, dtype=tf.float16)
+            return x + tf.cast(b16, tf.float32) + tf.cast(h16, tf.float32)
+
+        gd = f.get_concrete_function(
+            tf.TensorSpec((3,), tf.float32)).graph.as_graph_def()
+        sd = importFrozenTF(gd.SerializeToString())
+        out = TFGraphMapper.outputVariable(sd, _last_name(gd))
+        x = np.zeros(3, np.float32)
+        got = np.asarray(out.eval({_placeholder_name(gd): x}).jax())
+        np.testing.assert_allclose(got, 2 * vals, atol=1e-3)
+
+
+class TestImportErrors:
+    def test_unsupported_op_is_loud(self):
+        @tf.function
+        def f(x):
+            return tf.linalg.svd(x)[0]
+
+        gd = f.get_concrete_function(
+            tf.TensorSpec((3, 3), tf.float32)).graph.as_graph_def()
+        with pytest.raises(TFImportException, match="unsupported TF op"):
+            importFrozenTF(gd.SerializeToString())
+
+    def test_unknown_placeholder_dims_need_shapes(self):
+        @tf.function
+        def f(x):
+            return tf.nn.relu(x)
+
+        gd = f.get_concrete_function(
+            tf.TensorSpec((None, 4), tf.float32)).graph.as_graph_def()
+        with pytest.raises(TFImportException, match="inputShapes"):
+            importFrozenTF(gd.SerializeToString())
+        name = _placeholder_name(gd)
+        sd = importFrozenTF(gd.SerializeToString(),
+                            inputShapes={name: (2, 4)})
+        x = np.random.RandomState(4).rand(2, 4).astype("float32")
+        out = TFGraphMapper.outputVariable(sd, _last_name(gd))
+        res = np.asarray(out.eval({name: x}).jax())
+        np.testing.assert_allclose(res, np.maximum(x, 0))
