@@ -17,6 +17,12 @@ from deeplearning4j_tpu.data.iterators import (
     EmnistDataSetIterator, Cifar10DataSetIterator,
     CifarDataSetIterator, RandomDataSetIterator,
 )
+from deeplearning4j_tpu.data.transform import (
+    Join, executeJoin, Reducer, ReduceOp, ConditionFilter, ConditionOp,
+    ColumnCondition, DoubleColumnCondition, IntegerColumnCondition,
+    CategoricalColumnCondition, StringColumnCondition, DataAnalysis,
+    analyze,
+)
 from deeplearning4j_tpu.data.records import (
     RecordReader, CSVRecordReader, CollectionRecordReader, ImageRecordReader,
     Schema, TransformProcess, RecordReaderDataSetIterator,
@@ -34,5 +40,9 @@ __all__ = [
     "RecordReader", "CSVRecordReader", "CollectionRecordReader",
     "ImageRecordReader", "Schema", "TransformProcess",
     "RecordReaderDataSetIterator", "CSVSequenceRecordReader",
-    "SequenceRecordReaderDataSetIterator",
+    "SequenceRecordReaderDataSetIterator", "Join", "executeJoin",
+    "Reducer", "ReduceOp", "ConditionFilter", "ConditionOp",
+    "ColumnCondition", "DoubleColumnCondition", "IntegerColumnCondition",
+    "CategoricalColumnCondition", "StringColumnCondition",
+    "DataAnalysis", "analyze",
 ]

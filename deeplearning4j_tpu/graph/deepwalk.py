@@ -72,29 +72,60 @@ class DeepWalk:
             self._kw["seed"] = int(s)
             return self
 
+        def returnParam(self, p):
+            """node2vec p: smaller -> walks revisit the previous vertex
+            more (reference: upstream's weighted/biased walk support;
+            parameterisation per Grover & Leskovec 2016)."""
+            self._kw["returnParam"] = float(p)
+            return self
+
+        def inOutParam(self, q):
+            """node2vec q: q>1 keeps walks local (BFS-like, community
+            structure); q<1 pushes outward (DFS-like)."""
+            self._kw["inOutParam"] = float(q)
+            return self
+
         def build(self):
             return DeepWalk(**self._kw)
 
     def __init__(self, windowSize=5, vectorSize=100, learningRate=0.025,
-                 seed=42):
+                 seed=42, returnParam=1.0, inOutParam=1.0):
         self.windowSize = windowSize
         self.vectorSize = vectorSize
         self.learningRate = learningRate
         self.seed = seed
+        if returnParam <= 0 or inOutParam <= 0:
+            raise ValueError("returnParam/inOutParam must be > 0")
+        self.returnParam = float(returnParam)
+        self.inOutParam = float(inOutParam)
         self._w2v = None
 
     def _walks(self, graph, walkLength, walksPerVertex, rng):
         walks = []
         n = graph.numVertices()
+        p, q = self.returnParam, self.inOutParam
+        biased = (p != 1.0 or q != 1.0)
+        adj_sets = [set(a) for a in graph._adj] if biased else None
         for _ in range(walksPerVertex):
             for start in rng.permutation(n):
                 v = int(start)
+                prev = None
                 walk = [v]
                 for _ in range(walkLength - 1):
                     nbrs = graph._adj[v]
                     if not nbrs:
                         break  # dead end: truncate like upstream
-                    v = int(nbrs[rng.randint(len(nbrs))])
+                    if not biased or prev is None:
+                        nxt = int(nbrs[rng.randint(len(nbrs))])
+                    else:
+                        # node2vec second-order transition: 1/p to return,
+                        # 1 to a mutual neighbour of prev, 1/q outward
+                        w = np.array(
+                            [1.0 / p if x == prev
+                             else (1.0 if x in adj_sets[prev] else 1.0 / q)
+                             for x in nbrs])
+                        nxt = int(nbrs[rng.choice(len(nbrs), p=w / w.sum())])
+                    prev, v = v, nxt
                     walk.append(v)
                 walks.append(" ".join(map(str, walk)))
         return walks

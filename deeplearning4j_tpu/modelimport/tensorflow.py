@@ -211,7 +211,7 @@ class TFGraphMapper:
                     shape = tuple(int(x) for x in inputShapes[node.name])
                 else:
                     a = _attr(node, "shape")
-                    if a is not None:
+                    if a is not None and not a.shape.unknown_rank:
                         shape = tuple(d.size for d in a.shape.dim)
                 if shape is None or any(s < 0 for s in shape):
                     raise TFImportException(
@@ -247,13 +247,16 @@ class TFGraphMapper:
                 _require_nhwc(node)
                 x, w = get(ins[0]), get(ins[1])
                 s = _hw(_require_attr(node, "strides"))
+                dil_a = _attr(node, "dilations")
+                d = _hw(dil_a) if dil_a is not None else (1, 1)
                 kh, kw, cin, mult = shape_of(w)
-                pad = _conv_padding(node, shape_of(x), (kh, kw), s)
+                pad = _conv_padding(node, shape_of(x), (kh, kw), s, d)
                 # TF stores (kh,kw,Cin,mult); grouped-conv layout is
                 # (kh,kw,1,Cin*mult) with groups=Cin
                 wg = emit("reshape", [w], {"shape": [kh, kw, 1, cin * mult]})
                 vars_[node.name] = emit("conv2d", [x, wg], {
-                    "stride": s, "padding": pad, "groups": int(cin)})
+                    "stride": s, "padding": pad, "dilation": d,
+                    "groups": int(cin)})
                 continue
             if op == "BiasAdd":
                 _require_nhwc(node)

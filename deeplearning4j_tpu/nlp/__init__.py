@@ -1,10 +1,18 @@
-"""NLP: word embeddings (reference: deeplearning4j-nlp Word2Vec /
-ParagraphVectors + tokenizers). Compute path is one jitted SGNS step."""
+"""NLP: word/doc embeddings and text vectorizers (reference:
+deeplearning4j-nlp Word2Vec [skip-gram + CBOW] / ParagraphVectors /
+Glove / BagOfWordsVectorizer / TfidfVectorizer + tokenizers). Compute
+paths are single jitted steps (SGNS, CBOW, GloVe-AdaGrad)."""
 
 from deeplearning4j_tpu.nlp.word2vec import (
     Word2Vec, ParagraphVectors, DefaultTokenizerFactory,
     CollectionSentenceIterator, LineSentenceIterator,
 )
+from deeplearning4j_tpu.nlp.glove import Glove
+from deeplearning4j_tpu.nlp.vectorizers import (
+    BagOfWordsVectorizer, TfidfVectorizer, LabelAwareCollectionIterator,
+)
 
 __all__ = ["Word2Vec", "ParagraphVectors", "DefaultTokenizerFactory",
-           "CollectionSentenceIterator", "LineSentenceIterator"]
+           "CollectionSentenceIterator", "LineSentenceIterator", "Glove",
+           "BagOfWordsVectorizer", "TfidfVectorizer",
+           "LabelAwareCollectionIterator"]

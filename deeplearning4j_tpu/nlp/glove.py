@@ -1,0 +1,123 @@
+"""GloVe — global co-occurrence factorization.
+
+Reference: deeplearning4j-nlp org.deeplearning4j.models.glove.Glove
+(Builder: minWordFrequency/layerSize/windowSize/xMax/alpha/learningRate/
+epochs; trains with AdaGrad over co-occurrence pairs, per Pennington et
+al. 2014). TPU-native design: the co-occurrence table is built host-side
+once (sparse dict over sentence windows, symmetric, 1/distance
+weighting), then training is ONE jitted AdaGrad step over minibatches of
+(i, j, log X_ij, f(X_ij)) quadruples — two embedding gathers, a weighted
+squared error, scatter-add gradients via autodiff, donated buffers.
+Word vectors are W + W̃ (the paper's sum), exposed through the same
+query API as Word2Vec.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+
+class Glove(Word2Vec):
+    class Builder(Word2Vec.Builder):
+        def xMax(self, x):
+            self._kw["xMax"] = float(x)
+            return self
+
+        def alpha(self, a):
+            self._kw["alpha"] = float(a)
+            return self
+
+        def epochs(self, n):  # upstream Glove.Builder calls it epochs
+            self._kw["iterations"] = int(n)
+            return self
+
+        def build(self):
+            return Glove(**self._kw)
+
+    def __init__(self, xMax=100.0, alpha=0.75, learningRate=0.05,
+                 batchSize=4096, **kw):
+        kw.setdefault("negative", 0)  # unused; GloVe has no neg sampling
+        super().__init__(learningRate=learningRate, batchSize=batchSize, **kw)
+        self.xMax = float(xMax)
+        self.alpha = float(alpha)
+
+    # ------------- co-occurrence accumulation (host side, once) -------
+    def _cooccurrences(self):
+        self._scan_vocab()
+        X = defaultdict(float)
+        for toks in self._sents:
+            ids = [self.vocab[t] for t in toks if t in self.vocab]
+            for i, ci in enumerate(ids):
+                hi = min(len(ids), i + self.windowSize + 1)
+                for j in range(i + 1, hi):
+                    w = 1.0 / (j - i)  # the paper's 1/distance weighting
+                    X[(ci, ids[j])] += w
+                    X[(ids[j], ci)] += w
+        if not X:
+            raise ValueError("no co-occurrences (sentences too short?)")
+        keys = np.asarray(list(X.keys()), "int32")
+        vals = np.asarray(list(X.values()), "float32")
+        return keys[:, 0], keys[:, 1], vals
+
+    # ------------- training ------------------------------------------
+    def fit(self):
+        ii, jj, xx = self._cooccurrences()
+        logx = np.log(xx)
+        fx = np.minimum((xx / self.xMax) ** self.alpha, 1.0).astype("float32")
+        V, D = len(self.vocab), self.layerSize
+        k0, shuf_k = jax.random.split(jax.random.key(self.seed))
+        ks = jax.random.split(k0, 4)
+        scale = 0.5 / D
+        params = {
+            "W": jax.random.uniform(ks[0], (V, D), jnp.float32,
+                                    -scale, scale),
+            "Wt": jax.random.uniform(ks[1], (V, D), jnp.float32,
+                                     -scale, scale),
+            "b": jnp.zeros(V, jnp.float32),
+            "bt": jnp.zeros(V, jnp.float32),
+        }
+        # AdaGrad accumulators start at 1.0 (upstream
+        # legacy.AdaGradUpdater-style epsilon-free form from the GloVe
+        # reference implementation)
+        acc = jax.tree_util.tree_map(jnp.ones_like, params)
+        lr = self.learningRate
+
+        def step(params, acc, i, j, t, f):
+            def loss_fn(p):
+                err = (jnp.sum(p["W"][i] * p["Wt"][j], -1)
+                       + p["b"][i] + p["bt"][j] - t)
+                return jnp.mean(f * err * err)
+
+            loss, g = jax.value_and_grad(loss_fn)(params)
+            acc = jax.tree_util.tree_map(lambda a, gg: a + gg * gg, acc, g)
+            params = jax.tree_util.tree_map(
+                lambda p, gg, a: p - lr * gg * jax.lax.rsqrt(a), params, g,
+                acc)
+            return params, acc, loss
+
+        jstep = jax.jit(step, donate_argnums=(0, 1))
+        n = ii.shape[0]
+        B = min(self.batchSize, n)
+        loss = jnp.float32(0)
+        for epoch in range(self.iterations):
+            perm = np.asarray(jax.random.permutation(
+                jax.random.fold_in(shuf_k, epoch), n))
+            ie, je, te, fe = ii[perm], jj[perm], logx[perm], fx[perm]
+            for s in range(0, n, B):
+                params, acc, loss = jstep(
+                    params, acc, jnp.asarray(ie[s:s + B]),
+                    jnp.asarray(je[s:s + B]), jnp.asarray(te[s:s + B]),
+                    jnp.asarray(fe[s:s + B]))
+        # the paper's final vectors: W + W̃; keep W̃ as _C so the
+        # inherited save/load roundtrips both tables
+        self._W = params["W"] + params["Wt"]
+        self._C = params["Wt"]
+        self._score = float(loss)
+        return self

@@ -109,12 +109,28 @@ class Word2Vec:
             self._kw["tokenizer"] = tf
             return self
 
+        def elementsLearningAlgorithm(self, algorithm):
+            """"skipgram" (default) or "cbow" (reference: Word2Vec.Builder
+            .elementsLearningAlgorithm(new SkipGram<>()/new CBOW<>()))."""
+            name = algorithm if isinstance(algorithm, str) \
+                else type(algorithm).__name__
+            self._kw["elementsLearningAlgorithm"] = name
+            return self
+
         def build(self):
             return Word2Vec(**self._kw)
 
     def __init__(self, iterator=None, tokenizer=None, minWordFrequency=5,
                  layerSize=100, windowSize=5, negative=5, seed=42,
-                 iterations=1, learningRate=0.025, batchSize=1024):
+                 iterations=1, learningRate=0.025, batchSize=1024,
+                 elementsLearningAlgorithm="skipgram"):
+        alg = str(elementsLearningAlgorithm).lower()
+        alg = alg.split("<")[0]  # tolerate upstream's "CBOW<VocabWord>"
+        if alg not in ("skipgram", "cbow"):
+            raise ValueError(
+                f"unknown elementsLearningAlgorithm {elementsLearningAlgorithm!r}"
+                " (use 'skipgram' or 'cbow')")
+        self.algorithm = alg
         self.iterator = iterator
         self.tokenizer = tokenizer or DefaultTokenizerFactory()
         self.minWordFrequency = minWordFrequency
@@ -133,7 +149,7 @@ class Word2Vec:
         self._doc_trained = None   # ParagraphVectors: bool per doc
 
     # ---------------- vocab + pair extraction (host side, once) --------
-    def _scan(self):
+    def _scan_vocab(self):
         counts = Counter()
         sents = []
         self.iterator.reset()
@@ -153,8 +169,12 @@ class Word2Vec:
         self._ivocab = vocab_words
         f = np.array([counts[w] for w in vocab_words], "float64") ** 0.75
         self._freq = (f / f.sum()).astype("float32")
+
+    def _scan(self):
+        """Vocab scan + skip-gram (center, context) pair extraction."""
+        self._scan_vocab()
         centers, contexts = [], []
-        for toks in sents:
+        for toks in self._sents:
             ids = [self.vocab[t] for t in toks if t in self.vocab]
             for i, c in enumerate(ids):
                 lo = max(0, i - self.windowSize)
@@ -167,8 +187,81 @@ class Word2Vec:
             raise ValueError("no training pairs (sentences too short?)")
         return (np.asarray(centers, "int32"), np.asarray(contexts, "int32"))
 
+    def _cbow_examples(self):
+        """Vocab scan + CBOW examples: (center [N], context [N, 2w]
+        0-padded, mask [N, 2w]) — fixed-width rows so the whole epoch is
+        one jittable shape (XLA: no ragged batches)."""
+        self._scan_vocab()
+        width = 2 * self.windowSize
+        centers, ctxs, masks = [], [], []
+        for toks in self._sents:
+            ids = [self.vocab[t] for t in toks if t in self.vocab]
+            for i, c in enumerate(ids):
+                lo = max(0, i - self.windowSize)
+                hi = min(len(ids), i + self.windowSize + 1)
+                win = [ids[j] for j in range(lo, hi) if j != i]
+                if not win:
+                    continue
+                centers.append(c)
+                ctxs.append(win + [0] * (width - len(win)))
+                masks.append([1.0] * len(win) + [0.0] * (width - len(win)))
+        if not centers:
+            raise ValueError("no training pairs (sentences too short?)")
+        return (np.asarray(centers, "int32"), np.asarray(ctxs, "int32"),
+                np.asarray(masks, "float32"))
+
     # ---------------- training -------------------------------------
     def fit(self):
+        if self.algorithm == "cbow":
+            return self._fit_cbow()
+        return self._fit_skipgram()
+
+    def _fit_cbow(self):
+        """CBOW with negative sampling (reference: embeddings.learning.
+        impl.elements.CBOW): the MASKED MEAN of the window's input
+        vectors predicts the center word. Same table pair and negative
+        sampler as skip-gram; only the example shape differs."""
+        centers, ctxs, masks = self._cbow_examples()
+        V, D, K = len(self.vocab), self.layerSize, self.negative
+        rng = jax.random.key(self.seed)
+        init_k, shuf_k = jax.random.split(rng)
+        W = (jax.random.uniform(init_k, (V, D), jnp.float32) - 0.5) / D
+        C = jnp.zeros((V, D), jnp.float32)
+        freq = jnp.asarray(self._freq)
+        lr = self.learningRate
+
+        def step(W, C, ctr, ctx, msk, key):
+            neg = jax.random.choice(key, V, (ctr.shape[0], K), p=freq)
+
+            def loss_fn(W, C):
+                h = jnp.sum(W[ctx] * msk[..., None], 1) \
+                    / jnp.sum(msk, 1, keepdims=True)   # [B, D] masked mean
+                pos = jnp.sum(h * C[ctr], -1)
+                negs = jnp.einsum("bd,bkd->bk", h, C[neg])
+                return -(jnp.mean(jax.nn.log_sigmoid(pos)) +
+                         jnp.mean(jnp.sum(jax.nn.log_sigmoid(-negs), -1)))
+
+            loss, (gW, gC) = jax.value_and_grad(loss_fn, argnums=(0, 1))(W, C)
+            return W - lr * gW, C - lr * gC, loss
+
+        jstep = jax.jit(step, donate_argnums=(0, 1))
+        n = centers.shape[0]
+        B = min(self.batchSize, n)
+        loss = jnp.float32(0)
+        for epoch in range(self.iterations):
+            perm = np.asarray(jax.random.permutation(
+                jax.random.fold_in(shuf_k, epoch), n))
+            ctr_e, ctx_e, msk_e = centers[perm], ctxs[perm], masks[perm]
+            for s in range(0, n, B):
+                key = jax.random.fold_in(rng, epoch * 100003 + s)
+                W, C, loss = jstep(W, C, jnp.asarray(ctr_e[s:s + B]),
+                                   jnp.asarray(ctx_e[s:s + B]),
+                                   jnp.asarray(msk_e[s:s + B]), key)
+        self._W, self._C = W, C
+        self._score = float(loss)
+        return self
+
+    def _fit_skipgram(self):
         centers, contexts = self._scan()
         V, D, K = len(self.vocab), self.layerSize, self.negative
         rng = jax.random.key(self.seed)

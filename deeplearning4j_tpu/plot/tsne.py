@@ -5,11 +5,25 @@ perplexity / theta / learningRate; fit(INDArray) then getData()) — the
 standard companion to Word2Vec for embedding plots. Upstream uses the
 Barnes-Hut quad-tree approximation because exact t-SNE is O(N^2) on a
 JVM; on TPU the O(N^2) pairwise kernels ARE the fast path (dense
-matmul-shaped work on the MXU), so this implementation is exact and
-`theta` is accepted for API parity but unused. Per-point bandwidths are
-binary-searched for the target perplexity once on the host; the
-gradient loop (early exaggeration + momentum, van der Maaten 2008) runs
-as a single jitted lax.fori_loop.
+matmul-shaped work on the MXU). Two methods:
+
+- "exact": dense-P, whole-matrix gradient — the oracle. O(N^2) MEMORY,
+  so it caps out around N~10-20k.
+- "tiled": the same mathematics with bounded memory — P is kNN-sparse
+  (k = 3*perplexity, the standard t-SNE sparsification; k = N-1
+  reproduces exact-P bit-for-bit), the attractive force is a
+  segment-sum over P's edges, and the repulsive force + Q normalizer
+  stream over [tile, N] row blocks (each block a matmul on the MXU).
+  No Barnes-Hut approximation error — upstream's quad-tree exists
+  because a JVM can't afford the pairwise pass at all; a TPU can, it
+  just must not MATERIALISE it.
+
+method="auto" (default) picks exact below 4096 points, tiled above.
+`theta` is accepted for API parity but unused (tiled replaces BH as the
+large-N strategy). Per-point bandwidths are binary-searched for the
+target perplexity once on the host; the gradient loop (early
+exaggeration + momentum, van der Maaten 2008) runs as a single jitted
+lax.fori_loop either way.
 """
 
 from __future__ import annotations
@@ -51,6 +65,56 @@ def _p_conditional(X, perplexity, tol=1e-5, max_tries=50):
     return np.maximum(P, 1e-12)
 
 
+def _p_sparse(X, perplexity, k, block=2048, tol=1e-5, max_tries=50):
+    """kNN-sparse symmetrized P as COO (rows, cols, vals). The neighbour
+    search streams [block, N] distance tiles; the bandwidth binary
+    search runs vectorised over all rows at once."""
+    X = np.asarray(X, np.float64)
+    n = X.shape[0]
+    k = min(int(k), n - 1)
+    sq = np.sum(X ** 2, 1)
+    idx = np.empty((n, k), np.int64)
+    Dk = np.empty((n, k), np.float64)
+    for s in range(0, n, block):
+        e = min(n, s + block)
+        d = np.maximum(sq[s:e, None] + sq[None, :] - 2.0 * (X[s:e] @ X.T),
+                       0.0)
+        d[np.arange(e - s), np.arange(s, e)] = np.inf  # exclude self
+        part = np.argpartition(d, k - 1, axis=1)[:, :k]
+        idx[s:e] = part
+        Dk[s:e] = np.take_along_axis(d, part, axis=1)
+    target = np.log(perplexity)
+    lo = np.full(n, -np.inf)
+    hi = np.full(n, np.inf)
+    beta = np.ones(n)
+    for _ in range(max_tries):
+        expD = np.exp(-Dk * beta[:, None])
+        sumP = np.maximum(expD.sum(1), 1e-12)
+        H = np.log(sumP) + beta * np.sum(Dk * expD, 1) / sumP
+        if np.all(np.abs(H - target) < tol):
+            break
+        gt = H > target
+        lo = np.where(gt, beta, lo)
+        hi = np.where(gt, hi, beta)
+        beta = np.where(
+            gt, np.where(np.isinf(hi), beta * 2.0, (beta + hi) / 2.0),
+            np.where(np.isinf(lo), beta / 2.0, (beta + lo) / 2.0))
+    rowsP = np.exp(-Dk * beta[:, None])
+    rowsP /= np.maximum(rowsP.sum(1, keepdims=True), 1e-12)
+    # symmetrize the sparse conditional: P = (P + P^T) / 2n, summing
+    # duplicate (i,j) entries via unique codes
+    i0 = np.repeat(np.arange(n), k)
+    j0 = idx.ravel()
+    v0 = rowsP.ravel() / (2.0 * n)
+    codes = np.concatenate([i0 * n + j0, j0 * n + i0])
+    vals = np.concatenate([v0, v0])
+    uniq, inv = np.unique(codes, return_inverse=True)
+    acc = np.zeros(len(uniq))
+    np.add.at(acc, inv, vals)
+    return (uniq // n).astype(np.int32), (uniq % n).astype(np.int32), \
+        np.maximum(acc, 1e-12).astype(np.float32)
+
+
 class BarnesHutTsne:
     """Builder-constructed t-SNE (reference: BarnesHutTsne.Builder)."""
 
@@ -60,6 +124,22 @@ class BarnesHutTsne:
 
         def setMaxIter(self, n):
             self._kw["maxIter"] = int(n)
+            return self
+
+        def method(self, m):
+            """"exact" | "tiled" | "auto" (this framework's replacement
+            knob for upstream's theta; see module docstring)."""
+            self._kw["method"] = str(m)
+            return self
+
+        def tileSize(self, b):
+            self._kw["tileSize"] = int(b)
+            return self
+
+        def knnK(self, k):
+            """Sparse-P neighbour count for tiled mode (default
+            3*perplexity; k=N-1 makes tiled P identical to exact P)."""
+            self._kw["knnK"] = int(k)
             return self
 
         def perplexity(self, p):
@@ -86,14 +166,21 @@ class BarnesHutTsne:
             return BarnesHutTsne(**self._kw)
 
     def __init__(self, maxIter=1000, perplexity=30.0, theta=0.5,
-                 learningRate=200.0, numDimensions=2, seed=42):
+                 learningRate=200.0, numDimensions=2, seed=42,
+                 method="auto", tileSize=1024, knnK=None):
         self.maxIter = maxIter
         self.perplexity = perplexity
         self.theta = theta
         self.learningRate = learningRate
         self.numDimensions = numDimensions
         self.seed = seed
+        if method not in ("auto", "exact", "tiled"):
+            raise ValueError(f"method must be auto/exact/tiled, got {method!r}")
+        self.method = method
+        self.tileSize = int(tileSize)
+        self.knnK = knnK
         self._Y = None
+        self.usedMethod = None
 
     def fit(self, X):
         X = np.asarray(getattr(X, "toNumpy", lambda: X)())
@@ -102,6 +189,12 @@ class BarnesHutTsne:
             raise ValueError(
                 f"perplexity {self.perplexity} too large for {n} points "
                 f"(needs n > 3*perplexity)")
+        m = self.method
+        if m == "auto":
+            m = "exact" if n <= 4096 else "tiled"
+        self.usedMethod = m
+        if m == "tiled":
+            return self._fit_tiled(X, n)
         P = jnp.asarray(_p_conditional(X, self.perplexity), jnp.float32)
         key = jax.random.key(self.seed)
         Y0 = 1e-4 * jax.random.normal(key, (n, self.numDimensions),
@@ -131,6 +224,73 @@ class BarnesHutTsne:
         Y, _ = jax.jit(lambda y0: jax.lax.fori_loop(
             0, self.maxIter, body, (y0, jnp.zeros_like(y0))))(Y0)
         self._Y = np.asarray(Y)
+        return self
+
+    def _fit_tiled(self, X, n):
+        """Block-pairwise gradient: O(tile * N) peak memory instead of
+        O(N^2). Same objective, same update rule as the exact path."""
+        k = self.knnK if self.knnK is not None \
+            else int(round(3 * self.perplexity))
+        rows, cols, pvals0 = _p_sparse(X, self.perplexity, k)
+        B = min(self.tileSize, n)
+        n_pad = -(-n // B) * B
+        nblk = n_pad // B
+        d = self.numDimensions
+        rows_j = jnp.asarray(rows)
+        cols_j = jnp.asarray(cols)
+        pvals = jnp.asarray(pvals0)
+        key = jax.random.key(self.seed)
+        Y0 = 1e-4 * jax.random.normal(key, (n, d), jnp.float32)
+        Y0 = jnp.concatenate(
+            [Y0, jnp.zeros((n_pad - n, d), jnp.float32)], 0)
+        lr = self.learningRate
+        exag_iters = min(100, self.maxIter // 4)
+        valid = jnp.arange(n_pad) < n
+        col_ids = jnp.arange(n_pad)
+
+        def grad(Y, pv):
+            dt = Y.dtype
+            # attractive force: a segment-sum over P's (i, j) edges
+            diff = Y[rows_j] - Y[cols_j]
+            num_e = 1.0 / (1.0 + jnp.sum(diff * diff, 1))
+            attr = jax.ops.segment_sum((pv * num_e)[:, None] * diff,
+                                       rows_j, num_segments=n_pad)
+            # repulsive force + Q normalizer, streamed over row blocks
+            sqY = jnp.sum(Y * Y, 1)
+
+            def blk(s, carry):
+                S, rep = carry
+                yb = jax.lax.dynamic_slice(Y, (s * B, 0), (B, d))
+                rid = s * B + jnp.arange(B)
+                d2 = jnp.maximum(
+                    sqY[rid][:, None] + sqY[None, :] - 2.0 * yb @ Y.T, 0.0)
+                num = 1.0 / (1.0 + d2)
+                mask = (valid[None, :] & valid[rid][:, None]
+                        & (rid[:, None] != col_ids[None, :]))
+                num = jnp.where(mask, num, 0.0).astype(dt)
+                n2 = num * num
+                repb = jnp.sum(n2, 1)[:, None] * yb - n2 @ Y
+                return (S + jnp.sum(num),
+                        jax.lax.dynamic_update_slice(rep, repb, (s * B, 0)))
+
+            S, rep = jax.lax.fori_loop(
+                0, nblk, blk, (jnp.zeros((), dt), jnp.zeros_like(Y)))
+            return (4.0 * (attr - rep / jnp.maximum(S, 1e-12))).astype(dt)
+
+        def body(i, carry):
+            Y, V = carry
+            pv = jnp.where(i < exag_iters, pvals * 12.0, pvals)
+            g = grad(Y, pv)
+            mom = jnp.where(i < exag_iters, 0.5, 0.8).astype(Y.dtype)
+            V = mom * V - lr * g
+            Y = Y + V
+            # centre over REAL rows only; keep padding rows pinned at 0
+            mean = jnp.sum(Y * valid[:, None], 0, keepdims=True) / n
+            return jnp.where(valid[:, None], Y - mean, 0.0), V
+
+        Y, _ = jax.jit(lambda y0: jax.lax.fori_loop(
+            0, self.maxIter, body, (y0, jnp.zeros_like(y0))))(Y0)
+        self._Y = np.asarray(Y[:n])
         return self
 
     def getData(self):

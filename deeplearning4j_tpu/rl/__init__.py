@@ -1,8 +1,15 @@
 """Reinforcement learning (reference: the rl4j sub-project of the
-deeplearning4j monorepo — org.deeplearning4j.rl4j). The Q-network is a
-regular MultiLayerNetwork whose jitted fit() consumes TD targets."""
+deeplearning4j monorepo — org.deeplearning4j.rl4j): DQN (dense + conv
+with frame stacking) and advantage actor-critic. Q-networks are regular
+MultiLayerNetworks whose jitted fit() consumes TD targets; A3C keeps its
+actor-critic pytree on-device with vectorized environments."""
 
 from deeplearning4j_tpu.rl.qlearning import (MDP, QLearningConfiguration,
                                              QLearningDiscreteDense)
+from deeplearning4j_tpu.rl.conv import (HistoryProcessorConfiguration,
+                                        QLearningDiscreteConv)
+from deeplearning4j_tpu.rl.a3c import A3CConfiguration, A3CDiscreteDense
 
-__all__ = ["MDP", "QLearningConfiguration", "QLearningDiscreteDense"]
+__all__ = ["MDP", "QLearningConfiguration", "QLearningDiscreteDense",
+           "HistoryProcessorConfiguration", "QLearningDiscreteConv",
+           "A3CConfiguration", "A3CDiscreteDense"]

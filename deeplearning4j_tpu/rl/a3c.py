@@ -1,0 +1,186 @@
+"""Advantage actor-critic for discrete action spaces.
+
+Reference: rl4j org.deeplearning4j.rl4j.learning.async.a3c.discrete
+.A3CDiscreteDense with A3CLearningConfiguration (numThreads, nStep,
+gamma, learningRate) over the same MDP protocol as DQN. Upstream runs
+`numThreads` async JVM workers that Hogwild-update a shared net — the
+asynchrony exists to DECORRELATE samples on CPU clusters. The TPU-native
+equivalent keeps the exact same objective (n-step advantage policy
+gradient + value regression + entropy bonus, Mnih et al. 2016) but gets
+its decorrelation from `numThreads` vectorized environments stepped in
+lockstep: acting is ONE jitted forward over the env batch per step, and
+the update is ONE jitted fused step over the whole n-step rollout —
+no host-side weight races, bit-reproducible, and the batched matmuls
+land on the MXU where Hogwild's per-thread rank-1 updates cannot.
+
+The actor-critic net is a shared dense trunk with policy and value heads
+(reference: ActorCriticFactoryCompoundStdDense); params live in a pytree
+driven by the framework's own nn.updaters.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import updaters as _upd
+from deeplearning4j_tpu.rl.qlearning import BasePolicy
+
+
+class A3CConfiguration:
+    """Reference: A3CLearningConfiguration fields that shape the
+    algorithm."""
+
+    def __init__(self, seed=123, gamma=0.99, nStep=8, numThreads=8,
+                 learningRate=1e-3, entropyCoef=0.01, valueCoef=0.5,
+                 maxEpochStep=200):
+        self.seed = int(seed)
+        self.gamma = float(gamma)
+        self.nStep = int(nStep)
+        self.numThreads = int(numThreads)
+        self.learningRate = float(learningRate)
+        self.entropyCoef = float(entropyCoef)
+        self.valueCoef = float(valueCoef)
+        self.maxEpochStep = int(maxEpochStep)
+
+
+class A3CDiscreteDense:
+    """Actor-critic trainer (reference: A3CDiscreteDense).
+
+    `mdpFactory`: zero-arg callable returning a fresh MDP (upstream:
+    MDP.newInstance() gives each worker its own copy). `hiddenSize`
+    sizes the shared trunk (reference default factory: one dense layer).
+    """
+
+    def __init__(self, mdpFactory, config=None, hiddenSize=32):
+        self.conf = config or A3CConfiguration()
+        c = self.conf
+        self._envs = [mdpFactory() for _ in range(c.numThreads)]
+        mdp = self._envs[0]
+        self.obsSize = mdp.obsSize()
+        self.numActions = mdp.numActions()
+        H = int(hiddenSize)
+        k = jax.random.split(jax.random.key(c.seed), 3)
+        s1 = 1.0 / np.sqrt(self.obsSize)
+        s2 = 1.0 / np.sqrt(H)
+        self.params = {
+            "W1": jax.random.uniform(k[0], (self.obsSize, H), jnp.float32,
+                                     -s1, s1),
+            "b1": jnp.zeros(H, jnp.float32),
+            "Wp": jax.random.uniform(k[1], (H, self.numActions), jnp.float32,
+                                     -s2, s2),
+            "bp": jnp.zeros(self.numActions, jnp.float32),
+            "Wv": jax.random.uniform(k[2], (H, 1), jnp.float32, -s2, s2),
+            "bv": jnp.zeros(1, jnp.float32),
+        }
+        self._updater = _upd.Adam(c.learningRate)
+        self._upd_state = self._updater.init(self.params)
+        self._iteration = 0
+        self._rng = np.random.RandomState(c.seed)
+        self._step = 0
+        self._policy_losses = []
+        self._value_losses = []
+
+        def forward(p, x):
+            h = jnp.tanh(x @ p["W1"] + p["b1"])
+            logits = h @ p["Wp"] + p["bp"]
+            value = (h @ p["Wv"] + p["bv"])[:, 0]
+            return logits, value
+
+        self._jit_forward = jax.jit(forward)
+
+        def update(p, us, it, obs, acts, returns):
+            def loss_fn(p):
+                logits, value = forward(p, obs)
+                logp = jax.nn.log_softmax(logits)
+                probs = jax.nn.softmax(logits)
+                adv = jax.lax.stop_gradient(returns - value)
+                pg = -jnp.mean(
+                    jnp.take_along_axis(logp, acts[:, None], 1)[:, 0] * adv)
+                v = jnp.mean((returns - value) ** 2)
+                ent = -jnp.mean(jnp.sum(probs * logp, -1))
+                c_ = self.conf
+                return pg + c_.valueCoef * v - c_.entropyCoef * ent, (pg, v)
+
+            (_, (pg, v)), g = jax.value_and_grad(
+                loss_fn, has_aux=True)(p)
+            upd, us = self._updater.apply(g, us, it)
+            p = jax.tree_util.tree_map(lambda a, u: a - u, p, upd)
+            return p, us, pg, v
+
+        self._jit_update = jax.jit(update, donate_argnums=(0, 1))
+
+    # ---------------- rollout collection ------------------------------
+    def _policy_probs(self, obs_batch):
+        logits, _ = self._jit_forward(self.params,
+                                      jnp.asarray(obs_batch, jnp.float32))
+        return np.asarray(jax.nn.softmax(logits))
+
+    def train(self, maxSteps=10_000):
+        c = self.conf
+        obs = np.stack([np.asarray(e.reset(), "float32")
+                        for e in self._envs])
+        ep_steps = np.zeros(len(self._envs), int)
+        while self._step < maxSteps:
+            O, A, R, D = [], [], [], []
+            for _ in range(c.nStep):
+                probs = self._policy_probs(obs)
+                acts = np.array([self._rng.choice(self.numActions, p=pr)
+                                 for pr in probs])
+                nxt = np.empty_like(obs)
+                rews = np.zeros(len(self._envs), "float32")
+                dones = np.zeros(len(self._envs), "float32")
+                for i, (env, a) in enumerate(zip(self._envs, acts)):
+                    o2, r, d = env.step(int(a))
+                    ep_steps[i] += 1
+                    if ep_steps[i] >= c.maxEpochStep:
+                        d = True
+                    rews[i], dones[i] = r, float(d)
+                    nxt[i] = np.asarray(o2 if not d else env.reset(),
+                                        "float32")
+                    if d:
+                        ep_steps[i] = 0
+                O.append(obs.copy())
+                A.append(acts)
+                R.append(rews)
+                D.append(dones)
+                obs = nxt
+                self._step += len(self._envs)
+            # n-step returns, bootstrapped from V(s_{t+n}) per env
+            _, v_boot = self._jit_forward(self.params,
+                                          jnp.asarray(obs, jnp.float32))
+            ret = np.asarray(v_boot)
+            returns = []
+            for t in reversed(range(c.nStep)):
+                ret = R[t] + c.gamma * ret * (1.0 - D[t])
+                returns.append(ret)
+            returns.reverse()
+            flat_obs = jnp.asarray(np.concatenate(O), jnp.float32)
+            flat_act = jnp.asarray(np.concatenate(A), jnp.int32)
+            flat_ret = jnp.asarray(np.concatenate(returns), jnp.float32)
+            self.params, self._upd_state, pg, v = self._jit_update(
+                self.params, self._upd_state,
+                jnp.asarray(self._iteration, jnp.int32),
+                flat_obs, flat_act, flat_ret)
+            self._iteration += 1
+            self._policy_losses.append(float(pg))
+            self._value_losses.append(float(v))
+        return self
+
+    # ---------------- policy ------------------------------------------
+    def getPolicy(self, greedy=True):
+        """Reference: policy.ACPolicy (greedy=False samples, matching
+        upstream's stochastic ACPolicy with an rng)."""
+        outer = self
+
+        class _Policy(BasePolicy):
+            def nextAction(self, obs):
+                probs = outer._policy_probs(
+                    np.asarray(obs, "float32")[None])[0]
+                if greedy:
+                    return int(np.argmax(probs))
+                return int(outer._rng.choice(outer.numActions, p=probs))
+
+        return _Policy()

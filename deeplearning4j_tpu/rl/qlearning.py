@@ -14,6 +14,29 @@ from __future__ import annotations
 import numpy as np
 
 
+class BasePolicy:
+    """Shared rollout semantics for all policies (reference: rl4j
+    policy.Policy.play). Subclasses implement nextAction(obs); policies
+    with episode state (frame rings) override onEpisodeStart()."""
+
+    def nextAction(self, obs):
+        raise NotImplementedError
+
+    def onEpisodeStart(self):
+        pass
+
+    def play(self, mdp, maxSteps=1000):
+        self.onEpisodeStart()
+        obs = mdp.reset()
+        total = 0.0
+        for _ in range(maxSteps):
+            obs, r, done = mdp.step(self.nextAction(obs))
+            total += r
+            if done:
+                break
+        return total
+
+
 class MDP:
     """Environment protocol (reference: rl4j.mdp.MDP): discrete actions,
     dense observations."""
@@ -91,8 +114,18 @@ class QLearningDiscreteDense:
     def _act(self, obs):
         if self._rng.rand() < self._epsilon():
             return int(self._rng.randint(self.mdp.numActions()))
-        q = self._q(self.net._params, obs[None, :].astype("float32"))
+        q = self._q(self.net._params, obs[None].astype("float32"))
         return int(np.argmax(q[0]))
+
+    # ---- environment hooks (QLearningDiscreteConv overrides these to
+    # maintain its frame stack; reference: rl4j's HistoryProcessor sits
+    # at exactly this boundary) ---------------------------------------
+    def _reset_env(self):
+        return np.asarray(self.mdp.reset(), "float32")
+
+    def _step_env(self, action):
+        obs2, reward, done = self.mdp.step(action)
+        return np.asarray(obs2, "float32"), reward, done
 
     def _learn_batch(self):
         c = self.conf
@@ -121,11 +154,10 @@ class QLearningDiscreteDense:
     def train(self, maxSteps=5000):
         c = self.conf
         while self._step < maxSteps:
-            obs = np.asarray(self.mdp.reset(), "float32")
+            obs = self._reset_env()
             for _ in range(c.maxEpochStep):
                 a = self._act(obs)
-                obs2, reward, done = self.mdp.step(a)
-                obs2 = np.asarray(obs2, "float32")
+                obs2, reward, done = self._step_env(a)
                 item = (obs, a, float(reward), obs2, float(done))
                 if len(self._replay) < c.expRepMaxSize:
                     self._replay.append(item)
@@ -148,20 +180,10 @@ class QLearningDiscreteDense:
         policy.DQNPolicy)."""
         net = self.net
 
-        class _Policy:
+        class _Policy(BasePolicy):
             def nextAction(self, obs):
                 q = net.output(
-                    np.asarray(obs, "float32")[None, :]).toNumpy()
+                    np.asarray(obs, "float32")[None]).toNumpy()
                 return int(np.argmax(q[0]))
-
-            def play(self, mdp, maxSteps=1000):
-                obs = mdp.reset()
-                total = 0.0
-                for _ in range(maxSteps):
-                    obs, r, done = mdp.step(self.nextAction(obs))
-                    total += r
-                    if done:
-                        break
-                return total
 
         return _Policy()

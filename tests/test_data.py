@@ -306,6 +306,139 @@ class TestReviewRegressions:
             tp.execute([["X"]])
 
 
+class TestTransformDSL:
+    """Joins, reducers, condition filters, DataAnalysis (reference:
+    datavec-api transform.join/reduce/condition/analysis) against pandas
+    oracles."""
+
+    def _schemas(self):
+        from deeplearning4j_tpu.data import Schema
+
+        left = (Schema.Builder().addColumnString("user")
+                .addColumnDouble("amount").build())
+        right = (Schema.Builder().addColumnString("user")
+                 .addColumnCategorical("tier", "gold", "basic").build())
+        lrecs = [["ann", 10.0], ["bob", 5.0], ["ann", 2.5], ["eve", 1.0]]
+        rrecs = [["ann", "gold"], ["bob", "basic"], ["zoe", "basic"]]
+        return left, right, lrecs, rrecs
+
+    def _pd_join(self, lrecs, rrecs, how):
+        import pandas as pd
+
+        ld = pd.DataFrame(lrecs, columns=["user", "amount"])
+        rd = pd.DataFrame(rrecs, columns=["user", "tier"])
+        return ld.merge(rd, on="user", how=how)
+
+    @pytest.mark.parametrize("jtype,how", [("Inner", "inner"),
+                                           ("LeftOuter", "left"),
+                                           ("RightOuter", "right"),
+                                           ("FullOuter", "outer")])
+    def test_join_matches_pandas(self, jtype, how):
+        from deeplearning4j_tpu.data import Join, executeJoin
+
+        left, right, lrecs, rrecs = self._schemas()
+        join = (Join.Builder(jtype).setJoinColumns("user")
+                .setSchemas(left, right).build())
+        schema, out = executeJoin(join, lrecs, rrecs)
+        assert schema.getColumnNames() == ["user", "amount", "tier"]
+        oracle = self._pd_join(lrecs, rrecs, how)
+        got = sorted((r[0], -1.0 if r[1] is None else r[1], r[2] or "")
+                     for r in out)
+        want = sorted((u, -1.0 if a != a else a, t if t == t else "")
+                      for u, a, t in oracle.itertuples(index=False))
+        assert got == want
+
+    def test_join_validates_columns(self):
+        from deeplearning4j_tpu.data import Join
+
+        left, right, _, _ = self._schemas()
+        with pytest.raises(ValueError, match="missing from right"):
+            (Join.Builder("Inner").setJoinColumns("amount")
+             .setSchemas(left, right).build())
+        with pytest.raises(ValueError, match="unknown join type"):
+            Join.Builder("CrossApply")
+
+    def test_reducer_matches_pandas_groupby(self):
+        import pandas as pd
+
+        from deeplearning4j_tpu.data import Reducer, ReduceOp, Schema
+
+        schema = (Schema.Builder().addColumnString("k")
+                  .addColumnDouble("x").addColumnDouble("y").build())
+        rng = np.random.RandomState(0)
+        recs = [[rng.choice(["a", "b", "c"]), float(rng.randn()),
+                 float(rng.randn())] for _ in range(50)]
+        red = (Reducer.Builder(ReduceOp.Mean).keyColumns("k")
+               .sumColumns("x").stdevColumns("y").build())
+        out_schema, out = red.execute(schema, recs)
+        assert out_schema.getColumnNames() == ["k", "sum(x)", "stdev(y)"]
+        df = pd.DataFrame(recs, columns=["k", "x", "y"])
+        g = df.groupby("k")
+        for k, sx, sy in out:
+            assert sx == pytest.approx(g["x"].sum()[k])
+            assert sy == pytest.approx(g["y"].std()[k])  # pandas = sample
+
+    def test_reducer_count_min_max_first_last(self):
+        from deeplearning4j_tpu.data import Reducer, ReduceOp, Schema
+
+        schema = (Schema.Builder().addColumnString("k")
+                  .addColumnDouble("v").addColumnString("tag").build())
+        recs = [["a", 3.0, "p"], ["a", 1.0, "q"], ["b", 7.0, "r"]]
+        red = (Reducer.Builder(ReduceOp.TakeLast).keyColumns("k")
+               .countColumns("v").build())
+        out_schema, out = red.execute(schema, recs)
+        assert out_schema.getColumnNames() == ["k", "count(v)", "tag"]
+        assert out == [["a", 2, "q"], ["b", 1, "r"]]
+        red2 = (Reducer.Builder(ReduceOp.Min).keyColumns("k")
+                .maxColumns("v").takeFirstColumns("tag").build())
+        _, out2 = red2.execute(schema, recs)
+        assert out2 == [["a", 3.0, "p"], ["b", 7.0, "r"]]
+        with pytest.raises(ValueError, match="key column"):
+            red.execute((Schema.Builder().addColumnDouble("z").build()),
+                        [[1.0]])
+
+    def test_condition_filter_in_transform_process(self):
+        from deeplearning4j_tpu.data import (ConditionFilter, ConditionOp,
+                                             DoubleColumnCondition,
+                                             CategoricalColumnCondition,
+                                             Schema, TransformProcess)
+
+        schema = (Schema.Builder().addColumnDouble("amount")
+                  .addColumnCategorical("tier", "gold", "basic").build())
+        recs = [[10.0, "gold"], [0.5, "basic"], [3.0, "basic"],
+                [0.1, "gold"]]
+        tp = (TransformProcess.Builder(schema)
+              .filter(ConditionFilter(DoubleColumnCondition(
+                  "amount", ConditionOp.LessThan, 1.0)))
+              .build())
+        assert tp.execute(recs) == [[10.0, "gold"], [3.0, "basic"]]
+        tp2 = (TransformProcess.Builder(schema)
+               .filter(ConditionFilter(CategoricalColumnCondition(
+                   "tier", ConditionOp.InSet, {"basic"})))
+               .build())
+        assert tp2.execute(recs) == [[10.0, "gold"], [0.1, "gold"]]
+        with pytest.raises(ValueError, match="ConditionOp"):
+            DoubleColumnCondition("amount", "Approximately", 1.0)
+
+    def test_data_analysis_summary(self):
+        from deeplearning4j_tpu.data import Schema, analyze
+
+        schema = (Schema.Builder().addColumnDouble("x")
+                  .addColumnCategorical("c", "u", "v").build())
+        recs = [[1.0, "u"], [-2.0, "v"], [0.0, "u"], [None, None]]
+        da = analyze(schema, recs)
+        ax = da.getColumnAnalysis("x")
+        assert ax.min == -2.0 and ax.max == 1.0
+        assert ax.mean == pytest.approx(-1 / 3)
+        assert ax.countMissing == 1 and ax.countZero == 1 \
+            and ax.countNegative == 1
+        ac = da.getColumnAnalysis("c")
+        assert ac.mapOfUniqueAndCounts == {"u": 2, "v": 1}
+        assert "'x' (double)" in repr(da)
+        with pytest.raises(ValueError, match="no analysis"):
+            da.getColumnAnalysis("nope")
+
+
 class TestSequenceRecords:
     """CSVSequenceRecordReader + SequenceRecordReaderDataSetIterator
     (reference: datavec sequence readers feeding recurrent nets)."""

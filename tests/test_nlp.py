@@ -205,3 +205,79 @@ class TestDeepWalk:
         dw = DeepWalk.Builder().windowSize(2).vectorSize(8).seed(1).build()
         dw.fit(g, walkLength=10, walksPerVertex=3, iterations=2)
         assert dw.getVertexVector(0).shape == (8,)
+
+
+class TestNode2VecBias:
+    """node2vec p/q-biased walks (reference: upstream's weighted/biased
+    walk support; Grover & Leskovec 2016 parameterisation). The bias must
+    change walk statistics in the documented direction, and biased
+    embeddings must still capture community structure."""
+
+    _two_cluster_graph = TestDeepWalk._two_cluster_graph
+
+    def _backtrack_fraction(self, p):
+        from deeplearning4j_tpu.graph import Graph, DeepWalk
+        import numpy as np
+
+        g = Graph(10)
+        for i in range(9):
+            g.addEdge(i, i + 1)  # path graph
+        dw = DeepWalk(returnParam=p, seed=3)
+        rng = np.random.RandomState(3)
+        walks = dw._walks(g, 30, 5, rng)
+        back = total = 0
+        for w in walks:
+            ids = [int(t) for t in w.split()]
+            for t in range(2, len(ids)):
+                total += 1
+                back += ids[t] == ids[t - 2]
+        return back / total
+
+    def test_small_p_backtracks_more(self):
+        lo = self._backtrack_fraction(0.05)
+        hi = self._backtrack_fraction(20.0)
+        assert lo > hi + 0.3, (lo, hi)
+
+    def _escape_fraction(self, q):
+        # barbell: fraction of walk steps that leave the start clique.
+        # q > 1 keeps walks local; q < 1 pushes them outward.
+        from deeplearning4j_tpu.graph import DeepWalk
+        import numpy as np
+
+        g = self._two_cluster_graph()
+        dw = DeepWalk(inOutParam=q, seed=5)
+        rng = np.random.RandomState(5)
+        walks = dw._walks(g, 12, 6, rng)
+        out = total = 0
+        for w in walks:
+            ids = [int(t) for t in w.split()]
+            if ids[0] >= 6:
+                continue  # start in cluster A only
+            total += 1
+            out += any(v >= 6 for v in ids)
+        return out / total
+
+    def test_large_q_stays_local(self):
+        local = self._escape_fraction(8.0)
+        explore = self._escape_fraction(0.125)
+        assert local < explore - 0.1, (local, explore)
+
+    def test_biased_embeddings_cluster(self):
+        from deeplearning4j_tpu.graph import DeepWalk
+
+        dw = (DeepWalk.Builder().windowSize(4).vectorSize(16)
+              .learningRate(0.5).seed(7).returnParam(2.0).inOutParam(4.0)
+              .build())
+        dw.fit(self._two_cluster_graph(), walkLength=20, walksPerVertex=8,
+               iterations=25)
+        intra = dw.similarity(0, 3)
+        inter = dw.similarity(0, 9)
+        assert intra > inter + 0.1, (intra, inter)
+
+    def test_invalid_params_rejected(self):
+        from deeplearning4j_tpu.graph import DeepWalk
+
+        with pytest.raises(ValueError, match="returnParam"):
+            DeepWalk(returnParam=0.0)
+        with pytest.raises(ValueError, match="returnParam"):
+            DeepWalk(inOutParam=-1.0)
