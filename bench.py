@@ -58,8 +58,27 @@ def bench_resnet50():
     """Measures the standard stem, then the space-to-depth stem (exact
     same function — MLPerf conv1 rewrite, parity-tested in
     tests/test_zoo.py::TestSpaceToDepthStem) and reports the faster of
-    the two as the headline configuration."""
+    the two as the headline configuration.
+
+    First runs the maxpool-backward A/B (seconds) and selects the faster
+    implementation for the headline: the argmax rewrite targets TPU's
+    select-and-scatter problem, but on backends where the stock path wins
+    (CPU does: its scatter rewrite vectorizes) the headline must not
+    carry a self-inflicted regression. Gradient parity between the two
+    is pinned by tests/test_pooling_backward.py either way."""
+    from deeplearning4j_tpu.ops import pooling as _pooling
+
+    try:
+        ab = bench_maxpool_backward()
+        if ab["speedup"] < 1.0:
+            _pooling._BACKWARD_IMPL = "stock"
+    except Exception as e:
+        # the flagship number must survive an A/B failure: fall back to
+        # whatever impl is configured and record the error
+        ab = {"error": f"{type(e).__name__}: {e}"[:200]}
+    ab["headline_uses"] = _pooling._BACKWARD_IMPL
     rec = _measure_resnet50("standard")
+    rec["maxpool_backward_ab"] = ab
     # bank the standard-stem record across the process boundary NOW: if
     # the space-to-depth leg stalls and the parent kills this process,
     # the flagship measurement must survive (TimeoutExpired carries the
@@ -287,6 +306,57 @@ def bench_attention():
                                                     causal=True)), 3),
         }
     return out
+
+
+def bench_maxpool_backward():
+    """Argmax-routed maxpool backward vs the stock select-and-scatter
+    path, at the ResNet-50 stem-pool shape (the 206 MB consumer named in
+    BENCH_NOTES.md round 3). Each timed as an on-device fori_loop so the
+    tunnel dispatch floor doesn't mask kernel time."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops import pooling
+
+    B, H, W, C = 128, 112, 112, 64
+    N = 10
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(B, H, W, C), jnp.bfloat16)
+
+    # bypass the DL4J_TPU_MAXPOOL_BWD dispatch: each leg must measure
+    # ITS OWN implementation even when the env override is set (a
+    # stock-vs-stock comparison recorded as an A/B would be worse than
+    # no record)
+    def argmax_pool(x, k, s, pad):
+        return pooling._max_pool2d_argmax(
+            x, pooling._pair(k), pooling._pair(s),
+            (tuple(pad[0]), tuple(pad[1])))
+
+    def timed(pool_fn):
+        def g(x):
+            return jax.grad(
+                lambda t: jnp.sum(pool_fn(
+                    t, (3, 3), (2, 2), ((1, 1), (1, 1))).astype(jnp.float32)
+                ))(x)
+
+        def loop(x):
+            return jax.lax.fori_loop(0, N, lambda i, c: g(c).astype(c.dtype), x)
+
+        j = jax.jit(loop)
+        o = j(x)
+        float(jnp.sum(o.astype(jnp.float32)))  # compile + warm, sync
+        t0 = time.perf_counter()
+        o = j(x)
+        float(jnp.sum(o.astype(jnp.float32)))
+        return (time.perf_counter() - t0) / N * 1e3
+
+    argmax_ms = timed(argmax_pool)
+    stock_ms = timed(pooling.max_pool2d_reference)
+    return {"argmax_bwd_ms": round(argmax_ms, 3),
+            "select_and_scatter_bwd_ms": round(stock_ms, 3),
+            "speedup": round(stock_ms / argmax_ms, 3),
+            "shape": [B, H, W, C],
+            "note": "fwd+bwd of the ResNet stem pool (3x3/2 pad 1), bf16"}
 
 
 class _HostETLIterator:

@@ -9,6 +9,7 @@ pooling.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -20,6 +21,19 @@ from deeplearning4j_tpu.ops.conv import _pair
 # unrolled argmax backward emits k*k pad/where terms, which stops paying for
 # itself (HLO bloat) well before 6x6.
 _ARGMAX_BWD_MAX_WINDOW = 36
+
+# Backward implementation switch. The argmax rewrite targets TPU, where
+# XLA's select-and-scatter maps poorly (single 206 MB op in the ResNet
+# stem, BENCH_NOTES.md); the CPU backend instead rewrites
+# select-and-scatter into an efficient vectorized scatter and there the
+# stock path WINS (~5x, measured — bench.py bench_maxpool_backward).
+# DL4J_TPU_MAXPOOL_BWD=stock flips the default without a code change if
+# the live-TPU A/B ever lands the other way.
+_BACKWARD_IMPL = os.environ.get("DL4J_TPU_MAXPOOL_BWD", "argmax").lower()
+if _BACKWARD_IMPL not in ("argmax", "stock"):
+    raise ValueError(
+        f"DL4J_TPU_MAXPOOL_BWD must be 'argmax' or 'stock', got "
+        f"{os.environ['DL4J_TPU_MAXPOOL_BWD']!r}")
 
 
 def max_pool2d_reference(x, kernel, stride, padding):
@@ -137,7 +151,7 @@ def max_pool2d(x, kernel, stride, padding):
         pad = "SAME"
     else:
         pad = (tuple(padding[0]), tuple(padding[1]))
-    if k[0] * k[1] > _ARGMAX_BWD_MAX_WINDOW:
+    if _BACKWARD_IMPL == "stock" or k[0] * k[1] > _ARGMAX_BWD_MAX_WINDOW:
         return max_pool2d_reference(x, k, s, pad)
     return _max_pool2d_argmax(x, k, s, pad)
 
