@@ -1,7 +1,8 @@
 """NLP: word/doc embeddings and text vectorizers (reference:
-deeplearning4j-nlp Word2Vec [skip-gram + CBOW] / ParagraphVectors /
-Glove / BagOfWordsVectorizer / TfidfVectorizer + tokenizers). Compute
-paths are single jitted steps (SGNS, CBOW, GloVe-AdaGrad)."""
+deeplearning4j-nlp Word2Vec [skip-gram + CBOW, negative sampling or
+hierarchical softmax] / ParagraphVectors / Glove / BagOfWordsVectorizer
+/ TfidfVectorizer + tokenizers). Compute paths are single jitted steps
+(SGNS, CBOW, Huffman-path HS, GloVe-AdaGrad)."""
 
 from deeplearning4j_tpu.nlp.word2vec import (
     Word2Vec, ParagraphVectors, DefaultTokenizerFactory,

@@ -117,13 +117,20 @@ class Word2Vec:
             self._kw["elementsLearningAlgorithm"] = name
             return self
 
+        def useHierarchicSoftmax(self, flag=True):
+            """Huffman-tree hierarchical softmax instead of negative
+            sampling (reference: Word2Vec.Builder.useHierarchicSoftmax)."""
+            self._kw["useHierarchicSoftmax"] = bool(flag)
+            return self
+
         def build(self):
             return Word2Vec(**self._kw)
 
     def __init__(self, iterator=None, tokenizer=None, minWordFrequency=5,
                  layerSize=100, windowSize=5, negative=5, seed=42,
                  iterations=1, learningRate=0.025, batchSize=1024,
-                 elementsLearningAlgorithm="skipgram"):
+                 elementsLearningAlgorithm="skipgram",
+                 useHierarchicSoftmax=False):
         alg = str(elementsLearningAlgorithm).lower()
         alg = alg.split("<")[0]  # tolerate upstream's "CBOW<VocabWord>"
         if alg not in ("skipgram", "cbow"):
@@ -131,6 +138,7 @@ class Word2Vec:
                 f"unknown elementsLearningAlgorithm {elementsLearningAlgorithm!r}"
                 " (use 'skipgram' or 'cbow')")
         self.algorithm = alg
+        self.useHierarchicSoftmax = bool(useHierarchicSoftmax)
         self.iterator = iterator
         self.tokenizer = tokenizer or DefaultTokenizerFactory()
         self.minWordFrequency = minWordFrequency
@@ -167,7 +175,8 @@ class Word2Vec:
                 f"{self.minWordFrequency}")
         self.vocab = {w: i for i, w in enumerate(vocab_words)}
         self._ivocab = vocab_words
-        f = np.array([counts[w] for w in vocab_words], "float64") ** 0.75
+        self._counts = np.array([counts[w] for w in vocab_words], "int64")
+        f = self._counts.astype("float64") ** 0.75
         self._freq = (f / f.sum()).astype("float32")
 
     def _scan(self):
@@ -210,11 +219,133 @@ class Word2Vec:
         return (np.asarray(centers, "int32"), np.asarray(ctxs, "int32"),
                 np.asarray(masks, "float32"))
 
+    # ---------------- hierarchical softmax (reference: upstream's
+    # useHierarchicSoftmax path — Huffman codes over the vocab, sigmoid
+    # losses down each word's path of inner nodes) -------------------
+    @staticmethod
+    def _build_huffman(counts):
+        """counts[i] = frequency of vocab word i -> (points [V, L] int32
+        inner-node ids, signs [V, L] f32 in {+1,-1}, mask [V, L] f32).
+        Padded to the max code length L so one jittable gather serves
+        every word (XLA: no ragged paths)."""
+        import heapq
+
+        V = len(counts)
+        if V < 2:
+            raise ValueError("hierarchical softmax needs a vocabulary "
+                             "of at least 2 words")
+        heap = [(int(c), i) for i, c in enumerate(counts)]
+        heapq.heapify(heap)
+        parent = {}
+        nxt = V
+        while len(heap) > 1:
+            f1, n1 = heapq.heappop(heap)
+            f2, n2 = heapq.heappop(heap)
+            parent[n1] = (nxt, 0)
+            parent[n2] = (nxt, 1)
+            heapq.heappush(heap, (f1 + f2, nxt))
+            nxt += 1
+        paths = []
+        for w in range(V):
+            pts, bits = [], []
+            node = w
+            while node in parent:
+                par, bit = parent[node]
+                pts.append(par - V)  # inner nodes -> 0..V-2
+                bits.append(bit)
+                node = par
+            paths.append((pts[::-1], bits[::-1]))
+        L = max(len(p) for p, _ in paths)
+        points = np.zeros((V, L), "int32")
+        signs = np.zeros((V, L), "float32")
+        mask = np.zeros((V, L), "float32")
+        for w, (pts, bits) in enumerate(paths):
+            n = len(pts)
+            points[w, :n] = pts
+            signs[w, :n] = 1.0 - 2.0 * np.asarray(bits)  # bit 0 -> +1
+            mask[w, :n] = 1.0
+        return points, signs, mask
+
+    def _hs_loss_fn(self, points, signs, mask):
+        """loss(h [B,D], S1 [V-1,D], targets [B]) for the HS objective:
+        -mean_B sum_path log sigmoid(sign * h . S1[node])."""
+        def loss(h, S1, tgt):
+            nodes = points[tgt]            # [B, L]
+            logits = jnp.einsum("bd,bld->bl", h, S1[nodes])
+            lp = jax.nn.log_sigmoid(signs[tgt] * logits) * mask[tgt]
+            return -jnp.mean(jnp.sum(lp, -1))
+
+        return loss
+
     # ---------------- training -------------------------------------
     def fit(self):
+        if self.useHierarchicSoftmax:
+            return self._fit_hs()
         if self.algorithm == "cbow":
             return self._fit_cbow()
         return self._fit_skipgram()
+
+    def _fit_hs(self):
+        """Skip-gram or CBOW against the hierarchical-softmax objective.
+        Same example extraction as the negative-sampling paths; the
+        output table is the V-1 inner-node matrix instead of per-word
+        context vectors."""
+        cbow = self.algorithm == "cbow"
+        if cbow:
+            centers, ctxs, masks = self._cbow_examples()
+        else:
+            centers, contexts = self._scan()
+        V, D = len(self.vocab), self.layerSize
+        pts, sgn, msk = self._build_huffman(self._counts)
+        pts_j = jnp.asarray(pts)
+        sgn_j = jnp.asarray(sgn)
+        msk_j = jnp.asarray(msk)
+        hs_loss = self._hs_loss_fn(pts_j, sgn_j, msk_j)
+        rng = jax.random.key(self.seed)
+        init_k, shuf_k = jax.random.split(rng)
+        W = (jax.random.uniform(init_k, (V, D), jnp.float32) - 0.5) / D
+        S1 = jnp.zeros((max(V - 1, 1), D), jnp.float32)
+        lr = self.learningRate
+
+        if cbow:
+            def step(W, S1, ctr, ctx, m):
+                def loss_fn(W, S1):
+                    h = jnp.sum(W[ctx] * m[..., None], 1) \
+                        / jnp.sum(m, 1, keepdims=True)
+                    return hs_loss(h, S1, ctr)
+
+                loss, (gW, gS) = jax.value_and_grad(
+                    loss_fn, argnums=(0, 1))(W, S1)
+                return W - lr * gW, S1 - lr * gS, loss
+
+            data = (centers, ctxs, masks)
+        else:
+            def step(W, S1, ctr, ctx):
+                def loss_fn(W, S1):
+                    # skip-gram: center vector predicts the CONTEXT
+                    # word's Huffman path
+                    return hs_loss(W[ctr], S1, ctx)
+
+                loss, (gW, gS) = jax.value_and_grad(
+                    loss_fn, argnums=(0, 1))(W, S1)
+                return W - lr * gW, S1 - lr * gS, loss
+
+            data = (centers, contexts)
+        self._hs_tables = (pts_j, sgn_j, msk_j)  # ParagraphVectors reuse
+        jstep = jax.jit(step, donate_argnums=(0, 1))
+        n = data[0].shape[0]
+        B = min(self.batchSize, n)
+        loss = jnp.float32(0)
+        for epoch in range(self.iterations):
+            perm = np.asarray(jax.random.permutation(
+                jax.random.fold_in(shuf_k, epoch), n))
+            shuffled = [a[perm] for a in data]
+            for s in range(0, n, B):
+                batch = [jnp.asarray(a[s:s + B]) for a in shuffled]
+                W, S1, loss = jstep(W, S1, *batch)
+        self._W, self._C = W, S1  # _C = inner-node table in HS mode
+        self._score = float(loss)
+        return self
 
     def _fit_cbow(self):
         """CBOW with negative sampling (reference: embeddings.learning.
@@ -379,29 +510,41 @@ class ParagraphVectors(Word2Vec):
         return np.asarray(d, "int32"), np.asarray(w, "int32")
 
     def fit(self):
-        super().fit()  # word/context tables first (standard SGNS)
+        super().fit()  # word tables first (SGNS/CBOW/HS per config)
         d_idx, w_idx = self._doc_pairs()
         V, D, K = len(self.vocab), self.layerSize, self.negative
         init_k, shuf_k, step_k = jax.random.split(
             jax.random.key(self.seed ^ 0xD0C), 3)
         Dv = (jax.random.uniform(init_k, (self._n_docs, D), jnp.float32)
               - 0.5) / D
-        C = self._C  # frozen context table
+        C = self._C  # frozen: context table (NS) / inner-node table (HS)
         freq = jnp.asarray(self._freq)
         lr = self.learningRate
 
-        def step(Dv, dids, wids, key):
-            neg = jax.random.choice(key, V, (dids.shape[0], K), p=freq)
+        if self.useHierarchicSoftmax:
+            # PV-DBOW against the same frozen Huffman tree: the doc
+            # vector predicts each contained word's path
+            hs_loss = self._hs_loss_fn(*self._hs_tables)
 
-            def loss_fn(Dv):
-                v = Dv[dids]
-                pos = jnp.sum(v * C[wids], -1)
-                negs = jnp.einsum("bd,bkd->bk", v, C[neg])
-                return -(jnp.mean(jax.nn.log_sigmoid(pos)) +
-                         jnp.mean(jnp.sum(jax.nn.log_sigmoid(-negs), -1)))
+            def step(Dv, dids, wids, key):
+                def loss_fn(Dv):
+                    return hs_loss(Dv[dids], C, wids)
 
-            loss, g = jax.value_and_grad(loss_fn)(Dv)
-            return Dv - lr * g, loss
+                loss, g = jax.value_and_grad(loss_fn)(Dv)
+                return Dv - lr * g, loss
+        else:
+            def step(Dv, dids, wids, key):
+                neg = jax.random.choice(key, V, (dids.shape[0], K), p=freq)
+
+                def loss_fn(Dv):
+                    v = Dv[dids]
+                    pos = jnp.sum(v * C[wids], -1)
+                    negs = jnp.einsum("bd,bkd->bk", v, C[neg])
+                    return -(jnp.mean(jax.nn.log_sigmoid(pos)) +
+                             jnp.mean(jnp.sum(jax.nn.log_sigmoid(-negs), -1)))
+
+                loss, g = jax.value_and_grad(loss_fn)(Dv)
+                return Dv - lr * g, loss
 
         jstep = jax.jit(step, donate_argnums=(0,))
         n = d_idx.shape[0]
@@ -451,21 +594,29 @@ class ParagraphVectors(Word2Vec):
         ck = (int(wids.shape[0]), int(steps))
         run = cache.get(ck)
         if run is None:
+            # one loop skeleton; only the per-iteration loss differs
+            if self.useHierarchicSoftmax:
+                hs_loss = self._hs_loss_fn(*self._hs_tables)
+
+                def iter_loss(v, wids, kk):
+                    h = jnp.broadcast_to(v, (wids.shape[0], v.shape[0]))
+                    return hs_loss(h, C, wids)
+            else:
+                def iter_loss(v, wids, kk):
+                    neg = jax.random.choice(kk, V, (wids.shape[0], K),
+                                            p=freq)
+                    pos = C[wids] @ v
+                    negs = jnp.einsum("bkd,d->bk", C[neg], v)
+                    return -(jnp.mean(jax.nn.log_sigmoid(pos)) +
+                             jnp.mean(jnp.sum(
+                                 jax.nn.log_sigmoid(-negs), -1)))
+
             def run_fn(v, wids, key):
                 def body(i, carry):
                     v, k = carry
                     kk = jax.random.fold_in(k, i)
-                    neg = jax.random.choice(kk, V, (wids.shape[0], K),
-                                            p=freq)
-
-                    def loss_fn(v):
-                        pos = C[wids] @ v
-                        negs = jnp.einsum("bkd,d->bk", C[neg], v)
-                        return -(jnp.mean(jax.nn.log_sigmoid(pos)) +
-                                 jnp.mean(jnp.sum(
-                                     jax.nn.log_sigmoid(-negs), -1)))
-
-                    return v - lr * jax.grad(loss_fn)(v), k
+                    return v - lr * jax.grad(
+                        lambda vv: iter_loss(vv, wids, kk))(v), k
 
                 v, _ = jax.lax.fori_loop(0, steps, body, (v, key))
                 return v
@@ -482,8 +633,13 @@ class ParagraphVectors(Word2Vec):
                  W=np.asarray(self._W), C=np.asarray(self._C),
                  D=np.asarray(self._D), freq=np.asarray(self._freq),
                  doc_trained=np.asarray(self._doc_trained),
+                 # models loaded from pre-counts files have no _counts;
+                 # an empty array round-trips as "absent"
+                 counts=np.asarray(getattr(self, "_counts", [])),
                  hyper=np.asarray([self.negative, self.seed,
-                                   self.learningRate], "float64"))
+                                   self.learningRate,
+                                   float(self.useHierarchicSoftmax)],
+                                  "float64"))
 
     @staticmethod
     def load(path):
@@ -505,6 +661,16 @@ class ParagraphVectors(Word2Vec):
         m.negative = int(z["hyper"][0])
         m.seed = int(z["hyper"][1])
         m.learningRate = float(z["hyper"][2])
+        if "counts" in z.files and len(z["counts"]):  # restore regardless
+            # of mode: save() writes counts unconditionally, so
+            # load-then-save must round-trip
+            m._counts = np.asarray(z["counts"])
+        if len(z["hyper"]) > 3 and z["hyper"][3]:  # HS mode: rebuild the
+            # Huffman tables from the saved frequencies (deterministic)
+            m.useHierarchicSoftmax = True
+            pts, sgn, msk = Word2Vec._build_huffman(m._counts)
+            m._hs_tables = (jnp.asarray(pts), jnp.asarray(sgn),
+                            jnp.asarray(msk))
         return m
 
     def similarityToDoc(self, text, docIndex):

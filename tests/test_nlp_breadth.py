@@ -188,3 +188,99 @@ class TestVectorizers:
             v.iterator_over_corpus()
         with pytest.raises(RuntimeError, match="fit"):
             BagOfWordsVectorizer().transform("a")
+
+
+class TestHierarchicSoftmax:
+    """useHierarchicSoftmax (reference: Word2Vec.Builder
+    .useHierarchicSoftmax): Huffman codes over the vocab, sigmoid path
+    losses — the upstream default output layer, here as one jitted
+    padded-path step."""
+
+    def test_huffman_codes_are_optimal_prefix_code(self):
+        counts = np.array([50, 20, 15, 10, 5])
+        pts, sgn, msk = Word2Vec._build_huffman(counts)
+        lens = msk.sum(1).astype(int)
+        # Kraft equality: a COMPLETE binary prefix code
+        assert sum(2.0 ** -l for l in lens) == pytest.approx(1.0)
+        # more frequent -> never a longer code
+        assert all(lens[i] <= lens[j]
+                   for i in range(5) for j in range(5)
+                   if counts[i] > counts[j])
+        # inner node ids within [0, V-1)
+        assert pts.min() >= 0 and pts.max() < 4
+        # signs are +-1 on real path entries
+        assert set(np.unique(sgn[msk > 0])) == {-1.0, 1.0}
+        with pytest.raises(ValueError, match="at least 2"):
+            Word2Vec._build_huffman([7])
+
+    def _fit(self, algorithm):
+        return (Word2Vec.Builder()
+                .minWordFrequency(2).layerSize(16).windowSize(3)
+                .seed(7).iterations(40)
+                .learningRate(1.0 if algorithm == "cbow" else 0.5)
+                .elementsLearningAlgorithm(algorithm)
+                .useHierarchicSoftmax()
+                .iterate(CollectionSentenceIterator(_corpus()))
+                .tokenizerFactory(DefaultTokenizerFactory())
+                .build().fit())
+
+    @pytest.mark.parametrize("algorithm", ["skipgram", "cbow"])
+    def test_topic_words_cluster(self, algorithm):
+        m = self._fit(algorithm)
+        intra = m.similarity("cat", "dog")
+        inter = m.similarity("cat", "gpu")
+        assert intra > inter + 0.2, (algorithm, intra, inter)
+
+    def test_paragraph_vectors_hs_and_serde(self, tmp_path):
+        from deeplearning4j_tpu.nlp import ParagraphVectors
+
+        rng = np.random.RandomState(1)
+        animals = ["cat", "dog", "horse", "sheep"]
+        tech = ["cpu", "gpu", "ram", "disk"]
+        docs = []
+        for i in range(40):
+            src = animals if i % 2 == 0 else tech
+            docs.append(" ".join(rng.choice(src, 8)))
+        pv = (ParagraphVectors.Builder()
+              .minWordFrequency(2).layerSize(16).windowSize(3)
+              .seed(5).iterations(30).learningRate(0.5)
+              .useHierarchicSoftmax()
+              .iterate(CollectionSentenceIterator(docs))
+              .build().fit())
+
+        def cos(a, b):
+            return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b)
+                                  + 1e-12))
+
+        same = cos(pv.getParagraphVector(0), pv.getParagraphVector(2))
+        diff = cos(pv.getParagraphVector(0), pv.getParagraphVector(1))
+        assert same > diff + 0.2, (same, diff)
+        v = pv.inferVector("cat dog sheep")
+        assert cos(v, pv.getParagraphVector(0)) > \
+            cos(v, pv.getParagraphVector(1))
+        p = str(tmp_path / "pv_hs.npz")
+        pv.save(p)
+        pv2 = ParagraphVectors.load(p)
+        assert pv2.useHierarchicSoftmax
+        np.testing.assert_array_equal(pv2.inferVector("cat dog sheep"),
+                                      pv.inferVector("cat dog sheep"))
+
+    def test_load_then_save_roundtrips_both_modes(self, tmp_path):
+        # regression: save() writes counts unconditionally, so a LOADED
+        # model (old files may lack counts) must survive re-saving
+        from deeplearning4j_tpu.nlp import ParagraphVectors
+
+        docs = ["cat dog cat sheep", "cpu gpu disk ram"] * 15
+        for hs in (False, True):
+            pv = (ParagraphVectors.Builder().minWordFrequency(2)
+                  .layerSize(8).windowSize(2).iterations(3)
+                  .useHierarchicSoftmax(hs)
+                  .iterate(CollectionSentenceIterator(docs)).build().fit())
+            p1 = str(tmp_path / f"a{hs}.npz")
+            p2 = str(tmp_path / f"b{hs}.npz")
+            pv.save(p1)
+            loaded = ParagraphVectors.load(p1)
+            loaded.save(p2)  # crashed before the _counts restore fix
+            again = ParagraphVectors.load(p2)
+            np.testing.assert_array_equal(again.inferVector("cat dog"),
+                                          pv.inferVector("cat dog"))
