@@ -8,6 +8,7 @@ host in numpy — the TPU sees only the final fixed-shape float batches.
 
 from __future__ import annotations
 
+import operator
 from pathlib import Path
 
 import numpy as np
@@ -137,6 +138,18 @@ class ImageRecordReader(RecordReader):
 
 
 # ------------------------------------------------------ schema + transforms
+def _ieee_div(a, b):
+    """IEEE-754 division matching the reference's Java double semantics:
+    x/0.0 = ±Infinity, 0.0/0.0 = NaN — a zero divisor must not abort the
+    whole pipeline like Python's ZeroDivisionError would."""
+    with np.errstate(divide="ignore", invalid="ignore"):
+        return float(np.float64(a) / np.float64(b))
+
+
+_MATH_OPS = {"Add": operator.add, "Subtract": operator.sub,
+             "Multiply": operator.mul, "Divide": _ieee_div}
+
+
 class Schema:
     """Column schema (reference: org.datavec.api.transform.schema.Schema)."""
 
@@ -248,10 +261,7 @@ class TransformProcess:
             return self
 
         def doubleMathOp(self, name, op: str, value: float):
-            import operator
-
-            fn = {"Add": operator.add, "Subtract": operator.sub,
-                  "Multiply": operator.mul, "Divide": operator.truediv}[op]
+            fn = _MATH_OPS[op]
 
             def step(schema, recs):
                 i = schema.getIndexOfColumn(name)
@@ -269,6 +279,165 @@ class TransformProcess:
                 kept = [r for r in recs
                         if not predicate(dict(zip(names, r)))]
                 return schema, kept
+            self._steps.append(step)
+            return self
+
+        def stringToCategorical(self, name, stateNames):
+            """Reference: StringToCategoricalTransform — retype a string
+            column, validating every value against the states."""
+            states = list(stateNames)
+
+            def step(schema, recs):
+                i = schema.getIndexOfColumn(name)
+                for r in recs:
+                    if r[i] not in states:
+                        raise ValueError(
+                            f"stringToCategorical: value {r[i]!r} in "
+                            f"column '{name}' not in states {states}")
+                cols = list(schema._cols)
+                cols[i] = (name, "categorical", states)
+                return Schema(cols), recs
+            self._steps.append(step)
+            return self
+
+        def integerToCategorical(self, name, stateNames):
+            """Reference: IntegerToCategoricalTransform — value k becomes
+            stateNames[k]."""
+            states = list(stateNames)
+
+            def step(schema, recs):
+                i = schema.getIndexOfColumn(name)
+                for r in recs:
+                    k = int(r[i])
+                    if not (0 <= k < len(states)):
+                        raise ValueError(
+                            f"integerToCategorical: value {k} in column "
+                            f"'{name}' outside [0,{len(states)})")
+                    r[i] = states[k]
+                cols = list(schema._cols)
+                cols[i] = (name, "categorical", states)
+                return Schema(cols), recs
+            self._steps.append(step)
+            return self
+
+        def stringMapTransform(self, name, mapping):
+            """Reference: StringMapTransform — replace listed values,
+            pass others through."""
+            mapping = dict(mapping)
+
+            def step(schema, recs):
+                i = schema.getIndexOfColumn(name)
+                for r in recs:
+                    r[i] = mapping.get(r[i], r[i])
+                return schema, recs
+            self._steps.append(step)
+            return self
+
+        def appendStringColumnTransform(self, name, toAppend):
+            """Reference: AppendStringColumnTransform."""
+            def step(schema, recs):
+                i = schema.getIndexOfColumn(name)
+                for r in recs:
+                    r[i] = str(r[i]) + toAppend
+                return schema, recs
+            self._steps.append(step)
+            return self
+
+        def conditionalReplaceValueTransform(self, name, newValue,
+                                             condition):
+            """Reference: ConditionalReplaceValueTransform — where the
+            condition (data.transform ColumnCondition or any
+            record-dict predicate) matches, replace the column value."""
+            def step(schema, recs):
+                i = schema.getIndexOfColumn(name)
+                names = schema.getColumnNames()
+                pred = getattr(condition, "condition", condition)
+                for r in recs:
+                    if pred(dict(zip(names, r))):
+                        r[i] = newValue
+                return schema, recs
+            self._steps.append(step)
+            return self
+
+        def replaceMissingWithValue(self, name, value):
+            """Missing = None or NaN (reference: the ReplaceInvalid /
+            ReplaceEmpty family, collapsed to the Python data model)."""
+            def step(schema, recs):
+                i = schema.getIndexOfColumn(name)
+                for r in recs:
+                    v = r[i]
+                    if v is None or (isinstance(v, float) and v != v):
+                        r[i] = value
+                return schema, recs
+            self._steps.append(step)
+            return self
+
+        def doubleColumnsMathOp(self, newName, op, *columns):
+            """Reference: DoubleColumnsMathOpTransform — NEW column from
+            an op over existing double columns (Add/Subtract/Multiply/
+            Divide fold left-to-right; Divide follows Java double
+            semantics: x/0.0 = ±Infinity, 0.0/0.0 = NaN)."""
+            fn = _MATH_OPS[op]
+
+            def step(schema, recs):
+                idx = [schema.getIndexOfColumn(c) for c in columns]
+                for r in recs:
+                    acc = float(r[idx[0]])
+                    for i in idx[1:]:
+                        acc = fn(acc, float(r[i]))
+                    r.append(acc)
+                return Schema(schema._cols + [(newName, "double", None)]), recs
+            self._steps.append(step)
+            return self
+
+        def addConstantColumn(self, name, kind, value):
+            """Reference: AddConstantColumnTransform."""
+            def step(schema, recs):
+                for r in recs:
+                    r.append(value)
+                return Schema(schema._cols + [(name, kind, None)]), recs
+            self._steps.append(step)
+            return self
+
+        def duplicateColumn(self, name, newName):
+            """Reference: DuplicateColumnsTransform."""
+            def step(schema, recs):
+                i = schema.getIndexOfColumn(name)
+                kind, meta = schema._cols[i][1], schema._cols[i][2]
+                for r in recs:
+                    r.append(r[i])
+                return Schema(schema._cols + [(newName, kind, meta)]), recs
+            self._steps.append(step)
+            return self
+
+        def reorderColumns(self, *names):
+            """Reference: ReorderColumnsTransform — listed columns first
+            (in order), unlisted keep their relative order after."""
+            def step(schema, recs):
+                all_names = schema.getColumnNames()
+                missing = [n for n in names if n not in all_names]
+                if missing:
+                    raise ValueError(f"reorderColumns: unknown {missing}")
+                order = [all_names.index(n) for n in names] + \
+                    [i for i, n in enumerate(all_names) if n not in names]
+                new = Schema([schema._cols[i] for i in order])
+                return new, [[r[i] for i in order] for r in recs]
+            self._steps.append(step)
+            return self
+
+        def removeAllColumnsExceptFor(self, *names):
+            """Reference: TransformProcess.Builder
+            .removeAllColumnsExceptFor."""
+            def step(schema, recs):
+                all_names = schema.getColumnNames()
+                missing = [n for n in names if n not in all_names]
+                if missing:  # a typo here would silently drop EVERYTHING
+                    raise ValueError(
+                        f"removeAllColumnsExceptFor: unknown {missing} "
+                        f"(schema has {all_names})")
+                keep = [i for i, n in enumerate(all_names) if n in names]
+                new = Schema([schema._cols[i] for i in keep])
+                return new, [[r[i] for i in keep] for r in recs]
             self._steps.append(step)
             return self
 

@@ -123,6 +123,12 @@ class Word2Vec:
             self._kw["useHierarchicSoftmax"] = bool(flag)
             return self
 
+        def stopWords(self, words):
+            """Tokens excluded from the vocabulary and all training
+            pairs (reference: Word2Vec.Builder.stopWords)."""
+            self._kw["stopWords"] = list(words)
+            return self
+
         def build(self):
             return Word2Vec(**self._kw)
 
@@ -130,7 +136,7 @@ class Word2Vec:
                  layerSize=100, windowSize=5, negative=5, seed=42,
                  iterations=1, learningRate=0.025, batchSize=1024,
                  elementsLearningAlgorithm="skipgram",
-                 useHierarchicSoftmax=False):
+                 useHierarchicSoftmax=False, stopWords=()):
         alg = str(elementsLearningAlgorithm).lower()
         alg = alg.split("<")[0]  # tolerate upstream's "CBOW<VocabWord>"
         if alg not in ("skipgram", "cbow"):
@@ -139,6 +145,7 @@ class Word2Vec:
                 " (use 'skipgram' or 'cbow')")
         self.algorithm = alg
         self.useHierarchicSoftmax = bool(useHierarchicSoftmax)
+        self.stopWords = set(stopWords)
         self.iterator = iterator
         self.tokenizer = tokenizer or DefaultTokenizerFactory()
         self.minWordFrequency = minWordFrequency
@@ -162,7 +169,9 @@ class Word2Vec:
         sents = []
         self.iterator.reset()
         while self.iterator.hasNext():
-            toks = self.tokenizer.create(self.iterator.nextSentence())
+            toks = [t for t in
+                    self.tokenizer.create(self.iterator.nextSentence())
+                    if t not in self.stopWords]
             sents.append(toks)
             counts.update(toks)
         self._sents = sents  # reused by ParagraphVectors._doc_pairs

@@ -439,6 +439,90 @@ class TestTransformDSL:
             da.getColumnAnalysis("nope")
 
 
+class TestTransformBreadth:
+    """Round-4 column-transform additions (reference: datavec-api
+    transform.{string,column,doubletransform} classes)."""
+
+    def _schema(self):
+        from deeplearning4j_tpu.data import Schema
+
+        return (Schema.Builder().addColumnString("name")
+                .addColumnDouble("a").addColumnDouble("b")
+                .addColumnInteger("code").build())
+
+    def _recs(self):
+        return [["x", 2.0, 4.0, 0], ["y ", 3.0, 6.0, 1], ["x", 1.0, 0.5, 2]]
+
+    def test_string_and_categorical_retypes(self):
+        from deeplearning4j_tpu.data import TransformProcess
+
+        tp = (TransformProcess.Builder(self._schema())
+              .stringMapTransform("name", {"y ": "y"})
+              .appendStringColumnTransform("name", "_v1")
+              .stringToCategorical("name", ["x_v1", "y_v1"])
+              .integerToCategorical("code", ["lo", "mid", "hi"])
+              .build())
+        out = tp.execute(self._recs())
+        assert [r[0] for r in out] == ["x_v1", "y_v1", "x_v1"]
+        assert [r[3] for r in out] == ["lo", "mid", "hi"]
+        fs = tp.getFinalSchema()
+        assert fs.getType("name") == "categorical"
+        assert fs.getMeta("code") == ["lo", "mid", "hi"]
+        tp_bad = (TransformProcess.Builder(self._schema())
+                  .stringToCategorical("name", ["x"]).build())
+        with pytest.raises(ValueError, match="not in states"):
+            tp_bad.execute(self._recs())
+
+    def test_derived_and_structural_columns(self):
+        from deeplearning4j_tpu.data import TransformProcess
+
+        tp = (TransformProcess.Builder(self._schema())
+              .doubleColumnsMathOp("ratio", "Divide", "a", "b")
+              .addConstantColumn("ds", "string", "train")
+              .duplicateColumn("a", "a_copy")
+              .reorderColumns("ds", "name")
+              .build())
+        out = tp.execute(self._recs())
+        fs = tp.getFinalSchema()
+        assert fs.getColumnNames() == ["ds", "name", "a", "b", "code",
+                                       "ratio", "a_copy"]
+        assert out[0] == ["train", "x", 2.0, 4.0, 0, 0.5, 2.0]
+        tp2 = (TransformProcess.Builder(self._schema())
+               .removeAllColumnsExceptFor("a", "code").build())
+        assert tp2.getFinalSchema().getColumnNames() == ["a", "code"]
+        assert tp2.execute(self._recs())[1] == [3.0, 1]
+        with pytest.raises(ValueError, match="unknown"):
+            (TransformProcess.Builder(self._schema())
+             .reorderColumns("nope").build().execute(self._recs()))
+        with pytest.raises(ValueError, match="unknown"):
+            (TransformProcess.Builder(self._schema())
+             .removeAllColumnsExceptFor("labl").build()
+             .execute(self._recs()))
+        # Divide by zero: Java double semantics, not ZeroDivisionError
+        tp3 = (TransformProcess.Builder(self._schema())
+               .doubleColumnsMathOp("r", "Divide", "a", "b").build())
+        out3 = tp3.execute([["x", 1.0, 0.0, 0], ["y", 0.0, 0.0, 1]])
+        assert out3[0][-1] == float("inf")
+        assert out3[1][-1] != out3[1][-1]  # NaN
+
+    def test_conditional_replace_and_missing(self):
+        from deeplearning4j_tpu.data import (ConditionOp,
+                                             DoubleColumnCondition,
+                                             TransformProcess)
+
+        recs = [["x", 2.0, float("nan"), 0], ["y", -5.0, 1.0, None]]
+        tp = (TransformProcess.Builder(self._schema())
+              .conditionalReplaceValueTransform(
+                  "a", 0.0, DoubleColumnCondition(
+                      "a", ConditionOp.LessThan, 0.0))
+              .replaceMissingWithValue("b", -1.0)
+              .replaceMissingWithValue("code", 9)
+              .build())
+        out = tp.execute(recs)
+        assert out[1][1] == 0.0 and out[0][1] == 2.0
+        assert out[0][2] == -1.0 and out[1][3] == 9
+
+
 class TestSequenceRecords:
     """CSVSequenceRecordReader + SequenceRecordReaderDataSetIterator
     (reference: datavec sequence readers feeding recurrent nets)."""

@@ -284,3 +284,15 @@ class TestHierarchicSoftmax:
             again = ParagraphVectors.load(p2)
             np.testing.assert_array_equal(again.inferVector("cat dog"),
                                           pv.inferVector("cat dog"))
+
+
+class TestStopWords:
+    def test_stopwords_excluded_from_vocab_and_training(self):
+        m = (Word2Vec.Builder()
+             .minWordFrequency(1).layerSize(8).windowSize(2).iterations(2)
+             .stopWords(["the", "of"])
+             .iterate(CollectionSentenceIterator(
+                 ["the cat of the house", "the dog of the yard"] * 5))
+             .build().fit())
+        assert not m.hasWord("the") and not m.hasWord("of")
+        assert m.hasWord("cat") and m.hasWord("yard")
