@@ -23,6 +23,11 @@ from deeplearning4j_tpu.data.transform import (
     CategoricalColumnCondition, StringColumnCondition, DataAnalysis,
     analyze,
 )
+from deeplearning4j_tpu.data.augment import (
+    ImageTransform, FlipImageTransform, RandomCropTransform,
+    ResizeImageTransform, RotateImageTransform, PipelineImageTransform,
+    ImageAugmentationPreProcessor,
+)
 from deeplearning4j_tpu.data.records import (
     RecordReader, CSVRecordReader, CollectionRecordReader, ImageRecordReader,
     Schema, TransformProcess, RecordReaderDataSetIterator,
@@ -44,5 +49,8 @@ __all__ = [
     "Reducer", "ReduceOp", "ConditionFilter", "ConditionOp",
     "ColumnCondition", "DoubleColumnCondition", "IntegerColumnCondition",
     "CategoricalColumnCondition", "StringColumnCondition",
-    "DataAnalysis", "analyze",
+    "DataAnalysis", "analyze", "ImageTransform", "FlipImageTransform",
+    "RandomCropTransform", "ResizeImageTransform",
+    "RotateImageTransform", "PipelineImageTransform",
+    "ImageAugmentationPreProcessor",
 ]

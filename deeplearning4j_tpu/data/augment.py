@@ -1,0 +1,185 @@
+"""Image augmentation transforms — the DataVec ImageTransform family.
+
+Reference: datavec-data-image org.datavec.image.transform.{Flip,Crop,
+Resize,Rotate,Pipeline}ImageTransform + ImageTransformProcess. Upstream
+applies OpenCV ops per-image on the JVM host; TPU-first design runs the
+whole batch as ONE jitted program on device — vectorized (vmap) random
+flips/crops/rotations keyed by a counter-based RNG, so augmentation
+rides the accelerator's idle ETL gap instead of the host CPU and is
+bit-reproducible from (seed, batch counter).
+
+Transforms operate on [B, H, W, C] float arrays (the internal layout);
+`ImageAugmentationPreProcessor` is the DataSetPreProcessor adapter that
+converts from/to the NCHW API layout around them.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class ImageTransform:
+    """Base: apply(key, images[B,H,W,C]) -> images. Pure (jit-safe)."""
+
+    def apply(self, key, images):
+        raise NotImplementedError
+
+    def __call__(self, key, images):
+        return self.apply(key, images)
+
+
+class FlipImageTransform(ImageTransform):
+    """Random horizontal flip per image (reference: FlipImageTransform;
+    flipMode=1 — horizontal — is the augmentation one actually uses)."""
+
+    def __init__(self, p=0.5):
+        if not (0.0 <= p <= 1.0):
+            raise ValueError(f"flip probability must be in [0,1], got {p}")
+        self.p = float(p)
+
+    def apply(self, key, images):
+        flips = jax.random.bernoulli(key, self.p, (images.shape[0],))
+        return jnp.where(flips[:, None, None, None],
+                         images[:, :, ::-1, :], images)
+
+
+class RandomCropTransform(ImageTransform):
+    """Zero-pad by `pad` then crop a random [height, width] window per
+    image (reference: CropImageTransform with random coords — the
+    CIFAR/ImageNet pad-and-crop recipe)."""
+
+    def __init__(self, height, width, pad=0):
+        self.h, self.w, self.pad = int(height), int(width), int(pad)
+
+    def apply(self, key, images):
+        B, H, W, C = images.shape
+        p = self.pad
+        xp = jnp.pad(images, ((0, 0), (p, p), (p, p), (0, 0)))
+        max_y = H + 2 * p - self.h
+        max_x = W + 2 * p - self.w
+        if max_y < 0 or max_x < 0:
+            raise ValueError(
+                f"crop {self.h}x{self.w} larger than padded image "
+                f"{H + 2 * p}x{W + 2 * p}")
+        ky, kx = jax.random.split(key)
+        ys = jax.random.randint(ky, (B,), 0, max_y + 1)
+        xs = jax.random.randint(kx, (B,), 0, max_x + 1)
+
+        def crop_one(img, y, x):
+            return jax.lax.dynamic_slice(img, (y, x, 0),
+                                         (self.h, self.w, C))
+
+        return jax.vmap(crop_one)(xp, ys, xs)
+
+
+class ResizeImageTransform(ImageTransform):
+    """Deterministic bilinear resize (reference: ResizeImageTransform)."""
+
+    def __init__(self, height, width):
+        self.h, self.w = int(height), int(width)
+
+    def apply(self, key, images):
+        B, _, _, C = images.shape
+        return jax.image.resize(images, (B, self.h, self.w, C), "bilinear")
+
+
+class RotateImageTransform(ImageTransform):
+    """Random rotation, angle uniform in [-maxAngleDeg, +maxAngleDeg]
+    about the image centre, bilinear sampling, zero fill (reference:
+    RotateImageTransform)."""
+
+    def __init__(self, maxAngleDeg):
+        self.max_rad = float(maxAngleDeg) * np.pi / 180.0
+
+    def apply(self, key, images):
+        from jax.scipy.ndimage import map_coordinates
+
+        B, H, W, C = images.shape
+        angles = jax.random.uniform(key, (B,), jnp.float32,
+                                    minval=-self.max_rad,
+                                    maxval=self.max_rad)
+        # the coordinate grid stays f32 whatever the image dtype: bf16's
+        # 8-bit mantissa can't even represent integers past 256, which
+        # would shift sample coords by up to a pixel on large images
+        yy, xx = jnp.meshgrid(jnp.arange(H, dtype=jnp.float32),
+                              jnp.arange(W, dtype=jnp.float32),
+                              indexing="ij")
+        cy, cx = (H - 1) / 2.0, (W - 1) / 2.0
+
+        def rot_one(img, a):
+            ca, sa = jnp.cos(a), jnp.sin(a)
+            sy = cy + (yy - cy) * ca - (xx - cx) * sa
+            sx = cx + (yy - cy) * sa + (xx - cx) * ca
+
+            def chan(c):
+                return map_coordinates(c.astype(jnp.float32), [sy, sx],
+                                       order=1, mode="constant", cval=0.0)
+
+            return jnp.stack([chan(img[..., k]) for k in range(C)],
+                             -1).astype(img.dtype)
+
+        return jax.vmap(rot_one)(images, angles)
+
+
+class PipelineImageTransform(ImageTransform):
+    """Sequential composition with independent per-stage keys
+    (reference: PipelineImageTransform / ImageTransformProcess)."""
+
+    def __init__(self, *transforms):
+        if len(transforms) == 1 and isinstance(transforms[0], (list, tuple)):
+            transforms = tuple(transforms[0])
+        if not transforms:
+            raise ValueError("PipelineImageTransform needs >= 1 transform")
+        self.transforms = list(transforms)
+
+    def apply(self, key, images):
+        for i, t in enumerate(self.transforms):
+            images = t.apply(jax.random.fold_in(key, i), images)
+        return images
+
+
+class ImageAugmentationPreProcessor:
+    """DataSetPreProcessor adapter: set on any DataSetIterator via
+    setPreProcessor. Applies the transform to each batch's features on
+    device — NCHW API batches are converted to NHWC around the jitted
+    transform. A per-batch counter folds into the seed, so a restarted
+    run re-draws the identical augmentation stream (the framework's
+    determinism contract)."""
+
+    def __init__(self, transform: ImageTransform, seed=123,
+                 dataFormat="NCHW"):
+        self.transform = transform
+        self.seed = int(seed)
+        fmt = str(dataFormat).upper()
+        if fmt not in ("NCHW", "NHWC"):
+            raise ValueError(f"dataFormat must be NCHW or NHWC, got "
+                             f"{dataFormat!r}")
+        self.dataFormat = fmt
+        self._counter = 0
+        nchw = fmt == "NCHW"
+
+        def run(key, x):
+            # layout conversion INSIDE the jit: one fused XLA program
+            # per batch, not three dispatches with two extra copies
+            if nchw:
+                x = jnp.transpose(x, (0, 2, 3, 1))
+            x = self.transform.apply(key, x)
+            if nchw:
+                x = jnp.transpose(x, (0, 3, 1, 2))
+            return x
+
+        self._jit = jax.jit(run)
+
+    def preProcess(self, ds):
+        x = ds.getFeatures().jax()
+        if x.ndim != 4:
+            raise ValueError(
+                f"image augmentation needs 4-d features, got shape "
+                f"{tuple(x.shape)}")
+        key = jax.random.fold_in(jax.random.key(self.seed), self._counter)
+        self._counter += 1
+        ds.setFeatures(self._jit(key, x))
+        return ds

@@ -360,13 +360,15 @@ class TransformProcess:
             return self
 
         def replaceMissingWithValue(self, name, value):
-            """Missing = None or NaN (reference: the ReplaceInvalid /
-            ReplaceEmpty family, collapsed to the Python data model)."""
+            """Missing = None, NaN, or the empty string (reference: the
+            ReplaceInvalid / ReplaceEmpty family; "" is what
+            CSVRecordReader yields for an absent field)."""
             def step(schema, recs):
                 i = schema.getIndexOfColumn(name)
                 for r in recs:
                     v = r[i]
-                    if v is None or (isinstance(v, float) and v != v):
+                    if v is None or v == "" or \
+                            (isinstance(v, float) and v != v):
                         r[i] = value
                 return schema, recs
             self._steps.append(step)

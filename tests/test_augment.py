@@ -1,0 +1,152 @@
+"""Image augmentation (reference: datavec-data-image ImageTransform
+family) — jitted batched transforms with counter-keyed determinism."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import (
+    FlipImageTransform, RandomCropTransform, ResizeImageTransform,
+    RotateImageTransform, PipelineImageTransform,
+    ImageAugmentationPreProcessor, DataSet, DataSetIterator,
+)
+
+
+def _imgs(B=6, H=12, W=10, C=3, seed=0):
+    return jnp.asarray(np.random.RandomState(seed).rand(B, H, W, C),
+                       jnp.float32)
+
+
+class TestTransforms:
+    def test_flip_semantics(self):
+        x = _imgs()
+        key = jax.random.key(1)
+        always = FlipImageTransform(p=1.0).apply(key, x)
+        np.testing.assert_array_equal(np.asarray(always),
+                                      np.asarray(x)[:, :, ::-1, :])
+        never = FlipImageTransform(p=0.0).apply(key, x)
+        np.testing.assert_array_equal(np.asarray(never), np.asarray(x))
+        # p=0.5: deterministic per key, differs across keys
+        a = FlipImageTransform(0.5).apply(jax.random.key(2), x)
+        b = FlipImageTransform(0.5).apply(jax.random.key(2), x)
+        c = FlipImageTransform(0.5).apply(jax.random.key(3), x)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert not np.array_equal(np.asarray(a), np.asarray(c))
+        with pytest.raises(ValueError, match="probability"):
+            FlipImageTransform(p=1.5)
+
+    def test_random_crop_content_and_bounds(self):
+        x = _imgs()
+        t = RandomCropTransform(8, 8, pad=2)
+        out = t.apply(jax.random.key(4), x)
+        assert out.shape == (6, 8, 8, 3)
+        # every crop window is a contiguous sub-block of the padded
+        # image: its nonzero content must appear in the original
+        xp = np.pad(np.asarray(x), ((0, 0), (2, 2), (2, 2), (0, 0)))
+        found = 0
+        for i in range(6):
+            for y in range(xp.shape[1] - 8 + 1):
+                for xx in range(xp.shape[2] - 8 + 1):
+                    if np.array_equal(xp[i, y:y + 8, xx:xx + 8],
+                                      np.asarray(out)[i]):
+                        found += 1
+                        break
+                else:
+                    continue
+                break
+        assert found == 6
+        with pytest.raises(ValueError, match="larger"):
+            RandomCropTransform(64, 64).apply(jax.random.key(0), x)
+
+    def test_resize_and_rotate(self):
+        x = _imgs()
+        r = ResizeImageTransform(6, 5).apply(jax.random.key(0), x)
+        assert r.shape == (6, 6, 5, 3)
+        # zero-angle rotation is identity (bilinear at integer coords)
+        rot0 = RotateImageTransform(0.0).apply(jax.random.key(1), x)
+        np.testing.assert_allclose(np.asarray(rot0), np.asarray(x),
+                                   atol=1e-5)
+        # 10-degree rotation changes pixels but preserves shape/finiteness
+        rot = RotateImageTransform(10.0).apply(jax.random.key(2), x)
+        assert rot.shape == x.shape
+        assert np.isfinite(np.asarray(rot)).all()
+        assert not np.allclose(np.asarray(rot), np.asarray(x))
+
+    def test_pipeline_composes_in_order(self):
+        x = _imgs()
+        pipe = PipelineImageTransform(FlipImageTransform(1.0),
+                                      ResizeImageTransform(6, 6))
+        out = pipe.apply(jax.random.key(5), x)
+        manual = ResizeImageTransform(6, 6).apply(
+            jax.random.key(0), x[:, :, ::-1, :])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(manual),
+                                   atol=1e-6)
+        with pytest.raises(ValueError, match="1 transform"):
+            PipelineImageTransform()
+
+
+class TestPreProcessor:
+    def test_iterator_integration_nchw_and_determinism(self):
+        rng = np.random.RandomState(7)
+        X = rng.rand(8, 3, 12, 10).astype("float32")  # NCHW API layout
+        Y = np.eye(2, dtype="float32")[rng.randint(0, 2, 8)]
+
+        def run():
+            it = DataSetIterator(X, Y, batchSize=4)
+            it.setPreProcessor(ImageAugmentationPreProcessor(
+                PipelineImageTransform(FlipImageTransform(0.5),
+                                       RandomCropTransform(12, 10, pad=2)),
+                seed=11))
+            return [np.asarray(ds.getFeatures().jax()) for ds in it]
+
+        a, b = run(), run()
+        assert a[0].shape == (4, 3, 12, 10)  # NCHW preserved
+        for x1, x2 in zip(a, b):  # same seed + counter -> same stream
+            np.testing.assert_array_equal(x1, x2)
+        # the stream differs across batches (counter advances)
+        assert not np.array_equal(a[0], a[1])
+
+    def test_augmented_training_smoke(self):
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           MultiLayerNetwork,
+                                           ConvolutionLayer, OutputLayer,
+                                           Adam)
+
+        rng = np.random.RandomState(1)
+        X = rng.rand(16, 1, 10, 10).astype("float32")
+        Y = np.eye(2, dtype="float32")[rng.randint(0, 2, 16)]
+        it = DataSetIterator(X, Y, batchSize=8)
+        it.setPreProcessor(ImageAugmentationPreProcessor(
+            FlipImageTransform(0.5), seed=3))
+        conf = (NeuralNetConfiguration.Builder().seed(1).updater(Adam(1e-3))
+                .list()
+                .layer(ConvolutionLayer(nOut=4, kernelSize=(3, 3),
+                                        activation="relu"))
+                .layer(OutputLayer(nOut=2, activation="softmax",
+                                   lossFunction="mcxent"))
+                .setInputType(InputType.convolutional(10, 10, 1)).build())
+        net = MultiLayerNetwork(conf).init()
+        for _ in range(3):
+            net.fit(it)
+        assert np.isfinite(net.score())
+
+    def test_guards(self):
+        with pytest.raises(ValueError, match="dataFormat"):
+            ImageAugmentationPreProcessor(FlipImageTransform(), dataFormat="CHW")
+        pp = ImageAugmentationPreProcessor(FlipImageTransform())
+        with pytest.raises(ValueError, match="4-d"):
+            pp.preProcess(DataSet(np.zeros((2, 5), "float32"),
+                                  np.zeros((2, 2), "float32")))
+
+    def test_bf16_rotate_grid_precision(self):
+        # the sampling grid must be f32: bf16 can't represent integers
+        # past 256, so a bf16 grid would shift coords on large images.
+        # 0-degree rotation of a 300-wide bf16 image must be identity.
+        x = jnp.asarray(np.random.RandomState(2).rand(1, 4, 300, 1),
+                        jnp.bfloat16)
+        out = RotateImageTransform(0.0).apply(jax.random.key(0), x)
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(x, np.float32),
+            atol=1e-2)

@@ -510,7 +510,8 @@ class TestTransformBreadth:
                                              DoubleColumnCondition,
                                              TransformProcess)
 
-        recs = [["x", 2.0, float("nan"), 0], ["y", -5.0, 1.0, None]]
+        recs = [["x", 2.0, float("nan"), 0], ["y", -5.0, 1.0, None],
+                ["z", 1.0, "", 2]]
         tp = (TransformProcess.Builder(self._schema())
               .conditionalReplaceValueTransform(
                   "a", 0.0, DoubleColumnCondition(
@@ -521,6 +522,7 @@ class TestTransformBreadth:
         out = tp.execute(recs)
         assert out[1][1] == 0.0 and out[0][1] == 2.0
         assert out[0][2] == -1.0 and out[1][3] == 9
+        assert out[2][2] == -1.0  # "" = CSVRecordReader's missing field
 
 
 class TestSequenceRecords:
