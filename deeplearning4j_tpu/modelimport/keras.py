@@ -63,6 +63,16 @@ def _pair(v):
     return (int(v), int(v))
 
 
+def _leaky(alpha):
+    """Exact-alpha leaky-relu closure (activations.get accepts
+    callables): Keras slopes are arbitrary and rarely match the
+    registry's leakyrelu(0.01). Callable activations don't serialize —
+    re-export such imports via Keras, not ModelSerializer."""
+    import jax.nn as _jnn
+
+    return lambda x: _jnn.leaky_relu(x, alpha)
+
+
 def _conv_mode(padding):
     p = str(padding).lower()
     if p == "valid":
@@ -104,6 +114,54 @@ def _input_type_from_shape(shape):
                 f"variable feature dim in input {shape}")
         return InputType.feedForward(dims[0])
     raise UnsupportedKerasConfigurationException(f"unsupported input shape {shape}")
+
+
+class KerasReshapeLayer(L.Layer):
+    """Keras Reshape(target_shape), per example. Valid because Keras'
+    channels_last layout and the internal NHWC layout agree elementwise:
+    a row-major reshape means the same thing on both sides. Targets:
+    [features] (flatten) or [h, w, c]."""
+
+    def __init__(self, targetShape, **kw):
+        super().__init__(**kw)
+        self.targetShape = tuple(int(v) for v in targetShape)
+
+    def hasParams(self):
+        return False
+
+    def _resolve(self, inputType):
+        """Resolve one -1 wildcard (Keras allows it; Reshape((-1,)) is
+        the common flatten idiom) against the input's element count."""
+        t = list(self.targetShape)
+        if t.count(-1) > 1 or any(v < 1 and v != -1 for v in t):
+            raise InvalidKerasConfigurationException(
+                f"Reshape target {tuple(t)} invalid: at most one -1 "
+                "wildcard, all other dims positive")
+        if -1 in t:
+            total = inputType.arrayElementsPerExample()
+            known = 1
+            for v in t:
+                if v != -1:
+                    known *= v
+            if total % known:
+                raise InvalidKerasConfigurationException(
+                    f"Reshape target {tuple(t)}: {total} elements per "
+                    f"example not divisible by {known}")
+            t[t.index(-1)] = total // known
+        return tuple(t)
+
+    def getOutputType(self, inputType):
+        t = self._resolved = self._resolve(inputType)
+        if len(t) == 1:
+            return InputType.feedForward(t[0])
+        h, w, c = t
+        return InputType.convolutional(h, w, c)
+
+    def forward(self, params, state, x, train, key, mask=None):
+        # -1 resolution happened during shape inference (getOutputType
+        # always runs at build); fall back to the raw target otherwise
+        t = getattr(self, "_resolved", self.targetShape)
+        return x.reshape((x.shape[0],) + t), state
 
 
 class _KerasLayerSpec:
@@ -211,7 +269,10 @@ def _convert_layer(spec: _KerasLayerSpec, is_last: bool):
               "GlobalMaxPooling1D", "GlobalAveragePooling1D",
               "GlobalMaxPooling3D", "GlobalAveragePooling3D"):
         return L.GlobalPoolingLayer(
-            poolingType="max" if "Max" in cn else "avg", name=name)
+            poolingType="max" if "Max" in cn else "avg",
+            # keepdims=True (MobileNet heads) = upstream's
+            # collapseDimensions(false): pooled dims stay as size 1
+            collapseDimensions=not cfg.get("keepdims", False), name=name)
     if cn == "Flatten":
         return None  # our shape inference auto-inserts CnnToFeedForward
     if cn == "Dropout":
@@ -279,6 +340,33 @@ def _convert_layer(spec: _KerasLayerSpec, is_last: bool):
                               name=name)
     if cn == "Activation":
         return L.ActivationLayer(activation=_act(cfg.get("activation")), name=name)
+    if cn == "ReLU":
+        # standalone ReLU layer (MobileNet-family configs): plain,
+        # capped (relu6), or leaky — reject other parameterisations
+        max_v = cfg.get("max_value")
+        slope = float(cfg.get("negative_slope") or 0.0)
+        thresh = float(cfg.get("threshold") or 0.0)
+        if thresh != 0.0:
+            raise UnsupportedKerasConfigurationException(
+                f"ReLU threshold={thresh} not supported (layer '{name}')")
+        if max_v is not None and slope != 0.0:
+            raise UnsupportedKerasConfigurationException(
+                f"ReLU with both max_value and negative_slope not "
+                f"supported (layer '{name}')")
+        if max_v is not None:
+            if float(max_v) != 6.0:
+                raise UnsupportedKerasConfigurationException(
+                    f"ReLU max_value={max_v} not supported (only 6.0 — "
+                    f"relu6; layer '{name}')")
+            return L.ActivationLayer(activation="relu6", name=name)
+        if slope != 0.0:
+            return L.ActivationLayer(activation=_leaky(slope), name=name)
+        return L.ActivationLayer(activation="relu", name=name)
+    if cn == "LeakyReLU":
+        # Keras 3 serializes "negative_slope"; Keras 2 used "alpha".
+        # No `or` fallback: an explicit 0.0 means plain relu, not 0.3.
+        alpha = cfg.get("negative_slope", cfg.get("alpha", 0.3))
+        return L.ActivationLayer(activation=_leaky(float(alpha)), name=name)
     if cn == "BatchNormalization":
         bn = L.BatchNormalization(
             decay=float(cfg.get("momentum", 0.99)),
@@ -293,12 +381,20 @@ def _convert_layer(spec: _KerasLayerSpec, is_last: bool):
     if cn == "ZeroPadding2D":
         pad = cfg.get("padding", 1)
         if isinstance(pad, (list, tuple)) and pad and isinstance(pad[0], (list, tuple)):
+            # ((top, bottom), (left, right)) incl. asymmetric (MobileNet
+            # stride-2 blocks pad (0,1)); ZeroPaddingLayer's native
+            # 4-tuple order is (top, bottom, left, right)
             (t, b), (l, r) = pad
-            if t != b or l != r:
-                raise UnsupportedKerasConfigurationException(
-                    f"asymmetric ZeroPadding2D {pad} not supported (layer '{name}')")
-            pad = (t, l)
+            return L.ZeroPaddingLayer(padding=(int(t), int(b), int(l),
+                                               int(r)), name=name)
         return L.ZeroPaddingLayer(padding=_pair(pad), name=name)
+    if cn == "Reshape":
+        target = tuple(int(v) for v in cfg.get("target_shape", ()))
+        if len(target) not in (1, 3):
+            raise UnsupportedKerasConfigurationException(
+                f"Reshape to {target} not supported (only [features] or "
+                f"[h, w, c]; layer '{name}')")
+        return KerasReshapeLayer(target, name=name)
     if cn == "UpSampling2D":
         size = _pair(cfg.get("size", 2))
         if size[0] != size[1]:

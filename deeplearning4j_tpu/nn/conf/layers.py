@@ -633,12 +633,20 @@ class GlobalPoolingLayer(Layer):
     def getOutputType(self, inputType):
         if inputType.kind == InputType.CNN:
             self._mode = "cnn"
+            if not self.collapseDimensions:
+                # reference: collapseDimensions(false) keeps the pooled
+                # dims as size-1 (logical [B,C,1,1])
+                return InputType.convolutional(1, 1, inputType.channels)
             return InputType.feedForward(inputType.channels)
         if inputType.kind == InputType.CNN3D:
             self._mode = "cnn3d"
+            if not self.collapseDimensions:
+                return InputType.convolutional3D(1, 1, 1, inputType.channels)
             return InputType.feedForward(inputType.channels)
         if inputType.kind == InputType.RNN:
             self._mode = "rnn"
+            if not self.collapseDimensions:
+                return InputType.recurrent(inputType.size, 1)
             return InputType.feedForward(inputType.size)
         self._mode = "ff"
         return inputType
@@ -646,11 +654,17 @@ class GlobalPoolingLayer(Layer):
     def forward(self, params, state, x, train, key, mask=None):
         if x.ndim == 5:      # [B,D,H,W,C]
             y = _pool.global_pool(x, self.poolingType, (1, 2, 3), None, self.pnorm)
+            if not self.collapseDimensions:
+                y = y[:, None, None, None, :]
         elif x.ndim == 4:    # [B,H,W,C]
             y = _pool.global_pool(x, self.poolingType, (1, 2), None, self.pnorm)
+            if not self.collapseDimensions:
+                y = y[:, None, None, :]
         elif x.ndim == 3:    # [B,F,T]
             m = None if mask is None else mask[:, None, :]
             y = _pool.global_pool(x, self.poolingType, (2,), m, self.pnorm)
+            if not self.collapseDimensions:
+                y = y[:, :, None]
         else:
             y = x
         return y, state

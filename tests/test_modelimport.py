@@ -249,14 +249,22 @@ class TestReviewRegressions:
         x = np.random.RandomState(2).rand(6, 5).astype("float32")
         _parity(m, net, x, x)
 
-    def test_asymmetric_padding_rejected(self):
-        raw = {"class_name": "Sequential", "config": {"layers": [
-            {"class_name": "InputLayer", "config": {"batch_shape": [None, 8, 8, 1]}},
-            {"class_name": "ZeroPadding2D",
-             "config": {"name": "zp", "padding": [[0, 1], [0, 1]]}},
-        ]}}
-        with pytest.raises(UnsupportedKerasConfigurationException):
-            KerasModelImport.importKerasSequentialModelAndWeights(json.dumps(raw))
+    def test_asymmetric_padding_supported(self):
+        # round 4: asymmetric ((top,bottom),(left,right)) is now mapped
+        # onto ZeroPaddingLayer's native 4-tuple (MobileNet stride-2
+        # blocks pad (0,1)) — previously rejected
+        m = keras.Sequential([
+            keras.layers.ZeroPadding2D(padding=((0, 1), (0, 1)), name="zp"),
+            keras.layers.Conv2D(2, 3, strides=2, name="c"),
+        ])
+        m.build((2, 8, 8, 1))
+        net = KerasModelImport.importKerasSequentialModelAndWeights(
+            m.to_json(), weights=_wmap(m))
+        x = np.random.RandomState(5).rand(2, 8, 8, 1).astype("float32")
+        want = np.asarray(m(x))  # keras NHWC
+        # headless MLN (no output layer) returns the raw NHWC activation
+        got = np.asarray(net.output(x.transpose(0, 3, 1, 2)).jax())
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
     def test_functional_cnn_flatten_parity(self):
         inp = keras.layers.Input((6, 6, 2), name="in0")
@@ -429,3 +437,85 @@ class TestExtendedLayerImport:
             m.to_json(), _wmap(m))
         x = np.random.RandomState(6).rand(2, 12, 4).astype("float32")
         _parity(m, net, x, x.transpose(0, 2, 1), rtol=1e-3, atol=1e-4)
+
+
+class TestKerasApplicationsImport:
+    """Whole-architecture imports from real keras.applications configs +
+    weights (round 4: ReLU layer, asymmetric ZeroPadding2D, Reshape,
+    GlobalPooling keepdims)."""
+
+    def _parity(self, km):
+        w = {l.name: l.get_weights() for l in km.layers if l.get_weights()}
+        net = KerasModelImport.importKerasModelAndWeights(km.to_json(),
+                                                          weights=w)
+        x = np.random.RandomState(0).rand(2, 64, 64, 3).astype("float32")
+        golden = km.predict(x, verbose=0)
+        ours = np.asarray(net.output(x.transpose(0, 3, 1, 2)).jax())
+        np.testing.assert_allclose(ours, golden, rtol=1e-3, atol=1e-4)
+
+    def test_mobilenet_v1_exact(self):
+        # exercises: standalone ReLU(max_value=6), DepthwiseConv2D,
+        # GlobalAveragePooling2D(keepdims=True), Reshape, asymmetric pad
+        keras.utils.set_random_seed(3)
+        self._parity(tf.keras.applications.MobileNet(
+            weights=None, input_shape=(64, 64, 3), classes=5))
+
+    def test_mobilenet_v2_exact(self):
+        keras.utils.set_random_seed(4)
+        self._parity(tf.keras.applications.MobileNetV2(
+            weights=None, input_shape=(64, 64, 3), classes=5))
+
+    def test_densenet_config_imports(self):
+        keras.utils.set_random_seed(5)
+        km = tf.keras.applications.DenseNet121(
+            weights=None, input_shape=(64, 64, 3), classes=5)
+        net = KerasModelImport.importKerasModelAndWeights(km.to_json())
+        assert net is not None
+
+    def test_leaky_relu_alpha_parity(self):
+        keras.utils.set_random_seed(6)
+        m = keras.Sequential([
+            keras.layers.Dense(8),
+            keras.layers.LeakyReLU(negative_slope=0.05),  # NON-default:
+            # guards reading Keras 3's negative_slope key, not just the
+            # 0.3 fallback
+            keras.layers.ReLU(negative_slope=0.1),
+            keras.layers.Dense(3),
+        ])
+        m.build((4, 6))
+        w = {l.name: l.get_weights() for l in m.layers if l.get_weights()}
+        net = KerasModelImport.importKerasSequentialModelAndWeights(
+            m.to_json(), weights=w)
+        x = np.random.RandomState(1).randn(4, 6).astype("float32")
+        golden = np.asarray(m(x))
+        ours = np.asarray(net.output(x).jax())
+        np.testing.assert_allclose(ours, golden, rtol=1e-4, atol=1e-5)
+
+    def test_reshape_wildcard_flatten(self):
+        keras.utils.set_random_seed(7)
+        m = keras.Sequential([
+            keras.layers.Conv2D(3, 3, name="c"),
+            keras.layers.Reshape((-1,), name="rs"),
+            keras.layers.Dense(4, name="d"),
+        ])
+        m.build((2, 6, 6, 2))
+        w = {l.name: l.get_weights() for l in m.layers if l.get_weights()}
+        net = KerasModelImport.importKerasSequentialModelAndWeights(
+            m.to_json(), weights=w)
+        x = np.random.RandomState(2).rand(2, 6, 6, 2).astype("float32")
+        golden = np.asarray(m(x))
+        ours = np.asarray(net.output(x.transpose(0, 3, 1, 2)).jax())
+        np.testing.assert_allclose(ours, golden, rtol=1e-4, atol=1e-5)
+
+    def test_relu_unsupported_params_loud(self):
+        spec = {"class_name": "Sequential", "config": {"layers": [
+            {"class_name": "InputLayer",
+             "config": {"batch_input_shape": [None, 4]}},
+            {"class_name": "ReLU",
+             "config": {"name": "r", "max_value": 4.0}},
+            {"class_name": "Dense",
+             "config": {"name": "d", "units": 2}},
+        ]}}
+        with pytest.raises(UnsupportedKerasConfigurationException,
+                           match="max_value"):
+            KerasModelImport.importKerasSequentialModelAndWeights(spec)
