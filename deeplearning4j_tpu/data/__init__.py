@@ -28,6 +28,10 @@ from deeplearning4j_tpu.data.augment import (
     ResizeImageTransform, RotateImageTransform, PipelineImageTransform,
     ImageAugmentationPreProcessor,
 )
+from deeplearning4j_tpu.data.audio import (
+    SpectrogramTransform, MelSpectrogramTransform, MFCCTransform,
+    WavFileRecordReader, mel_filterbank,
+)
 from deeplearning4j_tpu.data.records import (
     RecordReader, CSVRecordReader, CollectionRecordReader, ImageRecordReader,
     Schema, TransformProcess, RecordReaderDataSetIterator,
@@ -52,5 +56,7 @@ __all__ = [
     "DataAnalysis", "analyze", "ImageTransform", "FlipImageTransform",
     "RandomCropTransform", "ResizeImageTransform",
     "RotateImageTransform", "PipelineImageTransform",
-    "ImageAugmentationPreProcessor",
+    "ImageAugmentationPreProcessor", "SpectrogramTransform",
+    "MelSpectrogramTransform", "MFCCTransform", "WavFileRecordReader",
+    "mel_filterbank",
 ]

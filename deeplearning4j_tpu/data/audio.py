@@ -1,0 +1,236 @@
+"""Audio feature extraction — the DataVec audio path.
+
+Reference: datavec-data-audio (WavFileRecordReader + the spectrogram
+feature extraction upstream delegates to musicg/JTransforms on the JVM
+host). TPU-first design: framing, windowing, FFT, mel filterbank and
+DCT all run as ONE jitted batched program — the mel projection and DCT
+are matmuls (MXU work), and the whole front-end can sit on device in
+front of an acoustic model exactly like image augmentation does.
+
+Shapes: waveforms [B, T] float -> Spectrogram [B, frames, bins] ->
+MelSpectrogram [B, frames, numMel] -> MFCC [B, frames, numCoeffs].
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.data.records import RecordReader
+
+
+def _hann(n):
+    # periodic Hann, the STFT convention
+    return 0.5 - 0.5 * jnp.cos(2.0 * jnp.pi * jnp.arange(n) / n)
+
+
+def _frame(x, frame_length, frame_step):
+    """[B, T] -> [B, frames, frame_length]; trailing partial frame is
+    dropped (static shapes)."""
+    B, T = x.shape
+    n = 1 + (T - frame_length) // frame_step
+    if n < 1:
+        raise ValueError(
+            f"signal length {T} shorter than frame_length {frame_length}")
+    idx = (jnp.arange(n)[:, None] * frame_step
+           + jnp.arange(frame_length)[None, :])
+    return x[:, idx]
+
+
+def mel_filterbank(num_mel, fft_length, sample_rate, fmin=0.0, fmax=None):
+    """[bins, num_mel] triangular mel filterbank (HTK mel scale —
+    the convention upstream's speech examples use)."""
+    fmax = fmax if fmax is not None else sample_rate / 2.0
+    if not (0 <= fmin < fmax <= sample_rate / 2.0):
+        raise ValueError(f"need 0 <= fmin < fmax <= nyquist, got "
+                         f"[{fmin}, {fmax}] at rate {sample_rate}")
+
+    def hz_to_mel(f):
+        return 2595.0 * np.log10(1.0 + np.asarray(f) / 700.0)
+
+    def mel_to_hz(m):
+        return 700.0 * (10.0 ** (np.asarray(m) / 2595.0) - 1.0)
+
+    bins = fft_length // 2 + 1
+    mel_pts = np.linspace(hz_to_mel(fmin), hz_to_mel(fmax), num_mel + 2)
+    hz_pts = mel_to_hz(mel_pts)
+    bin_freqs = np.arange(bins) * sample_rate / fft_length
+    fb = np.zeros((bins, num_mel), np.float32)
+    for m in range(num_mel):
+        lo, ctr, hi = hz_pts[m], hz_pts[m + 1], hz_pts[m + 2]
+        up = (bin_freqs - lo) / max(ctr - lo, 1e-9)
+        down = (hi - bin_freqs) / max(hi - ctr, 1e-9)
+        fb[:, m] = np.maximum(0.0, np.minimum(up, down))
+    dead = np.flatnonzero(fb.max(0) == 0.0)
+    if dead.size:
+        raise ValueError(
+            f"mel filters {dead.tolist()} are all-zero: triangles narrower "
+            f"than the FFT bin spacing ({sample_rate / fft_length:.1f} Hz). "
+            "Reduce num_mel or increase fft_length")
+    return fb
+
+
+def _dct2(n_in, n_out):
+    """[n_in, n_out] orthonormal DCT-II matrix (scipy.fft.dct norm='ortho')."""
+    k = np.arange(n_out)[None, :]
+    i = np.arange(n_in)[:, None]
+    m = np.cos(np.pi * k * (2 * i + 1) / (2.0 * n_in))
+    m *= np.sqrt(2.0 / n_in)
+    m[:, 0] *= np.sqrt(0.5)
+    return m.astype(np.float32)
+
+
+class SpectrogramTransform:
+    """|STFT|^2 power spectrogram (reference: the musicg spectrogram
+    upstream's audio readers produce). The full pipeline (framing,
+    window, FFT, and subclasses' mel/DCT matmuls) compiles as ONE jitted
+    program, created lazily on first apply()."""
+
+    def __init__(self, frameLength=400, frameStep=160, fftLength=None):
+        self.frameLength = int(frameLength)
+        self.frameStep = int(frameStep)
+        self.fftLength = int(fftLength or self.frameLength)
+        if self.fftLength < self.frameLength:
+            raise ValueError("fftLength must be >= frameLength")
+        self._jit = None
+
+    def _compute(self, x):
+        frames = _frame(x, self.frameLength, self.frameStep)
+        frames = frames * _hann(self.frameLength)
+        spec = jnp.fft.rfft(frames, n=self.fftLength)
+        return jnp.abs(spec) ** 2
+
+    def apply(self, waveforms):
+        x = jnp.asarray(waveforms, jnp.float32)
+        if x.ndim != 2:
+            raise ValueError(f"waveforms must be [B, T], got {x.shape}")
+        if self._jit is None:  # lazy: subclass __init__ finishes first
+            self._jit = jax.jit(self._compute)
+        return self._jit(x)
+
+    def __call__(self, waveforms):
+        return self.apply(waveforms)
+
+
+class MelSpectrogramTransform(SpectrogramTransform):
+    def __init__(self, numMel=40, sampleRate=16000, fmin=0.0, fmax=None,
+                 logScale=True, **kw):
+        super().__init__(**kw)
+        self.numMel = int(numMel)
+        self.sampleRate = int(sampleRate)
+        self.logScale = bool(logScale)
+        self._fb = jnp.asarray(mel_filterbank(
+            self.numMel, self.fftLength, self.sampleRate, fmin, fmax))
+
+    def _compute(self, x):
+        power = super()._compute(x)
+        mel = power @ self._fb  # [B, frames, numMel] — an MXU matmul
+        if self.logScale:
+            mel = jnp.log(mel + 1e-6)
+        return mel
+
+
+class MFCCTransform(MelSpectrogramTransform):
+    def __init__(self, numCoeffs=13, **kw):
+        kw.setdefault("logScale", True)
+        super().__init__(**kw)
+        if not self.logScale:
+            raise ValueError("MFCC requires logScale=True (DCT of log-mel)")
+        self.numCoeffs = int(numCoeffs)
+        if self.numCoeffs > self.numMel:
+            raise ValueError(
+                f"numCoeffs {self.numCoeffs} > numMel {self.numMel}")
+        self._dct = jnp.asarray(_dct2(self.numMel, self.numCoeffs))
+
+    def _compute(self, x):
+        return super()._compute(x) @ self._dct
+
+
+class WavFileRecordReader(RecordReader):
+    """PCM .wav files -> float waveforms in [-1, 1] (reference:
+    datavec-data-audio WavFileRecordReader). Directory layout and record
+    shape mirror ImageRecordReader — ``root/<label>/<file>.wav`` ->
+    ``[waveform float array, labelIndex]`` with getLabels()/numLabels()
+    — so RecordReaderDataSetIterator consumes it directly. Stereo is
+    averaged to mono; `length` pads/truncates to a fixed static shape.
+    All files must share one sample rate (validated at initialize;
+    exposed as `.sampleRate`)."""
+
+    arrayRecords = True  # record = [array, labelIndex]
+
+    def __init__(self, length=None):
+        self.length = None if length is None else int(length)
+        self.sampleRate = None
+        self._files = []
+        self._label_names = []
+        self._i = 0
+
+    def initialize(self, root):
+        import wave
+        from pathlib import Path
+
+        root = Path(root)
+        classes = sorted(d.name for d in root.iterdir() if d.is_dir())
+        self._label_names = classes
+        self._files = []
+        rates = {}
+        for ci, cname in enumerate(classes):
+            for f in sorted((root / cname).iterdir()):
+                if f.suffix.lower() == ".wav" and f.is_file():
+                    self._files.append((f, ci))
+                    with wave.open(str(f), "rb") as w:
+                        rates.setdefault(w.getframerate(), f)
+        if not self._files:
+            raise ValueError(f"no .wav files under {root} "
+                             "(expected root/<label>/<file>.wav)")
+        if len(rates) > 1:
+            raise ValueError(
+                f"mixed sample rates {sorted(rates)} under {root}; "
+                "resample to one rate first")
+        self.sampleRate = next(iter(rates))
+        self._i = 0
+        return self
+
+    def getLabels(self):
+        return list(self._label_names)
+
+    def numLabels(self) -> int:
+        return len(self._label_names)
+
+    def hasNext(self):
+        return self._i < len(self._files)
+
+    def reset(self):
+        self._i = 0
+
+    @staticmethod
+    def _read(path):
+        import wave
+
+        with wave.open(str(path), "rb") as w:
+            nch = w.getnchannels()
+            width = w.getsampwidth()
+            raw = w.readframes(w.getnframes())
+            rate = w.getframerate()
+        if width == 2:
+            data = np.frombuffer(raw, "<i2").astype(np.float32) / 32768.0
+        elif width == 1:  # unsigned 8-bit PCM
+            data = (np.frombuffer(raw, "u1").astype(np.float32) - 128.0) / 128.0
+        else:
+            raise ValueError(f"unsupported WAV sample width {width} bytes")
+        if nch > 1:
+            data = data.reshape(-1, nch).mean(1)
+        return data, rate
+
+    def next(self):
+        path, label = self._files[self._i]
+        self._i += 1
+        data, _ = self._read(path)
+        if self.length is not None:
+            if len(data) >= self.length:
+                data = data[:self.length]
+            else:
+                data = np.pad(data, (0, self.length - len(data)))
+        return [data, label]

@@ -478,7 +478,10 @@ class RecordReaderDataSetIterator:
                  regression: bool = False, shuffle=False, seed=123):
         feats, labels = [], []
         recordReader.reset()
-        image_mode = isinstance(recordReader, ImageRecordReader)
+        # readers whose records are [ndarray, labelIndex] (images, audio)
+        # rather than flat value lists mark themselves arrayRecords
+        image_mode = isinstance(recordReader, ImageRecordReader) or \
+            getattr(recordReader, "arrayRecords", False)
         while recordReader.hasNext():
             rec = recordReader.next()
             if image_mode:

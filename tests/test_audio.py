@@ -1,0 +1,207 @@
+"""Audio feature extraction (reference: datavec-data-audio) — STFT/mel/
+MFCC against numpy/scipy oracles, WAV reading via stdlib wave files."""
+
+import wave
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import (
+    SpectrogramTransform, MelSpectrogramTransform, MFCCTransform,
+    WavFileRecordReader, mel_filterbank,
+)
+
+
+def _tone(freq, n=4000, rate=16000, amp=0.5):
+    t = np.arange(n) / rate
+    return (amp * np.sin(2 * np.pi * freq * t)).astype("float32")
+
+
+class TestSpectrogram:
+    def test_matches_numpy_stft_oracle(self):
+        x = np.random.RandomState(0).randn(2, 1000).astype("float32")
+        t = SpectrogramTransform(frameLength=256, frameStep=128)
+        out = np.asarray(t.apply(x))
+        n_frames = 1 + (1000 - 256) // 128
+        assert out.shape == (2, n_frames, 129)
+        win = 0.5 - 0.5 * np.cos(2 * np.pi * np.arange(256) / 256)
+        for f in range(n_frames):
+            seg = x[0, f * 128:f * 128 + 256] * win
+            oracle = np.abs(np.fft.rfft(seg)) ** 2
+            np.testing.assert_allclose(out[0, f], oracle, rtol=1e-4,
+                                       atol=1e-4)
+
+    def test_tone_peaks_at_its_bin(self):
+        x = _tone(1000.0)[None, :]  # 1 kHz at 16 kHz rate
+        t = SpectrogramTransform(frameLength=512, frameStep=256)
+        out = np.asarray(t.apply(x))
+        peak_bin = out.mean(1)[0].argmax()
+        assert abs(peak_bin * 16000 / 512 - 1000.0) < 16000 / 512
+
+    def test_guards(self):
+        with pytest.raises(ValueError, match="fftLength"):
+            SpectrogramTransform(frameLength=256, fftLength=128)
+        with pytest.raises(ValueError, match="shorter"):
+            SpectrogramTransform(frameLength=256).apply(
+                np.zeros((1, 100), "float32"))
+        with pytest.raises(ValueError, match="B, T"):
+            SpectrogramTransform().apply(np.zeros(1000, "float32"))
+
+
+class TestMelAndMFCC:
+    def test_filterbank_properties(self):
+        fb = mel_filterbank(20, 512, 16000)
+        assert fb.shape == (257, 20)
+        assert (fb >= 0).all()
+        # each filter is a triangle: a unique peak, nonzero support
+        assert (fb.max(0) > 0).all()
+        # filters are ordered in frequency
+        peaks = fb.argmax(0)
+        assert (np.diff(peaks) > 0).all()
+        with pytest.raises(ValueError, match="nyquist"):
+            mel_filterbank(10, 512, 16000, fmin=0, fmax=9000)
+
+    def test_mel_against_manual_projection(self):
+        x = np.random.RandomState(1).randn(1, 2000).astype("float32")
+        m = MelSpectrogramTransform(numMel=24, sampleRate=16000,
+                                    frameLength=400, frameStep=160,
+                                    fftLength=512, logScale=False)
+        power = np.asarray(SpectrogramTransform(400, 160, 512).apply(x))
+        fb = mel_filterbank(24, 512, 16000)
+        np.testing.assert_allclose(np.asarray(m.apply(x)), power @ fb,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_mfcc_dct_matches_scipy(self):
+        from scipy.fft import dct as scipy_dct
+
+        x = np.random.RandomState(2).randn(1, 2000).astype("float32")
+        t = MFCCTransform(numCoeffs=13, numMel=26, sampleRate=16000,
+                          frameLength=400, frameStep=160, fftLength=512)
+        out = np.asarray(t.apply(x))
+        assert out.shape[-1] == 13
+        logmel = np.asarray(MelSpectrogramTransform(
+            numMel=26, sampleRate=16000, frameLength=400, frameStep=160,
+            fftLength=512).apply(x))
+        oracle = scipy_dct(logmel, type=2, norm="ortho", axis=-1)[..., :13]
+        np.testing.assert_allclose(out, oracle, rtol=1e-3, atol=1e-3)
+
+    def test_mfcc_guards(self):
+        with pytest.raises(ValueError, match="numCoeffs"):
+            MFCCTransform(numCoeffs=30, numMel=20)
+        with pytest.raises(ValueError, match="logScale"):
+            MFCCTransform(numCoeffs=5, numMel=20, logScale=False)
+
+
+class TestWavReader:
+    def _write_wav(self, path, data, rate=16000, width=2, nch=1):
+        with wave.open(str(path), "wb") as w:
+            w.setnchannels(nch)
+            w.setsampwidth(width)
+            w.setframerate(rate)
+            if width == 2:
+                w.writeframes((data * 32767).astype("<i2").tobytes())
+            else:
+                w.writeframes(((data * 127) + 128).astype("u1").tobytes())
+
+    def test_reads_labels_and_roundtrips(self, tmp_path):
+        (tmp_path / "yes").mkdir()
+        (tmp_path / "no").mkdir()
+        a = _tone(440, n=800)
+        b = _tone(880, n=600)
+        self._write_wav(tmp_path / "yes" / "a.wav", a)
+        self._write_wav(tmp_path / "no" / "b.wav", b)
+        rr = WavFileRecordReader(length=800).initialize(tmp_path)
+        assert rr.getLabels() == ["no", "yes"] and rr.numLabels() == 2
+        assert rr.sampleRate == 16000
+        recs = []
+        while rr.hasNext():
+            recs.append(rr.next())
+        by_label = {rr.getLabels()[r[1]]: r for r in recs}
+        np.testing.assert_allclose(by_label["yes"][0], a, atol=2e-4)
+        # shorter file zero-padded to the static length
+        assert len(by_label["no"][0]) == 800
+        np.testing.assert_allclose(by_label["no"][0][600:], 0.0)
+        rr.reset()
+        assert rr.hasNext()
+
+    def test_feeds_record_reader_dataset_iterator(self, tmp_path):
+        from deeplearning4j_tpu.data import RecordReaderDataSetIterator
+
+        for lab, freq in (("lo", 500.0), ("hi", 2000.0)):
+            (tmp_path / lab).mkdir()
+            for i in range(3):
+                self._write_wav(tmp_path / lab / f"{i}.wav",
+                                _tone(freq, n=400))
+        it = RecordReaderDataSetIterator(
+            WavFileRecordReader(length=400).initialize(tmp_path),
+            batchSize=6)
+        ds = it.next()
+        assert ds.getFeatures().shape() == (6, 400)
+        y = np.asarray(ds.getLabels().jax())
+        assert y.shape == (6, 2)
+        np.testing.assert_allclose(y.sum(1), 1.0)
+
+    def test_mixed_sample_rates_rejected(self, tmp_path):
+        (tmp_path / "x").mkdir()
+        self._write_wav(tmp_path / "x" / "a.wav", _tone(440, n=200))
+        self._write_wav(tmp_path / "x" / "b.wav", _tone(440, n=200),
+                        rate=8000)
+        with pytest.raises(ValueError, match="mixed sample rates"):
+            WavFileRecordReader().initialize(tmp_path)
+
+    def test_stereo_averaged_and_8bit(self, tmp_path):
+        (tmp_path / "x").mkdir()
+        stereo = np.stack([_tone(440, n=200), -_tone(440, n=200)], 1).ravel()
+        self._write_wav(tmp_path / "x" / "s.wav", stereo, nch=2)
+        self._write_wav(tmp_path / "x" / "e.wav", _tone(440, n=200), width=1)
+        rr = WavFileRecordReader().initialize(tmp_path)
+        assert rr.getLabels() == ["x"]
+        waves = [rr.next()[0], rr.next()[0]]  # sorted: e.wav, s.wav
+        mono8, stereo = waves
+        # stereo L = -R: mono average cancels to ~0
+        assert float(np.abs(stereo).max()) < 1e-3
+        assert float(np.abs(mono8).max()) > 0.2  # the 8-bit mono tone
+
+    def test_empty_dir_loud(self, tmp_path):
+        (tmp_path / "cls").mkdir()
+        with pytest.raises(ValueError, match="no .wav"):
+            WavFileRecordReader().initialize(tmp_path)
+
+    def test_mel_dead_filters_rejected(self):
+        with pytest.raises(ValueError, match="all-zero"):
+            mel_filterbank(80, 256, 16000)
+
+
+class TestEndToEnd:
+    def test_mfcc_frontend_trains_classifier(self):
+        # two synthetic 'keywords' (tones) -> MFCC -> dense classifier
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           MultiLayerNetwork, DenseLayer,
+                                           OutputLayer, Adam)
+
+        rng = np.random.RandomState(3)
+        X, y = [], []
+        for _ in range(40):
+            f = 500.0 if rng.rand() < 0.5 else 2000.0
+            w = _tone(f, n=1600) + rng.randn(1600).astype("float32") * 0.05
+            X.append(w)
+            y.append(0 if f == 500.0 else 1)
+        feats = np.asarray(MFCCTransform(
+            numCoeffs=13, numMel=26, frameLength=400, frameStep=160,
+            fftLength=512).apply(np.stack(X)))
+        flat = feats.reshape(len(X), -1)
+        labels = np.eye(2, dtype="float32")[y]
+        conf = (NeuralNetConfiguration.Builder().seed(1).updater(Adam(1e-2))
+                .list()
+                .layer(DenseLayer(nOut=16, activation="relu"))
+                .layer(OutputLayer(nOut=2, activation="softmax",
+                                   lossFunction="mcxent"))
+                .setInputType(InputType.feedForward(flat.shape[1])).build())
+        net = MultiLayerNetwork(conf).init()
+        for _ in range(30):
+            net.fit(flat.astype("float32"), labels)
+        from deeplearning4j_tpu.evaluation import Evaluation
+
+        ev = Evaluation(2)
+        ev.eval(labels, np.asarray(net.output(flat.astype("float32")).jax()))
+        assert ev.accuracy() == 1.0, ev.accuracy()
