@@ -92,6 +92,7 @@ class ImageRecordReader(RecordReader):
     with ParentPathLabelGenerator): ``root/<label>/<file>.png`` ->
     record ``[CHW float array, labelIndex]``."""
 
+    arrayRecords = True  # record = [array, labelIndex]
     EXTS = {".png", ".jpg", ".jpeg", ".bmp", ".gif"}
 
     def __init__(self, height: int, width: int, channels: int = 3):
@@ -480,8 +481,7 @@ class RecordReaderDataSetIterator:
         recordReader.reset()
         # readers whose records are [ndarray, labelIndex] (images, audio)
         # rather than flat value lists mark themselves arrayRecords
-        image_mode = isinstance(recordReader, ImageRecordReader) or \
-            getattr(recordReader, "arrayRecords", False)
+        image_mode = getattr(recordReader, "arrayRecords", False)
         while recordReader.hasNext():
             rec = recordReader.next()
             if image_mode:
@@ -491,7 +491,14 @@ class RecordReaderDataSetIterator:
                 li = labelIndex if labelIndex >= 0 else len(rec) - 1
                 labels.append(rec[li])
                 feats.append([float(v) for j, v in enumerate(rec) if j != li])
-        f = np.asarray(feats, np.float32)
+        try:
+            f = np.asarray(feats, np.float32)
+        except ValueError as e:
+            shapes = sorted({np.shape(x) for x in feats})
+            raise ValueError(
+                f"records have inconsistent shapes {shapes[:4]}; batching "
+                "needs fixed-size records (WavFileRecordReader: pass "
+                "length=N to pad/truncate)") from e
         if regression:
             l = np.asarray(labels, np.float32).reshape(len(labels), -1)
         else:

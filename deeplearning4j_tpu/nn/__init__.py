@@ -27,6 +27,8 @@ from deeplearning4j_tpu.nn.conf.layers import (
     SameDiffLayer, SameDiffLambdaLayer,
     Subsampling1DLayer, ZeroPadding1DLayer, RepeatVector,
     ElementWiseMultiplicationLayer, AutoEncoder,
+    Subsampling3DLayer, ZeroPadding3D, Deconvolution3D, MaskLayer,
+    MaskZeroLayer, FrozenLayerWithBackprop,
 )
 from deeplearning4j_tpu.nn.conf.dropout import (
     Dropout, GaussianDropout, GaussianNoise, AlphaDropout, SpatialDropout,

@@ -105,6 +105,7 @@ class MultiLayerConfiguration:
 
     @staticmethod
     def _wants(layer):
+        layer = _unwrap_layer(layer)
         if isinstance(layer, (R.BaseRecurrentLayer, R.Bidirectional, R.LastTimeStep,
                               L.RnnOutputLayer, L.Convolution1DLayer, L.EmbeddingSequenceLayer)):
             return InputType.RNN
@@ -118,6 +119,21 @@ class MultiLayerConfiguration:
 
     def _auto_preprocessor(self, layer, cur):
         return auto_preprocessor(layer, cur)
+
+
+def _unwrap_layer(layer):
+    """Look through delegating wrappers (MaskZeroLayer.underlying,
+    FrozenLayerWithBackprop.layer, ...) for isinstance-based format and
+    nIn inference."""
+    seen = 0
+    while seen < 8:  # cycle guard
+        inner = layer.__dict__.get("underlying") or layer.__dict__.get("layer")
+        if inner is None or isinstance(layer, R.Bidirectional):
+            # Bidirectional declares its own RNN format; don't unwrap it
+            return layer
+        layer = inner
+        seen += 1
+    return layer
 
 
 def auto_preprocessor(layer, cur):
@@ -207,7 +223,8 @@ class ListBuilder:
             conf.inferShapes()
         else:
             # all nIn set explicitly: derive input type from first layer
-            first = self._layers[0]
+            # (looking through wrapper layers for both nIn and format)
+            first = _unwrap_layer(self._layers[0])
             if getattr(first, "nIn", None) is None:
                 raise ValueError("Either setInputType(...) or nIn on the first layer")
             conf.inputType = InputType.feedForward(first.nIn) \

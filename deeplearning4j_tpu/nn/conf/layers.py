@@ -395,8 +395,13 @@ class Deconvolution2D(ConvolutionLayer):
 
     def forward(self, params, state, x, train, key, mask=None):
         x = self._dropout_input(x, train, key)
-        pad = _conv.explicit_padding(self.convolutionMode, self.padding,
-                                     self.kernelSize, self.stride, self.dilation)
+        # NOT explicit_padding: conv_transpose's (lo, hi) pairs mean
+        # something different from the forward conv's — see
+        # deconv_explicit_padding. Using (pad, pad) here made the output
+        # size disagree with getOutputType for any k != 2*pad + 1.
+        pad = _conv.deconv_explicit_padding(
+            self.convolutionMode, self.padding, self.kernelSize,
+            self.dilation)
         y = _conv.deconv2d(x, params["W"], params.get("b"), self.stride, pad, self.dilation)
         return _act.get(self.activation)(y), state
 
@@ -878,6 +883,210 @@ class Upsampling3D(Layer):
         for ax, s in zip((1, 2, 3), self.sizev):
             x = jnp.repeat(x, s, axis=ax)
         return x, state
+
+
+class Subsampling3DLayer(Layer):
+    """3D max/avg pooling over NDHWC (reference: Subsampling3DLayer)."""
+
+    def __init__(self, poolingType="max", kernelSize=(2, 2, 2),
+                 stride=(2, 2, 2), padding=(0, 0, 0),
+                 convolutionMode="truncate", **kw):
+        super().__init__(**kw)
+        t3 = lambda v: (v, v, v) if isinstance(v, int) else tuple(v)
+        self.poolingType = poolingType
+        self.kernelSize = t3(kernelSize)
+        self.stride = t3(stride)
+        self.padding = t3(padding)
+        self.convolutionMode = convolutionMode
+
+    def hasParams(self):
+        return False
+
+    def getOutputType(self, inputType):
+        dims = (inputType.depth, inputType.height, inputType.width)
+        d, h, w = (
+            _conv.conv_output_size(v, self.kernelSize[i], self.stride[i],
+                                   self.padding[i], 1, self.convolutionMode)
+            for i, v in enumerate(dims))
+        return InputType.convolutional3D(d, h, w, inputType.channels)
+
+    def forward(self, params, state, x, train, key, mask=None):
+        mode = str(self.convolutionMode).lower()
+        pad = "SAME" if mode == "same" else tuple(
+            (p, p) for p in self.padding)
+        t = str(self.poolingType).lower()
+        if t == "max":
+            y = _pool.max_pool3d(x, self.kernelSize, self.stride, pad)
+        elif t == "avg":
+            y = _pool.avg_pool3d(x, self.kernelSize, self.stride, pad)
+        else:
+            raise ValueError(f"Unknown poolingType {self.poolingType}")
+        return y, state
+
+
+class ZeroPadding3D(Layer):
+    """Zero-pad D/H/W of NDHWC data (reference: ZeroPadding3DLayer)."""
+
+    def __init__(self, padding=(1, 1, 1), **kw):
+        super().__init__(**kw)
+        p = padding
+        if isinstance(p, int):
+            p = ((p, p),) * 3
+        elif len(p) == 3 and not isinstance(p[0], (list, tuple)):
+            p = tuple((int(v), int(v)) for v in p)
+        else:
+            p = tuple((int(a), int(b)) for a, b in p)
+        self.pad = p  # ((dlo,dhi),(hlo,hhi),(wlo,whi))
+
+    def hasParams(self):
+        return False
+
+    def getOutputType(self, inputType):
+        (dl, dh), (hl, hh), (wl, wh) = self.pad
+        return InputType.convolutional3D(
+            inputType.depth + dl + dh, inputType.height + hl + hh,
+            inputType.width + wl + wh, inputType.channels)
+
+    def forward(self, params, state, x, train, key, mask=None):
+        return jnp.pad(x, ((0, 0),) + self.pad + ((0, 0),)), state
+
+
+class Deconvolution3D(Convolution3D):
+    """Transposed 3D conv (reference: conf.layers.Deconvolution3D)."""
+
+    def getOutputType(self, inputType):
+        dims = (inputType.depth, inputType.height, inputType.width)
+        d, h, w = (
+            _conv.deconv_output_size(v, self.kernelSize[i], self.stride[i],
+                                     self.padding[i], self.dilation[i],
+                                     self.convolutionMode)
+            for i, v in enumerate(dims))
+        return InputType.convolutional3D(d, h, w, self.nOut)
+
+    def forward(self, params, state, x, train, key, mask=None):
+        # weight layout (*k, nIn, nOut) inherited from Convolution3D —
+        # lax.conv_transpose reads the kernel spec relative to ITS input,
+        # so the forward-conv layout is the right one (same as Deconv2D)
+        x = self._dropout_input(x, train, key)
+        pad = _conv.deconv3d_explicit_padding(
+            self.convolutionMode, self.padding, self.kernelSize,
+            self.dilation)
+        y = _conv.deconv3d(x, params["W"], params.get("b"), self.stride,
+                           pad, self.dilation)
+        return _act.get(self.activation)(y), state
+
+
+class MaskLayer(Layer):
+    """Zero out masked time steps of NCW activations (reference:
+    util.MaskLayer — makes downstream layers that ignore masks safe)."""
+
+    def hasParams(self):
+        return False
+
+    def getOutputType(self, inputType):
+        return inputType
+
+    def forward(self, params, state, x, train, key, mask=None):
+        if mask is not None and x.ndim == 3:
+            x = x * mask[:, None, :]
+        return x, state
+
+
+class MaskZeroLayer(Layer):
+    """Wrap a recurrent layer, deriving the time mask from the INPUT:
+    steps whose every feature equals maskValue are masked (reference:
+    recurrent.MaskZeroLayer — the pad-with-zeros convention)."""
+
+    def __init__(self, underlying, maskValue=0.0, **kw):
+        super().__init__(**kw)
+        self.underlying = underlying
+        self.maskValue = float(maskValue)
+
+    def hasParams(self):
+        return self.underlying.hasParams()
+
+    def mergeGlobals(self, defaults):
+        super().mergeGlobals(defaults)
+        self.underlying.mergeGlobals(defaults)
+
+    def inferNIn(self, inputType):
+        if hasattr(self.underlying, "inferNIn"):
+            self.underlying.inferNIn(inputType)
+
+    def getOutputType(self, inputType):
+        return self.underlying.getOutputType(inputType)
+
+    def initialize(self, key, inputType, dtype):
+        return self.underlying.initialize(key, inputType, dtype)
+
+    def regularization(self, params):
+        # the wrapped layer's l1/l2/weightDecay must not silently vanish
+        return self.underlying.regularization(params)
+
+    @property
+    def constraints(self):
+        own = getattr(self, "_own_constraints", None)
+        return own if own else getattr(self.underlying, "constraints", None)
+
+    @constraints.setter
+    def constraints(self, v):
+        self._own_constraints = v
+
+    def forward(self, params, state, x, train, key, mask=None):
+        derived = jnp.any(x != self.maskValue, axis=1).astype(x.dtype)
+        if mask is not None:
+            derived = derived * mask
+        return self.underlying.forward(params, state, x, train, key,
+                                       derived)
+
+
+class FrozenLayerWithBackprop(Layer):
+    """Freeze the wrapped layer's parameters while KEEPING train-mode
+    semantics (dropout stays active; BN uses batch stats) — unlike the
+    plain frozen flag, which forces inference mode (reference:
+    misc.FrozenLayerWithBackprop vs misc.FrozenLayer). Gradients flow
+    through to earlier layers either way; the wrapped params get
+    structurally zero updates."""
+
+    def __init__(self, layer, **kw):
+        super().__init__(**kw)
+        self.layer = layer
+        self.frozen = True
+        self.frozenKeepTraining = True
+
+    # base-class methods must be delegated EXPLICITLY (__getattr__ only
+    # fires for attributes the class hierarchy does not define)
+    def hasParams(self):
+        return self.layer.hasParams()
+
+    def mergeGlobals(self, defaults):
+        super().mergeGlobals(defaults)
+        self.layer.mergeGlobals(defaults)
+
+    def inferNIn(self, inputType):
+        if hasattr(self.layer, "inferNIn"):
+            self.layer.inferNIn(inputType)
+
+    def getOutputType(self, inputType):
+        return self.layer.getOutputType(inputType)
+
+    def initialize(self, key, inputType, dtype):
+        return self.layer.initialize(key, inputType, dtype)
+
+    def forward(self, params, state, x, train, key, mask=None):
+        return self.layer.forward(params, state, x, train, key, mask)
+
+    def regularization(self, params):
+        return self.layer.regularization(params)
+
+    def __getattr__(self, item):
+        # delegate remaining attribute reads (nIn/nOut/activation/...).
+        # Must raise AttributeError (not KeyError) when 'layer' itself is
+        # absent — deepcopy/pickle probe attributes before __dict__ is
+        # repopulated during reconstruction
+        if "layer" not in self.__dict__:
+            raise AttributeError(item)
+        return getattr(self.__dict__["layer"], item)
 
 
 class SpaceToDepth(Layer):

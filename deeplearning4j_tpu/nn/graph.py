@@ -154,7 +154,9 @@ class ComputationGraph:
             # frozen layers run in inference mode (no dropout, BN keeps its
             # running stats) — mirrors MultiLayerNetwork._run_layers and the
             # reference's FrozenLayer/FrozenVertex
-            l_train = train and not getattr(layer, "frozen", False)
+            l_train = train and (not getattr(layer, "frozen", False)
+                                 or getattr(layer, "frozenKeepTraining",
+                                            False))
             lk = None if (key is None or not l_train) else \
                 jax.random.fold_in(key, self._layer_idx[name])
             p = self._cast_params(params[name])

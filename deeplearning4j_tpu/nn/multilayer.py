@@ -221,7 +221,9 @@ class MultiLayerNetwork:
             # dropout, and BN uses+preserves its stored running stats — the
             # reference's FrozenLayer forces the wrapped layer into inference
             # the same way, so the frozen feature extractor cannot drift
-            l_train = train and not getattr(layer, "frozen", False)
+            l_train = train and (not getattr(layer, "frozen", False)
+                                 or getattr(layer, "frozenKeepTraining",
+                                            False))
             lk = None if (key is None or not l_train) else jax.random.fold_in(key, i)
             p = self._cast_params(params[i])
             if i == len(self.layers) - 1 and isinstance(layer, (L.BaseOutputLayer, L.LossLayer)):

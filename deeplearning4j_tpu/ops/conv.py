@@ -35,6 +35,30 @@ def explicit_padding(mode, padding, kernel, stride, dilation):
     return ((ph, ph), (pw, pw))
 
 
+def _deconv_pads(mode, padding, kernel, dilation):
+    """ConvolutionMode + configured pad -> lax.conv_transpose padding
+    that reproduces the reference's deconv output size
+    s*(in-1) + k_eff - 2*pad. lax's explicit (lo, hi) pairs ADD to the
+    output relative to a (k_eff-1)-padded baseline, so the mapping is
+    lo = hi = k_eff - 1 - pad (NOT the forward-conv (pad, pad)).
+    n-dimensional: padding/kernel/dilation are equal-length tuples."""
+    if str(mode).lower() == "same":
+        return "SAME"
+    pads = []
+    for p, k, d in zip(padding, kernel, dilation):
+        k_eff = (k - 1) * d + 1
+        pads.append((k_eff - 1 - p, k_eff - 1 - p))
+    return tuple(pads)
+
+
+def deconv_explicit_padding(mode, padding, kernel, dilation):
+    return _deconv_pads(mode, _pair(padding), _pair(kernel), _pair(dilation))
+
+
+def deconv3d_explicit_padding(mode, padding, kernel, dilation):
+    return _deconv_pads(mode, padding, kernel, dilation)
+
+
 def conv2d(x, w, b=None, stride=(1, 1), padding=((0, 0), (0, 0)), dilation=(1, 1),
            groups=1):
     """x: [B,H,W,Cin], w: [kh,kw,Cin/groups,Cout] -> [B,H',W',Cout]."""
@@ -87,6 +111,23 @@ def conv3d(x, w, b=None, stride=(1, 1, 1), padding=((0, 0),) * 3,
     out = lax.conv_general_dilated(
         x, w,
         window_strides=tuple(stride),
+        padding=padding,
+        rhs_dilation=tuple(dilation),
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+    )
+    if b is not None:
+        out = out + b
+    return out
+
+
+def deconv3d(x, w, b=None, stride=(1, 1, 1), padding=((0, 0),) * 3,
+             dilation=(1, 1, 1)):
+    """Transposed 3D convolution. w: [kd,kh,kw,Cin,Cout] — the forward
+    layout; conv_transpose reads I against its own input channels
+    (reference: Deconvolution3D)."""
+    out = lax.conv_transpose(
+        x, w,
+        strides=tuple(stride),
         padding=padding,
         rhs_dilation=tuple(dilation),
         dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),

@@ -188,6 +188,46 @@ def pnorm_pool2d(x, kernel, stride, padding, p=2):
     return jnp.power(summed, 1.0 / p)
 
 
+def _triple(v):
+    return (v, v, v) if isinstance(v, int) else tuple(v)
+
+
+def max_pool3d(x, kernel, stride, padding):
+    """[B,D,H,W,C] max pooling (reference: Subsampling3DLayer). Stock
+    gradient — 3D pooling is not on the flagship hot path."""
+    k, s = _triple(kernel), _triple(stride)
+    pad = padding if padding == "SAME" else \
+        ((0, 0),) + tuple(padding) + ((0, 0),)
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max,
+        window_dimensions=(1, k[0], k[1], k[2], 1),
+        window_strides=(1, s[0], s[1], s[2], 1),
+        padding=pad if padding != "SAME" else "SAME",
+    )
+
+
+def avg_pool3d(x, kernel, stride, padding, count_include_pad=True):
+    k, s = _triple(kernel), _triple(stride)
+    pad = padding if padding == "SAME" else \
+        ((0, 0),) + tuple(padding) + ((0, 0),)
+    summed = lax.reduce_window(
+        x, 0.0, lax.add,
+        window_dimensions=(1, k[0], k[1], k[2], 1),
+        window_strides=(1, s[0], s[1], s[2], 1),
+        padding=pad if padding != "SAME" else "SAME",
+    )
+    if count_include_pad and padding != "SAME":
+        return summed / (k[0] * k[1] * k[2])
+    ones = jnp.ones_like(x)
+    counts = lax.reduce_window(
+        ones, 0.0, lax.add,
+        window_dimensions=(1, k[0], k[1], k[2], 1),
+        window_strides=(1, s[0], s[1], s[2], 1),
+        padding=pad if padding != "SAME" else "SAME",
+    )
+    return summed / counts
+
+
 def upsample2d(x, size):
     """Nearest-neighbour upsampling [B,H,W,C] (reference: Upsampling2D)."""
     sh, sw = _pair(size)
