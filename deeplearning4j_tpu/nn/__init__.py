@@ -33,6 +33,9 @@ from deeplearning4j_tpu.nn.conf.layers import (
 from deeplearning4j_tpu.nn.conf.dropout import (
     Dropout, GaussianDropout, GaussianNoise, AlphaDropout, SpatialDropout,
 )
+from deeplearning4j_tpu.nn.conf.weightnoise import (
+    DropConnect, WeightNoise,
+)
 from deeplearning4j_tpu.nn.conf.constraint import (
     MaxNormConstraint, MinMaxNormConstraint, NonNegativeConstraint,
     UnitNormConstraint,

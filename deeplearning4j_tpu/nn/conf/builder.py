@@ -301,6 +301,13 @@ class NeuralNetConfiguration:
             self._d["dropOut"] = v if not isinstance(v, (int, float)) else float(v)
             return self
 
+        def weightNoise(self, wn):
+            """Per-step weight perturbation during training (reference:
+            NeuralNetConfiguration.Builder.weightNoise — DropConnect or
+            WeightNoise from nn.conf.weightnoise)."""
+            self._d["weightNoise"] = wn
+            return self
+
         def _add_constraints(self, constraints, weights, biases):
             import copy
 

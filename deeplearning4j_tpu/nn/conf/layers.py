@@ -65,12 +65,13 @@ class Layer:
     # fields that fall back to globals when None
     _GLOBAL_FIELDS = ("activation", "weightInit", "biasInit", "updater",
                       "biasUpdater", "l1", "l2", "l1Bias", "l2Bias",
-                      "weightDecay", "dropOut", "distribution", "constraints")
+                      "weightDecay", "dropOut", "distribution", "constraints",
+                      "weightNoise")
 
     def __init__(self, name=None, activation=None, weightInit=None, biasInit=None,
                  updater=None, biasUpdater=None, l1=None, l2=None, l1Bias=None,
                  l2Bias=None, weightDecay=None, dropOut=None, distribution=None,
-                 constraints=None):
+                 constraints=None, weightNoise=None):
         self.name = name
         self.activation = activation
         self.weightInit = weightInit
@@ -83,6 +84,7 @@ class Layer:
         self.dropOut = dropOut
         self.distribution = distribution
         self.constraints = constraints
+        self.weightNoise = weightNoise
 
     @classmethod
     def Builder(cls, **kw):

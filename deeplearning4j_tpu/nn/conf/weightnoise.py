@@ -1,0 +1,117 @@
+"""Weight noise — DropConnect and additive/multiplicative noise.
+
+Reference: org.deeplearning4j.nn.conf.weightnoise.{DropConnect,
+WeightNoise} (IWeightNoise): perturb a layer's WEIGHTS (not its
+activations) during training forward passes; inference uses the clean
+weights. Applied functionally inside the jitted train step — the noisy
+weights are a pure function of (params, step key), so gradients flow
+through the perturbation exactly like upstream's backprop-through-
+masked-weights, and runs remain bit-reproducible from the step key.
+
+By default only weight matrices are perturbed ('W'-keyed entries and
+friends); biases opt in via applyToBias, matching upstream.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _weight_leaves(params):
+    """Walk an arbitrarily-nested layer param dict (wrapper layers like
+    Bidirectional store {'fwd': {...}, 'bwd': {...}}). Yields
+    ((path tuple), leaf key, array). The 'is this a weight' question
+    reuses Layer._NON_WEIGHT_PARAMS — the codebase's single param
+    classification (bias/beta/centers/alpha/vb) — instead of a parallel
+    hand-written set."""
+    def walk(d, path):
+        for k in sorted(d):
+            v = d[k]
+            if isinstance(v, dict):
+                yield from walk(v, path + (k,))
+            else:
+                yield path + (k,), k, v
+
+    yield from walk(params, ())
+
+
+def _rebuild(params, replacements):
+    """replacements: {path tuple: new array} -> new nested dict."""
+    def build(d, path):
+        out = {}
+        for k, v in d.items():
+            p = path + (k,)
+            out[k] = build(v, p) if isinstance(v, dict) \
+                else replacements.get(p, v)
+        return out
+
+    return build(params, ())
+
+
+# actual bias vectors within _NON_WEIGHT_PARAMS; the remainder
+# ('centers', 'alpha') are parameters with their own dynamics that
+# weight noise must NEVER touch, applyToBias or not
+_TRUE_BIASES = frozenset({"b", "beta", "vb"})
+
+
+class IWeightNoise:
+    def apply(self, params: dict, key) -> dict:
+        """params: one layer's (possibly nested) param dict, already
+        cast to compute dtype. Returns the perturbed dict; trace-safe."""
+        raise NotImplementedError
+
+    def _perturb(self, params, key, fn):
+        from deeplearning4j_tpu.nn.conf.layers import Layer
+
+        repl = {}
+        for i, (path, leaf, v) in enumerate(_weight_leaves(params)):
+            if not jnp.issubdtype(v.dtype, jnp.inexact):
+                continue
+            if leaf in Layer._NON_WEIGHT_PARAMS:
+                if not (self.applyToBias and leaf in _TRUE_BIASES):
+                    continue
+            repl[path] = fn(jax.random.fold_in(key, i), v)
+        return _rebuild(params, repl)
+
+
+class DropConnect(IWeightNoise):
+    """Zero each weight independently with prob 1-p, scaling kept
+    weights by 1/p (inverted dropout on WEIGHTS — reference:
+    weightnoise.DropConnect(weightRetainProb))."""
+
+    def __init__(self, weightRetainProb, applyToBias=False):
+        if not (0.0 < weightRetainProb <= 1.0):
+            raise ValueError(
+                f"weightRetainProb must be in (0,1], got {weightRetainProb}")
+        self.p = float(weightRetainProb)
+        self.applyToBias = bool(applyToBias)
+
+    def apply(self, params, key):
+        if self.p == 1.0:
+            return params
+
+        def drop(k, v):
+            keep = jax.random.bernoulli(k, self.p, v.shape)
+            return jnp.where(keep, v / self.p, 0.0).astype(v.dtype)
+
+        return self._perturb(params, key, drop)
+
+
+class WeightNoise(IWeightNoise):
+    """Add (or multiply in) noise drawn from a distribution
+    (reference: weightnoise.WeightNoise(Distribution, applyToBias,
+    additive)). `distribution` is a nn.weights distribution
+    (NormalDistribution/UniformDistribution)."""
+
+    def __init__(self, distribution, applyToBias=False, additive=True):
+        self.distribution = distribution
+        self.applyToBias = bool(applyToBias)
+        self.additive = bool(additive)
+
+    def apply(self, params, key):
+        def noise(k, v):
+            n = self.distribution.sample(k, v.shape, v.dtype)
+            return (v + n if self.additive else v * n).astype(v.dtype)
+
+        return self._perturb(params, key, noise)

@@ -160,6 +160,10 @@ class ComputationGraph:
             lk = None if (key is None or not l_train) else \
                 jax.random.fold_in(key, self._layer_idx[name])
             p = self._cast_params(params[name])
+            wn = getattr(layer, "weightNoise", None)
+            if wn is not None and lk is not None:
+                # train-time weight perturbation (reference: IWeightNoise)
+                p = wn.apply(p, jax.random.fold_in(lk, 0x5EED))
             if name in self.conf.networkOutputs and isinstance(
                     layer, (L.BaseOutputLayer, L.LossLayer)):
                 h = layer._dropout_input(h, l_train, lk)

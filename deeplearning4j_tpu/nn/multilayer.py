@@ -226,6 +226,11 @@ class MultiLayerNetwork:
                                             False))
             lk = None if (key is None or not l_train) else jax.random.fold_in(key, i)
             p = self._cast_params(params[i])
+            wn = getattr(layer, "weightNoise", None)
+            if wn is not None and lk is not None:
+                # train-time weight perturbation (reference: IWeightNoise);
+                # pure function of the step key — inference stays clean
+                p = wn.apply(p, jax.random.fold_in(lk, 0x5EED))
             if i == len(self.layers) - 1 and isinstance(layer, (L.BaseOutputLayer, L.LossLayer)):
                 # dropout applies to the output layer's input too
                 h = layer._dropout_input(h, l_train, lk)
