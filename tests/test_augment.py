@@ -150,3 +150,14 @@ class TestPreProcessor:
         np.testing.assert_allclose(
             np.asarray(out, np.float32), np.asarray(x, np.float32),
             atol=1e-2)
+
+    def test_nhwc_data_format_preprocessor(self):
+        # NHWC iterators (round-4 input format) skip the layout round-trip
+        rng = np.random.RandomState(9)
+        X = rng.rand(6, 10, 8, 3).astype("float32")  # NHWC feed
+        Y = np.eye(2, dtype="float32")[rng.randint(0, 2, 6)]
+        it = DataSetIterator(X, Y, batchSize=6)
+        it.setPreProcessor(ImageAugmentationPreProcessor(
+            FlipImageTransform(1.0), seed=1, dataFormat="NHWC"))
+        out = np.asarray(it.next().getFeatures().jax())
+        np.testing.assert_array_equal(out, X[:, :, ::-1, :])
