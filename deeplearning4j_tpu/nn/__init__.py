@@ -53,6 +53,8 @@ from deeplearning4j_tpu.nn.conf.graph import (
     GraphBuilder, ComputationGraphConfiguration, MergeVertex, ElementWiseVertex,
     SubsetVertex, StackVertex, UnstackVertex, ScaleVertex, ShiftVertex,
     L2NormalizeVertex, ReshapeVertex, PreprocessorVertex,
+    L2Vertex, DotProductVertex, ReverseTimeSeriesVertex, LastTimeStepVertex,
+    DuplicateToTimeSeriesVertex,
 )
 from deeplearning4j_tpu.nn.graph import ComputationGraph
 from deeplearning4j_tpu.nn.conf.layers import CnnLossLayer, RnnLossLayer

@@ -171,6 +171,119 @@ class L2NormalizeVertex(GraphVertex):
         return its[0]
 
 
+class L2Vertex(GraphVertex):
+    """Pairwise L2 distance between two inputs per example
+    (reference: graph.L2Vertex — the siamese-distance vertex).
+    Output [B, 1]."""
+
+    def __init__(self, eps=1e-8):
+        self.eps = eps
+
+    def apply(self, inputs):
+        a, b = inputs[0], inputs[1]
+        axes = tuple(range(1, a.ndim))
+        return jnp.sqrt(jnp.sum(jnp.square(a - b), axis=axes,
+                                keepdims=False)[:, None] + self.eps)
+
+    def getOutputType(self, *its):
+        return InputType.feedForward(1)
+
+
+class DotProductVertex(GraphVertex):
+    """Per-example dot product of two inputs (reference:
+    graph.DotProductVertex). Output [B, 1]."""
+
+    def apply(self, inputs):
+        a, b = inputs[0], inputs[1]
+        axes = tuple(range(1, a.ndim))
+        return jnp.sum(a * b, axis=axes)[:, None]
+
+    def getOutputType(self, *its):
+        return InputType.feedForward(1)
+
+
+class ReverseTimeSeriesVertex(GraphVertex):
+    """Reverse the time axis of NCW data (reference:
+    rnn.ReverseTimeSeriesVertex). Mask-aware: with a feature mask, each
+    example reverses only its VALID prefix (padding stays at the tail,
+    so the mask remains aligned and unchanged — upstream semantics)."""
+
+    maskAware = True
+
+    def apply(self, inputs):
+        return inputs[0][:, :, ::-1]
+
+    def applyMasked(self, inputs, masks):
+        x = inputs[0]
+        m = masks[0]
+        if m is None:
+            return x[:, :, ::-1], None
+        T = x.shape[-1]
+        lengths = jnp.sum(m, axis=1).astype(jnp.int32)       # [B]
+        t = jnp.arange(T)[None, :]                            # [1, T]
+        src = jnp.where(t < lengths[:, None],
+                        lengths[:, None] - 1 - t, t)          # [B, T]
+        rev = jnp.take_along_axis(x, src[:, None, :], axis=2)
+        return rev, m
+
+    def getOutputType(self, *its):
+        return its[0]
+
+
+class LastTimeStepVertex(GraphVertex):
+    """[B, F, T] -> [B, F], taking each example's LAST VALID time step
+    (mask-aware; index T-1 when no mask — reference:
+    rnn.LastTimeStepVertex, the seq2seq encoder-summary vertex)."""
+
+    maskAware = True
+
+    def apply(self, inputs):
+        return inputs[0][:, :, -1]
+
+    def applyMasked(self, inputs, masks):
+        x = inputs[0]
+        m = masks[0]
+        if m is None:
+            return x[:, :, -1], None
+        last = (jnp.sum(m, axis=1) - 1).astype(jnp.int32)     # [B]
+        out = jnp.take_along_axis(x, last[:, None, None],
+                                  axis=2)[:, :, 0]
+        return out, None  # FF output: no time mask downstream
+
+    def getOutputType(self, *its):
+        return InputType.feedForward(its[0].size)
+
+
+class DuplicateToTimeSeriesVertex(GraphVertex):
+    """[B, F] -> [B, F, T], broadcasting a vector across time
+    (reference: rnn.DuplicateToTimeSeriesVertex — feeds an encoder
+    summary to every decoder step). T and the output mask come from the
+    SECOND input (the reference names an input whose length to mirror)."""
+
+    maskAware = True
+
+    @staticmethod
+    def _require_two(inputs):
+        if len(inputs) < 2:
+            raise ValueError(
+                "DuplicateToTimeSeriesVertex needs two inputs: the [B,F] "
+                "vector and a [B,*,T] sequence whose length to mirror")
+
+    def apply(self, inputs):
+        self._require_two(inputs)
+        v, seq = inputs[0], inputs[1]
+        return jnp.broadcast_to(v[:, :, None],
+                                v.shape + (seq.shape[-1],))
+
+    def applyMasked(self, inputs, masks):
+        return self.apply(inputs), masks[1] if len(masks) > 1 else None
+
+    def getOutputType(self, *its):
+        self._require_two(its)  # build-time, where other config errors land
+        return InputType.recurrent(its[0].size,
+                                   its[1].dims.get("timeSeriesLength"))
+
+
 class ReshapeVertex(GraphVertex):
     def __init__(self, *newShape):
         self.newShape = tuple(int(s) for s in newShape)
@@ -374,6 +487,19 @@ class GraphBuilder:
             for dep in node.inputs:
                 if dep not in self._nodes:
                     raise ValueError(f"Vertex '{name}' references unknown input '{dep}'")
+        if str(self._backpropType).lower().startswith("t"):  # tbptt
+            # time-semantic vertices operate on the WHOLE sequence; under
+            # tbptt each window would be reversed/summarized independently
+            # — silently wrong, so reject at build
+            bad = [n for n, node in self._nodes.items()
+                   if isinstance(node.payload,
+                                 (ReverseTimeSeriesVertex, LastTimeStepVertex,
+                                  DuplicateToTimeSeriesVertex))]
+            if bad:
+                raise ValueError(
+                    f"vertices {bad} need the full sequence and are "
+                    "incompatible with truncated BPTT (each tbptt window "
+                    "would be processed independently)")
         return ComputationGraphConfiguration(
             self._nodes, self._inputs, self._outputs, self._defaults,
             self._inputTypes, self._backpropType, self._tbpttFwd, self._tbpttBack)

@@ -132,8 +132,16 @@ class ComputationGraph:
                 pp = getattr(node.payload, "pp", None)
                 if pp is not None and hasattr(pp, "batch"):
                     pp.batch = B  # FeedForwardToRnn needs B to un-flatten
-                acts[name] = node.payload.apply([acts[i] for i in node.inputs])
-                masks[name] = masks.get(node.inputs[0])
+                vert = node.payload
+                ins = [acts[i] for i in node.inputs]
+                if getattr(vert, "maskAware", False):
+                    # time-semantic vertices (reverse/last-step) must see
+                    # and may rewrite the masks of their inputs
+                    acts[name], masks[name] = vert.applyMasked(
+                        ins, [masks.get(i) for i in node.inputs])
+                else:
+                    acts[name] = vert.apply(ins)
+                    masks[name] = masks.get(node.inputs[0])
                 continue
             layer = node.payload
             out_mask = masks.get(node.inputs[0])

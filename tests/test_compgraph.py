@@ -312,3 +312,145 @@ class TestGraphPretrain:
         net = ComputationGraph(conf).init()
         with pytest.raises(ValueError, match="pretrainable"):
             net.pretrainLayer("d", np.zeros((2, 3), "float32"))
+
+
+class TestRound4Vertices:
+    """L2/DotProduct (siamese) and the seq2seq time vertices
+    (reference: graph.{L2Vertex, DotProductVertex},
+    graph.rnn.{ReverseTimeSeriesVertex, LastTimeStepVertex,
+    DuplicateToTimeSeriesVertex})."""
+
+    def test_siamese_distance_vertices(self):
+        from deeplearning4j_tpu.nn import (
+            NeuralNetConfiguration, InputType, ComputationGraph, DenseLayer,
+            OutputLayer, Adam, L2Vertex, DotProductVertex, MergeVertex,
+        )
+
+        g = (NeuralNetConfiguration.Builder().seed(1).updater(Adam(1e-2))
+             .graphBuilder().addInputs("a", "b"))
+        g.addLayer("ea", DenseLayer(nOut=6, activation="tanh"), "a")
+        g.addLayer("eb", DenseLayer(nOut=6, activation="tanh"), "b")
+        g.addVertex("l2", L2Vertex(), "ea", "eb")
+        g.addVertex("dot", DotProductVertex(), "ea", "eb")
+        g.addVertex("feat", MergeVertex(), "l2", "dot")
+        g.addLayer("out", OutputLayer(nOut=2, activation="softmax",
+                                      lossFunction="mcxent"), "feat")
+        net = ComputationGraph(
+            g.setOutputs("out")
+             .setInputTypes(InputType.feedForward(4),
+                            InputType.feedForward(4)).build()).init()
+        rng = np.random.RandomState(0)
+        xa = rng.rand(8, 4).astype("float32")
+        xb = rng.rand(8, 4).astype("float32")
+        acts = net.feedForward([xa, xb])
+        ea, eb = acts["ea"].toNumpy(), acts["eb"].toNumpy()
+        np.testing.assert_allclose(
+            acts["l2"].toNumpy()[:, 0],
+            np.sqrt(((ea - eb) ** 2).sum(1) + 1e-8), rtol=1e-5)
+        np.testing.assert_allclose(
+            acts["dot"].toNumpy()[:, 0], (ea * eb).sum(1), rtol=1e-5)
+        y = np.eye(2, dtype="float32")[rng.randint(0, 2, 8)]
+        net.fit([xa, xb], [y])
+        assert np.isfinite(net.score())
+
+    def test_seq2seq_time_vertices(self):
+        from deeplearning4j_tpu.nn import (
+            NeuralNetConfiguration, InputType, ComputationGraph, LSTM,
+            RnnOutputLayer, Adam, ReverseTimeSeriesVertex,
+            LastTimeStepVertex, DuplicateToTimeSeriesVertex,
+        )
+
+        g = (NeuralNetConfiguration.Builder().seed(2).updater(Adam(1e-2))
+             .graphBuilder().addInputs("src"))
+        g.addVertex("rev", ReverseTimeSeriesVertex(), "src")
+        g.addLayer("enc", LSTM(nOut=5), "rev")
+        g.addVertex("summary", LastTimeStepVertex(), "enc")
+        g.addVertex("dup", DuplicateToTimeSeriesVertex(), "summary", "src")
+        g.addLayer("dec", LSTM(nOut=5), "dup")
+        g.addLayer("out", RnnOutputLayer(nOut=3, activation="softmax",
+                                         lossFunction="mcxent"), "dec")
+        net = ComputationGraph(
+            g.setOutputs("out")
+             .setInputTypes(InputType.recurrent(4, 6)).build()).init()
+        rng = np.random.RandomState(1)
+        x = rng.rand(2, 4, 6).astype("float32")
+        acts = net.feedForward([x])
+        np.testing.assert_allclose(acts["rev"].toNumpy(),
+                                   x[:, :, ::-1], rtol=1e-6)
+        enc = acts["enc"].toNumpy()
+        np.testing.assert_allclose(acts["summary"].toNumpy(),
+                                   enc[:, :, -1], rtol=1e-6)
+        dup = acts["dup"].toNumpy()
+        assert dup.shape == (2, 5, 6)
+        for t in range(6):
+            np.testing.assert_allclose(dup[:, :, t],
+                                       acts["summary"].toNumpy(), rtol=1e-6)
+        y = np.zeros((2, 3, 6), "float32")
+        y[:, 0, :] = 1
+        net.fit(x, [y])
+        assert np.isfinite(net.score())
+
+    def test_duplicate_vertex_needs_two_inputs(self):
+        from deeplearning4j_tpu.nn import DuplicateToTimeSeriesVertex
+
+        with pytest.raises(ValueError, match="two inputs"):
+            DuplicateToTimeSeriesVertex().apply([np.zeros((2, 3))])
+
+    def test_mask_aware_reverse_and_last_step(self):
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nn import (LastTimeStepVertex,
+                                           ReverseTimeSeriesVertex)
+
+        x = np.arange(2 * 1 * 5, dtype="float32").reshape(2, 1, 5)
+        mask = np.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], "float32")
+        rev, m = ReverseTimeSeriesVertex().applyMasked(
+            [jnp.asarray(x)], [jnp.asarray(mask)])
+        # example 0: valid prefix [0,1,2] reversed, padding [3,4] in place
+        np.testing.assert_allclose(np.asarray(rev)[0, 0],
+                                   [2, 1, 0, 3, 4])
+        np.testing.assert_allclose(np.asarray(rev)[1, 0],
+                                   [9, 8, 7, 6, 5])
+        np.testing.assert_array_equal(np.asarray(m), mask)
+        last, lm = LastTimeStepVertex().applyMasked(
+            [jnp.asarray(x)], [jnp.asarray(mask)])
+        np.testing.assert_allclose(np.asarray(last)[:, 0], [2.0, 9.0])
+        assert lm is None
+        # no-mask paths match plain apply
+        np.testing.assert_allclose(
+            np.asarray(ReverseTimeSeriesVertex().applyMasked(
+                [jnp.asarray(x)], [None])[0]), x[:, :, ::-1])
+
+    def test_time_vertices_rejected_under_tbptt(self):
+        from deeplearning4j_tpu.nn import (
+            NeuralNetConfiguration, InputType, LSTM, RnnOutputLayer, Adam,
+            ReverseTimeSeriesVertex,
+        )
+
+        g = (NeuralNetConfiguration.Builder().seed(1).updater(Adam(1e-2))
+             .graphBuilder().addInputs("src"))
+        g.addVertex("rev", ReverseTimeSeriesVertex(), "src")
+        g.addLayer("enc", LSTM(nOut=4), "rev")
+        g.addLayer("out", RnnOutputLayer(nOut=2, activation="softmax",
+                                         lossFunction="mcxent"), "enc")
+        g.backpropType("tbptt").tBPTTForwardLength(3)
+        with pytest.raises(ValueError, match="truncated BPTT"):
+            (g.setOutputs("out")
+              .setInputTypes(InputType.recurrent(4, 6)).build())
+
+    def test_duplicate_vertex_single_input_fails_at_build(self):
+        from deeplearning4j_tpu.nn import (
+            NeuralNetConfiguration, InputType, LSTM, RnnOutputLayer, Adam,
+            DuplicateToTimeSeriesVertex, LastTimeStepVertex,
+        )
+
+        g = (NeuralNetConfiguration.Builder().seed(1).updater(Adam(1e-2))
+             .graphBuilder().addInputs("src"))
+        g.addLayer("enc", LSTM(nOut=4), "src")
+        g.addVertex("summary", LastTimeStepVertex(), "enc")
+        g.addVertex("dup", DuplicateToTimeSeriesVertex(), "summary")
+        g.addLayer("out", RnnOutputLayer(nOut=2, activation="softmax",
+                                         lossFunction="mcxent"), "dup")
+        with pytest.raises(ValueError, match="two inputs"):
+            (g.setOutputs("out")
+              .setInputTypes(InputType.recurrent(4, 6)).build())
