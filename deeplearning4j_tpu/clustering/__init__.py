@@ -1,10 +1,13 @@
 """Clustering + nearest neighbors (reference: the
 deeplearning4j-nearestneighbors-parent / nd4j clustering modules:
-org.deeplearning4j.clustering.kmeans.KMeansClustering and the VPTree
-nearest-neighbor stack)."""
+org.deeplearning4j.clustering.kmeans.KMeansClustering, the VPTree /
+KDTree nearest-neighbor stack, and nd4j's RandomProjectionLSH)."""
 
 from deeplearning4j_tpu.clustering.kmeans import (KMeansClustering,
                                                   ClusterSet,
                                                   NearestNeighbors)
+from deeplearning4j_tpu.clustering.trees import VPTree, KDTree
+from deeplearning4j_tpu.clustering.lsh import RandomProjectionLSH
 
-__all__ = ["KMeansClustering", "ClusterSet", "NearestNeighbors"]
+__all__ = ["KMeansClustering", "ClusterSet", "NearestNeighbors",
+           "VPTree", "KDTree", "RandomProjectionLSH"]

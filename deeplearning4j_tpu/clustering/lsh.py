@@ -1,0 +1,120 @@
+"""Random-projection LSH for approximate nearest neighbors.
+
+Reference: org.nd4j.linalg.api.ndarray / org.nd4j.linalg.lsh.
+RandomProjectionLSH (hashLength, numTables, inDimension; index(data),
+bucket(query), search(query, ...)).
+
+TPU-first shape: hashing IS a matmul — corpus codes are
+sign(X @ R) packed to bits in one [n, d] x [d, tables*hashLength]
+product on the MXU, and candidate re-ranking is the same quadratic
+distance form brute force uses, restricted to the candidate set. The
+host only keeps dict buckets (code -> row ids), which is the part a
+systolic array can't do.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.clustering.kmeans import _sq_dists
+from deeplearning4j_tpu.clustering.trees import _as_matrix, _as_vector
+
+
+class RandomProjectionLSH:
+    """Sign-random-projection (SimHash) multi-table LSH.
+
+    Points whose angle is small agree on each hyperplane side with
+    probability 1 - theta/pi, so hashLength bits * numTables trades
+    recall against candidate-set size exactly like the reference's
+    (hashLength, numTables) pair.
+    """
+
+    def __init__(self, hashLength, numTables, inDimension, seed=0):
+        self.hashLength = int(hashLength)
+        self.numTables = int(numTables)
+        self.inDimension = int(inDimension)
+        if min(self.hashLength, self.numTables, self.inDimension) < 1:
+            raise ValueError("hashLength, numTables, inDimension must be >= 1")
+        if self.hashLength > 62:
+            raise ValueError("hashLength > 62 overflows the packed int64 code")
+        key = jax.random.key(int(seed))
+        # one wide projection covering every table: [d, T*L]
+        self._R = jax.random.normal(
+            key, (self.inDimension, self.numTables * self.hashLength),
+            jnp.float32)
+        self._tables = None
+        self._X = None
+        self._mean = None
+
+    def _codes(self, X):
+        """[n, d] -> int64 [n, T] packed sign codes. The projection is a
+        device matmul; packing happens host-side in numpy int64 — device
+        integers are int32 unless x64 mode is on, which would silently
+        corrupt codes for hashLength > 30."""
+        bits = np.asarray((jnp.asarray(X, jnp.float32) @ self._R) >= 0)
+        bits = bits.reshape(-1, self.numTables, self.hashLength)
+        weights = 2 ** np.arange(self.hashLength, dtype=np.int64)
+        return (bits.astype(np.int64) * weights).sum(-1)
+
+    def index(self, data):
+        Xh = _as_matrix(data).astype(np.float32)
+        if Xh.shape[1] != self.inDimension:
+            raise ValueError(
+                f"data must be [n, {self.inDimension}], got {Xh.shape}")
+        codes = self._codes(Xh)
+        self._tables = [dict() for _ in range(self.numTables)]
+        for t in range(self.numTables):
+            table = self._tables[t]
+            for row, code in enumerate(codes[:, t]):
+                table.setdefault(int(code), []).append(row)
+        # mean-center the re-rank corpus (see clustering.kmeans._sq_dists)
+        self._mean = Xh.mean(0, keepdims=True)
+        self._X = jnp.asarray(Xh - self._mean)
+        return self
+
+    def _parse_query(self, query):
+        if self._tables is None:
+            raise ValueError("bucket()/search() before index()")
+        return _as_vector(query, self.inDimension).astype(
+            np.float32).reshape(1, -1)
+
+    def _candidates(self, q):
+        codes = self._codes(q)[0]
+        cand = set()
+        for t in range(self.numTables):
+            cand.update(self._tables[t].get(int(codes[t]), ()))
+        return np.fromiter(sorted(cand), np.int64, len(cand))
+
+    def bucket(self, query):
+        """Candidate row ids whose code matches the query's in ANY table
+        (reference: RandomProjectionLSH.bucket)."""
+        return self._candidates(self._parse_query(query))
+
+    def search(self, query, k):
+        """-> (indices, distances): exact euclidean re-rank of the
+        candidate set, nearest first. Approximate overall — recall is
+        governed by (hashLength, numTables); falls back to a full scan
+        only when no bucket matches (empty candidate set). May return
+        FEWER than k rows when the matched buckets hold fewer than k
+        candidates — the result length is min(k, candidates), like the
+        reference's bucket-limited search (it is not topped up from a
+        full scan, which would defeat the sublinear point)."""
+        k = int(k)
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        q = self._parse_query(query)
+        cand = self._candidates(q)
+        if cand.size == 0:
+            sub, back = self._X, None
+        else:
+            sub, back = self._X[jnp.asarray(cand)], cand
+        k_eff = min(k, int(sub.shape[0]))
+        qc = jnp.asarray(q - self._mean)
+        d2 = _sq_dists(qc, sub)[0]
+        negd, pos = jax.lax.top_k(-d2, k_eff)
+        pos = np.asarray(pos)
+        idx = pos if back is None else back[pos]
+        return idx, np.sqrt(np.asarray(-negd))

@@ -114,3 +114,206 @@ class TestKMeansEdgeCases:
         cs = KMeansClustering.setup(2, 30, seed=1).applyTo(
             np.concatenate([X, X + 0.5]))
         assert len(set(cs.getAssignments()[:30])) == 1
+
+
+class TestVPTree:
+    """VPTree vs the brute-force oracle (exact structure — must match)."""
+
+    def test_matches_brute_force(self):
+        rng = np.random.RandomState(3)
+        X = rng.randn(400, 8).astype("float32")
+        from deeplearning4j_tpu.clustering import VPTree
+        tree = VPTree(X, seed=1)
+        nn = NearestNeighbors(X)
+        for qi in range(10):
+            q = rng.randn(8).astype("float32")
+            ti, td = tree.search(q, 5)
+            bi, bd = nn.search(q, 5)
+            assert list(ti) == list(bi)
+            np.testing.assert_allclose(td, bd, rtol=1e-4, atol=1e-4)
+
+    def test_prunes(self):
+        # on clustered data the triangle-inequality prune must visit far
+        # fewer points than a full scan
+        X, _, _ = _blobs(n_per=300, k=4, d=3, seed=5, spread=30.0)
+        from deeplearning4j_tpu.clustering import VPTree
+        tree = VPTree(X, seed=0)
+        tree.search(X[7] + 0.01, 3)
+        assert tree._scanned < X.shape[0] * 0.5
+
+    def test_k_1_and_k_n(self):
+        rng = np.random.RandomState(0)
+        X = rng.randn(20, 4)
+        from deeplearning4j_tpu.clustering import VPTree
+        tree = VPTree(X)
+        i1, d1 = tree.search(X[11], 1)
+        assert i1[0] == 11 and d1[0] < 1e-6
+        iN, dN = tree.search(X[0], 20)
+        assert sorted(iN) == list(range(20))
+        assert np.all(np.diff(dN) >= -1e-12)
+
+    def test_errors(self):
+        from deeplearning4j_tpu.clustering import VPTree
+        with pytest.raises(ValueError):
+            VPTree(np.zeros((0, 3)))
+        tree = VPTree(np.random.RandomState(0).randn(5, 3))
+        with pytest.raises(ValueError):
+            tree.search(np.zeros(3), 6)
+        with pytest.raises(ValueError):
+            tree.search(np.zeros(4), 1)
+        with pytest.raises(ValueError):
+            VPTree(np.zeros((4, 2)), distance="manhattan")
+
+
+class TestKDTree:
+    def test_nn_matches_brute_force(self):
+        rng = np.random.RandomState(7)
+        X = rng.randn(200, 5)
+        from deeplearning4j_tpu.clustering import KDTree
+        tree = KDTree(5)
+        for p in X:
+            tree.insert(p)
+        assert tree.size() == 200
+        for _ in range(10):
+            q = rng.randn(5)
+            idx, dist = tree.nn(q)
+            d_all = np.linalg.norm(X - q, axis=1)
+            assert idx == int(np.argmin(d_all))
+            assert abs(dist - d_all.min()) < 1e-10
+
+    def test_knn_radius(self):
+        rng = np.random.RandomState(1)
+        X = rng.randn(150, 3)
+        from deeplearning4j_tpu.clustering import KDTree
+        tree = KDTree(3)
+        for p in X:
+            tree.insert(p)
+        q = X[42]
+        idx, dist = tree.knn(q, 1.2)
+        d_all = np.linalg.norm(X - q, axis=1)
+        expect = set(np.nonzero(d_all <= 1.2)[0])
+        assert set(idx) == expect
+        assert np.all(np.diff(dist) >= -1e-12)
+        assert idx[0] == 42  # the point itself, at distance 0
+
+    def test_empty_and_dims_errors(self):
+        from deeplearning4j_tpu.clustering import KDTree
+        with pytest.raises(ValueError):
+            KDTree(0)
+        tree = KDTree(3)
+        with pytest.raises(ValueError):
+            tree.nn(np.zeros(3))
+        tree.insert(np.zeros(3))
+        with pytest.raises(ValueError):
+            tree.insert(np.zeros(2))
+
+
+class TestRandomProjectionLSH:
+    def test_recall_on_clustered_data(self):
+        X, _, _ = _blobs(n_per=200, k=5, d=16, seed=2, spread=10.0)
+        from deeplearning4j_tpu.clustering import RandomProjectionLSH
+        lsh = RandomProjectionLSH(hashLength=10, numTables=8,
+                                  inDimension=16, seed=0).index(X)
+        nn = NearestNeighbors(X)
+        hits = total = 0
+        rng = np.random.RandomState(0)
+        for qi in rng.choice(X.shape[0], 20, replace=False):
+            q = X[qi] + rng.randn(16).astype("float32") * 0.05
+            li, _ = lsh.search(q, 10)
+            bi, _ = nn.search(q, 10)
+            hits += len(set(li.tolist()) & set(bi.tolist()))
+            total += 10
+        assert hits / total > 0.8  # sign-LSH recall on well-separated blobs
+
+    def test_bucket_contains_near_duplicates(self):
+        rng = np.random.RandomState(4)
+        X = rng.randn(300, 12).astype("float32")
+        from deeplearning4j_tpu.clustering import RandomProjectionLSH
+        lsh = RandomProjectionLSH(6, 12, 12, seed=3).index(X)
+        cand = lsh.bucket(X[17] * 1.0001)  # same direction -> same signs
+        assert 17 in cand
+        assert cand.size < X.shape[0]  # it's a bucket, not the corpus
+
+    def test_exact_rerank_ordering(self):
+        rng = np.random.RandomState(9)
+        X = rng.randn(100, 8).astype("float32")
+        from deeplearning4j_tpu.clustering import RandomProjectionLSH
+        lsh = RandomProjectionLSH(4, 6, 8, seed=1).index(X)
+        idx, dist = lsh.search(X[3], 5)
+        assert idx[0] == 3 and dist[0] < 1e-3
+        assert np.all(np.diff(dist) >= -1e-5)
+        # reported distances are TRUE euclidean distances, not hash stats
+        for i, d in zip(idx, dist):
+            assert abs(np.linalg.norm(X[i] - X[3]) - d) < 1e-3
+
+    def test_errors(self):
+        from deeplearning4j_tpu.clustering import RandomProjectionLSH
+        with pytest.raises(ValueError):
+            RandomProjectionLSH(0, 1, 4)
+        with pytest.raises(ValueError):
+            RandomProjectionLSH(63, 1, 4)
+        lsh = RandomProjectionLSH(4, 2, 4)
+        with pytest.raises(ValueError):
+            lsh.bucket(np.zeros(4))
+        lsh.index(np.random.RandomState(0).randn(10, 4))
+        with pytest.raises(ValueError):
+            lsh.bucket(np.zeros(5))
+        with pytest.raises(ValueError):
+            lsh.search(np.zeros(4), 0)
+
+
+class TestDegenerateCorpora:
+    """Regression: tie-heavy/duplicate corpora must not blow the
+    recursion limit (build and query are iterative)."""
+
+    def test_vptree_all_duplicates(self):
+        from deeplearning4j_tpu.clustering import VPTree
+        X = np.zeros((3000, 4), np.float32)
+        tree = VPTree(X)
+        idx, dist = tree.search(np.zeros(4), 3)
+        assert len(idx) == 3 and np.all(dist == 0)
+
+    def test_kdtree_duplicate_chain(self):
+        from deeplearning4j_tpu.clustering import KDTree
+        tree = KDTree(3)
+        for _ in range(2000):
+            tree.insert(np.ones(3))
+        idx, dist = tree.nn(np.ones(3) + 0.01)
+        assert dist < 0.02
+        ri, _ = tree.knn(np.ones(3), 0.1)
+        assert len(ri) == 2000
+
+    def test_kdtree_sorted_inserts(self):
+        from deeplearning4j_tpu.clustering import KDTree
+        tree = KDTree(2)
+        pts = np.stack([np.arange(2000.0), np.arange(2000.0)], 1)
+        for p in pts:
+            tree.insert(p)
+        idx, dist = tree.nn(np.array([1000.2, 1000.2]))
+        assert idx == 1000 and abs(dist - np.sqrt(2 * 0.04)) < 1e-6
+
+    def test_vptree_rejects_sqeuclidean(self):
+        from deeplearning4j_tpu.clustering import VPTree
+        with pytest.raises(ValueError):
+            VPTree(np.zeros((4, 2)), distance="sqeuclidean")
+
+    def test_lsh_rejects_empty_corpus(self):
+        from deeplearning4j_tpu.clustering import RandomProjectionLSH
+        with pytest.raises(ValueError):
+            RandomProjectionLSH(4, 2, 4).index(np.zeros((0, 4)))
+
+    def test_kdtree_knn_empty_raises(self):
+        from deeplearning4j_tpu.clustering import KDTree
+        with pytest.raises(ValueError):
+            KDTree(3).knn(np.zeros(3), 1.0)
+
+    def test_lsh_short_return(self):
+        # fewer candidates than k -> result length is the candidate
+        # count, not k (documented bucket-limited semantics)
+        rng = np.random.RandomState(2)
+        X = rng.randn(50, 6).astype("float32") * 10
+        from deeplearning4j_tpu.clustering import RandomProjectionLSH
+        lsh = RandomProjectionLSH(16, 1, 6, seed=0).index(X)
+        idx, dist = lsh.search(X[0], 20)
+        assert 1 <= len(idx) <= 20 and len(idx) == len(dist)
+        assert idx[0] == 0
