@@ -449,6 +449,75 @@ def bench_prefetch():
             "batches": NB, "batch": B, "host_cores": cores, "note": note}
 
 
+SECONDARY_CONFIGS = [("lenet_mnist", "bench_lenet"),
+                     ("samediff_mlp", "bench_samediff_mlp"),
+                     ("lstm_tbptt", "bench_lstm_tbptt"),
+                     ("attention", "bench_attention"),
+                     ("prefetch", "bench_prefetch")]
+
+
+def bench_tpu_secondaries():
+    """Every secondary TPU config in ONE interpreter, each banked with a
+    BENCHREC-CONFIG line the moment it lands.
+
+    Why one process: the round-4 live window showed per-config
+    subprocesses all dying in tunnel INIT (resnet50's process measured
+    fine; the four that followed each stalled before their first
+    compile and ate a 300 s budget doing nothing). One process pays the
+    stall-prone init once, and the incremental lines mean a mid-group
+    stall still keeps everything already measured."""
+    out = {}
+    for name, fn_name in SECONDARY_CONFIGS:
+        fn = globals()[fn_name]
+        try:
+            rec = fn()
+        except Exception as e:  # one config's failure must not eat the rest
+            rec = {"error": f"{type(e).__name__}: {e}"[:300]}
+        out[name] = rec
+        print("\nBENCHREC-CONFIG " + json.dumps({"name": name, "rec": rec}),
+              flush=True)
+    return out
+
+
+def _run_secondaries_subprocess(budget, deadline_capped=False):
+    """-> configs dict parsed from BENCHREC-CONFIG lines; configs the
+    group never reached get an explanatory error entry
+    (`deadline_capped` distinguishes a short deadline-driven budget
+    from a suspected tunnel stall in that error)."""
+    names = [n for n, _ in SECONDARY_CONFIGS]
+    here = os.path.dirname(os.path.abspath(__file__))
+    code = "import bench\nbench.bench_tpu_secondaries()\n"
+    out, stdout = {}, ""
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=budget, cwd=here)
+        stdout = r.stdout or ""
+        tail_err = (r.stderr or "").strip()[-200:]
+        fallback = {"error": f"group exited rc={r.returncode}: {tail_err}"} \
+            if r.returncode != 0 else {"error": "no record emitted"}
+    except subprocess.TimeoutExpired as e:
+        stdout = e.stdout
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode(errors="replace")
+        stdout = stdout or ""
+        fallback = {"error": f"group timeout at {budget}s (killed; "
+                    + ("bench deadline reached)" if deadline_capped
+                       else "TPU tunnel stall?)")}
+    except Exception as e:
+        fallback = {"error": f"{type(e).__name__}: {e}"[:300]}
+    for line in stdout.splitlines():
+        if line.startswith("BENCHREC-CONFIG "):
+            try:
+                rec = json.loads(line[len("BENCHREC-CONFIG "):])
+                out[rec["name"]] = rec["rec"]
+            except (json.JSONDecodeError, KeyError):
+                pass
+    for n in names:
+        out.setdefault(n, dict(fallback))
+    return out
+
+
 def bench_grad_sharing_virtual(timeout_s=600):
     """BASELINE config 5 on the virtual 8-device CPU mesh (one physical
     chip available — this certifies the sharded psum path, not ICI perf)."""
@@ -554,16 +623,13 @@ def main():
     _HEADLINE = headline
 
     configs = _CONFIGS  # module-global, shared with _error_line
-    for name, fn in [("lenet_mnist", "bench_lenet"),
-                     ("samediff_mlp", "bench_samediff_mlp"),
-                     ("lstm_tbptt", "bench_lstm_tbptt"),
-                     ("attention", "bench_attention"),
-                     ("prefetch", "bench_prefetch")]:
-        budget = _budget(300)
-        if budget < 45:  # leave headroom to emit the final line
+    budget = _budget(600)
+    if budget < 60:  # leave headroom to emit the final line
+        for name, _ in SECONDARY_CONFIGS:
             configs[name] = {"error": "skipped: bench deadline reached"}
-            continue
-        configs[name] = _run_config_subprocess(fn, budget)
+    else:
+        configs.update(_run_secondaries_subprocess(
+            budget, deadline_capped=budget < 600))
     # grad_sharing runs in-process: it is already its own CPU-pinned
     # subprocess (virtual 8-device mesh) and never touches the TPU
     budget = _budget(600)

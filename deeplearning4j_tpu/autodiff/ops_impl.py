@@ -1030,3 +1030,49 @@ def _lu(x):
 def _eigh(x):
     w, v = jnp.linalg.eigh(x)
     return w, v
+
+
+# ---- fft (reference: the Nd4j.fft / spectral op surface,
+# org.nd4j.linalg.api.ops.impl.transforms.custom fft family). XLA has a
+# native FFT lowering on TPU (complex64); these are thin named wrappers
+# so graphs serialize by op name like everything else. ----
+@op("fft")
+def _fft(x, numPoints=None, dimension=-1):
+    return jnp.fft.fft(x, n=numPoints, axis=dimension)
+
+
+@op("ifft")
+def _ifft(x, numPoints=None, dimension=-1):
+    return jnp.fft.ifft(x, n=numPoints, axis=dimension)
+
+
+@op("rfft")
+def _rfft(x, numPoints=None, dimension=-1):
+    return jnp.fft.rfft(x, n=numPoints, axis=dimension)
+
+
+@op("irfft")
+def _irfft(x, numPoints=None, dimension=-1):
+    return jnp.fft.irfft(x, n=numPoints, axis=dimension)
+
+
+@op("fft2")
+def _fft2(x):
+    return jnp.fft.fft2(x)
+
+
+@op("ifft2")
+def _ifft2(x):
+    return jnp.fft.ifft2(x)
+
+
+for _n, _f in {
+    "real": jnp.real, "imag": jnp.imag, "conj": jnp.conj,
+    "angle": jnp.angle,
+}.items():
+    _reg(_n, _f)
+
+
+@op("toComplex")
+def _to_complex(re, im):
+    return lax.complex(re, im)

@@ -258,6 +258,7 @@ class SameDiff:
         self.linalg = _LinalgOps(self)
         self.bitwise = _BitwiseOps(self)
         self.random = _RandomOps(self)
+        self.fft = _FFTOps(self)
 
     @staticmethod
     def create():
@@ -1544,6 +1545,42 @@ class _LinalgOps(_NS):
 
     def qr(self, x, name=None):
         return self._mk("qr", [x], nOut=2, name=name)
+
+
+class _FFTOps(_NS):
+    """Reference: the Nd4j.fft / spectral op family. Complex arrays are
+    first-class (complex64 lowers natively on TPU); real/imag/conj/
+    angle/toComplex convert at the boundary."""
+
+    def fft(self, x, numPoints=None, dimension=-1, name=None):
+        return self._mk("fft", [x], {"numPoints": numPoints,
+                                     "dimension": int(dimension)}, name=name)
+
+    def ifft(self, x, numPoints=None, dimension=-1, name=None):
+        return self._mk("ifft", [x], {"numPoints": numPoints,
+                                      "dimension": int(dimension)}, name=name)
+
+    def rfft(self, x, numPoints=None, dimension=-1, name=None):
+        """Real input -> positive-frequency half spectrum (complex)."""
+        return self._mk("rfft", [x], {"numPoints": numPoints,
+                                      "dimension": int(dimension)}, name=name)
+
+    def irfft(self, x, numPoints=None, dimension=-1, name=None):
+        return self._mk("irfft", [x], {"numPoints": numPoints,
+                                       "dimension": int(dimension)}, name=name)
+
+    def fft2(self, x, name=None):
+        return self._mk("fft2", [x], name=name)
+
+    def ifft2(self, x, name=None):
+        return self._mk("ifft2", [x], name=name)
+
+    for _n in "real imag conj angle".split():
+        locals()[_n] = _unary(_n)
+    del _n
+
+    def toComplex(self, re, im, name=None):
+        return self._mk("toComplex", [re, im], name=name)
 
 
 class _RandomOps(_NS):

@@ -913,3 +913,95 @@ class TestBlockOpsAndLinalgTail:
         V = v.eval().toNumpy()
         np.testing.assert_allclose(V @ np.diag(w.eval().toNumpy()) @ V.T,
                                    S, atol=1e-5)
+
+
+class TestFFTOps:
+    """sd.fft namespace (reference: the Nd4j.fft spectral family) —
+    numpy.fft oracles, gradient flow, serialization."""
+
+    def test_fft_ifft_roundtrip_oracle(self):
+        rng = np.random.RandomState(0)
+        xv = rng.randn(4, 16)
+        sd = SameDiff.create()
+        x = sd.constant(xv, name="x")
+        spec = sd.fft.fft(x, name="spec")
+        back = sd.fft.real(sd.fft.ifft(spec), name="back")
+        got = spec.eval().toNumpy()
+        np.testing.assert_allclose(got, np.fft.fft(xv, axis=-1),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(back.eval().toNumpy(), xv,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_rfft_irfft_numpoints_dimension(self):
+        rng = np.random.RandomState(1)
+        xv = rng.randn(8, 10)
+        sd = SameDiff.create()
+        x = sd.constant(xv)
+        r = sd.fft.rfft(x, numPoints=16, dimension=0)
+        np.testing.assert_allclose(r.eval().toNumpy(),
+                                   np.fft.rfft(xv, n=16, axis=0),
+                                   rtol=1e-4, atol=1e-4)
+        back = sd.fft.irfft(sd.fft.rfft(x), dimension=-1)
+        np.testing.assert_allclose(back.eval().toNumpy(), xv,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_fft2_and_complex_parts(self):
+        rng = np.random.RandomState(2)
+        xv = rng.randn(6, 8)
+        sd = SameDiff.create()
+        x = sd.constant(xv)
+        s = sd.fft.fft2(x)
+        oracle = np.fft.fft2(xv)
+        np.testing.assert_allclose(sd.fft.real(s).eval().toNumpy(),
+                                   oracle.real, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(sd.fft.imag(s).eval().toNumpy(),
+                                   oracle.imag, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(sd.fft.angle(s).eval().toNumpy(),
+                                   np.angle(oracle), rtol=1e-4, atol=1e-4)
+        rt = sd.fft.real(sd.fft.ifft2(s))
+        np.testing.assert_allclose(rt.eval().toNumpy(), xv,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_toComplex_conj(self):
+        sd = SameDiff.create()
+        re = sd.constant(np.array([1.0, 2.0]))
+        im = sd.constant(np.array([3.0, -4.0]))
+        z = sd.fft.toComplex(re, im)
+        zc = sd.fft.conj(z)
+        np.testing.assert_allclose(sd.fft.imag(zc).eval().toNumpy(),
+                                   np.array([-3.0, 4.0]))
+
+    def test_gradient_through_power_spectrum(self):
+        # d/dx sum(|rfft(x)|^2) has a clean oracle via jax.grad on the
+        # same jnp program
+        rng = np.random.RandomState(3)
+        xv = rng.randn(12)
+        sd = SameDiff.create()
+        x = sd.var("x", xv)
+        spec = sd.fft.rfft(x)
+        power = sd.math.sum(sd.math.square(sd.fft.real(spec))
+                            + sd.math.square(sd.fft.imag(spec)),
+                            name="power")
+        sd.setLossVariables("power")
+        grads = sd.calculateGradients(None, "x")
+
+        def f(v):
+            s = jnp.fft.rfft(v)
+            return jnp.sum(jnp.real(s) ** 2 + jnp.imag(s) ** 2)
+        oracle = jax.grad(f)(jnp.asarray(xv))
+        np.testing.assert_allclose(grads["x"].toNumpy(), oracle,
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_fft_graph_serializes(self, tmp_path):
+        rng = np.random.RandomState(4)
+        xv = rng.randn(4, 8)
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", jnp.float32, 4, 8)
+        mag = sd.math.sum(sd.math.square(sd.fft.real(sd.fft.rfft(x))),
+                          name="mag")
+        before = sd.output({"x": xv}, ["mag"])["mag"].toNumpy()
+        p = str(tmp_path / "fftgraph.sdz")
+        sd.save(p)
+        sd2 = SameDiff.load(p)
+        after = sd2.output({"x": xv}, ["mag"])["mag"].toNumpy()
+        np.testing.assert_allclose(after, before, rtol=1e-5)
