@@ -449,6 +449,10 @@ def bench_prefetch():
             "batches": NB, "batch": B, "host_cores": cores, "note": note}
 
 
+# child body for _run_secondaries_subprocess (module constant so tests
+# can drive the streaming parse with a stand-in child)
+_SECONDARIES_CODE = "import bench\nbench.bench_tpu_secondaries()\n"
+
 SECONDARY_CONFIGS = [("lenet_mnist", "bench_lenet"),
                      ("samediff_mlp", "bench_samediff_mlp"),
                      ("lstm_tbptt", "bench_lstm_tbptt"),
@@ -479,40 +483,57 @@ def bench_tpu_secondaries():
     return out
 
 
-def _run_secondaries_subprocess(budget, deadline_capped=False):
-    """-> configs dict parsed from BENCHREC-CONFIG lines; configs the
-    group never reached get an explanatory error entry
-    (`deadline_capped` distinguishes a short deadline-driven budget
-    from a suspected tunnel stall in that error)."""
+def _run_secondaries_subprocess(budget, deadline_capped=False, sink=None):
+    """-> configs dict parsed from BENCHREC-CONFIG lines. The child's
+    stdout is STREAMED and each record lands in `sink` (default: the
+    module-global _CONFIGS) the moment its line arrives — so a watchdog
+    hard stop mid-group still reports every finished config in the
+    error record. Configs the group never reached get an explanatory
+    error entry (`deadline_capped` distinguishes a short
+    deadline-driven budget from a suspected tunnel stall)."""
+    import tempfile
+    import threading
+
     names = [n for n, _ in SECONDARY_CONFIGS]
+    sink = _CONFIGS if sink is None else sink
     here = os.path.dirname(os.path.abspath(__file__))
-    code = "import bench\nbench.bench_tpu_secondaries()\n"
-    out, stdout = {}, ""
+    code = _SECONDARIES_CODE
+    out = {}
+
+    def _drain(stream):
+        for line in stream:  # EOF ends the thread
+            if line.startswith("BENCHREC-CONFIG "):
+                try:
+                    rec = json.loads(line[len("BENCHREC-CONFIG "):])
+                    out[rec["name"]] = rec["rec"]
+                    sink[rec["name"]] = rec["rec"]
+                except (json.JSONDecodeError, KeyError):
+                    pass
+
     try:
-        r = subprocess.run([sys.executable, "-c", code],
-                           capture_output=True, text=True,
-                           timeout=budget, cwd=here)
-        stdout = r.stdout or ""
-        tail_err = (r.stderr or "").strip()[-200:]
-        fallback = {"error": f"group exited rc={r.returncode}: {tail_err}"} \
-            if r.returncode != 0 else {"error": "no record emitted"}
-    except subprocess.TimeoutExpired as e:
-        stdout = e.stdout
-        if isinstance(stdout, bytes):
-            stdout = stdout.decode(errors="replace")
-        stdout = stdout or ""
-        fallback = {"error": f"group timeout at {budget}s (killed; "
-                    + ("bench deadline reached)" if deadline_capped
-                       else "TPU tunnel stall?)")}
+        with tempfile.TemporaryFile(mode="w+") as errf:
+            proc = subprocess.Popen([sys.executable, "-c", code],
+                                    stdout=subprocess.PIPE, stderr=errf,
+                                    text=True, cwd=here)
+            reader = threading.Thread(target=_drain, args=(proc.stdout,),
+                                      daemon=True)
+            reader.start()
+            try:
+                rc = proc.wait(timeout=budget)
+                reader.join(timeout=10)
+                errf.seek(0)
+                tail_err = errf.read().strip()[-200:]
+                fallback = ({"error": f"group exited rc={rc}: {tail_err}"}
+                            if rc != 0 else {"error": "no record emitted"})
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+                reader.join(timeout=10)
+                fallback = {"error": f"group timeout at {budget}s (killed; "
+                            + ("bench deadline reached)" if deadline_capped
+                               else "TPU tunnel stall?)")}
     except Exception as e:
         fallback = {"error": f"{type(e).__name__}: {e}"[:300]}
-    for line in stdout.splitlines():
-        if line.startswith("BENCHREC-CONFIG "):
-            try:
-                rec = json.loads(line[len("BENCHREC-CONFIG "):])
-                out[rec["name"]] = rec["rec"]
-            except (json.JSONDecodeError, KeyError):
-                pass
     for n in names:
         out.setdefault(n, dict(fallback))
     return out

@@ -12,8 +12,20 @@ from deeplearning4j_tpu.nlp.glove import Glove
 from deeplearning4j_tpu.nlp.vectorizers import (
     BagOfWordsVectorizer, TfidfVectorizer, LabelAwareCollectionIterator,
 )
+from deeplearning4j_tpu.nlp.tokenization import (
+    TokenPreProcess, LowCasePreProcessor, CommonPreprocessor,
+    EndingPreProcessor, NGramTokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.cnn_sentence import (
+    CnnSentenceDataSetIterator, CollectionLabeledSentenceProvider,
+    UnknownWordHandling,
+)
 
 __all__ = ["Word2Vec", "ParagraphVectors", "DefaultTokenizerFactory",
            "CollectionSentenceIterator", "LineSentenceIterator", "Glove",
            "BagOfWordsVectorizer", "TfidfVectorizer",
-           "LabelAwareCollectionIterator"]
+           "LabelAwareCollectionIterator",
+           "TokenPreProcess", "LowCasePreProcessor", "CommonPreprocessor",
+           "EndingPreProcessor", "NGramTokenizerFactory",
+           "CnnSentenceDataSetIterator",
+           "CollectionLabeledSentenceProvider", "UnknownWordHandling"]

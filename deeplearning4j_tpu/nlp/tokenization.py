@@ -1,0 +1,81 @@
+"""Tokenizer factories and token preprocessors.
+
+Reference: deeplearning4j-nlp text.tokenization —
+tokenizerfactory.{DefaultTokenizerFactory, NGramTokenizerFactory} and
+tokenizer.preprocessor.{CommonPreprocessor, LowCasePreProcessor,
+EndingPreProcessor}. Host-side string work (tokenization never touches
+the device); the factories plug into Word2Vec/GloVe/vectorizers via the
+existing `tokenizerFactory(...)` builder hooks, which call
+`create(sentence) -> [tokens]`.
+"""
+
+from __future__ import annotations
+
+import re
+
+
+class TokenPreProcess:
+    """Per-token string transform (reference:
+    tokenization.tokenizer.TokenPreProcess)."""
+
+    def preProcess(self, token):
+        raise NotImplementedError
+
+
+class LowCasePreProcessor(TokenPreProcess):
+    def preProcess(self, token):
+        return token.lower()
+
+
+class CommonPreprocessor(TokenPreProcess):
+    """Lowercase + strip punctuation/digits (reference:
+    preprocessor.CommonPreprocessor, which applies the same
+    [\\d.:,\"'()\\[\\]|/?!;]+ strip)."""
+
+    _STRIP = re.compile(r"[\d.:,\"'()\[\]|/?!;]+")
+
+    def preProcess(self, token):
+        return self._STRIP.sub("", token.lower())
+
+
+class EndingPreProcessor(TokenPreProcess):
+    """Crude English suffix stripper (reference:
+    preprocessor.EndingPreProcessor — same fixed suffix list, not a
+    real stemmer)."""
+
+    def preProcess(self, token):
+        if token.endswith("s") and not token.endswith("ss"):
+            token = token[:-1]
+        if token.endswith("."):
+            token = token[:-1]
+        if token.endswith("ly"):
+            token = token[:-2]
+        if token.endswith("ing"):
+            token = token[:-3]
+        return token
+
+
+class NGramTokenizerFactory:
+    """Emits all n-grams for n in [minN, maxN] over a base tokenizer's
+    tokens, n-gram tokens joined by spaces (reference:
+    tokenizerfactory.NGramTokenizerFactory)."""
+
+    def __init__(self, tokenizerFactory, minN, maxN):
+        self._base = tokenizerFactory
+        self.minN, self.maxN = int(minN), int(maxN)
+        if not (1 <= self.minN <= self.maxN):
+            raise ValueError(f"need 1 <= minN <= maxN, got {minN}, {maxN}")
+        self._pre = None
+
+    def setTokenPreProcessor(self, pre):
+        self._pre = pre
+
+    def create(self, sentence):
+        words = self._base.create(sentence)
+        if self._pre is not None:
+            words = [w for w in (self._pre.preProcess(t) for t in words) if w]
+        out = []
+        for n in range(self.minN, self.maxN + 1):
+            out.extend(" ".join(words[i:i + n])
+                       for i in range(len(words) - n + 1))
+        return out

@@ -25,12 +25,23 @@ import jax.numpy as jnp
 
 class DefaultTokenizerFactory:
     """Lowercasing word tokenizer (reference:
-    text.tokenization.tokenizerfactory.DefaultTokenizerFactory)."""
+    text.tokenization.tokenizerfactory.DefaultTokenizerFactory).
+    An optional TokenPreProcess (nlp.tokenization) maps each token;
+    tokens it empties are dropped."""
 
     _RE = re.compile(r"[A-Za-z0-9']+")
 
+    def __init__(self):
+        self._pre = None
+
+    def setTokenPreProcessor(self, pre):
+        self._pre = pre
+
     def create(self, sentence):
-        return self._RE.findall(sentence.lower())
+        words = self._RE.findall(sentence.lower())
+        if self._pre is not None:
+            words = [w for w in (self._pre.preProcess(t) for t in words) if w]
+        return words
 
 
 class CollectionSentenceIterator:

@@ -19,6 +19,10 @@ from deeplearning4j_tpu.parallel.multihost import (
     initialize as initializeMultiHost, hybrid_mesh, is_coordinator, num_hosts,
 )
 from deeplearning4j_tpu.parallel.inference import ParallelInference
+from deeplearning4j_tpu.parallel.spark import (
+    SparkDl4jMultiLayer, SparkComputationGraph,
+    ParameterAveragingTrainingMasterBuilder, SharedTrainingMasterBuilder,
+)
 from deeplearning4j_tpu.parallel.costmodel import (
     CHIPS, ChipSpec, DataParallelModel, all_reduce_time, all_gather_time,
     reduce_scatter_time, ppermute_time, resnet50_scaling,
@@ -32,6 +36,8 @@ __all__ = [
     "PipelineParallel", "partition_stages",
     "initializeMultiHost", "hybrid_mesh", "is_coordinator", "num_hosts",
     "ParallelInference",
+    "SparkDl4jMultiLayer", "SparkComputationGraph",
+    "ParameterAveragingTrainingMasterBuilder", "SharedTrainingMasterBuilder",
     "CHIPS", "ChipSpec", "DataParallelModel", "all_reduce_time",
     "all_gather_time", "reduce_scatter_time", "ppermute_time",
     "resnet50_scaling",

@@ -1,0 +1,151 @@
+"""Spark-facade entry points: SparkDl4jMultiLayer / SparkComputationGraph.
+
+Reference: the dl4j-spark subproject —
+org.deeplearning4j.spark.impl.multilayer.SparkDl4jMultiLayer and
+impl.graph.SparkComputationGraph: `new SparkDl4jMultiLayer(sc, conf,
+trainingMaster)` then `fit(JavaRDD<DataSet>)`.
+
+TPU translation: the Spark cluster's role (shard data, run workers,
+aggregate) is played by the device mesh + the existing TrainingMaster
+classes (`parallel/trainer.py`), which already implement the two
+upstream aggregation strategies (parameter averaging, shared
+gradients). This module is the ENTRY-POINT parity layer so upstream
+call sites port 1:1: the `sc` slot takes a `jax.sharding.Mesh` (or
+None for all local devices) — the mesh IS the cluster context here —
+and the "RDD" is any DataSetIterator or list of DataSet (a
+pre-sharded, already-local dataset; there is no JVM cluster to ship
+closures to).
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.parallel import trainer as _trainer
+
+
+class _DeferredMaster:
+    """A TrainingMaster configured before the net exists (upstream
+    builds the TrainingMaster first and hands it to the Spark wrapper,
+    which owns the net). bind() attaches net + mesh."""
+
+    def __init__(self, cls, kwargs):
+        self._cls = cls
+        self._kwargs = dict(kwargs)
+
+    def bind(self, net, mesh):
+        return self._cls(net, mesh=mesh, **self._kwargs)
+
+
+class ParameterAveragingTrainingMasterBuilder:
+    """Reference: ParameterAveragingTrainingMaster.Builder — the
+    `rddDataSetNumExamples`/`batchSizePerWorker` sizing args don't
+    exist here (batches keep whatever size the iterator yields; the
+    mesh shards them), so the constructor takes no required args."""
+
+    def __init__(self):
+        self._kw = {}
+
+    def averagingFrequency(self, k):
+        self._kw["averagingFrequency"] = int(k)
+        return self
+
+    def build(self):
+        return _DeferredMaster(_trainer.ParameterAveragingTrainingMaster,
+                               self._kw)
+
+
+class SharedTrainingMasterBuilder:
+    """Reference: SharedTrainingMaster.Builder (gradient-sharing mode;
+    int8-quantized allreduce by default, `thresholdAlgorithm` selects
+    Strom-2015 threshold encoding)."""
+
+    def __init__(self):
+        self._kw = {}
+
+    def thresholdAlgorithm(self, algo):
+        self._kw["thresholdAlgorithm"] = algo
+        return self
+
+    def gradientCompression(self, gc):
+        self._kw["gradient_compression"] = gc
+        return self
+
+    def targetSparsity(self, s):
+        self._kw["targetSparsity"] = float(s)
+        return self
+
+    def build(self):
+        return _DeferredMaster(_trainer.SharedTrainingMaster, self._kw)
+
+
+class SparkDl4jMultiLayer:
+    """Reference: SparkDl4jMultiLayer(sc, conf, trainingMaster).
+
+    `mesh`: jax Mesh or None (all local devices, data-parallel).
+    `conf_or_net`: a built configuration (init() is called for you,
+    like the Spark wrapper does) or an already-initialized net.
+    `trainingMaster`: a *Builder().build() deferred master, an already
+    -bound ParallelWrapper, or None (plain data-parallel).
+    """
+
+    def __init__(self, mesh, conf_or_net, trainingMaster=None):
+        cls = type(self)._net_cls()
+        if isinstance(conf_or_net, cls):
+            self._net = conf_or_net
+            if getattr(self._net, "_params", None) is None:
+                self._net.init()
+        else:
+            self._net = cls(conf_or_net).init()
+        if trainingMaster is None:
+            self._master = _trainer.ParallelWrapper(self._net, mesh=mesh)
+        elif isinstance(trainingMaster, _DeferredMaster):
+            self._master = trainingMaster.bind(self._net, mesh)
+        elif isinstance(trainingMaster, _trainer.ParallelWrapper):
+            self._master = trainingMaster
+        else:
+            raise ValueError(
+                f"trainingMaster must be a TrainingMaster builder result, "
+                f"a bound ParallelWrapper, or None; got {trainingMaster!r}")
+
+    @classmethod
+    def _net_cls(cls):
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        return MultiLayerNetwork
+
+    # ---------------- reference API -----------------------------------
+    def fit(self, data, epochs=None):
+        """`data`: DataSetIterator, list of DataSet, or a single
+        DataSet (the RDD analog). Returns the trained network, like
+        the reference's fit(JavaRDD<DataSet>)."""
+        if isinstance(data, (list, tuple)):
+            for _ in range(epochs or 1):
+                for ds in data:
+                    self._master.fit(ds)
+        else:
+            self._master.fit(data, epochs=epochs)
+        return self._net
+
+    def getNetwork(self):
+        return self._net
+
+    def getTrainingMaster(self):
+        return self._master
+
+    def evaluate(self, iterator):
+        return self._net.evaluate(iterator)
+
+    def evaluateRegression(self, iterator):
+        return self._net.evaluateRegression(iterator)
+
+    def evaluateROC(self, iterator, thresholdSteps=0):
+        return self._net.evaluateROC(iterator, thresholdSteps=thresholdSteps)
+
+
+class SparkComputationGraph(SparkDl4jMultiLayer):
+    """Reference: SparkComputationGraph — same wrapper over a
+    ComputationGraph (single-input/-output graphs, matching the
+    ParallelWrapper support surface)."""
+
+    @classmethod
+    def _net_cls(cls):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        return ComputationGraph

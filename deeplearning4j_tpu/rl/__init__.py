@@ -9,7 +9,11 @@ from deeplearning4j_tpu.rl.qlearning import (MDP, QLearningConfiguration,
 from deeplearning4j_tpu.rl.conv import (HistoryProcessorConfiguration,
                                         QLearningDiscreteConv)
 from deeplearning4j_tpu.rl.a3c import A3CConfiguration, A3CDiscreteDense
+from deeplearning4j_tpu.rl.async_nstep import (
+    AsyncNStepQLConfiguration, AsyncNStepQLearningDiscreteDense,
+)
 
 __all__ = ["MDP", "QLearningConfiguration", "QLearningDiscreteDense",
            "HistoryProcessorConfiguration", "QLearningDiscreteConv",
-           "A3CConfiguration", "A3CDiscreteDense"]
+           "A3CConfiguration", "A3CDiscreteDense",
+           "AsyncNStepQLConfiguration", "AsyncNStepQLearningDiscreteDense"]

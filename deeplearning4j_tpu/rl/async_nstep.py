@@ -1,0 +1,169 @@
+"""Async n-step Q-learning for discrete action spaces.
+
+Reference: rl4j org.deeplearning4j.rl4j.learning.async.nstep.discrete
+.AsyncNStepQLearningDiscreteDense with AsyncQLearningConfiguration
+(numThreads, nStep, gamma, targetDqnUpdateFreq, epsilon schedule).
+Upstream's third algorithm family: Hogwild workers accumulate n-step
+Q-gradients against a shared target net. Same TPU-native shape as
+`rl/a3c.py`: `numThreads` vectorized environments act in lockstep
+(epsilon-greedy over ONE jitted batched forward), the n-step targets
+bootstrap from a periodically-synced target network, and the update is
+ONE jitted fused step over the whole rollout — the decorrelation
+asynchrony buys upstream comes from the env batch here.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import updaters as _upd
+from deeplearning4j_tpu.rl.qlearning import BasePolicy
+
+
+class AsyncNStepQLConfiguration:
+    """Reference: AsyncQLearningConfiguration fields that shape the
+    algorithm (epsilon anneals linearly to minEpsilon over
+    epsilonNbStep environment steps, as upstream's EpsGreedy does)."""
+
+    def __init__(self, seed=123, gamma=0.99, nStep=8, numThreads=8,
+                 learningRate=1e-3, targetDqnUpdateFreq=50,
+                 minEpsilon=0.05, epsilonNbStep=3000, maxEpochStep=200):
+        self.seed = int(seed)
+        self.gamma = float(gamma)
+        self.nStep = int(nStep)
+        self.numThreads = int(numThreads)
+        self.learningRate = float(learningRate)
+        self.targetDqnUpdateFreq = int(targetDqnUpdateFreq)
+        self.minEpsilon = float(minEpsilon)
+        self.epsilonNbStep = int(epsilonNbStep)
+        self.maxEpochStep = int(maxEpochStep)
+
+
+class AsyncNStepQLearningDiscreteDense:
+    """n-step Q trainer (reference: AsyncNStepQLearningDiscreteDense).
+
+    `mdpFactory`: zero-arg callable returning a fresh MDP (upstream:
+    MDP.newInstance() per worker)."""
+
+    def __init__(self, mdpFactory, config=None, hiddenSize=32):
+        self.conf = config or AsyncNStepQLConfiguration()
+        c = self.conf
+        self._envs = [mdpFactory() for _ in range(c.numThreads)]
+        mdp = self._envs[0]
+        self.obsSize = mdp.obsSize()
+        self.numActions = mdp.numActions()
+        H = int(hiddenSize)
+        k = jax.random.split(jax.random.key(c.seed), 2)
+        s1 = 1.0 / np.sqrt(self.obsSize)
+        s2 = 1.0 / np.sqrt(H)
+        self.params = {
+            "W1": jax.random.uniform(k[0], (self.obsSize, H), jnp.float32,
+                                     -s1, s1),
+            "b1": jnp.zeros(H, jnp.float32),
+            "Wq": jax.random.uniform(k[1], (H, self.numActions), jnp.float32,
+                                     -s2, s2),
+            "bq": jnp.zeros(self.numActions, jnp.float32),
+        }
+        self.targetParams = jax.tree_util.tree_map(jnp.copy, self.params)
+        self._updater = _upd.Adam(c.learningRate)
+        self._upd_state = self._updater.init(self.params)
+        self._iteration = 0
+        self._rng = np.random.RandomState(c.seed)
+        self._step = 0
+        self._losses = []
+
+        def q_values(p, x):
+            h = jnp.tanh(x @ p["W1"] + p["b1"])
+            return h @ p["Wq"] + p["bq"]
+
+        self._jit_q = jax.jit(q_values)
+
+        def update(p, us, it, obs, acts, targets):
+            def loss_fn(p):
+                q = q_values(p, obs)
+                q_sa = jnp.take_along_axis(q, acts[:, None], 1)[:, 0]
+                return jnp.mean((targets - q_sa) ** 2)
+
+            loss, g = jax.value_and_grad(loss_fn)(p)
+            upd, us = self._updater.apply(g, us, it)
+            p = jax.tree_util.tree_map(lambda a, u: a - u, p, upd)
+            return p, us, loss
+
+        self._jit_update = jax.jit(update, donate_argnums=(0, 1))
+
+    def _epsilon(self):
+        c = self.conf
+        frac = min(1.0, self._step / max(1, c.epsilonNbStep))
+        return 1.0 + frac * (c.minEpsilon - 1.0)
+
+    def train(self, maxSteps=10_000):
+        c = self.conf
+        obs = np.stack([np.asarray(e.reset(), "float32")
+                        for e in self._envs])
+        ep_steps = np.zeros(len(self._envs), int)
+        while self._step < maxSteps:
+            O, A, R, D = [], [], [], []
+            for _ in range(c.nStep):
+                q = np.asarray(self._jit_q(self.params,
+                                           jnp.asarray(obs, jnp.float32)))
+                acts = q.argmax(1)
+                explore = self._rng.rand(len(self._envs)) < self._epsilon()
+                acts[explore] = self._rng.randint(
+                    0, self.numActions, int(explore.sum()))
+                nxt = np.empty_like(obs)
+                rews = np.zeros(len(self._envs), "float32")
+                dones = np.zeros(len(self._envs), "float32")
+                for i, (env, a) in enumerate(zip(self._envs, acts)):
+                    o2, r, d = env.step(int(a))
+                    ep_steps[i] += 1
+                    if ep_steps[i] >= c.maxEpochStep:
+                        d = True
+                    rews[i], dones[i] = r, float(d)
+                    nxt[i] = np.asarray(o2 if not d else env.reset(),
+                                        "float32")
+                    if d:
+                        ep_steps[i] = 0
+                O.append(obs.copy())
+                A.append(acts.astype(np.int64))
+                R.append(rews)
+                D.append(dones)
+                obs = nxt
+                self._step += len(self._envs)
+            # n-step targets bootstrapped from max_a Q_target(s_{t+n});
+            # a done cuts the bootstrap chain (upstream semantics)
+            q_boot = np.asarray(self._jit_q(self.targetParams,
+                                            jnp.asarray(obs, jnp.float32)))
+            ret = q_boot.max(1)
+            targets = []
+            for t in reversed(range(c.nStep)):
+                ret = R[t] + c.gamma * ret * (1.0 - D[t])
+                targets.append(ret)
+            targets.reverse()
+            self.params, self._upd_state, loss = self._jit_update(
+                self.params, self._upd_state,
+                jnp.asarray(self._iteration, jnp.int32),
+                jnp.asarray(np.concatenate(O), jnp.float32),
+                jnp.asarray(np.concatenate(A), jnp.int32),
+                jnp.asarray(np.concatenate(targets), jnp.float32))
+            self._iteration += 1
+            self._losses.append(float(loss))
+            if self._iteration % self.conf.targetDqnUpdateFreq == 0:
+                self.targetParams = jax.tree_util.tree_map(jnp.copy,
+                                                           self.params)
+        return self
+
+    def getPolicy(self):
+        """Greedy Q policy (reference: policy.DQNPolicy)."""
+        outer = self
+
+        class _Policy(BasePolicy):
+            def nextAction(self, obs):
+                q = np.asarray(outer._jit_q(
+                    outer.params,
+                    jnp.asarray(np.asarray(obs, "float32")[None])))
+                return int(q[0].argmax())
+
+        return _Policy()

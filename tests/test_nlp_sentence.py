@@ -1,0 +1,170 @@
+"""Tokenizer factories, preprocessors, and CnnSentenceDataSetIterator
+(reference: deeplearning4j-nlp text.tokenization + iterator.
+CnnSentenceDataSetIterator tests)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    Word2Vec, DefaultTokenizerFactory, CollectionSentenceIterator,
+    CommonPreprocessor, LowCasePreProcessor, EndingPreProcessor,
+    NGramTokenizerFactory, CnnSentenceDataSetIterator,
+    CollectionLabeledSentenceProvider, UnknownWordHandling,
+)
+
+
+class TestTokenization:
+    def test_default_tokenizer_with_preprocessor(self):
+        tf = DefaultTokenizerFactory()
+        assert tf.create("Hello, World! 42") == ["hello", "world", "42"]
+        tf.setTokenPreProcessor(CommonPreprocessor())
+        # digits stripped by CommonPreprocessor -> token drops out
+        assert tf.create("Hello, World! 42") == ["hello", "world"]
+
+    def test_lowcase_and_ending(self):
+        assert LowCasePreProcessor().preProcess("ABC") == "abc"
+        e = EndingPreProcessor()
+        assert e.preProcess("cats") == "cat"
+        assert e.preProcess("running") == "runn"  # reference parity: not a stemmer
+        assert e.preProcess("quickly") == "quick"
+        assert e.preProcess("boss") == "boss"
+
+    def test_ngram_factory(self):
+        tf = NGramTokenizerFactory(DefaultTokenizerFactory(), 1, 2)
+        toks = tf.create("the quick fox")
+        assert toks == ["the", "quick", "fox", "the quick", "quick fox"]
+
+    def test_ngram_bigram_only_and_errors(self):
+        tf = NGramTokenizerFactory(DefaultTokenizerFactory(), 2, 2)
+        assert tf.create("a b c") == ["a b", "b c"]
+        assert tf.create("single") == []
+        with pytest.raises(ValueError):
+            NGramTokenizerFactory(DefaultTokenizerFactory(), 3, 2)
+        with pytest.raises(ValueError):
+            NGramTokenizerFactory(DefaultTokenizerFactory(), 0, 2)
+
+
+def _corpus(n=80, seed=0):
+    rng = np.random.RandomState(seed)
+    pets = ["cat", "dog", "sheep", "horse"]
+    tech = ["cpu", "gpu", "disk", "ram"]
+    sents, labels = [], []
+    for _ in range(n):
+        src = pets if rng.rand() < 0.5 else tech
+        sents.append(" ".join(rng.choice(src, 5)))
+        labels.append("pets" if src is pets else "tech")
+    return sents, labels
+
+
+def _w2v(sents):
+    return (Word2Vec.Builder()
+            .minWordFrequency(1).layerSize(12).windowSize(3)
+            .negativeSample(4).seed(3).iterations(30).learningRate(0.4)
+            .iterate(CollectionSentenceIterator(sents))
+            .tokenizerFactory(DefaultTokenizerFactory())
+            .build().fit())
+
+
+class TestCnnSentenceIterator:
+    def test_shapes_masks_labels(self):
+        sents, labels = _corpus(20)
+        wv = _w2v(sents)
+        it = (CnnSentenceDataSetIterator.Builder()
+              .sentenceProvider(CollectionLabeledSentenceProvider(sents,
+                                                                  labels))
+              .wordVectors(wv).maxSentenceLength(8).minibatchSize(4)
+              .build())
+        assert it.getLabels() == ["pets", "tech"]
+        ds = it.next()
+        f = np.asarray(ds.getFeatures().jax())
+        m = np.asarray(ds.getFeaturesMaskArray().jax())
+        y = np.asarray(ds.getLabels().jax())
+        assert f.shape == (4, 1, 8, 12)
+        assert m.shape == (4, 8)
+        assert y.shape == (4, 2)
+        # sentences are 5 tokens -> mask has 5 ones, padding rows zero
+        assert m.sum(1).tolist() == [5.0] * 4
+        np.testing.assert_allclose(f[0, 0, 5:], 0.0)
+
+    def test_formats(self):
+        sents, labels = _corpus(8)
+        wv = _w2v(sents)
+        prov = CollectionLabeledSentenceProvider(sents, labels)
+        for fmt, shape in [("CNN1D", (8, 12, 6)), ("RNN", (8, 12, 6))]:
+            it = CnnSentenceDataSetIterator(
+                provider=prov, wordVectors=wv, maxSentenceLength=6,
+                minibatchSize=8, format=fmt)
+            f = np.asarray(it.next().getFeatures().jax())
+            assert f.shape == shape, (fmt, f.shape)
+
+    def test_unknown_word_handling(self):
+        sents, labels = _corpus(8)
+        wv = _w2v(sents)
+        prov = CollectionLabeledSentenceProvider(
+            ["cat zzz dog", "zzz zzz zzz"], ["pets", "tech"])
+        it = CnnSentenceDataSetIterator(
+            provider=prov, wordVectors=wv, maxSentenceLength=4,
+            minibatchSize=2, format="CNN")
+        m = np.asarray(it.next().getFeaturesMaskArray().jax())
+        # RemoveWord: zzz dropped -> lengths 2 and 1 (all-unknown keeps
+        # one zero step)
+        assert m.sum(1).tolist() == [2.0, 1.0]
+        it2 = CnnSentenceDataSetIterator(
+            provider=prov, wordVectors=wv, maxSentenceLength=4,
+            minibatchSize=2,
+            unknownWordHandling=UnknownWordHandling.UseUnknownVector)
+        m2 = np.asarray(it2.next().getFeaturesMaskArray().jax())
+        assert m2.sum(1).tolist() == [3.0, 3.0]
+
+    def test_errors(self):
+        sents, labels = _corpus(8)
+        wv = _w2v(sents)
+        with pytest.raises(ValueError):
+            CollectionLabeledSentenceProvider(["a"], ["x", "y"])
+        with pytest.raises(ValueError):
+            CollectionLabeledSentenceProvider([], [])
+        prov = CollectionLabeledSentenceProvider(sents, labels)
+        with pytest.raises(ValueError):
+            CnnSentenceDataSetIterator(provider=prov, wordVectors=wv,
+                                       format="NHWC")
+        with pytest.raises(ValueError):
+            CnnSentenceDataSetIterator(provider=prov, wordVectors=wv,
+                                       unknownWordHandling="Ignore")
+        with pytest.raises(ValueError):
+            CnnSentenceDataSetIterator(provider=None, wordVectors=wv)
+
+    def test_end_to_end_cnn_classifier(self):
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           MultiLayerNetwork,
+                                           ConvolutionLayer,
+                                           GlobalPoolingLayer, OutputLayer,
+                                           Adam)
+        from deeplearning4j_tpu.evaluation import Evaluation
+
+        sents, labels = _corpus(60, seed=4)
+        wv = _w2v(sents)
+        it = (CnnSentenceDataSetIterator.Builder()
+              .sentenceProvider(CollectionLabeledSentenceProvider(sents,
+                                                                  labels))
+              .wordVectors(wv).maxSentenceLength(8).minibatchSize(16)
+              .format("CNN").build())
+        conf = (NeuralNetConfiguration.Builder().seed(5).updater(Adam(3e-3))
+                .list()
+                .layer(ConvolutionLayer(nOut=8, kernelSize=(3, 12),
+                                        stride=(1, 1), padding=(0, 0),
+                                        activation="relu"))
+                .layer(GlobalPoolingLayer(poolingType="MAX"))
+                .layer(OutputLayer(nOut=2, activation="softmax",
+                                   lossFunction="mcxent"))
+                .setInputType(InputType.convolutional(8, 12, 1))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        for _ in range(15):
+            net.fit(it)
+        ev = Evaluation(2)
+        it.reset()
+        while it.hasNext():
+            ds = it.next()
+            ev.eval(np.asarray(ds.getLabels().jax()),
+                    np.asarray(net.output(ds.getFeatures()).jax()))
+        assert ev.accuracy() > 0.9, ev.accuracy()

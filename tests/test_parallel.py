@@ -765,3 +765,100 @@ class TestComputationGraphDataParallel:
         x, y, _ = _data(64)
         with pytest.raises(ValueError, match="single-input"):
             ParallelWrapper(net).fit(x[:, :2], y)
+
+
+class TestSparkFacade:
+    """SparkDl4jMultiLayer / SparkComputationGraph entry-point parity
+    (reference: dl4j-spark impl.multilayer/impl.graph wrappers)."""
+
+    def test_fit_with_parameter_averaging_builder(self):
+        from deeplearning4j_tpu.parallel import (
+            SparkDl4jMultiLayer, ParameterAveragingTrainingMasterBuilder)
+        x, y, yi = _data(96)
+        tm = (ParameterAveragingTrainingMasterBuilder()
+              .averagingFrequency(1).build())
+        spark_net = SparkDl4jMultiLayer(data_parallel_mesh(), _mlp(), tm)
+        it = DataSetIterator(x, y, 32)
+        for _ in range(30):
+            spark_net.fit(it)
+        net = spark_net.getNetwork()
+        acc = (np.asarray(net.output(x).jax()).argmax(1) == yi).mean()
+        assert acc > 0.9, acc
+        from deeplearning4j_tpu.parallel.trainer import \
+            ParameterAveragingTrainingMaster
+        assert isinstance(spark_net.getTrainingMaster(),
+                          ParameterAveragingTrainingMaster)
+
+    def test_fit_with_shared_master_and_evaluate(self):
+        from deeplearning4j_tpu.parallel import (
+            SparkDl4jMultiLayer, SharedTrainingMasterBuilder)
+        x, y, yi = _data(96, seed=3)
+        tm = SharedTrainingMasterBuilder().gradientCompression(None).build()
+        spark_net = SparkDl4jMultiLayer(None, _mlp(7), tm)
+        it = DataSetIterator(x, y, 32)
+        for _ in range(30):
+            spark_net.fit(it)
+        ev = spark_net.evaluate(DataSetIterator(x, y, 32))
+        assert ev.accuracy() > 0.9
+
+    def test_rdd_analog_list_of_datasets(self):
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.parallel import SparkDl4jMultiLayer
+        x, y, yi = _data(64, seed=5)
+        rdd = [DataSet(x[i:i + 32], y[i:i + 32]) for i in (0, 32)]
+        spark_net = SparkDl4jMultiLayer(None, _mlp(9))
+        for _ in range(25):
+            spark_net.fit(rdd)
+        acc = (np.asarray(spark_net.getNetwork().output(x).jax()).argmax(1)
+               == yi).mean()
+        assert acc > 0.85, acc
+
+    def test_rdd_list_honors_epochs(self):
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.parallel import SparkDl4jMultiLayer
+
+        class CountingMaster(ParallelWrapper):
+            fits = 0
+
+            def fit(self, data, labels=None, epochs=None):
+                CountingMaster.fits += 1
+                return super().fit(data, labels, epochs)
+
+        x, y, _ = _data(32)
+        net = MultiLayerNetwork(_mlp()).init()
+        spark_net = SparkDl4jMultiLayer(None, net, CountingMaster(net))
+        spark_net.fit([DataSet(x, y)], epochs=3)
+        assert CountingMaster.fits == 3
+
+    def test_accepts_prebuilt_net_and_bound_master(self):
+        from deeplearning4j_tpu.parallel import SparkDl4jMultiLayer
+        net = MultiLayerNetwork(_mlp()).init()
+        pw = ParallelWrapper(net)
+        spark_net = SparkDl4jMultiLayer(None, net, pw)
+        assert spark_net.getNetwork() is net
+        assert spark_net.getTrainingMaster() is pw
+
+    def test_rejects_bad_master(self):
+        from deeplearning4j_tpu.parallel import SparkDl4jMultiLayer
+        with pytest.raises(ValueError, match="trainingMaster"):
+            SparkDl4jMultiLayer(None, _mlp(), trainingMaster="averaging")
+
+    def test_computation_graph_facade(self):
+        from deeplearning4j_tpu.parallel import SparkComputationGraph
+        x, y, yi = _data(64, seed=8)
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(1).updater(Adam(1e-2)).graphBuilder()
+                .addInputs("in")
+                .addLayer("h", DenseLayer(nOut=32, activation="relu"), "in")
+                .addLayer("out", OutputLayer(nOut=3, activation="softmax"),
+                          "h")
+                .setOutputs("out")
+                .setInputTypes(InputType.feedForward(4))
+                .build())
+        spark_g = SparkComputationGraph(None, conf)
+        it = DataSetIterator(x, y, 32)
+        for _ in range(25):
+            spark_g.fit(it)
+        acc = (np.asarray(spark_g.getNetwork().output(x).jax()).argmax(1)
+               == yi).mean()
+        assert acc > 0.85, acc

@@ -130,3 +130,56 @@ class TestA3C:
         pol = a3c.getPolicy(greedy=False)
         acts = {pol.nextAction(ChainMDP(5).reset()) for _ in range(40)}
         assert acts <= {0, 1} and len(acts) >= 1
+
+
+class TestAsyncNStepQLearning:
+    """Reference: rl4j AsyncNStepQLearningDiscreteDense — the third
+    async family, vectorized like A3C but with n-step Q targets and a
+    periodically-synced target net."""
+
+    def _train(self, steps=12_000):
+        from deeplearning4j_tpu.rl import (AsyncNStepQLConfiguration,
+                                           AsyncNStepQLearningDiscreteDense)
+        conf = AsyncNStepQLConfiguration(seed=11, gamma=0.9, nStep=10,
+                                         numThreads=8, learningRate=3e-3,
+                                         targetDqnUpdateFreq=20,
+                                         minEpsilon=0.05,
+                                         epsilonNbStep=6000,
+                                         maxEpochStep=30)
+        return AsyncNStepQLearningDiscreteDense(
+            lambda: ChainMDP(5), conf, hiddenSize=32).train(maxSteps=steps)
+
+    def test_solves_chain(self):
+        ql = self._train()
+        assert ql.getPolicy().play(ChainMDP(5), maxSteps=20) == 10.0
+        # TD loss settles: late loss below early loss
+        l = ql._losses
+        assert np.mean(l[-10:]) < np.mean(l[:10]), (l[:3], l[-3:])
+
+    def test_greedy_policy_right_from_every_state(self):
+        ql = self._train()
+        pol = ql.getPolicy()
+        mdp = ChainMDP(5)
+        for s in range(4):
+            mdp.s = s
+            assert pol.nextAction(mdp._obs()) == 1, f"state {s}"
+
+    def test_epsilon_anneals(self):
+        from deeplearning4j_tpu.rl import (AsyncNStepQLConfiguration,
+                                           AsyncNStepQLearningDiscreteDense)
+        conf = AsyncNStepQLConfiguration(minEpsilon=0.1, epsilonNbStep=100)
+        ql = AsyncNStepQLearningDiscreteDense(lambda: ChainMDP(5), conf)
+        assert ql._epsilon() == 1.0
+        ql._step = 50
+        assert abs(ql._epsilon() - 0.55) < 1e-9
+        ql._step = 1000
+        assert abs(ql._epsilon() - 0.1) < 1e-9
+
+    def test_target_net_syncs(self):
+        ql = self._train(steps=2000)
+        # after >= targetDqnUpdateFreq iterations the target equals a
+        # recent params snapshot, not the init
+        diff = float(np.abs(np.asarray(ql.targetParams["Wq"])
+                            - np.asarray(ql.params["Wq"])).max())
+        assert diff < 1.0  # moved with training (init target is random-far)
+        assert ql._iteration >= ql.conf.targetDqnUpdateFreq
