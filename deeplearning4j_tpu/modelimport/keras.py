@@ -213,6 +213,21 @@ def _walk_keras3_history(node):
 # layer conversion
 # ---------------------------------------------------------------------------
 
+def _normalization_guards(cfg, name):
+    """Shared keras.layers.Normalization support checks (channels-last
+    stats, no invert) for both the adapt-mode BN mapping and the
+    constructor-mode vertex mapping."""
+    axis = cfg.get("axis", -1)
+    axis = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    if axis not in ((-1,), (3,)):
+        raise UnsupportedKerasConfigurationException(
+            f"Normalization over axis {axis} not supported "
+            f"(channels-last only; layer '{name}')")
+    if cfg.get("invert", False):
+        raise UnsupportedKerasConfigurationException(
+            f"Normalization(invert=True) not supported (layer '{name}')")
+
+
 def _convert_layer(spec: _KerasLayerSpec, is_last: bool):
     """Keras layer spec → (native layer | None, activation carried)."""
     cn, cfg = spec.className, spec.config
@@ -377,6 +392,27 @@ def _convert_layer(spec: _KerasLayerSpec, is_last: bool):
             lockGamma=not cfg.get("scale", True),
             lockBeta=not cfg.get("center", True),
             name=name)
+        return bn
+    if cn == "Normalization":
+        # keras.layers.Normalization (e.g. the EfficientNet stem):
+        # (x - mean) / sqrt(var). ADAPT mode (mean/var stored as
+        # weights) is exactly a frozen no-gamma/no-beta
+        # BatchNormalization in inference mode (eps=0: Keras guards
+        # sqrt(var) with epsilon(), ~1e-7, invisible at
+        # image-statistics variance scales); the BN weight mapper reads
+        # [mean, variance, (count ignored)] as-is. CONSTRUCTOR mode
+        # (mean/var in the config, NO weights) is intercepted by the
+        # functional importer as Shift/Scale vertices before reaching
+        # here.
+        _normalization_guards(cfg, name)
+        if cfg.get("mean") is not None:
+            raise UnsupportedKerasConfigurationException(
+                f"Normalization with constructor mean/variance is only "
+                f"supported in Functional models (layer '{name}')")
+        # eps=1e-14 ~ Keras's maximum(sqrt(var), epsilon()) clamp: equal
+        # at var=0, invisible at real-statistics variance scales
+        bn = L.BatchNormalization(eps=1e-14, lockGammaBeta=True, name=name)
+        bn.frozen = True  # stats are dataset constants, never updated
         return bn
     if cn == "ZeroPadding2D":
         pad = cfg.get("padding", 1)
@@ -742,6 +778,40 @@ class KerasModelImport:
                        "Maximum": ElementWiseVertex("max"),
                        "Concatenate": MergeVertex()}[sp.className]
                 gb.addVertex(sp.name, vtx, *inputs)
+                continue
+            if (sp.className == "Normalization"
+                    and sp.config.get("mean") is not None):
+                # constructor-mode Normalization: mean/variance are
+                # config constants (no weights) -> (x - mean)/sqrt(var)
+                # as chained Shift/Scale vertices
+                from deeplearning4j_tpu.nn.conf.graph import (ScaleVertex,
+                                                              ShiftVertex)
+
+                _normalization_guards(sp.config, sp.name)
+                mean = np.asarray(sp.config["mean"], np.float32).reshape(-1)
+                # Keras clamps the denominator at epsilon() ~1e-7;
+                # clamping variance at its square keeps a zero-variance
+                # channel finite with the same result
+                var = np.maximum(np.asarray(sp.config["variance"],
+                                            np.float32).reshape(-1), 1e-14)
+                gb.addVertex(sp.name + "_kshift",
+                             ShiftVertex(-mean), *inputs)
+                gb.addVertex(sp.name, ScaleVertex(1.0 / np.sqrt(var)),
+                             sp.name + "_kshift")
+                continue
+            if sp.className == "Rescaling":
+                # keras.layers.Rescaling: x*scale + offset with config
+                # constants (no weights) -> chained Scale/Shift vertices
+                # (the reference's ScaleVertex/ShiftVertex, extended to
+                # per-channel factors)
+                from deeplearning4j_tpu.nn.conf.graph import (ScaleVertex,
+                                                              ShiftVertex)
+
+                c = sp.config
+                gb.addVertex(sp.name + "_kscale",
+                             ScaleVertex(c.get("scale", 1.0)), *inputs)
+                gb.addVertex(sp.name, ShiftVertex(c.get("offset", 0.0)),
+                             sp.name + "_kscale")
                 continue
             if sp.className == "MultiHeadAttention":
                 from deeplearning4j_tpu.nn.conf.attention import AttentionVertex

@@ -22,6 +22,15 @@ class TokenPreProcess:
         raise NotImplementedError
 
 
+def apply_preprocessor(words, pre):
+    """Map `pre` over tokens, dropping tokens it empties — the one
+    shared copy of the factory-side preprocessor contract (used by
+    DefaultTokenizerFactory and NGramTokenizerFactory)."""
+    if pre is None:
+        return words
+    return [w for w in (pre.preProcess(t) for t in words) if w]
+
+
 class LowCasePreProcessor(TokenPreProcess):
     def preProcess(self, token):
         return token.lower()
@@ -71,9 +80,7 @@ class NGramTokenizerFactory:
         self._pre = pre
 
     def create(self, sentence):
-        words = self._base.create(sentence)
-        if self._pre is not None:
-            words = [w for w in (self._pre.preProcess(t) for t in words) if w]
+        words = apply_preprocessor(self._base.create(sentence), self._pre)
         out = []
         for n in range(self.minN, self.maxN + 1):
             out.extend(" ".join(words[i:i + n])

@@ -38,10 +38,10 @@ class DefaultTokenizerFactory:
         self._pre = pre
 
     def create(self, sentence):
-        words = self._RE.findall(sentence.lower())
-        if self._pre is not None:
-            words = [w for w in (self._pre.preProcess(t) for t in words) if w]
-        return words
+        from deeplearning4j_tpu.nlp.tokenization import apply_preprocessor
+
+        return apply_preprocessor(self._RE.findall(sentence.lower()),
+                                  self._pre)
 
 
 class CollectionSentenceIterator:

@@ -135,9 +135,25 @@ class UnstackVertex(GraphVertex):
         return its[0]
 
 
+def _affine_factor(v):
+    """Scalar (reference ScaleVertex/ShiftVertex semantics) or a
+    per-feature array broadcast over the LAST axis — activations are
+    channels-last internally, so a [C] factor is per-channel. Used by
+    the Keras importer for Rescaling/Normalization constants."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    arr = jnp.asarray(v, jnp.float32)
+    if arr.ndim == 0:  # numpy/jax 0-d scalars: float() accepted them before
+        return float(arr)
+    if arr.ndim != 1:
+        raise ValueError(f"scale/shift factor must be a scalar or 1-d "
+                         f"per-channel array, got shape {arr.shape}")
+    return arr
+
+
 class ScaleVertex(GraphVertex):
     def __init__(self, scaleFactor):
-        self.scaleFactor = float(scaleFactor)
+        self.scaleFactor = _affine_factor(scaleFactor)
 
     def apply(self, inputs):
         return inputs[0] * self.scaleFactor
@@ -148,7 +164,7 @@ class ScaleVertex(GraphVertex):
 
 class ShiftVertex(GraphVertex):
     def __init__(self, shiftFactor):
-        self.shiftFactor = float(shiftFactor)
+        self.shiftFactor = _affine_factor(shiftFactor)
 
     def apply(self, inputs):
         return inputs[0] + self.shiftFactor

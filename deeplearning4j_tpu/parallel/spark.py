@@ -100,6 +100,12 @@ class SparkDl4jMultiLayer:
         elif isinstance(trainingMaster, _DeferredMaster):
             self._master = trainingMaster.bind(self._net, mesh)
         elif isinstance(trainingMaster, _trainer.ParallelWrapper):
+            if trainingMaster.net is not self._net:
+                raise ValueError(
+                    "bound trainingMaster wraps a different network than "
+                    "this facade's — fit() would train one net while "
+                    "evaluate()/getNetwork() used the other; pass the same "
+                    "net to both, or pass a *TrainingMasterBuilder result")
             self._master = trainingMaster
         else:
             raise ValueError(
@@ -116,12 +122,19 @@ class SparkDl4jMultiLayer:
         """`data`: DataSetIterator, list of DataSet, or a single
         DataSet (the RDD analog). Returns the trained network, like
         the reference's fit(JavaRDD<DataSet>)."""
+        from deeplearning4j_tpu.data.dataset import DataSet
+
+        n_ep = 1 if epochs is None else int(epochs)
+        if n_ep < 1:
+            raise ValueError(f"epochs must be >= 1, got {epochs}")
+        if isinstance(data, DataSet):
+            data = [data]  # single batch honors epochs like a list does
         if isinstance(data, (list, tuple)):
-            for _ in range(epochs or 1):
+            for _ in range(n_ep):
                 for ds in data:
                     self._master.fit(ds)
         else:
-            self._master.fit(data, epochs=epochs)
+            self._master.fit(data, epochs=n_ep)
         return self._net
 
     def getNetwork(self):

@@ -519,3 +519,68 @@ class TestKerasApplicationsImport:
         with pytest.raises(UnsupportedKerasConfigurationException,
                            match="max_value"):
             KerasModelImport.importKerasSequentialModelAndWeights(spec)
+
+
+class TestEfficientNetImport:
+    """Round-4 second wave: Rescaling + Normalization (the EfficientNet
+    preprocessing stem) and SE-block broadcasting Multiply."""
+
+    def test_rescaling_normalization_parity(self):
+        keras.utils.set_random_seed(8)
+        inp = keras.Input((8, 8, 3), name="in0")
+        x = keras.layers.Rescaling(scale=1 / 127.5, offset=-1.0,
+                                   name="resc")(inp)
+        norm = keras.layers.Normalization(
+            axis=-1, mean=[0.2, -0.1, 0.4], variance=[1.5, 0.7, 2.0],
+            name="nrm")
+        x = norm(x)
+        x = keras.layers.Conv2D(4, 3, activation="relu", name="cv")(x)
+        x = keras.layers.GlobalAveragePooling2D(name="gap")(x)
+        out = keras.layers.Dense(3, activation="softmax", name="d")(x)
+        km = keras.Model(inp, out)
+        w = {l.name: l.get_weights() for l in km.layers if l.get_weights()}
+        net = KerasModelImport.importKerasModelAndWeights(km.to_json(),
+                                                          weights=w)
+        xv = np.random.RandomState(0).rand(2, 8, 8, 3).astype("float32") * 255
+        golden = km.predict(xv, verbose=0)
+        ours = np.asarray(net.output(xv.transpose(0, 3, 1, 2)).jax())
+        np.testing.assert_allclose(ours, golden, rtol=1e-4, atol=1e-5)
+
+    def test_normalization_guards(self):
+        inp = keras.Input((4, 4, 3))
+        x = keras.layers.Normalization(axis=1, mean=np.zeros((4, 1, 1)),
+                                       variance=np.ones((4, 1, 1)))(inp)
+        km = keras.Model(inp, keras.layers.Flatten()(x))
+        with pytest.raises(UnsupportedKerasConfigurationException,
+                           match="axis"):
+            KerasModelImport.importKerasModelAndWeights(km.to_json())
+
+    def test_efficientnetb0_exact(self):
+        # the full architecture: Rescaling/Normalization stem, MBConv
+        # blocks with broadcasting SE Multiply, swish, DepthwiseConv2D
+        keras.utils.set_random_seed(9)
+        km = tf.keras.applications.EfficientNetB0(
+            weights=None, input_shape=(64, 64, 3), classes=5)
+        w = {l.name: l.get_weights() for l in km.layers if l.get_weights()}
+        net = KerasModelImport.importKerasModelAndWeights(km.to_json(),
+                                                          weights=w)
+        x = np.random.RandomState(1).rand(2, 64, 64, 3).astype("float32") * 255
+        golden = km.predict(x, verbose=0)
+        ours = np.asarray(net.output(x.transpose(0, 3, 1, 2)).jax())
+        np.testing.assert_allclose(ours, golden, rtol=1e-3, atol=1e-4)
+
+    @pytest.mark.parametrize("app,size", [
+        ("EfficientNetV2B0", 64), ("Xception", 96), ("ResNet50V2", 64)])
+    def test_more_applications_exact(self, app, size):
+        # came for free with the EfficientNet layers — pin them
+        keras.utils.set_random_seed(11)
+        km = getattr(tf.keras.applications, app)(
+            weights=None, input_shape=(size, size, 3), classes=5)
+        w = {l.name: l.get_weights() for l in km.layers if l.get_weights()}
+        net = KerasModelImport.importKerasModelAndWeights(km.to_json(),
+                                                          weights=w)
+        x = np.random.RandomState(1).rand(2, size, size, 3).astype(
+            "float32") * 255
+        golden = km.predict(x, verbose=0)
+        ours = np.asarray(net.output(x.transpose(0, 3, 1, 2)).jax())
+        np.testing.assert_allclose(ours, golden, rtol=1e-3, atol=1e-4)
