@@ -22,6 +22,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from deeplearning4j_tpu.nlp.tokenization import \
+    apply_preprocessor as _apply_preprocessor
+
 
 class DefaultTokenizerFactory:
     """Lowercasing word tokenizer (reference:
@@ -38,10 +41,8 @@ class DefaultTokenizerFactory:
         self._pre = pre
 
     def create(self, sentence):
-        from deeplearning4j_tpu.nlp.tokenization import apply_preprocessor
-
-        return apply_preprocessor(self._RE.findall(sentence.lower()),
-                                  self._pre)
+        return _apply_preprocessor(self._RE.findall(sentence.lower()),
+                                   self._pre)
 
 
 class CollectionSentenceIterator:

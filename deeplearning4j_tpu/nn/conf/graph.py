@@ -137,40 +137,64 @@ class UnstackVertex(GraphVertex):
 
 def _affine_factor(v):
     """Scalar (reference ScaleVertex/ShiftVertex semantics) or a
-    per-feature array broadcast over the LAST axis — activations are
-    channels-last internally, so a [C] factor is per-channel. Used by
-    the Keras importer for Rescaling/Normalization constants."""
+    per-feature 1-d factor broadcast over the LAST axis — 2-d and 4-d
+    activations are channels-last internally, so a [C] factor is
+    per-channel (used by the Keras importer for Rescaling/Normalization
+    constants). Stored as float/tuple, NOT an array: configs must stay
+    array-free so toJson() works."""
+    import numpy as _np
+
     if isinstance(v, (int, float)):
         return float(v)
-    arr = jnp.asarray(v, jnp.float32)
+    arr = _np.asarray(v, _np.float32)
     if arr.ndim == 0:  # numpy/jax 0-d scalars: float() accepted them before
         return float(arr)
     if arr.ndim != 1:
         raise ValueError(f"scale/shift factor must be a scalar or 1-d "
                          f"per-channel array, got shape {arr.shape}")
-    return arr
+    return tuple(float(x) for x in arr)
 
 
-class ScaleVertex(GraphVertex):
+class _AffineVertex(GraphVertex):
+    """Shared Scale/Shift machinery: factor validation, per-channel
+    broadcast, and the NCW guard (3-d recurrent activations are
+    channels-FIRST internally, so a last-axis factor would scale time)."""
+
+    _factor = 0.0
+
+    def _value(self, x):
+        if isinstance(self._factor, float):
+            return self._factor
+        if x.ndim == 3:
+            raise ValueError(
+                f"per-channel {type(self).__name__} factors are not "
+                "supported on recurrent (NCW) activations — the factor "
+                "would broadcast over the time axis")
+        return jnp.asarray(self._factor, jnp.float32)
+
+    def getOutputType(self, *its):
+        if (not isinstance(self._factor, float)
+                and its[0].kind == InputType.RNN):
+            raise ValueError(
+                f"per-channel {type(self).__name__} factors are not "
+                "supported on recurrent inputs")
+        return its[0]
+
+
+class ScaleVertex(_AffineVertex):
     def __init__(self, scaleFactor):
-        self.scaleFactor = _affine_factor(scaleFactor)
+        self.scaleFactor = self._factor = _affine_factor(scaleFactor)
 
     def apply(self, inputs):
-        return inputs[0] * self.scaleFactor
-
-    def getOutputType(self, *its):
-        return its[0]
+        return inputs[0] * self._value(inputs[0])
 
 
-class ShiftVertex(GraphVertex):
+class ShiftVertex(_AffineVertex):
     def __init__(self, shiftFactor):
-        self.shiftFactor = _affine_factor(shiftFactor)
+        self.shiftFactor = self._factor = _affine_factor(shiftFactor)
 
     def apply(self, inputs):
-        return inputs[0] + self.shiftFactor
-
-    def getOutputType(self, *its):
-        return its[0]
+        return inputs[0] + self._value(inputs[0])
 
 
 class L2NormalizeVertex(GraphVertex):

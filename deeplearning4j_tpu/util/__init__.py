@@ -5,11 +5,13 @@ org.nd4j.linalg.profiler.
 """
 
 from deeplearning4j_tpu.util.serializer import ModelSerializer, TrainingCheckpoint
+from deeplearning4j_tpu.util.sharded_checkpoint import ShardedModelSerializer
 from deeplearning4j_tpu.util.workspace import (
     MemoryWorkspace, WorkspaceConfiguration, WorkspaceManager,
 )
 from deeplearning4j_tpu.util.profiler import OpProfiler, trace, annotate
 
-__all__ = ["ModelSerializer", "TrainingCheckpoint", "MemoryWorkspace",
+__all__ = ["ModelSerializer", "TrainingCheckpoint", "ShardedModelSerializer",
+           "MemoryWorkspace",
            "WorkspaceConfiguration", "WorkspaceManager", "OpProfiler",
            "trace", "annotate"]

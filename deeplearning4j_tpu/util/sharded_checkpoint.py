@@ -1,0 +1,137 @@
+"""Sharded (multi-host, per-device) checkpointing via Orbax.
+
+Reference: the reference stack checkpoints through
+ModelSerializer/CheckpointListener on a single JVM, and its Spark tier
+ships full parameter blobs through the driver. On TPU pods neither
+works: parameters live SHARDED across hosts (tensor/pipeline parallel),
+and funnelling them through one host at checkpoint time costs a full
+DCN gather per save. This module is the TPU-native replacement:
+Orbax/TensorStore writes each host's shards in parallel (OCDBT), saves
+are optionally async (training continues while the previous step's
+state flushes), and restore reshards automatically onto whatever mesh
+the restoring job uses — save on dp8, restore on dp2xtp4, or on one
+chip.
+
+Format: an Orbax directory holding the array state (params / updater
+moments / layer states / counters) plus a `manifest.json` with the
+serde-encoded network configuration, so `restore(path)` can rebuild
+the net without the caller supplying one (parity with
+ModelSerializer.restore's type dispatch).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.util import serde
+
+_MANIFEST = "manifest.json"
+_STATE_DIR = "state"
+
+
+def _net_state(net, saveUpdater=True):
+    state = {
+        "params": net._params,
+        "states": net._strip_carries(net._states),
+        "counters": {"iteration": np.int64(net._iteration),
+                     "epoch": np.int64(net._epoch)},
+    }
+    if saveUpdater:
+        state["upd_states"] = net._upd_states
+    return state
+
+
+class ShardedModelSerializer:
+    """writeModel/restore with Orbax-sharded array storage (the
+    distributed complement of util.serializer.ModelSerializer)."""
+
+    @staticmethod
+    def writeModel(net, path, saveUpdater=True, asyncSave=False):
+        """Save to directory `path`. With asyncSave=True the write
+        happens in the background — call the returned handle's
+        .wait_until_finished() (or save again / exit) to join it.
+        Sharded arrays are written per-shard: on multi-host, each host
+        writes only the shards it owns."""
+        import orbax.checkpoint as ocp
+
+        path = os.path.abspath(str(path))
+        os.makedirs(path, exist_ok=True)
+        conf_arrays = []
+        conf_node = serde.encode(net.conf, conf_arrays)
+        manifest = {
+            "cls": type(net).__name__,
+            "conf": conf_node,
+            # config-level constants (init values, vertex factors) are
+            # small; inline them so restore can rebuild the net BEFORE
+            # touching the array store
+            "conf_arrays": [{"dtype": str(np.asarray(a).dtype),
+                             "data": np.asarray(a).tolist()}
+                            for a in conf_arrays],
+            "saveUpdater": bool(saveUpdater),
+        }
+        with open(os.path.join(path, _MANIFEST), "w") as f:
+            json.dump(manifest, f)
+        ckpt = (ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+                if asyncSave else ocp.StandardCheckpointer())
+        state_path = os.path.join(path, _STATE_DIR)
+        ckpt.save(state_path, _net_state(net, saveUpdater), force=True)
+        if not asyncSave:
+            ckpt.wait_until_finished()
+        return ckpt
+
+    @staticmethod
+    def restore(path, sharding=None):
+        """Rebuild the network from `path`. `sharding`: optional
+        jax.sharding.Sharding (e.g. NamedSharding(mesh, P()) to
+        replicate onto a new mesh) applied to every restored array —
+        omit it to restore with the checkpoint's own layout on the
+        current devices. Works across topologies: Orbax reshards from
+        however many hosts/devices wrote the checkpoint."""
+        import orbax.checkpoint as ocp
+
+        path = os.path.abspath(str(path))
+        mpath = os.path.join(path, _MANIFEST)
+        if not os.path.exists(mpath):
+            raise ValueError(f"no sharded checkpoint at {path} "
+                             f"(missing {_MANIFEST})")
+        with open(mpath) as f:
+            manifest = json.load(f)
+        conf_arrays = [np.asarray(d["data"], dtype=d["dtype"])
+                       for d in manifest.get("conf_arrays", [])]
+        conf = serde.decode(manifest["conf"], conf_arrays)
+        if manifest["cls"] == "ComputationGraph":
+            from deeplearning4j_tpu.nn.graph import ComputationGraph
+            net = ComputationGraph(conf).init()
+        else:
+            from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+            net = MultiLayerNetwork(conf).init()
+
+        # the freshly-initialized net provides the restore target's
+        # structure and dtypes; sharding (if given) overrides placement
+        target = _net_state(net, manifest["saveUpdater"])
+
+        def _abstract(x):
+            x = jax.numpy.asarray(x)
+            # default to the fresh target's own placement: explicit
+            # shardings make cross-topology restores safe (Orbax warns
+            # when it has to guess from the sharding file)
+            return jax.ShapeDtypeStruct(
+                x.shape, x.dtype,
+                sharding=sharding if sharding is not None else x.sharding)
+
+        abstract = jax.tree_util.tree_map(_abstract, target)
+        ckpt = ocp.StandardCheckpointer()
+        state = ckpt.restore(os.path.join(path, _STATE_DIR), abstract)
+        ckpt.wait_until_finished()
+
+        net._params = state["params"]
+        net._states = state["states"]
+        if manifest["saveUpdater"]:
+            net._upd_states = state["upd_states"]
+        net._iteration = int(state["counters"]["iteration"])
+        net._epoch = int(state["counters"]["epoch"])
+        return net
