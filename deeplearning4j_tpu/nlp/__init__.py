@@ -20,6 +20,9 @@ from deeplearning4j_tpu.nlp.cnn_sentence import (
     CnnSentenceDataSetIterator, CollectionLabeledSentenceProvider,
     UnknownWordHandling,
 )
+from deeplearning4j_tpu.nlp.serializer import (
+    WordVectorSerializer, StaticWordVectors,
+)
 
 __all__ = ["Word2Vec", "ParagraphVectors", "DefaultTokenizerFactory",
            "CollectionSentenceIterator", "LineSentenceIterator", "Glove",
@@ -28,4 +31,5 @@ __all__ = ["Word2Vec", "ParagraphVectors", "DefaultTokenizerFactory",
            "TokenPreProcess", "LowCasePreProcessor", "CommonPreprocessor",
            "EndingPreProcessor", "NGramTokenizerFactory",
            "CnnSentenceDataSetIterator",
-           "CollectionLabeledSentenceProvider", "UnknownWordHandling"]
+           "CollectionLabeledSentenceProvider", "UnknownWordHandling",
+           "WordVectorSerializer", "StaticWordVectors"]

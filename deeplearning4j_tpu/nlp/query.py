@@ -1,0 +1,41 @@
+"""Shared word-vector query surface.
+
+Reference: the WordVectors/WordVectorsImpl interface in
+deeplearning4j-nlp (hasWord / getWordVector / similarity /
+wordsNearest) — one implementation serving both trained models
+(Word2Vec and subclasses) and loaded static tables
+(StaticWordVectors). Cosine scans are one [V, D] @ [D] product.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class WordVectorQuery:
+    """Mixin over (self.vocab, self._ivocab, self._W). Subclasses may
+    override _matrix() to gate access (e.g. require fit())."""
+
+    def _matrix(self):
+        return np.asarray(self._W)
+
+    def hasWord(self, word):
+        return word in self.vocab
+
+    def getWordVector(self, word):
+        # a COPY: callers normalize in place; a live view would corrupt
+        # the shared table
+        return np.array(self._matrix()[self.vocab[word]])
+
+    def similarity(self, w1, w2):
+        W = self._matrix()
+        a, b = W[self.vocab[w1]], W[self.vocab[w2]]
+        return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
+
+    def wordsNearest(self, word, n=10):
+        W = self._matrix()
+        v = W[self.vocab[word]]
+        sims = W @ v / (np.linalg.norm(W, axis=1) * np.linalg.norm(v) + 1e-12)
+        order = np.argsort(-sims)
+        out = [self._ivocab[i] for i in order if self._ivocab[i] != word]
+        return out[:n]

@@ -1,0 +1,116 @@
+"""Word-vector serialization and interop.
+
+Reference: org.deeplearning4j.models.embeddings.loader
+.WordVectorSerializer — writeWordVectors (the word2vec/GloVe text
+format: optional "V D" header then one "word v1 .. vD" line per word),
+loadTxtVectors, readWord2VecModel. Host-side text I/O; the loaded table
+becomes one [V, D] device array so lookups and similarity scans are
+matmul-shaped like the trained Word2Vec's own query API.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.query import WordVectorQuery
+
+
+class StaticWordVectors(WordVectorQuery):
+    """Read-only word vectors (reference: the WordVectors interface as
+    returned by loadTxtVectors). Shares Word2Vec's query surface
+    (hasWord/getWordVector/similarity/wordsNearest + `vocab`), so it
+    plugs into CnnSentenceDataSetIterator and friends."""
+
+    def __init__(self, words, matrix):
+        self._ivocab = list(words)
+        self.vocab = {w: i for i, w in enumerate(self._ivocab)}
+        if len(self.vocab) != len(self._ivocab):
+            raise ValueError("duplicate words in vector table")
+        self._W = np.asarray(matrix, np.float32)
+        if self._W.ndim != 2 or self._W.shape[0] != len(self._ivocab):
+            raise ValueError(
+                f"matrix shape {self._W.shape} does not match "
+                f"{len(self._ivocab)} words")
+
+
+class WordVectorSerializer:
+    @staticmethod
+    def writeWordVectors(vectors, path, writeHeader=True):
+        """Text format (reference: WordVectorSerializer.writeWordVectors):
+        optional "V D" header, then "word v1 .. vD" per line. Accepts a
+        trained Word2Vec/ParagraphVectors/Glove or a StaticWordVectors."""
+        words = (vectors._ivocab if hasattr(vectors, "_ivocab")
+                 else sorted(vectors.vocab))
+        if not words:
+            raise ValueError("no words to write")
+        first = np.asarray(vectors.getWordVector(words[0]))
+        with open(str(path), "w", encoding="utf-8") as f:
+            if writeHeader:
+                f.write(f"{len(words)} {first.shape[0]}\n")
+            for w in words:
+                if any(c.isspace() for c in w):
+                    raise ValueError(
+                        f"word {w!r} contains whitespace — unrepresentable "
+                        "in the text format")
+                vec = np.asarray(vectors.getWordVector(w), np.float32)
+                f.write(w + " " + " ".join(f"{x:.6g}" for x in vec) + "\n")
+
+    @staticmethod
+    def loadTxtVectors(path):
+        """-> StaticWordVectors (reference: loadTxtVectors). Accepts
+        files with or without the "V D" header line (GloVe ships
+        headerless); any whitespace separates fields."""
+        with open(str(path), encoding="utf-8") as f:
+            lines = [(ln, parts) for ln, parts in
+                     ((ln, line.split()) for ln, line in enumerate(f, 1))
+                     if parts]
+        if lines and len(lines[0][1]) == 2:
+            # a "V D" header has exactly two int tokens AND a matching
+            # body line count — the count check keeps a headerless
+            # numeric-vocab 1-d file from losing its first row
+            try:
+                v, _ = int(lines[0][1][0]), int(lines[0][1][1])
+                if v == len(lines) - 1:
+                    lines = lines[1:]
+            except ValueError:
+                pass
+        words, rows = [], []
+        dim = None
+        for ln, parts in lines:
+            word, vals = parts[0], parts[1:]
+            if dim is None:
+                dim = len(vals)
+                if dim == 0:
+                    raise ValueError(f"line {ln}: no vector components")
+            elif len(vals) != dim:
+                raise ValueError(f"line {ln}: expected {dim} components, "
+                                 f"got {len(vals)}")
+            words.append(word)
+            rows.append(np.array(vals, np.float32))
+        if not words:
+            raise ValueError(f"no vectors found in {path}")
+        return StaticWordVectors(words, np.stack(rows))
+
+    @staticmethod
+    def readWord2VecModel(path):
+        """Type-dispatching load (reference: readWord2VecModel): a
+        native npz (by extension, by the save()-appended '.npz', or by
+        zip magic bytes) restores the full trainable Word2Vec; anything
+        else is parsed as the text format."""
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+        p = str(path)
+        if p.endswith(".npz"):
+            return Word2Vec.load(p)
+        if not os.path.exists(p) and os.path.exists(p + ".npz"):
+            return Word2Vec.load(p)  # Word2Vec.save appended the suffix
+        if os.path.exists(p):
+            with open(p, "rb") as f:
+                if f.read(4) == b"PK\x03\x04":  # npz = zip container
+                    raise ValueError(
+                        f"{p} is an npz container without the .npz suffix "
+                        "(externally renamed?) — rename it to <name>.npz "
+                        "so the native loader can open it")
+        return WordVectorSerializer.loadTxtVectors(p)

@@ -22,6 +22,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from deeplearning4j_tpu.nlp.query import WordVectorQuery
 from deeplearning4j_tpu.nlp.tokenization import \
     apply_preprocessor as _apply_preprocessor
 
@@ -74,7 +75,7 @@ class LineSentenceIterator(CollectionSentenceIterator):
             super().__init__([l.strip() for l in fh if l.strip()])
 
 
-class Word2Vec:
+class Word2Vec(WordVectorQuery):
     """Builder-constructed SGNS model (reference: Word2Vec.Builder)."""
 
     class Builder:
@@ -453,30 +454,14 @@ class Word2Vec:
         self._score = float(loss)
         return self
 
-    # ---------------- query API ----------------------------------
+    # ---------------- query API (shared mixin) --------------------
     def _require_fit(self):
         if self._W is None:
             raise RuntimeError("call fit() first")
 
-    def hasWord(self, word):
-        return word in self.vocab
-
-    def getWordVector(self, word):
+    def _matrix(self):
         self._require_fit()
-        return np.asarray(self._W[self.vocab[word]])
-
-    def similarity(self, w1, w2):
-        a, b = self.getWordVector(w1), self.getWordVector(w2)
-        return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
-
-    def wordsNearest(self, word, n=10):
-        self._require_fit()
-        W = np.asarray(self._W)
-        v = W[self.vocab[word]]
-        sims = W @ v / (np.linalg.norm(W, axis=1) * np.linalg.norm(v) + 1e-12)
-        order = np.argsort(-sims)
-        out = [self._ivocab[i] for i in order if self._ivocab[i] != word]
-        return out[:n]
+        return np.asarray(self._W)
 
     # ---------------- serde --------------------------------------
     @staticmethod

@@ -168,3 +168,97 @@ class TestCnnSentenceIterator:
             ev.eval(np.asarray(ds.getLabels().jax()),
                     np.asarray(net.output(ds.getFeatures()).jax()))
         assert ev.accuracy() > 0.9, ev.accuracy()
+
+
+class TestWordVectorSerializer:
+    """Text-format interop (reference: WordVectorSerializer —
+    writeWordVectors / loadTxtVectors / readWord2VecModel)."""
+
+    def test_roundtrip_trained_model(self, tmp_path):
+        from deeplearning4j_tpu.nlp import (WordVectorSerializer,
+                                            StaticWordVectors)
+        sents, _ = _corpus(30)
+        wv = _w2v(sents)
+        p = tmp_path / "vecs.txt"
+        WordVectorSerializer.writeWordVectors(wv, p)
+        sv = WordVectorSerializer.loadTxtVectors(p)
+        assert isinstance(sv, StaticWordVectors)
+        assert set(sv.vocab) == set(wv.vocab)
+        for w in list(wv.vocab)[:5]:
+            np.testing.assert_allclose(sv.getWordVector(w),
+                                       wv.getWordVector(w),
+                                       rtol=1e-4, atol=1e-4)
+        # nearest-neighbor structure survives the 6-sig-digit text trip
+        w0 = list(wv.vocab)[0]
+        assert sv.wordsNearest(w0, 3) == wv.wordsNearest(w0, 3)
+
+    def test_headerless_glove_style(self, tmp_path):
+        from deeplearning4j_tpu.nlp import WordVectorSerializer
+        p = tmp_path / "glove.txt"
+        p.write_text("the 0.1 0.2 0.3\ncat -1 0.5 2\n")
+        sv = WordVectorSerializer.loadTxtVectors(p)
+        assert sv.hasWord("cat") and not sv.hasWord("dog")
+        np.testing.assert_allclose(sv.getWordVector("cat"), [-1, 0.5, 2])
+
+    def test_static_vectors_feed_cnn_sentence_iterator(self, tmp_path):
+        from deeplearning4j_tpu.nlp import WordVectorSerializer
+        sents, labels = _corpus(12)
+        wv = _w2v(sents)
+        p = tmp_path / "v.txt"
+        WordVectorSerializer.writeWordVectors(wv, p)
+        sv = WordVectorSerializer.loadTxtVectors(p)
+        it = CnnSentenceDataSetIterator(
+            provider=CollectionLabeledSentenceProvider(sents, labels),
+            wordVectors=sv, maxSentenceLength=6, minibatchSize=4)
+        assert np.asarray(it.next().getFeatures().jax()).shape == (4, 1, 6, 12)
+
+    def test_dispatch_and_errors(self, tmp_path):
+        from deeplearning4j_tpu.nlp import WordVectorSerializer, Word2Vec
+        sents, _ = _corpus(20)
+        wv = _w2v(sents)
+        npz = tmp_path / "m.npz"
+        wv.save(str(npz))
+        back = WordVectorSerializer.readWord2VecModel(str(npz))
+        assert isinstance(back, Word2Vec)
+        txt = tmp_path / "m.txt"
+        WordVectorSerializer.writeWordVectors(wv, txt)
+        assert WordVectorSerializer.readWord2VecModel(str(txt)).hasWord(
+            list(wv.vocab)[0])
+        bad = tmp_path / "bad.txt"
+        bad.write_text("a 1 2\nb 1\n")
+        with pytest.raises(ValueError, match="components"):
+            WordVectorSerializer.loadTxtVectors(bad)
+        with pytest.raises(ValueError, match="no vectors"):
+            empty = tmp_path / "e.txt"
+            empty.write_text("")
+            WordVectorSerializer.loadTxtVectors(empty)
+
+    def test_whitespace_robust_parsing(self, tmp_path):
+        from deeplearning4j_tpu.nlp import WordVectorSerializer
+        p = tmp_path / "messy.txt"
+        p.write_text("the 0.1  0.2\t0.3 \n   \ncat\t-1 0.5 2  \n")
+        sv = WordVectorSerializer.loadTxtVectors(p)
+        assert set(sv.vocab) == {"the", "cat"}
+        np.testing.assert_allclose(sv.getWordVector("cat"), [-1, 0.5, 2])
+
+    def test_numeric_vocab_1d_not_eaten_as_header(self, tmp_path):
+        from deeplearning4j_tpu.nlp import WordVectorSerializer
+        p = tmp_path / "years.txt"
+        p.write_text("1984 3\n1985 4\n1986 5\n")  # 3 != body count of 2
+        sv = WordVectorSerializer.loadTxtVectors(p)
+        assert sv.hasWord("1984") and len(sv.vocab) == 3
+
+    def test_suffixless_native_load(self, tmp_path):
+        from deeplearning4j_tpu.nlp import WordVectorSerializer, Word2Vec
+        sents, _ = _corpus(20)
+        wv = _w2v(sents)
+        wv.save(str(tmp_path / "model"))  # writes model.npz
+        back = WordVectorSerializer.readWord2VecModel(str(tmp_path / "model"))
+        assert isinstance(back, Word2Vec)
+
+    def test_get_word_vector_is_a_copy(self, tmp_path):
+        from deeplearning4j_tpu.nlp import StaticWordVectors
+        sv = StaticWordVectors(["a", "b"], np.eye(2, dtype="float32"))
+        v = sv.getWordVector("a")
+        v *= 100.0  # in-place caller mutation must not corrupt the table
+        np.testing.assert_allclose(sv.getWordVector("a"), [1.0, 0.0])
