@@ -428,9 +428,11 @@ def _maxpool(x, kernel=(2, 2), stride=(2, 2), padding=((0, 0), (0, 0))):
 
 
 @op("avgPooling2d")
-def _avgpool(x, kernel=(2, 2), stride=(2, 2), padding=((0, 0), (0, 0))):
+def _avgpool(x, kernel=(2, 2), stride=(2, 2), padding=((0, 0), (0, 0)),
+             count_include_pad=True):
     return _pool.avg_pool2d(x, tuple(kernel), tuple(stride),
-                            tuple(tuple(p) for p in padding))
+                            tuple(tuple(p) for p in padding),
+                            count_include_pad=count_include_pad)
 
 
 @op("upsampling2d")

@@ -1374,11 +1374,12 @@ class _CNNOps(_NS):
                          "padding": [list(p) for p in padding]}, name=name)
 
     def avgPooling2d(self, x, kernel, stride=None, padding=((0, 0), (0, 0)),
-                     name=None):
+                     count_include_pad=True, name=None):
         return self._mk("avgPooling2d", [x],
                         {"kernel": list(kernel),
                          "stride": list(stride or kernel),
-                         "padding": [list(p) for p in padding]}, name=name)
+                         "padding": [list(p) for p in padding],
+                         "count_include_pad": count_include_pad}, name=name)
 
     def upsampling2d(self, x, size=(2, 2), name=None):
         return self._mk("upsampling2d", [x], {"size": list(size)}, name=name)

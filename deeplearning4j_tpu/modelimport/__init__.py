@@ -1,8 +1,9 @@
-"""Model import: Keras configs/weights and TF frozen GraphDefs.
+"""Model import: Keras configs/weights, TF frozen GraphDefs, ONNX models.
 
 Reference: deeplearning4j-modelimport —
 org.deeplearning4j.nn.modelimport.keras.KerasModelImport — and nd4j-api
-org.nd4j.imports.graphmapper.tf.TFGraphMapper.
+org.nd4j.imports.graphmapper.tf.TFGraphMapper /
+org.nd4j.imports.graphmapper.onnx.OnnxGraphMapper.
 """
 
 from deeplearning4j_tpu.modelimport.keras import (
@@ -15,6 +16,11 @@ from deeplearning4j_tpu.modelimport.tensorflow import (
     TFImportException,
     importFrozenTF,
 )
+from deeplearning4j_tpu.modelimport.onnx import (
+    OnnxGraphMapper,
+    ONNXImportException,
+    importOnnx,
+)
 
 __all__ = [
     "KerasModelImport",
@@ -23,4 +29,7 @@ __all__ = [
     "TFGraphMapper",
     "TFImportException",
     "importFrozenTF",
+    "OnnxGraphMapper",
+    "ONNXImportException",
+    "importOnnx",
 ]

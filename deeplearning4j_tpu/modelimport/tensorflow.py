@@ -115,14 +115,19 @@ def _require_nhwc(node):
             "NHWC graphs are supported (TF's CPU freezing default)")
 
 
-def _same_pads(in_h, in_w, k, s, d=(1, 1)):
-    """TF SAME padding -> explicit ((lo,hi),(lo,hi)) for static shapes."""
+def _same_pads(in_h, in_w, k, s, d=(1, 1), lower=False):
+    """SAME padding -> explicit ((lo,hi),(lo,hi)) for static shapes.
+
+    `lower=False` puts the odd pad at the end (TF SAME / ONNX
+    SAME_UPPER); `lower=True` at the start (ONNX SAME_LOWER). Shared
+    with modelimport/onnx.py — one copy of the geometry math."""
     pads = []
     for size, kk, ss, dd in ((in_h, k[0], s[0], d[0]), (in_w, k[1], s[1], d[1])):
         eff = (kk - 1) * dd + 1
         out = -(-size // ss)
         tot = max((out - 1) * ss + eff - size, 0)
-        pads.append((tot // 2, tot - tot // 2))
+        lo = tot - tot // 2 if lower else tot // 2
+        pads.append((lo, tot - lo))
     return tuple(pads)
 
 
@@ -282,9 +287,14 @@ class TFGraphMapper:
                 k = _hw(_require_attr(node, "ksize"))
                 s = _hw(_require_attr(node, "strides"))
                 pad = _conv_padding(node, shape_of(x), k, s)
+                kw = {"kernel": k, "stride": s, "padding": pad}
+                if op == "AvgPool":
+                    # TF's AvgPool divides border windows by the VALID
+                    # cell count (excludes SAME/EXPLICIT padding)
+                    kw["count_include_pad"] = False
                 vars_[node.name] = emit(
                     "maxPooling2d" if op == "MaxPool" else "avgPooling2d",
-                    [x], {"kernel": k, "stride": s, "padding": pad})
+                    [x], kw)
                 continue
             if op == "MatMul":
                 ta = _attr(node, "transpose_a")

@@ -17,7 +17,16 @@ class WordVectorQuery:
     override _matrix() to gate access (e.g. require fit())."""
 
     def _matrix(self):
-        return np.asarray(self._W)
+        # self._W is a DEVICE array on trained models — np.asarray per
+        # lookup would pull the whole [V, D] table through the tunnel on
+        # every getWordVector call. Cache the host copy, keyed on the
+        # table's identity so a re-fit (which rebinds _W) invalidates it.
+        W = self._W
+        cached = getattr(self, "_W_host_cache", None)
+        if cached is None or cached[0] is not W:
+            cached = (W, np.asarray(W))
+            self._W_host_cache = cached
+        return cached[1]
 
     def hasWord(self, word):
         return word in self.vocab

@@ -24,7 +24,19 @@ class StaticWordVectors(WordVectorQuery):
     plugs into CnnSentenceDataSetIterator and friends."""
 
     def __init__(self, words, matrix):
-        self._ivocab = list(words)
+        if isinstance(words, dict):
+            # honor a {word: row index} mapping (the shape of
+            # Word2Vec.vocab) — iterating the dict and renumbering
+            # would silently rebind every vector to the wrong row
+            if sorted(words.values()) != list(range(len(words))):
+                raise ValueError(
+                    "vocab dict values must be exactly the row indices "
+                    f"0..{len(words) - 1}")
+            self._ivocab = [None] * len(words)
+            for w, i in words.items():
+                self._ivocab[i] = w
+        else:
+            self._ivocab = list(words)
         self.vocab = {w: i for i, w in enumerate(self._ivocab)}
         if len(self.vocab) != len(self._ivocab):
             raise ValueError("duplicate words in vector table")
@@ -45,15 +57,19 @@ class WordVectorSerializer:
                  else sorted(vectors.vocab))
         if not words:
             raise ValueError("no words to write")
+        # validate the whole vocab BEFORE opening the file: failing
+        # mid-loop would leave a truncated file whose header row count
+        # lies about the body
+        bad = [w for w in words if any(c.isspace() for c in w)]
+        if bad:
+            raise ValueError(
+                f"words {bad[:5]!r} contain whitespace — unrepresentable "
+                "in the text format")
         first = np.asarray(vectors.getWordVector(words[0]))
         with open(str(path), "w", encoding="utf-8") as f:
             if writeHeader:
                 f.write(f"{len(words)} {first.shape[0]}\n")
             for w in words:
-                if any(c.isspace() for c in w):
-                    raise ValueError(
-                        f"word {w!r} contains whitespace — unrepresentable "
-                        "in the text format")
                 vec = np.asarray(vectors.getWordVector(w), np.float32)
                 f.write(w + " " + " ".join(f"{x:.6g}" for x in vec) + "\n")
 

@@ -461,7 +461,9 @@ class Word2Vec(WordVectorQuery):
 
     def _matrix(self):
         self._require_fit()
-        return np.asarray(self._W)
+        # delegate to the mixin: it caches the host copy of the DEVICE
+        # table (full-table transfer per lookup otherwise)
+        return super()._matrix()
 
     # ---------------- serde --------------------------------------
     @staticmethod

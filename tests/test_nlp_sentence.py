@@ -233,6 +233,51 @@ class TestWordVectorSerializer:
             empty.write_text("")
             WordVectorSerializer.loadTxtVectors(empty)
 
+    def test_whitespace_word_rejected_before_any_write(self, tmp_path):
+        # validation must happen BEFORE the file is opened: a mid-loop
+        # failure would leave a truncated file whose header lies
+        from deeplearning4j_tpu.nlp import (WordVectorSerializer,
+                                            StaticWordVectors)
+        W = np.eye(3, dtype=np.float32)
+        sv = StaticWordVectors(
+            {"ok": 0, "new york": 1, "zz": 2}, W)
+        p = tmp_path / "bad_vocab.txt"
+        with pytest.raises(ValueError, match="whitespace"):
+            WordVectorSerializer.writeWordVectors(sv, p)
+        assert not p.exists()
+
+    def test_host_matrix_cached_across_lookups(self):
+        # getWordVector must not re-materialize the [V, D] table per
+        # call (device tables pay a full transfer each time); the cache
+        # invalidates when _W is rebound (re-fit)
+        from deeplearning4j_tpu.nlp import StaticWordVectors
+        sv = StaticWordVectors({"a": 0, "b": 1},
+                               np.eye(2, dtype=np.float32))
+        m1 = sv._matrix()
+        assert sv._matrix() is m1
+        sv._W = np.ones((2, 2), np.float32)  # rebind -> invalidate
+        m2 = sv._matrix()
+        assert m2 is not m1 and m2[0, 0] == 1.0
+
+    def test_static_vectors_honor_dict_indices(self):
+        # {word: row} dicts (the shape of Word2Vec.vocab) must bind by
+        # the GIVEN indices, not dict iteration order
+        from deeplearning4j_tpu.nlp import StaticWordVectors
+        W = np.asarray([[1., 0.], [0., 1.]], np.float32)
+        sv = StaticWordVectors({"b": 1, "a": 0}, W)  # insertion != index
+        np.testing.assert_array_equal(sv.getWordVector("a"), W[0])
+        np.testing.assert_array_equal(sv.getWordVector("b"), W[1])
+        with pytest.raises(ValueError, match="row indices"):
+            StaticWordVectors({"a": 0, "b": 2}, W)
+
+    def test_host_matrix_cached_on_trained_model(self):
+        # Word2Vec._matrix overrides the mixin (fit gate) — it must
+        # still delegate to the caching path, or every per-token
+        # getWordVector pays a full [V, D] device transfer
+        sents, _ = _corpus(12)
+        wv = _w2v(sents)
+        assert wv._matrix() is wv._matrix()
+
     def test_whitespace_robust_parsing(self, tmp_path):
         from deeplearning4j_tpu.nlp import WordVectorSerializer
         p = tmp_path / "messy.txt"

@@ -130,6 +130,24 @@ class TestCNNImport:
         ours = np.asarray(out.eval({_placeholder_name(gd): x}).jax())
         np.testing.assert_allclose(ours, golden, atol=1e-5, rtol=1e-4)
 
+    def test_same_padded_avgpool_excludes_padding(self):
+        # TF divides border windows by the VALID cell count; an
+        # include-pad average would be ~0.44-0.67x at the borders
+
+        @tf.function
+        def f(x):
+            return tf.nn.avg_pool2d(x, ksize=3, strides=2, padding="SAME")
+
+        gd = f.get_concrete_function(
+            tf.TensorSpec((1, 6, 6, 2), tf.float32)).graph.as_graph_def()
+        x = np.ones((1, 6, 6, 2), np.float32)
+        golden = np.asarray(f(tf.constant(x)))
+        assert golden.max() == golden.min() == 1.0  # exclude-pad on ones
+        sd = importFrozenTF(gd.SerializeToString())
+        out = TFGraphMapper.outputVariable(sd, _last_name(gd))
+        ours = np.asarray(out.eval({_placeholder_name(gd): x}).jax())
+        np.testing.assert_allclose(ours, golden, atol=1e-6)
+
     def test_depthwise_and_relu6_parity(self):
         tf.keras.utils.set_random_seed(6)
         model = tf.keras.Sequential([
