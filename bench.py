@@ -91,15 +91,42 @@ def bench_resnet50():
             s2d["stem_standard"] = {k: rec[k] for k in
                                     ("images_per_sec", "step_ms", "mfu")}
             s2d["stem"] = "space_to_depth"
-            return s2d
-        rec["stem_space_to_depth"] = {k: s2d[k] for k in
-                                      ("images_per_sec", "step_ms", "mfu")}
+            rec = s2d
+        else:
+            rec["stem_space_to_depth"] = {k: s2d[k] for k in
+                                          ("images_per_sec", "step_ms",
+                                           "mfu")}
     except Exception as e:
         rec["stem_space_to_depth"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    print("\nBENCHREC-PARTIAL " + json.dumps(rec), flush=True)
+    # Third A/B: checkpointPolicy="save_conv_outputs" (named-residual
+    # remat — recompute BN/relu/add tails in the backward instead of
+    # storing them; trades recompute FLOPs for HBM traffic, the round-4
+    # BENCH_NOTES lever). Same self-protection as the maxpool A/B: the
+    # headline flips only if the remat leg measures faster here.
+    if os.environ.get("DL4J_TPU_REMAT", "") != "off":
+        try:
+            rm = _measure_resnet50(rec["stem"], remat=True)
+            sub = {k: rm[k] for k in ("images_per_sec", "step_ms", "mfu",
+                                      "hbm_bytes_per_step")}
+            if rm["images_per_sec"] > rec["images_per_sec"]:
+                rm["remat_off"] = {k: rec[k] for k in
+                                   ("images_per_sec", "step_ms", "mfu",
+                                    "hbm_bytes_per_step")}
+                for carry in ("maxpool_backward_ab", "stem",
+                              "stem_space_to_depth", "stem_standard"):
+                    if carry in rec:
+                        rm[carry] = rec[carry]
+                rm["headline_uses_remat"] = True
+                return rm
+            rec["remat_ab"] = sub
+            rec["headline_uses_remat"] = False
+        except Exception as e:
+            rec["remat_ab"] = {"error": f"{type(e).__name__}: {e}"[:200]}
     return rec
 
 
-def _measure_resnet50(stem):
+def _measure_resnet50(stem, remat=False):
     import jax
     import jax.numpy as jnp
 
@@ -111,7 +138,9 @@ def _measure_resnet50(stem):
     B = 128
     net = ResNet50(numClasses=1000, inputShape=(3, 224, 224),
                    updater=Nesterovs(0.1, 0.9), stemMode=stem,
-                   dataType=DataType.BFLOAT16, dataFormat="NHWC").init()
+                   dataType=DataType.BFLOAT16, dataFormat="NHWC",
+                   checkpointPolicy="save_conv_outputs" if remat
+                   else None).init()
     rng = np.random.RandomState(0)
     # NHWC bf16 from the host: binds directly to the internal conv layout —
     # no 77 MB NCHW fp32 input param, no entry transpose+cast HLOs

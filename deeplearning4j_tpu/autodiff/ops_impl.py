@@ -52,6 +52,16 @@ for _n, _f in {
     "asinh": jnp.arcsinh, "acosh": jnp.arccosh, "atanh": jnp.arctanh,
     "erf": jax.scipy.special.erf, "erfc": jax.scipy.special.erfc,
     "atan2": jnp.arctan2,
+    # special functions (reference: nd4j impl.transforms.custom Lgamma/
+    # Digamma/Igamma/Igammac/Polygamma/Zeta/BetaInc ops)
+    "lgamma": jax.scipy.special.gammaln,
+    "digamma": jax.scipy.special.digamma,
+    "igamma": jax.scipy.special.gammainc,    # regularized lower P(a, x)
+    "igammac": jax.scipy.special.gammaincc,  # regularized upper Q(a, x)
+    "betainc": jax.scipy.special.betainc,
+    "polygamma": lambda n, x: jax.scipy.special.polygamma(
+        n.astype(jnp.int32) if hasattr(n, "astype") else n, x),
+    "zeta": jax.scipy.special.zeta,
     "isnan": jnp.isnan, "isinf": jnp.isinf, "isfinite": jnp.isfinite,
 }.items():
     _reg(_n, _f)

@@ -1031,10 +1031,12 @@ class _MathOps(_NS):
 
     for _n in ("neg abs sign exp expm1 log log1p log2 sqrt square floor ceil "
                "round sin cos tan asin acos atan sinh cosh tanh asinh acosh "
-               "atanh erf erfc reciprocal rsqrt isnan isinf isfinite").split():
+               "atanh erf erfc reciprocal rsqrt isnan isinf isfinite "
+               "lgamma digamma").split():
         locals()[_n] = _unary(_n)
     for _n in ("add sub mul div pow atan2 squaredDifference maximum minimum "
-               "floordiv mod eq neq gt gte lt lte and or xor").split():
+               "floordiv mod eq neq gt gte lt lte and or xor "
+               "igamma igammac polygamma zeta").split():
         locals()[_n] = _binary(_n)
     for _n in "sum mean prod max min std variance norm1 norm2 normmax".split():
         locals()[_n] = _reduction(_n)
@@ -1042,6 +1044,10 @@ class _MathOps(_NS):
 
     def logicalNot(self, x, name=None):
         return self._mk("not", [x], name=name)
+
+    def betainc(self, a, b, x, name=None):
+        """Regularized incomplete beta I_x(a, b) (reference: SDMath)."""
+        return self._mk("betainc", [a, b, x], name=name)
 
     # -- reduce3-style distance ops (reference: SDMath distance family) --
     def _dist(self, opName, x, y, dimensions, name):

@@ -210,6 +210,16 @@ class OnnxGraphMapper:
         def rank_of(tname):
             return len(shape_of(tname))
 
+        def dtype_of(tname):
+            m = meta.get(vars_[tname].name) if tname in vars_ else None
+            return np.dtype(m.dtype) if m is not None else np.dtype(np.float32)
+
+        def scalar(tname, ref, value):
+            """Bind a helper scalar in `ref`'s dtype — a float32 literal
+            would silently promote fp16/bf16 graphs under jax rules
+            (ONNX: a node's output dtype equals its input's)."""
+            return bind(tname, np.asarray(value, dtype_of(ref)))
+
         for init in graph.initializer:
             bind(init.name, tensor_to_ndarray(init))
 
@@ -337,9 +347,9 @@ class OnnxGraphMapper:
                 if alpha == 1.0:
                     vars_[out] = emit("elu", [x])
                 else:
-                    zero = bind(f"__{out}_zero", np.float32(0.0))
-                    a = bind(f"__{out}_alpha", np.float32(alpha))
-                    one = bind(f"__{out}_one", np.float32(1.0))
+                    zero = scalar(f"__{out}_zero", ins[0], 0.0)
+                    a = scalar(f"__{out}_alpha", ins[0], alpha)
+                    one = scalar(f"__{out}_one", ins[0], 1.0)
                     em1 = emit("sub", [emit("exp", [x]), one])
                     vars_[out] = emit(
                         "where", [emit("gt", [x, zero]), x,
@@ -355,8 +365,8 @@ class OnnxGraphMapper:
                 alpha = _attr_f(attrs, "alpha", 0.2)
                 beta = _attr_f(attrs, "beta", 0.5)
                 x = get(ins[0])
-                a = bind(f"__{out}_a", np.float32(alpha))
-                b = bind(f"__{out}_b", np.float32(beta))
+                a = scalar(f"__{out}_a", ins[0], alpha)
+                b = scalar(f"__{out}_b", ins[0], beta)
                 y = emit("add", [emit("mul", [x, a]), b])
                 vars_[out] = emit("clipByValue", [y], {"clipValueMin": 0.0,
                                                        "clipValueMax": 1.0})
@@ -364,7 +374,7 @@ class OnnxGraphMapper:
 
             if op == "PRelu":
                 x, slope = get(ins[0]), get(ins[1])
-                zero = bind(f"__{out}_zero", np.float32(0.0))
+                zero = scalar(f"__{out}_zero", ins[0], 0.0)
                 vars_[out] = emit(
                     "where", [emit("gt", [x, zero]), x,
                               emit("mul", [x, slope])])
@@ -388,10 +398,10 @@ class OnnxGraphMapper:
                                        "clipValueMax": hi})
                 elif lo is not None:
                     vars_[out] = emit(
-                        "maximum", [x, bind(f"__{out}_lo", np.float32(lo))])
+                        "maximum", [x, scalar(f"__{out}_lo", ins[0], lo)])
                 elif hi is not None:
                     vars_[out] = emit(
-                        "minimum", [x, bind(f"__{out}_hi", np.float32(hi))])
+                        "minimum", [x, scalar(f"__{out}_hi", ins[0], hi)])
                 else:
                     vars_[out] = emit("identity", [x])
                 continue
@@ -403,13 +413,13 @@ class OnnxGraphMapper:
                          {"transposeA": bool(_attr_i(attrs, "transA", 0)),
                           "transposeB": bool(_attr_i(attrs, "transB", 0))})
                 if alpha != 1.0:
-                    y = emit("mul", [y, bind(f"__{out}_alpha",
-                                             np.float32(alpha))])
+                    y = emit("mul", [y, scalar(f"__{out}_alpha",
+                                               ins[0], alpha)])
                 if len(ins) > 2 and ins[2]:
                     c = get(ins[2])
                     if beta != 1.0:
-                        c = emit("mul", [c, bind(f"__{out}_beta",
-                                                 np.float32(beta))])
+                        c = emit("mul", [c, scalar(f"__{out}_beta",
+                                                   ins[2], beta)])
                     y = emit("add", [y, c])
                 vars_[out] = y
                 continue
@@ -679,8 +689,8 @@ class OnnxGraphMapper:
                 else:
                     # jnp.mod wraps negatives Python-style, exactly the
                     # spec's semantics for in-range indices
-                    ids = emit("mod", [ids, bind(f"__{out}_dim",
-                                                 np.int64(dim))])
+                    ids = emit("mod", [ids, scalar(f"__{out}_dim",
+                                                   ins[1], dim)])
                 vars_[out] = emit("gather", [get(ins[0]), ids],
                                   {"axis": axis})
                 continue

@@ -248,6 +248,26 @@ class NeuralNetConfiguration:
             self._d["updater"] = _upd.resolve(u) if not isinstance(u, _upd.IUpdater) else u
             return self
 
+        def checkpointPolicy(self, policy):
+            """Named rematerialization policy for the whole train step
+            (jax.checkpoint with save_only_these_names). Currently:
+
+            - "save_conv_outputs": save ONLY conv/dense (MXU) outputs as
+              backward residuals; recompute the elementwise tails
+              (BN/activation/add) from them during the backward pass.
+              On bandwidth-bound steps this trades cheap recompute FLOPs
+              for the write+read of every elementwise intermediate —
+              the remaining HBM lever named in BENCH_NOTES.md round 4.
+            - None: store whatever autodiff needs (default).
+
+            Differs from activationCheckpointing (per-layer remat, a
+            capacity lever): this is a BANDWIDTH lever with a policy
+            boundary around the whole loss. ComputationGraph only."""
+            if policy not in (None, "save_conv_outputs"):
+                raise ValueError(f"unknown checkpointPolicy {policy!r}")
+            self._d["checkpointPolicy"] = policy
+            return self
+
         def activationCheckpointing(self, flag=True):
             """Rematerialize layer activations in the backward pass
             (jax.checkpoint): activations are recomputed instead of

@@ -379,6 +379,7 @@ class ComputationGraphConfiguration:
         self.gradientNormalizationThreshold = defaults.get("gradientNormalizationThreshold", 1.0)
         self.activationCheckpointing = defaults.get(
             "activationCheckpointing", False)
+        self.checkpointPolicy = defaults.get("checkpointPolicy")
         self.topoOrder = self._topo_sort()
         self._infer_shapes()
 

@@ -194,6 +194,16 @@ class ComputationGraph:
                     p, states[name], h, lk, fmask)
             else:
                 h, s = layer.forward(p, states[name], h, l_train, lk, fmask)
+            if getattr(self.conf, "checkpointPolicy", None) == \
+                    "save_conv_outputs" and isinstance(
+                        layer, (L.ConvolutionLayer, L.DenseLayer)):
+                # name MXU outputs as the ONLY residuals the train step's
+                # jax.checkpoint policy saves (_ckpt_loss_fn); everything
+                # else (BN, activations, adds, pools) is recomputed from
+                # them in the backward — outside that wrapper the name
+                # primitive is an identity
+                from jax.ad_checkpoint import checkpoint_name
+                h = checkpoint_name(h, "dl4j_mxu_out")
             acts[name] = h
             masks[name] = out_mask
             new_states[name] = s
@@ -257,8 +267,8 @@ class ComputationGraph:
         distributed wrappers (parallel.trainer) splice in cross-shard
         allreduce/pmean without duplicating the updater loop."""
         (loss, new_states), grads = jax.value_and_grad(
-            self._loss_fn, has_aux=True)(params, states, inputs, labels, key,
-                                         fmasks, lmasks, use_carries)
+            self._ckpt_loss_fn(use_carries), has_aux=True)(
+            params, states, inputs, labels, key, fmasks, lmasks)
         if grad_transform is not None:
             grads = grad_transform(grads)
         if loss_transform is not None:
@@ -284,6 +294,26 @@ class ComputationGraph:
             new_params[name] = np_n
             new_upd[name] = us
         return new_params, new_upd, new_states, loss
+
+    def _ckpt_loss_fn(self, use_carries):
+        """_loss_fn, under the conf's named-residual remat policy when
+        one is set. With checkpointPolicy="save_conv_outputs" the whole
+        loss is a jax.checkpoint region whose policy saves ONLY tensors
+        tagged "dl4j_mxu_out" in _run_graph (conv/dense outputs, plus
+        the region's own inputs, which are free); BN/activation/add/pool
+        intermediates are recomputed during the backward. On
+        bandwidth-bound steps that removes the write+read of every
+        elementwise intermediate at the cost of re-reading the saved
+        conv outputs — the BENCH_NOTES.md round-4 HBM lever."""
+        def base(p, s, i, l, k, fm, lm):
+            return self._loss_fn(p, s, i, l, k, fm, lm, use_carries)
+
+        if getattr(self.conf, "checkpointPolicy", None) != \
+                "save_conv_outputs":
+            return base
+        policy = jax.checkpoint_policies.save_only_these_names(
+            "dl4j_mxu_out")
+        return jax.checkpoint(base, policy=policy)
 
     def _forward_infer(self, params, states, inputs):
         acts, _, _ = self._run_graph(params, self._strip_carries(states),

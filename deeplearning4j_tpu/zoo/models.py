@@ -25,12 +25,16 @@ from deeplearning4j_tpu.nn.conf.layers import (CnnLossLayer, LossLayer,
 class ZooModel:
     def __init__(self, numClasses=1000, seed=123, inputShape=None, updater=None,
                  cacheMode=None, workspaceMode=None, dataType=None,
-                 dataFormat="NCHW"):
+                 dataFormat="NCHW", checkpointPolicy=None):
         self.numClasses = numClasses
         self.seed = seed
         self.inputShape = inputShape or self.defaultInputShape()
         self.updater = updater
         self.dataType = dataType or DataType.FLOAT
+        # named remat policy for the train step (see
+        # Builder.checkpointPolicy); graph-built zoo models thread it
+        # through their conf builders
+        self.checkpointPolicy = checkpointPolicy
         # Feed layout (reference: CNN2DFormat). inputShape stays the logical
         # (C, H, W) triple either way; dataFormat="NHWC" means fit/output
         # receive [B,H,W,C] arrays and the entry transpose disappears —
@@ -49,6 +53,18 @@ class ZooModel:
         conf = self.conf()
         from deeplearning4j_tpu.nn.conf.graph import ComputationGraphConfiguration
 
+        if self.checkpointPolicy is not None:
+            # applied here, not in each model's conf(), so EVERY
+            # graph-built zoo model honors the option (a silently
+            # ignored policy would claim the HBM lever is on)
+            if self.checkpointPolicy != "save_conv_outputs":
+                raise ValueError(
+                    f"unknown checkpointPolicy {self.checkpointPolicy!r}")
+            if not isinstance(conf, ComputationGraphConfiguration):
+                raise ValueError(
+                    f"{type(self).__name__} builds a MultiLayerNetwork; "
+                    "checkpointPolicy is a ComputationGraph feature")
+            conf.checkpointPolicy = self.checkpointPolicy
         net = ComputationGraph(conf) if isinstance(conf, ComputationGraphConfiguration) \
             else MultiLayerNetwork(conf)
         return net.init()
@@ -269,6 +285,7 @@ class ResNet50(ZooModel):
              .updater(self.updater or Nesterovs(1e-1, 0.9))
              .weightInit(WeightInit.RELU)
              .dataType(self.dataType)
+             .checkpointPolicy(self.checkpointPolicy)
              .graphBuilder()
              .addInputs("input"))
         if self.stemMode == "space_to_depth":

@@ -636,6 +636,28 @@ class TestErrorsAndTraining:
             np.asarray(grads["x"].jax()), xt.grad.numpy(),
             atol=1e-5, rtol=1e-4)
 
+    def test_fp16_graph_stays_fp16(self):
+        # helper constants (Gemm alpha, HardSigmoid, one-sided Clip)
+        # must bind in the graph's dtype — float32 literals would
+        # silently promote the whole downstream graph under jax rules
+        rs = np.random.RandomState(30)
+        w = rs.randn(4, 4).astype(np.float16)
+        nodes = [
+            wire.make_node("Gemm", ["x", "w"], ["g"], alpha=0.5, transB=1),
+            wire.make_node("HardSigmoid", ["g"], ["h"],
+                           alpha=0.2, beta=0.5),
+            wire.make_node("Clip", ["h", "lo"], ["y"]),
+        ]
+        graph = wire.make_graph(
+            nodes, "fp16",
+            inputs=[wire.make_value_info("x", np.float16, (2, 4))],
+            outputs=[wire.make_value_info("y", np.float16, (2, 4))],
+            initializers=[wire.make_tensor("w", w),
+                          wire.make_tensor("lo", np.float16(0.1))])
+        _, ours = _import_and_run(wire.make_model(graph),
+                                  {"x": rs.randn(2, 4).astype(np.float16)})
+        assert ours.dtype == np.float16, ours.dtype
+
     def test_model_file_roundtrip(self, tmp_path):
         torch.manual_seed(23)
         lin = torch.nn.Linear(4, 2)

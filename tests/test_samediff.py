@@ -686,6 +686,39 @@ class TestMathLongTail:
                                     1).eval().toNumpy(),
             (ai != bi).sum(1))
 
+    def test_special_functions_vs_scipy(self):
+        # reference: nd4j Lgamma/Digamma/Igamma/Igammac/BetaInc/
+        # Polygamma/Zeta custom ops — scipy is the oracle
+        import scipy.special as sp
+
+        rs = np.random.RandomState(1)
+        a = rs.uniform(0.5, 5.0, (3, 4))
+        b = rs.uniform(0.5, 5.0, (3, 4))
+        x01 = rs.uniform(0.05, 0.95, (3, 4))
+        sd = SameDiff.create()
+        av, bv, xv = sd.constant(a), sd.constant(b), sd.constant(x01)
+        np.testing.assert_allclose(sd.math.lgamma(av).eval().toNumpy(),
+                                   sp.gammaln(a), rtol=1e-5)
+        np.testing.assert_allclose(sd.math.digamma(av).eval().toNumpy(),
+                                   sp.digamma(a), rtol=1e-5)
+        np.testing.assert_allclose(sd.math.igamma(av, bv).eval().toNumpy(),
+                                   sp.gammainc(a, b), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(sd.math.igammac(av, bv).eval().toNumpy(),
+                                   sp.gammaincc(a, b), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            sd.math.betainc(av, bv, xv).eval().toNumpy(),
+            sp.betainc(a, b, x01), rtol=1e-5, atol=1e-6)
+        n = np.full((2, 3), 2.0)
+        xz = rs.uniform(1.5, 4.0, (2, 3))
+        np.testing.assert_allclose(
+            sd.math.polygamma(sd.constant(n), sd.constant(xz))
+            .eval().toNumpy(), sp.polygamma(2, xz), rtol=1e-4, atol=1e-6)
+        q = rs.uniform(1.0, 3.0, (2, 3))
+        s = rs.uniform(2.0, 5.0, (2, 3))
+        np.testing.assert_allclose(
+            sd.math.zeta(sd.constant(s), sd.constant(q)).eval().toNumpy(),
+            sp.zeta(s, q), rtol=1e-4, atol=1e-6)
+
     def test_segment_reductions(self):
         data = np.array([3.0, 1.0, 4.0, 1.0, 5.0, 9.0])
         ids = np.array([0, 0, 1, 1, 1, 2])
