@@ -58,14 +58,31 @@ class CSVRecordReader(RecordReader):
         self._skip = skipNumLines
         self._delim = delimiter
         self._lines = None
+        self._path = None
         self._i = 0
 
     def initialize(self, path):
         text = Path(path).read_text()
         lines = [ln for ln in text.splitlines() if ln.strip()]
         self._lines = lines[self._skip:]
+        self._path = str(path)
         self._i = 0
         return self
+
+    def asMatrix(self):
+        """Whole file as a float32 [rows, cols] matrix via the native
+        bulk parser (runtime/textparse.cpp — one buffer sweep instead of
+        a per-token Python loop), or None when the content is not a
+        clean numeric rectangle / no compiler is available. Callers
+        (RecordReaderDataSetIterator) fall back to next()-loop
+        semantics on None, so mixed-type CSVs behave exactly as before.
+        Reads the file lazily (the raw text is not kept resident)."""
+        if self._path is None:
+            return None
+        from deeplearning4j_tpu.runtime.textparse import parse_csv_f32
+
+        with open(self._path, "rb") as f:
+            return parse_csv_f32(f.read(), self._delim, self._skip)
 
     @staticmethod
     def _parse(tok: str):
@@ -482,23 +499,35 @@ class RecordReaderDataSetIterator:
         # readers whose records are [ndarray, labelIndex] (images, audio)
         # rather than flat value lists mark themselves arrayRecords
         image_mode = getattr(recordReader, "arrayRecords", False)
-        while recordReader.hasNext():
-            rec = recordReader.next()
-            if image_mode:
-                feats.append(rec[0])
-                labels.append(rec[1])
-            else:
-                li = labelIndex if labelIndex >= 0 else len(rec) - 1
-                labels.append(rec[li])
-                feats.append([float(v) for j, v in enumerate(rec) if j != li])
-        try:
-            f = np.asarray(feats, np.float32)
-        except ValueError as e:
-            shapes = sorted({np.shape(x) for x in feats})
-            raise ValueError(
-                f"records have inconsistent shapes {shapes[:4]}; batching "
-                "needs fixed-size records (WavFileRecordReader: pass "
-                "length=N to pad/truncate)") from e
+        # bulk fast path: a reader that can hand over the whole file as
+        # one numeric matrix (native textparse sweep) skips the
+        # per-record Python loop; None falls through to it
+        m = None if image_mode else getattr(recordReader, "asMatrix",
+                                            lambda: None)()
+        if m is not None and m.ndim == 2 and m.shape[1] >= 1:
+            li = labelIndex if labelIndex >= 0 else m.shape[1] - 1
+            f = np.delete(m, li, axis=1)
+            labels = m[:, li].tolist()
+        else:
+            while recordReader.hasNext():
+                rec = recordReader.next()
+                if image_mode:
+                    feats.append(rec[0])
+                    labels.append(rec[1])
+                else:
+                    li = labelIndex if labelIndex >= 0 else len(rec) - 1
+                    labels.append(rec[li])
+                    feats.append([float(v) for j, v in enumerate(rec)
+                                  if j != li])
+            try:
+                f = np.asarray(feats, np.float32)
+            except ValueError as e:
+                shapes = sorted({np.shape(x) for x in feats})
+                raise ValueError(
+                    f"records have inconsistent shapes {shapes[:4]}; "
+                    "batching needs fixed-size records "
+                    "(WavFileRecordReader: pass length=N to pad/truncate)"
+                ) from e
         if regression:
             l = np.asarray(labels, np.float32).reshape(len(labels), -1)
         else:

@@ -1,0 +1,51 @@
+"""Shared build-on-first-use loader for the native runtime pieces.
+
+One copy of the compile/cache/load logic serving runtime/ringbuffer.py
+and runtime/textparse.py: mtime-staleness rebuild, atomic rename so two
+processes building concurrently never load a half-written .so, and a
+record-the-error singleton so a missing compiler is probed exactly once.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+
+class NativeLoader:
+    def __init__(self, src, so, configure, extra_flags=()):
+        """`configure(lib)` sets restype/argtypes after a successful load."""
+        self._src = src
+        self._so = so
+        self._configure = configure
+        self._flags = list(extra_flags)
+        self._lib = None
+        self._err = None
+        self._lock = threading.Lock()
+
+    def _build(self):
+        os.makedirs(os.path.dirname(self._so), exist_ok=True)
+        tmp = f"{self._so}.tmp.{os.getpid()}"
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+               *self._flags, self._src, "-o", tmp]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, self._so)  # atomic: concurrent builders race safely
+
+    def lib(self):
+        """The loaded library, or None if unavailable (no compiler)."""
+        with self._lock:
+            if self._lib is not None or self._err is not None:
+                return self._lib
+            try:
+                if not os.path.exists(self._so) or (
+                        os.path.getmtime(self._so)
+                        < os.path.getmtime(self._src)):
+                    self._build()
+                lib = ctypes.CDLL(self._so)
+                self._configure(lib)
+                self._lib = lib
+            except Exception as e:  # pragma: no cover - no-compiler envs
+                self._err = e
+            return self._lib

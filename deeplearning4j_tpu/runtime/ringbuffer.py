@@ -13,59 +13,43 @@ from __future__ import annotations
 import ctypes
 import os
 import queue
-import subprocess
 import threading
 
+from deeplearning4j_tpu.runtime._native import NativeLoader
+
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_HERE, "prefetch.cpp")
-_SO = os.path.join(_HERE, "build", "libprefetch.so")
 
 PF_OK, PF_TIMEOUT, PF_CLOSED, PF_TOO_BIG = 0, -1, -2, -3
 
-_lib = None
-_lib_err = None
-_lib_lock = threading.Lock()
+
+def _configure(lib):
+    lib.pf_create.restype = ctypes.c_void_p
+    lib.pf_create.argtypes = [ctypes.c_size_t, ctypes.c_size_t]
+    lib.pf_destroy.argtypes = [ctypes.c_void_p]
+    lib.pf_capacity.restype = ctypes.c_size_t
+    lib.pf_capacity.argtypes = [ctypes.c_void_p]
+    lib.pf_slot_bytes.restype = ctypes.c_size_t
+    lib.pf_slot_bytes.argtypes = [ctypes.c_void_p]
+    lib.pf_count.restype = ctypes.c_size_t
+    lib.pf_count.argtypes = [ctypes.c_void_p]
+    lib.pf_push.restype = ctypes.c_long
+    lib.pf_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                            ctypes.c_size_t, ctypes.c_long]
+    lib.pf_pop.restype = ctypes.c_long
+    lib.pf_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                           ctypes.c_size_t, ctypes.c_long]
+    lib.pf_close.argtypes = [ctypes.c_void_p]
+    lib.pf_reopen.argtypes = [ctypes.c_void_p]
 
 
-def _build_so():
-    os.makedirs(os.path.dirname(_SO), exist_ok=True)
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
-           _SRC, "-o", _SO]
-    subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+_loader = NativeLoader(os.path.join(_HERE, "prefetch.cpp"),
+                       os.path.join(_HERE, "build", "libprefetch.so"),
+                       _configure, extra_flags=("-pthread",))
 
 
 def native_lib():
     """Load (building if needed) the native library; None if unavailable."""
-    global _lib, _lib_err
-    with _lib_lock:
-        if _lib is not None or _lib_err is not None:
-            return _lib
-        try:
-            if not os.path.exists(_SO) or (
-                    os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
-                _build_so()
-            lib = ctypes.CDLL(_SO)
-            lib.pf_create.restype = ctypes.c_void_p
-            lib.pf_create.argtypes = [ctypes.c_size_t, ctypes.c_size_t]
-            lib.pf_destroy.argtypes = [ctypes.c_void_p]
-            lib.pf_capacity.restype = ctypes.c_size_t
-            lib.pf_capacity.argtypes = [ctypes.c_void_p]
-            lib.pf_slot_bytes.restype = ctypes.c_size_t
-            lib.pf_slot_bytes.argtypes = [ctypes.c_void_p]
-            lib.pf_count.restype = ctypes.c_size_t
-            lib.pf_count.argtypes = [ctypes.c_void_p]
-            lib.pf_push.restype = ctypes.c_long
-            lib.pf_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                    ctypes.c_size_t, ctypes.c_long]
-            lib.pf_pop.restype = ctypes.c_long
-            lib.pf_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
-                                   ctypes.c_size_t, ctypes.c_long]
-            lib.pf_close.argtypes = [ctypes.c_void_p]
-            lib.pf_reopen.argtypes = [ctypes.c_void_p]
-            _lib = lib
-        except Exception as e:  # no compiler / load failure -> fallback
-            _lib_err = e
-        return _lib
+    return _loader.lib()
 
 
 class NativeRingBuffer:
@@ -74,7 +58,8 @@ class NativeRingBuffer:
     def __init__(self, capacity: int, slot_bytes: int):
         lib = native_lib()
         if lib is None:
-            raise RuntimeError(f"native prefetch unavailable: {_lib_err!r}")
+            raise RuntimeError(
+                f"native prefetch unavailable: {_loader._err!r}")
         self._lib = lib
         self._h = lib.pf_create(capacity, slot_bytes)
         if not self._h:

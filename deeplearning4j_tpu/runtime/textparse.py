@@ -1,0 +1,90 @@
+"""ctypes binding for the native bulk CSV parser (textparse.cpp).
+
+Built on runtime/_native.py (shared with ringbuffer.py). The binding
+returns None whenever the native path cannot serve the request — no
+compiler, delimiter the parser can't handle, or content that is not a
+clean numeric rectangle — and callers fall back to the Python record
+loop, so behavior never changes, only speed.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from deeplearning4j_tpu.runtime._native import NativeLoader
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _configure(lib):
+    lib.tp_parse_f32.restype = ctypes.c_long
+    lib.tp_parse_f32.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char,
+        ctypes.c_long, ctypes.POINTER(ctypes.c_float),
+        ctypes.c_long, ctypes.POINTER(ctypes.c_long)]
+
+
+_loader = NativeLoader(os.path.join(_HERE, "textparse.cpp"),
+                       os.path.join(_HERE, "build", "libtextparse.so"),
+                       _configure)
+
+
+def native_lib():
+    """Load (building if needed) the native library; None if unavailable."""
+    return _loader.lib()
+
+
+def _first_data_line(data, skip_rows):
+    """First non-blank, non-skipped line — WITHOUT copying the buffer."""
+    i, skipped, n = 0, 0, len(data)
+    while i < n:
+        j = data.find(b"\n", i)
+        if j < 0:
+            j = n
+        line = data[i:j].strip()
+        i = j + 1
+        if not line:
+            continue
+        if skipped < int(skip_rows):
+            skipped += 1
+            continue
+        return line
+    return b""
+
+
+def parse_csv_f32(data, delimiter=",", skip_rows=0):
+    """bytes/str -> float32 [rows, cols] matrix, or None to fall back.
+
+    None means: native lib unavailable, unsupported delimiter, or the
+    content is not a clean numeric rectangle (ragged rows, non-numeric
+    or empty fields) — exactly the cases the Python path handles with
+    its richer per-token semantics."""
+    lib = native_lib()
+    if lib is None:
+        return None
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    d = str(delimiter)
+    # whitespace delimiters collide with the parser's field trimming
+    if len(d) != 1 or d in (" ", "\t", "\n", "\r"):
+        return None
+    # capacity: rows over-estimated from newline count (headers/blank
+    # lines inflate it harmlessly), columns from the first DATA line —
+    # a short header row must not shrink the estimate
+    first = _first_data_line(data, skip_rows)
+    if not first:
+        return None
+    cols_est = first.count(d.encode()) + 1
+    cap = (data.count(b"\n") + 1) * cols_est
+    out = np.empty(cap, np.float32)
+    ncols = ctypes.c_long(0)
+    rows = lib.tp_parse_f32(
+        data, len(data), d.encode()[0], int(skip_rows),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        cap, ctypes.byref(ncols))
+    if rows <= 0 or ncols.value == 0:
+        return None
+    return out[:rows * ncols.value].reshape(rows, ncols.value).copy()

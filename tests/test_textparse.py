@@ -1,0 +1,154 @@
+"""Native bulk CSV parser (runtime/textparse.cpp) — parity + fallback.
+
+The contract: the native sweep either returns EXACTLY what the Python
+record loop would produce (as float32), or None so the caller falls
+back. It must never silently alter semantics — rejection cases (ragged,
+non-numeric, empty fields, weird delimiters) are as load-bearing as the
+happy path.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.runtime.textparse import native_lib, parse_csv_f32
+
+pytestmark = pytest.mark.skipif(native_lib() is None,
+                                reason="no native compiler available")
+
+
+class TestParseParity:
+    def test_numeric_rectangle(self):
+        text = "1,2.5,-3e2\n4.25,.5,6\n+7,8e-3,9.0\n"
+        m = parse_csv_f32(text)
+        golden = np.asarray([[1, 2.5, -300], [4.25, 0.5, 6],
+                             [7, 0.008, 9.0]], np.float32)
+        np.testing.assert_array_equal(m, golden)
+        assert m.dtype == np.float32
+
+    def test_messy_whitespace_and_crlf(self):
+        text = " 1 , 2 ,3\r\n\r\n  \n4,5, 6 \r\n"
+        np.testing.assert_array_equal(
+            parse_csv_f32(text), np.asarray([[1, 2, 3], [4, 5, 6]],
+                                            np.float32))
+
+    def test_skip_rows_header(self):
+        text = "a,b,c\n1,2,3\n4,5,6\n"
+        np.testing.assert_array_equal(
+            parse_csv_f32(text, skip_rows=1),
+            np.asarray([[1, 2, 3], [4, 5, 6]], np.float32))
+
+    def test_alternate_delimiter(self):
+        np.testing.assert_array_equal(
+            parse_csv_f32("1;2\n3;4\n", delimiter=";"),
+            np.asarray([[1, 2], [3, 4]], np.float32))
+
+    def test_rejections_return_none(self):
+        assert parse_csv_f32("1,2\n3\n") is None              # ragged
+        assert parse_csv_f32("1,x\n") is None                 # non-numeric
+        assert parse_csv_f32("1,,2\n") is None                # empty field
+        assert parse_csv_f32("1 2\n3 4\n", delimiter=" ") is None  # ws delim
+        assert parse_csv_f32("") is None                      # empty input
+        assert parse_csv_f32("1,2.5.6\n") is None             # partial parse
+
+    def test_strtof_extras_rejected(self):
+        # strtof's grammar is WIDER than Python float() — the fast path
+        # must not silently accept what the record loop would surface
+        assert parse_csv_f32("0x1A,1\n") is None    # C99 hex float
+        assert parse_csv_f32("inf,1\n") is None     # inf/nan -> Python path
+        assert parse_csv_f32("nan,1\n") is None
+        assert parse_csv_f32("1_000,2\n") is None
+
+    def test_short_header_does_not_sink_capacity(self):
+        # a 1-field header must not shrink the capacity estimate for
+        # 3-field data rows (regression: -3 capacity -> silent fallback)
+        m = parse_csv_f32("label\n1,2,3\n4,5,6\n", skip_rows=1)
+        np.testing.assert_array_equal(
+            m, np.asarray([[1, 2, 3], [4, 5, 6]], np.float32))
+
+    def test_large_random_matrix_matches_numpy(self):
+        rs = np.random.RandomState(0)
+        golden = rs.randn(500, 12).astype(np.float32)
+        text = "\n".join(",".join(f"{v:.6g}" for v in row)
+                         for row in golden)
+        m = parse_csv_f32(text)
+        # %.6g text round-trip is the comparison domain for BOTH sides
+        np.testing.assert_allclose(m, golden, rtol=1e-5, atol=1e-6)
+
+
+class TestReaderIntegration:
+    def _write(self, tmp_path, text, name="f.csv"):
+        p = tmp_path / name
+        p.write_text(text)
+        return str(p)
+
+    def test_as_matrix_and_fallback(self, tmp_path):
+        from deeplearning4j_tpu.data.records import CSVRecordReader
+
+        rr = CSVRecordReader().initialize(
+            self._write(tmp_path, "1,2,0\n3,4,1\n"))
+        np.testing.assert_array_equal(
+            rr.asMatrix(), np.asarray([[1, 2, 0], [3, 4, 1]], np.float32))
+        rr2 = CSVRecordReader().initialize(
+            self._write(tmp_path, "1,2,cat\n3,4,dog\n", "mixed.csv"))
+        assert rr2.asMatrix() is None  # strings -> Python loop territory
+
+    def test_iterator_fast_path_equals_record_loop(self, tmp_path):
+        from deeplearning4j_tpu.data.records import (CSVRecordReader,
+                                                     RecordReaderDataSetIterator)
+
+        rs = np.random.RandomState(1)
+        rows = ["%.5g,%.5g,%.5g,%d" % (*rs.randn(3), rs.randint(0, 4))
+                for _ in range(64)]
+        path = self._write(tmp_path, "\n".join(rows) + "\n")
+
+        fast = RecordReaderDataSetIterator(
+            CSVRecordReader().initialize(path), 16, labelIndex=3,
+            numPossibleLabels=4)
+
+        slow_rr = CSVRecordReader().initialize(path)
+        slow_rr.asMatrix = lambda: None  # force the record loop
+        slow = RecordReaderDataSetIterator(slow_rr, 16, labelIndex=3,
+                                           numPossibleLabels=4)
+        for _ in range(4):
+            a, b = fast.next(), slow.next()
+            np.testing.assert_allclose(np.asarray(a.getFeatures().jax()),
+                                       np.asarray(b.getFeatures().jax()),
+                                       rtol=1e-6)
+            np.testing.assert_array_equal(np.asarray(a.getLabels().jax()),
+                                          np.asarray(b.getLabels().jax()))
+
+    def test_regression_labels_fast_path(self, tmp_path):
+        from deeplearning4j_tpu.data.records import (CSVRecordReader,
+                                                     RecordReaderDataSetIterator)
+
+        path = self._write(tmp_path, "1,2,0.5\n3,4,1.5\n5,6,2.5\n7,8,3.5\n")
+        it = RecordReaderDataSetIterator(
+            CSVRecordReader().initialize(path), 4, labelIndex=2,
+            regression=True)
+        ds = it.next()
+        np.testing.assert_allclose(
+            np.asarray(ds.getLabels().jax()).ravel(),
+            [0.5, 1.5, 2.5, 3.5])
+        np.testing.assert_allclose(
+            np.asarray(ds.getFeatures().jax()),
+            [[1, 2], [3, 4], [5, 6], [7, 8]])
+
+    def test_throughput_smoke(self, tmp_path):
+        # not a hard perf assertion (1-core CI host); prints the ratio
+        # so live runs document the win
+        rs = np.random.RandomState(2)
+        golden = rs.randn(4000, 20).astype(np.float32)
+        text = "\n".join(",".join(f"{v:.6g}" for v in row)
+                         for row in golden) + "\n"
+        t0 = time.perf_counter()
+        m = parse_csv_f32(text)
+        native_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        py = np.asarray([[float(t) for t in ln.split(",")]
+                         for ln in text.splitlines() if ln], np.float32)
+        python_s = time.perf_counter() - t0
+        np.testing.assert_allclose(m, py, rtol=1e-6)
+        print(f"\nnative {native_s * 1e3:.1f} ms vs python "
+              f"{python_s * 1e3:.1f} ms ({python_s / max(native_s, 1e-9):.1f}x)")
