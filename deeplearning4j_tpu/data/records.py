@@ -9,6 +9,7 @@ host in numpy — the TPU sees only the final fixed-shape float batches.
 from __future__ import annotations
 
 import operator
+import os
 from pathlib import Path
 
 import numpy as np
@@ -66,6 +67,11 @@ class CSVRecordReader(RecordReader):
         lines = [ln for ln in text.splitlines() if ln.strip()]
         self._lines = lines[self._skip:]
         self._path = str(path)
+        try:
+            st = os.stat(self._path)
+            self._stat = (st.st_size, st.st_mtime_ns)
+        except OSError:
+            self._stat = None
         self._i = 0
         return self
 
@@ -76,13 +82,24 @@ class CSVRecordReader(RecordReader):
         clean numeric rectangle / no compiler is available. Callers
         (RecordReaderDataSetIterator) fall back to next()-loop
         semantics on None, so mixed-type CSVs behave exactly as before.
-        Reads the file lazily (the raw text is not kept resident)."""
+
+        Reads the file lazily (raw text is not kept resident); if the
+        file was deleted or changed since initialize(), returns None so
+        the caller serves the CACHED lines — next()-loop and fast path
+        always see the same data."""
         if self._path is None:
+            return None
+        try:
+            st = os.stat(self._path)
+            if self._stat != (st.st_size, st.st_mtime_ns):
+                return None
+            with open(self._path, "rb") as f:
+                data = f.read()
+        except OSError:
             return None
         from deeplearning4j_tpu.runtime.textparse import parse_csv_f32
 
-        with open(self._path, "rb") as f:
-            return parse_csv_f32(f.read(), self._delim, self._skip)
+        return parse_csv_f32(data, self._delim, self._skip)
 
     @staticmethod
     def _parse(tok: str):
@@ -499,15 +516,17 @@ class RecordReaderDataSetIterator:
         # readers whose records are [ndarray, labelIndex] (images, audio)
         # rather than flat value lists mark themselves arrayRecords
         image_mode = getattr(recordReader, "arrayRecords", False)
-        # bulk fast path: a reader that can hand over the whole file as
-        # one numeric matrix (native textparse sweep) skips the
-        # per-record Python loop; None falls through to it
-        m = None if image_mode else getattr(recordReader, "asMatrix",
-                                            lambda: None)()
+        # bulk fast path: EXACTLY CSVRecordReader (not subclasses — an
+        # overridden next()/_parse must keep its say) can hand over the
+        # whole file as one numeric matrix; None falls through
+        m = (recordReader.asMatrix()
+             if type(recordReader) is CSVRecordReader else None)
         if m is not None and m.ndim == 2 and m.shape[1] >= 1:
             li = labelIndex if labelIndex >= 0 else m.shape[1] - 1
             f = np.delete(m, li, axis=1)
             labels = m[:, li].tolist()
+            recordReader._i = len(recordReader._lines)  # consumed, like
+            # the record loop leaves it
         else:
             while recordReader.hasNext():
                 rec = recordReader.next()

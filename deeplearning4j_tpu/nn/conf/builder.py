@@ -50,6 +50,7 @@ class MultiLayerConfiguration:
         self.gradientNormalizationThreshold = gradientNormalizationThreshold
         self.activationCheckpointing = defaults.get(
             "activationCheckpointing", False)
+        self.checkpointPolicy = defaults.get("checkpointPolicy")
         # resolved per-layer input types (set during shape inference)
         self.layerInputTypes = []
 

@@ -245,8 +245,29 @@ class MultiLayerNetwork:
                     p, states[i], h, lk, fmask)
             else:
                 h, s = layer.forward(p, states[i], h, l_train, lk, fmask)
+            if getattr(self.conf, "checkpointPolicy", None) == \
+                    "save_conv_outputs" and isinstance(
+                        layer, (L.ConvolutionLayer, L.DenseLayer)):
+                # name MXU outputs as the ONLY residuals the train step's
+                # jax.checkpoint policy saves (_ckpt_loss_fn) — see
+                # nn/graph.py for the policy contract
+                from jax.ad_checkpoint import checkpoint_name
+                h = checkpoint_name(h, "dl4j_mxu_out")
             new_states.append(s)
         return h, new_states
+
+    def _ckpt_loss_fn(self, use_carries):
+        """_loss_fn under the conf's named-residual remat policy when one
+        is set (see ComputationGraph._ckpt_loss_fn — same contract)."""
+        def base(p, s, x, y, k, fm, lm):
+            return self._loss_fn(p, s, x, y, k, fm, lm, use_carries)
+
+        if getattr(self.conf, "checkpointPolicy", None) != \
+                "save_conv_outputs":
+            return base
+        policy = jax.checkpoint_policies.save_only_these_names(
+            "dl4j_mxu_out")
+        return jax.checkpoint(base, policy=policy)
 
     def _loss_from_preact(self, preact, labels, lmask):
         last = self.layers[-1]
@@ -303,8 +324,8 @@ class MultiLayerNetwork:
         (parallel.trainer) splice in an explicit cross-shard allreduce /
         pmean without duplicating the updater loop."""
         (loss, new_states), grads = jax.value_and_grad(
-            self._loss_fn, has_aux=True)(params, states, x, y, key, fmask, lmask,
-                                         use_carries)
+            self._ckpt_loss_fn(use_carries), has_aux=True)(
+            params, states, x, y, key, fmask, lmask)
         if grad_transform is not None:
             grads = grad_transform(grads)
         if loss_transform is not None:

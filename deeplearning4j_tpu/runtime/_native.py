@@ -33,6 +33,10 @@ class NativeLoader:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, self._so)  # atomic: concurrent builders race safely
 
+    def err(self):
+        """Why lib() returned None (the load/build exception), or None."""
+        return self._err
+
     def lib(self):
         """The loaded library, or None if unavailable (no compiler)."""
         with self._lock:

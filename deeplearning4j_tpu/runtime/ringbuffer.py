@@ -59,7 +59,7 @@ class NativeRingBuffer:
         lib = native_lib()
         if lib is None:
             raise RuntimeError(
-                f"native prefetch unavailable: {_loader._err!r}")
+                f"native prefetch unavailable: {_loader.err()!r}")
         self._lib = lib
         self._h = lib.pf_create(capacity, slot_bytes)
         if not self._h:

@@ -54,16 +54,12 @@ class ZooModel:
         from deeplearning4j_tpu.nn.conf.graph import ComputationGraphConfiguration
 
         if self.checkpointPolicy is not None:
-            # applied here, not in each model's conf(), so EVERY
-            # graph-built zoo model honors the option (a silently
-            # ignored policy would claim the HBM lever is on)
+            # applied here, not in each model's conf(), so EVERY zoo
+            # model honors the option (a silently ignored policy would
+            # claim the HBM lever is on); both network types implement it
             if self.checkpointPolicy != "save_conv_outputs":
                 raise ValueError(
                     f"unknown checkpointPolicy {self.checkpointPolicy!r}")
-            if not isinstance(conf, ComputationGraphConfiguration):
-                raise ValueError(
-                    f"{type(self).__name__} builds a MultiLayerNetwork; "
-                    "checkpointPolicy is a ComputationGraph feature")
             conf.checkpointPolicy = self.checkpointPolicy
         net = ComputationGraph(conf) if isinstance(conf, ComputationGraphConfiguration) \
             else MultiLayerNetwork(conf)

@@ -129,6 +129,37 @@ class TestSaveConvOutputsPolicy:
         with pytest.raises(ValueError, match="checkpointPolicy"):
             NeuralNetConfiguration.Builder().checkpointPolicy("save_everything")
 
+    def test_mln_trajectory_parity(self):
+        # the policy is a shared Builder option — MultiLayerNetwork
+        # implements it too (same tag + jax.checkpoint wrap)
+        from deeplearning4j_tpu.nn import MultiLayerNetwork
+
+        def mconf(policy):
+            b = (NeuralNetConfiguration.Builder().seed(9).updater(Adam(1e-2))
+                 .checkpointPolicy(policy))
+            return (b.list()
+                    .layer(ConvolutionLayer(nOut=5, kernelSize=(3, 3),
+                                            padding=(1, 1),
+                                            activation="identity"))
+                    .layer(BatchNormalization(activation="relu"))
+                    .layer(GlobalPoolingLayer(poolingType="avg"))
+                    .layer(DenseLayer(nOut=8, activation="relu"))
+                    .layer(OutputLayer(nOut=3, activation="softmax"))
+                    .setInputType(InputType.convolutional(6, 6, 2)).build())
+
+        rng = np.random.RandomState(4)
+        x = rng.randn(8, 2, 6, 6).astype("float32")
+        y = np.eye(3, dtype="float32")[rng.randint(0, 3, 8)]
+        stock = MultiLayerNetwork(mconf(None)).init()
+        remat = MultiLayerNetwork(mconf("save_conv_outputs")).init()
+        assert remat.conf.checkpointPolicy == "save_conv_outputs"
+        for _ in range(4):
+            stock.fit(x, y)
+            remat.fit(x, y)
+        np.testing.assert_allclose(stock.params().toNumpy(),
+                                   remat.params().toNumpy(),
+                                   rtol=1e-5, atol=1e-7)
+
     def test_zoo_flagship_threads_policy(self):
         from deeplearning4j_tpu.zoo import ResNet50
 

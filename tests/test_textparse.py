@@ -119,6 +119,56 @@ class TestReaderIntegration:
             np.testing.assert_array_equal(np.asarray(a.getLabels().jax()),
                                           np.asarray(b.getLabels().jax()))
 
+    def test_subclass_override_honored(self, tmp_path):
+        # a subclass transforming values in next() must NOT be bypassed
+        # by the bulk fast path (exact-type gate in the iterator)
+        from deeplearning4j_tpu.data.records import (CSVRecordReader,
+                                                     RecordReaderDataSetIterator)
+
+        class DoublingReader(CSVRecordReader):
+            def next(self):
+                return [v * 2 if isinstance(v, (int, float)) else v
+                        for v in super().next()]
+
+        path = self._write(tmp_path, "1,2,0\n3,4,1\n")
+        it = RecordReaderDataSetIterator(
+            DoublingReader().initialize(path), 2, labelIndex=2,
+            numPossibleLabels=3)  # labels double too: {0, 2}
+        ds = it.next()
+        np.testing.assert_allclose(
+            np.asarray(ds.getFeatures().jax()), [[2, 4], [6, 8]])
+        np.testing.assert_array_equal(
+            np.asarray(ds.getLabels().jax()).argmax(1), [0, 2])
+
+    def test_stale_file_falls_back_to_cached_lines(self, tmp_path):
+        from deeplearning4j_tpu.data.records import (CSVRecordReader,
+                                                     RecordReaderDataSetIterator)
+
+        p = tmp_path / "f.csv"
+        p.write_text("1,2,0\n3,4,1\n")
+        rr = CSVRecordReader().initialize(str(p))
+        p.write_text("9,9,0\n9,9,1\n9,9,0\n")  # rewritten after init
+        assert rr.asMatrix() is None  # stat mismatch -> fallback
+        it = RecordReaderDataSetIterator(rr, 2, labelIndex=2,
+                                         numPossibleLabels=2)
+        ds = it.next()  # record loop serves the lines cached at init
+        np.testing.assert_allclose(
+            np.asarray(ds.getFeatures().jax()), [[1, 2], [3, 4]])
+        p.unlink()
+        rr2 = CSVRecordReader()
+        rr2._lines, rr2._path, rr2._stat = ["1,2"], str(p), (1, 1)
+        assert rr2.asMatrix() is None  # deleted file -> fallback, no raise
+
+    def test_reader_consumed_after_fast_path(self, tmp_path):
+        from deeplearning4j_tpu.data.records import (CSVRecordReader,
+                                                     RecordReaderDataSetIterator)
+
+        rr = CSVRecordReader().initialize(
+            self._write(tmp_path, "1,2,0\n3,4,1\n"))
+        RecordReaderDataSetIterator(rr, 2, labelIndex=2,
+                                    numPossibleLabels=2)
+        assert not rr.hasNext()  # same post-state as the record loop
+
     def test_regression_labels_fast_path(self, tmp_path):
         from deeplearning4j_tpu.data.records import (CSVRecordReader,
                                                      RecordReaderDataSetIterator)
