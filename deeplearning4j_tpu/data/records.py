@@ -90,11 +90,13 @@ class CSVRecordReader(RecordReader):
         if self._path is None:
             return None
         try:
-            st = os.stat(self._path)
-            if self._stat != (st.st_size, st.st_mtime_ns):
-                return None
             with open(self._path, "rb") as f:
                 data = f.read()
+                # fstat AFTER the read, on the open fd: stat-then-read
+                # would race a concurrent rewrite between the two calls
+                st = os.fstat(f.fileno())
+            if self._stat != (st.st_size, st.st_mtime_ns):
+                return None
         except OSError:
             return None
         from deeplearning4j_tpu.runtime.textparse import parse_csv_f32

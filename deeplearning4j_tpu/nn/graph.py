@@ -610,6 +610,83 @@ class ComputationGraph:
     def numParams(self) -> int:
         return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(self._params))
 
+    def setParams(self, flat):
+        """Inverse of params(): set all parameters from one flat vector
+        (reference: Model.setParams). Leaf order matches params()."""
+        leaves, treedef = jax.tree_util.tree_flatten(self._params)
+        vec = np.asarray(_unwrap(flat)).reshape(-1)
+        if vec.size != sum(int(np.prod(l.shape)) for l in leaves):
+            raise ValueError(
+                f"setParams: got {vec.size} values for "
+                f"{self.numParams()} parameters")
+        new, off = [], 0
+        for l in leaves:
+            n = int(np.prod(l.shape))
+            new.append(jnp.asarray(vec[off:off + n], l.dtype).reshape(l.shape))
+            off += n
+        self._params = jax.tree_util.tree_unflatten(treedef, new)
+        return self
+
+    def paramTable(self) -> dict:
+        """"vertexName_paramName" -> INDArray (reference:
+        ComputationGraph.paramTable)."""
+        out = {}
+        for name in self._layer_names:
+            for k, v in self._params[name].items():
+                out[f"{name}_{k}"] = INDArray(v)
+        return out
+
+    def getParam(self, key: str):
+        """One parameter by "vertexName_paramName" key (reference:
+        Model.getParam). Vertex names may contain underscores, so the
+        split is on the LAST one."""
+        name, _, pname = key.rpartition("_")
+        return INDArray(self._params[name][pname])
+
+    def setParamTable(self, table: dict):
+        """Assign parameters by "vertexName_paramName" keys (reference:
+        Model.setParamTable). Shapes must match the existing table."""
+        for key, v in table.items():
+            name, _, pname = key.rpartition("_")
+            cur = self._params[name][pname]
+            arr = jnp.asarray(_unwrap(v), cur.dtype)
+            if arr.shape != cur.shape:
+                raise ValueError(
+                    f"setParamTable: {key} has shape {arr.shape}, "
+                    f"expected {cur.shape}")
+            self._params[name] = {**self._params[name], pname: arr}
+        return self
+
+    def computeGradientAndScore(self, inputs, labels):
+        """(grads, score) for gradient checks (reference:
+        Model.computeGradientAndScore). `inputs`/`labels` follow fit()'s
+        conventions (single array or list)."""
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labs = labels if isinstance(labels, (list, tuple)) else [labels]
+        feed = {n: _unwrap(v) for n, v in
+                zip(self.conf.networkInputs, ins)}
+        (loss, _), grads = jax.value_and_grad(
+            self._loss_fn, has_aux=True)(
+            self._params, self._states, feed,
+            [_unwrap(y) for y in labs], None, None, None, False)
+        return grads, float(loss)
+
+    def clone(self):
+        """Independent copy with the same configuration and parameters
+        (reference: ComputationGraph.clone). Buffers are COPIED —
+        fit() donates the original's arrays to XLA, so a buffer-sharing
+        clone would die on the original's next train step."""
+        net = ComputationGraph(self.conf).init()
+        copy = lambda x: jnp.copy(x) if hasattr(x, "shape") else x
+        net._params = jax.tree_util.tree_map(copy, self._params)
+        net._states = jax.tree_util.tree_map(copy, self._states)
+        net._upd_states = jax.tree_util.tree_map(copy, self._upd_states)
+        # training position travels with the updater moments (see
+        # MultiLayerNetwork.clone)
+        net._iteration = self._iteration
+        net._epoch = self._epoch
+        return net
+
     def setListeners(self, *listeners):
         self._listeners = list(listeners)
         return self

@@ -656,6 +656,61 @@ class MultiLayerNetwork:
                 out[f"{i}_{k}"] = INDArray(v)
         return out
 
+    def setParams(self, flat):
+        """Inverse of params(): set all parameters from one flat vector
+        (reference: Model.setParams). Leaf order matches params()."""
+        leaves, treedef = jax.tree_util.tree_flatten(self._params)
+        vec = np.asarray(_unwrap(flat)).reshape(-1)
+        if vec.size != sum(int(np.prod(l.shape)) for l in leaves):
+            raise ValueError(
+                f"setParams: got {vec.size} values for "
+                f"{self.numParams()} parameters")
+        new, off = [], 0
+        for l in leaves:
+            n = int(np.prod(l.shape))
+            new.append(jnp.asarray(vec[off:off + n], l.dtype).reshape(l.shape))
+            off += n
+        self._params = jax.tree_util.tree_unflatten(treedef, new)
+        return self
+
+    def getParam(self, key: str):
+        """One parameter by "layerIndex_name" key (reference:
+        Model.getParam, e.g. "0_W")."""
+        i, _, name = key.partition("_")
+        return INDArray(self._params[int(i)][name])
+
+    def setParamTable(self, table: dict):
+        """Assign parameters by "layerIndex_name" keys (reference:
+        Model.setParamTable). Shapes must match the existing table."""
+        for key, v in table.items():
+            i, _, name = key.partition("_")
+            i = int(i)
+            cur = self._params[i][name]
+            arr = jnp.asarray(_unwrap(v), cur.dtype)
+            if arr.shape != cur.shape:
+                raise ValueError(
+                    f"setParamTable: {key} has shape {arr.shape}, "
+                    f"expected {cur.shape}")
+            self._params[i] = {**self._params[i], name: arr}
+        return self
+
+    def clone(self):
+        """Independent copy with the same configuration and parameters
+        (reference: MultiLayerNetwork.clone). Buffers are COPIED —
+        fit() donates the original's arrays to XLA, so a buffer-sharing
+        clone would die on the original's next train step."""
+        net = MultiLayerNetwork(self.conf).init()
+        copy = lambda x: jnp.copy(x) if hasattr(x, "shape") else x
+        net._params = jax.tree_util.tree_map(copy, self._params)
+        net._states = jax.tree_util.tree_map(copy, self._states)
+        net._upd_states = jax.tree_util.tree_map(copy, self._upd_states)
+        # training position travels with the updater moments: a clone
+        # resuming at iteration 0 would restart LR schedules and repeat
+        # the dropout key stream
+        net._iteration = self._iteration
+        net._epoch = self._epoch
+        return net
+
     def getLayers(self):
         return self.layers
 

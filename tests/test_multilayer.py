@@ -553,3 +553,108 @@ class TestActivationCheckpointing:
                                           None, None, False))(
                 net._params, net._states)
             assert ("remat" in str(jpr)) == ck
+
+
+class TestModelInterfaceParity:
+    """Model-interface surface (reference: org.deeplearning4j.nn.api.Model):
+    setParams/getParam/setParamTable/clone on both network types."""
+
+    def _mln(self):
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           DenseLayer, OutputLayer, Adam,
+                                           MultiLayerNetwork)
+        conf = (NeuralNetConfiguration.Builder().seed(3).updater(Adam(1e-2))
+                .list()
+                .layer(DenseLayer(nOut=7, activation="tanh"))
+                .layer(OutputLayer(nOut=3, activation="softmax"))
+                .setInputType(InputType.feedForward(5)).build())
+        return MultiLayerNetwork(conf).init()
+
+    def _graph(self):
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           DenseLayer, OutputLayer, Adam,
+                                           ComputationGraph)
+        conf = (NeuralNetConfiguration.Builder().seed(4).updater(Adam(1e-2))
+                .graphBuilder().addInputs("in")
+                .addLayer("h_1", DenseLayer(nOut=6, activation="relu"), "in")
+                .addLayer("out", OutputLayer(nOut=2, activation="softmax"),
+                          "h_1")
+                .setOutputs("out")
+                .setInputTypes(InputType.feedForward(4)).build())
+        return ComputationGraph(conf).init()
+
+    def test_set_params_roundtrip(self):
+        net = self._mln()
+        flat = net.params().toNumpy() + 0.25  # distinct target vector
+        other = self._mln()
+        assert not np.allclose(other.params().toNumpy(), flat)
+        other.setParams(flat)
+        np.testing.assert_allclose(other.params().toNumpy(), flat,
+                                   rtol=1e-6)
+        with pytest.raises(ValueError, match="setParams"):
+            net.setParams(flat[:-1])
+
+    def test_get_param_and_set_param_table(self):
+        net = self._mln()
+        w0 = net.getParam("0_W").toNumpy()
+        assert w0.shape == (5, 7)
+        table = {"0_W": np.ones_like(w0)}
+        net.setParamTable(table)
+        np.testing.assert_allclose(net.getParam("0_W").toNumpy(), 1.0)
+        with pytest.raises(ValueError, match="shape"):
+            net.setParamTable({"0_W": np.ones((2, 2), "float32")})
+
+    def test_graph_param_table_underscore_names(self):
+        net = self._graph()
+        t = net.paramTable()
+        assert "h_1_W" in t and t["h_1_W"].shape() == (4, 6)
+        np.testing.assert_allclose(net.getParam("h_1_W").toNumpy(),
+                                   t["h_1_W"].toNumpy())
+        net.setParamTable({"h_1_b": np.full(6, 0.5, "float32")})
+        np.testing.assert_allclose(net.getParam("h_1_b").toNumpy(), 0.5)
+
+    def test_clone_is_independent(self):
+        rng = np.random.RandomState(0)
+        for net, fit in (
+                (self._mln(), lambda n: n.fit(
+                    rng.randn(8, 5).astype("float32"),
+                    np.eye(3, dtype="float32")[rng.randint(0, 3, 8)])),
+                (self._graph(), lambda n: n.fit(
+                    rng.randn(8, 4).astype("float32"),
+                    np.eye(2, dtype="float32")[rng.randint(0, 2, 8)]))):
+            dup = net.clone()
+            np.testing.assert_allclose(dup.params().toNumpy(),
+                                       net.params().toNumpy())
+            fit(net)  # training the original must not touch the clone
+            assert not np.allclose(dup.params().toNumpy(),
+                                   net.params().toNumpy())
+
+    def test_clone_carries_training_position(self):
+        # LR schedules and the dropout key stream are iteration-keyed:
+        # a clone resuming at 0 would silently diverge from the original
+        rng = np.random.RandomState(5)
+        net = self._mln()
+        for _ in range(3):
+            net.fit(rng.randn(4, 5).astype("float32"),
+                    np.eye(3, dtype="float32")[rng.randint(0, 3, 4)])
+        dup = net.clone()
+        assert dup._iteration == net._iteration == 3
+        assert dup._epoch == net._epoch
+
+    def test_graph_set_params_roundtrip(self):
+        net = self._graph()
+        flat = net.params().toNumpy() + 0.125
+        net.setParams(flat)
+        np.testing.assert_allclose(net.params().toNumpy(), flat, rtol=1e-6)
+        with pytest.raises(ValueError, match="setParams"):
+            net.setParams(flat[:-1])
+
+    def test_graph_compute_gradient_and_score(self):
+        net = self._graph()
+        rng = np.random.RandomState(1)
+        x = rng.randn(6, 4).astype("float32")
+        y = np.eye(2, dtype="float32")[rng.randint(0, 2, 6)]
+        grads, score = net.computeGradientAndScore(x, y)
+        assert np.isfinite(score)
+        g = np.asarray(grads["h_1"]["W"])
+        assert g.shape == (4, 6) and np.abs(g).sum() > 0
