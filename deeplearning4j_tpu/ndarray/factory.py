@@ -226,6 +226,56 @@ class Nd4j:
     def min(a, b) -> INDArray:
         return INDArray(jnp.minimum(_unwrap(a), _unwrap(b)))
 
+    @staticmethod
+    def kron(a, b) -> INDArray:
+        """Kronecker product (reference: Nd4j.kron)."""
+        return INDArray(jnp.kron(_unwrap(a), _unwrap(b)))
+
+    @staticmethod
+    def argMax(arr, *dimension) -> INDArray:
+        """Reference: Nd4j.argMax(arr, dims) — flat argmax with no dims.
+        Multi-dim reduction raises rather than silently using only the
+        first dim."""
+        x = _unwrap(arr)
+        if len(dimension) > 1:
+            raise ValueError(
+                "argMax over multiple dimensions is not supported; "
+                "reshape to merge the dims first")
+        axis = dimension[0] if dimension else None
+        return INDArray(jnp.argmax(x, axis=axis))
+
+    @staticmethod
+    def sortWithIndices(arr, dimension: int = -1,
+                        ascending: bool = True):
+        """[indices, sorted] pair (reference: Nd4j.sortWithIndices)."""
+        x = _unwrap(arr)
+        idx = jnp.argsort(x, axis=dimension)
+        if not ascending:
+            idx = jnp.flip(idx, axis=dimension)
+        return [INDArray(idx),
+                INDArray(jnp.take_along_axis(x, idx, axis=dimension))]
+
+    @staticmethod
+    def average(*arrs) -> INDArray:
+        """Elementwise mean of same-shaped arrays (reference:
+        Nd4j.averageAndPropagate family). Accepts varargs or one list."""
+        if len(arrs) == 1 and isinstance(arrs[0], (list, tuple)):
+            arrs = tuple(arrs[0])
+        if not arrs:
+            raise ValueError("average needs at least one array")
+        return INDArray(
+            sum(_unwrap(a) for a in arrs) / float(len(arrs)))
+
+    @staticmethod
+    def accumulate(*arrs) -> INDArray:
+        """Elementwise sum of same-shaped arrays (reference:
+        Nd4j.accumulate). Accepts varargs or one list."""
+        if len(arrs) == 1 and isinstance(arrs[0], (list, tuple)):
+            arrs = tuple(arrs[0])
+        if not arrs:
+            raise ValueError("accumulate needs at least one array")
+        return INDArray(sum(_unwrap(a) for a in arrs))
+
     # ----- executioner / env (reference: Nd4j.getExecutioner()) -------
     @staticmethod
     def getExecutioner():

@@ -23,6 +23,21 @@ def _wrap1(fn):
     return op
 
 
+def _rows(x, dimensions):
+    """[N, D] row view for the all-pairs distance family. Upstream's
+    `dimensions` selects the vector axis; only the row layout (vectors
+    along dim 1, the upstream default) is supported — anything else
+    raises rather than silently transposing."""
+    a = jnp.asarray(_unwrap(x))
+    if a.ndim != 2:
+        raise ValueError(f"all-distances expect 2-D [N, D] input, got "
+                         f"shape {a.shape}")
+    if dimensions and tuple(dimensions) != (1,):
+        raise ValueError("only dimensions=1 (vectors along rows) is "
+                         "supported")
+    return a
+
+
 class Transforms:
     # ----- exponential / log ------------------------------------------
     exp = staticmethod(_wrap1(jnp.exp))
@@ -138,6 +153,33 @@ class Transforms:
         inter = jnp.minimum(a, b).sum()
         union = jnp.maximum(a, b).sum()
         return float(1.0 - inter / jnp.maximum(union, 1e-12))
+
+    # ----- all-pairs distance matrices (reference:
+    # Transforms.allEuclideanDistances / allCosineSimilarities /
+    # allManhattanDistances — upstream lowers these to gemm-shaped
+    # kernels; here the [N, D] x [M, D] -> [N, M] forms ride the MXU) --
+    @staticmethod
+    def allEuclideanDistances(x, y, *dimensions) -> INDArray:
+        a, b = _rows(x, dimensions), _rows(y, dimensions)
+        # ||a-b||^2 = ||a||^2 + ||b||^2 - 2ab, clamped for fp error
+        sq = (jnp.sum(a * a, 1)[:, None] + jnp.sum(b * b, 1)[None, :]
+              - 2.0 * (a @ b.T))
+        return INDArray(jnp.sqrt(jnp.maximum(sq, 0.0)))
+
+    @staticmethod
+    def allManhattanDistances(x, y, *dimensions) -> INDArray:
+        a, b = _rows(x, dimensions), _rows(y, dimensions)
+        # L1 has no gemm form; stream rows so working memory stays
+        # O(M*D) instead of materializing the [N, M, D] broadcast
+        return INDArray(jax.lax.map(
+            lambda row: jnp.sum(jnp.abs(row[None, :] - b), -1), a))
+
+    @staticmethod
+    def allCosineSimilarities(x, y, *dimensions) -> INDArray:
+        a, b = _rows(x, dimensions), _rows(y, dimensions)
+        an = jnp.linalg.norm(a, axis=1)[:, None]
+        bn = jnp.linalg.norm(b, axis=1)[None, :]
+        return INDArray((a @ b.T) / jnp.maximum(an * bn, 1e-12))
 
     # ----- comparisons (reference: Transforms.and/or/xor/not) ---------
     @staticmethod

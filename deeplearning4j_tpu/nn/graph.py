@@ -676,11 +676,13 @@ class ComputationGraph:
         (reference: ComputationGraph.clone). Buffers are COPIED —
         fit() donates the original's arrays to XLA, so a buffer-sharing
         clone would die on the original's next train step."""
-        net = ComputationGraph(self.conf).init()
+        # initFrom, not init(): a full random re-initialization would
+        # be computed and immediately overwritten
         copy = lambda x: jnp.copy(x) if hasattr(x, "shape") else x
-        net._params = jax.tree_util.tree_map(copy, self._params)
-        net._states = jax.tree_util.tree_map(copy, self._states)
-        net._upd_states = jax.tree_util.tree_map(copy, self._upd_states)
+        net = ComputationGraph(self.conf).initFrom(
+            jax.tree_util.tree_map(copy, self._params),
+            jax.tree_util.tree_map(copy, self._states),
+            jax.tree_util.tree_map(copy, self._upd_states))
         # training position travels with the updater moments (see
         # MultiLayerNetwork.clone)
         net._iteration = self._iteration

@@ -699,11 +699,13 @@ class MultiLayerNetwork:
         (reference: MultiLayerNetwork.clone). Buffers are COPIED —
         fit() donates the original's arrays to XLA, so a buffer-sharing
         clone would die on the original's next train step."""
-        net = MultiLayerNetwork(self.conf).init()
+        # initFrom, not init(): a full random re-initialization would
+        # be computed and immediately overwritten
         copy = lambda x: jnp.copy(x) if hasattr(x, "shape") else x
-        net._params = jax.tree_util.tree_map(copy, self._params)
-        net._states = jax.tree_util.tree_map(copy, self._states)
-        net._upd_states = jax.tree_util.tree_map(copy, self._upd_states)
+        net = MultiLayerNetwork(self.conf).initFrom(
+            jax.tree_util.tree_map(copy, self._params),
+            jax.tree_util.tree_map(copy, self._states),
+            jax.tree_util.tree_map(copy, self._upd_states))
         # training position travels with the updater moments: a clone
         # resuming at iteration 0 would restart LR schedules and repeat
         # the dropout key stream

@@ -302,3 +302,68 @@ class TestTransforms:
         a = Nd4j.create([1.0, 2.0, 3.0])
         np.testing.assert_allclose(T.pow(a, 2).toNumpy(), [1, 4, 9])
         np.testing.assert_allclose(T.clip(a, 1.5, 2.5).toNumpy(), [1.5, 2.0, 2.5])
+
+
+class TestFactoryLongTail:
+    """Nd4j statics long tail (reference: org.nd4j.linalg.factory.Nd4j):
+    kron / argMax / sortWithIndices / average / accumulate."""
+
+    def test_kron(self):
+        a = Nd4j.create([[1.0, 2.0], [3.0, 4.0]])
+        b = Nd4j.eye(2)
+        np.testing.assert_allclose(
+            Nd4j.kron(a, b).toNumpy(),
+            np.kron(a.toNumpy(), b.toNumpy()))
+
+    def test_arg_max(self):
+        a = Nd4j.create([[1.0, 9.0, 2.0], [8.0, 0.0, 3.0]])
+        assert int(Nd4j.argMax(a).toNumpy()) == 1  # flat
+        np.testing.assert_array_equal(Nd4j.argMax(a, 1).toNumpy(), [1, 0])
+        np.testing.assert_array_equal(Nd4j.argMax(a, 0).toNumpy(), [1, 0, 1])
+
+    def test_sort_with_indices(self):
+        a = Nd4j.create([[3.0, 1.0, 2.0]])
+        idx, srt = Nd4j.sortWithIndices(a, 1, True)
+        np.testing.assert_array_equal(idx.toNumpy(), [[1, 2, 0]])
+        np.testing.assert_allclose(srt.toNumpy(), [[1, 2, 3]])
+        idx_d, srt_d = Nd4j.sortWithIndices(a, 1, False)
+        np.testing.assert_allclose(srt_d.toNumpy(), [[3, 2, 1]])
+
+    def test_average_and_accumulate(self):
+        arrs = [Nd4j.valueArrayOf((2, 2), v) for v in (1.0, 2.0, 6.0)]
+        np.testing.assert_allclose(Nd4j.average(*arrs).toNumpy(), 3.0)
+        np.testing.assert_allclose(Nd4j.average(arrs).toNumpy(), 3.0)
+        np.testing.assert_allclose(Nd4j.accumulate(*arrs).toNumpy(), 9.0)
+        with pytest.raises(ValueError):
+            Nd4j.average()
+
+
+class TestAllPairDistances:
+    """Transforms.all*Distances (reference: the gemm-lowered all-pairs
+    kernels in org.nd4j.linalg.ops.transforms.Transforms), scipy oracle."""
+
+    def test_all_pairs_vs_scipy(self):
+        from scipy.spatial.distance import cdist
+        from deeplearning4j_tpu.ndarray.transforms import Transforms
+
+        rs = np.random.RandomState(0)
+        a = rs.randn(7, 5).astype("float32")
+        b = rs.randn(4, 5).astype("float32")
+        np.testing.assert_allclose(
+            Transforms.allEuclideanDistances(a, b, 1).toNumpy(),
+            cdist(a, b), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            Transforms.allManhattanDistances(a, b, 1).toNumpy(),
+            cdist(a, b, "cityblock"), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            Transforms.allCosineSimilarities(a, b, 1).toNumpy(),
+            1.0 - cdist(a, b, "cosine"), rtol=1e-4, atol=1e-4)
+
+    def test_bad_shapes_rejected(self):
+        from deeplearning4j_tpu.ndarray.transforms import Transforms
+
+        with pytest.raises(ValueError, match="2-D"):
+            Transforms.allEuclideanDistances(np.zeros(3), np.zeros(3))
+        with pytest.raises(ValueError, match="dimensions"):
+            Transforms.allCosineSimilarities(np.zeros((2, 2)),
+                                             np.zeros((2, 2)), 0)
