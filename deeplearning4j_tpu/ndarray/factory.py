@@ -256,17 +256,6 @@ class Nd4j:
                 INDArray(jnp.take_along_axis(x, idx, axis=dimension))]
 
     @staticmethod
-    def average(*arrs) -> INDArray:
-        """Elementwise mean of same-shaped arrays (reference:
-        Nd4j.averageAndPropagate family). Accepts varargs or one list."""
-        if len(arrs) == 1 and isinstance(arrs[0], (list, tuple)):
-            arrs = tuple(arrs[0])
-        if not arrs:
-            raise ValueError("average needs at least one array")
-        return INDArray(
-            sum(_unwrap(a) for a in arrs) / float(len(arrs)))
-
-    @staticmethod
     def accumulate(*arrs) -> INDArray:
         """Elementwise sum of same-shaped arrays (reference:
         Nd4j.accumulate). Accepts varargs or one list."""
@@ -275,6 +264,15 @@ class Nd4j:
         if not arrs:
             raise ValueError("accumulate needs at least one array")
         return INDArray(sum(_unwrap(a) for a in arrs))
+
+    @staticmethod
+    def average(*arrs) -> INDArray:
+        """Elementwise mean of same-shaped arrays (reference:
+        Nd4j.averageAndPropagate family). Accepts varargs or one list."""
+        if len(arrs) == 1 and isinstance(arrs[0], (list, tuple)):
+            arrs = tuple(arrs[0])
+        total = Nd4j.accumulate(*arrs)  # shares the varargs/guard logic
+        return INDArray(total.jax() / float(len(arrs)))
 
     # ----- executioner / env (reference: Nd4j.getExecutioner()) -------
     @staticmethod

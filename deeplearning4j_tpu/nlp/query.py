@@ -41,10 +41,35 @@ class WordVectorQuery:
         a, b = W[self.vocab[w1]], W[self.vocab[w2]]
         return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
 
-    def wordsNearest(self, word, n=10):
+    def wordsNearest(self, word, n=10, negative=None):
+        """Nearest words by cosine. Two forms (reference: WordVectorsImpl
+        .wordsNearest):
+
+        - wordsNearest("king", 10) — neighbors of one word
+        - wordsNearest(["king", "woman"], 5, negative=["man"]) — the
+          classic analogy query: unit vectors of the positives summed,
+          negatives subtracted, scaled by 1/(len(pos)+len(neg)) (the
+          word2vec/gensim convention)
+        """
         W = self._matrix()
-        v = W[self.vocab[word]]
-        sims = W @ v / (np.linalg.norm(W, axis=1) * np.linalg.norm(v) + 1e-12)
+        positive = [word] if isinstance(word, str) else list(word)
+        neg = list(negative or [])
+        missing = [w for w in positive + neg if w not in self.vocab]
+        if missing:
+            raise KeyError(f"words not in vocabulary: {missing}")
+        # mean of normalized vectors, the word2vec convention: each query
+        # word contributes direction, not magnitude
+        def unit(w):
+            v = W[self.vocab[w]]
+            return v / (np.linalg.norm(v) + 1e-12)
+
+        v = (sum(unit(w) for w in positive)
+             - (sum(unit(w) for w in neg) if neg else 0.0)) / max(
+            len(positive) + len(neg), 1)
+        sims = W @ v / (np.linalg.norm(W, axis=1)
+                        * (np.linalg.norm(v) + 1e-12) + 1e-12)
         order = np.argsort(-sims)
-        out = [self._ivocab[i] for i in order if self._ivocab[i] != word]
+        query = set(positive) | set(neg)
+        out = [self._ivocab[i] for i in order
+               if self._ivocab[i] not in query]
         return out[:n]

@@ -307,3 +307,48 @@ class TestWordVectorSerializer:
         v = sv.getWordVector("a")
         v *= 100.0  # in-place caller mutation must not corrupt the table
         np.testing.assert_allclose(sv.getWordVector("a"), [1.0, 0.0])
+
+
+class TestAnalogyQuery:
+    """wordsNearest(positive, negative, n) analogy form (reference:
+    WordVectorsImpl.wordsNearest(Collection, Collection, int))."""
+
+    def test_analogy_on_constructed_vectors(self):
+        from deeplearning4j_tpu.nlp import StaticWordVectors
+        # geometry engineered so king - man + woman == queen exactly
+        W = np.asarray([
+            [1.0, 1.0, 0.0],   # king  = royal + male
+            [0.0, 1.0, 0.0],   # man   = male
+            [0.0, 0.0, 1.0],   # woman = female
+            [1.0, 0.0, 1.0],   # queen = royal + female
+            [0.0, 0.0, 0.0],   # filler
+        ], np.float32)
+        W[4] = [0.3, 0.3, 0.3]
+        sv = StaticWordVectors(["king", "man", "woman", "queen", "x"], W)
+        got = sv.wordsNearest(["king", "woman"], 1, negative=["man"])
+        assert got == ["queen"]
+        # single-word form unchanged
+        assert sv.wordsNearest("king", 2)[0] in ("queen", "man", "x")
+        with pytest.raises(KeyError, match="vocabulary"):
+            sv.wordsNearest(["king", "nope"], 1)
+
+    def test_string_positive_with_negative_honored(self):
+        # a plain-string positive must not silently drop `negative`
+        from deeplearning4j_tpu.nlp import StaticWordVectors
+        W = np.asarray([
+            [1.0, 1.0, 0.0],   # king
+            [0.0, 1.0, 0.0],   # man
+            [0.0, 0.0, 1.0],   # woman
+            [1.0, 0.0, 1.0],   # queen
+        ], np.float32)
+        sv = StaticWordVectors(["king", "man", "woman", "queen"], W)
+        got = sv.wordsNearest("king", 2, negative=["man"])
+        assert "man" not in got          # negatives excluded from results
+        assert got[0] == "queen"         # royal direction wins sans male
+
+    def test_single_word_backcompat(self):
+        from deeplearning4j_tpu.nlp import StaticWordVectors
+        W = np.asarray([[1, 0], [0.9, 0.1], [0, 1]], np.float32)
+        sv = StaticWordVectors(["a", "b", "c"], W)
+        assert sv.wordsNearest("a", 1) == ["b"]
+        assert "a" not in sv.wordsNearest("a", 3)
