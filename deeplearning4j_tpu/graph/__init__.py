@@ -2,6 +2,6 @@
 org.deeplearning4j.graph: Graph + DeepWalk). Walk generation is host
 side; embedding training reuses the jitted SGNS step from nlp/."""
 
-from deeplearning4j_tpu.graph.deepwalk import Graph, DeepWalk
+from deeplearning4j_tpu.graph.deepwalk import Graph, GraphLoader, DeepWalk
 
-__all__ = ["Graph", "DeepWalk"]
+__all__ = ["Graph", "GraphLoader", "DeepWalk"]

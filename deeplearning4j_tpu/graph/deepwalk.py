@@ -18,28 +18,89 @@ from deeplearning4j_tpu.nlp.word2vec import (Word2Vec,
 
 
 class Graph:
-    """Undirected-by-default adjacency graph (reference:
-    org.deeplearning4j.graph.graph.Graph)."""
+    """Undirected-by-default adjacency graph, optionally edge-weighted
+    (reference: org.deeplearning4j.graph.graph.Graph; weighted walks:
+    WeightedWalkIterator)."""
 
     def __init__(self, numVertices: int):
         if int(numVertices) <= 0:
             raise ValueError("numVertices must be positive")
         self._adj = [[] for _ in range(int(numVertices))]
+        self._w = [[] for _ in range(int(numVertices))]
 
     def numVertices(self) -> int:
         return len(self._adj)
 
-    def addEdge(self, a: int, b: int, directed: bool = False):
+    def addEdge(self, a: int, b: int, directed: bool = False,
+                weight: float = 1.0):
         n = self.numVertices()
         if not (0 <= a < n and 0 <= b < n):
             raise ValueError(f"edge ({a},{b}) outside [0,{n})")
+        if not (weight > 0):
+            raise ValueError(f"edge weight must be positive, got {weight}")
         self._adj[a].append(b)
+        self._w[a].append(float(weight))
         if not directed:
             self._adj[b].append(a)
+            self._w[b].append(float(weight))
         return self
 
     def getConnectedVertices(self, v: int):
         return list(self._adj[v])
+
+    def getEdgeWeights(self, v: int):
+        return list(self._w[v])
+
+
+class GraphLoader:
+    """Edge-list file loaders (reference:
+    org.deeplearning4j.graph.data.GraphLoader). Lines are
+    "a<delim>b" or "a<delim>b<delim>weight"; blank lines and
+    '#'-comments are skipped; any whitespace works when `delimiter`
+    is None."""
+
+    @staticmethod
+    def _parse(path, delimiter):
+        edges = []
+        with open(str(path)) as f:
+            for ln, line in enumerate(f, 1):
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                parts = (line.split(delimiter) if delimiter
+                         else line.split())
+                parts = [p for p in (s.strip() for s in parts) if p]
+                if len(parts) not in (2, 3):
+                    raise ValueError(
+                        f"{path}:{ln}: expected 'a b' or 'a b weight', "
+                        f"got {line!r}")
+                a, b = int(parts[0]), int(parts[1])
+                w = float(parts[2]) if len(parts) == 3 else 1.0
+                edges.append((a, b, w))
+        if not edges:
+            raise ValueError(f"{path}: no edges")
+        return edges
+
+    @staticmethod
+    def loadUndirectedGraphEdgeListFile(path, numVertices=None,
+                                        delimiter=None):
+        return GraphLoader._build(path, numVertices, delimiter,
+                                  directed=False)
+
+    @staticmethod
+    def loadWeightedEdgeListFile(path, numVertices=None, delimiter=None,
+                                 directed=False):
+        return GraphLoader._build(path, numVertices, delimiter, directed)
+
+    @staticmethod
+    def _build(path, numVertices, delimiter, directed):
+        edges = GraphLoader._parse(path, delimiter)
+        n = (numVertices if numVertices is not None
+             else max(max(a, b) for a, b, _ in edges) + 1)
+        g = Graph(n)
+        for a, b, w in edges:
+            g.addEdge(a, b, directed=directed, weight=w)
+        return g
 
 
 class _IdentityTokenizer:
@@ -106,6 +167,18 @@ class DeepWalk:
         p, q = self.returnParam, self.inOutParam
         biased = (p != 1.0 or q != 1.0)
         adj_sets = [set(a) for a in graph._adj] if biased else None
+        # edge weights multiply every transition probability (reference:
+        # WeightedWalkIterator; node2vec defines its alpha bias ON TOP
+        # of edge weights)
+        wlists = [np.asarray(w) for w in graph._w]
+        weighted = any(len(w) and (w != w[0]).any() for w in wlists
+                       if len(w))
+        # per-vertex first-order distributions are step-invariant:
+        # normalize once, not per step. Also serves a biased walk's
+        # FIRST step (no prev yet), where unweighted graphs need the
+        # uniform all-ones distribution
+        probs = ([w / w.sum() if len(w) else w for w in wlists]
+                 if (weighted or biased) else None)
         for _ in range(walksPerVertex):
             for start in rng.permutation(n):
                 v = int(start)
@@ -115,16 +188,22 @@ class DeepWalk:
                     nbrs = graph._adj[v]
                     if not nbrs:
                         break  # dead end: truncate like upstream
-                    if not biased or prev is None:
+                    if not biased and not weighted:
                         nxt = int(nbrs[rng.randint(len(nbrs))])
                     else:
                         # node2vec second-order transition: 1/p to return,
                         # 1 to a mutual neighbour of prev, 1/q outward
-                        w = np.array(
-                            [1.0 / p if x == prev
-                             else (1.0 if x in adj_sets[prev] else 1.0 / q)
-                             for x in nbrs])
-                        nxt = int(nbrs[rng.choice(len(nbrs), p=w / w.sum())])
+                        if biased and prev is not None:
+                            alpha = np.array(
+                                [1.0 / p if x == prev
+                                 else (1.0 if x in adj_sets[prev]
+                                       else 1.0 / q)
+                                 for x in nbrs])
+                            w = alpha * wlists[v]
+                            w = w / w.sum()
+                        else:  # first-order: precomputed distribution
+                            w = probs[v]
+                        nxt = int(nbrs[rng.choice(len(nbrs), p=w)])
                     prev, v = v, nxt
                     walk.append(v)
                 walks.append(" ".join(map(str, walk)))

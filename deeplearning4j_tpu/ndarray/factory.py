@@ -271,7 +271,9 @@ class Nd4j:
         Nd4j.averageAndPropagate family). Accepts varargs or one list."""
         if len(arrs) == 1 and isinstance(arrs[0], (list, tuple)):
             arrs = tuple(arrs[0])
-        total = Nd4j.accumulate(*arrs)  # shares the varargs/guard logic
+        if not arrs:
+            raise ValueError("average needs at least one array")
+        total = Nd4j.accumulate(*arrs)  # shares the summation logic
         return INDArray(total.jax() / float(len(arrs)))
 
     # ----- executioner / env (reference: Nd4j.getExecutioner()) -------

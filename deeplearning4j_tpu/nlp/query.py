@@ -54,6 +54,8 @@ class WordVectorQuery:
         W = self._matrix()
         positive = [word] if isinstance(word, str) else list(word)
         neg = list(negative or [])
+        if not positive and not neg:
+            raise ValueError("wordsNearest needs at least one query word")
         missing = [w for w in positive + neg if w not in self.vocab]
         if missing:
             raise KeyError(f"words not in vocabulary: {missing}")

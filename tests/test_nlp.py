@@ -281,3 +281,65 @@ class TestNode2VecBias:
             DeepWalk(returnParam=0.0)
         with pytest.raises(ValueError, match="returnParam"):
             DeepWalk(inOutParam=-1.0)
+
+
+class TestGraphLoaderAndWeights:
+    """GraphLoader edge-list files + weighted walks (reference:
+    org.deeplearning4j.graph.data.GraphLoader, WeightedWalkIterator)."""
+
+    def test_load_edge_list(self, tmp_path):
+        from deeplearning4j_tpu.graph import GraphLoader
+
+        p = tmp_path / "edges.txt"
+        p.write_text("# comment\n0 1\n1 2\n\n2 3\n")
+        g = GraphLoader.loadUndirectedGraphEdgeListFile(p)
+        assert g.numVertices() == 4
+        assert sorted(g.getConnectedVertices(1)) == [0, 2]
+        g2 = GraphLoader.loadUndirectedGraphEdgeListFile(p, numVertices=10)
+        assert g2.numVertices() == 10
+
+    def test_load_weighted_csv(self, tmp_path):
+        from deeplearning4j_tpu.graph import GraphLoader
+
+        p = tmp_path / "w.csv"
+        p.write_text("0,1,2.5\n1,2,0.5\n")
+        g = GraphLoader.loadWeightedEdgeListFile(p, delimiter=",")
+        assert g.getEdgeWeights(0) == [2.5]
+        assert sorted(g.getEdgeWeights(1)) == [0.5, 2.5]
+        d = GraphLoader.loadWeightedEdgeListFile(p, delimiter=",",
+                                                 directed=True)
+        assert d.getConnectedVertices(1) == [2]  # 0->1 not mirrored
+
+    def test_load_errors(self, tmp_path):
+        from deeplearning4j_tpu.graph import GraphLoader
+
+        bad = tmp_path / "bad.txt"
+        bad.write_text("0 1 2 3\n")
+        with pytest.raises(ValueError, match="expected"):
+            GraphLoader.loadUndirectedGraphEdgeListFile(bad)
+        empty = tmp_path / "empty.txt"
+        empty.write_text("# only comments\n")
+        with pytest.raises(ValueError, match="no edges"):
+            GraphLoader.loadUndirectedGraphEdgeListFile(empty)
+
+    def test_weighted_walks_follow_weights(self):
+        from deeplearning4j_tpu.graph import Graph, DeepWalk
+
+        # star: 0 connects to 1 (weight 1000) and 2..5 (weight 1);
+        # first-order transitions from 0 should overwhelmingly pick 1
+        g = Graph(6)
+        g.addEdge(0, 1, weight=1000.0)
+        for v in range(2, 6):
+            g.addEdge(0, v, weight=1.0)
+        dw = DeepWalk.Builder().vectorSize(8).build()
+        rng = np.random.RandomState(0)
+        walks = dw._walks(g, walkLength=2, walksPerVertex=200, rng=rng)
+        from_zero = [w.split()[1] for w in walks if w.split()[0] == "0"]
+        frac_to_1 = sum(1 for t in from_zero if t == "1") / len(from_zero)
+        assert frac_to_1 > 0.95, frac_to_1
+
+    def test_zero_weight_rejected(self):
+        from deeplearning4j_tpu.graph import Graph
+
+        with pytest.raises(ValueError, match="weight"):
+            Graph(2).addEdge(0, 1, weight=0.0)
