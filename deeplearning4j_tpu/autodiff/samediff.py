@@ -244,6 +244,7 @@ class SameDiff:
         self._ops = []         # list[_Op]
         self._producer = {}    # out name -> op index
         self._counter = 0
+        self._scopes = []  # active withNameScope stack
         self._loss_vars = []
         self._tc = None
         self._iteration = 0
@@ -265,15 +266,38 @@ class SameDiff:
         return SameDiff()
 
     # ---------- variable creation ----------
+    def _scoped(self, name):
+        """Apply the active name-scope prefix (reference:
+        SameDiff.withNameScope: names become "scope/name")."""
+        return "/".join(self._scopes + [name]) if self._scopes else name
+
+    def withNameScope(self, scope):
+        """Context manager: variables created inside get "scope/"-prefixed
+        names; scopes nest ("outer/inner/x"). Reference:
+        SameDiff.withNameScope."""
+        sd = self
+
+        class _Scope:
+            def __enter__(self_s):
+                sd._scopes.append(str(scope))
+                return sd
+
+            def __exit__(self_s, *exc):
+                sd._scopes.pop()
+                return False
+
+        return _Scope()
+
     def _name(self, base):
         self._counter += 1
         n = f"{base}_{self._counter}"
-        while n in self._vars:
+        while self._scoped(n) in self._vars:
             self._counter += 1
             n = f"{base}_{self._counter}"
         return n
 
     def _new_var(self, name, vtype):
+        name = self._scoped(name)
         if name in self._vars:
             raise ValueError(f"variable '{name}' already exists")
         v = SDVariable(self, name, vtype)
@@ -289,14 +313,15 @@ class SameDiff:
     def var(self, name, *args, weightInit=None, shape=None, dtype=jnp.float32):
         """sd.var("w", 4, 5) / sd.var("w", init_array) — trainable."""
         v = self._new_var(name, VariableType.VARIABLE)
+        # v.name, not name: _new_var applies the active name scope
         if len(args) == 1 and not isinstance(args[0], (int, np.integer)):
-            self._arrays[name] = _unwrap(args[0])
+            self._arrays[v.name] = _unwrap(args[0])
         else:
             shp = tuple(shape) if shape else tuple(int(a) for a in args)
             scheme = weightInit or _weights.WeightInit.XAVIER
             fan_in = shp[0] if shp else 1
             fan_out = shp[-1] if shp else 1
-            self._arrays[name] = _weights.init(
+            self._arrays[v.name] = _weights.init(
                 _random.getRandom().nextKey(), scheme, shp, fan_in, fan_out,
                 dtype)
         return v
@@ -304,7 +329,7 @@ class SameDiff:
     def constant(self, value, name=None):
         name = name or self._name("const")
         v = self._new_var(name, VariableType.CONSTANT)
-        self._arrays[name] = _unwrap(value)
+        self._arrays[v.name] = _unwrap(value)
         return v
 
     def _lift(self, x):
@@ -336,10 +361,11 @@ class SameDiff:
         outs = []
         for i in range(nOut):
             base = name if name else opName
-            n = base if (name and nOut == 1 and name not in self._vars) \
+            n = base if (name and nOut == 1
+                         and self._scoped(name) not in self._vars) \
                 else self._name(base)
-            outs.append(n)
-            self._new_var(n, VariableType.ARRAY)
+            # the op table must store the SCOPED name _new_var registers
+            outs.append(self._new_var(n, VariableType.ARRAY).name)
         self._ops.append(_Op(opName, in_names, outs, kwargs or {}))
         idx = len(self._ops) - 1
         for n in outs:

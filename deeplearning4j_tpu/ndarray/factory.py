@@ -276,6 +276,69 @@ class Nd4j:
         total = Nd4j.accumulate(*arrs)  # shares the summation logic
         return INDArray(total.jax() / float(len(arrs)))
 
+    # ----- file IO (reference: Nd4j.writeNpy/readNpy, writeTxt/readTxt,
+    # saveBinary/readBinary) -------------------------------------------
+    @staticmethod
+    def writeNpy(arr, path):
+        """Standard .npy file — numpy-ecosystem interop. Writes through
+        an open file object: np.save(str) silently appends ".npy" to
+        extension-less paths, breaking the read-back of the SAME path."""
+        with open(str(path), "wb") as f:
+            np.save(f, np.asarray(_unwrap(arr)), allow_pickle=False)
+
+    @staticmethod
+    def readNpy(path) -> INDArray:
+        return INDArray(jnp.asarray(np.load(str(path),
+                                            allow_pickle=False)))
+
+    @staticmethod
+    def saveBinary(arr, path):
+        """Binary save (reference: Nd4j.saveBinary). The container IS
+        .npy — self-describing shape/dtype, no bespoke format."""
+        Nd4j.writeNpy(arr, path)
+
+    @staticmethod
+    def readBinary(path) -> INDArray:
+        return Nd4j.readNpy(path)
+
+    @staticmethod
+    def writeTxt(arr, path):
+        """Text format: one "# shape: (..) dtype" header line, then the
+        flattened values (reference: Nd4j.writeTxt — upstream's own
+        header-plus-values text form, not numpy savetxt)."""
+        a = np.asarray(_unwrap(arr))
+        with open(str(path), "w", encoding="utf-8") as f:
+            f.write(f"# shape: {','.join(map(str, a.shape))} "
+                    f"dtype: {a.dtype.name}\n")
+            flat = a.reshape(-1)
+            f.write("\n".join(repr(float(v)) if a.dtype.kind == "f"
+                              else str(v) for v in flat))
+            f.write("\n")
+
+    @staticmethod
+    def readTxt(path) -> INDArray:
+        with open(str(path), encoding="utf-8") as f:
+            header = f.readline().strip()
+            if not header.startswith("# shape:"):
+                raise ValueError(
+                    f"{path}: not an Nd4j.writeTxt file (missing header)")
+            body = header[len("# shape:"):].strip()
+            shape_part, _, dtype_part = body.partition("dtype:")
+            shape = tuple(int(s) for s in shape_part.strip().split(",")
+                          if s != "")
+            dtype = np.dtype(dtype_part.strip() or "float32")
+            vals = [ln.strip() for ln in f if ln.strip()]
+        # parse by dtype kind: float('True') raises and float() of big
+        # int64 silently loses precision past 2**53
+        if dtype.kind == "b":
+            py = [v == "True" for v in vals]
+        elif dtype.kind in "iu":
+            py = [int(v) for v in vals]
+        else:
+            py = [float(v) for v in vals]
+        arr = np.asarray(py, dtype).reshape(shape)
+        return INDArray(jnp.asarray(arr))
+
     # ----- executioner / env (reference: Nd4j.getExecutioner()) -------
     @staticmethod
     def getExecutioner():

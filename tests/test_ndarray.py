@@ -367,3 +367,92 @@ class TestAllPairDistances:
         with pytest.raises(ValueError, match="dimensions"):
             Transforms.allCosineSimilarities(np.zeros((2, 2)),
                                              np.zeros((2, 2)), 0)
+
+
+class TestFileIO:
+    """Nd4j.writeNpy/readNpy/writeTxt/readTxt/saveBinary (reference:
+    org.nd4j.linalg.factory.Nd4j file IO)."""
+
+    def test_npy_roundtrip(self, tmp_path):
+        a = Nd4j.randn(3, 4, seed=0)
+        p = tmp_path / "a.npy"
+        Nd4j.writeNpy(a, p)
+        back = Nd4j.readNpy(p)
+        np.testing.assert_array_equal(back.toNumpy(), a.toNumpy())
+        # numpy itself can read it (ecosystem interop)
+        np.testing.assert_array_equal(np.load(p), a.toNumpy())
+
+    def test_binary_roundtrip_extensionless_path(self, tmp_path):
+        # np.save(str) appends ".npy" to extension-less paths; the
+        # file-object write path must round-trip the EXACT path given
+        a = Nd4j.arange(10).reshape(2, 5)
+        p = tmp_path / "model.bin"
+        Nd4j.saveBinary(a, p)
+        assert p.exists() and not (tmp_path / "model.bin.npy").exists()
+        np.testing.assert_array_equal(Nd4j.readBinary(p).toNumpy(),
+                                      a.toNumpy())
+
+    def test_txt_bool_and_int64_roundtrip(self, tmp_path):
+        b = Nd4j.create(np.asarray([[True, False], [False, True]]))
+        p = tmp_path / "b.txt"
+        Nd4j.writeTxt(b, p)
+        back = Nd4j.readTxt(p)
+        np.testing.assert_array_equal(back.toNumpy(), b.toNumpy())
+        assert back.toNumpy().dtype == np.bool_
+        big = Nd4j.create(np.asarray([2**60 + 1, -7]), dtype="int64")
+        q = tmp_path / "i.txt"
+        Nd4j.writeTxt(big, q)
+        np.testing.assert_array_equal(Nd4j.readTxt(q).toNumpy(),
+                                      [2**60 + 1, -7])  # no float detour
+
+    def test_txt_roundtrip_exact(self, tmp_path):
+        a = Nd4j.create([[1.5, -2.25], [3.0, 1e-7]])
+        p = tmp_path / "a.txt"
+        Nd4j.writeTxt(a, p)
+        back = Nd4j.readTxt(p)
+        # repr() round-trips float32 exactly
+        np.testing.assert_array_equal(back.toNumpy(), a.toNumpy())
+        assert back.toNumpy().dtype == np.float32
+        with pytest.raises(ValueError, match="header"):
+            q = tmp_path / "bad.txt"
+            q.write_text("1 2 3\n")
+            Nd4j.readTxt(q)
+
+
+class TestNameScopes:
+    """sd.withNameScope (reference: SameDiff.withNameScope): created
+    variables get scope-prefixed names; scopes nest."""
+
+    def test_scoped_names_and_nesting(self):
+        from deeplearning4j_tpu.autodiff import SameDiff
+
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", np.float32, 2, 3)
+        with sd.withNameScope("enc"):
+            w = sd.var("w", 3, 4)
+            h = sd.nn.relu(sd.math.mul(x, x), name="act")
+            with sd.withNameScope("deep"):
+                c = sd.constant(np.float32(2.0), "two")
+        assert w.name == "enc/w"
+        assert h.name == "enc/act"
+        assert c.name == "enc/deep/two"
+        assert x.name == "x"  # outside any scope
+        # lookups use the full name; graph still executes
+        out = sd.getVariable("enc/act").eval({"x": np.ones((2, 3),
+                                                          np.float32)})
+        np.testing.assert_allclose(np.asarray(out.jax()), 1.0)
+
+    def test_same_leaf_name_in_two_scopes(self):
+        from deeplearning4j_tpu.autodiff import SameDiff
+
+        sd = SameDiff.create()
+        with sd.withNameScope("a"):
+            va = sd.var("w", 2, 2)
+        with sd.withNameScope("b"):
+            vb = sd.var("w", 2, 2)
+        assert va.name == "a/w" and vb.name == "b/w"
+        # grads flow to scoped variables (full SameDiff graphs)
+        y = sd.math.add(sd.math.sum(va), sd.math.sum(vb))
+        y.markAsLoss()
+        g = sd.calculateGradients({}, "a/w")
+        np.testing.assert_allclose(np.asarray(g["a/w"].jax()), 1.0)
