@@ -311,8 +311,13 @@ class Nd4j:
             f.write(f"# shape: {','.join(map(str, a.shape))} "
                     f"dtype: {a.dtype.name}\n")
             flat = a.reshape(-1)
-            f.write("\n".join(repr(float(v)) if a.dtype.kind == "f"
-                              else str(v) for v in flat))
+            if a.dtype.kind == "f":
+                lines = (repr(float(v)) for v in flat)
+            elif a.dtype.kind == "c":
+                lines = (repr(complex(v)) for v in flat)
+            else:
+                lines = (str(v) for v in flat)
+            f.write("\n".join(lines))
             f.write("\n")
 
     @staticmethod
@@ -334,6 +339,8 @@ class Nd4j:
             py = [v == "True" for v in vals]
         elif dtype.kind in "iu":
             py = [int(v) for v in vals]
+        elif dtype.kind == "c":
+            py = [complex(v) for v in vals]
         else:
             py = [float(v) for v in vals]
         arr = np.asarray(py, dtype).reshape(shape)

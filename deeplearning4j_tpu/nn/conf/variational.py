@@ -138,6 +138,53 @@ class VariationalAutoencoder(FeedForwardLayer):
             recon = recon + nll / self.numSamples
         return jnp.mean(recon + kl)
 
+    def reconstructionLogProbability(self, params, x, numSamples=5,
+                                     key=None):
+        """Importance-weighted MC estimate of log p(x) per example
+        (reference: VariationalAutoencoder.reconstructionLogProbability
+        — the upstream anomaly-detection API):
+
+            log p(x) ~= logsumexp_k[log p(x|z_k) + log p(z_k)
+                                    - log q(z_k|x)] - log K,
+            z_k ~ q(z|x).
+
+        Returns [B] log-probabilities (higher = more in-distribution).
+        Pure in (params, x, key) — MultiLayerNetwork
+        .reconstructionLogProbability wraps it in a cached jax.jit."""
+        if key is None:
+            key = jax.random.key(0)
+        x = jnp.asarray(x)
+        mean, logstd = self.encode(params, x)
+        log2pi = jnp.log(2.0 * jnp.pi)
+
+        def one_sample(k):
+            eps = jax.random.normal(jax.random.fold_in(key, k), mean.shape,
+                                    mean.dtype)
+            z = mean + jnp.exp(logstd) * eps
+            log_qzx = -0.5 * jnp.sum(
+                jnp.square(eps) + 2.0 * logstd + log2pi, axis=-1)
+            log_pz = -0.5 * jnp.sum(jnp.square(z) + log2pi, axis=-1)
+            if self.reconstructionDistribution == "gaussian":
+                rmean, rlogstd = self.decode(params, z)
+                log_pxz = -0.5 * jnp.sum(
+                    jnp.square((x - rmean) * jnp.exp(-rlogstd))
+                    + 2.0 * rlogstd + log2pi, axis=-1)
+            else:
+                logits = self.decode(params, z)
+                log_pxz = -jnp.sum(
+                    jnp.maximum(logits, 0) - logits * x
+                    + jnp.log1p(jnp.exp(-jnp.abs(logits))), axis=-1)
+            return log_pxz + log_pz - log_qzx
+
+        lw = jax.vmap(one_sample)(jnp.arange(int(numSamples)))  # [K, B]
+        return jax.scipy.special.logsumexp(lw, axis=0) - jnp.log(
+            float(numSamples))
+
+    def reconstructionProbability(self, params, x, numSamples=5, key=None):
+        """exp of reconstructionLogProbability (reference API pair)."""
+        return jnp.exp(self.reconstructionLogProbability(
+            params, x, numSamples, key))
+
     def reconstruct(self, params, x):
         mean, _ = self.encode(params, x)
         out = self.decode(params, mean)

@@ -455,6 +455,48 @@ class MultiLayerNetwork:
         run_tbptt(self, x.shape[2], self.conf.tbpttFwdLength, jit_call)
 
     # ----- unsupervised layerwise pretraining (VAE etc.) --------------
+    def _frozen_feed(self, layerIdx, x):
+        """The input layers[layerIdx] would receive: frozen inference
+        forward of the preceding layers with every input preprocessor
+        applied — INCLUDING layerIdx's own (shared by pretrainLayer and
+        reconstructionLogProbability)."""
+        h = self._entry(x)
+        states = self._strip_carries(self._states)
+        for j in range(layerIdx + 1):
+            pp = self.conf.preprocessors.get(j)
+            if pp is not None:
+                if hasattr(pp, "batch"):
+                    pp.batch = x.shape[0]
+                h = pp.preProcess(h, None)
+            if j < layerIdx:
+                h, _ = self.layers[j].forward(
+                    self._cast_params(self._params[j]), states[j], h,
+                    False, None, None)
+        return h
+
+    def reconstructionLogProbability(self, data, numSamples=5, layerIdx=0):
+        """Per-example log p(x) estimate from a VariationalAutoencoder
+        layer (reference: the upstream anomaly-detection workflow —
+        net.getLayer(0).reconstructionLogProbability(data, K)). Higher
+        is more in-distribution. The frozen forward of preceding layers
+        + the VAE estimate compile into ONE cached jitted program per
+        (layerIdx, numSamples)."""
+        self._require_init()
+        layer = self.layers[layerIdx]
+        if not hasattr(layer, "reconstructionLogProbability"):
+            raise ValueError(
+                f"Layer {layerIdx} ({type(layer).__name__}) is not a "
+                "VariationalAutoencoder")
+        if not hasattr(self, "_rlp_jit"):
+            self._rlp_jit = {}
+        fn = self._rlp_jit.get((layerIdx, int(numSamples)))
+        if fn is None:
+            fn = jax.jit(lambda ps, x, k: layer.reconstructionLogProbability(
+                self._cast_params(ps[layerIdx]),
+                self._frozen_feed(layerIdx, x), int(numSamples), k))
+            self._rlp_jit[(layerIdx, int(numSamples))] = fn
+        return INDArray(fn(self._params, _unwrap(data), jax.random.key(0)))
+
     def pretrain(self, iterator, epochs=1):
         """Layerwise unsupervised pretraining of every pretrainable layer
         (reference: MultiLayerNetwork.pretrain(DataSetIterator) — upstream
@@ -476,18 +518,7 @@ class MultiLayerNetwork:
                              f"({type(layer).__name__}) is not pretrainable")
 
         def feed(x):
-            h = self._entry(x)
-            states = self._strip_carries(self._states)
-            for j in range(layerIdx):
-                pp = self.conf.preprocessors.get(j)
-                if pp is not None:
-                    if hasattr(pp, "batch"):
-                        pp.batch = x.shape[0]
-                    h = pp.preProcess(h, None)
-                h, _ = self.layers[j].forward(
-                    self._cast_params(self._params[j]), states[j], h,
-                    False, None, None)
-            return h
+            return self._frozen_feed(layerIdx, x)
 
         upd = self._updaters[layerIdx]
 

@@ -658,3 +658,62 @@ class TestModelInterfaceParity:
         assert np.isfinite(score)
         g = np.asarray(grads["h_1"]["W"])
         assert g.shape == (4, 6) and np.abs(g).sum() > 0
+
+
+class TestVAEReconstructionProbability:
+    """reconstructionLogProbability / reconstructionProbability
+    (reference: VariationalAutoencoder's anomaly-detection API,
+    importance-weighted MC estimate of log p(x))."""
+
+    def _pretrained(self):
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           MultiLayerNetwork,
+                                           VariationalAutoencoder,
+                                           OutputLayer, Adam)
+        conf = (NeuralNetConfiguration.Builder().seed(7).updater(Adam(5e-3))
+                .activation("tanh").list()
+                .layer(VariationalAutoencoder(
+                    nOut=2, encoderLayerSizes=(16,),
+                    decoderLayerSizes=(16,)))
+                .layer(OutputLayer(nOut=2, activation="softmax"))
+                .setInputType(InputType.feedForward(6)).build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(1)
+        x = (rng.randn(128, 6) * 0.3 + 1.5).astype("float32")
+        net.pretrainLayer(0, x, epochs=150)
+        return net, x, rng
+
+    def test_in_distribution_scores_higher_than_ood(self):
+        net, x, rng = self._pretrained()
+        lp_in = np.asarray(
+            net.reconstructionLogProbability(x[:32], numSamples=8).jax())
+        ood = (rng.randn(32, 6) * 0.3 - 6.0).astype("float32")
+        lp_out = np.asarray(
+            net.reconstructionLogProbability(ood, numSamples=8).jax())
+        assert lp_in.shape == (32,)
+        assert lp_in.mean() > lp_out.mean() + 10, (
+            lp_in.mean(), lp_out.mean())
+
+    def test_probability_is_exp_of_log(self):
+        import jax
+        net, x, _ = self._pretrained()
+        vae = net.layers[0]
+        lp = vae.reconstructionLogProbability(
+            net._params[0], x[:4], numSamples=3, key=jax.random.key(5))
+        p = vae.reconstructionProbability(
+            net._params[0], x[:4], numSamples=3, key=jax.random.key(5))
+        np.testing.assert_allclose(np.asarray(p), np.exp(np.asarray(lp)),
+                                   rtol=1e-5)
+
+    def test_non_vae_layer_rejected(self):
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           MultiLayerNetwork, DenseLayer,
+                                           OutputLayer, Adam)
+        conf = (NeuralNetConfiguration.Builder().seed(1).updater(Adam(1e-2))
+                .list()
+                .layer(DenseLayer(nOut=4))
+                .layer(OutputLayer(nOut=2, activation="softmax"))
+                .setInputType(InputType.feedForward(3)).build())
+        net = MultiLayerNetwork(conf).init()
+        with pytest.raises(ValueError, match="VariationalAutoencoder"):
+            net.reconstructionLogProbability(np.zeros((1, 3), "float32"))

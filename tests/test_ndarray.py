@@ -456,3 +456,12 @@ class TestNameScopes:
         y.markAsLoss()
         g = sd.calculateGradients({}, "a/w")
         np.testing.assert_allclose(np.asarray(g["a/w"].jax()), 1.0)
+
+
+def test_txt_complex_roundtrip(tmp_path):
+    c = Nd4j.create(np.asarray([1 + 2j, -0.5j], np.complex64))
+    p = tmp_path / "c.txt"
+    Nd4j.writeTxt(c, p)
+    back = Nd4j.readTxt(p)
+    np.testing.assert_allclose(back.toNumpy(), [1 + 2j, -0.5j])
+    assert back.toNumpy().dtype == np.complex64
