@@ -933,6 +933,46 @@ class SameDiff:
             phs[name] = _unwrap(arr)
         return phs
 
+    def evaluate(self, iterator, outputVariable, *evaluations):
+        """Stream a DataSetIterator through the graph and feed any number
+        of IEvaluation instances (reference: SameDiff.evaluate(
+        DataSetIterator, String, IEvaluation...)). Features bind via the
+        TrainingConfig's dataSetFeatureMapping; labels go straight to the
+        evaluations."""
+        if self._tc is None:
+            raise ValueError("setTrainingConfig first (evaluate needs the "
+                             "dataSetFeatureMapping to bind features)")
+        if not evaluations:
+            from deeplearning4j_tpu.evaluation.evaluation import Evaluation
+            evaluations = (Evaluation(),)
+        out_name = (outputVariable.name
+                    if isinstance(outputVariable, SDVariable)
+                    else outputVariable)
+        iterator.reset()
+        while iterator.hasNext():
+            ds = iterator.next()
+            feats = ds.getFeatures()
+            mapping = self._tc.dataSetFeatureMapping
+            if isinstance(feats, (list, tuple)):
+                if len(feats) != len(mapping):
+                    raise ValueError(
+                        f"iterator yields {len(feats)} feature arrays "
+                        f"but dataSetFeatureMapping has {len(mapping)}")
+                phs = {n: _unwrap(f) for n, f in zip(mapping, feats)}
+            elif len(mapping) != 1:
+                raise ValueError(
+                    f"dataSetFeatureMapping has {len(mapping)} names but "
+                    "the iterator yields a single feature array; "
+                    "multi-input graphs need a MultiDataSet-style "
+                    "iterator or explicit output() feeds")
+            else:
+                phs = {mapping[0]: _unwrap(feats)}
+            pred = self.output(phs, [out_name])[out_name]
+            for e in evaluations:
+                e.eval(ds.getLabels(), pred,
+                       mask=ds.getLabelsMaskArray())
+        return evaluations[0] if len(evaluations) == 1 else evaluations
+
     # ---------- serialization ----------
     def save(self, path, saveUpdaterState=False):
         """Graph → JSON, arrays → npz, both in one zip (reference:

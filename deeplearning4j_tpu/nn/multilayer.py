@@ -455,13 +455,18 @@ class MultiLayerNetwork:
         run_tbptt(self, x.shape[2], self.conf.tbpttFwdLength, jit_call)
 
     # ----- unsupervised layerwise pretraining (VAE etc.) --------------
-    def _frozen_feed(self, layerIdx, x):
+    def _frozen_feed(self, layerIdx, x, params=None, states=None):
         """The input layers[layerIdx] would receive: frozen inference
         forward of the preceding layers with every input preprocessor
         applied — INCLUDING layerIdx's own (shared by pretrainLayer and
-        reconstructionLogProbability)."""
+        reconstructionLogProbability). params/states may be passed
+        explicitly so a jitted caller traces them as ARGUMENTS — read
+        through self they would bake in as compile-time constants and
+        go stale after further training."""
+        params = self._params if params is None else params
+        states = (self._strip_carries(self._states) if states is None
+                  else states)
         h = self._entry(x)
-        states = self._strip_carries(self._states)
         for j in range(layerIdx + 1):
             pp = self.conf.preprocessors.get(j)
             if pp is not None:
@@ -470,7 +475,7 @@ class MultiLayerNetwork:
                 h = pp.preProcess(h, None)
             if j < layerIdx:
                 h, _ = self.layers[j].forward(
-                    self._cast_params(self._params[j]), states[j], h,
+                    self._cast_params(params[j]), states[j], h,
                     False, None, None)
         return h
 
@@ -491,11 +496,15 @@ class MultiLayerNetwork:
             self._rlp_jit = {}
         fn = self._rlp_jit.get((layerIdx, int(numSamples)))
         if fn is None:
-            fn = jax.jit(lambda ps, x, k: layer.reconstructionLogProbability(
-                self._cast_params(ps[layerIdx]),
-                self._frozen_feed(layerIdx, x), int(numSamples), k))
+            fn = jax.jit(
+                lambda ps, sts, x, k: layer.reconstructionLogProbability(
+                    self._cast_params(ps[layerIdx]),
+                    self._frozen_feed(layerIdx, x, ps, sts),
+                    int(numSamples), k))
             self._rlp_jit[(layerIdx, int(numSamples))] = fn
-        return INDArray(fn(self._params, _unwrap(data), jax.random.key(0)))
+        return INDArray(fn(self._params,
+                           self._strip_carries(self._states),
+                           _unwrap(data), jax.random.key(0)))
 
     def pretrain(self, iterator, epochs=1):
         """Layerwise unsupervised pretraining of every pretrainable layer

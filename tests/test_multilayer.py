@@ -705,6 +705,32 @@ class TestVAEReconstructionProbability:
         np.testing.assert_allclose(np.asarray(p), np.exp(np.asarray(lp)),
                                    rtol=1e-5)
 
+    def test_scores_track_preceding_layer_training(self):
+        # the cached jit must see CURRENT weights of preceding layers,
+        # not trace-time constants (layerIdx > 0 threads params/states)
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           MultiLayerNetwork, DenseLayer,
+                                           VariationalAutoencoder,
+                                           OutputLayer, Adam)
+        conf = (NeuralNetConfiguration.Builder().seed(3).updater(Adam(1e-2))
+                .activation("tanh").list()
+                .layer(DenseLayer(nOut=5))
+                .layer(VariationalAutoencoder(
+                    nOut=2, encoderLayerSizes=(8,), decoderLayerSizes=(8,)))
+                .layer(OutputLayer(nOut=2, activation="softmax"))
+                .setInputType(InputType.feedForward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(2)
+        x = rng.randn(16, 4).astype("float32")
+        lp0 = np.asarray(net.reconstructionLogProbability(
+            x, numSamples=2, layerIdx=1).jax())
+        # change layer 0's weights directly: scores MUST change
+        net.setParamTable({"0_W": np.asarray(
+            net.getParam("0_W").toNumpy() * 3.0)})
+        lp1 = np.asarray(net.reconstructionLogProbability(
+            x, numSamples=2, layerIdx=1).jax())
+        assert not np.allclose(lp0, lp1), "stale closure over layer-0 params"
+
     def test_non_vae_layer_rejected(self):
         from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
                                            MultiLayerNetwork, DenseLayer,

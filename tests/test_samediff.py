@@ -1038,3 +1038,61 @@ class TestFFTOps:
         sd2 = SameDiff.load(p)
         after = sd2.output({"x": xv}, ["mag"])["mag"].toNumpy()
         np.testing.assert_allclose(after, before, rtol=1e-5)
+
+
+class TestEvaluateAndScopedSerde:
+    """sd.evaluate(iterator, output, IEvaluation...) (reference:
+    SameDiff.evaluate) and scoped-name serialization."""
+
+    def test_evaluate_iterator(self):
+        from deeplearning4j_tpu.autodiff import TrainingConfig
+        from deeplearning4j_tpu.data import DataSetIterator
+        from deeplearning4j_tpu.evaluation import Evaluation
+        from deeplearning4j_tpu.nn import Adam
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 4).astype("float32")
+        w_true = rng.randn(4, 3)
+        yidx = np.argmax(x @ w_true, 1)
+        y = np.eye(3, dtype="float32")[yidx]
+
+        sd = SameDiff.create()
+        xin = sd.placeHolder("x", np.float32, 64, 4)
+        yin = sd.placeHolder("y", np.float32, 64, 3)
+        w = sd.var("w", 4, 3)
+        b = sd.var("b", np.zeros(3, np.float32))
+        logits = sd.nn.linear(xin, w, b, name="logits")
+        loss = sd.loss.softmaxCrossEntropy(yin, logits)
+        loss.markAsLoss()
+        sd.setTrainingConfig(
+            TrainingConfig.Builder().updater(Adam(0.05))
+            .dataSetFeatureMapping("x").dataSetLabelMapping("y").build())
+        it = DataSetIterator(x, y, 64)
+        for _ in range(60):
+            it.reset()
+            sd.fit(list(it))
+        e = sd.evaluate(it, "logits", Evaluation(3))
+        assert e.accuracy() > 0.9, e.accuracy()
+        with pytest.raises(ValueError, match="TrainingConfig"):
+            SameDiff.create().evaluate(it, "z")
+        # multi-input mapping with a single-feature iterator is LOUD,
+        # not silently bound to every placeholder
+        sd.setTrainingConfig(
+            TrainingConfig.Builder().dataSetFeatureMapping("x", "x2")
+            .dataSetLabelMapping("y").build())
+        with pytest.raises(ValueError, match="single feature array"):
+            sd.evaluate(it, "logits")
+
+    def test_scoped_names_survive_serde(self, tmp_path):
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", np.float32, 2, 3)
+        with sd.withNameScope("enc"):
+            w = sd.var("w", 3, 4)
+            out = sd.nn.relu(sd.nn.linear(x, w), name="out")
+        p = str(tmp_path / "scoped.sdz")
+        sd.save(p)
+        sd2 = SameDiff.load(p)
+        xv = np.random.RandomState(1).randn(2, 3).astype("float32")
+        np.testing.assert_array_equal(
+            np.asarray(sd.getVariable("enc/out").eval({"x": xv}).jax()),
+            np.asarray(sd2.getVariable("enc/out").eval({"x": xv}).jax()))
