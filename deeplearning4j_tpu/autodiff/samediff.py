@@ -926,11 +926,26 @@ class SameDiff:
             labs = [b[1]] if not isinstance(b[1], (tuple, list)) else list(b[1])
         else:
             raise TypeError(f"cannot map batch of type {type(b)}")
+        # LOUD on count mismatches: zip would silently truncate, and a
+        # single feature array bound to several placeholder names would
+        # train/evaluate a silently wrong model
+        if len(feats) != len(tc.dataSetFeatureMapping):
+            raise ValueError(
+                f"batch has {len(feats)} feature array(s) but "
+                f"dataSetFeatureMapping names "
+                f"{len(tc.dataSetFeatureMapping)}; for a single feature "
+                "array the mapping must have exactly one name")
+        if labs[0] is not None and tc.dataSetLabelMapping and \
+                len(labs) != len(tc.dataSetLabelMapping):
+            raise ValueError(
+                f"batch has {len(labs)} label array(s) but "
+                f"dataSetLabelMapping names {len(tc.dataSetLabelMapping)}")
         phs = {}
         for name, arr in zip(tc.dataSetFeatureMapping, feats):
             phs[name] = _unwrap(arr)
         for name, arr in zip(tc.dataSetLabelMapping, labs):
-            phs[name] = _unwrap(arr)
+            if arr is not None:
+                phs[name] = _unwrap(arr)
         return phs
 
     def evaluate(self, iterator, outputVariable, *evaluations):
@@ -951,22 +966,7 @@ class SameDiff:
         iterator.reset()
         while iterator.hasNext():
             ds = iterator.next()
-            feats = ds.getFeatures()
-            mapping = self._tc.dataSetFeatureMapping
-            if isinstance(feats, (list, tuple)):
-                if len(feats) != len(mapping):
-                    raise ValueError(
-                        f"iterator yields {len(feats)} feature arrays "
-                        f"but dataSetFeatureMapping has {len(mapping)}")
-                phs = {n: _unwrap(f) for n, f in zip(mapping, feats)}
-            elif len(mapping) != 1:
-                raise ValueError(
-                    f"dataSetFeatureMapping has {len(mapping)} names but "
-                    "the iterator yields a single feature array; "
-                    "multi-input graphs need a MultiDataSet-style "
-                    "iterator or explicit output() feeds")
-            else:
-                phs = {mapping[0]: _unwrap(feats)}
+            phs = self._batch_to_placeholders(ds, self._tc)
             pred = self.output(phs, [out_name])[out_name]
             for e in evaluations:
                 e.eval(ds.getLabels(), pred,

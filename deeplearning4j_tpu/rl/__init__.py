@@ -4,16 +4,20 @@ with frame stacking) and advantage actor-critic. Q-networks are regular
 MultiLayerNetworks whose jitted fit() consumes TD targets; A3C keeps its
 actor-critic pytree on-device with vectorized environments."""
 
-from deeplearning4j_tpu.rl.qlearning import (MDP, QLearningConfiguration,
+from deeplearning4j_tpu.rl.qlearning import (MDP, DQNPolicy,
+                                             QLearningConfiguration,
                                              QLearningDiscreteDense)
-from deeplearning4j_tpu.rl.conv import (HistoryProcessorConfiguration,
+from deeplearning4j_tpu.rl.conv import (HistoryDQNPolicy,
+                                        HistoryProcessorConfiguration,
                                         QLearningDiscreteConv)
-from deeplearning4j_tpu.rl.a3c import A3CConfiguration, A3CDiscreteDense
+from deeplearning4j_tpu.rl.a3c import (ACPolicy, A3CConfiguration,
+                                       A3CDiscreteDense)
 from deeplearning4j_tpu.rl.async_nstep import (
     AsyncNStepQLConfiguration, AsyncNStepQLearningDiscreteDense,
 )
 
-__all__ = ["MDP", "QLearningConfiguration", "QLearningDiscreteDense",
+__all__ = ["MDP", "DQNPolicy", "HistoryDQNPolicy", "ACPolicy",
+           "QLearningConfiguration", "QLearningDiscreteDense",
            "HistoryProcessorConfiguration", "QLearningDiscreteConv",
            "A3CConfiguration", "A3CDiscreteDense",
            "AsyncNStepQLConfiguration", "AsyncNStepQLearningDiscreteDense"]

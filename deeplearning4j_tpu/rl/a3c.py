@@ -173,14 +173,51 @@ class A3CDiscreteDense:
     def getPolicy(self, greedy=True):
         """Reference: policy.ACPolicy (greedy=False samples, matching
         upstream's stochastic ACPolicy with an rng)."""
-        outer = self
+        # live supplier: the policy tracks further train() calls, like
+        # DQNPolicy does through its mutable net reference
+        return ACPolicy(lambda: self.params, greedy=greedy,
+                        seed=self.conf.seed)
 
-        class _Policy(BasePolicy):
-            def nextAction(self, obs):
-                probs = outer._policy_probs(
-                    np.asarray(obs, "float32")[None])[0]
-                if greedy:
-                    return int(np.argmax(probs))
-                return int(outer._rng.choice(outer.numActions, p=probs))
 
-        return _Policy()
+class ACPolicy(BasePolicy):
+    """Actor-critic policy, persistable (reference: rl4j policy.ACPolicy
+    save/load). Holds the actor-critic parameter dict; inference is a
+    host-side numpy forward (single observations — no device round
+    trip), mirroring A3CDiscreteDense's tanh-MLP actor head exactly."""
+
+    def __init__(self, params, greedy=True, seed=0):
+        """`params`: a parameter dict (snapshot — what load() gives), or
+        a zero-arg callable returning one (live view — what
+        getPolicy() gives, so the policy tracks further training)."""
+        self._supplier = params if callable(params) else (lambda: params)
+        self.greedy = bool(greedy)
+        self._rng = np.random.RandomState(seed)
+
+    @property
+    def params(self):
+        return {k: np.asarray(v) for k, v in self._supplier().items()}
+
+    def _probs(self, obs):
+        p = self.params
+        h = np.tanh(obs @ p["W1"] + p["b1"])
+        logits = h @ p["Wp"] + p["bp"]
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+
+    def nextAction(self, obs):
+        probs = self._probs(np.asarray(obs, "float32")[None])[0]
+        if self.greedy:
+            return int(np.argmax(probs))
+        return int(self._rng.choice(len(probs), p=probs))
+
+    def save(self, path):
+        # file object: np.savez(str) appends ".npz" to other extensions
+        with open(str(path), "wb") as f:
+            np.savez(f, **self.params)
+        return self
+
+    @staticmethod
+    def load(path, greedy=True, seed=0):
+        with np.load(str(path)) as z:
+            params = {k: z[k] for k in z.files}
+        return ACPolicy(params, greedy=greedy, seed=seed)

@@ -75,25 +75,44 @@ class QLearningDiscreteConv(QLearningDiscreteDense):
     def getPolicy(self):
         """Greedy policy that carries its own frame ring (reference:
         DQNPolicy over a HistoryProcessor)."""
-        net = self.net
-        hist = self.hp.historyLength
-        frame = self._frame
+        return HistoryDQNPolicy(self.net, self.hp.historyLength)
 
-        class _Policy(BasePolicy):
-            def __init__(self):
-                self._frames = None
 
-            def onEpisodeStart(self):
-                self._frames = None  # play() resets the frame ring
+class HistoryDQNPolicy(BasePolicy):
+    """Greedy conv-DQN policy with its own frame ring, persistable
+    (reference: rl4j DQNPolicy over a HistoryProcessor). save() writes
+    the network; load() needs the historyLength the net was trained
+    with (it is an input-shape property, not a network parameter)."""
 
-            def nextAction(self, obs):
-                f = frame(obs)
-                if self._frames is None:
-                    self._frames = [f] * hist
-                else:
-                    self._frames = self._frames[1:] + [f]
-                stacked = np.concatenate(self._frames, axis=0)
-                q = net.output(stacked[None]).toNumpy()
-                return int(np.argmax(q[0]))
+    def __init__(self, net, historyLength):
+        self.net = net
+        self.historyLength = int(historyLength)
+        self._frames = None
 
-        return _Policy()
+    def onEpisodeStart(self):
+        self._frames = None  # play() resets the frame ring
+
+    def nextAction(self, obs):
+        f = QLearningDiscreteConv._frame(obs)
+        if self._frames is None:
+            self._frames = [f] * self.historyLength
+        else:
+            self._frames = self._frames[1:] + [f]
+        stacked = np.concatenate(self._frames, axis=0)
+        q = self.net.output(stacked[None]).toNumpy()
+        return int(np.argmax(q[0]))
+
+    def save(self, path):
+        from deeplearning4j_tpu.util.serializer import ModelSerializer
+
+        # saveUpdater=False: an inference-only artifact has no use
+        # for optimizer moments (3x the payload with Adam)
+        ModelSerializer.writeModel(self.net, path, False)
+        return self
+
+    @staticmethod
+    def load(path, historyLength):
+        from deeplearning4j_tpu.util.serializer import ModelSerializer
+
+        return HistoryDQNPolicy(ModelSerializer.restore(path),
+                                historyLength)

@@ -178,12 +178,31 @@ class QLearningDiscreteDense:
     def getPolicy(self):
         """Greedy policy over the trained Q-network (reference:
         policy.DQNPolicy)."""
-        net = self.net
+        return DQNPolicy(self.net)
 
-        class _Policy(BasePolicy):
-            def nextAction(self, obs):
-                q = net.output(
-                    np.asarray(obs, "float32")[None]).toNumpy()
-                return int(np.argmax(q[0]))
 
-        return _Policy()
+class DQNPolicy(BasePolicy):
+    """Greedy policy over a trained Q-network, persistable (reference:
+    rl4j policy.DQNPolicy.save/load — upstream serializes the DQN's
+    network; same here via ModelSerializer)."""
+
+    def __init__(self, net):
+        self.net = net
+
+    def nextAction(self, obs):
+        q = self.net.output(np.asarray(obs, "float32")[None]).toNumpy()
+        return int(np.argmax(q[0]))
+
+    def save(self, path):
+        from deeplearning4j_tpu.util.serializer import ModelSerializer
+
+        # saveUpdater=False: an inference-only artifact has no use
+        # for optimizer moments (3x the payload with Adam)
+        ModelSerializer.writeModel(self.net, path, False)
+        return self
+
+    @staticmethod
+    def load(path):
+        from deeplearning4j_tpu.util.serializer import ModelSerializer
+
+        return DQNPolicy(ModelSerializer.restore(path))

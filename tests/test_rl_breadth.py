@@ -183,3 +183,70 @@ class TestAsyncNStepQLearning:
                             - np.asarray(ql.params["Wq"])).max())
         assert diff < 1.0  # moved with training (init target is random-far)
         assert ql._iteration >= ql.conf.targetDqnUpdateFreq
+
+
+class TestPolicyPersistence:
+    """Policy save/load (reference: rl4j DQNPolicy.save/load,
+    ACPolicy.save/load)."""
+
+    def test_dqn_policy_roundtrip(self, tmp_path):
+        from deeplearning4j_tpu.rl import DQNPolicy
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           MultiLayerNetwork, DenseLayer,
+                                           OutputLayer, Adam)
+        conf = (NeuralNetConfiguration.Builder().seed(1).updater(Adam(1e-2))
+                .list()
+                .layer(DenseLayer(nOut=8, activation="relu"))
+                .layer(OutputLayer(nOut=3, activation="identity",
+                                   lossFunction="mse"))
+                .setInputType(InputType.feedForward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        pol = DQNPolicy(net)
+        p = str(tmp_path / "dqn.zip")
+        pol.save(p)
+        back = DQNPolicy.load(p)
+        obs = np.random.RandomState(0).randn(4).astype("float32")
+        assert back.nextAction(obs) == pol.nextAction(obs)
+
+    def test_ac_policy_roundtrip_and_sampling(self, tmp_path):
+        from deeplearning4j_tpu.rl import ACPolicy
+
+        rs = np.random.RandomState(2)
+        params = {"W1": rs.randn(5, 7).astype("float32"),
+                  "b1": np.zeros(7, "float32"),
+                  "Wp": rs.randn(7, 3).astype("float32"),
+                  "bp": np.zeros(3, "float32"),
+                  "Wv": rs.randn(7, 1).astype("float32"),
+                  "bv": np.zeros(1, "float32")}
+        pol = ACPolicy(params)
+        p = str(tmp_path / "ac.bin")  # extension-less-ish path must work
+        pol.save(p)
+        back = ACPolicy.load(p)
+        obs = rs.randn(5).astype("float32")
+        assert back.nextAction(obs) == pol.nextAction(obs)
+        # stochastic form samples from the actor distribution
+        stoch = ACPolicy(params, greedy=False, seed=5)
+        acts = {stoch.nextAction(obs) for _ in range(50)}
+        assert len(acts) >= 2  # not degenerate argmax
+
+    def test_trained_policy_survives_roundtrip(self, tmp_path):
+        # the policy from a trained DQN must keep solving the MDP
+        from deeplearning4j_tpu.rl import (DQNPolicy,
+                                           QLearningConfiguration,
+                                           QLearningDiscreteDense)
+        from test_rl import ChainMDP, _qnet
+
+        mdp = ChainMDP(4)
+        trainer = QLearningDiscreteDense(
+            mdp, _qnet(4, 2),
+            QLearningConfiguration(seed=7, maxEpochStep=20,
+                                   expRepMaxSize=2000, batchSize=32,
+                                   targetDqnUpdateFreq=50,
+                                   epsilonNbStep=800, gamma=0.9))
+        trainer.train(maxSteps=2500)
+        pol = trainer.getPolicy()
+        score = pol.play(mdp, maxSteps=30)
+        p = str(tmp_path / "solved.zip")
+        pol.save(p)
+        back = DQNPolicy.load(p)
+        assert back.play(mdp, maxSteps=30) == score
