@@ -966,7 +966,17 @@ class SameDiff:
         iterator.reset()
         while iterator.hasNext():
             ds = iterator.next()
-            phs = self._batch_to_placeholders(ds, self._tc)
+            # features only: labels go straight to the IEvaluations (a
+            # label-mapping mismatch must not block evaluation)
+            feats = ds.getFeatures()
+            feats = (list(feats) if isinstance(feats, (list, tuple))
+                     else [feats])
+            mapping = self._tc.dataSetFeatureMapping
+            if len(feats) != len(mapping):
+                raise ValueError(
+                    f"batch has {len(feats)} feature array(s) but "
+                    f"dataSetFeatureMapping names {len(mapping)}")
+            phs = {n: _unwrap(f) for n, f in zip(mapping, feats)}
             pred = self.output(phs, [out_name])[out_name]
             for e in evaluations:
                 e.eval(ds.getLabels(), pred,

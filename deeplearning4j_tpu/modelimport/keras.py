@@ -19,6 +19,7 @@ channels_last/time-major layout. Weight layouts happen to agree for Dense
 from __future__ import annotations
 
 import json
+import re
 
 import numpy as np
 
@@ -600,6 +601,77 @@ def _load_h5_weights(path):
     return out
 
 
+def _keras3_group_name(class_name, counters):
+    """Keras-3 weight-group name: to_snake_case(class) + per-class
+    counter in layer order (verified against keras 3.13 saving_lib)."""
+    n = re.sub(r"\W+", "", class_name)
+    n = re.sub("(.)([A-Z][a-z]+)", r"\1_\2", n)
+    n = re.sub("([a-z])([A-Z])", r"\1_\2", n).lower()
+    c = counters.get(class_name, 0)
+    counters[class_name] = c + 1
+    return n if c == 0 else f"{n}_{c}"
+
+
+def _load_keras3_archive(path):
+    """Keras-3 `.keras` zip -> (config dict, {configLayerName: [arrays]}
+    or None). model.weights.h5 stores variables under
+    layers/<snake_case(class)[_k]>/vars/<i> with NO name mapping back to
+    the config — group names are RECOMPUTED from the config's layer
+    order here and looked up BY NAME: h5py iterates groups
+    alphabetically (dense_10 sorts before dense_2), so order-based
+    collection would silently permute weights on models with 11+
+    same-class layers or non-alphabetical class order."""
+    import io
+    import zipfile
+
+    import h5py
+
+    with zipfile.ZipFile(str(path)) as z:
+        cfg = json.loads(z.read("config.json"))
+        if "model.weights.h5" not in z.namelist():
+            return cfg, None
+        blob = io.BytesIO(z.read("model.weights.h5"))
+    layers_cfg = cfg.get("config", {})
+    if isinstance(layers_cfg, dict):
+        layers_cfg = layers_cfg.get("layers", [])
+    counters, wmap = {}, {}
+    with h5py.File(blob, "r") as f:
+        root = f.get("layers")
+        if root is None:
+            return cfg, None
+        for lc in layers_cfg:
+            cls = lc.get("class_name", "")
+            gname = _keras3_group_name(cls, counters)
+            if gname not in root:
+                continue  # var-less layers (Dropout, Flatten, Input)
+            g = root[gname]
+            def subtree_has_data(grp):
+                for k in grp:
+                    item = grp[k]
+                    if isinstance(item, h5py.Group):
+                        if subtree_has_data(item):
+                            return True
+                    else:
+                        return True
+                return False
+
+            if "vars" in g and len(g["vars"]):
+                src = g["vars"]
+            elif "cell" in g and "vars" in g["cell"] and len(g["cell"]["vars"]):
+                src = g["cell"]["vars"]  # recurrent layers nest under cell
+            elif subtree_has_data(g):
+                raise UnsupportedKerasConfigurationException(
+                    f".keras archive layer "
+                    f"'{lc.get('config', {}).get('name')}' stores variables "
+                    "in nested containers (wrapper layers); re-save the "
+                    "weights as a legacy h5 for import")
+            else:
+                continue  # var-less layers (empty vars groups included)
+            lname = lc.get("config", {}).get("name")
+            wmap[lname] = [np.asarray(src[str(i)]) for i in range(len(src))]
+    return cfg, (wmap or None)
+
+
 # ---------------------------------------------------------------------------
 # the importer
 # ---------------------------------------------------------------------------
@@ -612,6 +684,8 @@ class KerasModelImport:
         text = str(source)
         if text.lstrip().startswith("{"):
             return json.loads(text)
+        if text.endswith(".keras"):
+            return _load_keras3_archive(text)[0]
         if text.endswith((".h5", ".hdf5")):
             import h5py
 
@@ -633,6 +707,11 @@ class KerasModelImport:
         """Sequential config (+ optional weights) → MultiLayerNetwork.
         `weights`: legacy-H5 path or {layerName: [arrays...]} dict.
         (reference: KerasModelImport.importKerasSequentialModelAndWeights)"""
+        if (not isinstance(configSource, dict) and weights is None
+                and str(configSource).endswith(".keras")):
+            # one-file Keras-3 archive: config + weights together,
+            # mirroring the upstream single-h5 convention
+            configSource, weights = _load_keras3_archive(configSource)
         cfg = KerasModelImport._parse_config(configSource)
         if cfg.get("class_name") != "Sequential":
             raise InvalidKerasConfigurationException(
@@ -699,7 +778,8 @@ class KerasModelImport:
                 CnnToFeedForwardPreProcessor,
             )
 
-            wmap = weights if isinstance(weights, dict) else _load_h5_weights(weights)
+            wmap = weights if isinstance(weights, dict) \
+                else _load_h5_weights(weights)
             for li, (sp, nl) in enumerate(native_specs):
                 if sp.name in wmap:
                     w = list(wmap[sp.name])
@@ -734,6 +814,9 @@ class KerasModelImport:
             ElementWiseVertex, MergeVertex,
         )
 
+        if (not isinstance(configSource, dict) and weights is None
+                and str(configSource).endswith(".keras")):
+            configSource, weights = _load_keras3_archive(configSource)
         cfg = KerasModelImport._parse_config(configSource)
         if cfg.get("class_name") not in ("Model", "Functional"):
             raise InvalidKerasConfigurationException(
@@ -849,7 +932,8 @@ class KerasModelImport:
                 CnnToFeedForwardPreProcessor,
             )
 
-            wmap = weights if isinstance(weights, dict) else _load_h5_weights(weights)
+            wmap = weights if isinstance(weights, dict) \
+                else _load_h5_weights(weights)
             for lname, nl in native_by_name.items():
                 if lname in wmap:
                     w = list(wmap[lname])

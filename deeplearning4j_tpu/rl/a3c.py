@@ -188,17 +188,24 @@ class ACPolicy(BasePolicy):
     def __init__(self, params, greedy=True, seed=0):
         """`params`: a parameter dict (snapshot — what load() gives), or
         a zero-arg callable returning one (live view — what
-        getPolicy() gives, so the policy tracks further training)."""
+        getPolicy() gives, so the policy tracks further training).
+        The live view is materialized host-side once per EPISODE
+        (onEpisodeStart), not per action — per-step device pulls would
+        cost a full parameter transfer every nextAction."""
         self._supplier = params if callable(params) else (lambda: params)
         self.greedy = bool(greedy)
         self._rng = np.random.RandomState(seed)
+        self._cached = None
 
     @property
     def params(self):
         return {k: np.asarray(v) for k, v in self._supplier().items()}
 
+    def onEpisodeStart(self):
+        self._cached = self.params  # one host snapshot per episode
+
     def _probs(self, obs):
-        p = self.params
+        p = self._cached if self._cached is not None else self.params
         h = np.tanh(obs @ p["W1"] + p["b1"])
         logits = h @ p["Wp"] + p["bp"]
         e = np.exp(logits - logits.max(-1, keepdims=True))

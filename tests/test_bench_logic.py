@@ -25,6 +25,13 @@ def _rec(ips, **extra):
 
 @pytest.fixture
 def stub(monkeypatch):
+    # bench_resnet50's losing maxpool A/B flips the module global
+    # _BACKWARD_IMPL to "stock"; restore it so later tests in this
+    # process keep exercising the default argmax path
+    from deeplearning4j_tpu.ops import pooling as _pooling
+
+    monkeypatch.setattr(_pooling, "_BACKWARD_IMPL",
+                        _pooling._BACKWARD_IMPL)
     calls = []
 
     def fake_measure(stem, remat=False):

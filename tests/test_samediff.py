@@ -1080,7 +1080,7 @@ class TestEvaluateAndScopedSerde:
         sd.setTrainingConfig(
             TrainingConfig.Builder().dataSetFeatureMapping("x", "x2")
             .dataSetLabelMapping("y").build())
-        with pytest.raises(ValueError, match="single feature array"):
+        with pytest.raises(ValueError, match="feature array"):
             sd.evaluate(it, "logits")
 
     def test_scoped_names_survive_serde(self, tmp_path):
