@@ -916,14 +916,20 @@ class SameDiff:
         self._train_state = state
         return history
 
-    def _batch_to_placeholders(self, b, tc):
+    def _batch_to_placeholders(self, b, tc, bind_labels=True):
         from deeplearning4j_tpu.data import DataSet
-        if isinstance(b, DataSet):
-            feats = [b.getFeatures()]
-            labs = [b.getLabels()]
-        elif isinstance(b, (tuple, list)):
+        if isinstance(b, (tuple, list)):
             feats = [b[0]] if not isinstance(b[0], (tuple, list)) else list(b[0])
             labs = [b[1]] if not isinstance(b[1], (tuple, list)) else list(b[1])
+        elif isinstance(b, DataSet) or hasattr(b, "getFeatures"):
+            # DataSet or any DataSet-like (MultiDataSet): features may be
+            # one array or a list of them
+            feats = b.getFeatures()
+            feats = list(feats) if isinstance(feats, (list, tuple)) \
+                else [feats]
+            labs = b.getLabels() if bind_labels else None
+            labs = (list(labs) if isinstance(labs, (list, tuple))
+                    else [labs])
         else:
             raise TypeError(f"cannot map batch of type {type(b)}")
         # LOUD on count mismatches: zip would silently truncate, and a
@@ -935,7 +941,8 @@ class SameDiff:
                 f"dataSetFeatureMapping names "
                 f"{len(tc.dataSetFeatureMapping)}; for a single feature "
                 "array the mapping must have exactly one name")
-        if labs[0] is not None and tc.dataSetLabelMapping and \
+        if bind_labels and labs[0] is not None and \
+                tc.dataSetLabelMapping and \
                 len(labs) != len(tc.dataSetLabelMapping):
             raise ValueError(
                 f"batch has {len(labs)} label array(s) but "
@@ -943,9 +950,10 @@ class SameDiff:
         phs = {}
         for name, arr in zip(tc.dataSetFeatureMapping, feats):
             phs[name] = _unwrap(arr)
-        for name, arr in zip(tc.dataSetLabelMapping, labs):
-            if arr is not None:
-                phs[name] = _unwrap(arr)
+        if bind_labels:
+            for name, arr in zip(tc.dataSetLabelMapping, labs):
+                if arr is not None:
+                    phs[name] = _unwrap(arr)
         return phs
 
     def evaluate(self, iterator, outputVariable, *evaluations):
@@ -966,17 +974,10 @@ class SameDiff:
         iterator.reset()
         while iterator.hasNext():
             ds = iterator.next()
-            # features only: labels go straight to the IEvaluations (a
-            # label-mapping mismatch must not block evaluation)
-            feats = ds.getFeatures()
-            feats = (list(feats) if isinstance(feats, (list, tuple))
-                     else [feats])
-            mapping = self._tc.dataSetFeatureMapping
-            if len(feats) != len(mapping):
-                raise ValueError(
-                    f"batch has {len(feats)} feature array(s) but "
-                    f"dataSetFeatureMapping names {len(mapping)}")
-            phs = {n: _unwrap(f) for n, f in zip(mapping, feats)}
+            # bind_labels=False: labels go straight to the IEvaluations
+            # (a label-mapping mismatch must not block evaluation)
+            phs = self._batch_to_placeholders(ds, self._tc,
+                                              bind_labels=False)
             pred = self.output(phs, [out_name])[out_name]
             for e in evaluations:
                 e.eval(ds.getLabels(), pred,

@@ -612,7 +612,20 @@ def _keras3_group_name(class_name, counters):
     return n if c == 0 else f"{n}_{c}"
 
 
-def _load_keras3_archive(path):
+def _keras3_subtree_has_data(grp):
+    import h5py
+
+    for k in grp:
+        item = grp[k]
+        if isinstance(item, h5py.Group):
+            if _keras3_subtree_has_data(item):
+                return True
+        else:
+            return True
+    return False
+
+
+def _load_keras3_archive(path, config_only=False):
     """Keras-3 `.keras` zip -> (config dict, {configLayerName: [arrays]}
     or None). model.weights.h5 stores variables under
     layers/<snake_case(class)[_k]>/vars/<i> with NO name mapping back to
@@ -628,7 +641,7 @@ def _load_keras3_archive(path):
 
     with zipfile.ZipFile(str(path)) as z:
         cfg = json.loads(z.read("config.json"))
-        if "model.weights.h5" not in z.namelist():
+        if config_only or "model.weights.h5" not in z.namelist():
             return cfg, None
         blob = io.BytesIO(z.read("model.weights.h5"))
     layers_cfg = cfg.get("config", {})
@@ -645,21 +658,11 @@ def _load_keras3_archive(path):
             if gname not in root:
                 continue  # var-less layers (Dropout, Flatten, Input)
             g = root[gname]
-            def subtree_has_data(grp):
-                for k in grp:
-                    item = grp[k]
-                    if isinstance(item, h5py.Group):
-                        if subtree_has_data(item):
-                            return True
-                    else:
-                        return True
-                return False
-
             if "vars" in g and len(g["vars"]):
                 src = g["vars"]
             elif "cell" in g and "vars" in g["cell"] and len(g["cell"]["vars"]):
                 src = g["cell"]["vars"]  # recurrent layers nest under cell
-            elif subtree_has_data(g):
+            elif _keras3_subtree_has_data(g):
                 raise UnsupportedKerasConfigurationException(
                     f".keras archive layer "
                     f"'{lc.get('config', {}).get('name')}' stores variables "
@@ -685,7 +688,7 @@ class KerasModelImport:
         if text.lstrip().startswith("{"):
             return json.loads(text)
         if text.endswith(".keras"):
-            return _load_keras3_archive(text)[0]
+            return _load_keras3_archive(text, config_only=True)[0]
         if text.endswith((".h5", ".hdf5")):
             import h5py
 

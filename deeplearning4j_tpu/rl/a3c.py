@@ -196,16 +196,26 @@ class ACPolicy(BasePolicy):
         self.greedy = bool(greedy)
         self._rng = np.random.RandomState(seed)
         self._cached = None
+        self._cached_src = None
 
     @property
     def params(self):
         return {k: np.asarray(v) for k, v in self._supplier().items()}
 
     def onEpisodeStart(self):
-        self._cached = self.params  # one host snapshot per episode
+        self._materialize()
+
+    def _materialize(self):
+        self._cached_src = self._supplier()
+        self._cached = {k: np.asarray(v)
+                        for k, v in self._cached_src.items()}
 
     def _probs(self, obs):
-        p = self._cached if self._cached is not None else self.params
+        # the trainer REBINDS its params dict every update, so an
+        # identity check detects staleness without any device transfer
+        if self._cached is None or self._supplier() is not self._cached_src:
+            self._materialize()
+        p = self._cached
         h = np.tanh(obs @ p["W1"] + p["b1"])
         logits = h @ p["Wp"] + p["bp"]
         e = np.exp(logits - logits.max(-1, keepdims=True))

@@ -87,12 +87,7 @@ class ZooModel:
         if not os.path.exists(path):
             raise FileNotFoundError(
                 f"initPretrained localFile does not exist: {path}")
-        if path.endswith(".keras"):
-            raise ValueError(
-                "Keras-3 .keras archives are not supported; re-save as "
-                "legacy HDF5 (model.save('weights.h5')) or convert to a "
-                "native checkpoint via zoo.pretrained.convertPretrained")
-        if path.endswith((".h5", ".hdf5")):
+        if path.endswith((".h5", ".hdf5", ".keras")):
             from deeplearning4j_tpu.zoo.pretrained import (
                 loadKerasApplicationsWeights,
             )

@@ -85,11 +85,20 @@ def loadKerasApplicationsWeights(model, net, h5path):
         CnnToFeedForwardPreProcessor,
     )
 
-    wmap = _load_h5_weights(h5path)
+    if str(h5path).endswith(".keras"):
+        # Keras-3 archive: the loader recomputes group names from the
+        # archived config, so the map is keyed by the SAME layer names a
+        # legacy h5 uses (keras.applications names are explicit)
+        from deeplearning4j_tpu.modelimport.keras import _load_keras3_archive
+
+        _, wmap = _load_keras3_archive(h5path)
+    else:
+        wmap = _load_h5_weights(h5path)
     if not wmap:
         raise InvalidKerasConfigurationException(
             f"{h5path} contains no layer weights (expected a legacy-format "
-            "Keras HDF5: model.save('x.h5') or save_weights('x.h5'))")
+            "Keras HDF5 — model.save('x.h5') / save_weights('x.h5') — or a "
+            "Keras-3 .keras archive)")
 
     def keras_weights(kname):
         if kname in wmap:

@@ -116,3 +116,22 @@ class TestVGG16Pretrained:
         net = model.initPretrained(localFile=path)
         ours = np.asarray(net.output(x.transpose(0, 3, 1, 2)).jax())
         np.testing.assert_allclose(ours, golden, rtol=1e-3, atol=1e-5)
+
+
+class TestKeras3ArchivePretrained:
+    def test_resnet50_from_keras_archive(self, tmp_path):
+        # .keras archives carry config layer names (conv1_conv etc.) via
+        # the recomputed-group-name loader, so the SAME name map applies
+        keras.utils.set_random_seed(17)
+        km = keras.applications.ResNet50(weights=None, include_top=True,
+                                         input_shape=(64, 64, 3),
+                                         classes=7)
+        path = str(tmp_path / "resnet50.keras")
+        km.save(path)
+        rng = np.random.RandomState(5)
+        x = rng.rand(2, 64, 64, 3).astype("float32")
+        golden = km.predict(x, verbose=0)
+        model = ResNet50(numClasses=7, inputShape=(3, 64, 64))
+        net = model.initPretrained(localFile=path)
+        ours = np.asarray(net.output(x.transpose(0, 3, 1, 2)).jax())
+        np.testing.assert_allclose(ours, golden, rtol=1e-3, atol=1e-5)
