@@ -22,7 +22,7 @@ from deeplearning4j_tpu.nn.conf.layers import (
     EmbeddingLayer, EmbeddingSequenceLayer,
     Convolution3D, Cropping1D, Cropping3D, Upsampling1D, Upsampling3D,
     SpaceToDepth, SpaceToBatch, LocallyConnected1D, LocallyConnected2D,
-    PReLULayer, CenterLossOutputLayer,
+    PReLULayer, CenterLossOutputLayer, OCNNOutputLayer,
     PrimaryCapsules, CapsuleLayer, CapsuleStrengthLayer,
     SameDiffLayer, SameDiffLambdaLayer,
     Subsampling1DLayer, ZeroPadding1DLayer, RepeatVector,

@@ -1367,6 +1367,85 @@ class CenterLossOutputLayer(BaseOutputLayer):
         return base + center
 
 
+class OCNNOutputLayer(BaseOutputLayer):
+    """One-class neural network output (reference: conf.ocnn.
+    OCNNOutputLayer, Chalapathy et al. 2018 "Anomaly Detection using
+    One-Class Neural Networks"):
+
+        minimize  0.5*||V||^2 + 0.5*||w||^2
+                  + (1/nu) * mean(max(0, r - yhat)) - r,
+        yhat = w . g(V x)
+
+    with r the nu-quantile of the scores under the paper's alternating
+    scheme. The reference recomputes r host-side every `windowSize`
+    iterations; here r is the stop-gradient nu-quantile of the CURRENT
+    batch's scores computed inside the jitted loss — the same
+    alternating optimization with window = batch and no host round
+    trip (`windowSize` is accepted for signature parity).
+
+    One-class training: labels are IGNORED — fit() needs a labels array
+    of shape [B, 1]; pass zeros. output() returns the score yhat; an
+    example is flagged anomalous when its score falls below the
+    nu-quantile of the training scores."""
+
+    def __init__(self, hiddenSize=40, nu=0.04, activation="sigmoid",
+                 initialRValue=0.1, windowSize=10000, **kw):
+        kw.setdefault("lossFunction", "mse")  # unused; computeLoss owns it
+        kw.setdefault("nOut", 1)
+        super().__init__(**kw)
+        if self.nOut != 1:
+            raise ValueError("OCNNOutputLayer emits one score (nOut=1)")
+        self.hiddenSize = int(hiddenSize)
+        self.nu = float(nu)
+        if not (0.0 < self.nu <= 1.0):
+            raise ValueError(f"nu must be in (0, 1], got {nu}")
+        self.activation = activation
+        self.initialRValue = float(initialRValue)
+        self.windowSize = int(windowSize)
+
+    def getOutputType(self, inputType):
+        return InputType.feedForward(1)
+
+    def initialize(self, key, inputType, dtype):
+        self.inferNIn(inputType)
+        kv, kw_ = jax.random.split(key)
+        params = {
+            "V": _winit.init(kv, self.weightInit, (self.nIn, self.hiddenSize),
+                             self.nIn, self.hiddenSize, dtype,
+                             self.distribution),
+            "w": _winit.init(kw_, self.weightInit, (self.hiddenSize, 1),
+                             self.hiddenSize, 1, dtype, self.distribution),
+        }
+        return params, {}
+
+    def preoutput(self, params, x):
+        g = _act.get(self.activation)
+        return g(x @ params["V"]) @ params["w"]  # [B, 1] scores
+
+    def outputFromPreact(self, pre):
+        return pre  # the score IS the output (no squashing)
+
+    def forward(self, params, state, x, train, key, mask=None):
+        x = self._dropout_input(x, train, key)
+        return self.preoutput(params, x), state
+
+    def computeLoss(self, preact, labels, lmask):
+        if lmask is not None:
+            raise ValueError(
+                "OCNNOutputLayer does not support label masks (one-class "
+                "training has no per-example labels to mask)")
+        scores = preact[:, 0]
+        r = jax.lax.stop_gradient(jnp.quantile(scores, self.nu))
+        return jnp.mean(jnp.maximum(0.0, r - scores)) / self.nu - r
+
+    def regularization(self, params):
+        # 0.5||V||^2 + 0.5||w||^2 is PART of the OC-NN objective, on top
+        # of any user l1/l2
+        base = super().regularization(params)
+        return base + 0.5 * (jnp.sum(jnp.square(params["V"]))
+                             + jnp.sum(jnp.square(params["w"])))
+
+
 # ======================================================================
 # Small sequence/utility layers (upstream long tail)
 # ======================================================================

@@ -189,9 +189,10 @@ class ACPolicy(BasePolicy):
         """`params`: a parameter dict (snapshot — what load() gives), or
         a zero-arg callable returning one (live view — what
         getPolicy() gives, so the policy tracks further training).
-        The live view is materialized host-side once per EPISODE
-        (onEpisodeStart), not per action — per-step device pulls would
-        cost a full parameter transfer every nextAction."""
+        The live view is materialized host-side lazily and re-pulled
+        only when the trainer REBINDS its params (identity check in
+        _probs — no device transfer unless training actually
+        happened)."""
         self._supplier = params if callable(params) else (lambda: params)
         self.greedy = bool(greedy)
         self._rng = np.random.RandomState(seed)

@@ -738,3 +738,62 @@ class TestSameDiffCustomLayers:
         tr, _ = l.forward({}, {}, jnp.asarray(x), True, jax.random.key(0))
         tr = np.asarray(tr)
         assert (tr == 0).any() and (tr == 2.0).any()  # masked + rescaled
+
+
+class TestOCNNOutputLayer:
+    """One-class NN head (reference: conf.ocnn.OCNNOutputLayer,
+    Chalapathy et al. 2018): trained on normal data only, its score
+    separates normals from outliers."""
+
+    def _net(self, nu=0.1):
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           MultiLayerNetwork, DenseLayer,
+                                           OCNNOutputLayer, Adam)
+        conf = (NeuralNetConfiguration.Builder().seed(5).updater(Adam(5e-3))
+                .list()
+                .layer(DenseLayer(nOut=8, activation="tanh"))
+                .layer(OCNNOutputLayer(hiddenSize=16, nu=nu,
+                                       activation="sigmoid"))
+                .setInputType(InputType.feedForward(4)).build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_anomaly_separation(self):
+        net = self._net()
+        rng = np.random.RandomState(0)
+        normal = (rng.randn(256, 4) * 0.4 + 1.0).astype("float32")
+        dummy_y = np.zeros((256, 1), "float32")  # one-class: ignored
+        first = None
+        for _ in range(60):
+            net.fit(normal, dummy_y)
+            first = first if first is not None else net.score()
+        assert net.score() < first
+        s_in = np.asarray(net.output(normal[:64]).jax()).ravel()
+        outliers = (rng.randn(64, 4) * 0.4 - 4.0).astype("float32")
+        s_out = np.asarray(net.output(outliers).jax()).ravel()
+        # decision threshold = nu-quantile of training scores
+        r = np.quantile(np.asarray(net.output(normal).jax()).ravel(), 0.1)
+        assert (s_in >= r).mean() > 0.85         # normals mostly above r
+        assert (s_out < r).mean() > 0.95, (      # outliers flagged
+            s_in.mean(), s_out.mean(), r)
+
+    def test_config_validation(self):
+        from deeplearning4j_tpu.nn import OCNNOutputLayer
+
+        with pytest.raises(ValueError, match="nu"):
+            OCNNOutputLayer(nu=0.0)
+        with pytest.raises(ValueError, match="nOut"):
+            OCNNOutputLayer(nOut=3)
+
+    def test_objective_includes_weight_norms(self):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn import OCNNOutputLayer
+        from deeplearning4j_tpu.nn.conf.inputs import InputType as IT
+        import jax
+
+        layer = OCNNOutputLayer(hiddenSize=4, nu=0.5, weightInit="xavier")
+        p, _ = layer.initialize(jax.random.key(0), IT.feedForward(3),
+                                jnp.float32)
+        reg = float(layer.regularization(p))
+        expect = 0.5 * (np.sum(np.square(np.asarray(p["V"])))
+                        + np.sum(np.square(np.asarray(p["w"]))))
+        np.testing.assert_allclose(reg, expect, rtol=1e-6)
