@@ -33,6 +33,11 @@ class LossFunctions:
         KL_DIVERGENCE = "kl_divergence"
         POISSON = "poisson"
         COSINE_PROXIMITY = "cosine_proximity"
+        SPARSE_MCXENT = "sparse_mcxent"
+        MEAN_ABSOLUTE_PERCENTAGE_ERROR = "mape"
+        MEAN_SQUARED_LOGARITHMIC_ERROR = "msle"
+        WASSERSTEIN = "wasserstein"
+        RECONSTRUCTION_CROSSENTROPY = "reconstruction_crossentropy"
 
 
 def _apply_mask_mean(per_elem, mask):
@@ -50,6 +55,10 @@ def _apply_mask_mean(per_elem, mask):
 def compute(loss_name, labels, preact, activation="identity", mask=None, weights=None):
     """Mean loss over the batch (reference: ILossFunction.computeScore)."""
     name = str(loss_name).lower()
+    if name == "reconstruction_crossentropy":
+        # alias: identical math to XENT, and the sigmoid-logits form is
+        # numerically stable where the clipped-log path saturates
+        name = "xent"
     act = _act.get(activation)
 
     if name in ("mcxent", "negativeloglikelihood"):
@@ -60,6 +69,22 @@ def compute(loss_name, labels, preact, activation="identity", mask=None, weights
         per = -labels * logp
         if weights is not None:
             per = per * weights
+        return _apply_mask_mean(per, mask)
+
+    if name == "sparse_mcxent":
+        # labels are CLASS INDICES — [B], [B,1], or [B,T,1] for
+        # recurrent heads (reference: LossSparseMCXENT)
+        idx = labels.astype(jnp.int32)
+        if idx.ndim == preact.ndim and idx.shape[-1] == 1:
+            idx = idx[..., 0]
+        if activation == "softmax":
+            logp = jax.nn.log_softmax(preact, axis=-1)
+        else:
+            logp = jnp.log(jnp.clip(act(preact), 1e-10, 1.0))
+        per = -jnp.take_along_axis(logp, idx[..., None], axis=-1)
+        if weights is not None:
+            # per-CLASS weights gather by each example's own class
+            per = per * jnp.asarray(weights)[idx][..., None]
         return _apply_mask_mean(per, mask)
 
     if name == "xent":
@@ -94,12 +119,28 @@ def compute(loss_name, labels, preact, activation="identity", mask=None, weights
         ln = labels / (jnp.linalg.norm(labels, axis=-1, keepdims=True) + 1e-10)
         on = out / (jnp.linalg.norm(out, axis=-1, keepdims=True) + 1e-10)
         per = -ln * on
+    elif name == "mape":
+        # reference LossMAPE: 100 * |y - yhat| / |y|
+        per = 100.0 * jnp.abs(out - labels) / jnp.clip(jnp.abs(labels),
+                                                       1e-10, None)
+    elif name == "msle":
+        # reference LossMSLE: (log((y+1)/(yhat+1)))^2
+        per = jnp.square(jnp.log1p(labels) - jnp.log1p(out))
+    elif name == "wasserstein":
+        # reference LossWasserstein (WGAN critic): mean(labels * yhat),
+        # labels in {-1, +1} marking real/generated
+        per = labels * out
+    elif name == "reconstruction_crossentropy":
+        # reference LossReconstructionCrossEntropy over activated output
+        p = jnp.clip(out, 1e-10, 1.0 - 1e-10)
+        per = -(labels * jnp.log(p) + (1 - labels) * jnp.log1p(-p))
     else:
         raise ValueError(f"Unknown loss function '{loss_name}'")
 
     if weights is not None:
         per = per * weights
-    if name == "mse":
-        # mean over output dim as well (reference MSE divides by nOut)
+    if name in ("mse", "mape", "msle"):
+        # mean over the output dim as well (reference LossMSE/LossMAPE/
+        # LossMSLE all divide by labels.size(1))
         per = per / per.shape[-1]
     return _apply_mask_mean(per, mask)

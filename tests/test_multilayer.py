@@ -743,3 +743,93 @@ class TestVAEReconstructionProbability:
         net = MultiLayerNetwork(conf).init()
         with pytest.raises(ValueError, match="VariationalAutoencoder"):
             net.reconstructionLogProbability(np.zeros((1, 3), "float32"))
+
+
+class TestLossLongTail:
+    """Upstream LossFunctions long tail (reference: LossSparseMCXENT,
+    LossMAPE, LossMSLE, LossWasserstein, LossReconstructionCrossEntropy)
+    vs handwritten oracles."""
+
+    def test_sparse_mcxent_matches_dense(self):
+        from deeplearning4j_tpu.nn import losses as _losses
+        import jax.numpy as jnp
+
+        rs = np.random.RandomState(0)
+        logits = jnp.asarray(rs.randn(6, 4).astype("float32"))
+        idx = rs.randint(0, 4, 6)
+        dense = _losses.compute("mcxent", jnp.asarray(
+            np.eye(4, dtype="float32")[idx]), logits, "softmax")
+        sparse = _losses.compute("sparse_mcxent",
+                                 jnp.asarray(idx.astype("float32")[:, None]),
+                                 logits, "softmax")
+        np.testing.assert_allclose(float(sparse), float(dense), rtol=1e-6)
+
+    def test_mape_msle_oracles(self):
+        from deeplearning4j_tpu.nn import losses as _losses
+        import jax.numpy as jnp
+
+        y = jnp.asarray([[2.0, 4.0]])
+        yhat = jnp.asarray([[1.0, 5.0]])
+        mape = _losses.compute("mape", y, yhat, "identity")
+        # reference LossMAPE divides by nOut (muli(100/size(1)))
+        np.testing.assert_allclose(
+            float(mape), 100 * (0.5 + 0.25) / 2, rtol=1e-6)
+        msle = _losses.compute("msle", y, yhat, "identity")
+        expect = (np.log(3 / 2) ** 2 + np.log(5 / 6) ** 2) / 2
+        np.testing.assert_allclose(float(msle), expect, rtol=1e-6)
+
+    def test_sparse_mcxent_recurrent_and_weighted(self):
+        from deeplearning4j_tpu.nn import losses as _losses
+        import jax.numpy as jnp
+
+        rs = np.random.RandomState(1)
+        pre = jnp.asarray(rs.randn(2, 4, 3).astype("float32"))  # [B,T,C]
+        idx = rs.randint(0, 3, (2, 4))
+        dense = _losses.compute(
+            "mcxent", jnp.asarray(np.eye(3, dtype="float32")[idx]),
+            pre, "softmax")
+        sparse = _losses.compute(
+            "sparse_mcxent", jnp.asarray(idx[..., None].astype("float32")),
+            pre, "softmax")
+        np.testing.assert_allclose(float(sparse), float(dense), rtol=1e-6)
+        # per-class weights gather by each example's class
+        logits = jnp.asarray(rs.randn(4, 3).astype("float32"))
+        idx2 = np.asarray([0, 1, 2, 1])
+        w = np.asarray([1.0, 2.0, 4.0], "float32")
+        got = _losses.compute("sparse_mcxent",
+                              jnp.asarray(idx2.astype("float32")[:, None]),
+                              logits, "softmax", weights=jnp.asarray(w))
+        logp = np.asarray(jax.nn.log_softmax(np.asarray(logits), -1))
+        expect = np.mean([-logp[i, c] * w[c] for i, c in enumerate(idx2)])
+        np.testing.assert_allclose(float(got), expect, rtol=1e-6)
+
+    def test_wasserstein_critic_sign(self):
+        from deeplearning4j_tpu.nn import losses as _losses
+        import jax.numpy as jnp
+
+        score = jnp.asarray([[3.0], [-1.0]])
+        lbl = jnp.asarray([[1.0], [-1.0]])  # real=+1, generated=-1
+        w = _losses.compute("wasserstein", lbl, score, "identity")
+        np.testing.assert_allclose(float(w), (3.0 + 1.0) / 2, rtol=1e-6)
+
+    def test_reconstruction_xent_trains_autoencoder(self):
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           MultiLayerNetwork, DenseLayer,
+                                           OutputLayer, Adam)
+        conf = (NeuralNetConfiguration.Builder().seed(2).updater(Adam(5e-3))
+                .list()
+                .layer(DenseLayer(nOut=3, activation="tanh"))
+                .layer(OutputLayer(nOut=6, activation="sigmoid",
+                                   lossFunction="reconstruction_crossentropy"))
+                .setInputType(InputType.feedForward(6)).build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(0)
+        # four repeated patterns: compressible through the 3-wide
+        # bottleneck (iid random bits are not)
+        patterns = (rng.rand(4, 6) > 0.5).astype("float32")
+        x = patterns[rng.randint(0, 4, 64)]
+        first = None
+        for _ in range(120):
+            net.fit(x, x)  # autoencode
+            first = first if first is not None else net.score()
+        assert net.score() < 0.5 * first, (first, net.score())
