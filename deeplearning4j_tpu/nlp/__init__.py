@@ -23,6 +23,7 @@ from deeplearning4j_tpu.nlp.cnn_sentence import (
 from deeplearning4j_tpu.nlp.serializer import (
     WordVectorSerializer, StaticWordVectors,
 )
+from deeplearning4j_tpu.nlp.fasttext import FastText
 
 __all__ = ["Word2Vec", "ParagraphVectors", "DefaultTokenizerFactory",
            "CollectionSentenceIterator", "LineSentenceIterator", "Glove",
@@ -32,4 +33,4 @@ __all__ = ["Word2Vec", "ParagraphVectors", "DefaultTokenizerFactory",
            "EndingPreProcessor", "NGramTokenizerFactory",
            "CnnSentenceDataSetIterator",
            "CollectionLabeledSentenceProvider", "UnknownWordHandling",
-           "WordVectorSerializer", "StaticWordVectors"]
+           "WordVectorSerializer", "StaticWordVectors", "FastText"]

@@ -1,0 +1,496 @@
+"""FastText — subword-enriched word vectors and text classification.
+
+Reference: deeplearning4j-nlp org.deeplearning4j.models.fasttext.FastText
+(Builder: supervised/skipgram/minCount/dim/contextWindow/negativeSamples/
+bucket/minNgramLength/maxNgramLength/wordNgrams/epochs/learningRate/
+labelPrefix; API: fit, predict, predictProbability, getWordVector,
+wordsNearest). Upstream wraps the C++ fastText library over JNI; here the
+model IS the framework: subword n-gram extraction and hashing happen
+host-side once, then training is a single jitted step over fixed-shape
+index batches — a [V, D] word table plus a [bucket, D] subword table,
+gathered together through a padded [V, S] subword-id matrix so every
+center word is one mask-weighted mean (XLA: no ragged gathers).
+
+Word representation (fastText convention): the average of the word's own
+vector and all its char-n-gram vectors, with "<"/">" boundary markers.
+OOV words get vectors from their subwords alone — the capability that
+motivates FastText over Word2Vec.
+
+Learning-rate semantics (whole nlp family convention): gradients are
+MINIBATCH MEANS, so the per-example step is learningRate/batch — much
+colder than upstream fastText's per-token SGD at the same nominal rate.
+On small corpora use learningRate≈0.5 (the supervised default here);
+the unsupervised default 0.05 mirrors upstream's but assumes corpora
+large enough for many minibatches per epoch.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nlp.query import WordVectorQuery
+from deeplearning4j_tpu.nlp.word2vec import DefaultTokenizerFactory
+
+
+def _ngrams(word, minn, maxn):
+    """Char n-grams of `<word>` between minn and maxn, fastText-style.
+    The full bracketed word itself is NOT included here (it has its own
+    vocab row)."""
+    w = "<" + word + ">"
+    out = []
+    for n in range(minn, maxn + 1):
+        if n >= len(w):  # also keeps the full bracketed word out: it
+            break        # has its own vocab row, not a subword slot
+        out.extend(w[i:i + n] for i in range(len(w) - n + 1))
+    return out
+
+
+def _fnv1a(s):
+    """FNV-1a 32-bit — fastText's dictionary hash (Dictionary::hash)."""
+    h = 2166136261
+    for b in s.encode("utf-8"):
+        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    return h
+
+
+class FastText(WordVectorQuery):
+    """Builder-constructed FastText model. Two modes:
+
+    - unsupervised (default): skip-gram negative sampling where the
+      center representation is the subword-averaged word vector
+    - supervised: bag-of-features (words + hashed word n-grams) mean
+      pooled into a softmax over labels (``__label__X`` tokens upstream)
+    """
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def supervised(self, flag=True):
+            self._kw["supervised"] = bool(flag)
+            return self
+
+        def skipgram(self, flag=True):
+            if flag:
+                self._kw["supervised"] = False
+            return self
+
+        def minCount(self, n):
+            self._kw["minCount"] = int(n)
+            return self
+
+        def dim(self, n):
+            self._kw["dim"] = int(n)
+            return self
+
+        def contextWindow(self, n):
+            self._kw["contextWindow"] = int(n)
+            return self
+
+        def negativeSamples(self, n):
+            self._kw["negative"] = int(n)
+            return self
+
+        def bucket(self, n):
+            self._kw["bucket"] = int(n)
+            return self
+
+        def minNgramLength(self, n):
+            self._kw["minn"] = int(n)
+            return self
+
+        def maxNgramLength(self, n):
+            self._kw["maxn"] = int(n)
+            return self
+
+        def wordNgrams(self, n):
+            self._kw["wordNgrams"] = int(n)
+            return self
+
+        def epochs(self, n):
+            self._kw["epochs"] = int(n)
+            return self
+
+        def learningRate(self, lr):
+            self._kw["learningRate"] = float(lr)
+            return self
+
+        def labelPrefix(self, p):
+            self._kw["labelPrefix"] = str(p)
+            return self
+
+        def seed(self, s):
+            self._kw["seed"] = int(s)
+            return self
+
+        def batchSize(self, n):
+            self._kw["batchSize"] = int(n)
+            return self
+
+        def iterate(self, sentenceIterator):
+            self._kw["iterator"] = sentenceIterator
+            return self
+
+        def tokenizerFactory(self, tf):
+            self._kw["tokenizer"] = tf
+            return self
+
+        def build(self):
+            return FastText(**self._kw)
+
+    def __init__(self, iterator=None, tokenizer=None, supervised=False,
+                 minCount=1, dim=100, contextWindow=5, negative=5,
+                 bucket=2000, minn=3, maxn=6, wordNgrams=1, epochs=5,
+                 learningRate=None, labelPrefix="__label__", seed=42,
+                 batchSize=1024):
+        self.iterator = iterator
+        self.tokenizer = tokenizer or DefaultTokenizerFactory()
+        self.supervised_mode = supervised
+        self.minCount = minCount
+        self.layerSize = self.dim = dim
+        self.contextWindow = contextWindow
+        self.negative = negative
+        self.bucket = bucket
+        self.minn = minn
+        self.maxn = maxn
+        self.wordNgrams = wordNgrams
+        self.epochs = epochs
+        # mode-dependent default, like fastText's CLI (0.05 skipgram /
+        # hotter for supervised — minibatched softmax SGD takes few
+        # steps per epoch on small corpora, so 0.05 underfits badly)
+        self.learningRate = (0.5 if supervised else 0.05) \
+            if learningRate is None else learningRate
+        self.labelPrefix = labelPrefix
+        self.seed = seed
+        self.batchSize = batchSize
+        self.vocab = {}
+        self._ivocab = []
+        self.labels = []           # supervised: index -> label string
+        self._W = None             # [V, D] effective word vectors (query)
+        self._Win = None           # [V, D] raw word input table
+        self._G = None             # [bucket, D] subword table
+        self._L = None             # supervised: [n_labels, D] + bias
+
+    # ------------- host-side corpus scan -----------------------------
+    def _sub_ids(self, word):
+        return [_fnv1a(g) % self.bucket for g in
+                _ngrams(word, self.minn, self.maxn)]
+
+    def _scan(self):
+        """Tokenize the corpus; split off labels in supervised mode."""
+        counts = Counter()
+        sents, labels = [], []
+        self.iterator.reset()
+        while self.iterator.hasNext():
+            raw = self.iterator.nextSentence()
+            label = None
+            if self.supervised_mode:
+                parts = raw.split()
+                tags = [p for p in parts if p.startswith(self.labelPrefix)]
+                if not tags:
+                    raise ValueError(
+                        f"supervised example has no {self.labelPrefix!r}"
+                        f" token: {raw[:60]!r}")
+                label = tags[0][len(self.labelPrefix):]
+                raw = " ".join(p for p in parts
+                               if not p.startswith(self.labelPrefix))
+            toks = self.tokenizer.create(raw)
+            sents.append(toks)
+            labels.append(label)
+            counts.update(toks)
+        vocab_words = sorted(
+            (w for w, c in counts.items() if c >= self.minCount),
+            key=lambda w: (-counts[w], w))
+        if not vocab_words:
+            raise ValueError(
+                f"empty vocabulary (minCount={self.minCount})")
+        self.vocab = {w: i for i, w in enumerate(vocab_words)}
+        self._ivocab = vocab_words
+        f = np.array([counts[w] for w in vocab_words], "float64") ** 0.75
+        self._freq = (f / f.sum()).astype("float32")
+        self._sents, self._labels_raw = sents, labels
+
+    def _subword_matrix(self):
+        """Padded [V, S] subword-row matrix + [V, S] mask; S = max
+        subword count over the vocab (one jittable gather shape).
+        Cached per vocab: fit() and _bake_vectors both need it, and the
+        host-side n-gram hash scan is O(total chars)."""
+        cached = getattr(self, "_subword_cache", None)
+        if cached is not None and cached[0] is self._ivocab:
+            return cached[1], cached[2]
+        rows = [self._sub_ids(w) for w in self._ivocab]
+        S = max(1, max(len(r) for r in rows))
+        ids = np.zeros((len(rows), S), "int32")
+        mask = np.zeros((len(rows), S), "float32")
+        for i, r in enumerate(rows):
+            ids[i, :len(r)] = r
+            mask[i, :len(r)] = 1.0
+        self._subword_cache = (self._ivocab, ids, mask)
+        return ids, mask
+
+    # ------------- training ------------------------------------------
+    def fit(self):
+        self._scan()
+        if self.supervised_mode:
+            return self._fit_supervised()
+        return self._fit_skipgram()
+
+    def _fit_skipgram(self):
+        centers, contexts = [], []
+        for toks in self._sents:
+            ids = [self.vocab[t] for t in toks if t in self.vocab]
+            for i, c in enumerate(ids):
+                lo = max(0, i - self.contextWindow)
+                hi = min(len(ids), i + self.contextWindow + 1)
+                for j in range(lo, hi):
+                    if j != i:
+                        centers.append(c)
+                        contexts.append(ids[j])
+        if not centers:
+            raise ValueError("no training pairs (sentences too short?)")
+        centers = np.asarray(centers, "int32")
+        contexts = np.asarray(contexts, "int32")
+        V, D, K = len(self.vocab), self.dim, self.negative
+        sub_ids, sub_mask = self._subword_matrix()
+        sub_ids_j = jnp.asarray(sub_ids)
+        sub_mask_j = jnp.asarray(sub_mask)
+        rng = jax.random.key(self.seed)
+        init_k, shuf_k = jax.random.split(rng)
+        kw, kg = jax.random.split(init_k)
+        Win = (jax.random.uniform(kw, (V, D), jnp.float32) - 0.5) / D
+        G = (jax.random.uniform(kg, (self.bucket, D), jnp.float32) - 0.5) / D
+        C = jnp.zeros((V, D), jnp.float32)
+        freq = jnp.asarray(self._freq)
+        lr = self.learningRate
+
+        def rep(Win, G, ctr):
+            # fastText: mean over {word} ∪ subwords
+            sids = sub_ids_j[ctr]                    # [B, S]
+            m = sub_mask_j[ctr]                      # [B, S]
+            tot = Win[ctr] + jnp.sum(G[sids] * m[..., None], 1)
+            return tot / (1.0 + jnp.sum(m, 1, keepdims=True))
+
+        def step(Win, G, C, ctr, ctx, key):
+            neg = jax.random.choice(key, V, (ctr.shape[0], K), p=freq)
+
+            def loss_fn(Win, G, C):
+                h = rep(Win, G, ctr)
+                pos = jnp.sum(h * C[ctx], -1)
+                negs = jnp.einsum("bd,bkd->bk", h, C[neg])
+                return -(jnp.mean(jax.nn.log_sigmoid(pos)) +
+                         jnp.mean(jnp.sum(jax.nn.log_sigmoid(-negs), -1)))
+
+            loss, (gW, gG, gC) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1, 2))(Win, G, C)
+            return Win - lr * gW, G - lr * gG, C - lr * gC, loss
+
+        jstep = jax.jit(step, donate_argnums=(0, 1, 2))
+        n = centers.shape[0]
+        B = min(self.batchSize, n)
+        loss = jnp.float32(0)
+        for epoch in range(self.epochs):
+            perm = np.asarray(jax.random.permutation(
+                jax.random.fold_in(shuf_k, epoch), n))
+            ce, xe = centers[perm], contexts[perm]
+            for s in range(0, n, B):
+                key = jax.random.fold_in(rng, epoch * 100003 + s)
+                Win, G, C, loss = jstep(Win, G, C,
+                                        jnp.asarray(ce[s:s + B]),
+                                        jnp.asarray(xe[s:s + B]), key)
+        self._Win, self._G, self._C = Win, G, C
+        self._score = float(loss)
+        self._bake_vectors()
+        return self
+
+    def _features(self, toks):
+        """Supervised feature ids for one example: vocab word rows, plus
+        word n-grams (n<=wordNgrams) hashed into V + bucket space —
+        fastText's Dictionary::addWordNgrams."""
+        V = len(self.vocab)
+        ids = [self.vocab[t] for t in toks if t in self.vocab]
+        feats = list(ids)
+        for n in range(2, self.wordNgrams + 1):
+            for i in range(len(toks) - n + 1):
+                g = " ".join(toks[i:i + n])
+                feats.append(V + _fnv1a(g) % self.bucket)
+        return feats
+
+    def _fit_supervised(self):
+        label_names = sorted({l for l in self._labels_raw if l is not None})
+        self.labels = label_names
+        lab_idx = {l: i for i, l in enumerate(label_names)}
+        rows, ys = [], []
+        for toks, lab in zip(self._sents, self._labels_raw):
+            feats = self._features(toks)
+            if not feats:
+                continue
+            rows.append(feats)
+            ys.append(lab_idx[lab])
+        if not rows:
+            raise ValueError("no supervised examples with known features")
+        T = max(len(r) for r in rows)
+        N, V, D = len(rows), len(self.vocab), self.dim
+        X = np.zeros((N, T), "int32")
+        M = np.zeros((N, T), "float32")
+        for i, r in enumerate(rows):
+            X[i, :len(r)] = r
+            M[i, :len(r)] = 1.0
+        y = np.asarray(ys, "int32")
+        nlab = len(label_names)
+        rng = jax.random.key(self.seed)
+        init_k, shuf_k = jax.random.split(rng)
+        # one embedding matrix over vocab + hashed-ngram space, the
+        # fastText supervised input layout
+        E = (jax.random.uniform(init_k, (V + self.bucket, D), jnp.float32)
+             - 0.5) / D
+        L = jnp.zeros((nlab, D), jnp.float32)
+        b = jnp.zeros((nlab,), jnp.float32)
+        lr = self.learningRate
+
+        def step(E, L, b, X, M, y):
+            def loss_fn(E, L, b):
+                h = jnp.sum(E[X] * M[..., None], 1) \
+                    / jnp.sum(M, 1, keepdims=True)
+                logits = h @ L.T + b
+                lp = jax.nn.log_softmax(logits)
+                return -jnp.mean(jnp.take_along_axis(
+                    lp, y[:, None], 1)[:, 0])
+
+            loss, (gE, gL, gb) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1, 2))(E, L, b)
+            return E - lr * gE, L - lr * gL, b - lr * gb, loss
+
+        jstep = jax.jit(step, donate_argnums=(0, 1, 2))
+        B = min(self.batchSize, N)
+        loss = jnp.float32(0)
+        for epoch in range(self.epochs):
+            perm = np.asarray(jax.random.permutation(
+                jax.random.fold_in(shuf_k, epoch), N))
+            Xe, Me, ye = X[perm], M[perm], y[perm]
+            for s in range(0, N, B):
+                E, L, b, loss = jstep(E, L, b, jnp.asarray(Xe[s:s + B]),
+                                      jnp.asarray(Me[s:s + B]),
+                                      jnp.asarray(ye[s:s + B]))
+        self._E, self._L, self._b = E, L, b
+        self._score = float(loss)
+        # the vocab slice of E doubles as word vectors for queries
+        self._W = E[:V]
+        return self
+
+    def _bake_vectors(self):
+        """Effective per-word vectors (word row + subword mean) for the
+        shared query mixin — computed ONCE on device, not per lookup."""
+        sub_ids, sub_mask = self._subword_matrix()
+        tot = self._Win + jnp.sum(
+            self._G[jnp.asarray(sub_ids)]
+            * jnp.asarray(sub_mask)[..., None], 1)
+        self._W = tot / (1.0 + jnp.asarray(sub_mask).sum(1, keepdims=True))
+
+    # ------------- queries -------------------------------------------
+    def getWordVector(self, word):
+        """In-vocab: the baked vector. OOV: subword-only mean — the
+        FastText capability Word2Vec lacks."""
+        if word in self.vocab:
+            return super().getWordVector(word)
+        if self._G is None:
+            raise KeyError(
+                f"{word!r} not in vocabulary (supervised models have no "
+                f"subword table for OOV queries)")
+        sids = self._sub_ids(word)
+        if not sids:
+            raise KeyError(f"{word!r} has no char n-grams of length "
+                           f">={self.minn}")
+        G = self._host("_G")  # identity-keyed cache from WordVectorQuery
+        return G[np.asarray(sids, "int64")].mean(0)
+
+    def similarityOOV(self, w1, w2):
+        a, b = self.getWordVector(w1), self.getWordVector(w2)
+        return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
+
+    # ------------- supervised inference ------------------------------
+    def _predict_logits(self, text):
+        if self._L is None:
+            raise RuntimeError("predict() requires a supervised model")
+        toks = self.tokenizer.create(text)
+        feats = self._features(toks)
+        if not feats:
+            raise ValueError("no known features in text")
+        E = self._host("_E")
+        h = E[np.asarray(feats, "int64")].mean(0)
+        return h @ self._host("_L").T + self._host("_b")
+
+    def predict(self, text):
+        return self.labels[int(np.argmax(self._predict_logits(text)))]
+
+    def predictProbability(self, text):
+        z = self._predict_logits(text)
+        p = np.exp(z - z.max())
+        p /= p.sum()
+        i = int(np.argmax(p))
+        return self.labels[i], float(p[i])
+
+    # ------------- serde ---------------------------------------------
+    @staticmethod
+    def _npz(path):
+        p = str(path)
+        return p if p.endswith(".npz") else p + ".npz"
+
+    def save(self, path):
+        if self._W is None:
+            raise RuntimeError("call fit() first")
+        common = dict(
+            words=np.array(self._ivocab, dtype=object),
+            # the tokenizer itself isn't serializable (arbitrary user
+            # code) — record its class so load() can refuse a silent
+            # default-tokenizer substitution
+            tokenizer_class=type(self.tokenizer).__name__,
+            hyper=np.asarray([self.minn, self.maxn, self.bucket,
+                              self.wordNgrams], "int64"))
+        if self.supervised_mode:
+            np.savez(self._npz(path), mode="supervised",
+                     labels=np.array(self.labels, dtype=object),
+                     E=np.asarray(self._E), L=np.asarray(self._L),
+                     b=np.asarray(self._b), **common)
+        else:
+            np.savez(self._npz(path), mode="skipgram",
+                     Win=np.asarray(self._Win), G=np.asarray(self._G),
+                     C=np.asarray(self._C), **common)
+
+    @staticmethod
+    def load(path, tokenizerFactory=None):
+        """Restore a saved model. A model fit with a non-default
+        tokenizer MUST be given the same tokenizerFactory back —
+        predict()/getWordVector would otherwise tokenize differently
+        than training did and silently mis-predict."""
+        z = np.load(FastText._npz(path), allow_pickle=True)
+        saved_tok = str(z["tokenizer_class"]) if "tokenizer_class" \
+            in z.files else "DefaultTokenizerFactory"
+        if tokenizerFactory is None \
+                and saved_tok != "DefaultTokenizerFactory":
+            raise ValueError(
+                f"model was trained with tokenizer {saved_tok}; pass "
+                f"the same tokenizerFactory= to FastText.load")
+        minn, maxn, bucket, wng = (int(x) for x in z["hyper"])
+        m = FastText(minn=minn, maxn=maxn, bucket=bucket, wordNgrams=wng,
+                     tokenizer=tokenizerFactory,
+                     supervised=str(z["mode"]) == "supervised")
+        m._ivocab = [str(w) for w in z["words"]]
+        m.vocab = {w: i for i, w in enumerate(m._ivocab)}
+        if m.supervised_mode:
+            m.labels = [str(l) for l in z["labels"]]
+            m._E = jnp.asarray(z["E"])
+            m._L = jnp.asarray(z["L"])
+            m._b = jnp.asarray(z["b"])
+            m._W = m._E[:len(m._ivocab)]
+        else:
+            m._Win = jnp.asarray(z["Win"])
+            m._G = jnp.asarray(z["G"])
+            m._C = jnp.asarray(z["C"])
+            m._bake_vectors()
+        m.layerSize = m.dim = int(m._W.shape[1])
+        return m

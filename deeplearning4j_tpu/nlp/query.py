@@ -16,17 +16,22 @@ class WordVectorQuery:
     """Mixin over (self.vocab, self._ivocab, self._W). Subclasses may
     override _matrix() to gate access (e.g. require fit())."""
 
+    def _host(self, attr):
+        """Host copy of the device table bound at self.<attr>, cached on
+        the table's identity — np.asarray per lookup would pull the
+        whole table through the device tunnel on every query; a re-fit
+        (which rebinds the attribute) invalidates the cache."""
+        arr = getattr(self, attr)
+        cache = getattr(self, "_host_cache", None)
+        if cache is None:
+            cache = self._host_cache = {}
+        hit = cache.get(attr)
+        if hit is None or hit[0] is not arr:
+            hit = cache[attr] = (arr, np.asarray(arr))
+        return hit[1]
+
     def _matrix(self):
-        # self._W is a DEVICE array on trained models — np.asarray per
-        # lookup would pull the whole [V, D] table through the tunnel on
-        # every getWordVector call. Cache the host copy, keyed on the
-        # table's identity so a re-fit (which rebinds _W) invalidates it.
-        W = self._W
-        cached = getattr(self, "_W_host_cache", None)
-        if cached is None or cached[0] is not W:
-            cached = (W, np.asarray(W))
-            self._W_host_cache = cached
-        return cached[1]
+        return self._host("_W")
 
     def hasWord(self, word):
         return word in self.vocab
