@@ -1,7 +1,7 @@
 """Arbiter — hyperparameter optimization.
 
 Reference: the Arbiter module (org.deeplearning4j.arbiter): ParameterSpace,
-CandidateGenerator (random/grid), ScoreFunction, termination conditions and
+CandidateGenerator (random/grid/genetic), ScoreFunction, termination conditions and
 LocalOptimizationRunner.
 """
 
@@ -14,6 +14,7 @@ from deeplearning4j_tpu.arbiter.spaces import (
 from deeplearning4j_tpu.arbiter.optimize import (
     RandomSearchGenerator,
     GridSearchCandidateGenerator,
+    GeneticSearchCandidateGenerator,
     TestSetLossScoreFunction,
     EvaluationScoreFunction,
     MaxCandidatesCondition,
@@ -27,7 +28,8 @@ from deeplearning4j_tpu.arbiter.optimize import (
 __all__ = [
     "ParameterSpace", "ContinuousParameterSpace", "DiscreteParameterSpace",
     "IntegerParameterSpace", "RandomSearchGenerator",
-    "GridSearchCandidateGenerator", "TestSetLossScoreFunction",
+    "GridSearchCandidateGenerator", "GeneticSearchCandidateGenerator",
+    "TestSetLossScoreFunction",
     "EvaluationScoreFunction", "MaxCandidatesCondition", "MaxTimeCondition",
     "OptimizationConfiguration", "LocalOptimizationRunner",
     "OptimizationResult", "CandidateResult",

@@ -42,6 +42,124 @@ class RandomSearchGenerator:
         return {k: s.sample(self._rng) for k, s in self.spaces.items()}
 
 
+class GeneticSearchCandidateGenerator:
+    """Population-based search (reference: arbiter.optimize.generator.
+    GeneticSearchCandidateGenerator + the genetic package's
+    ChromosomeFactory / GeneticSelectionOperator / crossover + mutation
+    operators). A genome is one unit-interval coordinate per named
+    space, decoded through ParameterSpace.from_unit — crossover and
+    mutation stay space-agnostic.
+
+    Generation 0 is uniform-random. Breeding: tournament selection over
+    every scored individual so far ((mu+lambda)-style — elites persist
+    in the parent pool instead of being re-emitted for re-evaluation,
+    unlike upstream's explicit elitism, which re-scores survivors),
+    uniform crossover, per-gene gaussian mutation. The runner feeds
+    scores back through reportResult(); without feedback it degrades to
+    random search (a loud degradation: breeding raises)."""
+
+    def __init__(self, parameterSpaces: dict, populationSize: int = 20,
+                 crossoverRate: float = 0.85, mutationRate: float = 0.15,
+                 mutationStdev: float = 0.15, tournamentSize: int = 3,
+                 seed: int = 12345):
+        import numpy as np
+
+        for k, v in parameterSpaces.items():
+            if not isinstance(v, ParameterSpace):
+                raise TypeError(f"space '{k}' is not a ParameterSpace")
+        if populationSize < 2:
+            raise ValueError("populationSize must be >= 2")
+        if tournamentSize < 1:
+            raise ValueError("tournamentSize must be >= 1")
+        self.spaces = dict(parameterSpaces)
+        self._names = list(self.spaces)
+        self.populationSize = int(populationSize)
+        self.crossoverRate = float(crossoverRate)
+        self.mutationRate = float(mutationRate)
+        self.mutationStdev = float(mutationStdev)
+        self.tournamentSize = int(tournamentSize)
+        self._rng = np.random.RandomState(seed)
+        self._pending = [self._rng.uniform(size=len(self._names))
+                         for _ in range(self.populationSize)]
+        self._awaiting = []   # emitted genomes, FIFO, waiting on scores
+        self._scored = []     # (genome, fitness) — fitness maximized
+        self.generation = 0
+
+    def hasMore(self) -> bool:
+        return True  # bounded by termination conditions
+
+    def _decode(self, genome) -> dict:
+        return {k: self.spaces[k].from_unit(u)
+                for k, u in zip(self._names, genome)}
+
+    def next(self) -> dict:
+        if not self._pending:
+            self._breed()
+        g = self._pending.pop(0)
+        self._awaiting.append(g)
+        return self._decode(g)
+
+    def reportResult(self, candidate: dict, score: float, minimize: bool):
+        """Fitness feedback from the runner, FIFO-paired with next().
+        Failed candidates arrive as +/-inf and become -inf fitness."""
+        import math as _math
+
+        if not self._awaiting:
+            raise RuntimeError("reportResult without an outstanding "
+                               "candidate (next() not called?)")
+        g = self._awaiting.pop(0)
+        if candidate != self._decode(g):
+            raise ValueError(
+                "reportResult candidate does not match the oldest "
+                "outstanding next() candidate — results must be "
+                "reported in emission order (FIFO)")
+        fit = -score if minimize else score
+        if not _math.isfinite(fit):
+            fit = float("-inf")
+        self._scored.append((g, fit))
+
+    def _breed(self):
+        import numpy as np
+
+        if not self._scored:
+            raise RuntimeError(
+                "GeneticSearchCandidateGenerator needs score feedback to "
+                "breed generation 1+ — run it under a runner that calls "
+                "reportResult (LocalOptimizationRunner does)")
+        rng = self._rng
+        n_genes = len(self._names)
+        # (mu+lambda) truncation: parents come from the best
+        # populationSize individuals EVER scored, not the whole history
+        # — tournament over an ever-growing pool dilutes selection
+        # pressure to nothing by late generations
+        pool = sorted(self._scored, key=lambda gf: gf[1],
+                      reverse=True)[:self.populationSize]
+        # anneal the mutation step: explore early, refine late
+        stdev = self.mutationStdev / (1.0 + 0.3 * self.generation)
+
+        def tournament():
+            idx = rng.randint(0, len(pool),
+                              size=min(self.tournamentSize, len(pool)))
+            best = max(idx, key=lambda i: pool[i][1])
+            return pool[best][0]
+
+        offspring = []
+        while len(offspring) < self.populationSize:
+            a, b = tournament(), tournament()
+            if rng.rand() < self.crossoverRate:
+                pick = rng.rand(n_genes) < 0.5  # uniform crossover
+                child = np.where(pick, a, b).astype(float)
+            else:
+                child = np.array(a, dtype=float)
+            mut = rng.rand(n_genes) < self.mutationRate
+            child = child + mut * rng.normal(0.0, stdev, size=n_genes)
+            # decode clamps to [0,1]; clamp here too so genomes stay in
+            # the unit cube for future crossovers
+            offspring.append(np.clip(child, 0.0, 1.0))
+        self._pending = offspring
+        self.generation += 1
+
+
 class GridSearchCandidateGenerator:
     def __init__(self, parameterSpaces: dict, discretizationCount: int = 3):
         self.spaces = dict(parameterSpaces)
@@ -229,6 +347,11 @@ class LocalOptimizationRunner:
                                       float("inf") if minimize else float("-inf"),
                                       error=e)
             results.append(res)
+            if hasattr(conf.candidateGenerator, "reportResult"):
+                # feedback-driven generators (genetic) learn from every
+                # candidate, including failures (scored +/-inf above)
+                conf.candidateGenerator.reportResult(
+                    candidate, res.score, minimize)
             if res.error is None and (
                     best is None or
                     (res.score < best.score if minimize else res.score > best.score)):

@@ -20,6 +20,20 @@ class ParameterSpace:
         """n representative values for grid search."""
         raise NotImplementedError
 
+    def from_unit(self, u: float):
+        """Decode a unit-interval coordinate u to a value; u is clamped
+        to [0, 1] (mutation/crossover arithmetic can overshoot).
+
+        The genetic generator represents every candidate as a genome of
+        unit coordinates (one per space) so crossover/mutation are
+        space-agnostic; each space owns its decode (reference analog:
+        arbiter's genetic ChromosomeFactory over double[] genes)."""
+        raise NotImplementedError
+
+    @staticmethod
+    def _clamp_unit(u):
+        return min(max(float(u), 0.0), 1.0)
+
 
 class ContinuousParameterSpace(ParameterSpace):
     """Uniform (or log-uniform) float range."""
@@ -45,6 +59,14 @@ class ContinuousParameterSpace(ParameterSpace):
             return [float(v) for v in np.geomspace(self.min, self.max, n)]
         return [float(v) for v in np.linspace(self.min, self.max, n)]
 
+    def from_unit(self, u):
+        u = self._clamp_unit(u)
+        if self.log:
+            return float(math.exp(math.log(self.min)
+                                  + u * (math.log(self.max)
+                                         - math.log(self.min))))
+        return float(self.min + u * (self.max - self.min))
+
 
 class DiscreteParameterSpace(ParameterSpace):
     def __init__(self, *values):
@@ -57,6 +79,12 @@ class DiscreteParameterSpace(ParameterSpace):
 
     def grid(self, n):
         return list(self.values)
+
+    def from_unit(self, u):
+        # u == 1.0 maps to the last value, not one past it
+        u = self._clamp_unit(u)
+        return self.values[min(int(u * len(self.values)),
+                               len(self.values) - 1)]
 
 
 class IntegerParameterSpace(ParameterSpace):
@@ -73,3 +101,8 @@ class IntegerParameterSpace(ParameterSpace):
         if n >= self.max - self.min + 1:
             return list(range(self.min, self.max + 1))
         return [int(round(v)) for v in np.linspace(self.min, self.max, n)]
+
+    def from_unit(self, u):
+        u = self._clamp_unit(u)
+        span = self.max - self.min + 1
+        return int(self.min + min(int(u * span), span - 1))

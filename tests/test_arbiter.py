@@ -144,3 +144,129 @@ class TestRunner:
                 .build())
         with pytest.raises(RuntimeError):
             LocalOptimizationRunner(conf, _builder, _data(seed=0)).execute()
+
+
+class TestFromUnit:
+    def test_continuous_endpoints_and_clamp(self):
+        s = ContinuousParameterSpace(0.1, 0.5)
+        assert s.from_unit(0.0) == pytest.approx(0.1)
+        assert s.from_unit(1.0) == pytest.approx(0.5)
+        assert s.from_unit(-0.3) == pytest.approx(0.1)   # clamped
+        assert s.from_unit(1.7) == pytest.approx(0.5)
+
+    def test_continuous_log(self):
+        s = ContinuousParameterSpace(1e-4, 1e-1, log=True)
+        assert s.from_unit(0.0) == pytest.approx(1e-4)
+        assert s.from_unit(1.0) == pytest.approx(1e-1)
+        # midpoint on the LOG scale is the geometric mean
+        assert s.from_unit(0.5) == pytest.approx(np.sqrt(1e-4 * 1e-1))
+
+    def test_discrete(self):
+        s = DiscreteParameterSpace("a", "b", "c")
+        assert s.from_unit(0.0) == "a"
+        assert s.from_unit(0.5) == "b"
+        assert s.from_unit(1.0) == "c"      # not one past the end
+        assert s.from_unit(-2.0) == "a"     # clamped, NOT values[-1]
+
+    def test_integer(self):
+        s = IntegerParameterSpace(2, 5)
+        assert s.from_unit(0.0) == 2
+        assert s.from_unit(1.0) == 5
+        assert s.from_unit(-0.4) == 2       # clamped, stays in range
+        assert all(s.from_unit(u) in (2, 3, 4, 5)
+                   for u in np.linspace(0, 1, 50))
+
+
+class _FakeModel:
+    """Carries the candidate through the runner's fit/score protocol
+    so generator tests don't pay a network compile per candidate."""
+
+    def __init__(self, candidate):
+        self.candidate = candidate
+
+    def fit(self, data, epochs=1):
+        pass
+
+
+class _SphereScore:
+    """score = sum_i (x_i - target_i)^2, minimized at the target."""
+
+    def __init__(self, targets):
+        self.targets = targets
+
+    def minimize(self):
+        return True
+
+    def score(self, model):
+        return float(sum((model.candidate[k] - t) ** 2
+                         for k, t in self.targets.items()))
+
+
+class TestGeneticSearch:
+    SPACES = {
+        "a": ContinuousParameterSpace(0.0, 1.0),
+        "b": ContinuousParameterSpace(0.0, 1.0),
+        "c": ContinuousParameterSpace(0.0, 1.0),
+        "d": ContinuousParameterSpace(0.0, 1.0),
+    }
+    TARGETS = {"a": 0.31, "b": 0.77, "c": 0.12, "d": 0.58}
+
+    def _run(self, gen, budget=120):
+        from deeplearning4j_tpu.arbiter import GeneticSearchCandidateGenerator  # noqa: F401
+        conf = (OptimizationConfiguration.Builder()
+                .candidateGenerator(gen)
+                .scoreFunction(_SphereScore(self.TARGETS))
+                .terminationConditions(MaxCandidatesCondition(budget))
+                .build())
+        return LocalOptimizationRunner(conf, _FakeModel, None).execute()
+
+    def test_beats_random_on_sphere(self):
+        from deeplearning4j_tpu.arbiter import GeneticSearchCandidateGenerator
+        gen = GeneticSearchCandidateGenerator(self.SPACES, populationSize=15,
+                                              seed=11)
+        rnd = RandomSearchGenerator(self.SPACES, seed=11)
+        g_best = self._run(gen).bestScore()
+        r_best = self._run(rnd).bestScore()
+        assert g_best < r_best, (g_best, r_best)
+        assert g_best < 0.01, g_best  # actually converges to the target
+
+    def test_generations_advance_and_improve(self):
+        from deeplearning4j_tpu.arbiter import GeneticSearchCandidateGenerator
+        gen = GeneticSearchCandidateGenerator(self.SPACES, populationSize=10,
+                                              seed=3)
+        res = self._run(gen, budget=80)
+        assert gen.generation >= 7
+        # mean score of the last generation beats generation 0's mean:
+        # selection pressure is actually doing something
+        scores = [r.score for r in res.results]
+        assert np.mean(scores[-10:]) < np.mean(scores[:10])
+
+    def test_breeding_without_feedback_raises(self):
+        from deeplearning4j_tpu.arbiter import GeneticSearchCandidateGenerator
+        gen = GeneticSearchCandidateGenerator(self.SPACES, populationSize=2,
+                                              seed=0)
+        gen.next()
+        gen.next()  # generation 0 exhausted, no reportResult calls
+        with pytest.raises(RuntimeError, match="reportResult"):
+            gen.next()
+
+    def test_failed_candidates_get_worst_fitness(self):
+        from deeplearning4j_tpu.arbiter import GeneticSearchCandidateGenerator
+        gen = GeneticSearchCandidateGenerator(self.SPACES, populationSize=4,
+                                              seed=0)
+        c = gen.next()
+        gen.reportResult(c, float("inf"), True)  # runner's failure score
+        assert gen._scored[-1][1] == float("-inf")
+
+    def test_mixed_space_types_decode(self):
+        from deeplearning4j_tpu.arbiter import GeneticSearchCandidateGenerator
+        spaces = {"lr": ContinuousParameterSpace(1e-4, 1e-1, log=True),
+                  "act": DiscreteParameterSpace("relu", "tanh"),
+                  "hidden": IntegerParameterSpace(4, 16)}
+        gen = GeneticSearchCandidateGenerator(spaces, populationSize=4, seed=1)
+        for _ in range(12):
+            c = gen.next()
+            gen.reportResult(c, 1.0, True)
+            assert 1e-4 <= c["lr"] <= 1e-1
+            assert c["act"] in ("relu", "tanh")
+            assert 4 <= c["hidden"] <= 16
