@@ -186,3 +186,126 @@ class ExistingDataSetIterator(DataSetIterator):
         super().__init__(dataset.getFeatures().toNumpy(),
                          dataset.getLabels().toNumpy(),
                          batchSize or dataset.numExamples())
+
+
+class KFoldIterator:
+    """K-fold cross-validation splits over one DataSet (reference:
+    org.deeplearning4j.datasets.iterator.KFoldIterator): next() yields
+    the k-th TRAINING fold as a DataSet; testFold() returns the held-out
+    fold for the split most recently emitted. Fold sizes follow the
+    reference: the first N % k folds get one extra example."""
+
+    def __init__(self, k: int, dataset: DataSet):
+        if k < 2:
+            raise ValueError("k must be >= 2")
+        n = dataset.numExamples()
+        if k > n:
+            raise ValueError(f"k={k} exceeds the {n} examples")
+        self.k = int(k)
+        self._f = dataset.getFeatures().toNumpy()
+        self._l = dataset.getLabels().toNumpy()
+        base, extra = divmod(n, self.k)
+        sizes = [base + (1 if i < extra else 0) for i in range(self.k)]
+        bounds = np.cumsum([0] + sizes)
+        self._folds = [(int(bounds[i]), int(bounds[i + 1]))
+                       for i in range(self.k)]
+        self.reset()
+
+    def reset(self):
+        self._i = 0
+        # a stale held-out fold from a previous pass must not satisfy
+        # testFold()'s call-next()-first contract
+        if hasattr(self, "_test"):
+            del self._test
+
+    def hasNext(self) -> bool:
+        return self._i < self.k
+
+    def next(self) -> DataSet:
+        if not self.hasNext():
+            raise StopIteration
+        lo, hi = self._folds[self._i]
+        self._test = DataSet(self._f[lo:hi], self._l[lo:hi])
+        train_f = np.concatenate([self._f[:lo], self._f[hi:]])
+        train_l = np.concatenate([self._l[:lo], self._l[hi:]])
+        self._i += 1
+        return DataSet(train_f, train_l)
+
+    def testFold(self) -> DataSet:
+        if not hasattr(self, "_test"):
+            raise RuntimeError("call next() first")
+        return self._test
+
+    def __iter__(self):
+        self.reset()
+        while self.hasNext():
+            yield self.next()
+
+
+class MultipleEpochsIterator:
+    """Replays an underlying iterator numEpochs times as one epoch
+    (reference: org.deeplearning4j.datasets.iterator
+    .MultipleEpochsIterator) — lets fit(iterator) run multi-epoch
+    training without a fit(..., epochs=) argument."""
+
+    def __init__(self, numEpochs: int, underlying):
+        if numEpochs < 1:
+            raise ValueError("numEpochs must be >= 1")
+        self.numEpochs = int(numEpochs)
+        self._it = underlying
+        self.reset()
+
+    def reset(self):
+        self._epoch = 0
+        self._it.reset()
+
+    def hasNext(self) -> bool:
+        if self._it.hasNext():
+            return True
+        return self._epoch + 1 < self.numEpochs
+
+    def next(self, num=None) -> DataSet:
+        if not self._it.hasNext():
+            if self._epoch + 1 >= self.numEpochs:
+                raise StopIteration
+            self._epoch += 1
+            self._it.reset()
+        return self._it.next(num) if num is not None else self._it.next()
+
+    def __iter__(self):
+        self.reset()
+        while self.hasNext():
+            yield self.next()
+
+    def batch(self):
+        return self._it.batch()
+
+    def totalExamples(self):
+        return self._it.totalExamples() * self.numEpochs
+
+    def inputColumns(self):
+        return self._it.inputColumns()
+
+    def totalOutcomes(self):
+        return self._it.totalOutcomes()
+
+    def setPreProcessor(self, pp):
+        self._it.setPreProcessor(pp)
+
+    def getPreProcessor(self):
+        return self._it.getPreProcessor()
+
+    def _raw_batches(self):
+        # normalizer statistics fitting: one UNPADDED pass over the
+        # underlying data — replaying epochs or seeing pad rows would
+        # bias the stats (see DataSetIterator._raw_batches)
+        return self._it._raw_batches()
+
+
+class ViewIterator(ExistingDataSetIterator):
+    """Batched view over one DataSet (reference:
+    org.deeplearning4j.datasets.iterator.impl.ViewIterator). Same
+    unwrapping as ExistingDataSetIterator, but batchSize is required."""
+
+    def __init__(self, dataset: DataSet, batchSize: int):
+        super().__init__(dataset, int(batchSize))

@@ -4,7 +4,8 @@ Reference: deeplearning4j-nn (org.deeplearning4j.nn.*).
 """
 
 from deeplearning4j_tpu.nn.activations import Activation
-from deeplearning4j_tpu.nn.weights import WeightInit, NormalDistribution, UniformDistribution
+from deeplearning4j_tpu.nn.weights import (
+    WeightInit, NormalDistribution, UniformDistribution, WeightInitEmbedding)
 from deeplearning4j_tpu.nn.losses import LossFunctions
 from deeplearning4j_tpu.nn import updaters
 from deeplearning4j_tpu.nn.updaters import (

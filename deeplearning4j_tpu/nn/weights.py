@@ -40,6 +40,10 @@ def init(key, scheme, shape, fan_in, fan_out, dtype=jnp.float32, distribution=No
 
 
 def _init(key, scheme, shape, fan_in, fan_out, dtype, distribution):
+    if isinstance(scheme, WeightInitEmbedding):
+        # pretrained table; shape validation inside (only embedding
+        # layers pass a matching [nIn, nOut])
+        return scheme.table(shape, dtype)
     s = scheme if isinstance(scheme, str) else getattr(scheme, "value", str(scheme))
     s = s.lower()
     if s == "zero":
@@ -106,3 +110,38 @@ class UniformDistribution:
 
     def sample(self, key, shape, dtype):
         return jax.random.uniform(key, shape, dtype, self.lower, self.upper)
+
+
+class WeightInitEmbedding:
+    """Seed an embedding table from pretrained vectors (reference:
+    org.deeplearning4j.nn.weights.embeddings.WeightInitEmbedding over an
+    EmbeddingInitializer — ArrayEmbeddingInitializer for raw arrays,
+    deeplearning4j-nlp's WordVectorsEmbeddingInitializer for WordVectors
+    models). Pass either a [nIn, nOut] array or any word-vector model
+    from the nlp package (Word2Vec / StaticWordVectors / FastText —
+    anything with vocab + getWordVector); rows follow the model's vocab
+    index order, the same order EmbeddingSequenceLayer inputs use when
+    tokenized against that model's vocab."""
+
+    def __init__(self, source):
+        self.source = source
+
+    def table(self, shape, dtype):
+        import numpy as np
+
+        src = self.source
+        if hasattr(src, "vocab") and hasattr(src, "getWordVector"):
+            words = getattr(src, "_ivocab", None) \
+                or sorted(src.vocab, key=src.vocab.get)
+            arr = np.stack([np.asarray(src.getWordVector(w))
+                            for w in words])
+        else:
+            arr = np.asarray(src)
+        if arr.ndim != 2 or tuple(arr.shape) != tuple(shape):
+            raise ValueError(
+                f"pretrained embedding shape {tuple(arr.shape)} does not "
+                f"match the layer's (nIn, nOut) {tuple(shape)} — set "
+                f"nIn={arr.shape[0] if arr.ndim == 2 else '?'}, "
+                f"nOut={arr.shape[1] if arr.ndim == 2 else '?'} on the "
+                f"embedding layer")
+        return jnp.asarray(arr, dtype)

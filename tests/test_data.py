@@ -715,3 +715,114 @@ class TestDatasetIteratorVariants:
                                      ).next().getLabels().shape() == (8, 47)
         with pytest.raises(ValueError, match="unknown EMNIST"):
             EmnistDataSetIterator("bogus", 8)
+
+
+class TestUtilityIterators:
+    """KFoldIterator / MultipleEpochsIterator / ViewIterator (reference:
+    org.deeplearning4j.datasets.iterator KFoldIterator,
+    MultipleEpochsIterator, impl.ViewIterator)."""
+
+    def _ds(self, n=10):
+        f = np.arange(n * 3, dtype="float32").reshape(n, 3)
+        l = np.eye(2, dtype="float32")[np.arange(n) % 2]
+        from deeplearning4j_tpu.data import DataSet
+        return DataSet(f, l)
+
+    def test_kfold_partition(self):
+        from deeplearning4j_tpu.data import KFoldIterator
+        ds = self._ds(10)
+        it = KFoldIterator(3, ds)   # fold sizes 4,3,3
+        seen_test_rows = []
+        folds = 0
+        while it.hasNext():
+            train = it.next()
+            test = it.testFold()
+            folds += 1
+            assert train.numExamples() + test.numExamples() == 10
+            tr = train.getFeatures().toNumpy()[:, 0]
+            te = test.getFeatures().toNumpy()[:, 0]
+            assert not set(tr) & set(te)  # disjoint
+            seen_test_rows.extend(te.tolist())
+        assert folds == 3
+        # every example held out exactly once across folds
+        assert sorted(seen_test_rows) == [float(3 * i) for i in range(10)]
+
+    def test_kfold_sizes_first_folds_larger(self):
+        from deeplearning4j_tpu.data import KFoldIterator
+        it = KFoldIterator(3, self._ds(10))
+        sizes = [it.next().numExamples() for _ in range(3)]
+        assert sizes == [6, 7, 7]  # tests are 4,3,3
+
+    def test_kfold_validation(self):
+        from deeplearning4j_tpu.data import KFoldIterator
+        with pytest.raises(ValueError, match="k must be"):
+            KFoldIterator(1, self._ds(10))
+        with pytest.raises(ValueError, match="exceeds"):
+            KFoldIterator(20, self._ds(10))
+
+    def test_multiple_epochs_replays(self):
+        from deeplearning4j_tpu.data import (DataSetIterator,
+                                             MultipleEpochsIterator)
+        f = np.arange(8, dtype="float32").reshape(4, 2)
+        l = np.eye(2, dtype="float32")[[0, 1, 0, 1]]
+        it = MultipleEpochsIterator(3, DataSetIterator(f, l, 2))
+        batches = [b for b in it]
+        assert len(batches) == 6  # 2 batches/epoch x 3 epochs
+        assert it.totalExamples() == 12
+        # resets cleanly for a second pass
+        assert len([b for b in it]) == 6
+
+    def test_multiple_epochs_trains_like_epochs_arg(self):
+        from deeplearning4j_tpu.data import (DataSetIterator,
+                                             MultipleEpochsIterator)
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration,
+                                           DenseLayer, OutputLayer,
+                                           MultiLayerNetwork, Adam)
+        rng = np.random.RandomState(0)
+        X = rng.randn(64, 4).astype("float32")
+        Y = np.eye(2, dtype="float32")[(X.sum(1) > 0).astype(int)]
+
+        def build():
+            conf = (NeuralNetConfiguration.Builder().seed(3)
+                    .updater(Adam(1e-2)).list()
+                    .layer(DenseLayer(nIn=4, nOut=8, activation="tanh"))
+                    .layer(OutputLayer(nOut=2, activation="softmax"))
+                    .build())
+            return MultiLayerNetwork(conf).init()
+
+        a = build()
+        a.fit(DataSetIterator(X, Y, 32), epochs=4)
+        b = build()
+        b.fit(MultipleEpochsIterator(4, DataSetIterator(X, Y, 32)))
+        assert abs(a.score() - b.score()) < 1e-5
+
+    def test_view_iterator(self):
+        from deeplearning4j_tpu.data import ViewIterator
+        it = ViewIterator(self._ds(10), 4)
+        b1 = it.next()
+        assert b1.numExamples() == 4
+        np.testing.assert_allclose(
+            b1.getFeatures().toNumpy()[:, 0], [0.0, 3.0, 6.0, 9.0])
+
+    def test_kfold_reset_clears_test_fold(self):
+        from deeplearning4j_tpu.data import KFoldIterator
+        it = KFoldIterator(3, self._ds(9))
+        while it.hasNext():
+            it.next()
+        it.reset()
+        with pytest.raises(RuntimeError, match="next"):
+            it.testFold()
+
+    def test_multiple_epochs_normalizer_stats_unbiased(self):
+        # NormalizerStandardize.fit must see one UNPADDED pass, not
+        # numEpochs padded replays
+        from deeplearning4j_tpu.data import (DataSetIterator,
+                                             MultipleEpochsIterator)
+        from deeplearning4j_tpu.data.normalizers import NormalizerStandardize
+        f = np.arange(10, dtype="float32").reshape(5, 2)  # odd vs batch 2
+        l = np.eye(2, dtype="float32")[[0, 1, 0, 1, 0]]
+        n1, n2 = NormalizerStandardize(), NormalizerStandardize()
+        n1.fit(DataSetIterator(f, l, 2))
+        n2.fit(MultipleEpochsIterator(3, DataSetIterator(f, l, 2)))
+        np.testing.assert_allclose(np.asarray(n1._mean), np.asarray(n2._mean))
+        np.testing.assert_allclose(np.asarray(n1._std), np.asarray(n2._std))
