@@ -11,6 +11,13 @@ from deeplearning4j_tpu.arbiter.spaces import (
     DiscreteParameterSpace,
     IntegerParameterSpace,
 )
+from deeplearning4j_tpu.arbiter.layerspace import (
+    LayerSpace,
+    DenseLayerSpace,
+    OutputLayerSpace,
+    ConvolutionLayerSpace,
+    MultiLayerSpace,
+)
 from deeplearning4j_tpu.arbiter.optimize import (
     RandomSearchGenerator,
     GridSearchCandidateGenerator,
@@ -32,5 +39,7 @@ __all__ = [
     "TestSetLossScoreFunction",
     "EvaluationScoreFunction", "MaxCandidatesCondition", "MaxTimeCondition",
     "OptimizationConfiguration", "LocalOptimizationRunner",
-    "OptimizationResult", "CandidateResult",
+    "OptimizationResult", "CandidateResult", "LayerSpace",
+    "DenseLayerSpace", "OutputLayerSpace", "ConvolutionLayerSpace",
+    "MultiLayerSpace",
 ]

@@ -1,0 +1,145 @@
+"""Network configuration spaces — the arbiter DSL over layer configs.
+
+Reference: arbiter-deeplearning4j org.deeplearning4j.arbiter.
+MultiLayerSpace + layers.DenseLayerSpace/OutputLayerSpace/
+ConvolutionLayerSpace (Builder DSL where any hyperparameter can be a
+fixed value or a ParameterSpace). Upstream materializes a
+MultiLayerConfiguration from a double[] chromosome; here the space
+flattens to the SAME named-ParameterSpace dict every generator
+(random/grid/genetic) already consumes, and `modelBuilder` closes the
+loop for LocalOptimizationRunner — so one DSL serves all three search
+strategies with no chromosome plumbing.
+
+    space = (MultiLayerSpace.Builder()
+             .seed(7)
+             .learningRate(ContinuousParameterSpace(1e-4, 1e-1, log=True))
+             .addLayer(DenseLayerSpace(nIn=6,
+                                       nOut=IntegerParameterSpace(4, 32),
+                                       activation=DiscreteParameterSpace(
+                                           "relu", "tanh")))
+             .addLayer(OutputLayerSpace(nOut=2, activation="softmax"))
+             .build())
+    gen = RandomSearchGenerator(space.parameterSpaces())
+    runner = LocalOptimizationRunner(conf, space.modelBuilder, train)
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.arbiter.spaces import ParameterSpace
+
+
+class LayerSpace:
+    """One layer whose constructor kwargs may be fixed values or
+    ParameterSpaces. Generic form: LayerSpace(DenseLayer, nOut=...);
+    the named subclasses below mirror the upstream class names."""
+
+    def __init__(self, layer_cls, **kwargs):
+        self.layer_cls = layer_cls
+        self.kwargs = kwargs
+
+    def _spaces(self, index):
+        return {f"{index}_{k}": v for k, v in self.kwargs.items()
+                if isinstance(v, ParameterSpace)}
+
+    def materialize(self, index, candidate):
+        kw = {k: (candidate[f"{index}_{k}"]
+                  if isinstance(v, ParameterSpace) else v)
+              for k, v in self.kwargs.items()}
+        return self.layer_cls(**kw)
+
+
+class DenseLayerSpace(LayerSpace):
+    def __init__(self, **kwargs):
+        from deeplearning4j_tpu.nn import DenseLayer
+
+        super().__init__(DenseLayer, **kwargs)
+
+
+class OutputLayerSpace(LayerSpace):
+    def __init__(self, **kwargs):
+        from deeplearning4j_tpu.nn import OutputLayer
+
+        super().__init__(OutputLayer, **kwargs)
+
+
+class ConvolutionLayerSpace(LayerSpace):
+    def __init__(self, **kwargs):
+        from deeplearning4j_tpu.nn import ConvolutionLayer
+
+        super().__init__(ConvolutionLayer, **kwargs)
+
+
+class MultiLayerSpace:
+    """Built space: parameterSpaces() feeds any candidate generator;
+    modelBuilder(candidate) is the LocalOptimizationRunner callback."""
+
+    class Builder:
+        def __init__(self):
+            self._layers = []
+            self._seed = 12345
+            self._lr = 1e-3
+            self._updater_factory = None
+            self._input_type = None
+
+        def seed(self, s):
+            self._seed = int(s)
+            return self
+
+        def learningRate(self, lr):
+            """Fixed float or a ParameterSpace (exposed as 'learningRate'
+            in the candidate dict)."""
+            self._lr = lr
+            return self
+
+        def updater(self, factory):
+            """Callable lr -> updater instance (default: Adam)."""
+            self._updater_factory = factory
+            return self
+
+        def addLayer(self, layer_space):
+            if not isinstance(layer_space, LayerSpace):
+                raise TypeError("addLayer expects a LayerSpace")
+            self._layers.append(layer_space)
+            return self
+
+        def setInputType(self, input_type):
+            self._input_type = input_type
+            return self
+
+        def build(self):
+            if not self._layers:
+                raise ValueError("MultiLayerSpace needs at least one layer")
+            return MultiLayerSpace(self)
+
+    def __init__(self, b):
+        self._layers = list(b._layers)
+        self._seed = b._seed
+        self._lr = b._lr
+        self._updater_factory = b._updater_factory
+        self._input_type = b._input_type
+
+    def parameterSpaces(self) -> dict:
+        out = {}
+        if isinstance(self._lr, ParameterSpace):
+            out["learningRate"] = self._lr
+        for i, ls in enumerate(self._layers):
+            out.update(ls._spaces(i))
+        if not out:
+            raise ValueError(
+                "no ParameterSpaces in this MultiLayerSpace — every "
+                "hyperparameter is fixed, there is nothing to search")
+        return out
+
+    def modelBuilder(self, candidate: dict):
+        from deeplearning4j_tpu.nn import (
+            Adam, MultiLayerNetwork, NeuralNetConfiguration)
+
+        lr = candidate.get("learningRate", self._lr)
+        factory = self._updater_factory or Adam
+        builder = (NeuralNetConfiguration.Builder()
+                   .seed(self._seed).updater(factory(lr)).list())
+        for i, ls in enumerate(self._layers):
+            builder.layer(ls.materialize(i, candidate))
+        if self._input_type is not None:
+            builder.setInputType(self._input_type)
+        return MultiLayerNetwork(builder.build()).init()

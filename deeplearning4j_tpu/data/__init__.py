@@ -6,6 +6,7 @@ Reference: org.nd4j.linalg.dataset + deeplearning4j-datasets + datavec.
 from deeplearning4j_tpu.data.dataset import (
     DataSet, DataSetIterator, ListDataSetIterator, ExistingDataSetIterator,
     KFoldIterator, MultipleEpochsIterator, ViewIterator,
+    MiniBatchFileDataSetIterator,
     SplitTestAndTrain,
 )
 from deeplearning4j_tpu.data.multidataset import MultiDataSet, MultiDataSetIterator
@@ -42,7 +43,8 @@ from deeplearning4j_tpu.data.records import (
 __all__ = [
     "DataSet", "DataSetIterator", "ListDataSetIterator",
     "ExistingDataSetIterator", "KFoldIterator", "MultipleEpochsIterator",
-    "ViewIterator", "SplitTestAndTrain", "MultiDataSet",
+    "ViewIterator", "MiniBatchFileDataSetIterator",
+    "SplitTestAndTrain", "MultiDataSet",
     "MultiDataSetIterator", "DataNormalization", "NormalizerStandardize",
     "NormalizerMinMaxScaler", "ImagePreProcessingScaler",
     "VGG16ImagePreProcessor", "IrisDataSetIterator", "MnistDataSetIterator", "FashionMnistDataSetIterator",

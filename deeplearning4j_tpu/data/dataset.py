@@ -84,6 +84,24 @@ class SplitTestAndTrain:
         return self._test
 
 
+def _pad_batch(f, l, fm, lm, n):
+    """Pad a short final batch to n rows with repeated last rows and a
+    zero label-mask over the pad, so XLA reuses one compiled executable
+    per batch shape and the padded rows contribute no loss."""
+    pad = n - len(f)
+    f = np.concatenate([f, np.repeat(f[-1:], pad, axis=0)])
+    l = np.concatenate([l, np.repeat(l[-1:], pad, axis=0)])
+    if fm is not None:
+        fm = np.concatenate([fm, np.repeat(fm[-1:], pad, axis=0)])
+    if lm is None:
+        lm = np.ones((n,) + (() if l.ndim == 2 else (l.shape[2],)),
+                     np.float32)
+        lm[-pad:] = 0.0
+    else:
+        lm = np.concatenate([lm, np.zeros((pad,) + lm.shape[1:], lm.dtype)])
+    return f, l, fm, lm
+
+
 class DataSetIterator:
     """Base in-memory iterator over (features, labels) arrays."""
 
@@ -122,18 +140,7 @@ class DataSetIterator:
         fm = None if self._fm is None else self._fm[idx]
         lm = None if self._lm is None else self._lm[idx]
         if self._pad_final and len(idx) < n:
-            # pad to full batch with repeated rows + zero label-mask so XLA
-            # reuses the compiled executable; loss of padded rows is masked
-            pad = n - len(idx)
-            f = np.concatenate([f, np.repeat(f[-1:], pad, axis=0)])
-            l = np.concatenate([l, np.repeat(l[-1:], pad, axis=0)])
-            if fm is not None:
-                fm = np.concatenate([fm, np.repeat(fm[-1:], pad, axis=0)])
-            if lm is None:
-                lm = np.ones((n,) + (() if l.ndim == 2 else (l.shape[2],)), np.float32)
-                lm[-pad:] = 0.0
-            else:
-                lm = np.concatenate([lm, np.zeros((pad,) + lm.shape[1:], lm.dtype)])
+            f, l, fm, lm = _pad_batch(f, l, fm, lm, n)
         ds = DataSet(f, l, fm, lm)
         if self._preprocessor is not None:
             self._preprocessor.preProcess(ds)
@@ -309,3 +316,115 @@ class ViewIterator(ExistingDataSetIterator):
 
     def __init__(self, dataset: DataSet, batchSize: int):
         super().__init__(dataset, int(batchSize))
+
+
+class MiniBatchFileDataSetIterator:
+    """Disk-backed minibatches (reference: org.deeplearning4j.datasets
+    .iterator.MiniBatchFileDataSetIterator): splits a DataSet into one
+    .npz file per batch under rootDir at construction, then streams
+    them back one at a time — the host never holds more than one batch
+    after the initial split, which is the point for datasets larger
+    than host RAM that arrive batch-wise. Masks persist with their
+    batches, and the final short batch pads like every other iterator
+    here (fixed shapes, one XLA executable)."""
+
+    def __init__(self, dataset: DataSet, batchSize: int, rootDir=None,
+                 delete_on_exhaust=False, pad_final=True):
+        import os
+        import tempfile
+
+        self._dir = str(rootDir) if rootDir is not None \
+            else tempfile.mkdtemp(prefix="minibatch_")
+        os.makedirs(self._dir, exist_ok=True)
+        self._batch = int(batchSize)
+        self._delete = bool(delete_on_exhaust)
+        self._pad_final = bool(pad_final)
+        f = dataset.getFeatures().toNumpy()
+        l = dataset.getLabels().toNumpy()
+        fm = dataset.getFeaturesMaskArray()
+        lm = dataset.getLabelsMaskArray()
+        fm = None if fm is None else fm.toNumpy()
+        lm = None if lm is None else lm.toNumpy()
+        self._n = len(f)
+        self._in_cols = int(np.prod(f.shape[1:]))
+        self._outcomes = int(l.shape[-1])
+        self._paths = []
+        for i in range(0, len(f), self._batch):
+            p = os.path.join(self._dir, f"dataset-{len(self._paths)}.npz")
+            rec = {"features": f[i:i + self._batch],
+                   "labels": l[i:i + self._batch]}
+            if fm is not None:
+                rec["features_mask"] = fm[i:i + self._batch]
+            if lm is not None:
+                rec["labels_mask"] = lm[i:i + self._batch]
+            np.savez(p, **rec)
+            self._paths.append(p)
+        self._preprocessor = None
+        self.reset()
+
+    def rootDir(self):
+        return self._dir
+
+    def reset(self):
+        self._i = 0
+
+    def hasNext(self) -> bool:
+        return self._i < len(self._paths)
+
+    def _load(self, i):
+        z = np.load(self._paths[i])
+        return (z["features"], z["labels"],
+                z["features_mask"] if "features_mask" in z.files else None,
+                z["labels_mask"] if "labels_mask" in z.files else None)
+
+    def next(self, num=None) -> DataSet:
+        import os
+
+        if num is not None and int(num) != self._batch:
+            raise ValueError(
+                f"batches were split to files at batchSize={self._batch}; "
+                f"next({num}) cannot re-batch them")
+        if not self.hasNext():
+            raise StopIteration
+        f, l, fm, lm = self._load(self._i)
+        if self._pad_final and len(f) < self._batch:
+            f, l, fm, lm = _pad_batch(f, l, fm, lm, self._batch)
+        ds = DataSet(f, l, fm, lm)
+        self._i += 1
+        if self._delete and not self.hasNext():
+            for p in self._paths:
+                os.unlink(p)
+            self._paths = []
+        if self._preprocessor is not None:
+            self._preprocessor.preProcess(ds)
+        return ds
+
+    def _raw_batches(self):
+        # unpadded, preprocessor-free pass for normalizer statistics
+        # (same contract as DataSetIterator._raw_batches)
+        for i in range(len(self._paths)):
+            f, l, _, _ = self._load(i)
+            yield f, l
+
+    def __iter__(self):
+        self.reset()
+        while self.hasNext():
+            yield self.next()
+
+    def batch(self) -> int:
+        return self._batch
+
+    def totalExamples(self) -> int:
+        return self._n
+
+    def inputColumns(self) -> int:
+        return self._in_cols
+
+    def totalOutcomes(self) -> int:
+        return self._outcomes
+
+    def setPreProcessor(self, pp):
+        self._preprocessor = pp
+
+    def getPreProcessor(self):
+        return self._preprocessor

@@ -47,24 +47,30 @@ class StaticWordVectors(WordVectorQuery):
                 f"{len(self._ivocab)} words")
 
 
+
+def _words_for_write(vectors, fmt):
+    """Vocab order + whitespace validation shared by the text and binary
+    writers — whole-vocab check BEFORE any file is opened so a failure
+    can't leave a truncated file."""
+    words = (vectors._ivocab if hasattr(vectors, "_ivocab")
+             else sorted(vectors.vocab))
+    if not words:
+        raise ValueError("no words to write")
+    bad = [w for w in words if any(c.isspace() for c in w)]
+    if bad:
+        raise ValueError(
+            f"words {bad[:5]!r} contain whitespace — unrepresentable in "
+            f"the {fmt} format")
+    return words
+
+
 class WordVectorSerializer:
     @staticmethod
     def writeWordVectors(vectors, path, writeHeader=True):
         """Text format (reference: WordVectorSerializer.writeWordVectors):
         optional "V D" header, then "word v1 .. vD" per line. Accepts a
         trained Word2Vec/ParagraphVectors/Glove or a StaticWordVectors."""
-        words = (vectors._ivocab if hasattr(vectors, "_ivocab")
-                 else sorted(vectors.vocab))
-        if not words:
-            raise ValueError("no words to write")
-        # validate the whole vocab BEFORE opening the file: failing
-        # mid-loop would leave a truncated file whose header row count
-        # lies about the body
-        bad = [w for w in words if any(c.isspace() for c in w)]
-        if bad:
-            raise ValueError(
-                f"words {bad[:5]!r} contain whitespace — unrepresentable "
-                "in the text format")
+        words = _words_for_write(vectors, "text")
         first = np.asarray(vectors.getWordVector(words[0]))
         with open(str(path), "w", encoding="utf-8") as f:
             if writeHeader:
@@ -109,6 +115,84 @@ class WordVectorSerializer:
             raise ValueError(f"no vectors found in {path}")
         return StaticWordVectors(words, np.stack(rows))
 
+
+    @staticmethod
+    def writeBinaryModel(vectors, path):
+        """word2vec C binary format (the Google News .bin layout, what
+        the reference's readWord2VecModel(binary) and gensim's
+        load_word2vec_format(binary=True) consume): ASCII "V D\\n"
+        header, then per word the UTF-8 token, one space, D
+        little-endian float32s, one trailing newline."""
+        words = _words_for_write(vectors, "word2vec binary")
+        first = np.asarray(vectors.getWordVector(words[0]))
+        with open(str(path), "wb") as f:
+            f.write(f"{len(words)} {first.shape[0]}\n".encode("ascii"))
+            for w in words:
+                vec = np.asarray(vectors.getWordVector(w),
+                                 "<f4")  # little-endian on any host
+                f.write(w.encode("utf-8") + b" ")
+                f.write(vec.tobytes())
+                f.write(b"\n")
+
+    @staticmethod
+    def readBinaryModel(path):
+        """-> StaticWordVectors from the word2vec C binary format."""
+        with open(str(path), "rb") as f:
+            header = b""
+            while not header.endswith(b"\n"):
+                c = f.read(1)
+                if not c:
+                    raise ValueError(f"{path}: truncated before header end")
+                header += c
+                if len(header) > 64:
+                    raise ValueError(f"{path}: malformed binary header")
+            try:
+                V, D = (int(t) for t in header.split())
+            except ValueError:
+                raise ValueError(f"{path}: binary header is not 'V D'")
+            words, rows = [], []
+            for i in range(V):
+                c = f.read(1)
+                while c in (b"\n", b" ", b"\r"):  # inter-record padding
+                    c = f.read(1)
+                w = b""
+                while c != b" ":
+                    if not c:
+                        raise ValueError(
+                            f"{path}: truncated in word {i + 1}/{V}")
+                    w += c
+                    c = f.read(1)
+                buf = f.read(4 * D)
+                vec = np.frombuffer(buf, "<f4")
+                if vec.size != D:
+                    raise ValueError(
+                        f"{path}: truncated vector for "
+                        f"{w.decode('utf-8', 'replace')!r} "
+                        f"({vec.size}/{D} floats)")
+                words.append(w.decode("utf-8"))
+                rows.append(vec.astype(np.float32))
+            trailing = f.read()
+            if trailing.strip(b"\n\r "):
+                raise ValueError(
+                    f"{path}: {len(trailing)} unexpected bytes after the "
+                    f"declared {V} records — not word2vec binary layout")
+        return StaticWordVectors(words, np.stack(rows))
+
+    @staticmethod
+    def _looks_binary(path):
+        """Binary-vs-text sniff for readWord2VecModel: a text vector
+        file is fully utf-8-decodable; raw float32 payloads essentially
+        never are."""
+        with open(str(path), "rb") as f:
+            sample = f.read(4096)
+        try:
+            sample.decode("utf-8")
+            return False
+        except UnicodeDecodeError as e:
+            # a multibyte char split at the sample boundary is not
+            # evidence of binary content
+            return e.start < len(sample) - 3
+
     @staticmethod
     def readWord2VecModel(path):
         """Type-dispatching load (reference: readWord2VecModel): a
@@ -129,4 +213,15 @@ class WordVectorSerializer:
                         f"{p} is an npz container without the .npz suffix "
                         "(externally renamed?) — rename it to <name>.npz "
                         "so the native loader can open it")
-        return WordVectorSerializer.loadTxtVectors(p)
+        if os.path.exists(p) and WordVectorSerializer._looks_binary(p):
+            return WordVectorSerializer.readBinaryModel(p)
+        try:
+            return WordVectorSerializer.loadTxtVectors(p)
+        except ValueError as text_err:
+            # binary payloads that happen to be valid UTF-8 (e.g.
+            # all-zero vectors) fool the sniff; accept the binary
+            # parse only if it consumes the file exactly
+            try:
+                return WordVectorSerializer.readBinaryModel(p)
+            except ValueError:
+                raise text_err

@@ -270,3 +270,62 @@ class TestGeneticSearch:
             assert 1e-4 <= c["lr"] <= 1e-1
             assert c["act"] in ("relu", "tanh")
             assert 4 <= c["hidden"] <= 16
+
+
+class TestMultiLayerSpace:
+    """The arbiter config-space DSL (reference: arbiter-deeplearning4j
+    MultiLayerSpace + DenseLayerSpace/OutputLayerSpace): flattens to the
+    named-ParameterSpace dict every generator consumes, and provides the
+    modelBuilder for LocalOptimizationRunner."""
+
+    def _space(self):
+        from deeplearning4j_tpu.arbiter import (
+            MultiLayerSpace, DenseLayerSpace, OutputLayerSpace)
+        return (MultiLayerSpace.Builder()
+                .seed(7)
+                .learningRate(ContinuousParameterSpace(1e-3, 1e-1, log=True))
+                .addLayer(DenseLayerSpace(
+                    nIn=6, nOut=IntegerParameterSpace(4, 16),
+                    activation=DiscreteParameterSpace("relu", "tanh")))
+                .addLayer(OutputLayerSpace(nOut=2, activation="softmax"))
+                .build())
+
+    def test_parameter_space_keys(self):
+        spaces = self._space().parameterSpaces()
+        assert set(spaces) == {"learningRate", "0_nOut", "0_activation"}
+
+    def test_model_builder_materializes_candidate(self):
+        space = self._space()
+        net = space.modelBuilder(
+            {"learningRate": 0.01, "0_nOut": 9, "0_activation": "tanh"})
+        assert np.asarray(net.getParam("0_W")).shape == (6, 9)
+        assert np.asarray(net.getParam("1_W")).shape == (9, 2)
+
+    def test_random_search_over_space_finds_good_model(self):
+        space = self._space()
+        gen = RandomSearchGenerator(space.parameterSpaces(), seed=4)
+        conf = (OptimizationConfiguration.Builder()
+                .candidateGenerator(gen)
+                .scoreFunction(EvaluationScoreFunction(_data(seed=1)))
+                .terminationConditions(MaxCandidatesCondition(4))
+                .epochsPerCandidate(8).build())
+        res = LocalOptimizationRunner(conf, space.modelBuilder,
+                                      _data(seed=0)).execute()
+        assert res.bestScore() > 0.8
+        assert set(res.bestCandidate()) == {"learningRate", "0_nOut",
+                                            "0_activation"}
+
+    def test_all_fixed_raises(self):
+        from deeplearning4j_tpu.arbiter import (
+            MultiLayerSpace, DenseLayerSpace, OutputLayerSpace)
+        space = (MultiLayerSpace.Builder()
+                 .addLayer(DenseLayerSpace(nIn=4, nOut=8))
+                 .addLayer(OutputLayerSpace(nOut=2, activation="softmax"))
+                 .build())
+        with pytest.raises(ValueError, match="nothing to search"):
+            space.parameterSpaces()
+
+    def test_add_layer_type_check(self):
+        from deeplearning4j_tpu.arbiter import MultiLayerSpace
+        with pytest.raises(TypeError, match="LayerSpace"):
+            MultiLayerSpace.Builder().addLayer(object())

@@ -826,3 +826,77 @@ class TestUtilityIterators:
         n2.fit(MultipleEpochsIterator(3, DataSetIterator(f, l, 2)))
         np.testing.assert_allclose(np.asarray(n1._mean), np.asarray(n2._mean))
         np.testing.assert_allclose(np.asarray(n1._std), np.asarray(n2._std))
+
+
+class TestMiniBatchFileIterator:
+    """MiniBatchFileDataSetIterator (reference: org.deeplearning4j
+    .datasets.iterator.MiniBatchFileDataSetIterator)."""
+
+    def _ds(self, n=10):
+        from deeplearning4j_tpu.data import DataSet
+        f = np.arange(n * 2, dtype="float32").reshape(n, 2)
+        l = np.eye(2, dtype="float32")[np.arange(n) % 2]
+        return DataSet(f, l)
+
+    def test_batches_roundtrip_from_disk(self, tmp_path):
+        import os
+        from deeplearning4j_tpu.data import MiniBatchFileDataSetIterator
+        it = MiniBatchFileDataSetIterator(self._ds(10), 4,
+                                          rootDir=tmp_path / "mb")
+        assert len(os.listdir(it.rootDir())) == 3  # 4+4+2
+        batches = [b for b in it]
+        # final batch PADS to the fixed shape with a zero label-mask
+        # over the pad rows (module invariant: one XLA executable)
+        assert [b.numExamples() for b in batches] == [4, 4, 4]
+        lm = batches[-1].getLabelsMaskArray().toNumpy()
+        np.testing.assert_allclose(lm, [1, 1, 0, 0])
+        all_f = np.concatenate([b.getFeatures().toNumpy()
+                                for b in batches[:2]]
+                               + [batches[2].getFeatures().toNumpy()[:2]])
+        np.testing.assert_allclose(all_f,
+                                   self._ds(10).getFeatures().toNumpy())
+        assert it.totalExamples() == 10
+        assert it.inputColumns() == 2 and it.totalOutcomes() == 2
+        # second pass re-reads the same files
+        assert len([b for b in it]) == 3
+
+    def test_masks_persist(self, tmp_path):
+        from deeplearning4j_tpu.data import (DataSet,
+                                             MiniBatchFileDataSetIterator)
+        f = np.zeros((5, 2, 3), "float32")
+        l = np.zeros((5, 2, 3), "float32")
+        fm = np.arange(15, dtype="float32").reshape(5, 3)
+        it = MiniBatchFileDataSetIterator(
+            DataSet(f, l, featuresMask=fm), 5, rootDir=tmp_path / "mbm")
+        b = it.next()
+        np.testing.assert_allclose(b.getFeaturesMaskArray().toNumpy(), fm)
+
+    def test_composes_with_normalizer_and_epochs(self, tmp_path):
+        from deeplearning4j_tpu.data import (
+            DataSetIterator, MiniBatchFileDataSetIterator,
+            MultipleEpochsIterator)
+        from deeplearning4j_tpu.data.normalizers import NormalizerStandardize
+        ds = self._ds(10)
+        it = MiniBatchFileDataSetIterator(ds, 4, rootDir=tmp_path / "mbn")
+        n1, n2 = NormalizerStandardize(), NormalizerStandardize()
+        n1.fit(MultipleEpochsIterator(2, it))
+        n2.fit(DataSetIterator(ds.getFeatures().toNumpy(),
+                               ds.getLabels().toNumpy(), 4))
+        np.testing.assert_allclose(np.asarray(n1._mean),
+                                   np.asarray(n2._mean))
+
+    def test_next_num_rejected(self, tmp_path):
+        from deeplearning4j_tpu.data import MiniBatchFileDataSetIterator
+        it = MiniBatchFileDataSetIterator(self._ds(8), 4,
+                                          rootDir=tmp_path / "mbx")
+        with pytest.raises(ValueError, match="re-batch"):
+            it.next(3)
+
+    def test_delete_on_exhaust(self, tmp_path):
+        import os
+        from deeplearning4j_tpu.data import MiniBatchFileDataSetIterator
+        it = MiniBatchFileDataSetIterator(self._ds(6), 3,
+                                          rootDir=tmp_path / "mb2",
+                                          delete_on_exhaust=True)
+        list(it)
+        assert os.listdir(it.rootDir()) == []

@@ -10,6 +10,7 @@ from deeplearning4j_tpu.nlp import (
     CommonPreprocessor, LowCasePreProcessor, EndingPreProcessor,
     NGramTokenizerFactory, CnnSentenceDataSetIterator,
     CollectionLabeledSentenceProvider, UnknownWordHandling,
+    WordVectorSerializer, StaticWordVectors,
 )
 
 
@@ -352,3 +353,106 @@ class TestAnalogyQuery:
         sv = StaticWordVectors(["a", "b", "c"], W)
         assert sv.wordsNearest("a", 1) == ["b"]
         assert "a" not in sv.wordsNearest("a", 3)
+
+
+class TestBinaryWordVectors:
+    """word2vec C binary format (reference: WordVectorSerializer's
+    binary read path for Google News-style .bin files)."""
+
+    def _vectors(self):
+        words = ["alpha", "beta", "gamma"]
+        mat = np.arange(9, dtype="float32").reshape(3, 3) / 7.0
+        return StaticWordVectors(words, mat)
+
+    def test_roundtrip(self, tmp_path):
+        v = self._vectors()
+        p = tmp_path / "vecs.bin"
+        WordVectorSerializer.writeBinaryModel(v, p)
+        r = WordVectorSerializer.readBinaryModel(p)
+        assert r._ivocab == v._ivocab
+        np.testing.assert_allclose(r._W, v._W, rtol=1e-7)
+
+    def test_wire_format_oracle(self, tmp_path):
+        # hand-assembled spec bytes: header, then word + ' ' + LE floats
+        # + '\n' — what the original word2vec C tool emits
+        import struct
+        p = tmp_path / "hand.bin"
+        with open(p, "wb") as f:
+            f.write(b"2 2\n")
+            f.write(b"cat " + struct.pack("<2f", 1.5, -2.25) + b"\n")
+            f.write(b"dog " + struct.pack("<2f", 0.5, 4.0) + b"\n")
+        r = WordVectorSerializer.readBinaryModel(p)
+        assert r._ivocab == ["cat", "dog"]
+        np.testing.assert_allclose(r.getWordVector("cat"), [1.5, -2.25])
+        np.testing.assert_allclose(r.getWordVector("dog"), [0.5, 4.0])
+
+    def test_written_bytes_match_spec(self, tmp_path):
+        import struct
+        v = StaticWordVectors(["x"], np.asarray([[1.0, 2.0]], "float32"))
+        p = tmp_path / "out.bin"
+        WordVectorSerializer.writeBinaryModel(v, p)
+        assert open(p, "rb").read() == \
+            b"1 2\nx " + struct.pack("<2f", 1.0, 2.0) + b"\n"
+
+    def test_truncated_raises(self, tmp_path):
+        import struct
+        p = tmp_path / "trunc.bin"
+        with open(p, "wb") as f:
+            f.write(b"2 2\n")
+            f.write(b"cat " + struct.pack("<2f", 1.0, 2.0) + b"\n")
+            f.write(b"dog " + struct.pack("<f", 1.0))  # half a vector
+        with pytest.raises(ValueError, match="truncated"):
+            WordVectorSerializer.readBinaryModel(p)
+
+    def test_read_word2vec_model_dispatches_binary(self, tmp_path):
+        v = self._vectors()
+        p = tmp_path / "auto.bin"
+        WordVectorSerializer.writeBinaryModel(v, p)
+        r = WordVectorSerializer.readWord2VecModel(p)
+        np.testing.assert_allclose(r.getWordVector("beta"),
+                                   v.getWordVector("beta"))
+        # and a text file still goes down the text path
+        pt = tmp_path / "auto.txt"
+        WordVectorSerializer.writeWordVectors(v, pt)
+        rt = WordVectorSerializer.readWord2VecModel(pt)
+        np.testing.assert_allclose(rt.getWordVector("beta"),
+                                   v.getWordVector("beta"), rtol=1e-5)
+
+    def test_whitespace_word_rejected(self, tmp_path):
+        v = StaticWordVectors(["ok", "bad word"],
+                              np.zeros((2, 2), "float32"))
+        with pytest.raises(ValueError, match="whitespace"):
+            WordVectorSerializer.writeBinaryModel(v, tmp_path / "w.bin")
+
+    def test_zero_vector_binary_still_dispatches(self, tmp_path):
+        # all-zero float payloads are valid UTF-8, defeating the byte
+        # sniff — the text-parse-fails -> clean-binary-parse fallback
+        # must still route correctly
+        v = StaticWordVectors(["pad", "ok"], np.zeros((2, 3), "float32"))
+        p = tmp_path / "zeros.bin"
+        WordVectorSerializer.writeBinaryModel(v, p)
+        r = WordVectorSerializer.readWord2VecModel(p)
+        assert r._ivocab == ["ok", "pad"] or r._ivocab == ["pad", "ok"]
+        np.testing.assert_allclose(r.getWordVector("pad"), [0, 0, 0])
+
+    def test_trailing_garbage_rejected(self, tmp_path):
+        import struct
+        p = tmp_path / "extra.bin"
+        with open(p, "wb") as f:
+            f.write(b"1 2\nw " + struct.pack("<2f", 1.0, 2.0) + b"\n")
+            f.write(b"unexpected trailing bytes")
+        with pytest.raises(ValueError, match="unexpected bytes"):
+            WordVectorSerializer.readBinaryModel(p)
+
+    def test_utf8_boundary_not_misread_as_binary(self, tmp_path):
+        # a multibyte char straddling the 4096-byte sniff boundary must
+        # not flip a text file to the binary path
+        p = tmp_path / "boundary.txt"
+        word = "café"  # 5 bytes utf-8, é = 2 bytes
+        filler = "x" * (4095 - 1 - 4)  # word starts so é spans offset 4096
+        with open(p, "w", encoding="utf-8") as f:
+            f.write(filler + " 1.0\n")   # first "word" is the filler
+            f.write(word + " 2.0\n")
+        assert not WordVectorSerializer._looks_binary(p)
+        r = WordVectorSerializer.readWord2VecModel(p)
+        assert r.hasWord(word)
