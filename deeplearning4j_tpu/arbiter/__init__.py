@@ -17,6 +17,7 @@ from deeplearning4j_tpu.arbiter.layerspace import (
     OutputLayerSpace,
     ConvolutionLayerSpace,
     MultiLayerSpace,
+    ComputationGraphSpace,
 )
 from deeplearning4j_tpu.arbiter.optimize import (
     RandomSearchGenerator,
@@ -41,5 +42,5 @@ __all__ = [
     "OptimizationConfiguration", "LocalOptimizationRunner",
     "OptimizationResult", "CandidateResult", "LayerSpace",
     "DenseLayerSpace", "OutputLayerSpace", "ConvolutionLayerSpace",
-    "MultiLayerSpace",
+    "MultiLayerSpace", "ComputationGraphSpace",
 ]

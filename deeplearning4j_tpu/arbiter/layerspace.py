@@ -143,3 +143,93 @@ class MultiLayerSpace:
         if self._input_type is not None:
             builder.setInputType(self._input_type)
         return MultiLayerNetwork(builder.build()).init()
+
+
+class ComputationGraphSpace:
+    """Graph-topology search space (reference: arbiter-deeplearning4j
+    org.deeplearning4j.arbiter.ComputationGraphSpace). Same flattening
+    contract as MultiLayerSpace, but hyperparameters are keyed by vertex
+    NAME ("dense_nOut") instead of layer index."""
+
+    class Builder:
+        def __init__(self):
+            self._inputs = []
+            self._layers = []      # (name, LayerSpace, input names)
+            self._outputs = []
+            self._input_types = None
+            self._seed = 12345
+            self._lr = 1e-3
+            self._updater_factory = None
+
+        def seed(self, s):
+            self._seed = int(s)
+            return self
+
+        def learningRate(self, lr):
+            self._lr = lr
+            return self
+
+        def updater(self, factory):
+            self._updater_factory = factory
+            return self
+
+        def addInputs(self, *names):
+            self._inputs.extend(names)
+            return self
+
+        def addLayer(self, name, layer_space, *inputs):
+            if not isinstance(layer_space, LayerSpace):
+                raise TypeError("addLayer expects a LayerSpace")
+            self._layers.append((name, layer_space, inputs))
+            return self
+
+        def setOutputs(self, *names):
+            self._outputs = list(names)
+            return self
+
+        def setInputTypes(self, *types):
+            self._input_types = types
+            return self
+
+        def build(self):
+            if not self._inputs or not self._outputs or not self._layers:
+                raise ValueError("ComputationGraphSpace needs addInputs, "
+                                 "addLayer, and setOutputs")
+            return ComputationGraphSpace(self)
+
+    def __init__(self, b):
+        self._inputs = list(b._inputs)
+        self._layers = list(b._layers)
+        self._outputs = list(b._outputs)
+        self._input_types = b._input_types
+        self._seed = b._seed
+        self._lr = b._lr
+        self._updater_factory = b._updater_factory
+
+    def parameterSpaces(self) -> dict:
+        out = {}
+        if isinstance(self._lr, ParameterSpace):
+            out["learningRate"] = self._lr
+        for name, ls, _ in self._layers:
+            out.update(ls._spaces(name))
+        if not out:
+            raise ValueError(
+                "no ParameterSpaces in this ComputationGraphSpace — every "
+                "hyperparameter is fixed, there is nothing to search")
+        return out
+
+    def modelBuilder(self, candidate: dict):
+        from deeplearning4j_tpu.nn import (
+            Adam, ComputationGraph, NeuralNetConfiguration)
+
+        lr = candidate.get("learningRate", self._lr)
+        factory = self._updater_factory or Adam
+        gb = (NeuralNetConfiguration.Builder()
+              .seed(self._seed).updater(factory(lr)).graphBuilder()
+              .addInputs(*self._inputs))
+        for name, ls, inputs in self._layers:
+            gb.addLayer(name, ls.materialize(name, candidate), *inputs)
+        gb.setOutputs(*self._outputs)
+        if self._input_types is not None:
+            gb.setInputTypes(*self._input_types)
+        return ComputationGraph(gb.build()).init()

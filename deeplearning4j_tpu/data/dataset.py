@@ -360,12 +360,18 @@ class MiniBatchFileDataSetIterator:
             np.savez(p, **rec)
             self._paths.append(p)
         self._preprocessor = None
+        self._exhausted_deleted = False
         self.reset()
 
     def rootDir(self):
         return self._dir
 
     def reset(self):
+        if self._exhausted_deleted:
+            raise RuntimeError(
+                "this MiniBatchFileDataSetIterator was built with "
+                "delete_on_exhaust=True and its batch files are gone — "
+                "a reset would silently iterate zero batches")
         self._i = 0
 
     def hasNext(self) -> bool:
@@ -395,6 +401,7 @@ class MiniBatchFileDataSetIterator:
             for p in self._paths:
                 os.unlink(p)
             self._paths = []
+            self._exhausted_deleted = True
         if self._preprocessor is not None:
             self._preprocessor.preProcess(ds)
         return ds

@@ -163,6 +163,9 @@ class WordVectorSerializer:
                     w += c
                     c = f.read(1)
                 buf = f.read(4 * D)
+                if len(buf) != 4 * D:  # incl. mid-float cuts, which
+                    # would make frombuffer raise a pathless numpy error
+                    buf = buf[:len(buf) - len(buf) % 4]
                 vec = np.frombuffer(buf, "<f4")
                 if vec.size != D:
                     raise ValueError(

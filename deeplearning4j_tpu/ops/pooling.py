@@ -22,14 +22,17 @@ from deeplearning4j_tpu.ops.conv import _pair
 # itself (HLO bloat) well before 6x6.
 _ARGMAX_BWD_MAX_WINDOW = 36
 
-# Backward implementation switch. The argmax rewrite targets TPU, where
-# XLA's select-and-scatter maps poorly (single 206 MB op in the ResNet
-# stem, BENCH_NOTES.md); the CPU backend instead rewrites
-# select-and-scatter into an efficient vectorized scatter and there the
-# stock path WINS (~5x, measured — bench.py bench_maxpool_backward).
-# DL4J_TPU_MAXPOOL_BWD=stock flips the default without a code change if
-# the live-TPU A/B ever lands the other way.
-_BACKWARD_IMPL = os.environ.get("DL4J_TPU_MAXPOOL_BWD", "argmax").lower()
+# Backward implementation switch. The argmax rewrite was built for TPU,
+# where XLA's select-and-scatter materializes a single 206 MB op in the
+# ResNet stem (BENCH_NOTES.md) — but the live-TPU A/B landed the OTHER
+# way: on TPU v5e the stock gradient measures ~1.9x faster than the
+# argmax form (8.99 vs 15.60 ms fwd+bwd at the stem-pool shape,
+# BENCH_LIVE_r04.json), and on CPU it is ~5x faster (XLA-CPU rewrites
+# select-and-scatter into a vectorized scatter). Stock is therefore the
+# default on every backend; the argmax path stays available
+# (DL4J_TPU_MAXPOOL_BWD=argmax) and gradient-parity-pinned for backends
+# where the trade may differ. bench.py still A/Bs both per run.
+_BACKWARD_IMPL = os.environ.get("DL4J_TPU_MAXPOOL_BWD", "stock").lower()
 if _BACKWARD_IMPL not in ("argmax", "stock"):
     raise ValueError(
         f"DL4J_TPU_MAXPOOL_BWD must be 'argmax' or 'stock', got "

@@ -12,6 +12,7 @@ from deeplearning4j_tpu.arbiter import (
 )
 from deeplearning4j_tpu.nn import (
     NeuralNetConfiguration, DenseLayer, OutputLayer, MultiLayerNetwork, Adam,
+    InputType,
 )
 from deeplearning4j_tpu.nn.losses import LossFunctions
 from deeplearning4j_tpu.data import DataSetIterator
@@ -329,3 +330,40 @@ class TestMultiLayerSpace:
         from deeplearning4j_tpu.arbiter import MultiLayerSpace
         with pytest.raises(TypeError, match="LayerSpace"):
             MultiLayerSpace.Builder().addLayer(object())
+
+
+class TestComputationGraphSpace:
+    def _space(self):
+        from deeplearning4j_tpu.arbiter import (
+            ComputationGraphSpace, DenseLayerSpace, OutputLayerSpace)
+        return (ComputationGraphSpace.Builder()
+                .seed(7)
+                .learningRate(ContinuousParameterSpace(1e-3, 1e-1, log=True))
+                .addInputs("in")
+                .addLayer("dense", DenseLayerSpace(
+                    nIn=6, nOut=IntegerParameterSpace(4, 16),
+                    activation="tanh"), "in")
+                .addLayer("out", OutputLayerSpace(nOut=2,
+                                                  activation="softmax"),
+                          "dense")
+                .setOutputs("out")
+                .setInputTypes(InputType.feedForward(6))
+                .build())
+
+    def test_keys_are_vertex_named(self):
+        assert set(self._space().parameterSpaces()) == {"learningRate",
+                                                        "dense_nOut"}
+
+    def test_search_over_graph_space(self):
+        space = self._space()
+        gen = RandomSearchGenerator(space.parameterSpaces(), seed=3)
+        conf = (OptimizationConfiguration.Builder()
+                .candidateGenerator(gen)
+                .scoreFunction(EvaluationScoreFunction(_data(seed=1)))
+                .terminationConditions(MaxCandidatesCondition(3))
+                .epochsPerCandidate(8).build())
+        res = LocalOptimizationRunner(conf, space.modelBuilder,
+                                      _data(seed=0)).execute()
+        assert res.bestScore() > 0.8
+        from deeplearning4j_tpu.nn import ComputationGraph
+        assert isinstance(res.bestModel(), ComputationGraph)

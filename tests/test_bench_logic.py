@@ -25,9 +25,9 @@ def _rec(ips, **extra):
 
 @pytest.fixture
 def stub(monkeypatch):
-    # bench_resnet50's losing maxpool A/B flips the module global
-    # _BACKWARD_IMPL to "stock"; restore it so later tests in this
-    # process keep exercising the default argmax path
+    # bench_resnet50's maxpool A/B rebinds the module global
+    # _BACKWARD_IMPL to the measured winner; restore the default (stock)
+    # for later tests in this process
     from deeplearning4j_tpu.ops import pooling as _pooling
 
     monkeypatch.setattr(_pooling, "_BACKWARD_IMPL",
@@ -122,3 +122,22 @@ class TestHeadlineSelection:
         for p in partials:
             rec = json.loads(p[len("BENCHREC-PARTIAL "):])
             assert rec["images_per_sec"] > 0
+
+
+class TestMaxpoolABSelection:
+    def test_argmax_winning_flips_default(self, stub, monkeypatch):
+        monkeypatch.setattr(bench, "bench_maxpool_backward",
+                            lambda: {"argmax_bwd_ms": 1.0,
+                                     "select_and_scatter_bwd_ms": 2.0,
+                                     "speedup": 2.0})
+        stub.table = {("standard", False): _rec(1000.0),
+                      ("space_to_depth", False): _rec(900.0),
+                      ("standard", True): _rec(800.0)}
+        rec = bench.bench_resnet50()
+        assert rec["maxpool_backward_ab"]["headline_uses"] == "argmax"
+
+    def test_default_is_stock(self):
+        from deeplearning4j_tpu.ops import pooling as _pooling
+        import os
+        if "DL4J_TPU_MAXPOOL_BWD" not in os.environ:
+            assert _pooling._BACKWARD_IMPL == "stock"

@@ -900,3 +900,12 @@ class TestMiniBatchFileIterator:
                                           delete_on_exhaust=True)
         list(it)
         assert os.listdir(it.rootDir()) == []
+
+    def test_delete_on_exhaust_reset_raises(self, tmp_path):
+        from deeplearning4j_tpu.data import MiniBatchFileDataSetIterator
+        it = MiniBatchFileDataSetIterator(self._ds(6), 3,
+                                          rootDir=tmp_path / "mbr",
+                                          delete_on_exhaust=True)
+        assert len([b for b in it]) == 2
+        with pytest.raises(RuntimeError, match="delete_on_exhaust"):
+            it.reset()

@@ -456,3 +456,11 @@ class TestBinaryWordVectors:
         assert not WordVectorSerializer._looks_binary(p)
         r = WordVectorSerializer.readWord2VecModel(p)
         assert r.hasWord(word)
+
+    def test_mid_float_truncation_diagnostic(self, tmp_path):
+        import struct
+        p = tmp_path / "midfloat.bin"
+        with open(p, "wb") as f:
+            f.write(b"1 2\nw " + struct.pack("<f", 1.0) + b"\x00\x01")
+        with pytest.raises(ValueError, match="truncated vector for 'w'"):
+            WordVectorSerializer.readBinaryModel(p)

@@ -18,6 +18,15 @@ import pytest
 from deeplearning4j_tpu.ops import pooling
 
 
+@pytest.fixture(autouse=True)
+def _argmax_impl(monkeypatch):
+    """This whole file tests the ARGMAX rewrite. The library default is
+    stock (the measured winner on CPU and TPU v5e — BENCH_NOTES.md), so
+    without this pin every new-vs-reference parity assertion would
+    compare the stock path against itself and pass vacuously."""
+    monkeypatch.setattr(pooling, "_BACKWARD_IMPL", "argmax")
+
+
 CASES = [
     # kernel, stride, padding  (ResNet stem pool = 3x3/2 SAME is the target)
     ((3, 3), (2, 2), "SAME"),
@@ -105,9 +114,10 @@ def test_finite_difference_gradcheck():
 
 
 def test_no_select_and_scatter_in_grad_hlo():
-    # The point of the custom VJP: the compiled backward must not contain
-    # select-and-scatter. Fails loudly if the routing ever regresses to
-    # the stock gradient (e.g. wrapper bypass).
+    # The point of the custom VJP: with the argmax impl selected (the
+    # file-wide fixture), the compiled backward must not contain
+    # select-and-scatter. Fails loudly if the routing ever bypasses the
+    # rewrite (e.g. wrapper bypass).
     def loss(x):
         return jnp.sum(pooling.max_pool2d(x, (3, 3), (2, 2), "SAME") ** 2)
 
