@@ -248,6 +248,12 @@ class TransformProcess:
         def __init__(self, schema: Schema):
             self._initial = schema
             self._steps = []
+            # declarative call log for toJson/fromJson — filled
+            # automatically by the method wrapper installed below the
+            # class body; steps it cannot represent (raw-callable
+            # filters) land in _unserializable and make toJson raise
+            self._spec = []
+            self._unserializable = []
 
         def removeColumns(self, *names):
             def step(schema, recs):
@@ -481,11 +487,20 @@ class TransformProcess:
             return self
 
         def build(self):
-            return TransformProcess(self._initial, self._steps)
+            # the SAME list objects, not copies: _steps is already
+            # shared, so _spec/_unserializable must stay in lockstep —
+            # a builder mutated after build() must not leave the built
+            # process executing steps its serialized form omits
+            return TransformProcess(self._initial, self._steps,
+                                    spec=self._spec,
+                                    unserializable=self._unserializable)
 
-    def __init__(self, initial, steps):
+    def __init__(self, initial, steps, spec=None, unserializable=None):
         self._initial = initial
         self._steps = steps
+        self._spec = spec
+        self._unserializable = [] if unserializable is None \
+            else unserializable
 
     def getInitialSchema(self) -> Schema:
         return self._initial
@@ -502,6 +517,83 @@ class TransformProcess:
         for s in self._steps:
             schema, recs = s(schema, recs)
         return recs
+
+    # ------------- JSON serde (reference: TransformProcess.toJson /
+    # fromJson — DataVec pipelines persist next to the model) ---------
+    def toJson(self) -> str:
+        import json as _json
+
+        if self._unserializable:
+            raise ValueError(
+                "pipeline contains steps whose arguments cannot be "
+                f"serialized: {self._unserializable} — raw callables "
+                "have no portable form; use "
+                "ConditionFilter(ColumnCondition(...)) for "
+                "JSON-representable predicates")
+        if self._spec is None:
+            raise ValueError("this TransformProcess was constructed "
+                             "directly from step closures, not through "
+                             "Builder — no declarative spec to serialize")
+        return _json.dumps({
+            "initialSchema": {"columns": self._initial._cols},
+            "steps": self._spec,
+        }, indent=1)
+
+    @staticmethod
+    def fromJson(text: str) -> "TransformProcess":
+        import json as _json
+
+        from deeplearning4j_tpu.util import serde as _serde
+
+        d = _json.loads(text)
+        cols = [(n, k, m) for n, k, m in d["initialSchema"]["columns"]]
+        b = TransformProcess.Builder(Schema(cols))
+        for entry in d["steps"]:
+            args = _serde.decode(entry["args"], [])
+            kwargs = _serde.decode(entry["kwargs"], [])
+            getattr(b, entry["op"])(*args, **kwargs)
+        return b.build()
+
+
+def _install_spec_recording():
+    """Wrap every chainable TransformProcess.Builder method to log its
+    call declaratively for toJson/fromJson, using the package's shared
+    tagged-tree codec (util/serde.py) — which snapshots mutable args at
+    record time, preserves non-string dict keys, handles numpy scalars
+    and in-package objects (ColumnCondition/ConditionFilter), and
+    refuses functions. A step whose arguments the codec rejects (a raw
+    callable predicate) marks the pipeline unserializable — recorded,
+    surfaced by toJson's error."""
+    import functools
+
+    from deeplearning4j_tpu.util import serde as _serde
+
+    B = TransformProcess.Builder
+
+    def wrap(name, fn):
+        @functools.wraps(fn)
+        def wrapper(self, *args, **kwargs):
+            out = fn(self, *args, **kwargs)
+            try:
+                arrays = []
+                e_args = _serde.encode(list(args), arrays)
+                e_kwargs = _serde.encode(kwargs, arrays)
+                if arrays:  # transform args are config scalars, never
+                    raise TypeError("array-valued transform argument")
+                self._spec.append({"op": name, "args": e_args,
+                                   "kwargs": e_kwargs})
+            except TypeError:
+                self._unserializable.append(name)
+            return out
+        return wrapper
+
+    for name, fn in list(vars(B).items()):
+        if name.startswith("_") or name == "build":
+            continue
+        setattr(B, name, wrap(name, fn))
+
+
+_install_spec_recording()
 
 
 # ----------------------------------------------- reader -> DataSet iterator

@@ -42,6 +42,9 @@ def encode(obj, arrays: list):
         return [encode(v, arrays) for v in obj]
     if isinstance(obj, tuple):
         return {"__t": [encode(v, arrays) for v in obj]}
+    if isinstance(obj, (set, frozenset)):
+        # sorted for deterministic output (members are config scalars)
+        return {"__s": [encode(v, arrays) for v in sorted(obj, key=repr)]}
     if isinstance(obj, dict):
         return {"__d": [[encode(k, arrays), encode(v, arrays)]
                         for k, v in obj.items()]}
@@ -79,6 +82,8 @@ def decode(node, arrays):
         return jnp.asarray(a)
     if "__t" in node:
         return tuple(decode(v, arrays) for v in node["__t"])
+    if "__s" in node:
+        return {decode(v, arrays) for v in node["__s"]}
     if "__d" in node:
         return {decode(k, arrays): decode(v, arrays) for k, v in node["__d"]}
     if "__dt" in node:

@@ -909,3 +909,107 @@ class TestMiniBatchFileIterator:
         assert len([b for b in it]) == 2
         with pytest.raises(RuntimeError, match="delete_on_exhaust"):
             it.reset()
+
+
+class TestTransformProcessJson:
+    """TransformProcess.toJson/fromJson (reference: DataVec
+    TransformProcess JSON persistence)."""
+
+    def _schema(self):
+        return (Schema.Builder().addColumnDouble("x")
+                .addColumnCategorical("c", "a", "b")
+                .addColumnString("s").build())
+
+    def test_roundtrip_execution_parity(self):
+        from deeplearning4j_tpu.data import TransformProcess as TP
+        tp = (TP.Builder(self._schema())
+              .doubleMathOp("x", "Multiply", 3.0)
+              .categoricalToOneHot("c")
+              .appendStringColumnTransform("s", "_z")
+              .build())
+        tp2 = TP.fromJson(tp.toJson())
+        rows = [[1.0, "a", "p"], [2.0, "b", "q"]]
+        assert tp2.execute([list(r) for r in rows]) == \
+            tp.execute([list(r) for r in rows])
+        assert tp2.getFinalSchema().getColumnNames() == \
+            tp.getFinalSchema().getColumnNames()
+
+    def test_condition_filter_roundtrips(self):
+        from deeplearning4j_tpu.data import TransformProcess as TP
+        from deeplearning4j_tpu.data.transform import (
+            ColumnCondition, ConditionFilter, ConditionOp)
+        tp = (TP.Builder(self._schema())
+              .filter(ConditionFilter(ColumnCondition(
+                  "c", ConditionOp.InSet, {"b"})))
+              .build())
+        tp2 = TP.fromJson(tp.toJson())
+        out = tp2.execute([[1.0, "a", "p"], [2.0, "b", "q"]])
+        assert out == [[1.0, "a", "p"]]  # 'b' rows removed
+
+    def test_conditional_replace_roundtrips(self):
+        from deeplearning4j_tpu.data import TransformProcess as TP
+        from deeplearning4j_tpu.data.transform import (
+            ColumnCondition, ConditionOp)
+        tp = (TP.Builder(self._schema())
+              .conditionalReplaceValueTransform(
+                  "x", -1.0, ColumnCondition("x", ConditionOp.GreaterThan,
+                                             5.0))
+              .build())
+        tp2 = TP.fromJson(tp.toJson())
+        assert tp2.execute([[9.0, "a", "p"]]) == [[-1.0, "a", "p"]]
+
+    def test_raw_callable_filter_refuses_loudly(self):
+        from deeplearning4j_tpu.data import TransformProcess as TP
+        tp = (TP.Builder(self._schema())
+              .filter(lambda rec: rec["x"] > 0)
+              .build())
+        with pytest.raises(ValueError, match="cannot be serialized"):
+            tp.toJson()
+
+    def test_json_is_plain_data(self):
+        import json
+        from deeplearning4j_tpu.data import TransformProcess as TP
+        tp = (TP.Builder(self._schema())
+              .removeColumns("s").renameColumn("x", "y").build())
+        d = json.loads(tp.toJson())
+        assert [e["op"] for e in d["steps"]] == ["removeColumns",
+                                                 "renameColumn"]
+        assert d["initialSchema"]["columns"][0] == ["x", "double", None]
+
+    def test_builder_mutation_after_build_stays_consistent(self):
+        # _steps/_spec/_unserializable share storage: a builder mutated
+        # after build() must not leave the process executing steps its
+        # serialized form omits
+        from deeplearning4j_tpu.data import TransformProcess as TP
+        b = TP.Builder(self._schema())
+        tp = b.build()
+        b.filter(lambda rec: rec["x"] > 0)
+        assert tp.execute([[1.0, "a", "p"], [-1.0, "b", "q"]]) == \
+            [[-1.0, "b", "q"]]  # the filter runs
+        with pytest.raises(ValueError, match="cannot be serialized"):
+            tp.toJson()        # ...so serialization must refuse
+
+    def test_int_keyed_mapping_roundtrips(self):
+        from deeplearning4j_tpu.data import TransformProcess as TP
+        s = Schema.Builder().addColumnInteger("i").build()
+        tp = TP.Builder(s).stringMapTransform("i", {1: 99}).build()
+        tp2 = TP.fromJson(tp.toJson())
+        assert tp2.execute([[1], [2]]) == tp.execute([[1], [2]]) == \
+            [[99], [2]]
+
+    def test_arg_mutation_after_record_does_not_leak(self):
+        from deeplearning4j_tpu.data import TransformProcess as TP
+        m = {"a": "b"}
+        tp = TP.Builder(self._schema()).stringMapTransform("s", m).build()
+        m["a"] = "CHANGED"
+        tp2 = TP.fromJson(tp.toJson())
+        assert tp.execute([[1.0, "a", "a"]]) == \
+            tp2.execute([[1.0, "a", "a"]]) == [[1.0, "a", "b"]]
+
+    def test_numpy_scalar_arg_serializes(self):
+        import numpy as _np
+        from deeplearning4j_tpu.data import TransformProcess as TP
+        tp = (TP.Builder(self._schema())
+              .doubleMathOp("x", "Multiply", _np.float64(2.0)).build())
+        tp2 = TP.fromJson(tp.toJson())  # must NOT be "unserializable"
+        assert tp2.execute([[3.0, "a", "p"]])[0][0] == 6.0
