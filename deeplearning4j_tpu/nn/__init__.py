@@ -4,6 +4,7 @@ Reference: deeplearning4j-nn (org.deeplearning4j.nn.*).
 """
 
 from deeplearning4j_tpu.nn.activations import Activation
+from deeplearning4j_tpu.nn.solvers import OptimizationAlgorithm
 from deeplearning4j_tpu.nn.weights import (
     WeightInit, NormalDistribution, UniformDistribution, WeightInitEmbedding)
 from deeplearning4j_tpu.nn.losses import LossFunctions

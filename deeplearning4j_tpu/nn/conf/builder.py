@@ -35,8 +35,7 @@ class GradientNormalization:
 class MultiLayerConfiguration:
     def __init__(self, layers, defaults, seed, dataType, inputType,
                  preprocessors, backpropType, tbpttFwdLength, tbpttBackLength,
-                 gradientNormalization=None, gradientNormalizationThreshold=1.0,
-                 maxNumLineSearchIterations=None):
+                 gradientNormalization=None, gradientNormalizationThreshold=1.0):
         self.layers = layers
         self.defaults = defaults
         self.seed = seed
@@ -51,6 +50,10 @@ class MultiLayerConfiguration:
         self.activationCheckpointing = defaults.get(
             "activationCheckpointing", False)
         self.checkpointPolicy = defaults.get("checkpointPolicy")
+        self.optimizationAlgo = defaults.get(
+            "optimizationAlgo", "STOCHASTIC_GRADIENT_DESCENT")
+        self.maxNumLineSearchIterations = defaults.get(
+            "maxNumLineSearchIterations", 20)
         # resolved per-layer input types (set during shape inference)
         self.layerInputTypes = []
 
@@ -241,6 +244,23 @@ class NeuralNetConfiguration:
             self._d = {}
 
         # fluent setters, mirroring the reference builder
+        def optimizationAlgo(self, algo):
+            """Reference: NeuralNetConfiguration.Builder.optimizationAlgo
+            (OptimizationAlgorithm enum): STOCHASTIC_GRADIENT_DESCENT
+            (default, per-layer updaters), LINE_GRADIENT_DESCENT,
+            CONJUGATE_GRADIENT, or LBFGS (nn/solvers.py — whole-pytree
+            optax step with jitted line search)."""
+            from deeplearning4j_tpu.nn.solvers import OptimizationAlgorithm
+
+            self._d["optimizationAlgo"] = OptimizationAlgorithm.resolve(algo)
+            return self
+
+        def maxNumLineSearchIterations(self, n):
+            """Line-search iteration cap for the non-SGD algorithms
+            (reference: Builder.maxNumLineSearchIterations)."""
+            self._d["maxNumLineSearchIterations"] = int(n)
+            return self
+
         def seed(self, s):
             self._d["seed"] = int(s)
             return self

@@ -380,6 +380,10 @@ class ComputationGraphConfiguration:
         self.activationCheckpointing = defaults.get(
             "activationCheckpointing", False)
         self.checkpointPolicy = defaults.get("checkpointPolicy")
+        self.optimizationAlgo = defaults.get(
+            "optimizationAlgo", "STOCHASTIC_GRADIENT_DESCENT")
+        self.maxNumLineSearchIterations = defaults.get(
+            "maxNumLineSearchIterations", 20)
         self.topoOrder = self._topo_sort()
         self._infer_shapes()
 

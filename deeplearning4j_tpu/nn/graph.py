@@ -39,9 +39,21 @@ class ComputationGraph:
         self._listeners = []
         self._compute_dtype = conf.dataType.np_dtype
         self._param_dtype = jnp.float64 if self._compute_dtype == jnp.float64 else jnp.float32
+        algo = getattr(conf, "optimizationAlgo",
+                       "STOCHASTIC_GRADIENT_DESCENT")
+        if algo != "STOCHASTIC_GRADIENT_DESCENT":
+            from deeplearning4j_tpu.nn import solvers as _solvers
+
+            self._solver = _solvers.build_solver(
+                algo, getattr(conf, "maxNumLineSearchIterations", 20))
+        else:
+            self._solver = None
         self._jit_train = jax.jit(self._train_step,
                                   static_argnames=("use_carries",),
-                                  donate_argnums=(0, 1, 2))
+                                  # optax solver states alias the param
+                                  # buffers (see MultiLayerNetwork)
+                                  donate_argnums=(0, 1, 2)
+                                  if self._solver is None else (2,))
         self._jit_forward = jax.jit(self._forward_infer)
         self._jit_loss = jax.jit(self._loss_only)
 
@@ -60,6 +72,8 @@ class ComputationGraph:
             upd_states[name] = u.init(p) if p else ()
         self._params, self._states = params, states
         self._updaters, self._upd_states = upds, upd_states
+        if self._solver is not None:
+            self._upd_states = self._solver.init(params)
         return self
 
     def initFrom(self, params, states, upd_states=None):
@@ -71,7 +85,11 @@ class ComputationGraph:
             payload = self.conf.nodes[name].payload
             self._updaters[name] = (_upd.resolve(payload.updater)
                                     if payload.updater is not None else _upd.Sgd())
-        if upd_states is not None:
+        if self._solver is not None:
+            # solver memory is batch-local and not serialized — fresh
+            # state on restore (see MultiLayerNetwork.initFrom)
+            self._upd_states = self._solver.init(params)
+        elif upd_states is not None:
             self._upd_states = upd_states
         else:
             self._upd_states = {
@@ -275,6 +293,26 @@ class ComputationGraph:
             loss = loss_transform(loss)
         if state_transform is not None:
             new_states = state_transform(new_states)
+        if self._solver is not None:
+            from deeplearning4j_tpu.nn import solvers as _solvers
+
+            def value_fn(ps):
+                return self._ckpt_loss_fn(use_carries)(
+                    ps, states, inputs, labels, key, fmasks, lmasks)[0]
+
+            new_params, new_upd = _solvers.solver_update(
+                self._solver, grads, upd_states, params, loss, value_fn)
+            for name in self._layer_names:
+                payload = self.conf.nodes[name].payload
+                if getattr(payload, "frozen", False):
+                    new_params[name] = params[name]
+                cs = getattr(payload, "constraints", None)
+                if cs and new_params[name]:
+                    from deeplearning4j_tpu.nn.conf.constraint import \
+                        apply_constraints
+                    new_params[name] = apply_constraints(
+                        cs, new_params[name])
+            return new_params, new_upd, new_states, loss
         glist = _grad_normalize([grads[n] for n in self._layer_names],
                                 self.conf.gradientNormalization,
                                 self.conf.gradientNormalizationThreshold)

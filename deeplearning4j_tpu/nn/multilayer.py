@@ -141,10 +141,22 @@ class MultiLayerNetwork:
         # params kept fp32 for stable updates even when compute is bf16/fp16;
         # fp64 dataType (gradient checks) promotes params too
         self._param_dtype = jnp.float64 if self._compute_dtype == jnp.float64 else jnp.float32
+        algo = getattr(conf, "optimizationAlgo",
+                       "STOCHASTIC_GRADIENT_DESCENT")
+        if algo != "STOCHASTIC_GRADIENT_DESCENT":
+            from deeplearning4j_tpu.nn import solvers as _solvers
+
+            self._solver = _solvers.build_solver(
+                algo, getattr(conf, "maxNumLineSearchIterations", 20))
+        else:
+            self._solver = None
         self._jit_train = jax.jit(
             self._train_step,
             static_argnames=("use_carries",),
-            donate_argnums=(0, 1, 2),
+            # solver (optax) states alias the param buffers (L-BFGS
+            # keeps previous params/updates); donating both would be
+            # `f(donate(a), donate(a))` — donate states only there
+            donate_argnums=(0, 1, 2) if self._solver is None else (2,),
         )
         self._jit_forward = jax.jit(self._forward_infer)
         self._jit_loss = jax.jit(self._loss_only)
@@ -165,6 +177,9 @@ class MultiLayerNetwork:
             upd_states.append(u.init(p) if p else ())
         self._params, self._states = params, states
         self._updaters, self._upd_states = upds, upd_states
+        if self._solver is not None:
+            # whole-pytree optimizer state replaces the per-layer list
+            self._upd_states = self._solver.init(params)
         self._iteration = 0
         return self
 
@@ -175,7 +190,12 @@ class MultiLayerNetwork:
         self._updaters = [
             _upd.resolve(l.updater) if l.updater is not None else _upd.Sgd()
             for l in self.layers]
-        if upd_states is not None:
+        if self._solver is not None:
+            # solver memory (L-BFGS curvature pairs, CG direction) is
+            # batch-local and not serialized — fresh state on restore,
+            # like the reference's solvers which rebuild per fit call
+            self._upd_states = self._solver.init(params)
+        elif upd_states is not None:
             self._upd_states = upd_states
         else:
             self._upd_states = [u.init(p) if p else ()
@@ -332,6 +352,28 @@ class MultiLayerNetwork:
             loss = loss_transform(loss)
         if state_transform is not None:
             new_states = state_transform(new_states)
+        if self._solver is not None:
+            # LBFGS / CG / line search: one whole-pytree step; the line
+            # search re-evaluates THIS batch's loss (same dropout key),
+            # so grads stay un-normalized — they must be the true
+            # gradient of value_fn for the Wolfe/Armijo conditions
+            from deeplearning4j_tpu.nn import solvers as _solvers
+
+            def value_fn(ps):
+                return self._ckpt_loss_fn(use_carries)(
+                    ps, states, x, y, key, fmask, lmask)[0]
+
+            new_params, new_upd = _solvers.solver_update(
+                self._solver, grads, upd_states, params, loss, value_fn)
+            for i, layer in enumerate(self.layers):
+                if getattr(layer, "frozen", False):
+                    new_params[i] = params[i]
+                cs = getattr(layer, "constraints", None)
+                if cs and new_params[i]:
+                    from deeplearning4j_tpu.nn.conf.constraint import \
+                        apply_constraints
+                    new_params[i] = apply_constraints(cs, new_params[i])
+            return new_params, new_upd, new_states, loss
         grads = _grad_normalize(grads, self.conf.gradientNormalization,
                                 self.conf.gradientNormalizationThreshold)
         new_params, new_upd_states = [], []
@@ -521,6 +563,13 @@ class MultiLayerNetwork:
         layer's own pretrain_loss (negative ELBO for VAE) in a donated
         jitted step (reference: MultiLayerNetwork.pretrainLayer)."""
         self._require_init()
+        if self._solver is not None:
+            raise ValueError(
+                "layerwise pretraining uses the per-layer updater path; "
+                "it is not defined under a whole-pytree "
+                f"optimizationAlgo ({self.conf.optimizationAlgo}) — "
+                "pretrain with STOCHASTIC_GRADIENT_DESCENT, then fine-"
+                "tune with the solver")
         layer = self.layers[layerIdx]
         if not getattr(layer, "pretrainable", False):
             raise ValueError(f"Layer {layerIdx} "

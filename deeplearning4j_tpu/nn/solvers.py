@@ -1,0 +1,137 @@
+"""Second-order / line-search optimization algorithms.
+
+Reference: org.deeplearning4j.nn.api.OptimizationAlgorithm +
+optimize.solvers.{StochasticGradientDescent, LineGradientDescent,
+ConjugateGradient, LBFGS} and BaseOptimizer's line-maximizer loop.
+Upstream runs these as host-side Java loops calling into the JVM
+backprop; here each one is an optax GradientTransformationExtraArgs
+applied inside the SAME jitted train step as SGD — the zoom/backtracking
+line searches re-evaluate the loss closure under jit (XLA while_loop),
+so a full L-BFGS iteration including line search is one device
+dispatch.
+
+SGD stays on the per-layer updater loop (Adam/Nesterovs/... with their
+schedules); the algorithms here replace that loop with one whole-pytree
+update because direction construction (CG beta, L-BFGS two-loop) and
+step-size search couple all layers through global inner products.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class OptimizationAlgorithm:
+    STOCHASTIC_GRADIENT_DESCENT = "STOCHASTIC_GRADIENT_DESCENT"
+    LINE_GRADIENT_DESCENT = "LINE_GRADIENT_DESCENT"
+    CONJUGATE_GRADIENT = "CONJUGATE_GRADIENT"
+    LBFGS = "LBFGS"
+
+    _ALL = (STOCHASTIC_GRADIENT_DESCENT, LINE_GRADIENT_DESCENT,
+            CONJUGATE_GRADIENT, LBFGS)
+
+    @staticmethod
+    def resolve(algo) -> str:
+        name = str(algo).upper()
+        if name not in OptimizationAlgorithm._ALL:
+            raise ValueError(
+                f"unknown OptimizationAlgorithm {algo!r}; one of "
+                f"{OptimizationAlgorithm._ALL}")
+        return name
+
+
+def _vdot(a, b):
+    leaves = jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(lambda x, y: jnp.vdot(x, y), a, b))
+    return sum(leaves) if leaves else jnp.asarray(0.0)
+
+
+class _PRState(NamedTuple):
+    prev_grad: Any
+    prev_dir: Any
+    first: jnp.ndarray  # bool: no history yet
+
+
+def _scale_by_polak_ribiere():
+    """Nonlinear conjugate-gradient direction (Polak-Ribiere+ with
+    steepest-descent restart when the CG direction loses descent) —
+    the direction construction inside upstream's ConjugateGradient.
+    Input updates are GRADIENTS; output is the (downhill) direction to
+    be scaled by the chained line search."""
+    import optax
+
+    def init_fn(params):
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        return _PRState(zeros, zeros, jnp.asarray(True))
+
+    def update_fn(updates, state, params=None, **extra):
+        del params, extra
+        g = updates
+        num = _vdot(g, jax.tree_util.tree_map(
+            lambda a, b: a - b, g, state.prev_grad))
+        den = _vdot(state.prev_grad, state.prev_grad)
+        beta = jnp.where(den > 0, jnp.maximum(num / jnp.where(den > 0, den, 1.0), 0.0), 0.0)
+        beta = jnp.where(state.first, 0.0, beta)
+        d = jax.tree_util.tree_map(
+            lambda gi, di: -gi + beta * di, g, state.prev_dir)
+        # restart on loss of descent: d must satisfy d . g < 0
+        descent = _vdot(d, g)
+        use_d = descent < 0
+        d = jax.tree_util.tree_map(
+            lambda di, gi: jnp.where(use_d, di, -gi), d, g)
+        return d, _PRState(g, d, jnp.asarray(False))
+
+    return optax.GradientTransformationExtraArgs(init_fn, update_fn)
+
+
+def build_solver(algo: str, maxIterations: int = 20):
+    """optax transformation for a non-SGD OptimizationAlgorithm.
+    maxIterations bounds the line-search inner loop (reference:
+    BaseOptimizer.maxIterations on the line maximizer). optax is
+    imported lazily: the nn package re-exports OptimizationAlgorithm,
+    and merely importing constants must not require optax."""
+    import optax
+
+    algo = OptimizationAlgorithm.resolve(algo)
+    if algo == OptimizationAlgorithm.LBFGS:
+        return optax.lbfgs(  # memory 10
+            linesearch=optax.scale_by_zoom_linesearch(
+                max_linesearch_steps=maxIterations,
+                # optax.lbfgs()'s own default; the fresh-unit initial
+                # step is what keeps MINIBATCH L-BFGS stable (a carried
+                # guess from another batch's curvature diverges)
+                initial_guess_strategy="one"))
+    if algo == OptimizationAlgorithm.CONJUGATE_GRADIENT:
+        return optax.chain(
+            _scale_by_polak_ribiere(),
+            optax.scale_by_backtracking_linesearch(
+                max_backtracking_steps=maxIterations,
+                increase_factor=1.5, max_learning_rate=1.0))
+    if algo == OptimizationAlgorithm.LINE_GRADIENT_DESCENT:
+        return optax.chain(
+            optax.scale(-1.0),
+            optax.scale_by_backtracking_linesearch(
+                max_backtracking_steps=maxIterations,
+                increase_factor=1.5, max_learning_rate=1.0))
+    raise ValueError(f"{algo} is the per-layer updater path, not a solver")
+
+
+def solver_update(solver, grads, opt_state, params, loss, value_fn):
+    """One whole-pytree solver step -> (new_params, new_opt_state).
+    value_fn(params) re-evaluates the SAME loss (same batch, same
+    dropout key) for the line search; under jit it becomes an XLA
+    while_loop body, not host round-trips."""
+    import optax
+
+    updates, opt_state = solver.update(
+        grads, opt_state, params, value=loss, grad=grads,
+        value_fn=value_fn)
+    new_params = optax.apply_updates(params, updates)
+    # param dtype stability (python-float line-search etas would promote
+    # under x64), matching the SGD path's cast
+    new_params = jax.tree_util.tree_map(
+        lambda p, n: n.astype(p.dtype), params, new_params)
+    return new_params, opt_state

@@ -47,6 +47,13 @@ class ParallelWrapper:
     def __init__(self, net, mesh=None, gradient_compression=None,
                  batch_axis=_mesh.DATA_AXIS, threshold=1e-3,
                  targetSparsity=None):
+        if getattr(net, "_solver", None) is not None:
+            raise ValueError(
+                "distributed trainers require "
+                "optimizationAlgo=STOCHASTIC_GRADIENT_DESCENT: a shard-"
+                "local line search (LBFGS/CG) would accept a different "
+                "step size on every replica and silently desynchronize "
+                "the supposedly-replicated parameters")
         self.net = net
         self.mesh = mesh or _mesh.data_parallel_mesh()
         self.batch_axis = batch_axis

@@ -24,7 +24,10 @@ def _net_payload(net, saveUpdater: bool) -> dict:
         "conf": net.conf,
         "params": net._params,
         "states": net._strip_carries(net._states),
-        "upd_states": net._upd_states if saveUpdater else None,
+        # solver (LBFGS/CG) memory is optax state — batch-local and
+        # out-of-package for the codec; restore re-inits it (initFrom)
+        "upd_states": net._upd_states
+        if saveUpdater and getattr(net, "_solver", None) is None else None,
         "iteration": net._iteration,
         "epoch": net._epoch,
     }
