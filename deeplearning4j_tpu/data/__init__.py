@@ -6,8 +6,11 @@ Reference: org.nd4j.linalg.dataset + deeplearning4j-datasets + datavec.
 from deeplearning4j_tpu.data.dataset import (
     DataSet, DataSetIterator, ListDataSetIterator, ExistingDataSetIterator,
     KFoldIterator, MultipleEpochsIterator, ViewIterator,
-    MiniBatchFileDataSetIterator,
+    MiniBatchFileDataSetIterator, ExistingMiniBatchDataSetIterator,
     SplitTestAndTrain,
+)
+from deeplearning4j_tpu.data.multireader import (
+    RecordReaderMultiDataSetIterator,
 )
 from deeplearning4j_tpu.data.multidataset import MultiDataSet, MultiDataSetIterator
 from deeplearning4j_tpu.data.normalizers import (
@@ -44,6 +47,7 @@ __all__ = [
     "DataSet", "DataSetIterator", "ListDataSetIterator",
     "ExistingDataSetIterator", "KFoldIterator", "MultipleEpochsIterator",
     "ViewIterator", "MiniBatchFileDataSetIterator",
+    "ExistingMiniBatchDataSetIterator", "RecordReaderMultiDataSetIterator",
     "SplitTestAndTrain", "MultiDataSet",
     "MultiDataSetIterator", "DataNormalization", "NormalizerStandardize",
     "NormalizerMinMaxScaler", "ImagePreProcessingScaler",

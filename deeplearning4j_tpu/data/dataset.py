@@ -435,3 +435,102 @@ class MiniBatchFileDataSetIterator:
 
     def getPreProcessor(self):
         return self._preprocessor
+
+
+class ExistingMiniBatchDataSetIterator:
+    """Streams previously saved minibatch files (reference:
+    org.deeplearning4j.datasets.iterator.ExistingMiniBatchDataSetIterator)
+    — the read-side pair of MiniBatchFileDataSetIterator: point it at a
+    rootDir of dataset-*.npz files (any directory the writer produced,
+    from this process or an earlier one)."""
+
+    def __init__(self, rootDir, pattern="dataset-%d.npz", pad_final=True):
+        import os
+        import re
+
+        self._dir = str(rootDir)
+        if not os.path.isdir(self._dir):
+            raise ValueError(f"{self._dir} is not a directory")
+        rx = re.compile("^" + re.escape(pattern).replace("%d", r"(\d+)")
+                        + "$")
+        found = []
+        for f in os.listdir(self._dir):
+            m = rx.match(f)
+            if m:
+                found.append((int(m.group(1)), os.path.join(self._dir, f)))
+        if not found:
+            raise ValueError(
+                f"no files matching {pattern!r} in {self._dir}")
+        self._paths = [p for _, p in sorted(found)]
+        self._pad_final = bool(pad_final)
+        # batch size = the writer's (first file's) row count; total
+        # examples = true rows on disk — one metadata sweep, arrays
+        # discarded immediately
+        sizes = []
+        with np.load(self._paths[0]) as z0:
+            self._in_cols = int(np.prod(z0["features"].shape[1:]))
+            self._outcomes = int(z0["labels"].shape[-1])
+        for p in self._paths:
+            with np.load(p) as z:
+                sizes.append(int(z["features"].shape[0]))
+        self._batch = sizes[0]
+        self._n = sum(sizes)
+        self._preprocessor = None
+        self.reset()
+
+    def reset(self):
+        self._i = 0
+
+    def hasNext(self) -> bool:
+        return self._i < len(self._paths)
+
+    def _load(self, i):
+        z = np.load(self._paths[i])
+        return (z["features"], z["labels"],
+                z["features_mask"] if "features_mask" in z.files else None,
+                z["labels_mask"] if "labels_mask" in z.files else None)
+
+    def next(self, num=None) -> DataSet:
+        if num is not None and int(num) != self._batch:
+            raise ValueError(
+                f"batches were split to files at batchSize={self._batch}; "
+                f"next({num}) cannot re-batch them")
+        if not self.hasNext():
+            raise StopIteration
+        f, l, fm, lm = self._load(self._i)
+        self._i += 1
+        if self._pad_final and len(f) < self._batch:
+            f, l, fm, lm = _pad_batch(f, l, fm, lm, self._batch)
+        ds = DataSet(f, l, fm, lm)
+        if self._preprocessor is not None:
+            self._preprocessor.preProcess(ds)
+        return ds
+
+    def _raw_batches(self):
+        # unpadded, preprocessor-free pass for normalizer statistics
+        for i in range(len(self._paths)):
+            f, l, _, _ = self._load(i)
+            yield f, l
+
+    def __iter__(self):
+        self.reset()
+        while self.hasNext():
+            yield self.next()
+
+    def batch(self) -> int:
+        return self._batch
+
+    def totalExamples(self) -> int:
+        return self._n
+
+    def inputColumns(self) -> int:
+        return self._in_cols
+
+    def totalOutcomes(self) -> int:
+        return self._outcomes
+
+    def setPreProcessor(self, pp):
+        self._preprocessor = pp
+
+    def getPreProcessor(self):
+        return self._preprocessor
