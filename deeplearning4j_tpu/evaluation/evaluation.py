@@ -20,14 +20,21 @@ def _to_np(a):
 
 
 class Evaluation:
-    def __init__(self, numClasses=None, labelsList=None):
+    def __init__(self, numClasses=None, labelsList=None, topN=1):
         self._n = numClasses
         self._labels = labelsList
         self._conf = None  # confusion matrix [actual, predicted]
+        # reference: Evaluation(int numClasses, Integer topN) — track
+        # how often the true class lands in the top-N scores
+        self._topN = int(topN)
+        self._topn_correct = 0
+        self._topn_total = 0
 
     def reset(self):
         """Clear accumulated statistics (reference: IEvaluation.reset())."""
         self._conf = None
+        self._topn_correct = 0
+        self._topn_total = 0
 
     def eval(self, labels, predictions, mask=None):
         y = _to_np(labels)
@@ -48,12 +55,25 @@ class Evaluation:
         actual = np.argmax(y, axis=-1)
         pred = np.argmax(p, axis=-1)
         np.add.at(self._conf, (actual, pred), 1)
+        if self._topN > 1:
+            k = min(self._topN, p.shape[-1])
+            topk = np.argpartition(-p, k - 1, axis=-1)[:, :k]
+            self._topn_correct += int((topk == actual[:, None]).any(-1).sum())
+            self._topn_total += len(actual)
         return self
 
     # ----- metrics ----------------------------------------------------
     def accuracy(self) -> float:
         c = self._conf
         return float(np.trace(c)) / max(1, c.sum())
+
+    def topNAccuracy(self) -> float:
+        """Fraction of examples whose true class was among the topN
+        scores (reference: Evaluation.topNAccuracy). topN=1 collapses
+        to accuracy()."""
+        if self._topN <= 1:
+            return self.accuracy()
+        return self._topn_correct / max(1, self._topn_total)
 
     def _per_class(self):
         c = self._conf.astype(np.float64)

@@ -375,3 +375,42 @@ class TestNetEvaluationVariants:
         from deeplearning4j_tpu.evaluation import Evaluation
         with pytest.raises(ValueError, match="single-output"):
             multi.doEvaluation(it, Evaluation())
+
+
+class TestTopNAccuracy:
+    """Evaluation(numClasses, topN) (reference: Evaluation.topNAccuracy)."""
+
+    def test_topn_counts(self):
+        from deeplearning4j_tpu.evaluation import Evaluation
+        y = np.eye(4, dtype="float32")[[0, 1, 2, 3]]
+        # predictions: true class ranked 2nd for rows 0-2, 4th for row 3
+        p = np.array([[0.3, 0.4, 0.2, 0.1],
+                      [0.1, 0.3, 0.4, 0.2],
+                      [0.1, 0.2, 0.3, 0.4],
+                      [0.4, 0.3, 0.2, 0.1]], "float32")
+        e = Evaluation(4, topN=2)
+        e.eval(y, p)
+        assert e.accuracy() == 0.0
+        assert e.topNAccuracy() == 0.75  # rows 0-2 in top-2, row 3 not
+        e3 = Evaluation(4, topN=4)
+        e3.eval(y, p)
+        assert e3.topNAccuracy() == 1.0
+
+    def test_topn_1_equals_accuracy(self):
+        from deeplearning4j_tpu.evaluation import Evaluation
+        rng = np.random.RandomState(0)
+        y = np.eye(5, dtype="float32")[rng.randint(0, 5, 40)]
+        p = rng.rand(40, 5).astype("float32")
+        e = Evaluation(5)
+        e.eval(y, p)
+        assert e.topNAccuracy() == e.accuracy()
+
+    def test_reset_clears_topn(self):
+        from deeplearning4j_tpu.evaluation import Evaluation
+        y = np.eye(3, dtype="float32")[[0, 1]]
+        p = np.eye(3, dtype="float32")[[0, 1]]
+        e = Evaluation(3, topN=2)
+        e.eval(y, p)
+        e.reset()
+        e.eval(y, p)
+        assert e.topNAccuracy() == 1.0
