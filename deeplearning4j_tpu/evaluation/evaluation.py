@@ -21,6 +21,11 @@ def _to_np(a):
 
 class Evaluation:
     def __init__(self, numClasses=None, labelsList=None, topN=1):
+        # reference overload Evaluation(int numClasses, Integer topN):
+        # an int second positional is topN, not a labels list
+        if isinstance(labelsList, int):
+            topN = labelsList
+            labelsList = None
         self._n = numClasses
         self._labels = labelsList
         self._conf = None  # confusion matrix [actual, predicted]
@@ -56,10 +61,12 @@ class Evaluation:
         pred = np.argmax(p, axis=-1)
         np.add.at(self._conf, (actual, pred), 1)
         if self._topN > 1:
-            k = min(self._topN, p.shape[-1])
-            topk = np.argpartition(-p, k - 1, axis=-1)[:, :k]
-            self._topn_correct += int((topk == actual[:, None]).any(-1).sum())
-            self._topn_total += len(actual)
+            p2 = np.atleast_2d(p)          # unbatched 1-D eval() calls
+            a2 = np.atleast_1d(actual)
+            k = min(self._topN, p2.shape[-1])
+            topk = np.argpartition(-p2, k - 1, axis=-1)[:, :k]
+            self._topn_correct += int((topk == a2[:, None]).any(-1).sum())
+            self._topn_total += len(a2)
         return self
 
     # ----- metrics ----------------------------------------------------

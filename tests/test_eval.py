@@ -414,3 +414,17 @@ class TestTopNAccuracy:
         e.reset()
         e.eval(y, p)
         assert e.topNAccuracy() == 1.0
+
+    def test_positional_topn_reference_overload(self):
+        from deeplearning4j_tpu.evaluation import Evaluation
+        y = np.eye(4, dtype="float32")[[0]]
+        p = np.array([[0.3, 0.4, 0.2, 0.1]], "float32")
+        e = Evaluation(4, 2)  # the upstream (numClasses, topN) shape
+        e.eval(y, p)
+        assert e.topNAccuracy() == 1.0 and e.accuracy() == 0.0
+
+    def test_topn_unbatched_1d(self):
+        from deeplearning4j_tpu.evaluation import Evaluation
+        e = Evaluation(3, topN=2)
+        e.eval(np.array([0.0, 1.0, 0.0]), np.array([0.5, 0.3, 0.2]))
+        assert e.topNAccuracy() == 1.0  # true class ranked 2nd
