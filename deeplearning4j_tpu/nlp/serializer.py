@@ -182,6 +182,24 @@ class WordVectorSerializer:
         return StaticWordVectors(words, np.stack(rows))
 
     @staticmethod
+    def writeParagraphVectors(model, path):
+        """Reference: WordVectorSerializer.writeParagraphVectors — the
+        full ParagraphVectors state (word + context + doc tables)."""
+        from deeplearning4j_tpu.nlp.word2vec import ParagraphVectors
+
+        if not isinstance(model, ParagraphVectors):
+            raise TypeError("writeParagraphVectors expects a "
+                            "ParagraphVectors model")
+        model.save(path)
+
+    @staticmethod
+    def readParagraphVectors(path):
+        """Reference: WordVectorSerializer.readParagraphVectors."""
+        from deeplearning4j_tpu.nlp.word2vec import ParagraphVectors
+
+        return ParagraphVectors.load(path)
+
+    @staticmethod
     def _looks_binary(path):
         """Binary-vs-text sniff for readWord2VecModel: a text vector
         file is fully utf-8-decodable; raw float32 payloads essentially
@@ -200,15 +218,23 @@ class WordVectorSerializer:
     def readWord2VecModel(path):
         """Type-dispatching load (reference: readWord2VecModel): a
         native npz (by extension, by the save()-appended '.npz', or by
-        zip magic bytes) restores the full trainable Word2Vec; anything
-        else is parsed as the text format."""
-        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+        zip magic bytes) restores the full trainable model — a
+        ParagraphVectors file (doc-vector table present) comes back as
+        ParagraphVectors, not silently downgraded; anything else is
+        parsed as the text format."""
+        from deeplearning4j_tpu.nlp.word2vec import ParagraphVectors, \
+            Word2Vec
+
+        def _load_native(q):
+            with np.load(Word2Vec._npz(q), allow_pickle=True) as z:
+                is_pv = "D" in z.files
+            return (ParagraphVectors if is_pv else Word2Vec).load(q)
 
         p = str(path)
         if p.endswith(".npz"):
-            return Word2Vec.load(p)
+            return _load_native(p)
         if not os.path.exists(p) and os.path.exists(p + ".npz"):
-            return Word2Vec.load(p)  # Word2Vec.save appended the suffix
+            return _load_native(p)  # save() appended the suffix
         if os.path.exists(p):
             with open(p, "rb") as f:
                 if f.read(4) == b"PK\x03\x04":  # npz = zip container

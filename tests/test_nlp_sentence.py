@@ -464,3 +464,62 @@ class TestBinaryWordVectors:
             f.write(b"1 2\nw " + struct.pack("<f", 1.0) + b"\x00\x01")
         with pytest.raises(ValueError, match="truncated vector for 'w'"):
             WordVectorSerializer.readBinaryModel(p)
+
+
+class TestFastTextIntegration:
+    def test_fasttext_feeds_cnn_sentence_iterator(self):
+        # FastText shares the WordVectors query surface, so it plugs
+        # into CnnSentenceDataSetIterator exactly like Word2Vec
+        from deeplearning4j_tpu.nlp import FastText
+        sents, labels = _corpus(20)
+        ft = (FastText.Builder().minCount(1).dim(12).epochs(10).seed(3)
+              .iterate(CollectionSentenceIterator(sents)).build().fit())
+        it = (CnnSentenceDataSetIterator.Builder()
+              .sentenceProvider(CollectionLabeledSentenceProvider(sents,
+                                                                  labels))
+              .wordVectors(ft).maxSentenceLength(8).minibatchSize(4)
+              .build())
+        ds = it.next()
+        f = np.asarray(ds.getFeatures().jax())
+        assert f.shape == (4, 1, 8, 12)
+        # the embedded rows are exactly FastText's baked vectors
+        first_tokens = sents[0].split()
+        np.testing.assert_allclose(
+            f[0, 0, 0], ft.getWordVector(first_tokens[0]), rtol=1e-5)
+
+
+class TestParagraphVectorsSerializer:
+    def test_write_read_roundtrip(self, tmp_path):
+        from deeplearning4j_tpu.nlp import ParagraphVectors
+        sents, _ = _corpus(16)
+        pv = (ParagraphVectors.Builder()
+              .minWordFrequency(1).layerSize(8).windowSize(2)
+              .iterations(3).seed(1)
+              .iterate(CollectionSentenceIterator(sents))
+              .build().fit())
+        p = tmp_path / "pv"
+        WordVectorSerializer.writeParagraphVectors(pv, p)
+        pv2 = WordVectorSerializer.readParagraphVectors(p)
+        np.testing.assert_allclose(pv2.getParagraphVector(0),
+                                   pv.getParagraphVector(0), rtol=1e-6)
+
+    def test_write_rejects_plain_word2vec(self, tmp_path):
+        sents, _ = _corpus(8)
+        w = _w2v(sents)
+        with pytest.raises(TypeError, match="ParagraphVectors"):
+            WordVectorSerializer.writeParagraphVectors(w, tmp_path / "x")
+
+    def test_read_word2vec_model_returns_paragraph_vectors(self, tmp_path):
+        from deeplearning4j_tpu.nlp import ParagraphVectors
+        sents, _ = _corpus(12)
+        pv = (ParagraphVectors.Builder()
+              .minWordFrequency(1).layerSize(8).windowSize(2)
+              .iterations(2).seed(1)
+              .iterate(CollectionSentenceIterator(sents))
+              .build().fit())
+        p = tmp_path / "pvx"
+        WordVectorSerializer.writeParagraphVectors(pv, p)
+        m = WordVectorSerializer.readWord2VecModel(str(p) + ".npz")
+        assert isinstance(m, ParagraphVectors)
+        np.testing.assert_allclose(m.getParagraphVector(0),
+                                   pv.getParagraphVector(0), rtol=1e-6)
