@@ -8,9 +8,11 @@ by XLA as the kernel library.
 from deeplearning4j_tpu.ndarray.dtype import DataType
 from deeplearning4j_tpu.ndarray.ndarray import INDArray
 from deeplearning4j_tpu.ndarray.factory import Nd4j
+from deeplearning4j_tpu.ndarray.convolution import Convolution
 from deeplearning4j_tpu.ndarray.indexing import NDArrayIndex
 from deeplearning4j_tpu.ndarray.executioner import XlaExecutioner
 from deeplearning4j_tpu.ndarray.transforms import Transforms
 
-__all__ = ["DataType", "INDArray", "Nd4j", "NDArrayIndex", "XlaExecutioner",
+__all__ = ["Convolution",
+           "DataType", "INDArray", "Nd4j", "NDArrayIndex", "XlaExecutioner",
            "Transforms"]

@@ -465,3 +465,83 @@ def test_txt_complex_roundtrip(tmp_path):
     back = Nd4j.readTxt(p)
     np.testing.assert_allclose(back.toNumpy(), [1 + 2j, -0.5j])
     assert back.toNumpy().dtype == np.complex64
+
+
+class TestIm2ColCol2Im:
+    """Convolution.im2col/col2im (reference:
+    org.nd4j.linalg.convolution.Convolution) vs a naive loop oracle."""
+
+    def _oracle_im2col(self, x, kh, kw, sy, sx, ph, pw):
+        b, c, h, w = x.shape
+        oh = (h + 2 * ph - kh) // sy + 1
+        ow = (w + 2 * pw - kw) // sx + 1
+        xp = np.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+        out = np.zeros((b, c, kh, kw, oh, ow), x.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                for oi in range(oh):
+                    for oj in range(ow):
+                        out[:, :, i, j, oi, oj] = \
+                            xp[:, :, oi * sy + i, oj * sx + j]
+        return out
+
+    def test_im2col_matches_oracle(self):
+        from deeplearning4j_tpu.ndarray.convolution import im2col
+        rng = np.random.RandomState(0)
+        for (kh, kw, sy, sx, ph, pw) in [(3, 3, 1, 1, 0, 0),
+                                         (2, 3, 2, 1, 1, 0),
+                                         (3, 2, 2, 2, 1, 1)]:
+            x = rng.randn(2, 3, 7, 6).astype("float32")
+            got = np.asarray(im2col(x, kh, kw, sy, sx, ph, pw))
+            want = self._oracle_im2col(x, kh, kw, sy, sx, ph, pw)
+            np.testing.assert_allclose(got, want, rtol=1e-6,
+                                       err_msg=str((kh, kw, sy, sx)))
+
+    def test_col2im_sums_overlaps(self):
+        from deeplearning4j_tpu.ndarray.convolution import col2im, im2col
+        rng = np.random.RandomState(1)
+        x = rng.randn(1, 2, 5, 5).astype("float32")
+        col = np.asarray(im2col(x, 3, 3, 1, 1, 0, 0))
+        back = np.asarray(col2im(col, 1, 1, 0, 0, h=5, w=5))
+        # each pixel returns multiplied by the number of windows
+        # containing it; the center of a 5x5/3x3/s1 is in 9 windows
+        counts = np.asarray(col2im(np.ones_like(col), 1, 1, 0, 0,
+                                   h=5, w=5))
+        np.testing.assert_allclose(back, x * counts, rtol=1e-6)
+        assert counts[0, 0, 2, 2] == 9 and counts[0, 0, 0, 0] == 1
+
+    def test_adjointness(self):
+        # <im2col(x), y> == <x, col2im(y)> — the property custom
+        # backward passes rely on
+        from deeplearning4j_tpu.ndarray.convolution import col2im, im2col
+        rng = np.random.RandomState(2)
+        x = rng.randn(2, 2, 6, 5).astype("float64")
+        y = rng.randn(2, 2, 3, 2, 3, 4).astype("float64")
+        lhs = float((np.asarray(im2col(x, 3, 2, 2, 1, 1, 0)) * y).sum())
+        rhs = float((x * np.asarray(col2im(y, 2, 1, 1, 0, h=6, w=5))).sum())
+        assert abs(lhs - rhs) < 1e-9
+
+    def test_same_mode_geometry(self):
+        from deeplearning4j_tpu.ndarray.convolution import im2col
+        x = np.zeros((1, 1, 7, 7), "float32")
+        col = np.asarray(im2col(x, 3, 3, 2, 2, isSameMode=True))
+        assert col.shape == (1, 1, 3, 3, 4, 4)  # ceil(7/2) = 4
+
+    def test_validation(self):
+        from deeplearning4j_tpu.ndarray.convolution import col2im, im2col
+        with pytest.raises(ValueError, match="NCHW"):
+            im2col(np.zeros((3, 4, 5), "float32"), 2, 2)
+        with pytest.raises(ValueError, match="does not fit"):
+            im2col(np.zeros((1, 1, 3, 3), "float32"), 5, 5)
+        col = np.zeros((1, 1, 2, 2, 2, 2), "float32")
+        with pytest.raises(ValueError, match="needs the target"):
+            col2im(col)
+        with pytest.raises(ValueError, match="do not match"):
+            col2im(col, h=9, w=9)
+
+    def test_indarray_input_accepted(self):
+        from deeplearning4j_tpu.ndarray import Nd4j
+        from deeplearning4j_tpu.ndarray.convolution import im2col
+        x = Nd4j.rand(1, 2, 4, 4)
+        col = im2col(x, 2, 2, 2, 2)
+        assert col.shape == (1, 2, 2, 2, 2, 2)
