@@ -539,9 +539,14 @@ class TestIm2ColCol2Im:
         with pytest.raises(ValueError, match="do not match"):
             col2im(col, h=9, w=9)
 
-    def test_indarray_input_accepted(self):
-        from deeplearning4j_tpu.ndarray import Nd4j
-        from deeplearning4j_tpu.ndarray.convolution import im2col
+    def test_indarray_in_indarray_out(self):
+        from deeplearning4j_tpu.ndarray import INDArray, Nd4j
+        from deeplearning4j_tpu.ndarray.convolution import col2im, im2col
         x = Nd4j.rand(1, 2, 4, 4)
         col = im2col(x, 2, 2, 2, 2)
-        assert col.shape == (1, 2, 2, 2, 2, 2)
+        assert isinstance(col, INDArray)
+        assert col.shape() == (1, 2, 2, 2, 2, 2)
+        back = col2im(col, 2, 2, 0, 0, h=4, w=4)
+        assert isinstance(back, INDArray)
+        # non-overlapping 2x2/s2 tiling: col2im inverts exactly
+        np.testing.assert_allclose(back.toNumpy(), x.toNumpy(), rtol=1e-6)
