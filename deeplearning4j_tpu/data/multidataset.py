@@ -47,10 +47,16 @@ class MultiDataSetIterator:
                  featuresMasks=None, labelsMasks=None, pad_final=True):
         self._f = [np.asarray(f) for f in MultiDataSet._as_list(featureArrays)]
         self._l = [np.asarray(l) for l in MultiDataSet._as_list(labelArrays)]
+        # per-array mask lists may carry None entries (reference:
+        # MultiDataSet mask arrays are nullable per input/output — a
+        # static input alongside a masked sequence input is the normal
+        # multi-reader case)
         self._fm = None if featuresMasks is None else \
-            [np.asarray(m) for m in MultiDataSet._as_list(featuresMasks)]
+            [None if m is None else np.asarray(m)
+             for m in MultiDataSet._as_list(featuresMasks)]
         self._lm = None if labelsMasks is None else \
-            [np.asarray(m) for m in MultiDataSet._as_list(labelsMasks)]
+            [None if m is None else np.asarray(m)
+             for m in MultiDataSet._as_list(labelsMasks)]
         self._batch = int(batchSize)
         self._pad_final = pad_final
         self.reset()
@@ -63,29 +69,40 @@ class MultiDataSetIterator:
 
     @staticmethod
     def _pad(arrs, pad):
-        return [np.concatenate([a, np.repeat(a[-1:], pad, axis=0)]) for a in arrs]
+        return [None if a is None
+                else np.concatenate([a, np.repeat(a[-1:], pad, axis=0)])
+                for a in arrs]
 
     def next(self) -> MultiDataSet:
         sl = slice(self._cursor, self._cursor + self._batch)
         self._cursor += self._batch
         f = [a[sl] for a in self._f]
         l = [a[sl] for a in self._l]
-        fm = None if self._fm is None else [a[sl] for a in self._fm]
-        lm = None if self._lm is None else [a[sl] for a in self._lm]
+        fm = None if self._fm is None else \
+            [None if a is None else a[sl] for a in self._fm]
+        lm = None if self._lm is None else \
+            [None if a is None else a[sl] for a in self._lm]
         short = self._batch - len(f[0])
         if self._pad_final and short > 0:
             f = self._pad(f, short)
             l = self._pad(l, short)
             if fm is not None:
                 fm = self._pad(fm, short)
+            def tail_mask(lab):
+                m = np.ones((self._batch,)
+                            + (() if lab.ndim == 2 else (lab.shape[2],)),
+                            np.float32)
+                m[-short:] = 0.0
+                return m
+
             if lm is None:
-                lm = []
-                for lab in l:
-                    m = np.ones((self._batch,) + (() if lab.ndim == 2 else (lab.shape[2],)),
-                                np.float32)
-                    m[-short:] = 0.0
-                    lm.append(m)
+                lm = [tail_mask(lab) for lab in l]
             else:
-                lm = [np.concatenate([m, np.zeros((short,) + m.shape[1:], m.dtype)])
-                      for m in lm]
+                # a None entry must ALSO gain a pad-zeroing mask: its
+                # label was padded with duplicated rows like the rest,
+                # and an unmasked duplicate would count in the loss
+                lm = [tail_mask(lab) if m is None
+                      else np.concatenate(
+                          [m, np.zeros((short,) + m.shape[1:], m.dtype)])
+                      for m, lab in zip(lm, l)]
         return MultiDataSet(f, l, fm, lm)

@@ -23,11 +23,26 @@ class RecordReaderMultiDataSetIterator:
             self._batch = int(batchSize)
             self._readers = {}   # name -> RecordReader
             self._specs = []     # (role, reader, kind, args) in call order
+            self._sequence = set()  # names added via addSequenceReader
 
         def addReader(self, name, recordReader):
             if name in self._readers:
                 raise ValueError(f"reader {name!r} already added")
             self._readers[name] = recordReader
+            return self
+
+        def addSequenceReader(self, name, sequenceReader):
+            """A time-series reader (CSVSequenceRecordReader-style:
+            next() returns one sequence as a list of per-step rows).
+            Specs over it produce [B, C, T] NCW arrays padded to the
+            reader's longest sequence, with the matching [B, T] mask
+            attached at the spec's position (reference overload:
+            RecordReaderMultiDataSetIterator.Builder
+            .addSequenceReader)."""
+            if name in self._readers:
+                raise ValueError(f"reader {name!r} already added")
+            self._readers[name] = sequenceReader
+            self._sequence.add(name)
             return self
 
         def _check(self, name):
@@ -62,13 +77,41 @@ class RecordReaderMultiDataSetIterator:
                 raise ValueError("at least one addOutput/"
                                  "addOutputOneHot(...) is required")
             return RecordReaderMultiDataSetIterator(
-                self._batch, self._readers, self._specs)
+                self._batch, self._readers, self._specs,
+                sequence=self._sequence)
 
-    def __init__(self, batchSize, readers, specs):
+    def __init__(self, batchSize, readers, specs, sequence=()):
         from deeplearning4j_tpu.data.records import CSVRecordReader
 
-        records, matrices = {}, {}
+        sequence = set(sequence)
+        records, matrices, seqs = {}, {}, {}
         for name, rr in readers.items():
+            if name in sequence:
+                rr.reset()
+                out = []
+                while rr.hasNext():
+                    steps = rr.next()
+                    if not steps:
+                        raise ValueError(
+                            f"sequence reader {name!r} produced an "
+                            "empty sequence")
+                    step_widths = {len(row) for row in steps}
+                    if len(step_widths) > 1:
+                        raise ValueError(
+                            f"ragged sequence in reader {name!r} "
+                            f"sequence {len(out)}: step widths "
+                            f"{sorted(step_widths)}")
+                    try:
+                        out.append(np.asarray(
+                            [[float(v) for v in row] for row in steps],
+                            np.float32))
+                    except (TypeError, ValueError):
+                        raise ValueError(
+                            f"non-numeric value in sequence reader "
+                            f"{name!r} sequence {len(out)}")
+                seqs[name] = out
+                records[name] = None
+                continue
             # bulk fast path first: EXACTLY CSVRecordReader (matching
             # RecordReaderDataSetIterator's native-parser contract) can
             # hand over the whole file as one float matrix
@@ -82,7 +125,8 @@ class RecordReaderMultiDataSetIterator:
             while rr.hasNext():
                 rows.append(rr.next())
             records[name] = rows
-        counts = {name: (len(matrices[name]) if records[name] is None
+        counts = {name: (len(seqs[name]) if name in seqs
+                         else len(matrices[name]) if records[name] is None
                          else len(records[name]))
                   for name in readers}
         if len(set(counts.values())) > 1:
@@ -93,9 +137,29 @@ class RecordReaderMultiDataSetIterator:
         if n == 0:
             raise ValueError("readers produced no records")
 
-        widths = {name: (matrices[name].shape[1] if records[name] is None
-                         else min(len(r) for r in records[name]))
-                  for name in readers}
+        widths = {}
+        seq_pack = {}   # name -> (padded [N, width, Tmax], mask [N, Tmax])
+        for name in readers:
+            if name in seqs:
+                ss = seqs[name]
+                wset = {a.shape[1] for a in ss}
+                if len(wset) > 1:
+                    raise ValueError(
+                        f"sequence reader {name!r} has inconsistent "
+                        f"column counts across sequences: {sorted(wset)}")
+                widths[name] = wset.pop()
+                tmax = max(a.shape[0] for a in ss)
+                packed = np.zeros((len(ss), widths[name], tmax),
+                                  np.float32)
+                mask = np.zeros((len(ss), tmax), np.float32)
+                for i, a in enumerate(ss):
+                    packed[i, :, :a.shape[0]] = a.T   # [T,C] -> [C,T]
+                    mask[i, :a.shape[0]] = 1.0
+                seq_pack[name] = (packed, mask)
+            elif records[name] is None:
+                widths[name] = matrices[name].shape[1]
+            else:
+                widths[name] = min(len(r) for r in records[name])
         col_cache = {}
 
         def get_col(name, c):
@@ -125,8 +189,45 @@ class RecordReaderMultiDataSetIterator:
             return out
 
         features, labels = [], []
+        fmasks, lmasks = [], []
         for role, name, kind, args in specs:
             width = widths[name]
+            if name in seq_pack:
+                packed, mask = seq_pack[name]
+                if kind == "cols":
+                    first, last = args
+                    first = 0 if first is None else int(first)
+                    last = width - 1 if last is None else int(last)
+                    if not (0 <= first <= last < width):
+                        raise ValueError(
+                            f"column range [{first}, {last}] out of "
+                            f"bounds for sequence reader {name!r} with "
+                            f"{width} columns")
+                    arr = packed[:, first:last + 1, :]   # [N, C, T]
+                else:  # onehot: per-step labels -> [N, num, T]
+                    col, num = args
+                    if not 0 <= col < width:
+                        raise ValueError(
+                            f"one-hot column {col} out of bounds for "
+                            f"sequence reader {name!r} ({width} cols)")
+                    idx = packed[:, col, :].astype(np.int64)  # [N, T]
+                    # padded steps carry 0 — valid class index, masked
+                    real = mask > 0
+                    vals = idx[real]
+                    if vals.size and (vals.min() < 0 or vals.max() >= num):
+                        raise ValueError(
+                            f"label value {vals.min() if vals.min() < 0 else vals.max()}"
+                            f" outside [0, {num}) in sequence reader "
+                            f"{name!r} col {col}")
+                    arr = np.transpose(
+                        np.eye(num, dtype=np.float32)[idx], (0, 2, 1))
+                if role == "input":
+                    features.append(arr)
+                    fmasks.append(mask)
+                else:
+                    labels.append(arr)
+                    lmasks.append(mask)
+                continue
             if kind == "cols":
                 first, last = args
                 first = 0 if first is None else int(first)
@@ -149,9 +250,19 @@ class RecordReaderMultiDataSetIterator:
                         f"label value {idx.min() if idx.min() < 0 else idx.max()}"
                         f" outside [0, {num}) in reader {name!r} col {col}")
                 arr = np.eye(num, dtype=np.float32)[idx]
-            (features if role == "input" else labels).append(arr)
+            if role == "input":
+                features.append(arr)
+                fmasks.append(None)
+            else:
+                labels.append(arr)
+                lmasks.append(None)
 
-        self._it = MultiDataSetIterator(features, labels, batchSize)
+        self._it = MultiDataSetIterator(
+            features, labels, batchSize,
+            featuresMasks=fmasks if any(m is not None for m in fmasks)
+            else None,
+            labelsMasks=lmasks if any(m is not None for m in lmasks)
+            else None)
         self._batch = int(batchSize)
         self._n = n
 

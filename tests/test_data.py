@@ -1193,3 +1193,142 @@ class TestExistingMiniBatchIterator:
             (RecordReaderMultiDataSetIterator.Builder(2)
              .addReader("r", rr).addInput("r", 0, 2)
              .addOutputOneHot("r", 0, 9).build())
+
+
+class TestSequenceMultiReader:
+    """addSequenceReader in RecordReaderMultiDataSetIterator (reference
+    overload): sequence specs produce padded+masked [B, C, T] arrays."""
+
+    def _seq_files(self, tmp_path, name, seqs):
+        d = tmp_path / name
+        d.mkdir()
+        for i, rows in enumerate(seqs):
+            (d / f"seq_{i:02d}.csv").write_text(
+                "\n".join(",".join(str(v) for v in r) for r in rows))
+        from deeplearning4j_tpu.data import CSVSequenceRecordReader
+        return CSVSequenceRecordReader().initialize(d)
+
+    def test_padded_masked_ncw(self, tmp_path):
+        from deeplearning4j_tpu.data import RecordReaderMultiDataSetIterator
+        srr = self._seq_files(tmp_path, "s1", [
+            [[1, 10], [2, 20], [3, 30]],     # T=3
+            [[4, 40]],                        # T=1
+        ])
+        it = (RecordReaderMultiDataSetIterator.Builder(2)
+              .addSequenceReader("s", srr)
+              .addInput("s", 0, 0)
+              .addOutput("s", 1, 1)
+              .build())
+        mds = it.next()
+        f = mds.getFeatures()[0].toNumpy()
+        assert f.shape == (2, 1, 3)          # NCW, padded to Tmax=3
+        np.testing.assert_allclose(f[0, 0], [1, 2, 3])
+        np.testing.assert_allclose(f[1, 0], [4, 0, 0])
+        fm = mds.getFeaturesMaskArrays()[0].toNumpy()
+        np.testing.assert_allclose(fm, [[1, 1, 1], [1, 0, 0]])
+        lm = mds.getLabelsMaskArrays()[0].toNumpy()
+        np.testing.assert_allclose(lm, fm)
+
+    def test_per_step_onehot_labels(self, tmp_path):
+        from deeplearning4j_tpu.data import RecordReaderMultiDataSetIterator
+        srr = self._seq_files(tmp_path, "s2", [
+            [[0.5, 0], [0.6, 2]],
+            [[0.7, 1], [0.8, 1]],
+        ])
+        it = (RecordReaderMultiDataSetIterator.Builder(2)
+              .addSequenceReader("s", srr)
+              .addInput("s", 0, 0)
+              .addOutputOneHot("s", 1, 3)
+              .build())
+        l = it.next().getLabels()[0].toNumpy()
+        assert l.shape == (2, 3, 2)          # [B, classes, T]
+        np.testing.assert_allclose(l[0, :, 0], [1, 0, 0])
+        np.testing.assert_allclose(l[0, :, 1], [0, 0, 1])
+        np.testing.assert_allclose(l[1, :, 0], [0, 1, 0])
+
+    def test_mixed_static_and_sequence_trains_graph(self, tmp_path):
+        from deeplearning4j_tpu.data import (CSVRecordReader,
+                                             RecordReaderMultiDataSetIterator)
+        from deeplearning4j_tpu.nn import (ComputationGraph, DenseLayer,
+                                           InputType, MergeVertex,
+                                           NeuralNetConfiguration,
+                                           OutputLayer, Adam)
+        from deeplearning4j_tpu.nn.conf.recurrent import LSTM, LastTimeStep
+        rng = np.random.RandomState(1)
+        n, T = 32, 4
+        seqs = rng.rand(n, T, 1).round(3)
+        static = rng.randn(n, 2).round(3)
+        y = ((seqs.sum((1, 2)) + static.sum(1)) > 2.0).astype(int)
+        srr = self._seq_files(tmp_path, "s3",
+                              [s.tolist() for s in seqs])
+        p = tmp_path / "static.csv"
+        p.write_text("\n".join(
+            ",".join(str(v) for v in row) + f",{int(l)}"
+            for row, l in zip(static, y)))
+        it = (RecordReaderMultiDataSetIterator.Builder(16)
+              .addSequenceReader("seq", srr)
+              .addReader("st", CSVRecordReader().initialize(p))
+              .addInput("seq")
+              .addInput("st", 0, 1)
+              .addOutputOneHot("st", 2, 2)
+              .build())
+        conf = (NeuralNetConfiguration.Builder().seed(5).updater(Adam(1e-2))
+                .graphBuilder()
+                .addInputs("inSeq", "inSt")
+                .addLayer("rnn", LastTimeStep(LSTM(nIn=1, nOut=8)), "inSeq")
+                .addLayer("dSt", DenseLayer(nIn=2, nOut=8,
+                                            activation="tanh"), "inSt")
+                .addVertex("m", MergeVertex(), "rnn", "dSt")
+                .addLayer("out", OutputLayer(nOut=2, activation="softmax"),
+                          "m")
+                .setOutputs("out")
+                .setInputTypes(InputType.recurrent(1, T),
+                               InputType.feedForward(2))
+                .build())
+        net = ComputationGraph(conf).init()
+        for _ in range(30):
+            net.fit(it)
+        assert np.isfinite(net.score())
+        out = net.outputSingle(
+            np.transpose(seqs, (0, 2, 1)).astype("float32"),
+            static.astype("float32"))
+        acc = (np.asarray(out.toNumpy()).argmax(1) == y).mean()
+        assert acc > 0.85, acc
+
+    def test_inconsistent_seq_widths_raise(self, tmp_path):
+        from deeplearning4j_tpu.data import RecordReaderMultiDataSetIterator
+        srr = self._seq_files(tmp_path, "s4",
+                              [[[1, 2]], [[1, 2, 3]]])
+        with pytest.raises(ValueError, match="inconsistent"):
+            (RecordReaderMultiDataSetIterator.Builder(2)
+             .addSequenceReader("s", srr)
+             .addInput("s").addOutput("s", 0, 0).build())
+
+    def test_padded_final_batch_masks_none_entries(self, tmp_path):
+        # a None-mask label padded with duplicate rows must gain a
+        # zero-tail mask — unmasked duplicates would count in the loss
+        from deeplearning4j_tpu.data.multidataset import MultiDataSetIterator
+        seqf = np.random.RandomState(0).rand(3, 1, 2).astype("float32")
+        seql = np.ones((3, 2, 2), "float32")
+        statl = np.eye(2, dtype="float32")[[0, 1, 0]]
+        mask = np.ones((3, 2), "float32")
+        it = MultiDataSetIterator([seqf], [seql, statl], 2,
+                                  featuresMasks=[mask],
+                                  labelsMasks=[mask, None])
+        it.next()
+        mds = it.next()  # final short batch (1 real + 1 pad)
+        lms = mds.getLabelsMaskArrays()
+        assert lms[1] is not None
+        np.testing.assert_allclose(lms[1].toNumpy(), [1.0, 0.0])
+
+    def test_ragged_sequence_diagnostic(self, tmp_path):
+        from deeplearning4j_tpu.data import RecordReaderMultiDataSetIterator
+        d = tmp_path / "rg"
+        d.mkdir()
+        (d / "seq_00.csv").write_text("1,2\n1,2,3")
+        from deeplearning4j_tpu.data import CSVSequenceRecordReader
+        srr = CSVSequenceRecordReader().initialize(d)
+        with pytest.raises(ValueError, match="ragged sequence"):
+            (RecordReaderMultiDataSetIterator.Builder(1)
+             .addSequenceReader("s", srr)
+             .addInput("s").addOutput("s", 0, 0).build())
