@@ -201,6 +201,23 @@ class Word2Vec(WordVectorQuery):
         f = self._counts.astype("float64") ** 0.75
         self._freq = (f / f.sum()).astype("float32")
 
+    @staticmethod
+    def _windows(ids, windowSize):
+        """CBOW-shaped examples for one token-id sequence:
+        (centers, contexts [*, 2w] 0-padded, masks [*, 2w]) as lists —
+        THE window extraction used by CBOW, PV-DM training, and PV-DM
+        inference (one implementation, three call sites)."""
+        width = 2 * windowSize
+        centers, ctxs, masks = [], [], []
+        for i, c in enumerate(ids):
+            lo = max(0, i - windowSize)
+            hi = min(len(ids), i + windowSize + 1)
+            win = [ids[j] for j in range(lo, hi) if j != i]
+            centers.append(c)
+            ctxs.append(win + [0] * (width - len(win)))
+            masks.append([1.0] * len(win) + [0.0] * (width - len(win)))
+        return centers, ctxs, masks
+
     def _scan(self):
         """Vocab scan + skip-gram (center, context) pair extraction."""
         self._scan_vocab()
@@ -223,19 +240,16 @@ class Word2Vec(WordVectorQuery):
         0-padded, mask [N, 2w]) — fixed-width rows so the whole epoch is
         one jittable shape (XLA: no ragged batches)."""
         self._scan_vocab()
-        width = 2 * self.windowSize
         centers, ctxs, masks = [], [], []
         for toks in self._sents:
             ids = [self.vocab[t] for t in toks if t in self.vocab]
-            for i, c in enumerate(ids):
-                lo = max(0, i - self.windowSize)
-                hi = min(len(ids), i + self.windowSize + 1)
-                win = [ids[j] for j in range(lo, hi) if j != i]
-                if not win:
+            cs, xs, ms = self._windows(ids, self.windowSize)
+            for c, x, m in zip(cs, xs, ms):
+                if not any(m):  # CBOW drops empty-window examples
                     continue
                 centers.append(c)
-                ctxs.append(win + [0] * (width - len(win)))
-                masks.append([1.0] * len(win) + [0.0] * (width - len(win)))
+                ctxs.append(x)
+                masks.append(m)
         if not centers:
             raise ValueError("no training pairs (sentences too short?)")
         return (np.asarray(centers, "int32"), np.asarray(ctxs, "int32"),
@@ -489,17 +503,48 @@ class Word2Vec(WordVectorQuery):
 
 
 class ParagraphVectors(Word2Vec):
-    """Doc embeddings via PV-DBOW (reference: deeplearning4j-nlp
-    models.paragraphvectors.ParagraphVectors, dm=0 mode): each document
-    vector is trained to predict the words it contains, against the
-    same negative-sampling objective and context table as Word2Vec.
+    """Doc embeddings via PV-DBOW or PV-DM (reference: deeplearning4j-nlp
+    models.paragraphvectors.ParagraphVectors with
+    sequenceLearningAlgorithm DBOW / DM).
+
+    DBOW (default, upstream's default too): word tables train first
+    (SGNS/CBOW/HS per config), then each document vector is trained to
+    predict the words it contains against the FROZEN context table.
+
+    DM ("distributed memory", mean variant): ONE joint jitted step — the
+    masked mean of the context window's word vectors AND the doc vector
+    predicts the center word; words, docs, and the output table all
+    receive gradients together, which is upstream's DM training order.
+
     Labels are the document indices ("DOC_i" upstream LabelsSource);
     inferVector() fits a fresh vector for unseen text with the trained
-    context table frozen."""
+    tables frozen."""
 
     class Builder(Word2Vec.Builder):
+        def sequenceLearningAlgorithm(self, algorithm):
+            """"DBOW" (default) or "DM" (reference: ParagraphVectors
+            .Builder.sequenceLearningAlgorithm(new DBOW<>()/new DM<>()))."""
+            name = algorithm if isinstance(algorithm, str) \
+                else type(algorithm).__name__
+            self._kw["sequenceLearningAlgorithm"] = name
+            return self
+
         def build(self):
             return ParagraphVectors(**self._kw)
+
+    def __init__(self, *args, sequenceLearningAlgorithm="DBOW", **kw):
+        super().__init__(*args, **kw)
+        alg = str(sequenceLearningAlgorithm).upper().split("<")[0]
+        if alg not in ("DBOW", "DM"):
+            raise ValueError(
+                f"unknown sequenceLearningAlgorithm "
+                f"{sequenceLearningAlgorithm!r} (use 'DBOW' or 'DM')")
+        if alg == "DM" and self.useHierarchicSoftmax:
+            raise ValueError(
+                "PV-DM here trains with negative sampling; combine DM "
+                "with useHierarchicSoftmax(False) or use DBOW for the "
+                "hierarchical-softmax path")
+        self.sequenceAlgorithm = alg
 
     def _doc_pairs(self):
         """(doc_id, word_id) for every in-vocab token of every doc; uses
@@ -517,7 +562,80 @@ class ParagraphVectors(Word2Vec):
         self._doc_trained = np.asarray(trained, bool)
         return np.asarray(d, "int32"), np.asarray(w, "int32")
 
+    def _dm_examples(self):
+        """(doc [N], center [N], context [N, 2w], mask [N, 2w]) over all
+        documents — CBOW-shaped windows plus the owning doc id."""
+        self._scan_vocab()
+        docs, centers, ctxs, masks = [], [], [], []
+        for doc_id, toks in enumerate(self._sents):
+            ids = [self.vocab[t] for t in toks if t in self.vocab]
+            cs, xs, ms = self._windows(ids, self.windowSize)
+            docs.extend([doc_id] * len(cs))
+            centers.extend(cs)
+            ctxs.extend(xs)
+            masks.extend(ms)
+        if not centers:
+            raise ValueError("no training examples (empty documents?)")
+        self._n_docs = len(self._sents)
+        self._doc_trained = np.asarray(
+            [any(t in self.vocab for t in toks) for toks in self._sents],
+            bool)
+        return (np.asarray(docs, "int32"), np.asarray(centers, "int32"),
+                np.asarray(ctxs, "int32"), np.asarray(masks, "float32"))
+
+    def _fit_dm(self):
+        """Joint PV-DM training: words + docs + output table in one
+        jitted SGNS step."""
+        docs, centers, ctxs, masks = self._dm_examples()
+        V, D, K = len(self.vocab), self.layerSize, self.negative
+        rng = jax.random.key(self.seed)
+        init_k, shuf_k = jax.random.split(rng)
+        kw_, kd_ = jax.random.split(init_k)
+        W = (jax.random.uniform(kw_, (V, D), jnp.float32) - 0.5) / D
+        Dv = (jax.random.uniform(kd_, (self._n_docs, D), jnp.float32)
+              - 0.5) / D
+        C = jnp.zeros((V, D), jnp.float32)
+        freq = jnp.asarray(self._freq)
+        lr = self.learningRate
+
+        def step(W, Dv, C, dids, ctr, ctx, m, key):
+            neg = jax.random.choice(key, V, (ctr.shape[0], K), p=freq)
+
+            def loss_fn(W, Dv, C):
+                # dm_mean: doc vector joins the window average
+                tot = jnp.sum(W[ctx] * m[..., None], 1) + Dv[dids]
+                h = tot / (jnp.sum(m, 1, keepdims=True) + 1.0)
+                pos = jnp.sum(h * C[ctr], -1)
+                negs = jnp.einsum("bd,bkd->bk", h, C[neg])
+                return -(jnp.mean(jax.nn.log_sigmoid(pos)) +
+                         jnp.mean(jnp.sum(jax.nn.log_sigmoid(-negs), -1)))
+
+            loss, (gW, gD, gC) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1, 2))(W, Dv, C)
+            return W - lr * gW, Dv - lr * gD, C - lr * gC, loss
+
+        jstep = jax.jit(step, donate_argnums=(0, 1, 2))
+        n = centers.shape[0]
+        B = min(self.batchSize, n)
+        loss = jnp.float32(0)
+        for epoch in range(self.iterations):
+            perm = np.asarray(jax.random.permutation(
+                jax.random.fold_in(shuf_k, epoch), n))
+            de, ce, xe, me = (docs[perm], centers[perm], ctxs[perm],
+                              masks[perm])
+            for s in range(0, n, B):
+                key = jax.random.fold_in(rng, epoch * 100003 + s)
+                W, Dv, C, loss = jstep(
+                    W, Dv, C, jnp.asarray(de[s:s + B]),
+                    jnp.asarray(ce[s:s + B]), jnp.asarray(xe[s:s + B]),
+                    jnp.asarray(me[s:s + B]), key)
+        self._W, self._C, self._D = W, C, Dv
+        self._score = float(loss)
+        return self
+
     def fit(self):
+        if getattr(self, "sequenceAlgorithm", "DBOW") == "DM":
+            return self._fit_dm()
         super().fit()  # word tables first (SGNS/CBOW/HS per config)
         d_idx, w_idx = self._doc_pairs()
         V, D, K = len(self.vocab), self.layerSize, self.negative
@@ -587,6 +705,8 @@ class ParagraphVectors(Word2Vec):
                if t in self.vocab]
         if not ids:
             raise ValueError("no in-vocabulary tokens in text")
+        if getattr(self, "sequenceAlgorithm", "DBOW") == "DM":
+            return self._infer_dm(ids, steps)
         wids = jnp.asarray(np.asarray(ids, "int32"))
         V, K = len(self.vocab), self.negative
         C, freq, lr = self._C, jnp.asarray(self._freq), self.learningRate
@@ -632,6 +752,53 @@ class ParagraphVectors(Word2Vec):
             run = cache[ck] = jax.jit(run_fn)
         return np.asarray(run(v0, wids, samp_k))
 
+    def _infer_dm(self, ids, steps):
+        """DM inference: windows from the text, W/C frozen, only the new
+        doc vector trains (reference: DM's inferSequence)."""
+        centers, ctxs, masks = self._windows(ids, self.windowSize)
+        ctr = jnp.asarray(np.asarray(centers, "int32"))
+        ctx = jnp.asarray(np.asarray(ctxs, "int32"))
+        msk = jnp.asarray(np.asarray(masks, "float32"))
+        V, K = len(self.vocab), self.negative
+        W, C = self._W, self._C
+        freq, lr = jnp.asarray(self._freq), self.learningRate
+        init_k, samp_k = jax.random.split(
+            jax.random.key(self.seed ^ 0x1FE12))
+        v0 = (jax.random.uniform(init_k, (self.layerSize,), jnp.float32)
+              - 0.5) / self.layerSize
+        cache = getattr(self, "_infer_cache", None)
+        if cache is None:
+            cache = self._infer_cache = {}
+        ck = ("dm", int(ctr.shape[0]), int(steps))
+        run = cache.get(ck)
+        if run is None:
+            # ctr/ctx/msk are TRACED ARGUMENTS, not closure constants:
+            # the cache key is only (token count, steps), so baking the
+            # text into the compile would hand a second same-length
+            # query the FIRST text's windows (the DBOW path passes wids
+            # for the same reason)
+            def iter_loss(v, ctr, ctx, msk, kk):
+                neg = jax.random.choice(kk, V, (ctr.shape[0], K), p=freq)
+                tot = jnp.sum(W[ctx] * msk[..., None], 1) + v
+                h = tot / (jnp.sum(msk, 1, keepdims=True) + 1.0)
+                pos = jnp.sum(h * C[ctr], -1)
+                negs = jnp.einsum("bd,bkd->bk", h, C[neg])
+                return -(jnp.mean(jax.nn.log_sigmoid(pos)) +
+                         jnp.mean(jnp.sum(jax.nn.log_sigmoid(-negs), -1)))
+
+            def run_fn(v, ctr, ctx, msk, key):
+                def body(i, carry):
+                    v, k = carry
+                    kk = jax.random.fold_in(k, i)
+                    return v - lr * jax.grad(
+                        lambda vv: iter_loss(vv, ctr, ctx, msk, kk))(v), k
+
+                v, _ = jax.lax.fori_loop(0, steps, body, (v, key))
+                return v
+
+            run = cache[ck] = jax.jit(run_fn)
+        return np.asarray(run(v0, ctr, ctx, msk, samp_k))
+
     def save(self, path):
         self._require_fit()
         if getattr(self, "_D", None) is None:
@@ -646,7 +813,10 @@ class ParagraphVectors(Word2Vec):
                  counts=np.asarray(getattr(self, "_counts", [])),
                  hyper=np.asarray([self.negative, self.seed,
                                    self.learningRate,
-                                   float(self.useHierarchicSoftmax)],
+                                   float(self.useHierarchicSoftmax),
+                                   float(getattr(self, "sequenceAlgorithm",
+                                                 "DBOW") == "DM"),
+                                   self.windowSize],
                                   "float64"))
 
     @staticmethod
@@ -673,6 +843,9 @@ class ParagraphVectors(Word2Vec):
             # of mode: save() writes counts unconditionally, so
             # load-then-save must round-trip
             m._counts = np.asarray(z["counts"])
+        if len(z["hyper"]) > 4:
+            m.sequenceAlgorithm = "DM" if z["hyper"][4] else "DBOW"
+            m.windowSize = int(z["hyper"][5])  # DM inference windows
         if len(z["hyper"]) > 3 and z["hyper"][3]:  # HS mode: rebuild the
             # Huffman tables from the saved frequencies (deterministic)
             m.useHierarchicSoftmax = True

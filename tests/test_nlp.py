@@ -5,7 +5,8 @@ semantic clustering on a structured synthetic corpus, API parity, serde.
 import numpy as np
 import pytest
 
-from deeplearning4j_tpu.nlp import (Word2Vec, DefaultTokenizerFactory,
+from deeplearning4j_tpu.nlp import (Word2Vec, ParagraphVectors,
+                                    DefaultTokenizerFactory,
                                     CollectionSentenceIterator)
 
 
@@ -343,3 +344,93 @@ class TestGraphLoaderAndWeights:
 
         with pytest.raises(ValueError, match="weight"):
             Graph(2).addEdge(0, 1, weight=0.0)
+
+
+class TestParagraphVectorsDM:
+    """PV-DM mode (reference: ParagraphVectors.Builder
+    .sequenceLearningAlgorithm(new DM<>()) — joint doc+word training)."""
+
+    def _docs(self):
+        rng = np.random.RandomState(3)
+        animals = ["cat", "dog", "horse", "sheep", "cow"]
+        tech = ["cpu", "gpu", "ram", "disk", "cache"]
+        docs, topics = [], []
+        for i in range(40):
+            topic = animals if i % 2 == 0 else tech
+            docs.append(" ".join(rng.choice(topic, 8)))
+            topics.append(i % 2)
+        return docs, topics
+
+    def _fit(self, **kw):
+        docs, topics = self._docs()
+        # DM splits each window's signal across words + doc + output
+        # table (h is a 7-way mean here), so per-table steps are ~1/7
+        # of skip-gram's at the same lr — a hotter schedule and more
+        # full-batch epochs compensate on this tiny corpus
+        pv = (ParagraphVectors.Builder()
+              .minWordFrequency(1).layerSize(16).windowSize(3)
+              .negativeSample(4).seed(7).iterations(120).learningRate(1.0)
+              .sequenceLearningAlgorithm("DM")
+              .iterate(CollectionSentenceIterator(docs))
+              .build().fit())
+        return pv, topics
+
+    def test_doc_vectors_cluster_by_topic(self):
+        pv, topics = self._fit()
+        assert pv.sequenceAlgorithm == "DM"
+        vecs = np.stack([pv.getParagraphVector(i) for i in range(40)])
+        vecs /= np.linalg.norm(vecs, axis=1, keepdims=True) + 1e-12
+        sims = vecs @ vecs.T
+        same = np.asarray([[t1 == t2 for t2 in topics] for t1 in topics])
+        off = ~np.eye(40, dtype=bool)
+        intra = sims[same & off].mean()
+        inter = sims[~same].mean()
+        assert intra > inter + 0.15, (intra, inter)
+
+    def test_word_vectors_trained_jointly(self):
+        pv, _ = self._fit()
+        # DM trains words too — topic words must cluster
+        assert pv.similarity("cat", "dog") > pv.similarity("cat", "gpu")
+
+    def test_infer_vector_lands_near_topic(self):
+        pv, topics = self._fit()
+        v = pv.inferVector("cat dog sheep horse cow cat dog")
+        v = v / (np.linalg.norm(v) + 1e-12)
+        def mean_sim(t):
+            idx = [i for i in range(40) if topics[i] == t]
+            vecs = np.stack([pv.getParagraphVector(i) for i in idx])
+            vecs /= np.linalg.norm(vecs, axis=1, keepdims=True) + 1e-12
+            return float((vecs @ v).mean())
+        assert mean_sim(0) > mean_sim(1), (mean_sim(0), mean_sim(1))
+
+    def test_serde_roundtrip_preserves_dm(self, tmp_path):
+        pv, _ = self._fit()
+        p = tmp_path / "pv_dm"
+        pv.save(p)
+        pv2 = ParagraphVectors.load(p)
+        assert pv2.sequenceAlgorithm == "DM"
+        np.testing.assert_allclose(pv2.getParagraphVector(3),
+                                   pv.getParagraphVector(3), rtol=1e-6)
+        # inference works on the restored model (needs windowSize back)
+        v = pv2.inferVector("cat dog cat dog cat")
+        assert np.isfinite(v).all()
+
+    def test_dm_rejects_hierarchical_softmax(self):
+        with pytest.raises(ValueError, match="negative sampling"):
+            ParagraphVectors(sequenceLearningAlgorithm="DM",
+                             useHierarchicSoftmax=True)
+
+    def test_unknown_algorithm_rejected(self):
+        with pytest.raises(ValueError, match="sequenceLearningAlgorithm"):
+            ParagraphVectors(sequenceLearningAlgorithm="skip-thought")
+
+    def test_infer_cache_does_not_collide_across_texts(self):
+        # two different same-token-count texts must get DIFFERENT
+        # inferred vectors (the jit cache keys on length, so windows
+        # must be traced arguments, not baked constants)
+        pv, _ = self._fit()
+        va = np.array(pv.inferVector("cat dog horse sheep cow"))
+        vb = np.array(pv.inferVector("gpu ram disk cache cpu"))
+        va /= np.linalg.norm(va) + 1e-12
+        vb /= np.linalg.norm(vb) + 1e-12
+        assert float(va @ vb) < 0.9, float(va @ vb)
