@@ -30,7 +30,7 @@ from deeplearning4j_tpu.nn.conf.layers import (
     Subsampling1DLayer, ZeroPadding1DLayer, RepeatVector,
     ElementWiseMultiplicationLayer, AutoEncoder,
     Subsampling3DLayer, ZeroPadding3D, Deconvolution3D, MaskLayer,
-    MaskZeroLayer, FrozenLayerWithBackprop,
+    MaskZeroLayer, FrozenLayerWithBackprop, FrozenLayer,
 )
 from deeplearning4j_tpu.nn.conf.dropout import (
     Dropout, GaussianDropout, GaussianNoise, AlphaDropout, SpatialDropout,
@@ -45,6 +45,7 @@ from deeplearning4j_tpu.nn.conf.constraint import (
 from deeplearning4j_tpu.nn.conf.variational import VariationalAutoencoder
 from deeplearning4j_tpu.nn.conf.recurrent import (
     LSTM, GravesLSTM, SimpleRnn, GRU, Bidirectional, LastTimeStep,
+    GravesBidirectionalLSTM,
 )
 from deeplearning4j_tpu.nn.conf.attention import (
     SelfAttentionLayer, LearnedSelfAttentionLayer, RecurrentAttentionLayer,

@@ -198,6 +198,17 @@ class Bidirectional(FeedForwardLayer):
         return y, {"fwd": sf, "bwd": sb}
 
 
+class GravesBidirectionalLSTM(Bidirectional):
+    """Upstream's dedicated bidirectional Graves LSTM class
+    (reference: conf.layers.GravesBidirectionalLSTM) — exactly
+    Bidirectional(GravesLSTM(...), mode=CONCAT) with a flat
+    constructor, kept as its own class for API parity."""
+
+    def __init__(self, nIn=None, nOut=None, mode="CONCAT", **kw):
+        super().__init__(layer=GravesLSTM(nIn=nIn, nOut=nOut, **kw),
+                         mode=mode)
+
+
 class LastTimeStep(FeedForwardLayer):
     """Wraps a recurrent layer, emitting only the final (optionally masked)
     timestep as FF data (reference: conf.layers.recurrent.LastTimeStep)."""

@@ -797,3 +797,57 @@ class TestOCNNOutputLayer:
         expect = 0.5 * (np.sum(np.square(np.asarray(p["V"])))
                         + np.sum(np.square(np.asarray(p["w"]))))
         np.testing.assert_allclose(reg, expect, rtol=1e-6)
+
+
+class TestFrozenLayerAndGravesBidirectional:
+    """misc.FrozenLayer (inference-mode freeze) and
+    GravesBidirectionalLSTM (reference parity classes)."""
+
+    def test_frozen_layer_params_fixed_and_inference_mode(self):
+        from deeplearning4j_tpu.nn import (
+            Adam, DenseLayer, DropoutLayer, FrozenLayer, MultiLayerNetwork,
+            NeuralNetConfiguration, OutputLayer)
+        rng = np.random.RandomState(0)
+        X = rng.randn(32, 4).astype("float32")
+        Y = np.eye(2, dtype="float32")[(X.sum(1) > 0).astype(int)]
+        conf = (NeuralNetConfiguration.Builder().seed(3).updater(Adam(1e-2))
+                .list()
+                .layer(FrozenLayer(DenseLayer(nIn=4, nOut=8,
+                                              activation="tanh",
+                                              dropOut=0.5)))
+                .layer(OutputLayer(nOut=2, activation="softmax"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        w0 = np.asarray(net.getParam("0_W")).copy()
+        for _ in range(5):
+            net.fit(X, Y)
+        np.testing.assert_array_equal(np.asarray(net.getParam("0_W")), w0)
+        # inference-mode freeze: dropout is OFF even during training, so
+        # two training-mode forwards agree deterministically
+        a = net.output(X).toNumpy()
+        b = net.output(X).toNumpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_graves_bidirectional_lstm(self):
+        from deeplearning4j_tpu.nn import (
+            Adam, GravesBidirectionalLSTM, InputType, MultiLayerNetwork,
+            NeuralNetConfiguration, RnnOutputLayer)
+        rng = np.random.RandomState(1)
+        X = rng.randn(8, 3, 5).astype("float32")   # [B, C, T]
+        Y = np.zeros((8, 2, 5), "float32")
+        Y[:, 0] = 1.0
+        conf = (NeuralNetConfiguration.Builder().seed(3).updater(Adam(1e-2))
+                .list()
+                .layer(GravesBidirectionalLSTM(nIn=3, nOut=4))
+                .layer(RnnOutputLayer(nOut=2, activation="softmax"))
+                .setInputType(InputType.recurrent(3, 5))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        out = net.output(X).toNumpy()
+        assert out.shape == (8, 2, 5)  # CONCAT 2*4 -> projected to 2
+        s0 = None
+        for _ in range(5):
+            net.fit(X, Y)
+            if s0 is None:
+                s0 = net.score()
+        assert net.score() < s0
