@@ -30,7 +30,7 @@ from deeplearning4j_tpu.nn.conf.layers import (
     Subsampling1DLayer, ZeroPadding1DLayer, RepeatVector,
     ElementWiseMultiplicationLayer, AutoEncoder,
     Subsampling3DLayer, ZeroPadding3D, Deconvolution3D, MaskLayer,
-    MaskZeroLayer, FrozenLayerWithBackprop, FrozenLayer,
+    MaskZeroLayer, FrozenLayerWithBackprop,
 )
 from deeplearning4j_tpu.nn.conf.dropout import (
     Dropout, GaussianDropout, GaussianNoise, AlphaDropout, SpatialDropout,

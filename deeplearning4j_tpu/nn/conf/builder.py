@@ -232,7 +232,9 @@ class ListBuilder:
             if getattr(first, "nIn", None) is None:
                 raise ValueError("Either setInputType(...) or nIn on the first layer")
             conf.inputType = InputType.feedForward(first.nIn) \
-                if not isinstance(first, (R.BaseRecurrentLayer, L.RnnOutputLayer)) \
+                if not isinstance(first, (R.BaseRecurrentLayer,
+                                          R.Bidirectional,
+                                          L.RnnOutputLayer)) \
                 else InputType.recurrent(first.nIn)
             conf.inferShapes()
         return conf

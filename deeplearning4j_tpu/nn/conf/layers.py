@@ -1091,21 +1091,6 @@ class FrozenLayerWithBackprop(Layer):
         return getattr(self.__dict__["layer"], item)
 
 
-class FrozenLayer(FrozenLayerWithBackprop):
-    """Freeze the wrapped layer's parameters AND force inference-mode
-    forward semantics — dropout off, BN running stats (reference:
-    misc.FrozenLayer; contrast FrozenLayerWithBackprop, which keeps
-    train-mode behavior). Gradients still flow through to earlier
-    layers; the wrapped params get structurally zero updates."""
-
-    def __init__(self, layer, **kw):
-        super().__init__(layer, **kw)
-        self.frozenKeepTraining = False
-
-    def forward(self, params, state, x, train, key, mask=None):
-        return self.layer.forward(params, state, x, False, key, mask)
-
-
 class SpaceToDepth(Layer):
     """[B,H,W,C] -> [B,H/b,W/b,C*b*b] (reference: conf.layers.SpaceToDepth;
     the YOLO2 passthrough vertex). blocks must divide H and W."""

@@ -159,6 +159,8 @@ class Bidirectional(FeedForwardLayer):
         self.layer = layer
         self.mode = str(mode).lower()
         self.nOut = None
+        if self.nIn is None:  # first-layer shape inference reads the
+            self.nIn = getattr(layer, "nIn", None)  # wrapper's nIn
 
     def mergeGlobals(self, defaults):
         super().mergeGlobals(defaults)
@@ -200,11 +202,12 @@ class Bidirectional(FeedForwardLayer):
 
 class GravesBidirectionalLSTM(Bidirectional):
     """Upstream's dedicated bidirectional Graves LSTM class
-    (reference: conf.layers.GravesBidirectionalLSTM) — exactly
-    Bidirectional(GravesLSTM(...), mode=CONCAT) with a flat
-    constructor, kept as its own class for API parity."""
+    (reference: conf.layers.GravesBidirectionalLSTM, which SUMS the
+    forward and backward passes — output width nOut, not 2*nOut) —
+    Bidirectional(GravesLSTM(...), mode=ADD) with a flat constructor.
+    Pass mode="CONCAT" for the width-doubling variant."""
 
-    def __init__(self, nIn=None, nOut=None, mode="CONCAT", **kw):
+    def __init__(self, nIn=None, nOut=None, mode="ADD", **kw):
         super().__init__(layer=GravesLSTM(nIn=nIn, nOut=nOut, **kw),
                          mode=mode)
 

@@ -800,12 +800,13 @@ class TestOCNNOutputLayer:
 
 
 class TestFrozenLayerAndGravesBidirectional:
-    """misc.FrozenLayer (inference-mode freeze) and
-    GravesBidirectionalLSTM (reference parity classes)."""
+    """misc.FrozenLayer semantics (the transfer.FrozenLayer marker +
+    _run_layers' inference-mode forcing) and GravesBidirectionalLSTM."""
 
     def test_frozen_layer_params_fixed_and_inference_mode(self):
+        import jax
         from deeplearning4j_tpu.nn import (
-            Adam, DenseLayer, DropoutLayer, FrozenLayer, MultiLayerNetwork,
+            Adam, DenseLayer, FrozenLayer, MultiLayerNetwork,
             NeuralNetConfiguration, OutputLayer)
         rng = np.random.RandomState(0)
         X = rng.randn(32, 4).astype("float32")
@@ -822,11 +823,21 @@ class TestFrozenLayerAndGravesBidirectional:
         for _ in range(5):
             net.fit(X, Y)
         np.testing.assert_array_equal(np.asarray(net.getParam("0_W")), w0)
-        # inference-mode freeze: dropout is OFF even during training, so
-        # two training-mode forwards agree deterministically
-        a = net.output(X).toNumpy()
-        b = net.output(X).toNumpy()
-        np.testing.assert_array_equal(a, b)
+        # the reference FrozenLayer's DISTINGUISHING behavior: the frozen
+        # layer runs inference-mode even under train=True — dropout off,
+        # so different step keys give identical activations (an UNfrozen
+        # dropout layer would differ)
+        pa, _ = net._run_layers(net._params, net._states, X[:4], True,
+                                jax.random.key(0), None)
+        pb, _ = net._run_layers(net._params, net._states, X[:4], True,
+                                jax.random.key(1), None)
+        np.testing.assert_array_equal(np.asarray(pa), np.asarray(pb))
+        net.layers[0].frozen = False  # control: dropout becomes live
+        pc, _ = net._run_layers(net._params, net._states, X[:4], True,
+                                jax.random.key(0), None)
+        pd, _ = net._run_layers(net._params, net._states, X[:4], True,
+                                jax.random.key(1), None)
+        assert not np.array_equal(np.asarray(pc), np.asarray(pd))
 
     def test_graves_bidirectional_lstm(self):
         from deeplearning4j_tpu.nn import (
@@ -836,15 +847,17 @@ class TestFrozenLayerAndGravesBidirectional:
         X = rng.randn(8, 3, 5).astype("float32")   # [B, C, T]
         Y = np.zeros((8, 2, 5), "float32")
         Y[:, 0] = 1.0
+        # reference ergonomics: nIn on the layer, no setInputType call
         conf = (NeuralNetConfiguration.Builder().seed(3).updater(Adam(1e-2))
                 .list()
                 .layer(GravesBidirectionalLSTM(nIn=3, nOut=4))
                 .layer(RnnOutputLayer(nOut=2, activation="softmax"))
-                .setInputType(InputType.recurrent(3, 5))
                 .build())
         net = MultiLayerNetwork(conf).init()
+        # upstream SUMS fwd+bwd: hidden width stays nOut=4
+        assert np.asarray(net.getParam("1_W")).shape[0] == 4
         out = net.output(X).toNumpy()
-        assert out.shape == (8, 2, 5)  # CONCAT 2*4 -> projected to 2
+        assert out.shape == (8, 2, 5)
         s0 = None
         for _ in range(5):
             net.fit(X, Y)
