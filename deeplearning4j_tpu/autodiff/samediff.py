@@ -856,44 +856,14 @@ class SameDiff:
                 len(self._ops))
         jstep = self._jit_cache.get(ckey)
         if jstep is None:
-            def step(params, ustate, consts, phs, it, rng):
-                def loss_fn(p):
-                    env = dict(consts)
-                    env.update(p)
-                    env.update(phs)
-                    outs = self._run_graph(env, loss_names, train=True,
-                                           rng=rng)
-                    loss = sum(jnp.sum(o) for o in outs.values())
-                    if tc.l2:
-                        loss = loss + tc.l2 * sum(
-                            jnp.sum(jnp.square(a)) for a in p.values())
-                    if tc.l1:
-                        loss = loss + tc.l1 * sum(
-                            jnp.sum(jnp.abs(a)) for a in p.values())
-                    return loss
-
-                loss, grads = jax.value_and_grad(loss_fn)(params)
-                if tc.weightDecay:
-                    grads = {n: g + tc.weightDecay * params[n]
-                             for n, g in grads.items()}
-                upd, new_state = updater.apply(grads, ustate, it, params=params)
-                new_params = {n: params[n] - upd[n] for n in params}
-                return loss, new_params, new_state
-
-            jstep = jax.jit(step, donate_argnums=(0, 1))
+            jstep = jax.jit(
+                self._fit_step_fn(tc, loss_names, updater),
+                donate_argnums=(0, 1))
             self._jit_cache[ckey] = jstep
 
         params = {n: self._arrays[n] for n in var_names}
         consts = {n: a for n, a in self._arrays.items() if n not in params}
-        state = getattr(self, "_train_state", None)
-        if state is None:
-            state = updater.init(params)
-            pending = getattr(self, "_pending_updater_leaves", None)
-            if pending is not None:
-                leaves, treedef = jax.tree_util.tree_flatten(state)
-                state = jax.tree_util.tree_unflatten(
-                    treedef, [jnp.asarray(l) for l in pending])
-                self._pending_updater_leaves = None
+        state = self._train_state_for(params, updater)
 
         history = []
         base_key = jax.random.key(0)
@@ -915,6 +885,94 @@ class SameDiff:
         self._arrays.update(params)
         self._train_state = state
         return history
+
+    def _fit_step_fn(self, tc, loss_names, updater):
+        """Raw (unjitted) train step: forward+loss+grad+updater. Shared
+        by fit() (jitted directly, donated buffers) and fitSteps()
+        (wrapped in an on-device lax.fori_loop)."""
+        def step(params, ustate, consts, phs, it, rng):
+            def loss_fn(p):
+                env = dict(consts)
+                env.update(p)
+                env.update(phs)
+                outs = self._run_graph(env, loss_names, train=True,
+                                       rng=rng)
+                loss = sum(jnp.sum(o) for o in outs.values())
+                if tc.l2:
+                    loss = loss + tc.l2 * sum(
+                        jnp.sum(jnp.square(a)) for a in p.values())
+                if tc.l1:
+                    loss = loss + tc.l1 * sum(
+                        jnp.sum(jnp.abs(a)) for a in p.values())
+                return loss
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            if tc.weightDecay:
+                grads = {n: g + tc.weightDecay * params[n]
+                         for n, g in grads.items()}
+            upd, new_state = updater.apply(grads, ustate, it, params=params)
+            new_params = {n: params[n] - upd[n] for n in params}
+            return loss, new_params, new_state
+
+        return step
+
+    def _train_state_for(self, params, updater):
+        state = getattr(self, "_train_state", None)
+        if state is None:
+            state = updater.init(params)
+            pending = getattr(self, "_pending_updater_leaves", None)
+            if pending is not None:
+                leaves, treedef = jax.tree_util.tree_flatten(state)
+                state = jax.tree_util.tree_unflatten(
+                    treedef, [jnp.asarray(l) for l in pending])
+                self._pending_updater_leaves = None
+        return state
+
+    def fitSteps(self, features=None, labels=None, numSteps=1, data=None):
+        """TPU-native k-step fit: numSteps optimizer steps on one batch
+        entirely on device (lax.fori_loop), one host sync per call;
+        returns the final loss. Semantics match numSteps fit() calls on
+        the same batch — the per-step RNG and iteration counter advance
+        through the same streams. See MultiLayerNetwork.fitSteps for the
+        rationale (host dispatch latency dominates small graphs)."""
+        if self._tc is None:
+            raise ValueError("setTrainingConfig first")
+        tc = self._tc
+        loss_names = self._loss_names()
+        var_names = sorted(n for n, v in self._vars.items()
+                           if v.variableType == VariableType.VARIABLE)
+        updater = tc.updater
+        b = data if data is not None else (features, labels)
+        phs = self._batch_to_placeholders(b, tc)
+        ckey = ("fitSteps", numSteps, tuple(var_names), tuple(loss_names),
+                id(tc), len(self._ops))
+        jloop = self._jit_cache.get(ckey)
+        if jloop is None:
+            step = self._fit_step_fn(tc, loss_names, updater)
+            base_key = jax.random.key(0)
+
+            def loop(params, ustate, consts, phs, it0):
+                def body(i, carry):
+                    p, s, _ = carry
+                    it = it0 + i
+                    loss, p, s = step(p, s, consts, phs, it,
+                                      jax.random.fold_in(base_key, it))
+                    return (p, s, loss.astype(jnp.float32))
+
+                return jax.lax.fori_loop(
+                    0, numSteps, body, (params, ustate, jnp.float32(0)))
+
+            jloop = jax.jit(loop, donate_argnums=(0, 1))
+            self._jit_cache[ckey] = jloop
+        params = {n: self._arrays[n] for n in var_names}
+        consts = {n: a for n, a in self._arrays.items() if n not in params}
+        state = self._train_state_for(params, updater)
+        params, state, loss = jloop(params, state, consts, phs,
+                                    jnp.asarray(self._iteration, jnp.int32))
+        self._arrays.update(params)
+        self._train_state = state
+        self._iteration += numSteps
+        return float(loss)
 
     def _batch_to_placeholders(self, b, tc, bind_labels=True):
         from deeplearning4j_tpu.data import DataSet

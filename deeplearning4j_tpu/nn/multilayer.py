@@ -496,6 +496,106 @@ class MultiLayerNetwork:
 
         run_tbptt(self, x.shape[2], self.conf.tbpttFwdLength, jit_call)
 
+    def fitSteps(self, data, labels=None, numSteps=1):
+        """TPU-native k-step fit: run `numSteps` optimizer steps on ONE
+        batch entirely on device (lax.fori_loop) and sync the loss to the
+        host once per call.
+
+        No upstream analog — upstream fit() pays a host round-trip per
+        iteration, which is correct fit() semantics but lets dispatch
+        latency dominate small models (BENCH_NOTES.md tunnel analysis:
+        ~78 ms/fetch swamps a 2 ms LeNet step). This is the
+        framework-native loop for that regime. Semantics match numSteps
+        consecutive fit() calls on the same batch: the dropout/noise key
+        advances per step from the same fold_in stream, the iteration
+        counter feeds the updater schedules, and tBPTT nets run their
+        full window sweep (carries reset per sequence) per step.
+        Listeners fire once at the end with the final loss.
+        """
+        from deeplearning4j_tpu.data.dataset import DataSet
+
+        self._require_init()
+        ds = DataSet(data, labels) if labels is not None else data
+        x = _unwrap(ds.getFeatures())
+        y = _unwrap(ds.getLabels())
+        fmask = _unwrap(ds.getFeaturesMaskArray())
+        lmask = _unwrap(ds.getLabelsMaskArray())
+        tbptt = (self.conf.backpropType == BackpropType.TruncatedBPTT
+                 and x.ndim == 3)
+        if tbptt:
+            T, L = x.shape[2], self.conf.tbpttFwdLength
+            if T % L != 0:
+                raise ValueError(
+                    f"fitSteps tBPTT needs seq len divisible by "
+                    f"tbpttFwdLength (got T={T}, L={L}): the on-device "
+                    "window sweep uses fixed-size dynamic slices. Use "
+                    "fit() for ragged tails.")
+            n_win = T // L
+        else:
+            n_win = 1
+        cache = getattr(self, "_fit_steps_cache", None)
+        if cache is None:
+            cache = self._fit_steps_cache = {}
+        # n_win is baked into the traced loop body, so it must key the
+        # cache alongside numSteps (jit's own shape retrace would reuse
+        # the wrong closure constant)
+        jloop = cache.get((numSteps, n_win))
+        if jloop is None:
+            seed_key = jax.random.key(self.conf.seed ^ 0x5EED)
+
+            def loop(params, upd, states, it0, x, y, fmask, lmask):
+                def window(carry, step_i, win_i, use_carries):
+                    p, u, s, _ = carry
+                    it = it0 + step_i * n_win + win_i
+                    key = jax.random.fold_in(seed_key, it)
+                    if n_win == 1:
+                        xs, ys, fs, ls = x, y, fmask, lmask
+                    else:
+                        L = self.conf.tbpttFwdLength
+                        sl = lambda a, ax: None if a is None else \
+                            jax.lax.dynamic_slice_in_dim(a, win_i * L, L, ax)
+                        xs, ys, fs, ls = sl(x, 2), sl(y, 2), \
+                            sl(fmask, 1), sl(lmask, 1)
+                    p, u, s, loss = self._train_step(
+                        p, u, s, it, xs, ys, key, fs, ls,
+                        use_carries=use_carries)
+                    return (p, u, s, loss.astype(jnp.float32))
+
+                def body(i, carry):
+                    # window 0 strips carries (fresh sequence); later
+                    # tbptt windows carry h/c across the chunk boundary
+                    carry = window(carry, i, 0, False)
+                    if n_win > 1:
+                        carry = jax.lax.fori_loop(
+                            1, n_win,
+                            lambda w, c: window(c, i, w, True), carry)
+                    # fori_loop needs a structure-stable carry: the step
+                    # ADDS h/c entries to states; strip them at sequence
+                    # end (use_carries=False re-strips inside the step
+                    # anyway, and persistent state like BN stats survives)
+                    p, u, s, loss = carry
+                    return (p, u, self._strip_carries(s), loss)
+
+                return jax.lax.fori_loop(
+                    0, numSteps, body,
+                    (params, upd, self._strip_carries(states),
+                     jnp.float32(0)))
+
+            jloop = jax.jit(
+                loop,
+                donate_argnums=(0, 1, 2) if self._solver is None else (2,))
+            cache[(numSteps, n_win)] = jloop
+        self._params, self._upd_states, self._states, loss = jloop(
+            self._params, self._upd_states, self._states,
+            jnp.asarray(self._iteration, jnp.int32), x, y, fmask, lmask)
+        self._score = float(loss)
+        self._iteration += numSteps * n_win
+        # no post-loop carry strip needed: the loop body strips at the
+        # end of every step to keep the fori carry structure stable
+        for lst in self._listeners:
+            lst.iterationDone(self, self._iteration, self._epoch)
+        return self
+
     # ----- unsupervised layerwise pretraining (VAE etc.) --------------
     def _frozen_feed(self, layerIdx, x, params=None, states=None):
         """The input layers[layerIdx] would receive: frozen inference

@@ -161,15 +161,51 @@ def _on_tpu() -> bool:
 # isn't amortised
 _MIN_FLASH_SEQ = 512
 
+# Mid-T window where the lax.scan blockwise form measured FASTEST on the
+# TPU v5e (BENCH_LIVE_r04 / BENCH_NOTES.md attention table, bf16
+# B4 H8 D64: T=512 flash 5.00 ms beats blockwise 16.29; T=2048 blockwise
+# 7.92 ms beats flash 13.45 AND fused 12.67; T=8192 flash 13.93 beats
+# blockwise 23.84). A single min-T threshold cannot encode that
+# win-lose-win pattern, so the dispatcher carries the measured window
+# explicitly. Boundaries sit at the geometric midpoints of the measured
+# grid (1024, 4096) pending a finer sweep — bench_attention's block-size
+# sweep exists to move them from measurement, not taste.
+_BLOCKWISE_WINDOW = (1024, 4096)
+
+
+def _choose_impl(T, *, on_tpu, force_streaming=False, has_mask=False,
+                 interpret=False):
+    """Pure dispatch decision -> 'flash' | 'fused' | 'blockwise'.
+
+    Split out of flash_attention so tests can pin the choice per (T,
+    backend) against the banked hardware table without running a kernel
+    (tests/test_attention.py::TestDispatchTable)."""
+    if has_mask:
+        return "blockwise"
+    if interpret:
+        return "flash"
+    if not on_tpu:
+        if not force_streaming and T <= 2048:
+            return "fused"
+        return "blockwise"
+    if T < _MIN_FLASH_SEQ:
+        return "blockwise" if force_streaming else "fused"
+    lo, hi = _BLOCKWISE_WINDOW
+    if lo <= T < hi:
+        return "blockwise"
+    return "flash"
+
 
 def flash_attention(q, k, v, causal=False, key_mask=None,
                     block_q=512, block_k=512, force_streaming=False):
     """Attention [B,H,T,D] with automatic kernel dispatch.
 
-    Pallas flash kernel: TPU backend, no ragged key mask, T >= 512.
-    Short sequences use the fused XLA form (scores fit on-chip); ragged
-    masks and non-TPU backends use the lax.scan blockwise form (same
-    online-softmax math, same O(T) memory).
+    The dispatch obeys the measured winner-per-T table (see
+    _BLOCKWISE_WINDOW): fused XLA below 512 (scores fit on-chip), the
+    Pallas flash kernel at long T, and the lax.scan blockwise form in
+    the measured mid-T window where it beats both. Ragged masks and
+    non-TPU backends use the blockwise form (same online-softmax math,
+    same O(T) memory).
 
     force_streaming=True (set when the caller passed an explicit
     block_size, i.e. asked for bounded memory) never takes the fused
@@ -177,21 +213,19 @@ def flash_attention(q, k, v, causal=False, key_mask=None,
     """
     from deeplearning4j_tpu.ops.attention import dot_product_attention
 
-    if key_mask is not None:
+    T = max(q.shape[2], k.shape[2])
+    impl = _choose_impl(T, on_tpu=_on_tpu(), force_streaming=force_streaming,
+                        has_mask=key_mask is not None, interpret=_INTERPRET)
+    if impl == "fused":
+        return dot_product_attention(q, k, v, causal=causal)
+    if impl == "blockwise":
         return blockwise_attention(q, k, v, block_size=block_k, causal=causal,
                                    key_mask=key_mask)
-    if _INTERPRET:  # tests: force the kernel path on any backend
+    if _INTERPRET:
+        # interpreter-mode tests exist to catch kernel regressions — the
+        # silent fallback below would hand them blockwise output that
+        # matches the reference by construction
         return _flash(q, k, v, causal, block_q, block_k)
-    T = max(q.shape[2], k.shape[2])
-    if not _on_tpu():
-        if not force_streaming and T <= 2048:
-            return dot_product_attention(q, k, v, causal=causal)
-        return blockwise_attention(q, k, v, block_size=block_k, causal=causal)
-    if T < _MIN_FLASH_SEQ:
-        if force_streaming:
-            return blockwise_attention(q, k, v, block_size=block_k,
-                                       causal=causal)
-        return dot_product_attention(q, k, v, causal=causal)
     try:
         return _flash(q, k, v, causal, block_q, block_k)
     except Exception:

@@ -134,6 +134,87 @@ class TestFit:
         assert np.isfinite(net.score())
 
 
+class TestFitSteps:
+    """fitSteps(k) — the TPU-native on-device k-step loop — must be
+    bit-for-bit the same trajectory as k consecutive fit() calls on the
+    same batch (same RNG stream, same iteration counters)."""
+
+    def test_matches_k_fit_calls(self):
+        x, y, _ = _separable_data()
+        a = MultiLayerNetwork(_mlp(seed=7)).init()
+        b = MultiLayerNetwork(_mlp(seed=7)).init()
+        for _ in range(5):
+            a.fit(x, y)
+        b.fitSteps(x, y, numSteps=5)
+        np.testing.assert_allclose(a.params().toNumpy(),
+                                   b.params().toNumpy(), rtol=2e-6, atol=2e-6)
+        assert abs(a.score() - b.score()) < 1e-5
+        assert a._iteration == b._iteration == 5
+
+    def test_matches_with_dropout_rng_stream(self):
+        """Dropout keys advance per inner step exactly as fit()'s."""
+        def conf():
+            return (NeuralNetConfiguration.Builder().seed(3)
+                    .updater(Sgd(0.05)).weightInit(WeightInit.XAVIER)
+                    .activation("relu").list()
+                    .layer(DenseLayer(nOut=16, dropOut=0.7))
+                    .layer(OutputLayer(nOut=3, activation="softmax",
+                                       lossFunction="mcxent"))
+                    .setInputType(InputType.feedForward(4)).build())
+        x, y, _ = _separable_data()
+        a = MultiLayerNetwork(conf()).init()
+        b = MultiLayerNetwork(conf()).init()
+        for _ in range(4):
+            a.fit(x, y)
+        b.fitSteps(x, y, numSteps=4)
+        np.testing.assert_allclose(a.params().toNumpy(),
+                                   b.params().toNumpy(), rtol=2e-6, atol=2e-6)
+
+    def test_tbptt_window_sweep(self):
+        V, B, T, L = 5, 4, 8, 4
+
+        def conf():
+            return (NeuralNetConfiguration.Builder().seed(11)
+                    .updater(Adam(5e-3)).list()
+                    .layer(GravesLSTM(nOut=8))
+                    .layer(RnnOutputLayer(nOut=V, activation="softmax",
+                                          lossFunction="mcxent"))
+                    .setInputType(InputType.recurrent(V, T))
+                    .backpropType(BackpropType.TruncatedBPTT)
+                    .tBPTTLength(L).build())
+
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, V, (B, T))
+        x = np.eye(V, dtype="float32")[ids].transpose(0, 2, 1)
+        y = np.eye(V, dtype="float32")[np.roll(ids, -1, 1)].transpose(0, 2, 1)
+        a = MultiLayerNetwork(conf()).init()
+        b = MultiLayerNetwork(conf()).init()
+        for _ in range(3):
+            a.fit(x, y)
+        b.fitSteps(x, y, numSteps=3)
+        np.testing.assert_allclose(a.params().toNumpy(),
+                                   b.params().toNumpy(), rtol=5e-6, atol=5e-6)
+        assert a._iteration == b._iteration  # 3 sequences x 2 windows
+
+    def test_tbptt_ragged_tail_raises(self):
+        V, B, T, L = 5, 4, 10, 4  # 10 % 4 != 0
+
+        conf = (NeuralNetConfiguration.Builder().seed(1).updater(Sgd(0.1))
+                .list()
+                .layer(GravesLSTM(nOut=8))
+                .layer(RnnOutputLayer(nOut=V, activation="softmax",
+                                      lossFunction="mcxent"))
+                .setInputType(InputType.recurrent(V, T))
+                .backpropType(BackpropType.TruncatedBPTT)
+                .tBPTTLength(L).build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(0)
+        x = rng.rand(B, V, T).astype("float32")
+        y = rng.rand(B, V, T).astype("float32")
+        with pytest.raises(ValueError, match="divisible"):
+            net.fitSteps(x, y, numSteps=2)
+
+
 class TestCnn:
     def test_lenet_shape_inference_and_fit(self):
         conf = (NeuralNetConfiguration.Builder()

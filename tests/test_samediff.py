@@ -1096,3 +1096,48 @@ class TestEvaluateAndScopedSerde:
         np.testing.assert_array_equal(
             np.asarray(sd.getVariable("enc/out").eval({"x": xv}).jax()),
             np.asarray(sd2.getVariable("enc/out").eval({"x": xv}).jax()))
+
+
+class TestFitSteps:
+    """SameDiff.fitSteps — the on-device k-step loop — must follow the
+    same trajectory as k fit() calls on the same batch (shared raw step,
+    same RNG/iteration streams)."""
+
+    def _linreg(self):
+        rs = np.random.RandomState(0)
+        X = rs.rand(32, 5)
+        Y = X @ np.array([[1.0], [-2.0], [3.0], [0.5], [-1.5]])
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", jnp.float64, 32, 5)
+        y = sd.placeHolder("y", jnp.float64, 32, 1)
+        w = sd.var("w", np.zeros((5, 1)))
+        sd.loss.meanSquaredError(y, sd.nn.linear(x, w, name="p"), name="l")
+        sd.setTrainingConfig(TrainingConfig.Builder()
+                             .updater(Adam(learningRate=0.05))
+                             .dataSetFeatureMapping("x")
+                             .dataSetLabelMapping("y").build())
+        return sd, X, Y
+
+    def test_matches_k_fit_calls(self):
+        a, X, Y = self._linreg()
+        b, _, _ = self._linreg()
+        hist = a.fit(features=X, labels=Y, epochs=6)
+        loss = b.fitSteps(features=X, labels=Y, numSteps=6)
+        np.testing.assert_allclose(
+            a.getVariable("w").getArr().toNumpy(),
+            b.getVariable("w").getArr().toNumpy(), rtol=1e-6, atol=1e-8)
+        # fitSteps returns the LAST step's loss (fp32 carry)
+        np.testing.assert_allclose(loss, hist[-1], rtol=1e-5)
+        assert a._iteration == b._iteration == 6
+
+    def test_interleaves_with_fit(self):
+        """fit() after fitSteps() continues the same updater state and
+        iteration counter (no hidden reset)."""
+        a, X, Y = self._linreg()
+        b, _, _ = self._linreg()
+        a.fit(features=X, labels=Y, epochs=4)
+        b.fitSteps(features=X, labels=Y, numSteps=2)
+        b.fit(features=X, labels=Y, epochs=2)
+        np.testing.assert_allclose(
+            a.getVariable("w").getArr().toNumpy(),
+            b.getVariable("w").getArr().toNumpy(), rtol=1e-6, atol=1e-8)
