@@ -428,8 +428,13 @@ def bench_attention():
                         _flash(q, k, v, True, bq, bk)), 3)
                 except Exception as e:
                     sweep[f"bq{bq}_bk{bk}"] = f"{type(e).__name__}"
+                # incremental banking against a mid-sweep tunnel stall;
+                # partial=True so a line-grabbing reader can't mistake
+                # an early cumulative record for the finished sweep
                 print("\nBENCHREC-SWEEP " + json.dumps(
-                    {"T": T, "sweep": sweep}), flush=True)
+                    {"T": T, "partial": True, "sweep": sweep}), flush=True)
+            print("\nBENCHREC-SWEEP " + json.dumps(
+                {"T": T, "sweep": sweep}), flush=True)
             rec["flash_block_sweep"] = sweep
             ms = [v for v in sweep.values() if isinstance(v, float)]
             if ms:

@@ -8,6 +8,8 @@ from deeplearning4j_tpu.clustering.kmeans import (KMeansClustering,
                                                   NearestNeighbors)
 from deeplearning4j_tpu.clustering.trees import VPTree, KDTree
 from deeplearning4j_tpu.clustering.lsh import RandomProjectionLSH
+from deeplearning4j_tpu.clustering.server import NearestNeighborsServer
 
 __all__ = ["KMeansClustering", "ClusterSet", "NearestNeighbors",
-           "VPTree", "KDTree", "RandomProjectionLSH"]
+           "VPTree", "KDTree", "RandomProjectionLSH",
+           "NearestNeighborsServer"]

@@ -1,0 +1,145 @@
+"""NearestNeighborsServer: HTTP kNN serving over VPTree / LSH indexes.
+
+Reference: deeplearning4j-nearestneighbors-server — upstream's
+NearestNeighborsServer loads an INDArray corpus, builds a VPTree, and
+serves JSON kNN queries over HTTP (`/knn` for an already-indexed point,
+`/knnnew` for a new vector). Same surface here on stdlib http.server —
+zero new dependencies, daemon-threaded like optimize.ui.UIServer:
+
+  GET  /status   {"numPoints": n, "dims": d, "index": "VPTree"}
+  POST /knn      {"index": i, "k": 5}      neighbors of corpus point i
+  POST /knnnew   {"point": [...], "k": 5}  neighbors of a new vector
+
+Both POST routes answer {"results": [{"index": i, "distance": d}, ...]},
+nearest first. /knn drops the query point itself from its result (the
+trivial distance-0 self match), matching the upstream behavior.
+
+Any object with `search(vector, k) -> (indices, distances)` can serve —
+VPTree (exact) and RandomProjectionLSH (approximate) both qualify.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import numpy as np
+
+from deeplearning4j_tpu.clustering.trees import VPTree
+
+
+class NearestNeighborsServer:
+    """Build (or wrap) a kNN index and serve it over HTTP.
+
+    points: [n, d] corpus -> a VPTree is built over it.
+    index:  alternatively, a prebuilt index exposing search(vec, k);
+            pass `corpus` too if /knn (query-by-row) should work.
+    """
+
+    def __init__(self, points=None, index=None, corpus=None):
+        if (points is None) == (index is None):
+            raise ValueError("pass exactly one of points / index")
+        if points is not None:
+            self._corpus = np.asarray(
+                getattr(points, "toNumpy", lambda: points)(), np.float64)
+            self._index = VPTree(self._corpus)
+        else:
+            self._index = index
+            self._corpus = None if corpus is None else np.asarray(
+                getattr(corpus, "toNumpy", lambda: corpus)(), np.float64)
+        self._httpd = None
+        self._thread = None
+
+    # ----- query API (usable without the HTTP layer) -------------------
+    def knnNew(self, point, k):
+        idx, dist = self._index.search(point, int(k))
+        return [{"index": int(i), "distance": float(d)}
+                for i, d in zip(np.asarray(idx), np.asarray(dist))]
+
+    def knn(self, row, k):
+        if self._corpus is None:
+            raise ValueError(
+                "/knn needs the corpus — construct with points= or corpus=")
+        row = int(row)
+        if not (0 <= row < self._corpus.shape[0]):
+            raise ValueError(
+                f"index {row} outside corpus [0, {self._corpus.shape[0]})")
+        # k+1 then drop the self-match (distance-0 row itself)
+        k = int(k)
+        k_eff = min(k + 1, self._corpus.shape[0])
+        res = self.knnNew(self._corpus[row], k_eff)
+        return [r for r in res if r["index"] != row][:k]
+
+    @property
+    def numPoints(self):
+        if self._corpus is not None:
+            return int(self._corpus.shape[0])
+        X = getattr(self._index, "_X", None)
+        return None if X is None else int(np.asarray(X).shape[0])
+
+    # ----- HTTP layer --------------------------------------------------
+    @property
+    def port(self):
+        return self._httpd.server_address[1] if self._httpd else None
+
+    def start(self, port=9200):
+        """Serve on 127.0.0.1:<port> (0 = ephemeral); returns self."""
+        import http.server
+
+        if self._httpd is not None:
+            return self
+        srv = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, obj, code=200):
+                data = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path != "/status":
+                    return self._json({"error": "unknown route"}, 404)
+                d = None
+                if srv._corpus is not None:
+                    d = int(srv._corpus.shape[1])
+                elif getattr(srv._index, "_X", None) is not None:
+                    d = int(np.asarray(srv._index._X).shape[1])
+                return self._json({"numPoints": srv.numPoints, "dims": d,
+                                   "index": type(srv._index).__name__})
+
+            def do_POST(self):
+                if self.path not in ("/knn", "/knnnew"):
+                    return self._json({"error": "unknown route"}, 404)
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    k = int(body.get("k", 5))
+                    if self.path == "/knn":
+                        results = srv.knn(body["index"], k)
+                    else:
+                        results = srv.knnNew(
+                            np.asarray(body["point"], np.float64), k)
+                    return self._json({"results": results, "k": k})
+                except (KeyError, TypeError, ValueError) as e:
+                    return self._json(
+                        {"error": f"{type(e).__name__}: {e}"}, 400)
+
+        self._httpd = http.server.ThreadingHTTPServer(("127.0.0.1", port),
+                                                      Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._thread = None

@@ -12,7 +12,11 @@ from deeplearning4j_tpu.ndarray.convolution import Convolution
 from deeplearning4j_tpu.ndarray.indexing import NDArrayIndex
 from deeplearning4j_tpu.ndarray.executioner import XlaExecutioner
 from deeplearning4j_tpu.ndarray.transforms import Transforms
+from deeplearning4j_tpu.ndarray.compression import (BasicNDArrayCompressor,
+                                                    CompressedNDArray,
+                                                    Int8Inference)
 
 __all__ = ["Convolution",
            "DataType", "INDArray", "Nd4j", "NDArrayIndex", "XlaExecutioner",
-           "Transforms"]
+           "Transforms", "BasicNDArrayCompressor", "CompressedNDArray",
+           "Int8Inference"]

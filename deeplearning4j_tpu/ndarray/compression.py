@@ -1,0 +1,225 @@
+"""NDArray buffer compression + post-training int8 weight quantization.
+
+Reference: nd4j-api `BasicNDArrayCompressor` / `Nd4j.getCompressor()` —
+named buffer codecs (GZIP, FLOAT16, INT8, NOOP) with
+compress/decompress and a process-wide default algorithm. Upstream uses
+these to shrink buffers at rest (serialization, transport); the codec
+surface is reproduced 1:1 here.
+
+The TPU-first extension is `quantize_int8` + `Int8Inference`
+(dequant-on-use): weights live in HBM as int8 with per-output-channel
+scales and are dequantized INSIDE the jitted forward, so XLA fuses the
+`q * scale` into the consuming matmul/conv — 4x less weight bandwidth
+on the bandwidth-bound inference path, which is the role upstream's
+INT8 compression plays for its CUDA buffers.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ndarray.ndarray import INDArray
+
+_ALGOS = ("GZIP", "FLOAT16", "INT8", "NOOP")
+
+
+class CompressedNDArray:
+    """Opaque compressed buffer + the descriptor needed to restore it
+    (upstream: a compressed INDArray flagged by its CompressionDescriptor)."""
+
+    def __init__(self, algo, payload, shape, dtype, extra=None):
+        self.algo = algo
+        self.payload = payload
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+        self.extra = extra  # per-algo sidecar (e.g. int8 scale)
+
+    def isCompressed(self):
+        return True
+
+    def compressedBytes(self):
+        n = len(self.payload) if isinstance(self.payload, bytes) \
+            else self.payload.nbytes
+        if self.extra is not None:
+            n += np.asarray(self.extra).nbytes
+        return n
+
+    def originalBytes(self):
+        return int(np.prod(self.shape, dtype=np.int64)) * self.dtype.itemsize
+
+    def ratio(self):
+        return self.compressedBytes() / max(self.originalBytes(), 1)
+
+    def __repr__(self):
+        return (f"CompressedNDArray(algo={self.algo}, shape={self.shape}, "
+                f"ratio={self.ratio():.3f})")
+
+
+class BasicNDArrayCompressor:
+    """`Nd4j.getCompressor()` parity surface.
+
+    GZIP    lossless zlib over the raw buffer
+    FLOAT16 cast to f16 (lossy), restored to the original float dtype
+    INT8    per-tensor absmax affine int8 (lossy), scale in the sidecar
+    NOOP    descriptor-only identity (upstream ships one; useful to
+            exercise the codec path with zero loss)
+    """
+
+    _instance = None
+
+    @classmethod
+    def getInstance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def __init__(self):
+        self._default = "GZIP"
+
+    def getAvailableCompressors(self):
+        return list(_ALGOS)
+
+    def setDefaultCompression(self, algo):
+        algo = str(algo).upper()
+        if algo not in _ALGOS:
+            raise ValueError(f"unknown compressor {algo!r}; "
+                             f"available: {_ALGOS}")
+        self._default = algo
+        return self
+
+    def getDefaultCompression(self):
+        return self._default
+
+    def compress(self, arr, algo=None):
+        algo = (algo or self._default).upper()
+        if algo not in _ALGOS:
+            raise ValueError(f"unknown compressor {algo!r}; "
+                             f"available: {_ALGOS}")
+        x = np.asarray(getattr(arr, "toNumpy", lambda: arr)())
+        if algo == "GZIP":
+            return CompressedNDArray(
+                algo, zlib.compress(np.ascontiguousarray(x).tobytes(), 6),
+                x.shape, x.dtype)
+        if algo == "FLOAT16":
+            if not np.issubdtype(x.dtype, np.floating):
+                raise ValueError("FLOAT16 compression needs a float array")
+            return CompressedNDArray(algo, x.astype(np.float16),
+                                     x.shape, x.dtype)
+        if algo == "INT8":
+            if not np.issubdtype(x.dtype, np.floating):
+                raise ValueError("INT8 compression needs a float array")
+            scale = float(np.max(np.abs(x))) / 127.0 or 1.0
+            q = np.clip(np.round(x / scale), -127, 127).astype(np.int8)
+            return CompressedNDArray(algo, q, x.shape, x.dtype,
+                                     extra=np.float32(scale))
+        return CompressedNDArray(algo, x, x.shape, x.dtype)  # NOOP
+
+    def decompress(self, carr):
+        if not isinstance(carr, CompressedNDArray):
+            return carr if isinstance(carr, INDArray) else INDArray(carr)
+        if carr.algo == "GZIP":
+            x = np.frombuffer(zlib.decompress(carr.payload),
+                              dtype=carr.dtype).reshape(carr.shape)
+        elif carr.algo == "FLOAT16":
+            x = carr.payload.astype(carr.dtype)
+        elif carr.algo == "INT8":
+            x = (carr.payload.astype(np.float32)
+                 * np.float32(carr.extra)).astype(carr.dtype)
+        else:  # NOOP
+            x = carr.payload
+        return INDArray(np.asarray(x).reshape(carr.shape))
+
+
+# ---------------------------------------------------------------------
+# post-training int8 weight quantization (dequant-on-use inference)
+# ---------------------------------------------------------------------
+
+class QLeaf(NamedTuple):
+    """An int8-quantized weight leaf: q int8, scale fp32 broadcast along
+    the last (output-channel) axis. NamedTuple = transparent jax pytree."""
+    q: jnp.ndarray
+    scale: jnp.ndarray
+
+
+def _eligible(a):
+    return (hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+            and getattr(a, "ndim", 0) >= 2)
+
+
+def quantize_int8(params):
+    """fp weight pytree -> same-structure pytree with >=2-D float leaves
+    replaced by QLeaf (per-output-channel absmax int8). 1-D leaves
+    (biases, BN stats) stay fp — they are a rounding error of the bytes
+    and quantizing them costs accuracy for nothing."""
+
+    def quant(a):
+        if not _eligible(a):
+            return a
+        x = jnp.asarray(a, jnp.float32)
+        absmax = jnp.max(jnp.abs(x), axis=tuple(range(x.ndim - 1)),
+                         keepdims=True)
+        scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+        q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+        return QLeaf(q=q, scale=scale.astype(jnp.float32))
+
+    return jax.tree_util.tree_map(quant, params)
+
+
+def dequantize(qparams, dtype=jnp.float32):
+    def dq(leaf):
+        if isinstance(leaf, QLeaf):
+            return (leaf.q.astype(jnp.float32) * leaf.scale).astype(dtype)
+        return leaf
+
+    return jax.tree_util.tree_map(
+        dq, qparams, is_leaf=lambda x: isinstance(x, QLeaf))
+
+
+def quantized_bytes(qparams):
+    """(quantized, original-fp32) byte counts for the weight pytree."""
+    qb = fb = 0
+    for leaf in jax.tree_util.tree_leaves(
+            qparams, is_leaf=lambda x: isinstance(x, QLeaf)):
+        if isinstance(leaf, QLeaf):
+            qb += leaf.q.size + leaf.scale.size * 4
+            fb += leaf.q.size * 4
+        elif hasattr(leaf, "size"):
+            qb += leaf.size * 4
+            fb += leaf.size * 4
+    return qb, fb
+
+
+class Int8Inference:
+    """Int8 dequant-on-use inference wrapper for a trained
+    MultiLayerNetwork: `Int8Inference(net).output(x)`.
+
+    Weights are held as int8+scale; the dequant runs inside the jitted
+    forward so XLA fuses it into each weight's consumer and the HBM
+    working set shrinks ~4x. Accuracy: per-channel absmax keeps zoo-size
+    classifiers within a fraction of a point of fp32 top-1 (pinned by
+    tests/test_compression.py on a trained model).
+    """
+
+    def __init__(self, net):
+        net._require_init()
+        self._net = net
+        self._qparams = quantize_int8(net._params)
+        cdt = net._compute_dtype
+
+        def fwd(qp, states, x):
+            return net._forward_infer(dequantize(qp, cdt), states, x)
+
+        self._jit = jax.jit(fwd)
+
+    def output(self, x):
+        x = x.jax() if isinstance(x, INDArray) else jnp.asarray(x)
+        return INDArray(self._jit(self._qparams, self._net._states, x))
+
+    def memoryRatio(self):
+        qb, fb = quantized_bytes(self._qparams)
+        return qb / max(fb, 1)

@@ -232,6 +232,15 @@ class Nd4j:
         return INDArray(jnp.kron(_unwrap(a), _unwrap(b)))
 
     @staticmethod
+    def getCompressor():
+        """Reference: Nd4j.getCompressor() -> BasicNDArrayCompressor
+        singleton (GZIP/FLOAT16/INT8/NOOP buffer codecs)."""
+        from deeplearning4j_tpu.ndarray.compression import \
+            BasicNDArrayCompressor
+
+        return BasicNDArrayCompressor.getInstance()
+
+    @staticmethod
     def argMax(arr, *dimension) -> INDArray:
         """Reference: Nd4j.argMax(arr, dims) — flat argmax with no dims.
         Multi-dim reduction raises rather than silently using only the

@@ -1,12 +1,14 @@
-"""Training dashboard rendering.
+"""Training dashboard: static report rendering + live HTTP server.
 
 Reference: deeplearning4j-ui — `UIServer.getInstance().attach(storage)`
-serves a live play-framework dashboard fed by StatsListener. That design
-assumes a long-lived JVM webserver next to the trainer; in this
-zero-egress TPU build the equivalent is (a) the StatsListener JSONL
-stream, which any live dashboard can tail, and (b) this module, which
-renders that stream into a single self-contained HTML report (inline
-SVG, no external assets, no server) — the artifact you keep from a run.
+serves a live play-framework dashboard fed by StatsListener. The TPU
+build keeps that shape with zero new dependencies: (a) the StatsListener
+JSONL stream, (b) render_report(), which turns that stream into a
+single self-contained HTML report (inline SVG, no external assets) —
+the artifact you keep from a run — and (c) UIServer.start(), a stdlib
+http.server endpoint that serves the live-rendered report with
+auto-refresh plus a JSONL polling route (`/train/updates?since=N`) for
+external dashboards, standing in for the reference's Play/Vertx server.
 """
 
 from __future__ import annotations
@@ -14,7 +16,9 @@ from __future__ import annotations
 import html
 import json
 import math
+import threading
 import time
+import urllib.parse
 
 
 def _read_records(logFile):
@@ -121,11 +125,20 @@ td{{border:1px solid #ddd;padding:4px 12px}}
 
 
 class UIServer:
-    """API-compatible shim for the reference's UIServer singleton.
+    """The reference's UIServer singleton, TPU-build edition.
 
     attach() takes a StatsListener (or a JSONL path); render() produces
-    the HTML report for every attached source. There is deliberately no
-    live HTTP server in this build — the report is the artifact.
+    the HTML report for every attached source; start(port) serves the
+    live report over HTTP (stdlib http.server — see module docstring):
+
+      GET /                       report for source 0, auto-refreshing
+      GET /train/<i>              report for source i
+      GET /train/<i>/updates?since=N   JSONL records from line N on,
+                                  as {"records": [...], "next": M}
+      GET /sources                attached source paths
+
+    The handler re-reads the JSONL on every request, so a dashboard
+    open during training updates as the listener appends.
     """
 
     _instance = None
@@ -138,6 +151,8 @@ class UIServer:
 
     def __init__(self):
         self._sources = []
+        self._httpd = None
+        self._thread = None
 
     def attach(self, source):
         path = getattr(source, "logFile", source)
@@ -163,3 +178,81 @@ class UIServer:
                     f"{outFile}.{i}.html"
             docs.append(render_report(src, out, title=title))
         return docs
+
+    # ----- live server (reference: UIServer.getInstance() web UI) -----
+    @property
+    def port(self):
+        """Bound port once start()ed (use port=0 for an ephemeral one)."""
+        return self._httpd.server_address[1] if self._httpd else None
+
+    def start(self, port=9000, refreshSec=5):
+        """Serve the live dashboard on 127.0.0.1:<port>; returns self.
+        Daemon-threaded, so it never keeps a training process alive."""
+        import http.server
+
+        if self._httpd is not None:
+            return self
+        ui = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):  # no stderr chatter per request
+                pass
+
+            def _send(self, code, body, ctype):
+                data = body.encode() if isinstance(body, str) else body
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _json(self, obj, code=200):
+                self._send(code, json.dumps(obj), "application/json")
+
+            def do_GET(self):
+                parsed = urllib.parse.urlparse(self.path)
+                parts = [p for p in parsed.path.split("/") if p]
+                try:
+                    if parsed.path == "/sources":
+                        return self._json({"sources": list(ui._sources)})
+                    if not parts or parts[0] == "train":
+                        # /train/updates == /train/0/updates (the docs'
+                        # short form for the single-source case)
+                        if len(parts) > 1 and parts[1] == "updates":
+                            parts = [parts[0], "0"] + parts[1:]
+                        idx = int(parts[1]) if len(parts) > 1 else 0
+                        if not (0 <= idx < len(ui._sources)):
+                            return self._json(
+                                {"error": f"no source {idx} attached"}, 404)
+                        src = ui._sources[idx]
+                        if len(parts) > 2 and parts[2] == "updates":
+                            q = urllib.parse.parse_qs(parsed.query)
+                            since = int(q.get("since", ["0"])[0])
+                            recs = _read_records(src)
+                            return self._json({"records": recs[since:],
+                                               "next": len(recs)})
+                        doc = render_report(src, title=f"Training (live) — {src}")
+                        doc = doc.replace(
+                            "<meta charset='utf-8'>",
+                            "<meta charset='utf-8'>"
+                            f"<meta http-equiv='refresh' content='{refreshSec}'>",
+                            1)
+                        return self._send(200, doc, "text/html")
+                    return self._json({"error": "unknown route"}, 404)
+                except (ValueError, OSError) as e:
+                    return self._json({"error": f"{type(e).__name__}: {e}"},
+                                      500)
+
+        self._httpd = http.server.ThreadingHTTPServer(("127.0.0.1", port),
+                                                      Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._thread = None

@@ -1,0 +1,172 @@
+"""Serving tier: live UIServer dashboard + NearestNeighborsServer.
+
+Reference strategy: upstream's deeplearning4j-ui TestVertxUI and
+nearestneighbors-server NearestNeighborsTest drive the real HTTP
+endpoints and parse the responses — same here (stdlib urllib against
+127.0.0.1, ephemeral ports, no mocks).
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (NearestNeighborsServer,
+                                           RandomProjectionLSH, VPTree)
+from deeplearning4j_tpu.optimize.ui import UIServer
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+def _post(url, obj):
+    req = urllib.request.Request(
+        url, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+@pytest.fixture
+def stats_log(tmp_path):
+    p = tmp_path / "stats.jsonl"
+    with open(p, "w") as fh:
+        for i in range(8):
+            fh.write(json.dumps({"type": "stats", "iteration": i,
+                                 "score": 2.0 / (i + 1),
+                                 "iterationsPerSec": 10.0 + i,
+                                 "time": 100.0 + i}) + "\n")
+        fh.write(json.dumps({"type": "epochEnd", "epoch": 0}) + "\n")
+    return p
+
+
+class TestUIServerLive:
+    def test_dashboard_and_polling_roundtrip(self, stats_log):
+        ui = UIServer().attach(str(stats_log)).start(port=0)
+        try:
+            base = f"http://127.0.0.1:{ui.port}"
+            status, html_doc = _get(base + "/")
+            assert status == 200
+            assert "http-equiv='refresh'" in html_doc
+            assert "score vs iteration" in html_doc
+            assert "0.25" in html_doc  # final score 2/8
+
+            status, body = _get(base + "/train/0/updates?since=0")
+            upd = json.loads(body)
+            assert status == 200 and upd["next"] == 9
+            assert upd["records"][0]["score"] == 2.0
+
+            # live append -> the polling route sees exactly the new tail
+            with open(stats_log, "a") as fh:
+                fh.write(json.dumps({"type": "stats", "iteration": 8,
+                                     "score": 0.2}) + "\n")
+            status, body = _get(base + f"/train/0/updates?since={upd['next']}")
+            upd2 = json.loads(body)
+            assert [r["iteration"] for r in upd2["records"]] == [8]
+            assert upd2["next"] == 10
+
+            status, body = _get(base + "/sources")
+            assert json.loads(body)["sources"] == [str(stats_log)]
+        finally:
+            ui.stop()
+        assert ui.port is None
+
+    def test_unknown_source_404(self, stats_log):
+        ui = UIServer().attach(str(stats_log)).start(port=0)
+        try:
+            for bad in ("/train/5", "/train/-1"):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    _get(f"http://127.0.0.1:{ui.port}{bad}")
+                assert ei.value.code == 404, bad
+        finally:
+            ui.stop()
+
+    def test_updates_short_form(self, stats_log):
+        """Docs advertise /train/updates as shorthand for source 0."""
+        ui = UIServer().attach(str(stats_log)).start(port=0)
+        try:
+            status, body = _get(
+                f"http://127.0.0.1:{ui.port}/train/updates?since=8")
+            upd = json.loads(body)
+            assert status == 200 and upd["next"] == 9
+            assert upd["records"][0]["type"] == "epochEnd"
+        finally:
+            ui.stop()
+
+
+class TestNearestNeighborsServer:
+    def _corpus(self, n=64, d=8):
+        return np.random.RandomState(0).randn(n, d)
+
+    def test_knnnew_matches_bruteforce(self):
+        X = self._corpus()
+        srv = NearestNeighborsServer(points=X).start(port=0)
+        try:
+            q = np.random.RandomState(1).randn(8)
+            status, resp = _post(f"http://127.0.0.1:{srv.port}/knnnew",
+                                 {"point": q.tolist(), "k": 5})
+            assert status == 200 and len(resp["results"]) == 5
+            got = [r["index"] for r in resp["results"]]
+            want = np.argsort(np.linalg.norm(X - q, axis=1))[:5].tolist()
+            assert got == want
+            dists = [r["distance"] for r in resp["results"]]
+            assert dists == sorted(dists)
+        finally:
+            srv.stop()
+
+    def test_knn_excludes_self(self):
+        X = self._corpus()
+        srv = NearestNeighborsServer(points=X).start(port=0)
+        try:
+            status, resp = _post(f"http://127.0.0.1:{srv.port}/knn",
+                                 {"index": 3, "k": 4})
+            assert status == 200
+            idxs = [r["index"] for r in resp["results"]]
+            assert 3 not in idxs and len(idxs) == 4
+            want = np.argsort(np.linalg.norm(X - X[3], axis=1))[1:5].tolist()
+            assert idxs == want
+        finally:
+            srv.stop()
+
+    def test_status_and_errors(self):
+        X = self._corpus(n=16, d=4)
+        srv = NearestNeighborsServer(points=X).start(port=0)
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            status, body = _get(base + "/status")
+            st = json.loads(body)
+            assert st == {"numPoints": 16, "dims": 4, "index": "VPTree"}
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(base + "/knnnew", {"point": [1.0, 2.0], "k": 3})
+            assert ei.value.code == 400  # wrong dims -> readable error
+            assert "dims" in json.loads(ei.value.read().decode())["error"]
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(base + "/knn", {"k": 3})  # missing index
+            assert ei.value.code == 400
+        finally:
+            srv.stop()
+
+    def test_lsh_backed_index(self):
+        X = self._corpus(n=128, d=16)
+        lsh = RandomProjectionLSH(hashLength=4, numTables=6, inDimension=16)
+        lsh.index(X)
+        srv = NearestNeighborsServer(index=lsh, corpus=X).start(port=0)
+        try:
+            status, resp = _post(f"http://127.0.0.1:{srv.port}/knnnew",
+                                 {"point": X[7].tolist(), "k": 3})
+            assert status == 200
+            # the query IS corpus row 7 — any sane LSH recalls its bucket
+            assert resp["results"][0]["index"] == 7
+            assert resp["results"][0]["distance"] < 1e-6
+        finally:
+            srv.stop()
+
+    def test_ctor_validation(self):
+        with pytest.raises(ValueError, match="exactly one"):
+            NearestNeighborsServer()
+        with pytest.raises(ValueError, match="exactly one"):
+            NearestNeighborsServer(points=np.eye(3), index=VPTree(np.eye(3)))
