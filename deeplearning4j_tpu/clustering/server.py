@@ -20,15 +20,13 @@ VPTree (exact) and RandomProjectionLSH (approximate) both qualify.
 
 from __future__ import annotations
 
-import json
-import threading
-
 import numpy as np
 
 from deeplearning4j_tpu.clustering.trees import VPTree
+from deeplearning4j_tpu.util.httpserve import HttpServerOwner, JsonHandler
 
 
-class NearestNeighborsServer:
+class NearestNeighborsServer(HttpServerOwner):
     """Build (or wrap) a kNN index and serve it over HTTP.
 
     points: [n, d] corpus -> a VPTree is built over it.
@@ -47,8 +45,6 @@ class NearestNeighborsServer:
             self._index = index
             self._corpus = None if corpus is None else np.asarray(
                 getattr(corpus, "toNumpy", lambda: corpus)(), np.float64)
-        self._httpd = None
-        self._thread = None
 
     # ----- query API (usable without the HTTP layer) -------------------
     def knnNew(self, point, k):
@@ -78,30 +74,11 @@ class NearestNeighborsServer:
         return None if X is None else int(np.asarray(X).shape[0])
 
     # ----- HTTP layer --------------------------------------------------
-    @property
-    def port(self):
-        return self._httpd.server_address[1] if self._httpd else None
-
     def start(self, port=9200):
         """Serve on 127.0.0.1:<port> (0 = ephemeral); returns self."""
-        import http.server
-
-        if self._httpd is not None:
-            return self
         srv = self
 
-        class Handler(http.server.BaseHTTPRequestHandler):
-            def log_message(self, *a):
-                pass
-
-            def _json(self, obj, code=200):
-                data = json.dumps(obj).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
-
+        class Handler(JsonHandler):
             def do_GET(self):
                 if self.path != "/status":
                     return self._json({"error": "unknown route"}, 404)
@@ -117,8 +94,7 @@ class NearestNeighborsServer:
                 if self.path not in ("/knn", "/knnnew"):
                     return self._json({"error": "unknown route"}, 404)
                 try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    body = json.loads(self.rfile.read(n) or b"{}")
+                    body = self._read_json_object()
                     k = int(body.get("k", 5))
                     if self.path == "/knn":
                         results = srv.knn(body["index"], k)
@@ -130,16 +106,4 @@ class NearestNeighborsServer:
                     return self._json(
                         {"error": f"{type(e).__name__}: {e}"}, 400)
 
-        self._httpd = http.server.ThreadingHTTPServer(("127.0.0.1", port),
-                                                      Handler)
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True)
-        self._thread.start()
-        return self
-
-    def stop(self):
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
-            self._thread = None
+        return self._serve(Handler, port)

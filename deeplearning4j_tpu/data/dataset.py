@@ -269,7 +269,17 @@ class MultipleEpochsIterator:
     def hasNext(self) -> bool:
         if self._it.hasNext():
             return True
-        return self._epoch + 1 < self.numEpochs
+        # hasNext()==True must guarantee next() succeeds: an EMPTY
+        # underlying iterator has no batch in ANY remaining epoch, so
+        # advance epochs (reset + re-check) until a batch is actually
+        # available (ADVICE r4 — remaining epochs alone don't imply a
+        # remaining batch). next() below tolerates the advanced state.
+        while self._epoch + 1 < self.numEpochs:
+            self._epoch += 1
+            self._it.reset()
+            if self._it.hasNext():
+                return True
+        return False
 
     def next(self, num=None) -> DataSet:
         if not self._it.hasNext():
@@ -277,6 +287,8 @@ class MultipleEpochsIterator:
                 raise StopIteration
             self._epoch += 1
             self._it.reset()
+            if not self._it.hasNext():  # empty underlying: same contract
+                raise StopIteration
         return self._it.next(num) if num is not None else self._it.next()
 
     def __iter__(self):
@@ -437,6 +449,24 @@ class MiniBatchFileDataSetIterator:
         return self._preprocessor
 
 
+def _npz_member_shapes(path, *names):
+    """Shapes of arrays inside an .npz WITHOUT decompressing their data:
+    one ZipFile open, parsing just each member's .npy format header."""
+    import zipfile
+
+    shapes = {}
+    with zipfile.ZipFile(path) as zf:
+        for name in names:
+            with zf.open(name + ".npy") as fh:
+                version = np.lib.format.read_magic(fh)
+                if version == (1, 0):
+                    shape, _, _ = np.lib.format.read_array_header_1_0(fh)
+                else:
+                    shape, _, _ = np.lib.format.read_array_header_2_0(fh)
+            shapes[name] = shape
+    return shapes
+
+
 class ExistingMiniBatchDataSetIterator:
     """Streams previously saved minibatch files (reference:
     org.deeplearning4j.datasets.iterator.ExistingMiniBatchDataSetIterator)
@@ -464,15 +494,16 @@ class ExistingMiniBatchDataSetIterator:
         self._paths = [p for _, p in sorted(found)]
         self._pad_final = bool(pad_final)
         # batch size = the writer's (first file's) row count; total
-        # examples = true rows on disk — one metadata sweep, arrays
-        # discarded immediately
-        sizes = []
-        with np.load(self._paths[0]) as z0:
-            self._in_cols = int(np.prod(z0["features"].shape[1:]))
-            self._outcomes = int(z0["labels"].shape[-1])
-        for p in self._paths:
-            with np.load(p) as z:
-                sizes.append(int(z["features"].shape[0]))
+        # examples = true rows on disk — the metadata sweep reads ONLY
+        # each member's .npy header (ADVICE r4: np.load + touching the
+        # array decompressed every full features buffer, O(dataset) I/O
+        # at construction, against the streaming intent)
+        first = _npz_member_shapes(self._paths[0], "features", "labels")
+        self._in_cols = int(np.prod(first["features"][1:]))
+        self._outcomes = int(first["labels"][-1])
+        sizes = [first["features"][0]] + [
+            int(_npz_member_shapes(p, "features")["features"][0])
+            for p in self._paths[1:]]
         self._batch = sizes[0]
         self._n = sum(sizes)
         self._preprocessor = None

@@ -39,13 +39,16 @@ from deeplearning4j_tpu.nlp.word2vec import DefaultTokenizerFactory
 
 def _ngrams(word, minn, maxn):
     """Char n-grams of `<word>` between minn and maxn, fastText-style.
-    The full bracketed word itself is NOT included here (it has its own
-    vocab row)."""
+    Matches upstream Dictionary::computeSubwords: the full bracketed
+    word IS one of the n-grams whenever minn <= len('<word>') <= maxn
+    (it additionally has its own vocab row when in-vocab), so
+    OOV/subword semantics line up with upstream-trained models
+    (ADVICE r4)."""
     w = "<" + word + ">"
     out = []
     for n in range(minn, maxn + 1):
-        if n >= len(w):  # also keeps the full bracketed word out: it
-            break        # has its own vocab row, not a subword slot
+        if n > len(w):
+            break
         out.extend(w[i:i + n] for i in range(len(w) - n + 1))
     return out
 

@@ -46,6 +46,15 @@ class ComputationGraph:
 
             self._solver = _solvers.build_solver(
                 algo, getattr(conf, "maxNumLineSearchIterations", 20))
+            if getattr(conf, "gradientNormalization", None) is not None:
+                import warnings
+
+                warnings.warn(
+                    f"gradientNormalization={conf.gradientNormalization} is "
+                    f"IGNORED under optimizationAlgo={algo}: the line search "
+                    "needs the true gradient for its Wolfe/Armijo "
+                    "conditions (ADVICE r4). Use SGD-family updaters for "
+                    "gradient clipping.", stacklevel=2)
         else:
             self._solver = None
         self._jit_train = jax.jit(self._train_step,

@@ -148,6 +148,16 @@ class MultiLayerNetwork:
 
             self._solver = _solvers.build_solver(
                 algo, getattr(conf, "maxNumLineSearchIterations", 20))
+            if conf.gradientNormalization is not None:
+                import warnings
+
+                warnings.warn(
+                    f"gradientNormalization={conf.gradientNormalization} is "
+                    f"IGNORED under optimizationAlgo={algo}: the line search "
+                    "needs the true gradient of the loss for its "
+                    "Wolfe/Armijo conditions, so clipping/renorm is not "
+                    "applied (ADVICE r4). Use SGD-family updaters for "
+                    "gradient clipping.", stacklevel=2)
         else:
             self._solver = None
         self._jit_train = jax.jit(
@@ -367,6 +377,12 @@ class MultiLayerNetwork:
                 self._solver, grads, upd_states, params, loss, value_fn)
             for i, layer in enumerate(self.layers):
                 if getattr(layer, "frozen", False):
+                    # safety net, normally a no-op: frozen grads enter the
+                    # solver structurally zero (_loss_fn stop_gradient),
+                    # and zero-grad coordinates of a fresh L-BFGS/CG state
+                    # stay zero inductively (direction, s/y pairs), so the
+                    # recorded step already matches the applied step —
+                    # invariant pinned by test_solvers.py::TestFrozenUnderSolver
                     new_params[i] = params[i]
                 cs = getattr(layer, "constraints", None)
                 if cs and new_params[i]:

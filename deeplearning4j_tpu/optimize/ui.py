@@ -16,9 +16,10 @@ from __future__ import annotations
 import html
 import json
 import math
-import threading
 import time
 import urllib.parse
+
+from deeplearning4j_tpu.util.httpserve import HttpServerOwner, JsonHandler
 
 
 def _read_records(logFile):
@@ -124,7 +125,7 @@ td{{border:1px solid #ddd;padding:4px 12px}}
     return doc
 
 
-class UIServer:
+class UIServer(HttpServerOwner):
     """The reference's UIServer singleton, TPU-build edition.
 
     attach() takes a StatsListener (or a JSONL path); render() produces
@@ -151,8 +152,6 @@ class UIServer:
 
     def __init__(self):
         self._sources = []
-        self._httpd = None
-        self._thread = None
 
     def attach(self, source):
         path = getattr(source, "logFile", source)
@@ -180,35 +179,12 @@ class UIServer:
         return docs
 
     # ----- live server (reference: UIServer.getInstance() web UI) -----
-    @property
-    def port(self):
-        """Bound port once start()ed (use port=0 for an ephemeral one)."""
-        return self._httpd.server_address[1] if self._httpd else None
-
     def start(self, port=9000, refreshSec=5):
         """Serve the live dashboard on 127.0.0.1:<port>; returns self.
         Daemon-threaded, so it never keeps a training process alive."""
-        import http.server
-
-        if self._httpd is not None:
-            return self
         ui = self
 
-        class Handler(http.server.BaseHTTPRequestHandler):
-            def log_message(self, *a):  # no stderr chatter per request
-                pass
-
-            def _send(self, code, body, ctype):
-                data = body.encode() if isinstance(body, str) else body
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
-
-            def _json(self, obj, code=200):
-                self._send(code, json.dumps(obj), "application/json")
-
+        class Handler(JsonHandler):
             def do_GET(self):
                 parsed = urllib.parse.urlparse(self.path)
                 parts = [p for p in parsed.path.split("/") if p]
@@ -239,20 +215,12 @@ class UIServer:
                             1)
                         return self._send(200, doc, "text/html")
                     return self._json({"error": "unknown route"}, 404)
-                except (ValueError, OSError) as e:
+                except ValueError as e:
+                    # malformed index/since is the CLIENT's error
+                    return self._json({"error": f"{type(e).__name__}: {e}"},
+                                      400)
+                except OSError as e:  # source file unreadable: ours
                     return self._json({"error": f"{type(e).__name__}: {e}"},
                                       500)
 
-        self._httpd = http.server.ThreadingHTTPServer(("127.0.0.1", port),
-                                                      Handler)
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True)
-        self._thread.start()
-        return self
-
-    def stop(self):
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
-            self._thread = None
+        return self._serve(Handler, port)

@@ -1,0 +1,68 @@
+"""Shared scaffolding for the stdlib HTTP serving tier
+(optimize.ui.UIServer, clustering.server.NearestNeighborsServer):
+a daemon-threaded ThreadingHTTPServer owner mixin plus a JSON-speaking
+BaseHTTPRequestHandler base — one copy of the start/stop/port/body
+plumbing so fixes land in one place."""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+
+
+class JsonHandler(http.server.BaseHTTPRequestHandler):
+    """Request handler base: silenced per-request logging, JSON/body
+    writers with correct Content-Length, and strict JSON-object body
+    parsing (a list/scalar body is a client error, not a crash)."""
+
+    def log_message(self, *a):
+        pass
+
+    def _send(self, code, body, ctype):
+        data = body.encode() if isinstance(body, str) else body
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _json(self, obj, code=200):
+        self._send(code, json.dumps(obj), "application/json")
+
+    def _read_json_object(self):
+        n = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(n) or b"{}")
+        if not isinstance(body, dict):
+            raise ValueError(
+                f"JSON object body required, got {type(body).__name__}")
+        return body
+
+
+class HttpServerOwner:
+    """start/stop/port for a class that owns one loopback HTTP server."""
+
+    _httpd = None
+    _thread = None
+
+    @property
+    def port(self):
+        """Bound port once started (pass port=0 for an ephemeral one)."""
+        return self._httpd.server_address[1] if self._httpd else None
+
+    def _serve(self, handler_cls, port):
+        if self._httpd is not None:
+            return self
+        self._httpd = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", port), handler_cls)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._thread = None

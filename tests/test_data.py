@@ -1332,3 +1332,27 @@ class TestSequenceMultiReader:
             (RecordReaderMultiDataSetIterator.Builder(1)
              .addSequenceReader("s", srr)
              .addInput("s").addOutput("s", 0, 0).build())
+
+
+class TestMultipleEpochsEmptyUnderlying:
+    """ADVICE r4: hasNext()==True must guarantee next() succeeds even
+    when the underlying iterator is EMPTY and epochs remain."""
+
+    class _Empty:
+        def reset(self):
+            pass
+
+        def hasNext(self):
+            return False
+
+        def next(self, num=None):
+            raise StopIteration
+
+    def test_empty_underlying_contract(self):
+        from deeplearning4j_tpu.data.dataset import MultipleEpochsIterator
+
+        it = MultipleEpochsIterator(3, self._Empty())
+        assert not it.hasNext()
+        with pytest.raises(StopIteration):
+            it.next()
+        assert list(iter(it)) == []

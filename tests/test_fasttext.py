@@ -31,13 +31,14 @@ class TestSubwords:
         # fastText brackets the word: <where> → 3-grams of "<where>"
         assert _ngrams("where", 3, 3) == [
             "<wh", "whe", "her", "ere", "re>"]
-        # n == len("<as>") stops the loop, so the full bracketed word
-        # never appears as its own subword
+        # upstream computeSubwords parity: the full bracketed word is a
+        # subword whenever its length is within [minn, maxn] (ADVICE r4)
         got = _ngrams("as", 3, 6)
-        assert got == ["<as", "as>"]
+        assert got == ["<as", "as>", "<as>"]
 
-    def test_full_bracketed_word_excluded(self):
-        for n in (3, 4, 5, 6):
+    def test_full_bracketed_word_in_range_only(self):
+        assert "<cat>" in _ngrams("cat", 5, 5)  # len("<cat>") == 5
+        for n in (3, 4, 6):
             assert "<cat>" not in _ngrams("cat", n, n)
 
     def test_fnv1a_reference_values(self):

@@ -85,6 +85,17 @@ class TestUIServerLive:
         finally:
             ui.stop()
 
+    def test_client_errors_are_4xx(self, stats_log):
+        """Malformed paths/params are the CLIENT's fault: 400, not 500."""
+        ui = UIServer().attach(str(stats_log)).start(port=0)
+        try:
+            for bad in ("/train/abc", "/train/0/updates?since=abc"):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    _get(f"http://127.0.0.1:{ui.port}{bad}")
+                assert ei.value.code == 400, bad
+        finally:
+            ui.stop()
+
     def test_updates_short_form(self, stats_log):
         """Docs advertise /train/updates as shorthand for source 0."""
         ui = UIServer().attach(str(stats_log)).start(port=0)
@@ -147,6 +158,11 @@ class TestNearestNeighborsServer:
             with pytest.raises(urllib.error.HTTPError) as ei:
                 _post(base + "/knn", {"k": 3})  # missing index
             assert ei.value.code == 400
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(base + "/knnnew", [1, 2, 3])  # non-object body
+            assert ei.value.code == 400
+            assert "object" in \
+                json.loads(ei.value.read().decode())["error"]
         finally:
             srv.stop()
 

@@ -231,3 +231,64 @@ class TestSolversOnGraphAndGuards:
         X, Y = _lsq_data()
         net.fit(X, Y)
         assert np.isfinite(net.score())
+
+
+class TestFrozenUnderSolver:
+    """ADVICE r4: under a whole-pytree solver, the step RECORDED in the
+    solver's memory (curvature pairs / CG direction) must match the step
+    actually APPLIED when layers are frozen. Frozen grads enter the
+    solver structurally zero (stop_gradient in _loss_fn), and zero-grad
+    coordinates of a fresh solver state stay zero inductively — so the
+    solver's own output must never move frozen params and the
+    post-update reset in _train_step stays a no-op."""
+
+    @pytest.mark.parametrize("algo", [OptimizationAlgorithm.LBFGS,
+                                      OptimizationAlgorithm.CONJUGATE_GRADIENT])
+    def test_solver_output_never_moves_frozen_params(self, algo,
+                                                     monkeypatch):
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn import solvers as S
+
+        X, Y = _lsq_data()
+        net = _regression_net(algo)
+        net.layers[0].frozen = True
+        captured = []
+        orig = S.solver_update
+
+        def spy(solver, grads, upd, params, loss, value_fn):
+            new_params, new_upd = orig(solver, grads, upd, params, loss,
+                                       value_fn)
+            captured.append((params, new_params))
+            return new_params, new_upd
+
+        monkeypatch.setattr(S, "solver_update", spy)
+        # eager (unjitted) steps so the captured pytrees are concrete
+        p, u, s = net._params, net._upd_states, net._states
+        key = jax.random.key(0)
+        for it in range(3):
+            p, u, s, loss = net._train_step(
+                p, u, s, jnp.asarray(it, jnp.int32),
+                jnp.asarray(X), jnp.asarray(Y), key, None, None)
+        assert len(captured) == 3
+        for params, new_params in captured:
+            for k in params[0]:
+                np.testing.assert_array_equal(
+                    np.asarray(new_params[0][k]), np.asarray(params[0][k]))
+        assert np.isfinite(float(loss))
+
+    def test_gradient_normalization_warns_under_solver(self):
+        from deeplearning4j_tpu.nn import GradientNormalization
+
+        conf = (NeuralNetConfiguration.Builder().seed(1)
+                .optimizationAlgo(OptimizationAlgorithm.LBFGS)
+                .gradientNormalization(
+                    GradientNormalization.ClipL2PerLayer)
+                .gradientNormalizationThreshold(1.0)
+                .list()
+                .layer(DenseLayer(nIn=5, nOut=2, activation="identity"))
+                .layer(OutputLayer(nOut=2, activation="identity",
+                                   lossFunction=LF.MSE))
+                .build())
+        with pytest.warns(UserWarning, match="IGNORED"):
+            MultiLayerNetwork(conf)
