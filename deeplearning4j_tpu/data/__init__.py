@@ -26,7 +26,10 @@ from deeplearning4j_tpu.data.transform import (
     Join, executeJoin, Reducer, ReduceOp, ConditionFilter, ConditionOp,
     ColumnCondition, DoubleColumnCondition, IntegerColumnCondition,
     CategoricalColumnCondition, StringColumnCondition, DataAnalysis,
-    analyze,
+    analyze, DataQualityAnalysis, analyzeQuality,
+)
+from deeplearning4j_tpu.data.columnar import (
+    ColumnarRecordReader, writeColumnar,
 )
 from deeplearning4j_tpu.data.augment import (
     ImageTransform, FlipImageTransform, RandomCropTransform,
@@ -61,7 +64,9 @@ __all__ = [
     "Reducer", "ReduceOp", "ConditionFilter", "ConditionOp",
     "ColumnCondition", "DoubleColumnCondition", "IntegerColumnCondition",
     "CategoricalColumnCondition", "StringColumnCondition",
-    "DataAnalysis", "analyze", "ImageTransform", "FlipImageTransform",
+    "DataAnalysis", "analyze", "DataQualityAnalysis", "analyzeQuality",
+    "ColumnarRecordReader", "writeColumnar",
+    "ImageTransform", "FlipImageTransform",
     "RandomCropTransform", "ResizeImageTransform",
     "RotateImageTransform", "PipelineImageTransform",
     "ImageAugmentationPreProcessor", "SpectrogramTransform",

@@ -486,6 +486,34 @@ class TransformProcess:
             self._steps.append(step)
             return self
 
+        def coordinatesDistanceTransform(self, newColumnName, firstColumn,
+                                         secondColumn, delimiter=","):
+            """Reference: org.datavec.api.transform.geo
+            .CoordinatesDistanceTransform — euclidean distance between
+            two delimited-coordinate string columns ("x,y[,z...]"),
+            appended as a new double column. Dimensions must agree
+            per-row; either side missing/blank yields None."""
+            def step(schema, recs):
+                i = schema.getIndexOfColumn(firstColumn)
+                j = schema.getIndexOfColumn(secondColumn)
+                for r in recs:
+                    a, b = r[i], r[j]
+                    if a in (None, "") or b in (None, ""):
+                        r.append(None)
+                        continue
+                    va = [float(t) for t in str(a).split(delimiter)]
+                    vb = [float(t) for t in str(b).split(delimiter)]
+                    if len(va) != len(vb):
+                        raise ValueError(
+                            f"coordinatesDistanceTransform: {a!r} has "
+                            f"{len(va)} dims, {b!r} has {len(vb)}")
+                    r.append(sum((x - y) ** 2
+                                 for x, y in zip(va, vb)) ** 0.5)
+                return Schema(schema._cols
+                              + [(newColumnName, "double", None)]), recs
+            self._steps.append(step)
+            return self
+
         def build(self):
             # the SAME list objects, not copies: _steps is already
             # shared, so _spec/_unserializable must stay in lockstep —

@@ -383,3 +383,168 @@ def analyze(schema: Schema, records) -> DataAnalysis:
         else:
             analyses[name] = CategoricalColumnAnalysis(vals)
     return DataAnalysis(schema, analyses)
+
+
+# --------------------------------------------------------------------
+# data quality (reference: datavec-api transform.analysis.quality —
+# AnalyzeLocal.analyzeQuality -> DataQualityAnalysis of per-column
+# ColumnQuality counts)
+# --------------------------------------------------------------------
+
+class ColumnQuality:
+    def __init__(self):
+        self.countValid = 0
+        self.countInvalid = 0
+        self.countMissing = 0
+        self.countTotal = 0
+
+    def __repr__(self):
+        extra = "".join(f" {k}={v}" for k, v in vars(self).items()
+                        if k.startswith("count")
+                        and k not in ("countValid", "countInvalid",
+                                      "countMissing", "countTotal") and v)
+        return (f"{type(self).__name__}(valid={self.countValid} "
+                f"invalid={self.countInvalid} missing={self.countMissing} "
+                f"total={self.countTotal}{extra})")
+
+
+class DoubleColumnQuality(ColumnQuality):
+    def __init__(self):
+        super().__init__()
+        self.countNaN = 0
+        self.countInfinite = 0
+
+
+class IntegerColumnQuality(ColumnQuality):
+    pass
+
+
+class CategoricalColumnQuality(ColumnQuality):
+    pass
+
+
+class StringColumnQuality(ColumnQuality):
+    def __init__(self):
+        super().__init__()
+        self.countEmptyString = 0
+
+
+class DataQualityAnalysis:
+    """Reference: transform.analysis.quality.DataQualityAnalysis —
+    per-column validity audit, printable as a table."""
+
+    def __init__(self, schema: Schema, qualities: dict):
+        self.schema = schema
+        self._q = qualities
+
+    def getColumnQuality(self, name) -> ColumnQuality:
+        if name not in self._q:
+            raise ValueError(f"no quality record for column '{name}' "
+                             f"(have {sorted(self._q)})")
+        return self._q[name]
+
+    def isClean(self) -> bool:
+        return all(q.countInvalid == 0 and q.countMissing == 0
+                   for q in self._q.values())
+
+    def __repr__(self):
+        rows = [f"  {n!r} ({self.schema.getType(n)}): {self._q[n]!r}"
+                for n in self.schema.getColumnNames()]
+        return "DataQualityAnalysis[\n" + "\n".join(rows) + "\n]"
+
+
+def _quality_double(vals):
+    q = DoubleColumnQuality()
+    for v in vals:
+        if v is None:
+            q.countMissing += 1
+            continue
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            f = float(v)
+        else:
+            try:  # CSV-sourced records are strings: parse THEN classify,
+                f = float(str(v))  # so 'nan'/'1e999' can't count valid
+            except ValueError:
+                q.countInvalid += 1
+                continue
+        if math.isnan(f):
+            q.countNaN += 1
+            q.countInvalid += 1
+        elif math.isinf(f):
+            q.countInfinite += 1
+            q.countInvalid += 1
+        else:
+            q.countValid += 1
+    return q
+
+
+def _quality_integer(vals):
+    q = IntegerColumnQuality()
+    for v in vals:
+        if v is None:
+            q.countMissing += 1
+        elif isinstance(v, bool):
+            q.countInvalid += 1
+        elif isinstance(v, int):
+            q.countValid += 1
+        elif isinstance(v, float):
+            # non-finite floats cannot be int(v)'d — they are invalid,
+            # not a crash (a quality audit must tolerate dirty data)
+            if math.isfinite(v) and v == int(v):
+                q.countValid += 1  # integral float parses upstream
+            else:
+                q.countInvalid += 1
+        else:
+            try:
+                int(str(v))
+                q.countValid += 1
+            except ValueError:
+                q.countInvalid += 1
+    return q
+
+
+def _quality_categorical(vals, states):
+    q = CategoricalColumnQuality()
+    for v in vals:
+        if v is None:
+            q.countMissing += 1
+        elif states is not None and v in states:
+            q.countValid += 1
+        else:
+            q.countInvalid += 1
+    return q
+
+
+def _quality_string(vals):
+    q = StringColumnQuality()
+    for v in vals:
+        if v is None:
+            q.countMissing += 1
+        elif isinstance(v, str):
+            q.countValid += 1
+            if v == "":
+                q.countEmptyString += 1
+        else:
+            q.countInvalid += 1
+    return q
+
+
+def analyzeQuality(schema: Schema, records) -> DataQualityAnalysis:
+    """Reference: AnalyzeLocal.analyzeQuality(schema, recordReader).
+    Every count* field sums to countTotal per column; `isClean()` is the
+    gate a pipeline checks before training."""
+    qualities = {}
+    for i, name in enumerate(schema.getColumnNames()):
+        vals = [r[i] for r in records]
+        typ = schema.getType(name)
+        if typ == "double":
+            q = _quality_double(vals)
+        elif typ == "integer":
+            q = _quality_integer(vals)
+        elif typ == "categorical":
+            q = _quality_categorical(vals, schema.getMeta(name))
+        else:
+            q = _quality_string(vals)
+        q.countTotal = len(vals)
+        qualities[name] = q
+    return DataQualityAnalysis(schema, qualities)

@@ -15,9 +15,11 @@ from deeplearning4j_tpu.rl.a3c import (ACPolicy, A3CConfiguration,
 from deeplearning4j_tpu.rl.async_nstep import (
     AsyncNStepQLConfiguration, AsyncNStepQLearningDiscreteDense,
 )
+from deeplearning4j_tpu.rl.gym import GymEnv
 
 __all__ = ["MDP", "DQNPolicy", "HistoryDQNPolicy", "ACPolicy",
            "QLearningConfiguration", "QLearningDiscreteDense",
            "HistoryProcessorConfiguration", "QLearningDiscreteConv",
            "A3CConfiguration", "A3CDiscreteDense",
-           "AsyncNStepQLConfiguration", "AsyncNStepQLearningDiscreteDense"]
+           "AsyncNStepQLConfiguration", "AsyncNStepQLearningDiscreteDense",
+           "GymEnv"]

@@ -1,0 +1,192 @@
+"""Columnar storage + data quality + geo transform.
+
+Reference strategy: datavec-arrow's RecordReaderTests (write/read
+round-trips through the record abstraction), datavec-api
+TestDataQualityAnalysis, and TestGeoTransforms — with pandas as the
+independent numeric oracle.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from deeplearning4j_tpu.data import (ColumnarRecordReader,
+                                     RecordReaderDataSetIterator, Schema,
+                                     TransformProcess, analyzeQuality,
+                                     writeColumnar)
+
+
+def _schema():
+    return (Schema.Builder()
+            .addColumnDouble("x")
+            .addColumnInteger("n")
+            .addColumnCategorical("cat", "a", "b", "c")
+            .addColumnString("s")
+            .build())
+
+
+def _records():
+    return [
+        [1.5, 7, "a", "hello"],
+        [-2.25, 0, "b", ""],
+        [None, 3, "c", "wörld"],   # missing double, non-ascii string
+        [3.75, None, "a", None],   # missing int + string
+    ]
+
+
+class TestColumnarRoundTrip:
+    def test_records_roundtrip_exact(self, tmp_path):
+        p = tmp_path / "data.ndc"
+        writeColumnar(p, _schema(), _records())
+        rr = ColumnarRecordReader().initialize(p)
+        got = list(rr)
+        assert got == _records()
+        # reader is self-described: schema reconstructed from the file
+        s = rr.getSchema()
+        assert s.getColumnNames() == ["x", "n", "cat", "s"]
+        assert s.getType("cat") == "categorical"
+        assert s.getMeta("cat") == ["a", "b", "c"]
+
+    def test_columns_fast_path_pandas_oracle(self, tmp_path):
+        rng = np.random.RandomState(0)
+        n = 200
+        df = pd.DataFrame({"x": rng.randn(n),
+                           "n": rng.randint(-50, 50, n)})
+        recs = [[float(df.x[i]), int(df.n[i])] for i in range(n)]
+        schema = (Schema.Builder().addColumnDouble("x")
+                  .addColumnInteger("n").build())
+        p = tmp_path / "num.ndc"
+        writeColumnar(p, schema, recs)
+        cols = ColumnarRecordReader().initialize(p).asColumns()
+        np.testing.assert_array_equal(cols["x"], df.x.to_numpy())
+        np.testing.assert_array_equal(cols["n"], df.n.to_numpy())
+        assert cols["x"].dtype == np.float64
+        assert cols["n"].dtype == np.int64
+
+    def test_missing_double_reads_nan_in_column_view(self, tmp_path):
+        p = tmp_path / "m.ndc"
+        writeColumnar(p, _schema(), _records())
+        cols = ColumnarRecordReader().initialize(p).asColumns()
+        assert np.isnan(cols["x"][2])
+        # integer column with missing rows promotes to float64 + NaN
+        # (a missing row must never masquerade as 0)
+        assert cols["n"].dtype == np.float64
+        assert np.isnan(cols["n"][3]) and cols["n"][1] == 0.0
+        assert cols["s"] == ["hello", "", "wörld", ""]  # None reads ""
+
+    def test_nonintegral_value_in_integer_column_raises(self, tmp_path):
+        schema = Schema.Builder().addColumnInteger("n").build()
+        with pytest.raises(ValueError, match="non-integral"):
+            writeColumnar(tmp_path / "x.ndc", schema, [[1.7]])
+
+    def test_bad_magic_raises(self, tmp_path):
+        p = tmp_path / "junk.ndc"
+        p.write_bytes(b"NOPE" + b"\x00" * 16)
+        with pytest.raises(ValueError, match="NDC1"):
+            ColumnarRecordReader().initialize(p)
+
+    def test_wired_into_dataset_iterator(self, tmp_path):
+        """The reader is a drop-in RecordReader: columnar file ->
+        RecordReaderDataSetIterator -> DataSet batches (the ArrowRecordReader
+        use upstream)."""
+        rng = np.random.RandomState(1)
+        n = 40
+        recs = [[float(rng.randn()), float(rng.randn()),
+                 int(rng.randint(0, 3))] for _ in range(n)]
+        schema = (Schema.Builder().addColumnsDouble("f0", "f1")
+                  .addColumnInteger("label").build())
+        p = tmp_path / "train.ndc"
+        writeColumnar(p, schema, recs)
+        rr = ColumnarRecordReader().initialize(p)
+        it = RecordReaderDataSetIterator(rr, batchSize=10, labelIndex=2,
+                                         numPossibleLabels=3)
+        ds = it.next()
+        assert ds.getFeatures().shape() == (10, 2)
+        assert ds.getLabels().shape() == (10, 3)
+        total = 1
+        while it.hasNext():
+            it.next()
+            total += 1
+        assert total == 4
+
+
+class TestDataQuality:
+    def test_counts_per_type(self):
+        schema = _schema()
+        recs = [
+            [1.0, 1, "a", "ok"],
+            [float("nan"), 2.0, "b", ""],
+            [float("inf"), "x", "zzz", 7],
+            [None, None, None, None],
+        ]
+        dqa = analyzeQuality(schema, recs)
+        x = dqa.getColumnQuality("x")
+        assert (x.countValid, x.countInvalid, x.countMissing,
+                x.countTotal) == (1, 2, 1, 4)
+        assert x.countNaN == 1 and x.countInfinite == 1
+        n = dqa.getColumnQuality("n")
+        assert (n.countValid, n.countInvalid, n.countMissing) == (2, 1, 1)
+        cat = dqa.getColumnQuality("cat")
+        assert (cat.countValid, cat.countInvalid, cat.countMissing) \
+            == (2, 1, 1)
+        s = dqa.getColumnQuality("s")
+        assert (s.countValid, s.countInvalid, s.countMissing) == (2, 1, 1)
+        assert s.countEmptyString == 1
+        assert not dqa.isClean()
+        assert "DataQualityAnalysis" in repr(dqa)
+
+    def test_clean_data_is_clean(self):
+        schema = (Schema.Builder().addColumnDouble("x").build())
+        assert analyzeQuality(schema, [[0.5], [1.0]]).isClean()
+
+    def test_string_sourced_nan_inf_not_valid(self):
+        """CSV records arrive as STRINGS: 'nan'/'1e999' must classify
+        as NaN/infinite (invalid), never slip through isClean()."""
+        schema = (Schema.Builder().addColumnDouble("x").build())
+        dqa = analyzeQuality(schema, [["nan"], ["1e999"], ["2.5"]])
+        x = dqa.getColumnQuality("x")
+        assert (x.countValid, x.countInvalid) == (1, 2)
+        assert x.countNaN == 1 and x.countInfinite == 1
+        assert not dqa.isClean()
+
+    def test_nonfinite_in_integer_column_is_invalid_not_crash(self):
+        schema = (Schema.Builder().addColumnInteger("n").build())
+        dqa = analyzeQuality(
+            schema, [[float("nan")], [float("inf")], [3], [2.0]])
+        n = dqa.getColumnQuality("n")
+        assert (n.countValid, n.countInvalid) == (2, 2)
+
+
+class TestCoordinatesDistance:
+    def test_euclidean_distance_and_serde(self):
+        schema = (Schema.Builder().addColumnString("p1")
+                  .addColumnString("p2").build())
+        tp = (TransformProcess.Builder(schema)
+              .coordinatesDistanceTransform("dist", "p1", "p2")
+              .build())
+        out = tp.execute([["0,0", "3,4"], ["1,1,1", "1,1,1"],
+                          [None, "5,5"]])
+        assert out[0][2] == pytest.approx(5.0)
+        assert out[1][2] == pytest.approx(0.0)
+        assert out[2][2] is None
+        assert tp.getFinalSchema().getType("dist") == "double"
+        # serde: geo transforms persist like every other declarative step
+        tp2 = TransformProcess.fromJson(tp.toJson())
+        out2 = tp2.execute([["0,0", "3,4"]])
+        assert out2[0][2] == pytest.approx(5.0)
+
+    def test_dimension_mismatch_raises(self):
+        schema = (Schema.Builder().addColumnString("p1")
+                  .addColumnString("p2").build())
+        tp = (TransformProcess.Builder(schema)
+              .coordinatesDistanceTransform("d", "p1", "p2").build())
+        with pytest.raises(ValueError, match="dims"):
+            tp.execute([["0,0", "1,2,3"]])
+
+    def test_custom_delimiter(self):
+        schema = (Schema.Builder().addColumnString("p1")
+                  .addColumnString("p2").build())
+        tp = (TransformProcess.Builder(schema)
+              .coordinatesDistanceTransform("d", "p1", "p2",
+                                            delimiter=":").build())
+        assert tp.execute([["0:0", "0:2"]])[0][2] == pytest.approx(2.0)
