@@ -188,6 +188,29 @@ def _measure_resnet50(stem, remat=False):
     cost = {"flops": float((ca or {}).get("flops", 0.0)),
             "bytes_accessed": float((ca or {}).get("bytes accessed", 0.0))}
 
+    ledger_rec = None
+    if stem == "standard" and not remat:
+        # per-op HBM table + analytic roofline floor (VERDICT r4 #2):
+        # pure host-side HLO text parsing + abstract shape eval, cheap
+        try:
+            from deeplearning4j_tpu.util import hbm_ledger
+            led = hbm_ledger.ledger_for_compiled(compiled, top=10)
+            fl = hbm_ledger.train_step_floor(net, (B, 224, 224, 3),
+                                             optimizer_slots=1)
+            ledger_rec = {
+                "ledger_total_bytes": led["total_bytes"],
+                "by_opcode": {k: v for k, v in
+                              list(led["by_opcode"].items())[:8]},
+                "top": [{k: r[k] for k in ("op", "bytes")}
+                        for r in led["top"]],
+                "floor_bytes": fl["floor_bytes"],
+                "floor_terms": fl["terms"],
+                "measured_over_floor": round(
+                    cost["bytes_accessed"] / max(fl["floor_bytes"], 1), 3),
+            }
+        except Exception as e:
+            ledger_rec = {"error": f"{type(e).__name__}: {e}"[:200]}
+
     p, u, s = net._params, net._upd_states, net._states
     for it in range(2):  # warmup (executions of the compiled step)
         p, u, s, loss = compiled(p, u, s, jnp.asarray(it, jnp.int32),
@@ -203,7 +226,7 @@ def _measure_resnet50(stem, remat=False):
     dt = (time.perf_counter() - t0) / iters
     assert np.isfinite(final_loss)
 
-    return {
+    rec = {
         "images_per_sec": round(B / dt, 1),
         "step_ms": round(dt * 1e3, 2),
         "batch": B,
@@ -213,6 +236,9 @@ def _measure_resnet50(stem, remat=False):
         "mfu": round(profiler.mfu(cost["flops"], dt), 3),
         "limiter": "hbm_bandwidth (analysis: BENCH_NOTES.md)",
     }
+    if ledger_rec is not None:
+        rec["hbm_ledger"] = ledger_rec
+    return rec
 
 
 def bench_lenet():

@@ -51,14 +51,18 @@ def writeColumnar(path, schema: Schema, records):
         if typ in ("double", "integer"):
             if typ == "integer":
                 for v in col:  # 1.7 in an int column must not silently
-                    if v is not None and float(v) != int(v):  # truncate
+                    # truncate; true ints skip the float round-trip
+                    # (float() loses precision above 2**53)
+                    if v is None or (isinstance(v, int)
+                                     and not isinstance(v, bool)):
+                        continue
+                    if float(v) != int(v):
                         raise ValueError(
                             f"column {name!r} is integer but got "
                             f"non-integral value {v!r}")
-            dtype = np.float64 if typ == "double" else np.int64
-            vals = np.array([0 if v is None else v for v in col], dtype)
-            blocks.append(vals.astype("<f8" if typ == "double" else "<i8")
-                          .tobytes())
+            vals = np.array([0 if v is None else v for v in col],
+                            "<f8" if typ == "double" else "<i8")
+            blocks.append(vals.tobytes())
         else:  # categorical / string: one encode pass builds blob+offsets
             chunks = [("" if v is None else str(v)).encode("utf-8")
                       for v in col]

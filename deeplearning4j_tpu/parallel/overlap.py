@@ -36,10 +36,17 @@ import numpy as np
 
 
 _DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s32": 4,
-                "u32": 4, "s8": 1, "u8": 1, "pred": 1, "s64": 8, "u64": 8}
+                "u32": 4, "s8": 1, "u8": 1, "pred": 1, "s64": 8, "u64": 8,
+                "s16": 2, "u16": 2, "s4": 1, "u4": 1,
+                "f8e4m3": 1, "f8e5m2": 1, "f8e4m3fn": 1, "f8e5m2fnuz": 1,
+                "f8e4m3fnuz": 1, "f8e4m3b11fnuz": 1, "f8e3m4": 1,
+                "f4e2m1fn": 1, "e8m0fnu": 1,
+                "c64": 8, "c128": 16}
 
-_SHAPE_RE = re.compile(r"(f64|f32|bf16|f16|s64|u64|s32|u32|s8|u8|pred)"
-                       r"\[([0-9,]*)\]")
+# longest-first alternation so f8e4m3fn doesn't half-match as f8e4m3
+_SHAPE_RE = re.compile(
+    "(" + "|".join(sorted(_DTYPE_BYTES, key=len, reverse=True))
+    + r")\[([0-9,]*)\]")
 _OP_RE = re.compile(r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*[^=]*?\s([a-z][\w\-]*)\(")
 
 

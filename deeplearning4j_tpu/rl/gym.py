@@ -72,9 +72,19 @@ class GymEnv(MDP):
     def reset(self):
         if self._seed_pending:
             self._seed_pending = False  # gym seeds once, on first reset
+            # API detection by SIGNATURE, not try/except: a TypeError
+            # raised inside a gymnasium env's own reset must propagate,
+            # not silently re-run reset unseeded
+            import inspect
+
             try:
+                takes_seed = "seed" in inspect.signature(
+                    self._env.reset).parameters
+            except (TypeError, ValueError):  # C-impl/exotic callables
+                takes_seed = False
+            if takes_seed:
                 out = self._env.reset(seed=self._seed)
-            except TypeError:
+            else:
                 # classic API seeds via env.seed(s), not reset(seed=)
                 seed_fn = getattr(self._env, "seed", None)
                 if callable(seed_fn):

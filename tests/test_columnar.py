@@ -79,6 +79,15 @@ class TestColumnarRoundTrip:
         with pytest.raises(ValueError, match="non-integral"):
             writeColumnar(tmp_path / "x.ndc", schema, [[1.7]])
 
+    def test_big_int64_exact_roundtrip(self, tmp_path):
+        """ints above 2**53 are exact in int64 — the integral check
+        must not round-trip them through float."""
+        schema = Schema.Builder().addColumnInteger("n").build()
+        big = 2 ** 53 + 1
+        p = tmp_path / "big.ndc"
+        writeColumnar(p, schema, [[big], [-big]])
+        assert list(ColumnarRecordReader().initialize(p)) == [[big], [-big]]
+
     def test_bad_magic_raises(self, tmp_path):
         p = tmp_path / "junk.ndc"
         p.write_bytes(b"NOPE" + b"\x00" * 16)
