@@ -53,7 +53,7 @@ def writeColumnar(path, schema: Schema, records):
                 for v in col:  # 1.7 in an int column must not silently
                     # truncate; true ints skip the float round-trip
                     # (float() loses precision above 2**53)
-                    if v is None or (isinstance(v, int)
+                    if v is None or (isinstance(v, (int, np.integer))
                                      and not isinstance(v, bool)):
                         continue
                     if float(v) != int(v):

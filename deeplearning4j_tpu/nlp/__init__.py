@@ -15,6 +15,8 @@ from deeplearning4j_tpu.nlp.vectorizers import (
 from deeplearning4j_tpu.nlp.tokenization import (
     TokenPreProcess, LowCasePreProcessor, CommonPreprocessor,
     EndingPreProcessor, NGramTokenizerFactory,
+    ChineseTokenizerFactory, JapaneseTokenizerFactory,
+    KoreanTokenizerFactory,
 )
 from deeplearning4j_tpu.nlp.cnn_sentence import (
     CnnSentenceDataSetIterator, CollectionLabeledSentenceProvider,
@@ -31,6 +33,8 @@ __all__ = ["Word2Vec", "ParagraphVectors", "DefaultTokenizerFactory",
            "LabelAwareCollectionIterator",
            "TokenPreProcess", "LowCasePreProcessor", "CommonPreprocessor",
            "EndingPreProcessor", "NGramTokenizerFactory",
+           "ChineseTokenizerFactory", "JapaneseTokenizerFactory",
+           "KoreanTokenizerFactory",
            "CnnSentenceDataSetIterator",
            "CollectionLabeledSentenceProvider", "UnknownWordHandling",
            "WordVectorSerializer", "StaticWordVectors", "FastText"]

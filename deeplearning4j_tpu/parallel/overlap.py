@@ -35,17 +35,21 @@ import re
 import numpy as np
 
 
-_DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s32": 4,
-                "u32": 4, "s8": 1, "u8": 1, "pred": 1, "s64": 8, "u64": 8,
-                "s16": 2, "u16": 2, "s4": 1, "u4": 1,
-                "f8e4m3": 1, "f8e5m2": 1, "f8e4m3fn": 1, "f8e5m2fnuz": 1,
-                "f8e4m3fnuz": 1, "f8e4m3b11fnuz": 1, "f8e3m4": 1,
-                "f4e2m1fn": 1, "e8m0fnu": 1,
-                "c64": 8, "c128": 16}
+# element widths in BITS: s4/u4/f4 pack two per byte in XLA buffers
+# (ShapeUtil::ByteSizeOf), so pricing them at a whole byte would double-
+# count exactly the quantized buffers a traffic table should rank
+_DTYPE_BITS = {"f64": 64, "f32": 32, "bf16": 16, "f16": 16, "s32": 32,
+               "u32": 32, "s8": 8, "u8": 8, "pred": 8, "s64": 64,
+               "u64": 64, "s16": 16, "u16": 16, "s4": 4, "u4": 4,
+               "f8e4m3": 8, "f8e5m2": 8, "f8e4m3fn": 8, "f8e5m2fnuz": 8,
+               "f8e4m3fnuz": 8, "f8e4m3b11fnuz": 8, "f8e3m4": 8,
+               "f4e2m1fn": 4, "e8m0fnu": 8,
+               "c64": 64, "c128": 128}
+_DTYPE_BYTES = {k: max(v // 8, 1) for k, v in _DTYPE_BITS.items()}
 
 # longest-first alternation so f8e4m3fn doesn't half-match as f8e4m3
 _SHAPE_RE = re.compile(
-    "(" + "|".join(sorted(_DTYPE_BYTES, key=len, reverse=True))
+    "(" + "|".join(sorted(_DTYPE_BITS, key=len, reverse=True))
     + r")\[([0-9,]*)\]")
 _OP_RE = re.compile(r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*[^=]*?\s([a-z][\w\-]*)\(")
 
@@ -57,7 +61,7 @@ def _shape_bytes(text):
         for d in dims.split(","):
             if d:
                 n *= int(d)
-        total += n * _DTYPE_BYTES[dt]
+        total += (n * _DTYPE_BITS[dt] + 7) // 8
     return total
 
 

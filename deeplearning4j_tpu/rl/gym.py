@@ -78,8 +78,12 @@ class GymEnv(MDP):
             import inspect
 
             try:
-                takes_seed = "seed" in inspect.signature(
-                    self._env.reset).parameters
+                params = inspect.signature(self._env.reset).parameters
+                # a **kwargs reset (gym wrappers like TimeLimit) forwards
+                # seed= to the inner env — treat it as seed-accepting
+                takes_seed = "seed" in params or any(
+                    p.kind is inspect.Parameter.VAR_KEYWORD
+                    for p in params.values())
             except (TypeError, ValueError):  # C-impl/exotic callables
                 takes_seed = False
             if takes_seed:

@@ -37,7 +37,7 @@ import re
 
 import numpy as np
 
-from deeplearning4j_tpu.parallel.overlap import _DTYPE_BYTES, _SHAPE_RE
+from deeplearning4j_tpu.parallel.overlap import _DTYPE_BITS, _SHAPE_RE
 
 # '%name = <result types> opcode(...operands...)'
 _DEF_RE = re.compile(
@@ -57,17 +57,17 @@ def _result_bytes(result_text):
     # an unrecognized dtype must FAIL, not silently rank as 0 bytes —
     # the whole point is an accurate table on the TPU backend
     for tok in _ANY_SHAPE_RE.findall(result_text):
-        if tok not in _DTYPE_BYTES and tok != "token":
+        if tok not in _DTYPE_BITS and tok != "token":
             raise ValueError(
                 f"unknown HLO dtype {tok!r} in {result_text[:80]!r} — "
-                "add it to parallel/overlap.py _DTYPE_BYTES")
+                "add it to parallel/overlap.py _DTYPE_BITS")
     total = 0
     for dt, dims in _SHAPE_RE.findall(result_text):
         n = 1
         for d in dims.split(","):
             if d:
                 n *= int(d)
-        total += n * _DTYPE_BYTES[dt]
+        total += (n * _DTYPE_BITS[dt] + 7) // 8
     return total
 
 

@@ -81,12 +81,16 @@ class TestColumnarRoundTrip:
 
     def test_big_int64_exact_roundtrip(self, tmp_path):
         """ints above 2**53 are exact in int64 — the integral check
-        must not round-trip them through float."""
+        must not round-trip them through float; numpy integer scalars
+        get the same exemption."""
         schema = Schema.Builder().addColumnInteger("n").build()
         big = 2 ** 53 + 1
         p = tmp_path / "big.ndc"
         writeColumnar(p, schema, [[big], [-big]])
         assert list(ColumnarRecordReader().initialize(p)) == [[big], [-big]]
+        p2 = tmp_path / "bignp.ndc"
+        writeColumnar(p2, schema, [[np.int64(big)]])
+        assert list(ColumnarRecordReader().initialize(p2)) == [[big]]
 
     def test_bad_magic_raises(self, tmp_path):
         p = tmp_path / "junk.ndc"

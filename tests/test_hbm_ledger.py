@@ -49,6 +49,12 @@ class TestLedger:
         with pytest.raises(ValueError, match="unknown HLO dtype"):
             ledger("ENTRY e {\n  %a = q77[8]{0} iota()\n}")
 
+    def test_subbyte_dtypes_priced_packed(self):
+        # s4 packs two per byte (ShapeUtil::ByteSizeOf): 1001 elems ->
+        # ceil(1001/2) = 501 bytes, not 1001
+        led = ledger("ENTRY e {\n  %a = s4[1001]{0} iota()\n}")
+        assert led["by_opcode"]["iota"] == 501
+
     def test_lenet_step_matches_xla_cost_analysis(self):
         from deeplearning4j_tpu.ndarray import DataType
         from deeplearning4j_tpu.zoo import LeNet

@@ -1,0 +1,92 @@
+# -*- coding: utf-8 -*-
+"""CJK tokenizer factories (reference: the deeplearning4j-nlp-chinese/
+-japanese/-korean satellites). Segmentation behavior is pinned against
+hand-segmented strings; the Word2Vec integration check proves the
+factories plug into the same tokenizerFactory(...) hook the English
+pipeline uses."""
+
+import pytest
+
+from deeplearning4j_tpu.nlp import (ChineseTokenizerFactory,
+                                    CollectionSentenceIterator,
+                                    JapaneseTokenizerFactory,
+                                    KoreanTokenizerFactory,
+                                    LowCasePreProcessor, Word2Vec)
+
+
+class TestChinese:
+    def test_character_fallback_without_dictionary(self):
+        tf = ChineseTokenizerFactory()
+        assert tf.create("我爱北京") == ["我", "爱", "北", "京"]
+
+    def test_dictionary_forward_maximum_matching(self):
+        tf = ChineseTokenizerFactory(dictionary=["北京", "天安门", "我们"])
+        # greedy longest match: 北京 + 天安门 segment as words, 爱 falls
+        # back to a single character
+        assert tf.create("我们爱北京天安门") == ["我们", "爱", "北京", "天安门"]
+
+    def test_mixed_script_passthrough(self):
+        tf = ChineseTokenizerFactory(dictionary=["模型"])
+        assert tf.create("TPU模型v5e") == ["TPU", "模型", "v5e"]
+
+    def test_preprocessor_applies(self):
+        tf = ChineseTokenizerFactory()
+        tf.setTokenPreProcessor(LowCasePreProcessor())
+        assert tf.create("GPU和TPU") == ["gpu", "和", "tpu"]
+
+
+class TestJapanese:
+    def test_script_boundary_segmentation(self):
+        tf = JapaneseTokenizerFactory()
+        # kanji / hiragana / katakana transitions delimit tokens
+        assert tf.create("私はコーヒーが好きです") == \
+            ["私", "は", "コーヒー", "が", "好", "きです"]
+
+    def test_dictionary_refines_kanji_runs(self):
+        tf = JapaneseTokenizerFactory(dictionary=["東京", "大学"])
+        assert tf.create("東京大学へ行く") == ["東京", "大学", "へ", "行", "く"]
+
+    def test_latin_passthrough(self):
+        assert JapaneseTokenizerFactory().create("JAXで学ぶ") == \
+            ["JAX", "で", "学", "ぶ"]
+
+
+class TestKorean:
+    def test_josa_particle_stripping(self):
+        tf = KoreanTokenizerFactory()
+        # 서울은/서울을/서울 all normalize to the same row
+        assert tf.create("서울은 크다") == ["서울", "크다"]
+        assert tf.create("서울을 본다") == ["서울", "본다"]
+
+    def test_strip_disabled(self):
+        tf = KoreanTokenizerFactory(stripParticles=False)
+        assert tf.create("서울은 크다") == ["서울은", "크다"]
+
+    def test_particle_only_word_not_emptied(self):
+        # a word that IS a particle string must survive stripping
+        assert KoreanTokenizerFactory().create("은 화폐다")[0] == "은"
+
+
+class TestWord2VecIntegration:
+    def test_chinese_corpus_trains_through_factory(self):
+        """End-to-end: dictionary-segmented Chinese corpus through the
+        standard Word2Vec builder hook; related words land closer than
+        unrelated ones."""
+        dict_ = ["北京", "上海", "城市", "苹果", "香蕉", "水果", "很大",
+                 "好吃"]
+        corpus = (["北京 是 城市", "上海 是 城市", "城市 很大",
+                   "北京 很大", "上海 很大"] * 6
+                  + ["苹果 是 水果", "香蕉 是 水果", "苹果 好吃",
+                     "香蕉 好吃", "水果 好吃"] * 6)
+        # sentences already spaced: the factory still segments each
+        # token run (proves create() is in the loop)
+        w2v = (Word2Vec.Builder()
+               .minWordFrequency(1).layerSize(16).seed(7).iterations(40)
+               .windowSize(2)
+               .tokenizerFactory(ChineseTokenizerFactory(dictionary=dict_))
+               .iterate(CollectionSentenceIterator(corpus))
+               .build())
+        w2v.fit()
+        assert w2v.hasWord("北京") and w2v.hasWord("水果")
+        assert w2v.similarity("北京", "上海") > \
+            w2v.similarity("北京", "香蕉")

@@ -101,6 +101,17 @@ class TestGymEnvAdapter:
         obs, r, done = env.step(0)
         assert r == pytest.approx(0.2) and not done
 
+    def test_kwargs_reset_wrapper_gets_seed(self):
+        """gym>=0.26 wrappers declare reset(self, **kwargs) and forward
+        seed= inward — signature detection must treat that as
+        seed-accepting (env.seed() no longer exists there)."""
+        class Wrapper(GymChain):
+            def reset(self, **kwargs):
+                return super().reset(**kwargs)
+        env = GymEnv(Wrapper(), seed=99)
+        env.reset()
+        assert env._env.seeded_with == 99
+
     def test_classic_env_seeds_via_seed_method(self):
         class SeedableClassic(ClassicGymChain):
             def seed(self, s):
