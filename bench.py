@@ -685,8 +685,17 @@ def _run_secondaries_subprocess(budget, deadline_capped=False, sink=None):
             if line.startswith("BENCHREC-CONFIG "):
                 try:
                     rec = json.loads(line[len("BENCHREC-CONFIG "):])
-                    out[rec["name"]] = rec["rec"]
-                    sink[rec["name"]] = rec["rec"]
+                    name, new = rec["name"], rec["rec"]
+                    prev = out.get(name)
+                    # an error-only final record must not ERASE partial
+                    # measurements this config already banked (e.g. the
+                    # attention T-table lines) — attach, don't replace
+                    if (isinstance(prev, dict) and prev
+                            and "error" not in prev
+                            and set(new) == {"error"}):
+                        new = dict(prev, error_after_partial=new["error"])
+                    out[name] = new
+                    sink[name] = new
                 except (json.JSONDecodeError, KeyError):
                     pass
 

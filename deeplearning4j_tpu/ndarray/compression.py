@@ -196,18 +196,20 @@ def quantized_bytes(qparams):
 
 class Int8Inference:
     """Int8 dequant-on-use inference wrapper for a trained
-    MultiLayerNetwork: `Int8Inference(net).output(x)`.
+    MultiLayerNetwork OR ComputationGraph (zoo models are graphs):
+    `Int8Inference(net).output(x)`.
 
     Weights are held as int8+scale; the dequant runs inside the jitted
     forward so XLA fuses it into each weight's consumer and the HBM
     working set shrinks ~4x. Accuracy: per-channel absmax keeps zoo-size
     classifiers within a fraction of a point of fp32 top-1 (pinned by
-    tests/test_compression.py on a trained model).
+    tests/test_compression.py on a trained MLN and a zoo graph).
     """
 
     def __init__(self, net):
         net._require_init()
         self._net = net
+        self._graph = not hasattr(net, "layers")  # ComputationGraph
         self._qparams = quantize_int8(net._params)
         cdt = net._compute_dtype
 
@@ -217,9 +219,22 @@ class Int8Inference:
         self._jit = jax.jit(fwd)
 
     def output(self, x):
-        x = x.jax() if isinstance(x, INDArray) else jnp.asarray(x)
-        return INDArray(self._jit(self._qparams, self._net._states, x))
+        """Single-input forward. Graphs return their FIRST network
+        output (`ComputationGraph.outputSingle` semantics); pass a dict
+        of input-name -> array for multi-input graphs."""
+        if self._graph and not isinstance(x, dict):
+            x = {self._net.conf.networkInputs[0]: _unwrap_arr(x)}
+        elif isinstance(x, dict):
+            x = {k: _unwrap_arr(v) for k, v in x.items()}
+        else:
+            x = _unwrap_arr(x)
+        out = self._jit(self._qparams, self._net._states, x)
+        return INDArray(out[0] if self._graph else out)
 
     def memoryRatio(self):
         qb, fb = quantized_bytes(self._qparams)
         return qb / max(fb, 1)
+
+
+def _unwrap_arr(x):
+    return x.jax() if isinstance(x, INDArray) else jnp.asarray(x)

@@ -187,7 +187,10 @@ class KoreanTokenizerFactory(_CJKBase):
     """Reference: nlp-korean KoreanTokenizerFactory (open-korean-text).
     Korean spaces between words (eojeol); the analyzer's normalization
     step this reproduces is particle (josa) stripping so '서울은' and
-    '서울' share an embedding row. stripParticles=False disables it."""
+    '서울' share an embedding row (stripParticles=False disables it).
+    A supplied dictionary additionally FMM-segments each stripped
+    eojeol — compound nouns split like the analyzer's compound-noun
+    decomposition."""
 
     _JOSA = ("에서", "으로", "은", "는", "이", "가", "을", "를",
              "의", "에", "로", "와", "과", "도", "만")
@@ -199,10 +202,35 @@ class KoreanTokenizerFactory(_CJKBase):
     def _tokenize(self, sentence):
         out = []
         for kind, run in self._runs(sentence):
-            if kind == "hangul" and self._strip:
-                for j in self._JOSA:  # tuple is longest-first
-                    if run.endswith(j) and len(run) > len(j):
-                        run = run[:-len(j)]
-                        break
+            if kind == "hangul":
+                if self._strip:
+                    for j in self._JOSA:  # tuple is longest-first
+                        if run.endswith(j) and len(run) > len(j):
+                            run = run[:-len(j)]
+                            break
+                if self._dict:
+                    # dictionary words split; non-matching spans stay
+                    # whole (unlike zh/ja, Korean has real spaces, so
+                    # single-syllable fallback would shred normal words)
+                    segs = _fmm(run, self._dict, self._max)
+                    out.extend(self._merge_nondict(segs))
+                    continue
             out.append(run)
+        return out
+
+    def _merge_nondict(self, segs):
+        """_fmm singles that are NOT dictionary words merge back into
+        spans, so only dictionary hits split an eojeol."""
+        out = []
+        buf = ""
+        for s in segs:
+            if s in self._dict:
+                if buf:
+                    out.append(buf)
+                    buf = ""
+                out.append(s)
+            else:
+                buf += s
+        if buf:
+            out.append(buf)
         return out

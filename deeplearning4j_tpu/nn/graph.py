@@ -413,7 +413,9 @@ class ComputationGraph:
         labs = [_unwrap(l) for l in (labels if isinstance(labels, (list, tuple)) else [labels])]
         self._step(inputs, labs, None, None)
 
-    def _fit_ds(self, ds):
+    def _extract_ds(self, ds):
+        """(inputs dict, labels list, fmasks, lmasks) from a DataSet or
+        MultiDataSet — shared by fit() and fitSteps()."""
         from deeplearning4j_tpu.data.multidataset import MultiDataSet
 
         if isinstance(ds, MultiDataSet):
@@ -432,7 +434,10 @@ class ComputationGraph:
             fmasks = None if fm is None else {self.conf.networkInputs[0]: _unwrap(fm)}
             lm = ds.getLabelsMaskArray()
             lmasks = None if lm is None else [_unwrap(lm)]
-        self._step(inputs, labs, fmasks, lmasks)
+        return inputs, labs, fmasks, lmasks
+
+    def _fit_ds(self, ds):
+        self._step(*self._extract_ds(ds))
 
     def _step(self, inputs, labels, fmasks, lmasks):
         if self.conf.backpropType == "tbptt" and any(
@@ -448,6 +453,106 @@ class ComputationGraph:
         self._iteration += 1
         for lst in self._listeners:
             lst.iterationDone(self, self._iteration, self._epoch)
+
+    def fitSteps(self, data, labels=None, numSteps=1):
+        """TPU-native k-step fit for graphs — numSteps optimizer steps
+        on one batch in a single on-device lax.fori_loop, one host sync.
+        Same trajectory/RNG/iteration semantics as numSteps fit() calls;
+        see MultiLayerNetwork.fitSteps for the rationale. tBPTT graphs
+        run their full window sweep per step (seq len must divide
+        tbpttFwdLength; mixed static+sequence inputs slice only the
+        [B,C,T] entries, like fit())."""
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.data.multidataset import MultiDataSet
+
+        self._require_init()
+        if labels is not None:
+            inputs = self._coerce_inputs(data)
+            labs = [_unwrap(l) for l in
+                    (labels if isinstance(labels, (list, tuple))
+                     else [labels])]
+            fmasks = lmasks = None
+        elif isinstance(data, (DataSet, MultiDataSet)):
+            inputs, labs, fmasks, lmasks = self._extract_ds(data)
+        else:
+            raise ValueError("fitSteps takes (x, y) arrays or one "
+                             "DataSet/MultiDataSet batch, not an iterator")
+        tbptt = self.conf.backpropType == "tbptt" and any(
+            v.ndim == 3 for v in inputs.values())
+        if tbptt:
+            T = max(v.shape[2] for v in inputs.values() if v.ndim == 3)
+            L = self.conf.tbpttFwdLength
+            if T % L != 0:
+                raise ValueError(
+                    f"fitSteps tBPTT needs seq len divisible by "
+                    f"tbpttFwdLength (got T={T}, L={L}); use fit() for "
+                    "ragged tails")
+            n_win = T // L
+        else:
+            n_win = 1
+        cache = getattr(self, "_fit_steps_cache", None)
+        if cache is None:
+            cache = self._fit_steps_cache = {}
+        jloop = cache.get((numSteps, n_win))
+        if jloop is None:
+            seed_key = jax.random.key(self.conf.seed ^ 0x5EED)
+
+            def loop(params, upd, states, it0, inputs, labels, fmasks,
+                     lmasks):
+                L = getattr(self.conf, "tbpttFwdLength", 1)
+
+                def window(carry, step_i, win_i, use_carries):
+                    p, u, s, _ = carry
+                    it = it0 + step_i * n_win + win_i
+                    key = jax.random.fold_in(seed_key, it)
+                    if n_win == 1:
+                        ic, lc, fc, mc = inputs, labels, fmasks, lmasks
+                    else:
+                        sl3 = lambda a: a if a is None or a.ndim != 3 \
+                            else jax.lax.dynamic_slice_in_dim(
+                                a, win_i * L, L, 2)
+                        slm = lambda m: None if m is None else \
+                            jax.lax.dynamic_slice_in_dim(m, win_i * L, L, 1)
+                        ic = {n: sl3(v) for n, v in inputs.items()}
+                        lc = [sl3(l) for l in labels]
+                        fc = None if fmasks is None else \
+                            {n: slm(m) for n, m in fmasks.items()}
+                        mc = None if lmasks is None else \
+                            [slm(m) for m in lmasks]
+                    p, u, s, loss = self._train_step(
+                        p, u, s, it, ic, lc, key, fc, mc,
+                        use_carries=use_carries)
+                    return (p, u, s, loss.astype(jnp.float32))
+
+                def body(i, carry):
+                    carry = window(carry, i, 0, False)
+                    if n_win > 1:
+                        carry = jax.lax.fori_loop(
+                            1, n_win,
+                            lambda w, c: window(c, i, w, True), carry)
+                    # structure-stable carry: strip the h/c entries the
+                    # step adds (see MultiLayerNetwork.fitSteps)
+                    p, u, s, loss = carry
+                    return (p, u, self._strip_carries(s), loss)
+
+                return jax.lax.fori_loop(
+                    0, numSteps, body,
+                    (params, upd, self._strip_carries(states),
+                     jnp.float32(0)))
+
+            jloop = jax.jit(
+                loop,
+                donate_argnums=(0, 1, 2) if self._solver is None else (2,))
+            cache[(numSteps, n_win)] = jloop
+        self._params, self._upd_states, self._states, loss = jloop(
+            self._params, self._upd_states, self._states,
+            jnp.asarray(self._iteration, jnp.int32), inputs, labs,
+            fmasks, lmasks)
+        self._score = float(loss)
+        self._iteration += numSteps * n_win
+        for lst in self._listeners:
+            lst.iterationDone(self, self._iteration, self._epoch)
+        return self
 
     def _fit_tbptt(self, inputs, labels, fmasks, lmasks):
         """Truncated BPTT over the DAG: split time ([B,C,T] axis 2) into

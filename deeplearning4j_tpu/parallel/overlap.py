@@ -45,7 +45,6 @@ _DTYPE_BITS = {"f64": 64, "f32": 32, "bf16": 16, "f16": 16, "s32": 32,
                "f8e4m3fnuz": 8, "f8e4m3b11fnuz": 8, "f8e3m4": 8,
                "f4e2m1fn": 4, "e8m0fnu": 8,
                "c64": 64, "c128": 128}
-_DTYPE_BYTES = {k: max(v // 8, 1) for k, v in _DTYPE_BITS.items()}
 
 # longest-first alternation so f8e4m3fn doesn't half-match as f8e4m3
 _SHAPE_RE = re.compile(

@@ -72,24 +72,17 @@ class GymEnv(MDP):
     def reset(self):
         if self._seed_pending:
             self._seed_pending = False  # gym seeds once, on first reset
-            # API detection by SIGNATURE, not try/except: a TypeError
-            # raised inside a gymnasium env's own reset must propagate,
-            # not silently re-run reset unseeded
-            import inspect
-
+            # probe reset(seed=) directly — signature inspection can't
+            # see through **kwargs wrappers (TimeLimit et al. forward
+            # seed inward). Only an API-mismatch TypeError (its message
+            # names the seed argument) falls back to the classic
+            # env.seed() path; a TypeError raised by a bug INSIDE the
+            # env propagates instead of silently re-running unseeded.
             try:
-                params = inspect.signature(self._env.reset).parameters
-                # a **kwargs reset (gym wrappers like TimeLimit) forwards
-                # seed= to the inner env — treat it as seed-accepting
-                takes_seed = "seed" in params or any(
-                    p.kind is inspect.Parameter.VAR_KEYWORD
-                    for p in params.values())
-            except (TypeError, ValueError):  # C-impl/exotic callables
-                takes_seed = False
-            if takes_seed:
                 out = self._env.reset(seed=self._seed)
-            else:
-                # classic API seeds via env.seed(s), not reset(seed=)
+            except TypeError as e:
+                if "seed" not in str(e):
+                    raise
                 seed_fn = getattr(self._env, "seed", None)
                 if callable(seed_fn):
                     seed_fn(self._seed)

@@ -454,3 +454,63 @@ class TestRound4Vertices:
         with pytest.raises(ValueError, match="two inputs"):
             (g.setOutputs("out")
               .setInputTypes(InputType.recurrent(4, 6)).build())
+
+
+class TestGraphFitSteps:
+    """ComputationGraph.fitSteps — same bit-parity bar as the
+    MultiLayerNetwork/SameDiff variants (TestFitSteps there)."""
+
+    def _conf(self):
+        return (NeuralNetConfiguration.Builder().seed(9).updater(Adam(1e-2))
+                .graphBuilder()
+                .addInputs("in")
+                .addLayer("d1", DenseLayer(nOut=16, activation="relu"), "in")
+                .addLayer("d2", DenseLayer(nOut=16, activation="identity"),
+                          "d1")
+                .addVertex("res", ElementWiseVertex("add"), "d1", "d2")
+                .addLayer("out", OutputLayer(nOut=3, activation="softmax"),
+                          "res")
+                .setOutputs("out")
+                .setInputTypes(InputType.feedForward(4))
+                .build())
+
+    def test_matches_k_fit_calls(self):
+        x, y, _ = _xor_ish()
+        a = ComputationGraph(self._conf()).init()
+        b = ComputationGraph(self._conf()).init()
+        for _ in range(5):
+            a.fit(x, y)
+        b.fitSteps(x, y, numSteps=5)
+        np.testing.assert_allclose(a.params().toNumpy(),
+                                   b.params().toNumpy(),
+                                   rtol=2e-6, atol=2e-6)
+        assert abs(a.score() - b.score()) < 1e-5
+        assert a._iteration == b._iteration == 5
+
+    def test_multidataset_batch(self):
+        conf = (NeuralNetConfiguration.Builder().seed(3).updater(Sgd(0.1))
+                .graphBuilder()
+                .addInputs("a", "b")
+                .addLayer("da", DenseLayer(nOut=8, activation="tanh"), "a")
+                .addLayer("db", DenseLayer(nOut=8, activation="tanh"), "b")
+                .addVertex("m", MergeVertex(), "da", "db")
+                .addLayer("out", OutputLayer(nOut=2, activation="softmax"),
+                          "m")
+                .setOutputs("out")
+                .setInputTypes(InputType.feedForward(4),
+                               InputType.feedForward(3))
+                .build())
+        rng = np.random.RandomState(0)
+        mds = MultiDataSet(
+            [rng.randn(16, 4).astype("float32"),
+             rng.randn(16, 3).astype("float32")],
+            [np.eye(2, dtype="float32")[rng.randint(0, 2, 16)]])
+        g = ComputationGraph(conf).init()
+        g.fitSteps(mds, numSteps=4)
+        assert np.isfinite(g.score())
+        assert g._iteration == 4
+
+    def test_iterator_rejected(self):
+        g = ComputationGraph(self._conf()).init()
+        with pytest.raises(ValueError, match="iterator"):
+            g.fitSteps(iter([]), numSteps=2)

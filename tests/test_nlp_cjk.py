@@ -90,3 +90,12 @@ class TestWord2VecIntegration:
         assert w2v.hasWord("北京") and w2v.hasWord("水果")
         assert w2v.similarity("北京", "上海") > \
             w2v.similarity("北京", "香蕉")
+
+
+class TestKoreanDictionary:
+    def test_dictionary_splits_compounds_only(self):
+        tf = KoreanTokenizerFactory(dictionary=["서울", "대학교"])
+        # compound eojeol splits on dictionary hits after josa stripping
+        assert tf.create("서울대학교는 크다") == ["서울", "대학교", "크다"]
+        # non-dictionary eojeol stays whole (no single-syllable shred)
+        assert tf.create("바나나") == ["바나나"]

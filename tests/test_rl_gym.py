@@ -169,3 +169,14 @@ class TestGymEnvAdapter:
         dqn.train(maxSteps=2500)
         policy = dqn.getPolicy()
         assert policy.play(env, maxSteps=20) == pytest.approx(10.0)
+
+
+class TestSeedProbeSemantics:
+    def test_env_internal_typeerror_propagates(self):
+        """A TypeError raised by a bug INSIDE a seed-accepting reset
+        must propagate, not silently re-run reset unseeded."""
+        class Buggy(GymChain):
+            def reset(self, seed=None):
+                raise TypeError("cannot unpack non-iterable NoneType")
+        with pytest.raises(TypeError, match="unpack"):
+            GymEnv(Buggy(), seed=1).reset()
