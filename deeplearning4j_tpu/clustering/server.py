@@ -74,12 +74,15 @@ class NearestNeighborsServer(HttpServerOwner):
         return None if X is None else int(np.asarray(X).shape[0])
 
     # ----- HTTP layer --------------------------------------------------
-    def start(self, port=9200):
-        """Serve on 127.0.0.1:<port> (0 = ephemeral); returns self."""
+    def start(self, port=9200, requestDeadline=None):
+        """Serve on 127.0.0.1:<port> (0 = ephemeral); returns self.
+        GET /healthz answers readiness (503 while setReady(False), e.g.
+        during an index rebuild); requestDeadline (seconds) bounds each
+        request — see util.httpserve."""
         srv = self
 
         class Handler(JsonHandler):
-            def do_GET(self):
+            def handle_GET(self):
                 if self.path != "/status":
                     return self._json({"error": "unknown route"}, 404)
                 d = None
@@ -90,7 +93,7 @@ class NearestNeighborsServer(HttpServerOwner):
                 return self._json({"numPoints": srv.numPoints, "dims": d,
                                    "index": type(srv._index).__name__})
 
-            def do_POST(self):
+            def handle_POST(self):
                 if self.path not in ("/knn", "/knnnew"):
                     return self._json({"error": "unknown route"}, 404)
                 try:
@@ -106,4 +109,4 @@ class NearestNeighborsServer(HttpServerOwner):
                     return self._json(
                         {"error": f"{type(e).__name__}: {e}"}, 400)
 
-        return self._serve(Handler, port)
+        return self._serve(Handler, port, requestDeadline=requestDeadline)

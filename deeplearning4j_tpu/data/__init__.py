@@ -40,6 +40,7 @@ from deeplearning4j_tpu.data.audio import (
     SpectrogramTransform, MelSpectrogramTransform, MFCCTransform,
     WavFileRecordReader, mel_filterbank,
 )
+from deeplearning4j_tpu.data.resilient import RetryingDataSetIterator
 from deeplearning4j_tpu.data.records import (
     RecordReader, CSVRecordReader, CollectionRecordReader, ImageRecordReader,
     Schema, TransformProcess, RecordReaderDataSetIterator,
@@ -57,6 +58,7 @@ __all__ = [
     "VGG16ImagePreProcessor", "IrisDataSetIterator", "MnistDataSetIterator", "FashionMnistDataSetIterator",
     "EmnistDataSetIterator",
     "Cifar10DataSetIterator", "CifarDataSetIterator", "RandomDataSetIterator",
+    "RetryingDataSetIterator",
     "RecordReader", "CSVRecordReader", "CollectionRecordReader",
     "ImageRecordReader", "Schema", "TransformProcess",
     "RecordReaderDataSetIterator", "CSVSequenceRecordReader",

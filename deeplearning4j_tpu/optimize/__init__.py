@@ -14,6 +14,7 @@ from deeplearning4j_tpu.optimize.listeners import (
     TimeIterationListener,
     StatsListener,
     NanScoreWatcher,
+    ResilienceListener,
 )
 from deeplearning4j_tpu.optimize.ui import UIServer, render_report
 from deeplearning4j_tpu.optimize.earlystopping import (
@@ -37,6 +38,7 @@ __all__ = [
     "TrainingListener", "ScoreIterationListener", "PerformanceListener",
     "EvaluativeListener", "CheckpointListener", "CollectScoresListener",
     "TimeIterationListener", "StatsListener", "NanScoreWatcher",
+    "ResilienceListener",
     "EarlyStoppingConfiguration", "EarlyStoppingTrainer",
     "EarlyStoppingGraphTrainer", "EarlyStoppingResult", "TerminationReason",
     "MaxEpochsTerminationCondition", "ScoreImprovementEpochTerminationCondition",

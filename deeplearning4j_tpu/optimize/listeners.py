@@ -30,6 +30,18 @@ class TrainingListener:
     def onEpochEnd(self, model) -> None:
         pass
 
+    # ----- resilience hooks (runtime.resilience.ResilientFit) ---------
+    def onStepSkipped(self, model, iteration: int, epoch: int,
+                      loss: float) -> None:
+        """A step produced non-finite loss/params and was NOT applied."""
+
+    def onCheckpointSaved(self, model, path: str, iteration: int) -> None:
+        pass
+
+    def onCheckpointRestored(self, model, path: str,
+                             iteration: int) -> None:
+        """Training resumed from `path` (preemption recovery)."""
+
 
 class ScoreIterationListener(TrainingListener):
     """Print score every `printIterations` iterations
@@ -268,6 +280,31 @@ class StatsListener(TrainingListener):
         first, last = scores[0], scores[-1]
         return (f"{len(scores)} records; score {first[1]:.6f} @ iter {first[0]} "
                 f"→ {last[1]:.6f} @ iter {last[0]}")
+
+
+class ResilienceListener(TrainingListener):
+    """Collects the resilience event stream (skipped steps, checkpoint
+    saves, restores) in memory — the assertion surface for the fault
+    matrix, and a cheap ops signal ('how often does this run skip?').
+    Events are (kind, iteration, detail) tuples, oldest first."""
+
+    def __init__(self):
+        self.events = []
+        self.skippedSteps = 0
+        self.saves = 0
+        self.restores = 0
+
+    def onStepSkipped(self, model, iteration, epoch, loss):
+        self.skippedSteps += 1
+        self.events.append(("skip", iteration, loss))
+
+    def onCheckpointSaved(self, model, path, iteration):
+        self.saves += 1
+        self.events.append(("save", iteration, path))
+
+    def onCheckpointRestored(self, model, path, iteration):
+        self.restores += 1
+        self.events.append(("restore", iteration, path))
 
 
 class NanScoreWatcher(TrainingListener):

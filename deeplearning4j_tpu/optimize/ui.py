@@ -179,13 +179,16 @@ class UIServer(HttpServerOwner):
         return docs
 
     # ----- live server (reference: UIServer.getInstance() web UI) -----
-    def start(self, port=9000, refreshSec=5):
+    def start(self, port=9000, refreshSec=5, requestDeadline=None):
         """Serve the live dashboard on 127.0.0.1:<port>; returns self.
-        Daemon-threaded, so it never keeps a training process alive."""
+        Daemon-threaded, so it never keeps a training process alive.
+        GET /healthz answers readiness; requestDeadline (seconds) turns
+        a stuck handler into a 503 instead of a hung client — see
+        util.httpserve."""
         ui = self
 
         class Handler(JsonHandler):
-            def do_GET(self):
+            def handle_GET(self):
                 parsed = urllib.parse.urlparse(self.path)
                 parts = [p for p in parsed.path.split("/") if p]
                 try:
@@ -223,4 +226,4 @@ class UIServer(HttpServerOwner):
                     return self._json({"error": f"{type(e).__name__}: {e}"},
                                       500)
 
-        return self._serve(Handler, port)
+        return self._serve(Handler, port, requestDeadline=requestDeadline)

@@ -1,0 +1,39 @@
+"""Version-tolerant shard_map.
+
+jax moved shard_map from jax.experimental.shard_map (<= 0.4.x, with a
+`check_rep` flag) to the top-level jax.shard_map (with `check_vma`).
+The container matrix this repo runs on spans both; importing the new
+location unconditionally took the ENTIRE parallel package down at
+collection time on older jax. All parallel modules import shard_map
+from here, written against the NEW calling convention — the shim maps
+check_vma onto check_rep when only the legacy entry point exists.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.6: top-level, check_vma
+    from jax import shard_map as _shard_map
+except ImportError:  # legacy: experimental, check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = set(inspect.signature(_shard_map).parameters)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, **kw):
+    if "check_vma" in kw and "check_vma" not in _PARAMS:
+        kw["check_rep"] = kw.pop("check_vma")
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a named mesh axis from inside a shard_map body.
+    lax.axis_size is the modern spelling; on legacy jax a psum of the
+    Python constant 1 folds to the same static int."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)

@@ -112,7 +112,7 @@ class ParallelWrapper:
         """Train step with an explicit int8-quantized gradient all-reduce
         (EQuARX-style). Uses shard_map over the data axis so the quantize →
         psum → dequantize pipeline is expressed directly."""
-        from jax import shard_map
+        from deeplearning4j_tpu.parallel._compat import shard_map
 
         n = self.net
         mesh, ax = self.mesh, self.batch_axis
@@ -160,7 +160,7 @@ class ParallelWrapper:
         with this algorithm is an Ethernet-era optimization, while the
         algorithm's semantics (sparsified, error-compensated updates)
         are preserved exactly."""
-        from jax import shard_map
+        from deeplearning4j_tpu.parallel._compat import shard_map
 
         n = self.net
         mesh, ax = self.mesh, self.batch_axis
@@ -295,6 +295,23 @@ class ParallelWrapper:
         for lst in n._listeners:
             lst.iterationDone(n, n._iteration, n._epoch)
 
+    def trainStep(self):
+        """The un-jitted per-batch step function with the canonical
+        `(params, upd, states, it, x, y, key, fmask, lmask) ->
+        (params', upd', states', loss)` signature, for harnesses that
+        splice logic around it before jitting — runtime.resilience
+        wraps it in the non-finite guard. The threshold mode threads a
+        residual through the step (a different arity), so it cannot be
+        guarded this way."""
+        if self.gradient_compression is None:
+            return self.net._train_step
+        if self.gradient_compression == "int8":
+            return self._compressed_step
+        raise ValueError(
+            "trainStep() supports gradient_compression None/'int8'; the "
+            "'threshold' step carries per-replica residual state and is "
+            "not wrappable — run it without the non-finite guard")
+
     def averagingFrequency(self, *_):
         # synchronous psum makes per-step averaging exact already; the
         # reference's periodic-averaging semantics live in
@@ -363,6 +380,15 @@ class ParameterAveragingTrainingMaster(ParallelWrapper):
         self._avg_freq = int(averagingFrequency)
         self._stacked = None  # (params, upd_states, states) + replica axis
 
+    def trainStep(self):
+        raise ValueError(
+            "ParameterAveragingTrainingMaster's step is not expressible "
+            "as one wrappable train step: it takes LOCAL per-replica "
+            "steps on stacked state with a periodic pmean, all inside "
+            "its own _fit_batch. Wrap ParallelWrapper/"
+            "SharedTrainingMaster in ResilientFit instead, or run this "
+            "master without the non-finite guard")
+
     def averagingFrequency(self, k):
         if self._jit is not None:
             raise RuntimeError("set averagingFrequency before the first fit()")
@@ -389,7 +415,7 @@ class ParameterAveragingTrainingMaster(ParallelWrapper):
                          stack(n._states))
 
     def _build_jit(self):
-        from jax import shard_map
+        from deeplearning4j_tpu.parallel._compat import shard_map
 
         n, mesh, ax = self.net, self.mesh, self.batch_axis
 

@@ -12,10 +12,16 @@ from deeplearning4j_tpu.runtime.ringbuffer import (
 from deeplearning4j_tpu.runtime.async_iterator import (
     AsyncDataSetIterator, AsyncMultiDataSetIterator, pack_arrays, unpack_arrays,
 )
+from deeplearning4j_tpu.runtime.resilience import (
+    RetryPolicy, retry, FaultInjector, Preemption, ResilientFit,
+    NonFiniteStepError, non_finite_guard,
+)
 
 __all__ = [
     "NativeRingBuffer", "PythonRingBuffer", "make_ring", "native_lib",
     "AsyncDataSetIterator", "AsyncMultiDataSetIterator",
     "pack_arrays", "unpack_arrays",
     "PF_OK", "PF_TIMEOUT", "PF_CLOSED", "PF_TOO_BIG",
+    "RetryPolicy", "retry", "FaultInjector", "Preemption", "ResilientFit",
+    "NonFiniteStepError", "non_finite_guard",
 ]

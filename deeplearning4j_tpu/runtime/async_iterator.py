@@ -12,10 +12,13 @@ from __future__ import annotations
 
 import struct
 import threading
+import time as _time
 
 import numpy as np
 
-from deeplearning4j_tpu.runtime.ringbuffer import PF_CLOSED, PF_TOO_BIG, make_ring
+from deeplearning4j_tpu.runtime.ringbuffer import (
+    PF_CLOSED, PF_TIMEOUT, PF_TOO_BIG, make_ring,
+)
 
 _DTYPES = [np.float32, np.float64, np.int32, np.int64, np.uint8, np.bool_,
            np.float16, np.int16, np.int8, np.uint32, np.uint64]
@@ -123,7 +126,11 @@ class AsyncDataSetIterator:
                         f"{ring.slot_bytes}")
             ring.push(self._SENTINEL)
         except Exception as e:  # surface in the consumer
-            self._error = e
+            if ring is self._ring:
+                self._error = e
+            # else: this is an abandoned worker from a previous epoch
+            # (bounded _shutdown gave up on it) — its failure must not
+            # poison the current epoch's fresh ring
             ring.close()
 
     def _start_epoch(self):
@@ -151,20 +158,56 @@ class AsyncDataSetIterator:
 
     # ----- consumer (DataSetIterator surface) -------------------------
     def _fill(self):
+        """Stage the next batch. A producer error propagates on the very
+        next consumer call — BEFORE any batches still queued in the ring
+        — and never stalls the training loop: the pop runs on a short
+        timeout so a raise that a missed close() wakeup would otherwise
+        hide is picked up within ~100 ms."""
         if self._pending is not None or self._exhausted:
             return
-        got = self._ring.pop()
-        if isinstance(got, int):  # PF_CLOSED after error/shutdown
-            self._exhausted = True
+        while True:
             if self._error is not None:
+                self._finish(drain=True)
                 raise self._error
+            got = self._ring.pop(timeout_ms=100)
+            if got == PF_TIMEOUT:
+                t = self._thread
+                if (t is not None and not t.is_alive()
+                        and self._error is None
+                        and self._ring.count() == 0):
+                    # worker died without sentinel OR error (e.g. killed
+                    # by the interpreter) — fail loudly, don't spin
+                    self._exhausted = True
+                    self._thread = None
+                    raise RuntimeError(
+                        "async prefetch worker died without signaling "
+                        "end-of-epoch or an error")
+                continue  # re-check the error flag, then keep waiting
+            if isinstance(got, int):  # PF_CLOSED after error/shutdown
+                self._finish()
+                if self._error is not None:
+                    raise self._error
+                return
+            if got == self._SENTINEL:
+                self._finish()
+                if self._error is not None:
+                    raise self._error
+                return
+            self._pending = got
             return
-        if got == self._SENTINEL:
-            self._exhausted = True
-            if self._error is not None:
-                raise self._error
-            return
-        self._pending = got
+
+    def _finish(self, drain=False):
+        """End-of-pass bookkeeping: mark exhausted and JOIN the producer
+        so a raising worker never leaks its daemon thread (it has either
+        pushed the sentinel or closed the ring, so it is exiting)."""
+        self._exhausted = True
+        if drain:
+            self._ring.close()  # unstick a producer blocked on push
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=2.0)
+        if t is not None and not t.is_alive():
+            self._thread = None
 
     def hasNext(self) -> bool:
         self._fill()
@@ -187,11 +230,30 @@ class AsyncDataSetIterator:
     def _shutdown(self):
         if self._ring is not None:
             self._ring.close()
-        if self._thread is not None and self._thread.is_alive():
-            # drain so a blocked producer can observe the close
-            while self._thread.is_alive():
+        t = self._thread
+        if t is not None and t.is_alive():
+            # drain so a blocked producer can observe the close; bounded
+            # so a base iterator stuck in I/O can't hang reset()/close()
+            # forever (the worker is a daemon thread and cannot keep the
+            # process alive)
+            deadline = _time.monotonic() + 5.0
+            while t.is_alive() and _time.monotonic() < deadline:
                 self._ring.pop(timeout_ms=10)
-                self._thread.join(timeout=0.05)
+                t.join(timeout=0.05)
+            if t.is_alive():
+                import warnings
+
+                warnings.warn(
+                    "async prefetch worker did not exit within 5s "
+                    "(base iterator stuck in I/O?); abandoning the "
+                    "daemon thread and its ring — when its blocking "
+                    "call returns it may consume one more base batch, "
+                    "then sees the closed ring and exits", stacklevel=3)
+                # never reuse this ring: the zombie would push a stale
+                # batch/sentinel into the NEXT epoch after reopen();
+                # left closed, its push gets PF_CLOSED and the thread
+                # dies. _start_epoch sizes a fresh ring on demand.
+                self._ring = None
         self._thread = None
 
     def close(self):
@@ -238,7 +300,8 @@ class AsyncMultiDataSetIterator(AsyncDataSetIterator):
                     raise ValueError("multidataset exceeds ring slot")
             ring.push(self._SENTINEL)
         except Exception as e:
-            self._error = e
+            if ring is self._ring:  # see AsyncDataSetIterator._producer
+                self._error = e
             ring.close()
 
     @staticmethod

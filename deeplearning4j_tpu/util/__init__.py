@@ -5,13 +5,17 @@ org.nd4j.linalg.profiler.
 """
 
 from deeplearning4j_tpu.util.serializer import ModelSerializer, TrainingCheckpoint
-from deeplearning4j_tpu.util.sharded_checkpoint import ShardedModelSerializer
+from deeplearning4j_tpu.util.sharded_checkpoint import (
+    ShardedModelSerializer, latest_step, gc_checkpoints, step_path,
+    read_manifest,
+)
 from deeplearning4j_tpu.util.workspace import (
     MemoryWorkspace, WorkspaceConfiguration, WorkspaceManager,
 )
 from deeplearning4j_tpu.util.profiler import OpProfiler, trace, annotate
 
 __all__ = ["ModelSerializer", "TrainingCheckpoint", "ShardedModelSerializer",
+           "latest_step", "gc_checkpoints", "step_path", "read_manifest",
            "MemoryWorkspace",
            "WorkspaceConfiguration", "WorkspaceManager", "OpProfiler",
            "trace", "annotate"]

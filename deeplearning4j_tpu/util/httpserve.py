@@ -2,7 +2,26 @@
 (optimize.ui.UIServer, clustering.server.NearestNeighborsServer):
 a daemon-threaded ThreadingHTTPServer owner mixin plus a JSON-speaking
 BaseHTTPRequestHandler base — one copy of the start/stop/port/body
-plumbing so fixes land in one place."""
+plumbing so fixes land in one place.
+
+Production hardening (runtime.resilience PR): every server built on
+this base gets
+
+* ``GET /healthz`` — readiness probe answering 200 {"status": "ok"}
+  while the owner is started and ready, 503 otherwise (pod schedulers
+  and load balancers gate traffic on it; flip with setReady(False)
+  during index rebuilds / model swaps),
+* an optional per-request deadline: ``start(..., requestDeadline=s)``
+  runs each handler on a watched worker thread and answers 503
+  {"error": "deadline exceeded"} instead of letting a stuck handler
+  hang the client connection forever. The late handler's own write is
+  suppressed (single-response lock), so the two can never interleave
+  on the socket.
+
+Handlers subclass JsonHandler and implement ``handle_GET`` /
+``handle_POST`` (NOT do_GET/do_POST — the base owns those to splice in
+/healthz and the deadline).
+"""
 
 from __future__ import annotations
 
@@ -13,22 +32,36 @@ import threading
 
 class JsonHandler(http.server.BaseHTTPRequestHandler):
     """Request handler base: silenced per-request logging, JSON/body
-    writers with correct Content-Length, and strict JSON-object body
-    parsing (a list/scalar body is a client error, not a crash)."""
+    writers with correct Content-Length, strict JSON-object body
+    parsing (a list/scalar body is a client error, not a crash), and
+    the /healthz + request-deadline dispatch described in the module
+    docstring."""
+
+    # per-request response state (instances are per-request, so class
+    # attrs are safe defaults)
+    _responded = False
+    _suppressed = False
+    _resp_lock = None
 
     def log_message(self, *a):
         pass
 
-    def _send(self, code, body, ctype):
+    def _send(self, code, body, ctype, _force=False):
         data = body.encode() if isinstance(body, str) else body
+        lock = self._resp_lock
+        if lock is not None:
+            with lock:
+                if self._suppressed and not _force:
+                    return  # deadline already answered 503 for us
+                self._responded = True
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
 
-    def _json(self, obj, code=200):
-        self._send(code, json.dumps(obj), "application/json")
+    def _json(self, obj, code=200, _force=False):
+        self._send(code, json.dumps(obj), "application/json", _force=_force)
 
     def _read_json_object(self):
         n = int(self.headers.get("Content-Length", 0))
@@ -38,23 +71,108 @@ class JsonHandler(http.server.BaseHTTPRequestHandler):
                 f"JSON object body required, got {type(body).__name__}")
         return body
 
+    # ----- dispatch ----------------------------------------------------
+    def _owner(self):
+        return getattr(self.server, "owner", None)
+
+    def do_GET(self):
+        if self.path.split("?", 1)[0] == "/healthz":
+            owner = self._owner()
+            ready = owner.ready if owner is not None else True
+            return self._json(
+                {"status": "ok" if ready else "unready"},
+                200 if ready else 503)
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def _dispatch(self, method):
+        impl = getattr(self, f"handle_{method}", None)
+        if impl is None:
+            return self._json({"error": f"{method} not supported"}, 501)
+        owner = self._owner()
+        deadline = getattr(owner, "requestDeadline", None)
+        if not deadline:
+            return impl()
+        # deadline mode: the handler body runs on a watched daemon
+        # thread; if it overruns, THIS thread answers 503 and the
+        # worker's eventual write is dropped by the response lock. The
+        # worker is abandoned, not killed — Python can't safely kill a
+        # thread — but the CLIENT is released, which is the contract.
+        self._resp_lock = threading.Lock()
+        done = threading.Event()
+
+        def run():
+            try:
+                impl()
+            except Exception as e:
+                try:
+                    # parity with the non-deadline path's 500; the
+                    # response lock drops this if the deadline already
+                    # answered 503
+                    self._json({"error": f"{type(e).__name__}: {e}"}, 500)
+                except Exception:
+                    pass  # connection is gone; nothing left to report to
+            finally:
+                done.set()
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        if not done.wait(float(deadline)):
+            with self._resp_lock:
+                overrun = not self._responded
+                if overrun:
+                    self._suppressed = True
+            if overrun:
+                self._json({"error": "deadline exceeded",
+                            "deadlineSec": float(deadline)}, 503,
+                           _force=True)
+                self.close_connection = True
+            else:
+                # response is mid-write; give it a grace period, then
+                # drop the connection rather than let a later request's
+                # response interleave with the still-writing worker
+                if not done.wait(5.0):
+                    self.close_connection = True
+
 
 class HttpServerOwner:
-    """start/stop/port for a class that owns one loopback HTTP server."""
+    """start/stop/port for a class that owns one loopback HTTP server,
+    plus the readiness flag /healthz reports and the per-request
+    deadline JsonHandler enforces."""
 
     _httpd = None
     _thread = None
+    _ready = True
+    requestDeadline = None  # seconds; None/0 disables
 
     @property
     def port(self):
         """Bound port once started (pass port=0 for an ephemeral one)."""
         return self._httpd.server_address[1] if self._httpd else None
 
-    def _serve(self, handler_cls, port):
+    @property
+    def ready(self) -> bool:
+        """What /healthz answers: started AND not administratively
+        drained via setReady(False)."""
+        return self._httpd is not None and self._ready
+
+    def setReady(self, ready: bool):
+        """Flip readiness without stopping the server (drain traffic
+        during an index rebuild / model swap)."""
+        self._ready = bool(ready)
+        return self
+
+    def _serve(self, handler_cls, port, requestDeadline=None):
         if self._httpd is not None:
             return self
+        if requestDeadline is not None:
+            self.requestDeadline = float(requestDeadline) or None
+        self._ready = True  # a restart clears any previous drain
         self._httpd = http.server.ThreadingHTTPServer(
             ("127.0.0.1", port), handler_cls)
+        self._httpd.owner = self
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()

@@ -17,12 +17,24 @@ moments / layer states / counters) plus a `manifest.json` with the
 serde-encoded network configuration, so `restore(path)` can rebuild
 the net without the caller supplying one (parity with
 ModelSerializer.restore's type dispatch).
+
+Durability: writeModel stages the whole checkpoint under a
+`<path>.tmp-*` sibling and renames it into place only once every byte
+is on disk — the rename IS the commit, so a save preempted at any
+point leaves either the previous complete checkpoint or none, never a
+half-written directory that restore would then load. latest_step() /
+gc_checkpoints() manage a directory of `step_<n>` checkpoints for the
+periodic-save / resume-from-latest training loop
+(runtime.resilience.ResilientFit; reference: CheckpointListener's
+rotation).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import re
+import shutil
 
 import jax
 import numpy as np
@@ -31,14 +43,122 @@ from deeplearning4j_tpu.util import serde
 
 _MANIFEST = "manifest.json"
 _STATE_DIR = "state"
+_STEP_RE = re.compile(r"^step_(\d+)$")
+_TMP_RE = re.compile(r"\.tmp-")
+
+
+def _commit(tmp: str, final: str):
+    """Rename the staged checkpoint into place (multi-host: process 0
+    only — every host wrote its shards into the SAME staging dir).
+    Fresh paths (ResilientFit's `step_<n>` scheme) commit in one atomic
+    rename. Overwriting an existing checkpoint swaps via a `.old`
+    sibling: there is an unavoidable instant with no directory at
+    `final` itself, but a COMPLETE copy always exists at `final` or its
+    `.old` sibling (which gc_checkpoints deliberately does NOT sweep,
+    so a crash inside the swap stays manually recoverable)."""
+    import jax
+
+    if jax.process_index() != 0:
+        return
+    if os.path.isdir(final):
+        trash = final + ".old"
+        shutil.rmtree(trash, ignore_errors=True)
+        os.rename(final, trash)
+        os.rename(tmp, final)
+        shutil.rmtree(trash, ignore_errors=True)
+    else:
+        os.rename(tmp, final)
+
+
+class _AtomicSaveHandle:
+    """Async-save handle: joins the Orbax background write, THEN commits
+    the staged directory. Until wait_until_finished() returns, restore()
+    still sees the previous complete checkpoint (or none)."""
+
+    def __init__(self, ckpt, tmp, final):
+        self._ckpt = ckpt
+        self._tmp = tmp
+        self._final = final
+        self._done = False
+
+    def wait_until_finished(self):
+        self._ckpt.wait_until_finished()
+        if not self._done:
+            _commit(self._tmp, self._final)
+            self._done = True
+        return self
+
+
+def step_path(directory, step: int) -> str:
+    """Canonical `<dir>/step_<n>` checkpoint path for iteration `step`."""
+    return os.path.join(os.path.abspath(str(directory)), f"step_{int(step)}")
+
+
+def latest_step(directory):
+    """Highest step number with a COMPLETE checkpoint under `directory`
+    (a committed `step_<n>` dir with its manifest), or None. Staged
+    `.tmp-*` leftovers from preempted saves are never candidates — the
+    commit rename is what makes a checkpoint visible here."""
+    directory = os.path.abspath(str(directory))
+    if not os.path.isdir(directory):
+        return None
+    best = None
+    for name in os.listdir(directory):
+        m = _STEP_RE.match(name)
+        if not m:
+            continue
+        if not os.path.exists(os.path.join(directory, name, _MANIFEST)):
+            continue
+        n = int(m.group(1))
+        best = n if best is None else max(best, n)
+    return best
+
+
+def gc_checkpoints(directory, keepLast: int):
+    """Keep the newest `keepLast` complete `step_<n>` checkpoints (DL4J
+    CheckpointListener keepLast parity) and sweep any `.tmp-*` staging
+    leftovers from saves that died before their commit rename. Returns
+    the list of deleted paths. keepLast <= 0 keeps everything (still
+    sweeps dead staging dirs)."""
+    directory = os.path.abspath(str(directory))
+    if not os.path.isdir(directory):
+        return []
+    steps, deleted = [], []
+    for name in os.listdir(directory):
+        full = os.path.join(directory, name)
+        m = _STEP_RE.match(name)
+        if m and os.path.exists(os.path.join(full, _MANIFEST)):
+            steps.append((int(m.group(1)), full))
+        elif _TMP_RE.search(name):
+            # dead staging dirs only; `.old` siblings are left alone —
+            # after a crash mid-overwrite they hold the ONLY complete
+            # copy of that checkpoint
+            shutil.rmtree(full, ignore_errors=True)
+            deleted.append(full)
+    if keepLast and keepLast > 0 and len(steps) > keepLast:
+        steps.sort()
+        for _, full in steps[:-keepLast]:
+            shutil.rmtree(full, ignore_errors=True)
+            deleted.append(full)
+    return deleted
+
+
+def read_manifest(path) -> dict:
+    """The checkpoint's manifest.json (includes any `extra` metadata the
+    saver attached — e.g. ResilientFit's mid-epoch resume position)."""
+    mpath = os.path.join(os.path.abspath(str(path)), _MANIFEST)
+    with open(mpath) as f:
+        return json.load(f)
 
 
 def _net_state(net, saveUpdater=True):
     state = {
         "params": net._params,
         "states": net._strip_carries(net._states),
-        "counters": {"iteration": np.int64(net._iteration),
-                     "epoch": np.int64(net._epoch)},
+        # 0-d arrays, not np scalars: StandardCheckpointHandler's
+        # save-state validation only admits ndarray/jax.Array leaves
+        "counters": {"iteration": np.asarray(net._iteration, np.int64),
+                     "epoch": np.asarray(net._epoch, np.int64)},
     }
     if saveUpdater:
         state["upd_states"] = net._upd_states
@@ -50,16 +170,37 @@ class ShardedModelSerializer:
     distributed complement of util.serializer.ModelSerializer)."""
 
     @staticmethod
-    def writeModel(net, path, saveUpdater=True, asyncSave=False):
+    def writeModel(net, path, saveUpdater=True, asyncSave=False, extra=None):
         """Save to directory `path`. With asyncSave=True the write
-        happens in the background — call the returned handle's
-        .wait_until_finished() (or save again / exit) to join it.
-        Sharded arrays are written per-shard: on multi-host, each host
-        writes only the shards it owns."""
+        happens in the background — you MUST call the returned handle's
+        .wait_until_finished() to join AND commit it. Sharded arrays
+        are written per-shard: on multi-host, each host writes only the
+        shards it owns.
+
+        The save is ATOMIC at `path`: everything is staged under a
+        `<path>.tmp-stage` sibling (one SHARED staging dir — on
+        multi-host, every host writes its shards into it and process 0
+        performs the commit rename) and renamed into place only after
+        the state is fully flushed. A save killed mid-write can
+        therefore never leave a torn "latest" checkpoint for
+        restore()/latest_step() to pick up. asyncSave contract: the
+        commit happens inside the returned handle's
+        wait_until_finished() — an async save that is never joined is
+        never committed (the stale staging dir is swept by the next
+        save / gc_checkpoints).
+
+        `extra`: optional JSON-serializable dict recorded in the
+        manifest (read back via read_manifest) — resume metadata like
+        ResilientFit's batch-within-epoch position rides here so it
+        commits atomically WITH the state it describes."""
         import orbax.checkpoint as ocp
 
         path = os.path.abspath(str(path))
-        os.makedirs(path, exist_ok=True)
+        tmp = path + ".tmp-stage"
+        if jax.process_index() == 0:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            shutil.rmtree(tmp, ignore_errors=True)
+            os.makedirs(tmp)
         conf_arrays = []
         conf_node = serde.encode(net.conf, conf_arrays)
         manifest = {
@@ -73,15 +214,19 @@ class ShardedModelSerializer:
                             for a in conf_arrays],
             "saveUpdater": bool(saveUpdater),
         }
-        with open(os.path.join(path, _MANIFEST), "w") as f:
-            json.dump(manifest, f)
+        if extra is not None:
+            manifest["extra"] = extra
+        if jax.process_index() == 0:
+            with open(os.path.join(tmp, _MANIFEST), "w") as f:
+                json.dump(manifest, f)
         ckpt = (ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
                 if asyncSave else ocp.StandardCheckpointer())
-        state_path = os.path.join(path, _STATE_DIR)
+        state_path = os.path.join(tmp, _STATE_DIR)
         ckpt.save(state_path, _net_state(net, saveUpdater), force=True)
+        handle = _AtomicSaveHandle(ckpt, tmp, path)
         if not asyncSave:
-            ckpt.wait_until_finished()
-        return ckpt
+            handle.wait_until_finished()
+        return handle
 
     @staticmethod
     def restore(path, sharding=None):

@@ -25,6 +25,17 @@ jax.config.update("jax_enable_x64", True)  # fp64 oracles for gradchecks
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # registered here (no pytest.ini in this repo) so `-m 'not slow'`
+    # and `-m faults` filter without unknown-marker warnings
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from the tier-1 run")
+    config.addinivalue_line(
+        "markers",
+        "faults: deterministic fault-injection tests "
+        "(runtime.resilience.FaultInjector)")
+
+
 @pytest.fixture(autouse=True)
 def _fixed_seed():
     from deeplearning4j_tpu.ndarray import random as r
