@@ -1,0 +1,57 @@
+"""Pre-compilation static analysis.
+
+Three passes, one CLI (``python -m deeplearning4j_tpu.analysis``):
+
+- shape/dtype inference over model configs (shapes.validate_model)
+- SameDiff graph validation (samediff_check.validate_samediff)
+- JAX-purity source lint (purity.lint_paths)
+
+See docs/ANALYSIS.md for the diagnostic catalogue and suppression
+syntax. ``MultiLayerNetwork.init(validate=True)`` /
+``ComputationGraph.init(validate=True)`` run the shape pass eagerly and
+raise ConfigValidationError instead of deferring mistakes to trace
+time.
+"""
+
+from deeplearning4j_tpu.analysis.diagnostics import (  # noqa: F401
+    ALL_CODES, ConfigValidationError, Diagnostic, Report,
+)
+from deeplearning4j_tpu.analysis.shapes import validate_model  # noqa: F401
+from deeplearning4j_tpu.analysis.samediff_check import (  # noqa: F401
+    validate_samediff,
+)
+from deeplearning4j_tpu.analysis.purity import (  # noqa: F401
+    lint_paths, lint_source,
+)
+
+__all__ = ["ALL_CODES", "ConfigValidationError", "Diagnostic", "Report",
+           "validate_model", "validate_or_raise", "validate_samediff",
+           "lint_paths", "lint_source", "zoo_corpus"]
+
+
+def validate_or_raise(conf, batchSize=32):
+    """The eager-check contract behind init(validate=True), shared by
+    MultiLayerNetwork and ComputationGraph so the two entry points
+    cannot diverge. Returns the Report on success."""
+    report = validate_model(conf, batchSize=batchSize)
+    if not report.ok:
+        raise ConfigValidationError(report)
+    return report
+
+
+def zoo_corpus():
+    """Every zoo model (default construction) as (name, ZooModel) pairs —
+    the validation corpus for `--zoo`, the self-check tests, and the
+    `analysis` bench config. ENUMERATED from zoo.models (every ZooModel
+    subclass defined there), so a newly added model joins the gate
+    automatically instead of silently falling outside a frozen list."""
+    import inspect
+
+    from deeplearning4j_tpu.zoo import models as Z
+
+    classes = [
+        cls for _, cls in sorted(vars(Z).items())
+        if inspect.isclass(cls) and issubclass(cls, Z.ZooModel)
+        and cls is not Z.ZooModel and cls.__module__ == Z.__name__
+    ]
+    return [(cls.__name__, cls()) for cls in classes]

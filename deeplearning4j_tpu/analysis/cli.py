@@ -1,0 +1,172 @@
+"""CLI driver: ``python -m deeplearning4j_tpu.analysis``.
+
+Runs the static passes over a model config file, the zoo corpus, or a
+source tree:
+
+    python -m deeplearning4j_tpu.analysis --zoo
+    python -m deeplearning4j_tpu.analysis model.json
+    python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/ops
+    python -m deeplearning4j_tpu.analysis --codes
+
+Exit status: 0 = clean (warnings allowed), 1 = errors found,
+2 = usage / unreadable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json as _json
+import sys
+import time
+
+
+def _build_parser():
+    p = argparse.ArgumentParser(
+        prog="python -m deeplearning4j_tpu.analysis",
+        description="Pre-compilation static analysis: config shape/dtype "
+                    "inference, SameDiff graph validation, JAX-purity "
+                    "lint.")
+    p.add_argument("paths", nargs="*",
+                   help=".json model configs and/or .py files / source "
+                        "directories")
+    p.add_argument("--zoo", action="store_true",
+                   help="validate every zoo model configuration")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable JSON output")
+    p.add_argument("--verbose", "-v", action="store_true",
+                   help="include the per-layer param/memory table and "
+                        "suppressed findings")
+    p.add_argument("--codes", action="store_true",
+                   help="list every diagnostic code and exit")
+    p.add_argument("--batch-size", type=int, default=32,
+                   help="batch size assumed by the activation-memory "
+                        "report (default 32)")
+    return p
+
+
+def _report_to_json(name, report, wall_s=None):
+    rec = {
+        "subject": name,
+        "errors": [d.format() for d in report.errors],
+        "warnings": [d.format() for d in report.warnings],
+        "suppressed": [d.format() for d in report.suppressed],
+        "codes": report.codes(),
+    }
+    if report.layers:
+        rec["layers"] = report.layers
+        rec["total_params"] = report.totalParams()
+    if wall_s is not None:
+        rec["wall_s"] = round(wall_s, 4)
+    return rec
+
+
+def _validate_model_file(path, batch_size):
+    from deeplearning4j_tpu.analysis.shapes import validate_model
+    from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
+    from deeplearning4j_tpu.nn.conf.graph import (
+        ComputationGraphConfiguration,
+    )
+
+    with open(path, "r", encoding="utf-8") as fh:
+        text = fh.read()
+    errors = []
+    for cls in (MultiLayerConfiguration, ComputationGraphConfiguration):
+        try:
+            conf = cls.fromJson(text)
+            return validate_model(conf, batchSize=batch_size)
+        except Exception as e:
+            errors.append(f"{cls.__name__}: {e}")
+    from deeplearning4j_tpu.analysis.diagnostics import ERROR, Report
+
+    rep = Report(subject=path)
+    rep.add("SHP05", ERROR, path,
+            "not a loadable model config: " + "; ".join(errors))
+    return rep
+
+
+def run_zoo(batch_size=32):
+    """Validate the whole zoo corpus; -> [(name, Report, wall_s)]."""
+    from deeplearning4j_tpu.analysis import validate_model, zoo_corpus
+
+    out = []
+    for name, model in zoo_corpus():
+        t0 = time.perf_counter()
+        rep = validate_model(model, batchSize=batch_size)
+        out.append((name, rep, time.perf_counter() - t0))
+    return out
+
+
+def main(argv=None):
+    args = _build_parser().parse_args(argv)
+
+    if args.codes:
+        from deeplearning4j_tpu.analysis.diagnostics import ALL_CODES
+
+        for code, desc in ALL_CODES.items():
+            print(f"{code}  {desc}")
+        return 0
+
+    if not args.zoo and not args.paths:
+        _build_parser().print_usage()
+        return 2
+
+    import os
+
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        # a typo'd path must NOT pass vacuously — a CI gate wired to
+        # this command would silently stop gating. Checked before any
+        # work so the usage error is instant.
+        print("no such path(s): " + ", ".join(missing), file=sys.stderr)
+        return 2
+
+    records = []
+    had_error = False
+
+    if args.zoo:
+        for name, rep, wall in run_zoo(args.batch_size):
+            records.append((name, rep, wall))
+            had_error = had_error or not rep.ok
+
+    src_paths = []
+    for path in args.paths:
+        if path.endswith(".json"):
+            try:
+                rep = _validate_model_file(path, args.batch_size)
+            except OSError as e:
+                print(f"cannot read {path}: {e}", file=sys.stderr)
+                return 2
+            records.append((path, rep, None))
+            had_error = had_error or not rep.ok
+        else:
+            src_paths.append(path)
+    if src_paths:
+        from deeplearning4j_tpu.analysis.purity import (
+            iter_py_files, lint_paths,
+        )
+
+        if not any(True for _ in iter_py_files(src_paths)):
+            # an existing path that contributes no lintable .py file
+            # (e.g. model.jsn typo) must not pass vacuously either
+            print("no .py files under: " + ", ".join(src_paths),
+                  file=sys.stderr)
+            return 2
+        rep = lint_paths(src_paths)
+        records.append(("purity:" + ",".join(src_paths), rep, None))
+        had_error = had_error or not rep.ok
+
+    if args.as_json:
+        print(_json.dumps(
+            {"reports": [_report_to_json(n, r, w) for n, r, w in records],
+             "ok": not had_error}, indent=2))
+    else:
+        for name, rep, wall in records:
+            rep.subject = name
+            print(rep.format(verbose=args.verbose))
+            if wall is not None and args.verbose:
+                print(f"  ({wall * 1e3:.1f} ms)")
+        n_err = sum(len(r.errors) for _, r, _ in records)
+        n_warn = sum(len(r.warnings) for _, r, _ in records)
+        print(f"\n{len(records)} subject(s): {n_err} error(s), "
+              f"{n_warn} warning(s)")
+    return 1 if had_error else 0

@@ -1,0 +1,158 @@
+"""Diagnostic model shared by every static-analysis pass.
+
+Reference: the spirit of org.deeplearning4j.nn.conf's pre-execution
+config validation (InputType propagation errors) generalized into a
+collecting validator: passes append Diagnostic records instead of
+raising on the first problem, so one run reports every config mistake
+with its location and a fix hint — before a TPU pod slot is burned on
+a trace that dies inside lowered XLA ops.
+
+Diagnostic codes are stable identifiers (tests and suppressions key on
+them):
+
+shape/config   SHP01 nIn mismatch          SHP02 non-positive spatial dim
+               SHP03 format adaptation     SHP04 merge/elementwise rank
+               SHP05 layer config error    SHP06 missing nOut
+dtype          DTY01 non-TPU-native fp64   DTY02 implicit dtype promotion
+SameDiff graph GRF01 unknown op            GRF02 duplicate variable
+               GRF03 dangling variable     GRF04 cycle (use-before-def)
+               GRF05 unfed placeholder     GRF06 dead subgraph
+JAX purity     PUR01 print under trace     PUR02 implicit host sync
+               PUR03 untracked host RNG    PUR04 closed-over mutation
+               PUR05 non-hashable static arg
+"""
+
+from __future__ import annotations
+
+ERROR = "error"
+WARNING = "warning"
+
+#: every stable diagnostic code with a one-line description (the CLI's
+#: --codes listing and the docs table are generated from this)
+ALL_CODES = {
+    "SHP01": "explicit nIn disagrees with the propagated input size",
+    "SHP02": "conv/pool arithmetic yields a non-positive spatial dim",
+    "SHP03": "no preprocessor exists for the required format adaptation",
+    "SHP04": "merge/elementwise vertex inputs disagree in rank or shape",
+    "SHP05": "layer/vertex configuration error raised during inference",
+    "SHP06": "layer requires nOut but none was configured",
+    "DTY01": "fp64 dataType is emulated (slow) on TPU",
+    "DTY02": "op silently promotes mixed input dtypes",
+    "GRF01": "op name not present in the OPS registry",
+    "GRF02": "variable produced by more than one op",
+    "GRF03": "op consumes a variable that nothing defines",
+    "GRF04": "variable used before its producer (cycle)",
+    "GRF05": "placeholder required by the outputs but never fed",
+    "GRF06": "op does not contribute to any loss/output",
+    "LNT00": "file could not be linted (parse or read failure)",
+    "PUR01": "print() inside a jit-traced function",
+    "PUR02": "implicit host sync on a traced value",
+    "PUR03": "untracked host RNG inside a jit-traced function",
+    "PUR04": "mutation of closed-over state inside a jit-traced function",
+    "PUR05": "non-hashable default for a static jit argument",
+}
+
+
+class Diagnostic:
+    """One finding: code + severity + location + message (+ fix hint)."""
+
+    __slots__ = ("code", "severity", "where", "message", "hint", "suppressed")
+
+    def __init__(self, code, severity, where, message, hint=None,
+                 suppressed=False):
+        if code not in ALL_CODES:
+            raise ValueError(f"unknown diagnostic code {code!r}")
+        self.code = code
+        self.severity = severity
+        self.where = where
+        self.message = message
+        self.hint = hint
+        self.suppressed = suppressed
+
+    def format(self):
+        tag = "suppressed" if self.suppressed else self.severity
+        s = f"{self.code} [{tag}] {self.where}: {self.message}"
+        if self.hint:
+            s += f"; hint: {self.hint}"
+        return s
+
+    def __repr__(self):
+        return f"<Diagnostic {self.format()}>"
+
+
+class Report:
+    """Accumulated diagnostics from one analysis pass (or several merged).
+
+    `layers` optionally carries the per-layer parameter-count /
+    activation-memory table produced by the shape pass.
+    """
+
+    def __init__(self, subject=""):
+        self.subject = subject
+        self.diagnostics = []
+        self.layers = []   # [{index,name,type,in,out,params,activation_bytes}]
+
+    def add(self, code, severity, where, message, hint=None, suppressed=False):
+        d = Diagnostic(code, severity, where, message, hint, suppressed)
+        self.diagnostics.append(d)
+        return d
+
+    def extend(self, other: "Report"):
+        self.diagnostics.extend(other.diagnostics)
+        self.layers.extend(other.layers)
+        return self
+
+    @property
+    def errors(self):
+        return [d for d in self.diagnostics
+                if d.severity == ERROR and not d.suppressed]
+
+    @property
+    def warnings(self):
+        return [d for d in self.diagnostics
+                if d.severity == WARNING and not d.suppressed]
+
+    @property
+    def suppressed(self):
+        return [d for d in self.diagnostics if d.suppressed]
+
+    @property
+    def ok(self):
+        return not self.errors
+
+    def codes(self):
+        return sorted({d.code for d in self.diagnostics if not d.suppressed})
+
+    def totalParams(self):
+        return sum(row.get("params", 0) for row in self.layers)
+
+    def format(self, verbose=False):
+        lines = []
+        head = self.subject or "analysis"
+        lines.append(f"== {head}: {len(self.errors)} error(s), "
+                     f"{len(self.warnings)} warning(s), "
+                     f"{len(self.suppressed)} suppressed ==")
+        for d in self.diagnostics:
+            if d.suppressed and not verbose:
+                continue
+            lines.append("  " + d.format())
+        if verbose and self.layers:
+            lines.append(f"  -- {len(self.layers)} layer(s), "
+                         f"{self.totalParams():,} params --")
+            for row in self.layers:
+                lines.append(
+                    "  [{index:>3}] {name:<28} {type:<24} "
+                    "{out:<34} params={params:<12,} "
+                    "act={activation_bytes:,}B".format(**row))
+        return "\n".join(lines)
+
+
+class ConfigValidationError(ValueError):
+    """Raised by the opt-in eager check (init(validate=True)) when the
+    shape/dtype pass finds errors. Carries the full Report."""
+
+    def __init__(self, report: Report):
+        self.report = report
+        super().__init__(
+            "model configuration failed static validation:\n"
+            + report.format())

@@ -1,0 +1,564 @@
+"""JAX-purity linter: AST pass flagging impure code under jit tracing.
+
+A function traced by jax.jit/vmap/grad/lax.scan/... executes ONCE at
+trace time; Python side effects inside it silently freeze (a print fires
+once, a np.random draw becomes a compile-time constant, a mutated
+closure desynchronizes from the compiled program) and host syncs
+(float(x), x.item(), np.asarray on a tracer) either fail under jit or
+force a device round-trip. None of this is caught by the type system —
+it is exactly the class of bug a static pass catches and a TPU run
+surfaces as silent wrongness or a cryptic TracerError.
+
+Codes:
+- PUR01 print() under trace (fires once at trace time; use
+  jax.debug.print for per-step output)
+- PUR02 implicit host sync: float()/int()/bool() on a traced value,
+  .item(), numpy asarray/array on a traced value
+- PUR03 untracked host RNG: numpy.random.* / stdlib random.* under
+  trace (frozen into the compiled program; use jax.random with a
+  threaded key)
+- PUR04 mutation of closed-over state: global/nonlocal declarations,
+  self.attr writes, append/extend/update/... on closed-over objects
+- PUR05 non-hashable default for a static jit argument (jit caches on
+  static-arg hash; a list/dict/set default throws at call time)
+
+Suppression: a violation is downgraded to "suppressed" when its line
+carries a justification comment of the form
+
+    x = float(loss)  # purity-ok[PUR02]: loss is a host-side scalar here
+
+The code list may be comma-separated or `*`; the justification text
+after the colon/dash is REQUIRED — a bare tag does not suppress.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from deeplearning4j_tpu.analysis.diagnostics import ERROR, Report
+
+__all__ = ["lint_source", "lint_paths", "iter_py_files"]
+
+# transforms whose function argument executes under trace
+_TRACING_TRANSFORMS = {
+    "jit", "vmap", "pmap", "grad", "value_and_grad", "checkpoint", "remat",
+    "eval_shape", "linearize", "vjp", "jvp", "hessian", "jacfwd", "jacrev",
+    "shard_map", "xmap", "custom_vjp", "custom_jvp",
+}
+# methods that register trace-executed callables on an existing object
+# (f.defvjp(fwd, bwd), f.defjvp(jvp))
+_TRACING_REGISTRARS = {"defvjp", "defjvp", "defjvps", "def_fwd", "def_bwd"}
+# lax control flow: (callable-arg positions) per callee name
+_TRACING_HOFS = {
+    "scan": (0,), "cond": (1, 2), "while_loop": (0, 1), "fori_loop": (2,),
+    "switch": None,  # every arg after the index may be a branch
+    "map": (0,), "associative_scan": (0,), "custom_root": None,
+}
+# host-callback escapes: functions handed to these run ON HOST by design
+_CALLBACK_SINKS = {"pure_callback", "io_callback", "callback",
+                   "debug_callback"}
+
+_MUTATORS = {"append", "extend", "insert", "add", "update", "pop",
+             "popitem", "remove", "discard", "clear", "setdefault",
+             "write", "writelines"}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*purity-ok\[(?P<codes>[A-Z0-9*,\s]+)\]\s*[:—-]\s*(?P<why>\S.*)")
+
+
+class Violation:
+    __slots__ = ("path", "line", "col", "code", "message", "suppressed")
+
+    def __init__(self, path, line, col, code, message, suppressed=False):
+        self.path, self.line, self.col = path, line, col
+        self.code, self.message = code, message
+        self.suppressed = suppressed
+
+    def format(self):
+        tag = " (suppressed)" if self.suppressed else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.code} " \
+               f"{self.message}{tag}"
+
+
+def _call_name(func):
+    """Trailing name of a call target: jax.jit -> 'jit', jit -> 'jit'."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _root_name(node):
+    """Leftmost Name of an attribute/subscript chain, else None."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _attr_chain(node):
+    """Dotted parts of an attribute chain rooted at a Name:
+    np.random.randn -> ['np', 'random', 'randn']."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return None
+
+
+class _ModuleIndex(ast.NodeVisitor):
+    """First pass: import aliases, every function def, and which defs /
+    lambdas are handed to tracing transforms."""
+
+    def __init__(self):
+        self.numpy_aliases = set()
+        self.numpy_random_aliases = set()   # numpy.random bound directly
+        self.stdlib_random_aliases = set()
+        self.jax_aliases = {}  # local name -> original (from jax... import)
+        self.functools_partial = {"partial"}
+        self.defs = {}          # name -> [FunctionDef nodes]
+        self.traced = set()     # id(node) of traced def/lambda nodes
+        self.callback_fns = set()   # id(node) handed to host callbacks
+        self.static_mutable = []    # (call/def node, param name) for PUR05
+
+    # -- imports --------------------------------------------------------
+    def visit_Import(self, node):
+        for a in node.names:
+            asname = a.asname or a.name.split(".")[0]
+            if a.name == "numpy":
+                self.numpy_aliases.add(asname)
+            elif a.name == "numpy.random":
+                if a.asname:          # import numpy.random as npr
+                    self.numpy_random_aliases.add(a.asname)
+                else:                 # import numpy.random binds 'numpy'
+                    self.numpy_aliases.add("numpy")
+            elif a.name.startswith("numpy."):
+                self.numpy_aliases.add(asname)
+            if a.name == "random":
+                self.stdlib_random_aliases.add(asname)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        if node.module == "numpy":
+            for a in node.names:
+                if a.name == "random":  # from numpy import random [as r]
+                    self.numpy_random_aliases.add(a.asname or "random")
+        elif node.module and (node.module == "jax"
+                              or node.module.startswith("jax.")):
+            # from jax import jit as J / from jax.lax import scan — the
+            # local binding is a jax callable; record it so aliased
+            # transforms are caught and bare HOF names need provenance
+            for a in node.names:
+                self.jax_aliases[a.asname or a.name] = a.name
+        self.generic_visit(node)
+
+    def _resolved(self, name):
+        """Local name -> original jax name when import-aliased."""
+        return self.jax_aliases.get(name, name)
+
+    def _is_jax_hof(self, func):
+        """True for lax.scan / jax.lax.cond / aliased bare names — NOT
+        for the builtin map() or an unrelated obj.map()."""
+        name = _call_name(func)
+        if self._resolved(name) not in _TRACING_HOFS:
+            return False
+        if isinstance(func, ast.Attribute):
+            chain = _attr_chain(func) or []
+            root = self._resolved(chain[0]) if chain else ""
+            return root == "jax" or "lax" in (root,) + tuple(chain[1:-1]) \
+                or root.startswith("jax.")
+        # bare name: only when explicitly imported from a jax module
+        return name in self.jax_aliases
+
+    # -- defs -----------------------------------------------------------
+    def visit_FunctionDef(self, node):
+        self.defs.setdefault(node.name, []).append(node)
+        for dec in node.decorator_list:
+            if self._is_tracing_expr(dec):
+                self.traced.add(id(node))
+                self._check_static_defaults(dec, node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _is_tracing_expr(self, expr):
+        """@jit / @jax.jit / @J (aliased) / @partial(jax.jit, ...)."""
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            return self._resolved(_call_name(expr)) in _TRACING_TRANSFORMS
+        if isinstance(expr, ast.Call):
+            name = self._resolved(_call_name(expr.func))
+            if name in _TRACING_TRANSFORMS:
+                return True
+            if name in self.functools_partial and expr.args:
+                return self._is_tracing_expr(expr.args[0])
+        return False
+
+    # -- call sites -----------------------------------------------------
+    def visit_Call(self, node):
+        name = self._resolved(_call_name(node.func))
+        callables = []
+        if name in _TRACING_TRANSFORMS:
+            callables = node.args[:1]
+            self._note_static_call(node)
+        elif self._is_jax_hof(node.func):
+            pos = _TRACING_HOFS[name]
+            callables = list(node.args) if pos is None else \
+                [node.args[i] for i in pos if i < len(node.args)]
+        elif name in _TRACING_REGISTRARS:
+            for a in node.args:
+                self._mark(a, self.traced)
+        elif name in _CALLBACK_SINKS:
+            for a in node.args:
+                self._mark(a, self.callback_fns)
+        for c in callables:
+            self._mark(c, self.traced)
+        self.generic_visit(node)
+
+    def _mark(self, expr, into):
+        if isinstance(expr, ast.Lambda):
+            into.add(id(expr))
+        elif isinstance(expr, ast.Name):
+            # defs appearing AFTER the call site resolve in finalize()
+            into.add(("name", expr.id))
+        elif isinstance(expr, ast.Attribute):
+            # jax.jit(self._train_step): resolve by method name
+            into.add(("name", expr.attr))
+        elif isinstance(expr, ast.Call):
+            # jax.jit(partial(f, ...)) / jit(wraps(f)(g)) — best effort
+            for a in expr.args:
+                self._mark(a, into)
+
+    def _note_static_call(self, call):
+        """jax.jit(f, static_argnames=...) — pair the static names with
+        f's defaults for the PUR05 check."""
+        static = None
+        for kw in call.keywords:
+            if kw.arg in ("static_argnames", "static_argnums"):
+                static = kw
+        if static is None or not call.args:
+            return
+        target = call.args[0]
+        if isinstance(target, ast.Name):
+            self.static_mutable.append((static, target.id, call))
+
+    def _check_static_defaults(self, dec, fndef):
+        if not isinstance(dec, ast.Call):
+            return
+        for kw in dec.keywords:
+            if kw.arg in ("static_argnames", "static_argnums"):
+                self.static_mutable.append((kw, fndef.name, dec))
+
+    def finalize(self):
+        """Resolve name-based traced marks to def nodes."""
+        for item in list(self.traced):
+            if isinstance(item, tuple):
+                self.traced.discard(item)
+                for d in self.defs.get(item[1], []):
+                    self.traced.add(id(d))
+        for item in list(self.callback_fns):
+            if isinstance(item, tuple):
+                self.callback_fns.discard(item)
+                for d in self.defs.get(item[1], []):
+                    self.callback_fns.add(id(d))
+
+
+def _propagate_traced(index):
+    """Transitive closure WITHIN the module: a function called (as
+    `f(...)` or `self.f(...)`) from a traced function also executes
+    under that trace. Cross-module calls are invisible — the linter is
+    per-file by design (each module's own traced surface is checked
+    where it is defined)."""
+    id2def = {}
+    for defs in index.defs.values():
+        for d in defs:
+            id2def[id(d)] = d
+    changed = True
+    while changed:
+        changed = False
+        for did in list(index.traced):
+            d = id2def.get(did)
+            if d is None:
+                continue
+            for n in ast.walk(d):
+                if not isinstance(n, ast.Call):
+                    continue
+                callee = None
+                if isinstance(n.func, ast.Name):
+                    callee = n.func.id
+                elif isinstance(n.func, ast.Attribute) \
+                        and isinstance(n.func.value, ast.Name) \
+                        and n.func.value.id == "self":
+                    callee = n.func.attr
+                if callee is None:
+                    continue
+                for cd in index.defs.get(callee, []):
+                    if id(cd) not in index.traced \
+                            and id(cd) not in index.callback_fns:
+                        index.traced.add(id(cd))
+                        changed = True
+
+
+def _static_names(kw_node, fndef):
+    """Param names referenced by a static_argnames/static_argnums kw."""
+    val = kw_node.value
+    names = []
+    consts = []
+    for n in ast.walk(val):
+        if isinstance(n, ast.Constant):
+            consts.append(n.value)
+    params = [a.arg for a in fndef.args.args]
+    for c in consts:
+        if isinstance(c, str) and c in params:
+            names.append(c)
+        elif isinstance(c, int) and 0 <= c < len(params):
+            names.append(params[c])
+    return names
+
+
+class _TracedBodyChecker(ast.NodeVisitor):
+    """Second pass: walk ONE traced function body flagging impurities."""
+
+    def __init__(self, index, path, out):
+        self.ix = index
+        self.path = path
+        self.out = out
+        self.local_names = set()
+
+    def run(self, fn):
+        a = fn.args
+        for arg in list(a.args) + list(a.posonlyargs) + list(a.kwonlyargs):
+            self.local_names.add(arg.arg)
+        if a.vararg:
+            self.local_names.add(a.vararg.arg)
+        if a.kwarg:
+            self.local_names.add(a.kwarg.arg)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for st in body:
+            self._collect_locals(st)
+        for st in body:
+            self.visit(st)
+
+    def _collect_locals(self, node):
+        for n in ast.walk(node):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.local_names.add(n.name)
+            elif isinstance(n, ast.arg):
+                # params of nested defs/lambdas are locals of the region
+                self.local_names.add(n.arg)
+            elif isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                self.local_names.add(n.id)
+            elif isinstance(n, (ast.comprehension,)):
+                for t in ast.walk(n.target):
+                    if isinstance(t, ast.Name):
+                        self.local_names.add(t.id)
+
+    def _flag(self, node, code, msg):
+        self.out.append(Violation(self.path, node.lineno, node.col_offset,
+                                  code, msg))
+
+    def _touches_local(self, expr):
+        """True when the expression reads any name bound inside the
+        traced function — i.e. it can be a traced value. Closed-over
+        names are static Python config at trace time: float(closure)
+        is legal and common, float(local_tracer) is the bug."""
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                    and n.id in self.local_names:
+                return True
+        return False
+
+    # -- statements -----------------------------------------------------
+    def visit_Global(self, node):
+        self._flag(node, "PUR04",
+                   f"`global {', '.join(node.names)}` inside a jit-traced "
+                   "function: the write happens once at trace time, not "
+                   "per step")
+
+    def visit_Nonlocal(self, node):
+        self._flag(node, "PUR04",
+                   f"`nonlocal {', '.join(node.names)}` inside a "
+                   "jit-traced function: trace-time-only mutation")
+
+    def _check_target(self, tgt):
+        root = _root_name(tgt)
+        if isinstance(tgt, ast.Attribute) and root == "self":
+            self._flag(tgt, "PUR04",
+                       f"writes self.{tgt.attr} under trace: the object "
+                       "mutates at trace time only; return the value or "
+                       "carry it through the step's pytree")
+        elif isinstance(tgt, (ast.Attribute, ast.Subscript)) \
+                and root is not None and root not in self.local_names:
+            self._flag(tgt, "PUR04",
+                       f"mutates closed-over '{root}' under trace "
+                       "(trace-time-only side effect)")
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._check_target(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._check_target(node.target)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        # nested defs run under the same trace unless they're host
+        # callbacks by design
+        if id(node) in self.ix.callback_fns:
+            return
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    # -- calls ----------------------------------------------------------
+    def visit_Call(self, node):
+        fname = _call_name(node.func)
+        chain = _attr_chain(node.func) or []
+
+        if isinstance(node.func, ast.Name) and node.func.id == "print":
+            self._flag(node, "PUR01",
+                       "print() under jit executes once at TRACE time; "
+                       "use jax.debug.print(...) for runtime output")
+        elif isinstance(node.func, ast.Name) \
+                and node.func.id in ("float", "int", "bool") \
+                and len(node.args) == 1 \
+                and not isinstance(node.args[0], ast.Constant) \
+                and self._touches_local(node.args[0]):
+            self._flag(node, "PUR02",
+                       f"{node.func.id}(...) on a traced value forces a "
+                       "host sync (ConcretizationTypeError under jit); "
+                       "keep it as a 0-d array or hoist it out of the "
+                       "traced function")
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "item" and not node.args \
+                and self._touches_local(node.func.value):
+            self._flag(node, "PUR02",
+                       ".item() on a traced value forces a host sync")
+        elif chain and chain[0] in self.ix.numpy_random_aliases \
+                and len(chain) >= 2:
+            self._flag(node, "PUR03",
+                       f"{'.'.join(chain)} under trace is drawn ONCE at "
+                       "trace time and frozen into the program; use "
+                       "jax.random with a threaded key")
+        elif chain and chain[0] in self.ix.numpy_aliases:
+            if len(chain) >= 2 and chain[1] == "random":
+                self._flag(node, "PUR03",
+                           f"{'.'.join(chain)} under trace is drawn ONCE "
+                           "at trace time and frozen into the program; "
+                           "use jax.random with a threaded key")
+            elif chain[-1] in ("asarray", "array", "frombuffer") \
+                    and any(self._touches_local(a) for a in node.args):
+                self._flag(node, "PUR02",
+                           f"{'.'.join(chain)}(...) on a traced value "
+                           "forces a host transfer; use jnp.asarray")
+        elif chain and chain[0] in self.ix.stdlib_random_aliases \
+                and len(chain) >= 2:
+            self._flag(node, "PUR03",
+                       f"{'.'.join(chain)} under trace: host RNG frozen "
+                       "at trace time; use jax.random")
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATORS:
+            root = _root_name(node.func.value)
+            if root is not None and root not in self.local_names \
+                    and root != "self":
+                self._flag(node, "PUR04",
+                           f"{root}.{node.func.attr}(...) mutates "
+                           "closed-over state under trace (happens once "
+                           "at trace time)")
+        self.generic_visit(node)
+
+
+def _check_static_args(index, path, out):
+    """PUR05: static jit args whose function-side default is a mutable
+    (unhashable) literal."""
+    for kw, target_name, site in index.static_mutable:
+        for fndef in index.defs.get(target_name, []):
+            names = _static_names(kw, fndef)
+            args = fndef.args
+            defaults = dict(zip([a.arg for a in args.args][-len(args.defaults):]
+                                if args.defaults else [], args.defaults))
+            defaults.update({a.arg: d for a, d in
+                             zip(args.kwonlyargs, args.kw_defaults) if d})
+            for n in names:
+                d = defaults.get(n)
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                    out.append(Violation(
+                        path, d.lineno, d.col_offset, "PUR05",
+                        f"static jit argument '{n}' of {fndef.name}() "
+                        f"defaults to a {type(d).__name__.lower()} "
+                        "literal: unhashable, so the jit cache lookup "
+                        "raises at call time; use a tuple/frozenset or "
+                        "None"))
+
+
+def lint_source(source, path="<string>"):
+    """Lint one Python source string. Returns [Violation]."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Violation(path, e.lineno or 0, 0, "LNT00",
+                          f"file does not parse: {e.msg}")]
+    index = _ModuleIndex()
+    index.visit(tree)
+    index.finalize()
+    _propagate_traced(index)
+
+    out = []
+    seen_fn = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and id(node) in index.traced:
+            if id(node) in seen_fn or id(node) in index.callback_fns:
+                continue
+            seen_fn.add(id(node))
+            _TracedBodyChecker(index, path, out).run(node)
+    _check_static_args(index, path, out)
+
+    # apply per-line suppressions
+    lines = source.splitlines()
+    deduped = {}
+    for v in out:
+        line = lines[v.line - 1] if 0 < v.line <= len(lines) else ""
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            codes = {c.strip() for c in m.group("codes").split(",")}
+            if "*" in codes or v.code in codes:
+                v.suppressed = True
+        deduped.setdefault((v.line, v.col, v.code), v)
+    return sorted(deduped.values(), key=lambda v: (v.line, v.col, v.code))
+
+
+def iter_py_files(paths):
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git", "build")]
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+        elif p.endswith(".py"):
+            yield p
+
+
+def lint_paths(paths):
+    """Lint files/directories. Returns a Report (violations become
+    PUR* diagnostics; suppressed ones are carried but don't fail)."""
+    report = Report(subject="purity")
+    for path in iter_py_files(paths):
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                src = fh.read()
+        except OSError as e:
+            report.add("LNT00", ERROR, path, f"unreadable: {e}")
+            continue
+        for v in lint_source(src, path):
+            report.add(v.code, ERROR, f"{v.path}:{v.line}:{v.col}",
+                       v.message, suppressed=v.suppressed)
+    return report

@@ -424,7 +424,11 @@ class SameDiff:
                                     condBody, len(ins), "whileLoop condBody"),
                                 "loopGraph": self._record_body(
                                     loopBody, len(ins), "whileLoop loopBody"),
-                                "maxIterations": maxIterations},
+                                # coerced HERE (host side): the executor
+                                # reads it under trace, where an int() call
+                                # would be an implicit host sync (PUR02)
+                                "maxIterations": (None if maxIterations is None
+                                                  else int(maxIterations))},
                         nOut=len(ins), name=name)
 
     # aliases in jax idiom
@@ -610,6 +614,10 @@ class SameDiff:
                                    rng, len(op.outputs), "whileLoop loopBody",
                                    dynamic_rng=True)
         max_it = op.kwargs["maxIterations"]
+        if max_it is not None:
+            # static op attribute, possibly a float from an old saved
+            # graph.json — NOT a tracer
+            max_it = int(max_it)  # purity-ok[PUR02]: static op kwarg, never traced
         # the PRNG key rides in the carry so stochastic ops inside the
         # body draw fresh values EVERY iteration (a closure-captured key
         # would replay one sample N times). The carry key is folded with
@@ -643,7 +651,7 @@ class SameDiff:
                 return vs + (new[-1],), None
 
             carry, _ = jax.lax.scan(scan_body, carry0, None,
-                                    length=int(max_it))
+                                    length=max_it)
             res = carry[:-1]
         return res[0] if len(op.outputs) == 1 else res
 

@@ -140,6 +140,20 @@ def _unwrap_layer(layer):
     return layer
 
 
+def input_type_from_first_layer(layers):
+    """InputType derived from an explicit first-layer nIn when no
+    setInputType(...) was given — shared by ListBuilder.build() and the
+    static validator so the two can never diverge. None when the first
+    layer has no nIn to derive from."""
+    first = _unwrap_layer(layers[0])
+    if getattr(first, "nIn", None) is None:
+        return None
+    return InputType.feedForward(first.nIn) \
+        if not isinstance(first, (R.BaseRecurrentLayer, R.Bidirectional,
+                                  L.RnnOutputLayer)) \
+        else InputType.recurrent(first.nIn)
+
+
 def auto_preprocessor(layer, cur):
     """Auto-insert a format preprocessor for a layer given the incoming
     InputType (shared by sequential and graph shape inference)."""
@@ -228,14 +242,9 @@ class ListBuilder:
         else:
             # all nIn set explicitly: derive input type from first layer
             # (looking through wrapper layers for both nIn and format)
-            first = _unwrap_layer(self._layers[0])
-            if getattr(first, "nIn", None) is None:
+            conf.inputType = input_type_from_first_layer(self._layers)
+            if conf.inputType is None:
                 raise ValueError("Either setInputType(...) or nIn on the first layer")
-            conf.inputType = InputType.feedForward(first.nIn) \
-                if not isinstance(first, (R.BaseRecurrentLayer,
-                                          R.Bidirectional,
-                                          L.RnnOutputLayer)) \
-                else InputType.recurrent(first.nIn)
             conf.inferShapes()
         return conf
 

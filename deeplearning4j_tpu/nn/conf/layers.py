@@ -228,8 +228,23 @@ class EmbeddingSequenceLayer(FeedForwardLayer):
         super().__init__(nIn=nIn, nOut=nOut, hasBias=hasBias, **kw)
         self.inputLength = inputLength
 
+    def inferNIn(self, inputType):
+        # like EmbeddingLayer: nIn is the VOCABULARY size, not the input
+        # width — the inherited inference would silently set nIn to the
+        # index-sequence feature count (1), i.e. a one-word vocabulary
+        if self.nIn is None:
+            raise ValueError(
+                "EmbeddingSequenceLayer requires explicit nIn (vocabulary "
+                "size); it cannot be inferred from the input shape")
+
     def getOutputType(self, inputType):
-        return InputType.recurrent(self.nOut, self.inputLength)
+        # the output sequence length is the INPUT's when known; forward
+        # emits one embedding per input step regardless of inputLength,
+        # so declaring inputLength over a known differing T would lie
+        t = inputType.dims.get("timeSeriesLength")
+        if t is None:
+            t = self.inputLength
+        return InputType.recurrent(self.nOut, t)
 
     def forward(self, params, state, x, train, key, mask=None):
         idx = x.astype(jnp.int32)

@@ -67,7 +67,13 @@ class ComputationGraph:
         self._jit_loss = jax.jit(self._loss_only)
 
     # ------------------------------------------------------------------
-    def init(self):
+    def init(self, validate=False):
+        """Initialize parameters. validate=True runs the static
+        shape/dtype analyzer first (see MultiLayerNetwork.init)."""
+        if validate:
+            from deeplearning4j_tpu.analysis import validate_or_raise
+
+            validate_or_raise(self.conf)
         key = jax.random.key(self.conf.seed)
         params, states, upds, upd_states = {}, {}, {}, {}
         for i, name in enumerate(self._layer_names):

@@ -174,7 +174,16 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     # initialization
     # ------------------------------------------------------------------
-    def init(self):
+    def init(self, validate=False):
+        """Initialize parameters. validate=True runs the static
+        shape/dtype analyzer first (analysis.validate_model) and raises
+        ConfigValidationError with every finding — catching config
+        mistakes eagerly instead of at trace time, where the XLA error
+        would name a lowered op instead of the offending layer."""
+        if validate:
+            from deeplearning4j_tpu.analysis import validate_or_raise
+
+            validate_or_raise(self.conf)
         key = jax.random.key(self.conf.seed)
         params, states, upds, upd_states = [], [], [], []
         for i, layer in enumerate(self.layers):

@@ -97,13 +97,23 @@ def build_solver(algo: str, maxIterations: int = 20):
 
     algo = OptimizationAlgorithm.resolve(algo)
     if algo == OptimizationAlgorithm.LBFGS:
-        return optax.lbfgs(  # memory 10
-            linesearch=optax.scale_by_zoom_linesearch(
+        try:
+            ls = optax.scale_by_zoom_linesearch(
                 max_linesearch_steps=maxIterations,
                 # optax.lbfgs()'s own default; the fresh-unit initial
                 # step is what keeps MINIBATCH L-BFGS stable (a carried
                 # guess from another batch's curvature diverges)
-                initial_guess_strategy="one"))
+                initial_guess_strategy="one")
+        except TypeError:
+            # optax <= 0.2.3: no initial_guess_strategy kwarg, and that
+            # zoom implementation mixes f64 weak scalars into its cond
+            # state under jax_enable_x64 (branch dtype mismatch) — use
+            # the Armijo backtracking search there instead, which the
+            # CG/line-GD paths already rely on
+            ls = optax.scale_by_backtracking_linesearch(
+                max_backtracking_steps=maxIterations,
+                increase_factor=1.5, max_learning_rate=1.0)
+        return optax.lbfgs(linesearch=ls)  # memory 10
     if algo == OptimizationAlgorithm.CONJUGATE_GRADIENT:
         return optax.chain(
             _scale_by_polak_ribiere(),

@@ -34,6 +34,11 @@ def pytest_configure(config):
         "markers",
         "faults: deterministic fault-injection tests "
         "(runtime.resilience.FaultInjector)")
+    config.addinivalue_line(
+        "markers",
+        "lint: static-analysis self-checks (purity linter over the "
+        "package source + zoo config corpus); tier-1 fails on new "
+        "violations")
 
 
 @pytest.fixture(autouse=True)
