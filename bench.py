@@ -762,6 +762,61 @@ def bench_analysis():
     }
 
 
+def bench_analysis_parallel():
+    """Partition-plan analyzer wall time (deeplearning4j_tpu/analysis/
+    partitioning): the zoo corpus validated on both canonical meshes
+    (dp4xtp2 and dp2xpp4) — the pre-flight cost a `--parallel` gate
+    adds before a pod slot is claimed — plus the RetraceSentinel proof
+    that the benchmark training step compiles exactly ONCE across a
+    multi-step fit (the acceptance obligation: a retrace loop would
+    eat the TPU window in compiles)."""
+    import jax
+
+    from deeplearning4j_tpu.analysis import RetraceSentinel
+    from deeplearning4j_tpu.analysis.cli import (
+        CANONICAL_MESHES, run_zoo_parallel,
+    )
+    from deeplearning4j_tpu.data.dataset import DataSetIterator
+    from deeplearning4j_tpu.ndarray import DataType
+    from deeplearning4j_tpu.zoo import LeNet
+
+    t0 = time.perf_counter()
+    results = run_zoo_parallel(list(CANONICAL_MESHES), batch_size=32)
+    zoo_s = time.perf_counter() - t0
+    errors = {n: len(r.errors) for n, r, _ in results if r.errors}
+    per_subject = {n: round(w * 1e3, 1) for n, r, w in results}
+    warn_codes = sorted({d.code for _, r, _ in results
+                         for d in r.warnings})
+
+    # RetraceSentinel: the training step must compile exactly once
+    net = LeNet(numClasses=10, inputShape=(1, 28, 28),
+                dataType=DataType.BFLOAT16).init()
+    sentinel = RetraceSentinel(max_compiles=1).install(net, "train_step")
+    B, steps = 32, 6
+    rng = np.random.RandomState(0)
+    x = rng.randn(B * steps, 1, 28, 28).astype("float32")
+    y = np.eye(10, dtype="float32")[rng.randint(0, 10, B * steps)]
+    t0 = time.perf_counter()
+    net.fit(DataSetIterator(x, y, B))
+    fit_s = time.perf_counter() - t0
+    compiles = sentinel.compiles("train_step")
+
+    return {
+        "zoo_subjects": len(results),
+        "meshes": [dict(m) for m in CANONICAL_MESHES],
+        "zoo_wall_s": round(zoo_s, 3),
+        "zoo_ms_per_subject": per_subject,
+        "zoo_errors": errors,      # must be {} — the corpus gate
+        "zoo_warning_codes": warn_codes,
+        "train_step_compiles": compiles,   # must be 1
+        "train_steps_run": steps,
+        "fit_wall_s": round(fit_s, 3),
+        "note": ("partition-plan validation (PAR01-06) of the zoo on "
+                 "dp4xtp2 + dp2xpp4 + RetraceSentinel single-compile "
+                 "proof over a LeNet fit; host-only, no TPU"),
+    }
+
+
 # child body for _run_secondaries_subprocess (module constant so tests
 # can drive the streaming parse with a stand-in child)
 _SECONDARIES_CODE = "import bench\nbench.bench_tpu_secondaries()\n"
@@ -772,7 +827,8 @@ SECONDARY_CONFIGS = [("attention", "bench_attention"),
                      ("lstm_tbptt", "bench_lstm_tbptt"),
                      ("prefetch", "bench_prefetch"),
                      ("resilience", "bench_resilience"),
-                     ("analysis", "bench_analysis")]
+                     ("analysis", "bench_analysis"),
+                     ("analysis_parallel", "bench_analysis_parallel")]
 # attention runs FIRST: the flash-vs-fused table is the one headline
 # perf claim still never captured live (VERDICT r3 weak #1); if the
 # tunnel degrades partway through the secondaries, it must already be

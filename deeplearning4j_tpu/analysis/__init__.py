@@ -1,16 +1,22 @@
 """Pre-compilation static analysis.
 
-Three passes, one CLI (``python -m deeplearning4j_tpu.analysis``):
+Five passes, one CLI (``python -m deeplearning4j_tpu.analysis``):
 
 - shape/dtype inference over model configs (shapes.validate_model)
 - SameDiff graph validation (samediff_check.validate_samediff)
 - JAX-purity source lint (purity.lint_paths)
+- partition-plan validation: mesh/PartitionSpec sanity, collective
+  axis consistency, pipeline balance, per-chip HBM fit prediction
+  (partitioning.validate_plan, CLI ``--parallel``)
+- recompilation-hazard lint + runtime compile counter
+  (retrace.lint_retrace_paths / retrace.RetraceSentinel)
 
 See docs/ANALYSIS.md for the diagnostic catalogue and suppression
 syntax. ``MultiLayerNetwork.init(validate=True)`` /
 ``ComputationGraph.init(validate=True)`` run the shape pass eagerly and
 raise ConfigValidationError instead of deferring mistakes to trace
-time.
+time; passing ``mesh=``/``hbm_gb=`` extends the gate with the
+partition-plan passes.
 """
 
 from deeplearning4j_tpu.analysis.diagnostics import (  # noqa: F401
@@ -23,17 +29,33 @@ from deeplearning4j_tpu.analysis.samediff_check import (  # noqa: F401
 from deeplearning4j_tpu.analysis.purity import (  # noqa: F401
     lint_paths, lint_source,
 )
+from deeplearning4j_tpu.analysis.partitioning import (  # noqa: F401
+    ShardingPlan, check_collectives, validate_plan,
+)
+from deeplearning4j_tpu.analysis.retrace import (  # noqa: F401
+    RetraceError, RetraceSentinel, lint_retrace, lint_retrace_paths,
+)
 
 __all__ = ["ALL_CODES", "ConfigValidationError", "Diagnostic", "Report",
            "validate_model", "validate_or_raise", "validate_samediff",
+           "validate_plan", "ShardingPlan", "check_collectives",
+           "RetraceError", "RetraceSentinel", "lint_retrace",
+           "lint_retrace_paths",
            "lint_paths", "lint_source", "zoo_corpus"]
 
 
-def validate_or_raise(conf, batchSize=32):
+def validate_or_raise(conf, batchSize=32, mesh=None, hbm_gb=None,
+                      plan=None):
     """The eager-check contract behind init(validate=True), shared by
     MultiLayerNetwork and ComputationGraph so the two entry points
-    cannot diverge. Returns the Report on success."""
-    report = validate_model(conf, batchSize=batchSize)
+    cannot diverge. With a `mesh` the partition-plan passes run too
+    (validate_plan subsumes the shape pass). Returns the Report on
+    success."""
+    if mesh is not None:
+        report = validate_plan(conf, mesh, plan=plan, batchSize=batchSize,
+                               hbm_gb=hbm_gb)
+    else:
+        report = validate_model(conf, batchSize=batchSize)
     if not report.ok:
         raise ConfigValidationError(report)
     return report

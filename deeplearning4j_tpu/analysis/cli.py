@@ -7,6 +7,16 @@ source tree:
     python -m deeplearning4j_tpu.analysis model.json
     python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/ops
     python -m deeplearning4j_tpu.analysis --codes
+    python -m deeplearning4j_tpu.analysis --parallel --zoo
+    python -m deeplearning4j_tpu.analysis --parallel --zoo \\
+        --mesh data=4,model=2 --hbm-gb 16
+    python -m deeplearning4j_tpu.analysis --parallel my_trainer.py
+
+``--parallel`` switches model subjects to the partition-plan analyzer
+(PAR01-06: mesh/spec sanity, divisibility, collective axis
+consistency, pipeline balance, per-chip HBM fit) on every ``--mesh``
+(default: the canonical dp4xtp2 and dp2xpp4 meshes), and adds the
+recompilation-hazard lint (RTC01-03) to source paths.
 
 Exit status: 0 = clean (warnings allowed), 1 = errors found,
 2 = usage / unreadable input.
@@ -41,7 +51,25 @@ def _build_parser():
     p.add_argument("--batch-size", type=int, default=32,
                    help="batch size assumed by the activation-memory "
                         "report (default 32)")
+    p.add_argument("--parallel", action="store_true",
+                   help="run the partition-plan analyzer (PAR01-06) on "
+                        "model subjects and the retrace lint (RTC01-03) "
+                        "on source paths")
+    p.add_argument("--mesh", action="append", dest="meshes", metavar="SPEC",
+                   help="mesh for --parallel as axis=size pairs, e.g. "
+                        "'data=4,model=2'; repeatable (default: the "
+                        "canonical dp4xtp2 and dp2xpp4 meshes)")
+    p.add_argument("--hbm-gb", type=float, default=None,
+                   help="per-chip HBM budget in GB for the PAR06 fit "
+                        "prediction (no budget: the prediction is "
+                        "reported but never fails)")
     return p
+
+
+#: the meshes --parallel validates against when --mesh is not given:
+#: the two canonical 8-chip regimes the trainers target (dp4xtp2 and
+#: dp2xpp4)
+CANONICAL_MESHES = ({"data": 4, "model": 2}, {"data": 2, "pipe": 4})
 
 
 def _report_to_json(name, report, wall_s=None):
@@ -55,6 +83,8 @@ def _report_to_json(name, report, wall_s=None):
     if report.layers:
         rec["layers"] = report.layers
         rec["total_params"] = report.totalParams()
+    if getattr(report, "plan", None) is not None:
+        rec["plan"] = report.plan
     if wall_s is not None:
         rec["wall_s"] = round(wall_s, 4)
     return rec
@@ -84,6 +114,32 @@ def _validate_model_file(path, batch_size):
     return rep
 
 
+def _validate_plan_file(path, axes, batch_size, hbm_gb):
+    from deeplearning4j_tpu.analysis import validate_plan
+    from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
+    from deeplearning4j_tpu.nn.conf.graph import (
+        ComputationGraphConfiguration,
+    )
+
+    with open(path, "r", encoding="utf-8") as fh:
+        text = fh.read()
+    errors = []
+    for cls in (MultiLayerConfiguration, ComputationGraphConfiguration):
+        try:
+            conf = cls.fromJson(text)
+        except Exception as e:
+            errors.append(f"{cls.__name__}: {e}")
+            continue
+        return validate_plan(conf, axes, batchSize=batch_size,
+                             hbm_gb=hbm_gb)
+    from deeplearning4j_tpu.analysis.diagnostics import ERROR, Report
+
+    rep = Report(subject=path)
+    rep.add("SHP05", ERROR, path,
+            "not a loadable model config: " + "; ".join(errors))
+    return rep
+
+
 def run_zoo(batch_size=32):
     """Validate the whole zoo corpus; -> [(name, Report, wall_s)]."""
     from deeplearning4j_tpu.analysis import validate_model, zoo_corpus
@@ -93,6 +149,23 @@ def run_zoo(batch_size=32):
         t0 = time.perf_counter()
         rep = validate_model(model, batchSize=batch_size)
         out.append((name, rep, time.perf_counter() - t0))
+    return out
+
+
+def run_zoo_parallel(meshes, batch_size=32, hbm_gb=None):
+    """Partition-plan validation of the zoo corpus on every mesh;
+    -> [("Model@mesh", Report, wall_s)]."""
+    from deeplearning4j_tpu.analysis import validate_plan, zoo_corpus
+    from deeplearning4j_tpu.analysis.partitioning import _mesh_tag
+
+    out = []
+    for axes in meshes:
+        tag = _mesh_tag(axes)
+        for name, model in zoo_corpus():
+            t0 = time.perf_counter()
+            rep = validate_plan(model, axes, batchSize=batch_size,
+                                hbm_gb=hbm_gb)
+            out.append((f"{name}@{tag}", rep, time.perf_counter() - t0))
     return out
 
 
@@ -120,11 +193,30 @@ def main(argv=None):
         print("no such path(s): " + ", ".join(missing), file=sys.stderr)
         return 2
 
+    meshes = None
+    if args.parallel:
+        from deeplearning4j_tpu.analysis.partitioning import normalize_mesh
+
+        try:
+            meshes = ([normalize_mesh(m) for m in args.meshes]
+                      if args.meshes else list(CANONICAL_MESHES))
+        except (ValueError, TypeError) as e:
+            print(f"bad --mesh: {e}", file=sys.stderr)
+            return 2
+    elif args.meshes or args.hbm_gb is not None:
+        print("--mesh/--hbm-gb require --parallel", file=sys.stderr)
+        return 2
+
     records = []
     had_error = False
 
     if args.zoo:
-        for name, rep, wall in run_zoo(args.batch_size):
+        if args.parallel:
+            results = run_zoo_parallel(meshes, args.batch_size,
+                                       hbm_gb=args.hbm_gb)
+        else:
+            results = run_zoo(args.batch_size)
+        for name, rep, wall in results:
             records.append((name, rep, wall))
             had_error = had_error or not rep.ok
 
@@ -132,12 +224,25 @@ def main(argv=None):
     for path in args.paths:
         if path.endswith(".json"):
             try:
-                rep = _validate_model_file(path, args.batch_size)
+                if args.parallel:
+                    from deeplearning4j_tpu.analysis.partitioning import (
+                        _mesh_tag,
+                    )
+
+                    for axes in meshes:
+                        rep = _validate_plan_file(path, axes,
+                                                  args.batch_size,
+                                                  args.hbm_gb)
+                        records.append((f"{path}@{_mesh_tag(axes)}",
+                                        rep, None))
+                        had_error = had_error or not rep.ok
+                else:
+                    rep = _validate_model_file(path, args.batch_size)
+                    records.append((path, rep, None))
+                    had_error = had_error or not rep.ok
             except OSError as e:
                 print(f"cannot read {path}: {e}", file=sys.stderr)
                 return 2
-            records.append((path, rep, None))
-            had_error = had_error or not rep.ok
         else:
             src_paths.append(path)
     if src_paths:
@@ -154,6 +259,34 @@ def main(argv=None):
         rep = lint_paths(src_paths)
         records.append(("purity:" + ",".join(src_paths), rep, None))
         had_error = had_error or not rep.ok
+        if args.parallel:
+            from deeplearning4j_tpu.analysis.partitioning import (
+                check_collectives,
+            )
+            from deeplearning4j_tpu.analysis.retrace import (
+                lint_retrace_paths,
+            )
+
+            rep = lint_retrace_paths(src_paths)
+            records.append(("retrace:" + ",".join(src_paths), rep, None))
+            had_error = had_error or not rep.ok
+            # collective axes are valid when any requested mesh has them
+            axes = set()
+            for m in meshes:
+                axes |= set(m)
+            from deeplearning4j_tpu.analysis.diagnostics import Report
+
+            crep = Report(subject="collectives")
+            for f in iter_py_files(src_paths):
+                try:
+                    with open(f, "r", encoding="utf-8") as fh:
+                        crep.extend(check_collectives(fh.read(), axes,
+                                                      path=f))
+                except OSError as e:
+                    crep.add("LNT00", "error", f, f"unreadable: {e}")
+            records.append(("collectives:" + ",".join(src_paths), crep,
+                            None))
+            had_error = had_error or not crep.ok
 
     if args.as_json:
         print(_json.dumps(

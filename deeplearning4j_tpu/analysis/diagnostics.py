@@ -20,6 +20,11 @@ SameDiff graph GRF01 unknown op            GRF02 duplicate variable
 JAX purity     PUR01 print under trace     PUR02 implicit host sync
                PUR03 untracked host RNG    PUR04 closed-over mutation
                PUR05 non-hashable static arg
+partitioning   PAR01 unknown mesh axis     PAR02 spec rank mismatch
+               PAR03 indivisible shard dim PAR04 collective axis mismatch
+               PAR05 pipeline imbalance    PAR06 per-chip HBM over budget
+retracing      RTC01 varying trace-key arg RTC02 unhashable static arg
+               RTC03 shape-polymorphic feed
 """
 
 from __future__ import annotations
@@ -50,6 +55,15 @@ ALL_CODES = {
     "PUR03": "untracked host RNG inside a jit-traced function",
     "PUR04": "mutation of closed-over state inside a jit-traced function",
     "PUR05": "non-hashable default for a static jit argument",
+    "PAR01": "plan names a mesh axis that does not exist (or reuses one)",
+    "PAR02": "PartitionSpec rank exceeds the array rank",
+    "PAR03": "sharded dimension not divisible by its mesh axis size",
+    "PAR04": "collective/shard_map axis name absent from the mesh",
+    "PAR05": "pipeline stages unbalanced (or net not pipelineable)",
+    "PAR06": "predicted per-chip HBM exceeds the budget",
+    "RTC01": "jit call site keyed on a varying Python value (retrace loop)",
+    "RTC02": "unhashable/mutable value passed for a static jit argument",
+    "RTC03": "shape-polymorphic argument stream forces retracing",
 }
 
 

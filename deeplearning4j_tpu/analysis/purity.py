@@ -233,6 +233,12 @@ class _ModuleIndex(ast.NodeVisitor):
             # jax.jit(partial(f, ...)) / jit(wraps(f)(g)) — best effort
             for a in expr.args:
                 self._mark(a, into)
+        elif isinstance(expr, (ast.BoolOp, ast.IfExp)):
+            # jit(step_fn or self._train_step): every branch may trace
+            parts = expr.values if isinstance(expr, ast.BoolOp) \
+                else [expr.body, expr.orelse]
+            for p in parts:
+                self._mark(p, into)
 
     def _note_static_call(self, call):
         """jax.jit(f, static_argnames=...) — pair the static names with

@@ -1,0 +1,459 @@
+"""Recompilation-hazard detection: a static AST pass + a runtime
+compile counter.
+
+jit compiles once per distinct *trace key* — (tree structure, shapes,
+dtypes/weak-types of array args) x (values of static args). A call
+pattern that varies the key per step turns "compile once, run forever"
+into a compile **every step**, and on TPU one XLA compile costs seconds
+to minutes: a retrace loop silently eats the whole pod window. The
+failure is invisible locally (CPU compiles are fast) and cryptic in
+production (the step "randomly" stalls), which makes it exactly the
+class of bug worth catching statically.
+
+Static pass (lint_retrace / lint_retrace_paths), codes stable:
+
+- RTC01  a jit call site keyed on a varying Python value:
+         * a STATIC argument (static_argnames/static_argnums) fed a
+           value that changes per loop iteration — every distinct value
+           is a fresh executable;
+         * `jax.jit(...)` constructed INSIDE a loop — each wrapper owns
+           a fresh compilation cache, so nothing is ever reused;
+         * the same argument position passed a Python numeric literal
+           at one call site and a non-literal elsewhere — the
+           weak-type flip retraces even at identical shapes.
+- RTC02  an unhashable/mutable value (list/dict/set literal, np.array)
+         passed for a static argument — the cache lookup hashes static
+         args, so this raises at call time (the call-site twin of the
+         purity pass's PUR05 default check).
+- RTC03  a shape-polymorphic argument stream: a jitted function fed a
+         slice whose bounds vary per iteration (`x[:i]`), or an
+         `arange(n)`-style constructor of loop-varying extent — every
+         iteration presents a new shape, hence a new trace.
+
+Runtime hook (RetraceSentinel): counts ACTUAL traces per wrapped
+function — the wrapper body only executes when jit (re)traces, so the
+count is exact — and raises RetraceError past a threshold. bench.py's
+`analysis_parallel` config uses it to prove the benchmark training
+step compiles exactly once across a multi-step fit.
+
+    sentinel = RetraceSentinel(max_compiles=1)
+    step = jax.jit(sentinel.wrap(fn, "train_step"))
+    ...
+    assert sentinel.compiles("train_step") == 1
+
+or, for a network: ``sentinel.install(net)`` re-jits the net's train
+step through the same jit options the net itself uses.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from deeplearning4j_tpu.analysis.diagnostics import ERROR, Report
+from deeplearning4j_tpu.analysis.purity import (
+    _SUPPRESS_RE, _call_name, iter_py_files,
+)
+
+__all__ = ["RetraceError", "RetraceSentinel", "lint_retrace",
+           "lint_retrace_paths"]
+
+
+# ----------------------------------------------------------------------
+# runtime: the compile counter
+# ----------------------------------------------------------------------
+
+class RetraceError(RuntimeError):
+    """A traced function compiled more often than its budget allows."""
+
+
+class RetraceSentinel:
+    """Counts actual compiles (traces) of wrapped functions.
+
+    The wrapper's Python body runs ONLY while jit traces — cached
+    executions never re-enter Python — so incrementing a host-side
+    counter there counts compiles exactly. The count is intentionally a
+    trace-time side effect; that is the entire mechanism.
+    """
+
+    def __init__(self, max_compiles=1):
+        self.max_compiles = int(max_compiles)
+        self.counts = {}
+
+    def wrap(self, fn, name=None):
+        """-> fn wrapped with the compile counter; hand the result to
+        jax.jit (the sentinel does not jit for you, so every jit option
+        stays the caller's)."""
+        label = name or getattr(fn, "__name__", repr(fn))
+
+        def counted(*args, **kwargs):
+            self._record(label)
+            return fn(*args, **kwargs)
+
+        counted.__name__ = getattr(fn, "__name__", "counted")
+        return counted
+
+    def _record(self, label):
+        n = self.counts.get(label, 0) + 1
+        self.counts[label] = n
+        if n > self.max_compiles:
+            raise RetraceError(
+                f"'{label}' is being traced for the {n}th time (budget "
+                f"{self.max_compiles}): the call site varies its trace "
+                "key (shapes/dtypes/static args) per call — see "
+                "docs/ANALYSIS.md RTC01-03 for the usual causes")
+
+    def compiles(self, name):
+        return self.counts.get(name, 0)
+
+    def install(self, net, name="train_step"):
+        """Route a MultiLayerNetwork/ComputationGraph's jitted train
+        step through this sentinel (same jit options the net built its
+        own step with). Returns self."""
+        net._jit_train = net._make_jit_train(
+            self.wrap(net._train_step, name))
+        return self
+
+
+# ----------------------------------------------------------------------
+# static pass
+# ----------------------------------------------------------------------
+
+def _static_positions(call):
+    """(static_names, static_nums) requested by a jit(...) call's
+    keywords; names/ints only (non-literal specs are invisible)."""
+    names, nums = set(), set()
+    for kw in call.keywords:
+        if kw.arg not in ("static_argnames", "static_argnums"):
+            continue
+        for n in ast.walk(kw.value):
+            if isinstance(n, ast.Constant):
+                if isinstance(n.value, str):
+                    names.add(n.value)
+                elif isinstance(n.value, int):
+                    nums.add(n.value)
+    return names, nums
+
+
+class _JitIndex(ast.NodeVisitor):
+    """Find every name a jitted callable is bound to, with its static
+    argument spec: `g = jax.jit(f, static_argnames=...)`,
+    `self._jit = jax.jit(...)`, and defs decorated with jit /
+    partial(jit, ...)."""
+
+    def __init__(self):
+        self.jitted = {}       # callable name -> (static_names, static_nums)
+        self.defs = {}         # function name -> FunctionDef (for params)
+
+    def _is_jit(self, expr):
+        if isinstance(expr, ast.Call):
+            name = _call_name(expr.func)
+            if name == "jit":
+                return expr
+            if name == "partial" and expr.args \
+                    and _call_name(getattr(expr.args[0], "func",
+                                           expr.args[0])) == "jit":
+                return expr.args[0] if isinstance(expr.args[0], ast.Call) \
+                    else expr
+        return None
+
+    def visit_FunctionDef(self, node):
+        self.defs[node.name] = node
+        for dec in node.decorator_list:
+            jc = self._is_jit(dec)
+            if jc is not None:
+                self.jitted[node.name] = _static_positions(jc)
+            elif isinstance(dec, (ast.Name, ast.Attribute)) \
+                    and _call_name(dec) == "jit":
+                self.jitted[node.name] = (set(), set())
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Assign(self, node):
+        jc = self._is_jit(node.value)
+        if jc is not None:
+            spec = _static_positions(jc)
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.jitted[t.id] = spec
+                elif isinstance(t, ast.Attribute):
+                    self.jitted[t.attr] = spec
+        self.generic_visit(node)
+
+
+class _LoopVars(ast.NodeVisitor):
+    """Names that take a new value on each iteration of one loop."""
+
+    def __init__(self, loop):
+        self.names = set()
+        if isinstance(loop, ast.For):
+            for n in ast.walk(loop.target):
+                if isinstance(n, ast.Name):
+                    self.names.add(n.id)
+        for st in loop.body:
+            self.visit(st)
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    self.names.add(n.id)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        for n in ast.walk(node.target):
+            if isinstance(n, ast.Name):
+                self.names.add(n.id)
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        for n in ast.walk(node.target):
+            if isinstance(n, ast.Name):
+                self.names.add(n.id)
+        self.generic_visit(node)
+
+    visit_comprehension = visit_For
+
+
+def _reads(expr, names):
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                and n.id in names:
+            return n.id
+    return None
+
+
+def _is_mutable_literal(expr):
+    if isinstance(expr, (ast.List, ast.Dict, ast.Set)):
+        return type(expr).__name__.lower()
+    if isinstance(expr, ast.Call):
+        name = _call_name(expr.func)
+        if name in ("array", "asarray", "zeros", "ones", "arange"):
+            return f"{name}(...) array"
+        if name in ("list", "dict", "set", "bytearray"):
+            return name
+    return None
+
+
+_ARANGE_LIKE = {"arange", "linspace", "ones", "zeros", "empty", "full"}
+
+
+class _CallSiteChecker(ast.NodeVisitor):
+    """Walk the module flagging hazardous call sites of known-jitted
+    functions; loop context is threaded down so per-iteration variation
+    is recognizable."""
+
+    def __init__(self, index, path, out):
+        self.ix = index
+        self.path = path
+        self.out = out
+        self.loop_stack = []   # [set(varying names)]
+        # argument literal-ness per (fn, position) for the weak-type
+        # flip check: {(fn, pos): {"literal", "other"}}
+        self.arg_kinds = {}
+
+    def _flag(self, node, code, msg):
+        self.out.append((node.lineno, getattr(node, "col_offset", 0),
+                         code, msg))
+
+    def _varying(self):
+        s = set()
+        for v in self.loop_stack:
+            s |= v
+        return s
+
+    # -- loops ----------------------------------------------------------
+    def visit_For(self, node):
+        self.loop_stack.append(_LoopVars(node).names)
+        self.generic_visit(node)
+        self.loop_stack.pop()
+
+    visit_AsyncFor = visit_For
+
+    def visit_While(self, node):
+        self.loop_stack.append(_LoopVars(node).names)
+        self.generic_visit(node)
+        self.loop_stack.pop()
+
+    # -- call sites -----------------------------------------------------
+    def visit_Call(self, node):
+        fname = _call_name(node.func)
+
+        # jax.jit(...) constructed inside a loop: fresh cache each time
+        if fname == "jit" and self.loop_stack:
+            self._flag(node, "RTC01",
+                       "jax.jit(...) constructed inside a loop: every "
+                       "iteration builds a NEW wrapper with an empty "
+                       "compile cache, so each call recompiles; hoist "
+                       "the jit out of the loop")
+
+        spec = self.ix.jitted.get(fname)
+        if spec is not None:
+            self._check_jitted_call(node, fname, spec)
+        self.generic_visit(node)
+
+    def _param_names(self, fname):
+        d = self.ix.defs.get(fname)
+        if d is None:
+            return []
+        return [a.arg for a in d.args.args]
+
+    def _check_jitted_call(self, node, fname, spec):
+        static_names, static_nums = spec
+        params = self._param_names(fname)
+        varying = self._varying()
+
+        for pos, arg in enumerate(node.args):
+            pname = params[pos] if pos < len(params) else None
+            is_static = pos in static_nums or (pname in static_names)
+            self._check_one(node, fname, arg, pos, pname, is_static,
+                            varying)
+        for kw in node.keywords:
+            if kw.arg is None:
+                continue
+            is_static = kw.arg in static_names
+            self._check_one(node, fname, kw.value, None, kw.arg,
+                            is_static, varying)
+
+    def _check_one(self, node, fname, arg, pos, pname, is_static,
+                   varying):
+        label = pname or (f"arg {pos}" if pos is not None else "arg")
+
+        if is_static:
+            mut = _is_mutable_literal(arg)
+            if mut is not None:
+                self._flag(arg, "RTC02",
+                           f"static argument '{label}' of {fname}() is "
+                           f"passed a {mut}: static args are hashed "
+                           "for the jit cache lookup, so this raises "
+                           "TypeError at call time; pass a "
+                           "tuple/frozenset or make the arg traced")
+                return
+            v = _reads(arg, varying)
+            if v is not None:
+                self._flag(arg, "RTC01",
+                           f"static argument '{label}' of {fname}() "
+                           f"varies with loop variable '{v}': every "
+                           "distinct value compiles a NEW executable; "
+                           "make it a traced argument or hoist it out "
+                           "of the loop")
+                return
+
+        # weak-type flip: same position literal at one site, not at
+        # another (recorded across the whole module walk)
+        if pos is not None:
+            kind = "literal" if isinstance(arg, ast.Constant) \
+                and isinstance(arg.value, (int, float, complex)) \
+                and not isinstance(arg.value, bool) else "other"
+            kinds = self.arg_kinds.setdefault((fname, pos), {})
+            kinds.setdefault(kind, arg)
+            if len(kinds) == 2:
+                lit = kinds["literal"]
+                self._flag(
+                    lit if kind == "literal" else arg, "RTC01",
+                    f"argument {pos} of {fname}() is a bare Python "
+                    f"number at line {lit.lineno} but a non-literal "
+                    "elsewhere: the weak-type flip retraces even at "
+                    "identical shapes; jnp.asarray(...) the literal "
+                    "with an explicit dtype")
+                kinds["reported"] = True
+
+        # shape polymorphism: slice bounds / extent constructors that
+        # read a loop-varying name
+        v = self._poly_shape(arg, varying)
+        if v is not None and not is_static:
+            self._flag(arg, "RTC03",
+                       f"argument '{label}' of {fname}() has a shape "
+                       f"that varies with loop variable '{v}' "
+                       "(slice/arange extent): every iteration "
+                       "presents a new shape and retraces; pad to a "
+                       "fixed bucket or lift the loop into lax.scan")
+
+    def _poly_shape(self, arg, varying):
+        for n in ast.walk(arg):
+            if isinstance(n, ast.Subscript):
+                sl = n.slice
+                slices = sl.elts if isinstance(sl, ast.Tuple) else [sl]
+                for s in slices:
+                    if isinstance(s, ast.Slice):
+                        v = self._slice_width_varies(s, varying)
+                        if v is not None:
+                            return v
+            elif isinstance(n, ast.Call):
+                cname = _call_name(n.func)
+                if cname in _ARANGE_LIKE and n.args:
+                    v = _reads(n.args[0], varying)
+                    if v is not None:
+                        return v
+        return None
+
+    @staticmethod
+    def _slice_width_varies(s, varying):
+        """Loop variable that makes the slice WIDTH vary, or None.
+        `x[s : s + B]` is the standard minibatch window: both bounds
+        move but the width is fixed (the ragged tail costs ONE extra
+        compile, not one per iteration) — only width-varying slices
+        (`x[:i]`, `x[i:]`, `x[a:b]` with independent bounds) retrace
+        every step."""
+        lo, hi = s.lower, s.upper
+        v_lo = None if lo is None else _reads(lo, varying)
+        v_hi = None if hi is None else _reads(hi, varying)
+        if v_lo is None and v_hi is None:
+            return None
+        if v_lo is not None and v_hi is not None:
+            # fixed-width pattern: lower is `v`, upper is `v <op> k`
+            # (or mirrored) with the offset not itself loop-varying
+            if isinstance(lo, ast.Name) and isinstance(hi, ast.BinOp) \
+                    and isinstance(hi.left, ast.Name) \
+                    and hi.left.id == lo.id \
+                    and _reads(hi.right, varying) is None:
+                return None
+            return v_lo
+        return v_lo or v_hi
+
+
+def lint_retrace(source, path="<string>"):
+    """Static retrace-hazard lint of one source string -> Report.
+    Suppressions use the purity pass's comment syntax
+    (`# purity-ok[RTC01]: reason`)."""
+    report = Report(subject=f"retrace:{path}")
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        report.add("LNT00", ERROR, f"{path}:{e.lineno or 0}",
+                   f"file does not parse: {e.msg}")
+        return report
+    index = _JitIndex()
+    index.visit(tree)
+    out = []
+    _CallSiteChecker(index, path, out).visit(tree)
+
+    lines = source.splitlines()
+    seen = set()
+    for line, col, code, msg in sorted(out):
+        if (line, col, code) in seen:
+            continue
+        seen.add((line, col, code))
+        suppressed = False
+        text = lines[line - 1] if 0 < line <= len(lines) else ""
+        m = _SUPPRESS_RE.search(text)
+        if m:
+            codes = {c.strip() for c in m.group("codes").split(",")}
+            suppressed = "*" in codes or code in codes
+        report.add(code, ERROR, f"{path}:{line}:{col}", msg,
+                   suppressed=suppressed)
+    return report
+
+
+def lint_retrace_paths(paths):
+    """Lint files/directories for retrace hazards -> merged Report."""
+    report = Report(subject="retrace")
+    for path in iter_py_files(paths):
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                src = fh.read()
+        except OSError as e:
+            report.add("LNT00", ERROR, path, f"unreadable: {e}")
+            continue
+        report.extend(lint_retrace(src, path))
+    return report

@@ -244,10 +244,14 @@ def _check_loss_activation(report, where, layer):
             hint=f"use activation='{allowed[0]}' (or switch the loss)")
 
 
-def _check_layer(report, where, layer, cur, dataType, batchSize, index=None):
+def _check_layer(report, where, layer, cur, dataType, batchSize, index=None,
+                 key=None):
     """Validate one layer against its (already format-adapted) input
     type. Returns the layer's output InputType, or None when
-    propagation past this layer is impossible."""
+    propagation past this layer is impossible. `key` is the caller's
+    stable handle back to the layer (sequential index / graph vertex
+    name) — the partition-plan analyzer uses it to re-resolve the layer
+    object from the original config."""
     from deeplearning4j_tpu.nn.conf.builder import _unwrap_layer
     from deeplearning4j_tpu.nn.conf import layers as L
 
@@ -318,13 +322,31 @@ def _check_layer(report, where, layer, cur, dataType, batchSize, index=None):
                              batchSize, abstract)
     n_params = _param_count(abstract)
     act = out.arrayElementsPerExample() * _dtype_size(dataType) * batchSize
+    param_shapes = {}
+    if abstract is not None:
+        for pname, leaf in (abstract[0] or {}).items():
+            try:
+                param_shapes[pname] = tuple(int(d) for d in leaf.shape)
+            except (AttributeError, TypeError):
+                # nested/non-array leaves (rare wrappers): flatten
+                import jax
+
+                for j, l in enumerate(jax.tree_util.tree_leaves(leaf)):
+                    param_shapes[f"{pname}.{j}"] = tuple(
+                        int(d) for d in l.shape)
+    out_shape = _internal_shape(out, batchSize)
     report.layers.append({
         "index": index if index is not None else len(report.layers),
+        "key": key if key is not None else index,
         "name": getattr(layer, "name", None) or (where.split("(")[0].strip()),
         "type": type(layer).__name__,
         "in": _fmt_type(cur),
         "out": _fmt_type(out),
+        "out_kind": out.kind,
+        "out_shape": None if out_shape is None
+        else tuple(int(d) if d is not None else None for d in out_shape),
         "params": n_params,
+        "param_shapes": param_shapes,
         "activation_bytes": int(act),
     })
     return out
@@ -398,7 +420,7 @@ def _validate_sequential(report, layers, defaults, inputType, preprocessors,
         if cur is None:
             return
         cur = _check_layer(report, where, layer, cur, dataType, batchSize,
-                           index=i)
+                           index=i, key=i)
         if cur is None:
             return
 
@@ -605,7 +627,7 @@ def _validate_graph(report, nodes, networkInputs, networkOutputs, inputTypes,
             resolved[name] = None
             continue
         resolved[name] = _check_layer(report, where, layer, cur, dataType,
-                                      batchSize, index=li)
+                                      batchSize, index=li, key=name)
 
     for out in networkOutputs:
         if out not in nodes:

@@ -57,23 +57,32 @@ class ComputationGraph:
                     "gradient clipping.", stacklevel=2)
         else:
             self._solver = None
-        self._jit_train = jax.jit(self._train_step,
-                                  static_argnames=("use_carries",),
-                                  # optax solver states alias the param
-                                  # buffers (see MultiLayerNetwork)
-                                  donate_argnums=(0, 1, 2)
-                                  if self._solver is None else (2,))
+        self._jit_train = self._make_jit_train()
         self._jit_forward = jax.jit(self._forward_infer)
         self._jit_loss = jax.jit(self._loss_only)
 
+    def _make_jit_train(self, step_fn=None):
+        """Canonical train-step jit; see MultiLayerNetwork._make_jit_train
+        (RetraceSentinel.install re-jits a wrapped step through this)."""
+        return jax.jit(step_fn or self._train_step,
+                       static_argnames=("use_carries",),
+                       # optax solver states alias the param
+                       # buffers (see MultiLayerNetwork)
+                       donate_argnums=(0, 1, 2)
+                       if self._solver is None else (2,))
+
     # ------------------------------------------------------------------
-    def init(self, validate=False):
+    def init(self, validate=False, mesh=None, hbm_gb=None, plan=None,
+             batchSize=32):
         """Initialize parameters. validate=True runs the static
-        shape/dtype analyzer first (see MultiLayerNetwork.init)."""
-        if validate:
+        shape/dtype analyzer first; a `mesh` extends it with the
+        partition-plan passes, with `batchSize` the global batch you
+        will fit() with (see MultiLayerNetwork.init)."""
+        if validate or mesh is not None:
             from deeplearning4j_tpu.analysis import validate_or_raise
 
-            validate_or_raise(self.conf)
+            validate_or_raise(self.conf, batchSize=batchSize, mesh=mesh,
+                              hbm_gb=hbm_gb, plan=plan)
         key = jax.random.key(self.conf.seed)
         params, states, upds, upd_states = {}, {}, {}, {}
         for i, name in enumerate(self._layer_names):

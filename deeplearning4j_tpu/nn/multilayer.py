@@ -160,30 +160,49 @@ class MultiLayerNetwork:
                     "gradient clipping.", stacklevel=2)
         else:
             self._solver = None
-        self._jit_train = jax.jit(
-            self._train_step,
+        self._jit_train = self._make_jit_train()
+        self._jit_forward = jax.jit(self._forward_infer)
+        self._jit_loss = jax.jit(self._loss_only)
+
+    def _make_jit_train(self, step_fn=None):
+        """The canonical jit of the train step. Factored out so
+        instrumentation (analysis.retrace.RetraceSentinel.install) can
+        re-jit a wrapped step under the SAME options — static args and
+        donation must match or the counter would measure a different
+        program."""
+        return jax.jit(
+            step_fn or self._train_step,
             static_argnames=("use_carries",),
             # solver (optax) states alias the param buffers (L-BFGS
             # keeps previous params/updates); donating both would be
             # `f(donate(a), donate(a))` — donate states only there
             donate_argnums=(0, 1, 2) if self._solver is None else (2,),
         )
-        self._jit_forward = jax.jit(self._forward_infer)
-        self._jit_loss = jax.jit(self._loss_only)
 
     # ------------------------------------------------------------------
     # initialization
     # ------------------------------------------------------------------
-    def init(self, validate=False):
+    def init(self, validate=False, mesh=None, hbm_gb=None, plan=None,
+             batchSize=32):
         """Initialize parameters. validate=True runs the static
         shape/dtype analyzer first (analysis.validate_model) and raises
         ConfigValidationError with every finding — catching config
         mistakes eagerly instead of at trace time, where the XLA error
-        would name a lowered op instead of the offending layer."""
-        if validate:
+        would name a lowered op instead of the offending layer.
+
+        Plan-aware form: passing `mesh` (axis->size dict, Mesh, or
+        "data=4,model=2") extends the eager check with the
+        partition-plan analyzer (analysis.validate_plan): sharding-spec
+        sanity, collective axis consistency, pipeline balance and —
+        with `hbm_gb` — the per-chip HBM fit prediction, all before any
+        trace. Pass `batchSize` as the GLOBAL batch you will fit() with
+        — the PAR03 divisibility check and the PAR06 residency
+        prediction are statements about that batch, not the default."""
+        if validate or mesh is not None:
             from deeplearning4j_tpu.analysis import validate_or_raise
 
-            validate_or_raise(self.conf)
+            validate_or_raise(self.conf, batchSize=batchSize, mesh=mesh,
+                              hbm_gb=hbm_gb, plan=plan)
         key = jax.random.key(self.conf.seed)
         params, states, upds, upd_states = [], [], [], []
         for i, layer in enumerate(self.layers):

@@ -163,6 +163,36 @@ class DataParallelModel:
         }
 
 
+def layer_step_flops(param_count, out_shape, out_kind="feedforward"):
+    """Forward-pass FLOP estimate for one layer from its parameter count
+    and internal output shape (leading batch dim included).
+
+    Every parameter of a dense/conv/recurrent layer participates in one
+    multiply-accumulate per output POSITION (spatial site / time step /
+    single vector), so flops ~= 2 * params * batch * positions:
+      FF   [B, N]          -> positions = 1
+      CNN  [B, H, W, C]    -> positions = H * W
+      CNN3D[B, D, H, W, C] -> positions = D * H * W
+      RNN  [B, F, T]       -> positions = T
+    Parameterless layers (pooling, activation) cost ~0 by this model —
+    correct at the granularity the pipeline-balance report needs, where
+    matmul/conv FLOPs dominate by orders of magnitude. The backward pass
+    is a constant ~2x of this everywhere, so SKEW ratios are unaffected.
+    """
+    if not param_count or not out_shape or len(out_shape) < 2:
+        return 0
+    batch = out_shape[0] or 1
+    if out_kind == "recurrent":
+        positions = out_shape[2] if len(out_shape) > 2 and out_shape[2] else 1
+    else:
+        # trailing dim is the feature/channel width in every internal
+        # layout (FF [B,N], CNN NHWC, CNN3D NDHWC)
+        positions = 1
+        for d in out_shape[1:-1]:
+            positions *= d or 1
+    return int(2 * param_count * batch * positions)
+
+
 def resnet50_scaling(step_time_s: float = 0.0546,
                      param_count: int = 25_610_216,
                      grad_dtype_bytes: int = 2,

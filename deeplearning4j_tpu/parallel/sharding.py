@@ -23,7 +23,32 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from deeplearning4j_tpu.parallel.mesh import MODEL_AXIS
+from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+def shard_batch(arr, mesh: Mesh, batch_axis=DATA_AXIS, dim=0):
+    """Place one batch array with dim `dim` sharded over `batch_axis`.
+
+    REJECTS indivisible batches with an error naming the axis instead
+    of letting the placement silently pad (uneven GSPMD tiling pads the
+    trailing shard with garbage rows that would train): the same check
+    the partition-plan analyzer reports statically as PAR03, enforced
+    at the runtime boundary every trainer shares."""
+    if batch_axis not in mesh.shape:
+        raise ValueError(
+            f"mesh has no axis '{batch_axis}' (axes: "
+            f"{list(mesh.shape)}); build the mesh with a data-parallel "
+            "axis or pass batch_axis=")
+    width = mesh.shape[batch_axis]
+    if arr.shape[dim] % width != 0:
+        raise ValueError(
+            f"Global batch {arr.shape[dim]} not divisible by "
+            f"data-parallel mesh axis '{batch_axis}' (size {width}): "
+            "refusing to silently pad; use a batch size that is a "
+            f"multiple of {width} (PAR03)")
+    spec = [None] * arr.ndim
+    spec[dim] = batch_axis
+    return jax.device_put(arr, NamedSharding(mesh, P(*spec)))
 
 
 def spec_for_param(name: str, shape, model_axis=MODEL_AXIS, min_shard_size=2 ** 16):
@@ -41,9 +66,17 @@ def spec_for_param(name: str, shape, model_axis=MODEL_AXIS, min_shard_size=2 ** 
     return P()
 
 
-def shard_params(params, mesh: Mesh, model_axis=MODEL_AXIS, min_shard_size=2 ** 16):
+def shard_params(params, mesh: Mesh, model_axis=MODEL_AXIS,
+                 min_shard_size=2 ** 16, on_indivisible="replicate"):
     """Annotate+place a params pytree (list/dict of per-layer dicts) onto
-    the mesh with tensor-parallel shardings; returns the placed pytree."""
+    the mesh with tensor-parallel shardings; returns the placed pytree.
+
+    on_indivisible: what to do when a selected dim does not divide by
+    the model-axis size — "replicate" (default; GSPMD requires even
+    tiling, and replication is always correct) or "error" to fail
+    loudly naming the axis (the strict mode a validated plan uses)."""
+    if on_indivisible not in ("replicate", "error"):
+        raise ValueError("on_indivisible must be 'replicate' or 'error'")
 
     def place(path, leaf):
         name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
@@ -54,6 +87,11 @@ def shard_params(params, mesh: Mesh, model_axis=MODEL_AXIS, min_shard_size=2 ** 
         ok = True
         for dim, axis in zip(leaf.shape, tuple(spec) + (None,) * (leaf.ndim - len(spec))):
             if axis == model_axis and dim % width != 0:
+                if on_indivisible == "error":
+                    raise ValueError(
+                        f"param {jax.tree_util.keystr(path)} dim {dim} "
+                        f"is not divisible by mesh axis "
+                        f"'{model_axis}' (size {width}) (PAR03)")
                 ok = False
         if not ok:
             spec = P()

@@ -71,11 +71,14 @@ class ParallelWrapper:
                 "gradient_compression must be None, 'int8' or 'threshold'")
 
     # ------------------------------------------------------------------
-    def _batch_sharding(self, arr):
+    def _shard_batch(self, arr):
+        """Divisibility-checked batch placement (sharding.shard_batch:
+        rejects indivisible batches naming the axis, never pads)."""
+        from deeplearning4j_tpu.parallel.sharding import shard_batch
+
         if arr is None:
             return None
-        return NamedSharding(self.mesh, P(self.batch_axis,
-                                          *([None] * (arr.ndim - 1))))
+        return shard_batch(arr, self.mesh, batch_axis=self.batch_axis)
 
     def _place_replicated(self):
         """Move the net's params/opt/layer state onto the mesh, replicated."""
@@ -258,16 +261,10 @@ class ParallelWrapper:
         y = _unwrap(ds.getLabels())
         fmask = _unwrap(ds.getFeaturesMaskArray())
         lmask = _unwrap(ds.getLabelsMaskArray())
-        if x.shape[0] % self.mesh.shape[self.batch_axis] != 0:
-            raise ValueError(
-                f"Global batch {x.shape[0]} not divisible by data-parallel "
-                f"width {self.mesh.shape[self.batch_axis]}")
-        x = jax.device_put(x, self._batch_sharding(x))
-        y = jax.device_put(y, self._batch_sharding(y))
-        if fmask is not None:
-            fmask = jax.device_put(fmask, self._batch_sharding(fmask))
-        if lmask is not None:
-            lmask = jax.device_put(lmask, self._batch_sharding(lmask))
+        x = self._shard_batch(x)
+        y = self._shard_batch(y)
+        fmask = self._shard_batch(fmask)
+        lmask = self._shard_batch(lmask)
         if self._is_graph():
             # ComputationGraph._train_step takes an inputs dict + labels
             # list (single-input/-output graphs through this wrapper)
@@ -463,16 +460,10 @@ class ParameterAveragingTrainingMaster(ParallelWrapper):
         n = self.net
         x, y = unw(ds.getFeatures()), unw(ds.getLabels())
         fmask, lmask = unw(ds.getFeaturesMaskArray()), unw(ds.getLabelsMaskArray())
-        if x.shape[0] % self.mesh.shape[self.batch_axis] != 0:
-            raise ValueError(
-                f"Global batch {x.shape[0]} not divisible by data-parallel "
-                f"width {self.mesh.shape[self.batch_axis]}")
-        x = jax.device_put(x, self._batch_sharding(x))
-        y = jax.device_put(y, self._batch_sharding(y))
-        if fmask is not None:
-            fmask = jax.device_put(fmask, self._batch_sharding(fmask))
-        if lmask is not None:
-            lmask = jax.device_put(lmask, self._batch_sharding(lmask))
+        x = self._shard_batch(x)
+        y = self._shard_batch(y)
+        fmask = self._shard_batch(fmask)
+        lmask = self._shard_batch(lmask)
         key = jax.random.fold_in(jax.random.key(n.conf.seed ^ 0x5EED), n._iteration)
         p, u, s = self._stacked
         step = self._jit_avg if (n._iteration + 1) % self._avg_freq == 0 \

@@ -454,16 +454,11 @@ class ResilientFit:
             x = self.injector.maybe_poison(net._iteration, x)
         if self.wrapper is not None:
             w = self.wrapper
-            if x.shape[0] % w.mesh.shape[w.batch_axis] != 0:
-                raise ValueError(
-                    f"Global batch {x.shape[0]} not divisible by "
-                    f"data-parallel width {w.mesh.shape[w.batch_axis]}")
-            x = jax.device_put(x, w._batch_sharding(x))
-            y = jax.device_put(y, w._batch_sharding(y))
-            if fmask is not None:
-                fmask = jax.device_put(fmask, w._batch_sharding(fmask))
-            if lmask is not None:
-                lmask = jax.device_put(lmask, w._batch_sharding(lmask))
+            # divisibility-checked placement (rejects, never pads)
+            x = w._shard_batch(x)
+            y = w._shard_batch(y)
+            fmask = w._shard_batch(fmask)
+            lmask = w._shard_batch(lmask)
         # the exact key stream of MultiLayerNetwork._fit_batch — resumed
         # and uninterrupted runs fold the same iteration into the same
         # seed, which is what makes the trajectories bitwise-identical

@@ -4,14 +4,32 @@ VERDICT r4 weak #3: the headline diagnosis stopped at "bandwidth-bound,
 46.8 GB/step" with no table saying WHICH fusions carry those bytes or
 what the unavoidable floor is. This module supplies both:
 
-- `ledger(hlo_text)` walks the compiled module's ENTRY computation and
-  charges each instruction its output buffer plus every operand buffer
-  (resolved through a module-wide symbol table). ENTRY-level operands/
-  results are exactly the buffers that cross HBM on TPU — everything
-  inside a fusion stays in registers/VMEM — so ranking these is the
-  per-op HBM table. (Generalises the HLO-walking approach of
-  parallel/overlap.py, which reads schedule structure from the same
-  text.)
+- `ledger(hlo_text)` walks the compiled module and charges each
+  instruction the bytes it moves, following XLA's own HloCostAnalysis
+  conventions so the total reproduces
+  ``compiled.cost_analysis()["bytes accessed"]`` (validated exact to
+  <0.1% on XLA:CPU by tests/test_hbm_ledger.py):
+
+  * a plain instruction is charged its output buffer plus every operand
+    buffer (resolved through a module-wide symbol table);
+  * a TUPLE-shaped result is priced as its pointer table (8 bytes per
+    top-level element, the backend's ShapeSizeBytes convention) — the
+    leaf buffers are charged at the get-tuple-element consumers that
+    actually read them, never twice;
+  * ``call`` / ``while`` / ``conditional`` recurse into their attached
+    computations (body + condition once for a while, matching
+    HandleWhile's single-iteration convention) instead of being charged
+    at the call site;
+  * ``dynamic-slice`` / ``dynamic-update-slice`` are in-place: only the
+    slice region is charged (2x the update/output plus the scalar
+    indices), not the full aliased buffer;
+  * ``fusion`` is call-site-priced (parameters + root) with XLA's
+    utilization scaling: a fusion whose ROOT is a dynamic-update-slice
+    writes only the update region (the aliased operand reads likewise),
+    and a parameter consumed exclusively through dynamic-slice is
+    charged the slice size, not the full buffer — the in-place loop
+    patterns XLA emits for scan/select_and_scatter bodies. Everything
+    else inside a fusion stays in registers/VMEM and is free.
 
 - `train_step_floor(net, x_shape)` computes the analytic lower bound on
   HBM bytes for one training step from the MODEL, not the compiler:
@@ -20,6 +38,12 @@ what the unavoidable floor is. This module supplies both:
   conv net's forward+backward. Measured bytes / floor says how close
   XLA's lowering is to the memory roofline — "within N% of floor" is a
   result; "bandwidth-bound" alone is a stopping excuse.
+
+- `static_memory_terms(...)` is the RESIDENCY (capacity) counterpart of
+  the floor's traffic model: per-chip HBM bytes a train step must hold
+  live at its high-water mark. The partition-plan analyzer's PAR06 pass
+  (analysis/partitioning.py) builds on it to predict OOM before any
+  compile.
 
 The floor's activation model, stated so the number is auditable: every
 layer boundary activation A is (1) written by the forward, (2) read by
@@ -44,13 +68,49 @@ _DEF_RE = re.compile(
     r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*(.*?)\s([a-z][\w\-]*)\((.*)$")
 _OPERAND_RE = re.compile(r"%?([\w.\-]+)")
 
+# 'name {' / 'ENTRY name {' / '%name (params) -> result {'
+_COMP_RE = re.compile(r"^(ENTRY\s+)?%?([\w.\-]+)\s*(?:\(.*)?\{\s*$")
+
+# attached-computation attributes, parsed per key so a comma-list like
+# branch_computations={%a, %b} cannot bleed into the next attribute
+_ATTACH_RE = re.compile(
+    r"(?:calls|to_apply|condition|body|true_computation|false_computation)"
+    r"=%?([\w.\-]+)")
+_ATTACH_LIST_RE = re.compile(r"branch_computations=\{([^}]*)\}")
+
 # opcodes that don't move HBM bytes themselves (metadata / control flow
 # / aliasing views); their operands are charged where actually consumed
-_FREE_OPS = {"parameter", "tuple", "get-tuple-element", "bitcast",
+_FREE_OPS = {"parameter", "get-tuple-element", "bitcast",
              "constant", "after-all", "partition-id", "replica-id"}
+
+# opcodes charged by recursing into their attached computations
+# (HloCostAnalysis HandleCall/HandleWhile/HandleConditional)
+_SUBCOMP_OPS = {"call", "while", "conditional"}
+
+_POINTER_SIZE = 8  # bytes per tuple-table entry (CPU/TPU ShapeSizeBytes)
 
 
 _ANY_SHAPE_RE = re.compile(r"\b([a-z][a-z0-9]{0,14})\[[0-9,]*\]")
+
+
+def _tuple_arity(result_text):
+    """Top-level element count of a tuple-shaped result text like
+    '(f32[2]{0}, (s32[3]{0}, s32[]))' -> 2; 0 for non-tuple results."""
+    s = result_text.strip()
+    if not s.startswith("("):
+        return 0
+    depth = 0
+    arity = 1
+    for ch in s:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                break
+        elif ch == "," and depth == 1:
+            arity += 1
+    return arity
 
 
 def _result_bytes(result_text):
@@ -61,6 +121,11 @@ def _result_bytes(result_text):
             raise ValueError(
                 f"unknown HLO dtype {tok!r} in {result_text[:80]!r} — "
                 "add it to parallel/overlap.py _DTYPE_BITS")
+    arity = _tuple_arity(result_text)
+    if arity:
+        # tuple shape = pointer table; the element buffers are charged
+        # at the GTE consumers that read them
+        return arity * _POINTER_SIZE
     total = 0
     for dt, dims in _SHAPE_RE.findall(result_text):
         n = 1
@@ -71,56 +136,192 @@ def _result_bytes(result_text):
     return total
 
 
+def _parse_module(hlo_text):
+    """-> (sizes, computations, entry_name) where computations maps
+    name -> [(name, op, out_bytes, operand_names, attached_comps,
+    is_root)]."""
+    sizes = {}
+    comps = {}
+    cur = None
+    entry = None
+    for line in hlo_text.splitlines():
+        s = line.strip()
+        m = _DEF_RE.match(line)
+        if m is None:
+            # not an instruction: computation header or closing brace
+            cm = _COMP_RE.match(s)
+            if cm:
+                cur = cm.group(2)
+                comps[cur] = []
+                if cm.group(1):
+                    entry = cur
+            elif s == "}":
+                cur = None
+            continue
+        name, result, op, rest = m.groups()
+        sizes[name] = _result_bytes(result)
+        # operands = instruction names before the first metadata key;
+        # stop there to avoid charging called-computation names
+        arg_text = rest.split("), ")[0] if "), " in rest else rest
+        operands = _OPERAND_RE.findall(arg_text)
+        attached = _ATTACH_RE.findall(rest)
+        for lst in _ATTACH_LIST_RE.findall(rest):
+            attached.extend(t.strip().lstrip("%")
+                            for t in lst.split(",") if t.strip())
+        if cur is not None:
+            comps[cur].append((name, op, sizes[name], operands, attached,
+                               s.startswith("ROOT ")))
+    return sizes, comps, entry
+
+
+def _fusion_bytes(fname, callsite_operands, out_bytes, sizes, comps):
+    """(bytes, out, in) of one fusion call site with XLA's utilization
+    scaling: an in-place DUS root writes only the update region, and a
+    parameter consumed exclusively via dynamic-slice is charged the
+    slice size (HloCostAnalysis fusion handling). Falls back to the
+    plain parameters+root charge when the fused computation is
+    unavailable."""
+    insts = comps.get(fname)
+    known = [t for t in callsite_operands if t in sizes]
+    if not insts:
+        seen, in_bytes = set(), 0
+        for t in known:
+            if t not in seen:
+                seen.add(t)
+                in_bytes += sizes[t]
+        return out_bytes + in_bytes, out_bytes, in_bytes
+
+    param_of = {}     # inner parameter name -> callsite operand name
+    consumers = {}    # inner name -> [(op, operands)]
+    root = None
+    for name, op, _, operands, _, is_root in insts:
+        if op == "parameter":
+            idx = next((int(t) for t in operands if t.isdigit()), None)
+            if idx is not None and idx < len(known):
+                param_of[name] = known[idx]
+        else:
+            for t in operands:
+                consumers.setdefault(t, []).append((op, operands))
+        if is_root:
+            root = (name, op, operands)
+    if root is None and insts:
+        root = (insts[-1][0], insts[-1][1], insts[-1][3])
+
+    dus_aliased = None   # inner name feeding the in-place DUS operand 0
+    out_eff = out_bytes
+    if root is not None and root[1] == "dynamic-update-slice":
+        r_ops = [t for t in root[2] if t in sizes]
+        if len(r_ops) >= 2:
+            out_eff = sizes[r_ops[1]]    # update region only
+            dus_aliased = r_ops[0]
+
+    def data_operand(operands):
+        """First operand that names an instruction (the token list also
+        carries dtype/dim text, which never resolves in `sizes`)."""
+        return next((t for t in operands if t in sizes), None)
+
+    in_bytes = 0
+    for pname, site_name in param_of.items():
+        uses = consumers.get(pname, [])
+        if pname == dus_aliased:
+            in_bytes += out_eff          # aliased: reads the update region
+        elif uses and all(op == "dynamic-slice"
+                          and data_operand(ops) == pname
+                          for op, ops in uses):
+            # sliced access only: charge each slice's output, not the
+            # full buffer
+            in_bytes += sum(b for _n, o, b, ops2, _a, _r in insts
+                            if o == "dynamic-slice"
+                            and data_operand(ops2) == pname)
+        else:
+            in_bytes += sizes[site_name]
+    return out_eff + in_bytes, out_eff, in_bytes
+
+
+def _instruction_bytes(op, out_bytes, operands, sizes):
+    """(bytes, out, in) for one non-recursive instruction, following the
+    HloCostAnalysis special cases for in-place slicing ops."""
+    known = [t for t in operands if t in sizes]
+    if op == "dynamic-update-slice":
+        # operand 0 aliases the output: only the update region moves
+        upd = sizes[known[1]] if len(known) > 1 else 0
+        idx = sum(sizes[t] for t in known[2:])
+        return 2 * upd + idx, upd, upd + idx
+    if op == "dynamic-slice":
+        idx = sum(sizes[t] for t in known[1:])
+        return 2 * out_bytes + idx, out_bytes, out_bytes + idx
+    if op == "tuple":
+        # gathers pointers only; element buffers charged at consumers
+        return out_bytes, out_bytes, 0
+    in_bytes = 0
+    seen = set()
+    for t in known:
+        if t not in seen:
+            seen.add(t)
+            in_bytes += sizes[t]
+    return out_bytes + in_bytes, out_bytes, in_bytes
+
+
 def ledger(hlo_text, top=15):
     """Rank ENTRY instructions by HBM bytes touched.
 
     Returns {"total_bytes", "by_opcode": {op: bytes}, "top": [
     {"name", "op", "bytes", "out_bytes", "in_bytes"}, ...]}.
+    by_opcode attributes bytes to the opcode that actually moves them —
+    instructions inside call/while/conditional bodies count under their
+    own opcodes, not under the call site's.
     """
-    # symbol table over the WHOLE module: entry operands can reference
-    # computations' results only via entry-local names, but building it
-    # globally is harmless and keeps the parse single-pass
-    sizes = {}
-    defs = []
-    in_entry = False
-    for line in hlo_text.splitlines():
-        s = line.strip()
-        if s.startswith("ENTRY "):
-            in_entry = True
-            continue
-        if in_entry and s == "}":
-            in_entry = False
-            continue
-        m = _DEF_RE.match(line)
-        if not m:
-            continue
-        name, result, op, rest = m.groups()
-        nbytes = _result_bytes(result)
-        sizes[name] = nbytes
-        if in_entry:
-            defs.append((name, op, nbytes, rest))
+    sizes, comps, entry = _parse_module(hlo_text)
+    if entry is None:
+        # single anonymous/first computation (inline test modules)
+        entry = next(iter(comps)) if comps else None
+
+    by_op = {}
+    visiting = set()
+
+    def inst_bytes(op, out_bytes, operands, attached):
+        if op == "fusion" and attached:
+            return _fusion_bytes(attached[0], operands, out_bytes, sizes,
+                                 comps)
+        return _instruction_bytes(op, out_bytes, operands, sizes)
+
+    def comp_cost(cname):
+        """Total bytes of one computation, recursing through
+        call/while/conditional (processed per call site, as
+        HloCostAnalysis does); free ops and fusion interiors are never
+        charged."""
+        if cname in visiting or cname not in comps:
+            return 0
+        visiting.add(cname)
+        total = 0
+        for name, op, out_bytes, operands, attached, _root in comps[cname]:
+            if op in _FREE_OPS:
+                continue
+            if op in _SUBCOMP_OPS:
+                total += sum(comp_cost(a) for a in attached)
+                continue
+            nbytes, _, _ = inst_bytes(op, out_bytes, operands, attached)
+            total += nbytes
+            by_op[op] = by_op.get(op, 0) + nbytes
+        visiting.discard(cname)
+        return total
 
     rows = []
-    by_op = {}
     total = 0
-    for name, op, out_bytes, rest in defs:
+    for name, op, out_bytes, operands, attached, _root in comps.get(entry, []):
         if op in _FREE_OPS:
             continue
-        # operands = known instruction names referenced before control
-        # metadata; stop at the first metadata key to avoid charging
-        # called-computation names
-        arg_text = rest.split("), ")[0] if "), " in rest else rest
-        in_bytes = 0
-        seen = set()
-        for tok in _OPERAND_RE.findall(arg_text):
-            if tok in sizes and tok not in seen:
-                seen.add(tok)
-                in_bytes += sizes[tok]
-        nbytes = out_bytes + in_bytes
+        if op in _SUBCOMP_OPS:
+            sub = sum(comp_cost(a) for a in attached)
+            total += sub
+            rows.append({"name": name, "op": op, "bytes": sub,
+                         "out_bytes": 0, "in_bytes": sub})
+            continue
+        nbytes, ob, ib = inst_bytes(op, out_bytes, operands, attached)
         total += nbytes
         by_op[op] = by_op.get(op, 0) + nbytes
         rows.append({"name": name, "op": op, "bytes": nbytes,
-                     "out_bytes": out_bytes, "in_bytes": in_bytes})
+                     "out_bytes": ob, "in_bytes": ib})
     rows.sort(key=lambda r: -r["bytes"])
     return {"total_bytes": total,
             "by_opcode": dict(sorted(by_op.items(), key=lambda kv: -kv[1])),
@@ -225,6 +426,46 @@ def train_step_floor(net, x_shape, optimizer_slots=1):
     }
     return {"floor_bytes": int(sum(terms.values())), "terms": terms,
             "param_count": P, "boundary_activation_elems": A}
+
+
+# ---------------------------------------------------------------------
+# static residency (capacity) model — the PAR06 building block
+# ---------------------------------------------------------------------
+
+def static_memory_terms(param_elems, opt_state_elems, boundary_act_bytes,
+                        compute_itemsize, param_itemsize, input_bytes=0,
+                        grad_itemsize=None):
+    """Per-chip HBM RESIDENCY at the train step's high-water mark,
+    computed from already-placed (per-chip) element counts — the caller
+    (analysis/partitioning.py) applies the sharding plan's division
+    first. This is capacity, not traffic: what must fit, vs what the
+    floor says must move.
+
+      params:      fp32 master copies
+      grads:       one gradient buffer per param (fp32 — the updaters
+                   consume fp32 grads)
+      optimizer:   the updater's state leaves (exact count, not slots x
+                   params — Sgd holds nothing, Adam holds 2x)
+      cast copy:   a compute-dtype copy of the params, only when the
+                   compute dtype differs from the param dtype
+      activations: every conv/dense/pool boundary buffer simultaneously
+                   live at the start of the backward pass (the
+                   high-water mark without rematerialisation)
+      input:       the device-resident batch
+    """
+    gb = param_itemsize if grad_itemsize is None else grad_itemsize
+    terms = {
+        "params_bytes": int(param_elems * param_itemsize),
+        "grads_bytes": int(param_elems * gb),
+        "optimizer_state_bytes": int(opt_state_elems * param_itemsize),
+        "params_cast_copy_bytes": (int(param_elems * compute_itemsize)
+                                   if compute_itemsize != param_itemsize
+                                   else 0),
+        "activations_bytes": int(boundary_act_bytes),
+        "input_bytes": int(input_bytes),
+    }
+    terms["total_bytes"] = int(sum(terms.values()))
+    return terms
 
 
 def _tree_leaves(t):

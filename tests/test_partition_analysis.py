@@ -1,0 +1,544 @@
+"""Partition-plan analyzer + retrace-hazard tests
+(deeplearning4j_tpu/analysis/{partitioning,retrace}.py).
+
+Matrix: every PAR01-06 / RTC01-03 code triggered on a deliberately
+broken plan/source (bad axis name, rank mismatch, indivisible dim,
+unbalanced pipeline, over-budget HBM, retrace loop), the clean-pass
+gate over zoo models on the canonical dp4xtp2 and dp2xpp4 meshes, the
+runtime pieces (shard_batch rejection, RetraceSentinel single-compile
+proof, plan-aware init), and the CLI exit-code contract.
+"""
+
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.analysis import (
+    ConfigValidationError, RetraceError, RetraceSentinel,
+    ShardingPlan, check_collectives, lint_retrace, validate_plan,
+)
+from deeplearning4j_tpu.analysis.partitioning import (
+    normalize_mesh, pipeline_balance,
+)
+from deeplearning4j_tpu.nn import (
+    DenseLayer, InputType, MultiLayerNetwork, NeuralNetConfiguration,
+    OutputLayer,
+)
+
+DP4TP2 = {"data": 4, "model": 2}
+DP2PP4 = {"data": 2, "pipe": 4}
+
+
+def _codes(report):
+    return set(report.codes())
+
+
+def _mlp(widths=(32, 10), nIn=16):
+    b = NeuralNetConfiguration.Builder().list()
+    for w in widths[:-1]:
+        b.layer(DenseLayer(nOut=w, activation="relu"))
+    b.layer(OutputLayer(nOut=widths[-1], activation="softmax"))
+    return b.setInputType(InputType.feedForward(nIn)).build()
+
+
+def _stack(n_body, width=64, nIn=16, nOut=4):
+    """Pipelineable MLP: one shape-changing entry Dense + n_body
+    identical Dense(width->width) + output head."""
+    b = (NeuralNetConfiguration.Builder().list()
+         .layer(DenseLayer(nOut=width, activation="relu")))
+    for _ in range(n_body):
+        b.layer(DenseLayer(nOut=width, activation="relu"))
+    b.layer(OutputLayer(nOut=nOut, activation="softmax"))
+    return b.setInputType(InputType.feedForward(nIn)).build()
+
+
+# ======================================================================
+# mesh / plan basics
+# ======================================================================
+
+class TestMeshForms:
+    def test_normalize_dict_string_mesh(self):
+        assert normalize_mesh({"data": 4}) == {"data": 4}
+        assert normalize_mesh("data=4, model=2") == {"data": 4, "model": 2}
+        from deeplearning4j_tpu.parallel.mesh import build_mesh
+
+        m = build_mesh({"data": 4, "model": 2})
+        assert normalize_mesh(m) == {"data": 4, "model": 2}
+
+    def test_bad_string_mesh_raises(self):
+        with pytest.raises(ValueError, match="axis=size"):
+            normalize_mesh("data:4")
+
+    def test_nonpositive_axis_is_par01(self):
+        rep = validate_plan(_mlp(), {"data": 0})
+        assert "PAR01" in _codes(rep), rep.format()
+
+    def test_too_many_devices_is_par01(self):
+        rep = validate_plan(_mlp(), {"data": 64}, devices=8)
+        assert "PAR01" in _codes(rep), rep.format()
+
+
+class TestSpecChecks:
+    def test_par01_unknown_axis_in_plan(self):
+        rep = validate_plan(_mlp(), DP4TP2,
+                            plan={"model_axis": "tensor"}, batchSize=32)
+        assert "PAR01" in _codes(rep), rep.format()
+        assert any("tensor" in e.message for e in rep.errors)
+
+    def test_par01_unknown_axis_in_param_spec(self):
+        plan = ShardingPlan(param_specs={"0.W": (None, "ghost")})
+        rep = validate_plan(_mlp(), DP4TP2, plan=plan)
+        assert "PAR01" in _codes(rep), rep.format()
+
+    def test_par01_checked_on_every_layer_under_pipeline_placement(self):
+        # a bogus explicit spec on a layer the pipeline placement does
+        # NOT put on the heaviest stage must still be validated — spec
+        # checking is decoupled from the residency walk
+        conf = _stack(n_body=8)
+        last = len(conf.layers) - 1  # output head (epilogue)
+        plan = ShardingPlan(param_specs={f"{last}.W": ("bogus_axis",)})
+        rep = validate_plan(conf, DP2PP4, plan=plan)
+        assert "PAR01" in _codes(rep), rep.format()
+
+    def test_par01_axis_used_twice_in_spec(self):
+        plan = ShardingPlan(param_specs={"0.W": ("model", "model")})
+        rep = validate_plan(_mlp(), DP4TP2, plan=plan)
+        assert "PAR01" in _codes(rep), rep.format()
+
+    def test_par02_spec_rank_exceeds_array_rank(self):
+        plan = ShardingPlan(param_specs={"0.W": (None, None, "model")})
+        rep = validate_plan(_mlp(), DP4TP2, plan=plan)
+        assert "PAR02" in _codes(rep), rep.format()
+        assert any("rank" in e.message for e in rep.errors)
+
+    def test_par03_explicit_indivisible_is_error(self):
+        # W of layer 0 is (16, 33): 33 % 2 != 0 over "model"
+        conf = _mlp(widths=(33, 10))
+        plan = ShardingPlan(param_specs={"0.W": (None, "model")})
+        rep = validate_plan(conf, DP4TP2, plan=plan)
+        bad = [e for e in rep.errors if e.code == "PAR03"]
+        assert bad and "'model'" in bad[0].message.replace('"', "'"), \
+            rep.format()
+
+    def test_par03_default_indivisible_is_warning(self):
+        # big enough to pass min_shard_size, odd width -> the default
+        # Megatron spec would shard 513 2-ways; runtime replicates
+        conf = _mlp(widths=(513, 10), nIn=256)
+        rep = validate_plan(conf, DP4TP2)
+        assert rep.ok, rep.format()
+        assert any(w.code == "PAR03" and "REPLICATE" in w.message
+                   for w in rep.warnings), rep.format()
+
+    def test_par03_batch_not_divisible(self):
+        rep = validate_plan(_mlp(), DP4TP2, batchSize=30)
+        assert any(e.code == "PAR03" and "'data'" in e.message
+                   for e in rep.errors), rep.format()
+
+    def test_dp_only_mesh_is_clean(self):
+        rep = validate_plan(_mlp(), {"data": 8}, batchSize=32)
+        assert rep.ok and not rep.warnings, rep.format()
+
+
+# ======================================================================
+# PAR04 — collective axis consistency
+# ======================================================================
+
+class TestCollectives:
+    def test_bad_literal_axis_flagged(self):
+        src = textwrap.dedent('''
+            import jax
+            from jax import lax
+
+            def step(x):
+                return lax.psum(x, "batch")
+        ''')
+        rep = check_collectives(src, {"data", "model"}, path="t.py")
+        assert "PAR04" in _codes(rep), rep.format()
+        assert any("batch" in e.message for e in rep.errors)
+
+    def test_canonical_constant_resolves(self):
+        src = textwrap.dedent('''
+            from jax import lax
+            from deeplearning4j_tpu.parallel.mesh import DATA_AXIS
+
+            def step(x):
+                return lax.pmean(x, DATA_AXIS)
+        ''')
+        rep = check_collectives(src, {"data"}, path="t.py")
+        assert rep.ok, rep.format()
+        rep2 = check_collectives(src, {"replica"}, path="t.py")
+        assert "PAR04" in _codes(rep2), rep2.format()
+
+    def test_partition_spec_axis_checked(self):
+        src = 'spec = P(None, "tensor")\n'
+        rep = check_collectives(src, {"data", "model"}, path="t.py")
+        assert "PAR04" in _codes(rep), rep.format()
+
+    def test_default_axis_param_warns_not_errors(self):
+        src = textwrap.dedent('''
+            def fit(net, batch_axis="replica"):
+                return net
+        ''')
+        rep = check_collectives(src, {"data"}, path="t.py")
+        assert rep.ok  # warning, not error
+        assert any(w.code == "PAR04" for w in rep.warnings), rep.format()
+
+    def test_repo_trainer_paths_clean_on_canonical_meshes(self):
+        for mesh in (DP4TP2, DP2PP4):
+            rep = validate_plan(_mlp(), mesh)
+            assert not [e for e in rep.errors if e.code == "PAR04"], \
+                rep.format()
+
+
+# ======================================================================
+# PAR05 — pipeline balance
+# ======================================================================
+
+class TestPipelineBalance:
+    def test_balanced_stack_reports_no_skew(self):
+        conf = _stack(n_body=8)
+        rep = validate_plan(conf, DP2PP4, batchSize=32)
+        assert rep.ok, rep.format()
+        bal = rep.plan["balance"]
+        assert bal is not None and bal["n_stages"] == 4
+        assert not [w for w in rep.warnings if w.code == "PAR05"], \
+            rep.format()
+
+    def test_unbalanced_prologue_warns(self):
+        # a fat shape-changing entry layer rides in stage 0's effective
+        # load; body layers are tiny -> skew >> 1.5
+        conf = _stack(n_body=4, width=8, nIn=4096)
+        rep = validate_plan(conf, DP2PP4, batchSize=32)
+        assert rep.ok, rep.format()
+        skewed = [w for w in rep.warnings
+                  if w.code == "PAR05" and "skew" in w.message]
+        assert skewed, rep.format()
+
+    def test_not_pipelineable_warns(self):
+        rep = validate_plan(_mlp(widths=(32, 10)), DP2PP4)
+        assert rep.ok, rep.format()
+        assert any(w.code == "PAR05" for w in rep.warnings), rep.format()
+
+    def test_balance_numbers_match_partition(self):
+        conf = _stack(n_body=4, width=32, nIn=16)
+        from deeplearning4j_tpu.analysis import validate_model
+
+        rows = validate_model(conf, batchSize=8).layers
+        bal = pipeline_balance(conf, rows, 2, batchSize=8)
+        # 4 identical body layers over 2 stages, 2 each; W 32x32 + b
+        assert bal["layers_per_stage"] == 2
+        assert bal["stage_params"] == [2 * (32 * 32 + 32)] * 2
+        assert bal["prologue"]["params"] == 16 * 32 + 32
+        assert bal["epilogue"]["params"] == 32 * 4 + 4
+
+
+# ======================================================================
+# PAR06 — per-chip HBM fit
+# ======================================================================
+
+class TestHbmFit:
+    def test_over_budget_is_error(self):
+        conf = _mlp(widths=(4096, 10), nIn=4096)
+        rep = validate_plan(conf, {"data": 2}, batchSize=32,
+                            hbm_gb=0.0001)
+        bad = [e for e in rep.errors if e.code == "PAR06"]
+        assert bad, rep.format()
+        assert "exceeds" in bad[0].message
+
+    def test_no_budget_reports_but_never_fails(self):
+        rep = validate_plan(_mlp(), DP4TP2, batchSize=32)
+        assert "PAR06" not in _codes(rep)
+        mem = rep.plan["memory"]
+        assert mem["total_bytes"] > 0
+        assert mem["total_bytes"] == sum(
+            v for k, v in mem.items()
+            if k.endswith("_bytes") and k != "total_bytes")
+
+    def test_near_budget_warns(self):
+        rep = validate_plan(_mlp(), {"data": 2}, batchSize=32)
+        total = rep.plan["memory"]["total_bytes"]
+        rep2 = validate_plan(_mlp(), {"data": 2}, batchSize=32,
+                             hbm_gb=total * 1.05 / 1e9)
+        assert rep2.ok, rep2.format()
+        assert any(w.code == "PAR06" for w in rep2.warnings), rep2.format()
+
+    def test_tensor_sharding_shrinks_per_chip_params(self):
+        conf = _mlp(widths=(4096, 10), nIn=4096)
+        dp = validate_plan(conf, {"data": 2}).plan["memory"]
+        tp = validate_plan(conf, {"data": 1, "model": 2}).plan["memory"]
+        assert tp["params_bytes"] < dp["params_bytes"]
+
+    def test_updater_state_counted_exactly(self):
+        from deeplearning4j_tpu.nn import Adam
+
+        conf = (NeuralNetConfiguration.Builder().updater(Adam(1e-3)).list()
+                .layer(DenseLayer(nOut=32))
+                .layer(OutputLayer(nOut=10, activation="softmax"))
+                .setInputType(InputType.feedForward(16))
+                .build())
+        mem = validate_plan(conf, {"data": 1}).plan["memory"]
+        # Adam: m+v = 2x params, fp32
+        assert mem["optimizer_state_bytes"] == 2 * mem["params_bytes"]
+
+
+# ======================================================================
+# RTC01-03 — retrace hazards (static) + RetraceSentinel (runtime)
+# ======================================================================
+
+_RETRACE_FIXTURE = textwrap.dedent('''
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(params, x):
+        return params + x
+
+    def g(x, n):
+        return x * n
+
+    gj = jax.jit(g, static_argnames=("n",))
+
+    def train(params, batches):
+        for i, b in enumerate(batches):
+            gj(b, n=i)                  # RTC01: static arg varies
+            h = jax.jit(lambda z: z)    # RTC01: jit built in loop
+            step(params, b[:i])         # RTC03: width-varying slice
+            step(params, jnp.arange(i)) # RTC03: varying extent
+        return params
+
+    gj(jnp.ones(3), n=[1, 2])           # RTC02: unhashable static
+''')
+
+
+class TestRetraceLint:
+    def test_every_code_fires(self):
+        rep = lint_retrace(_RETRACE_FIXTURE, "fixture.py")
+        assert {"RTC01", "RTC02", "RTC03"} <= _codes(rep), rep.format()
+
+    def test_weak_type_flip_across_sites(self):
+        src = textwrap.dedent('''
+            import jax
+
+            @jax.jit
+            def step(p, lr):
+                return p * lr
+
+            def run(p, lr):
+                step(p, 0.5)
+                step(p, lr)
+        ''')
+        rep = lint_retrace(src, "t.py")
+        assert any(d.code == "RTC01" and "weak-type" in d.message
+                   for d in rep.diagnostics), rep.format()
+
+    def test_fixed_width_minibatch_window_not_flagged(self):
+        src = textwrap.dedent('''
+            import jax
+            f = jax.jit(lambda x: x.sum())
+
+            def run(x, B):
+                for s in range(0, 1024, B):
+                    f(x[s:s + B])
+        ''')
+        assert lint_retrace(src, "t.py").diagnostics == [], \
+            lint_retrace(src, "t.py").format()
+
+    def test_suppression(self):
+        src = textwrap.dedent('''
+            import jax
+            f = jax.jit(lambda x: x)
+
+            def run(x):
+                for i in range(4):
+                    f(x[:i])  # purity-ok[RTC03]: 4 shapes total, bounded
+        ''')
+        rep = lint_retrace(src, "t.py")
+        assert not rep.errors and rep.suppressed, rep.format()
+
+    def test_package_source_is_retrace_clean(self):
+        import os
+
+        from deeplearning4j_tpu.analysis import lint_retrace_paths
+
+        pkg = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))) + \
+            "/deeplearning4j_tpu"
+        rep = lint_retrace_paths([pkg])
+        assert rep.ok, rep.format()
+
+
+class TestRetraceSentinel:
+    def test_counts_traces_exactly(self):
+        s = RetraceSentinel(max_compiles=2)
+        f = jax.jit(s.wrap(lambda x: x * 2, "f"))
+        for _ in range(5):
+            f(jnp.ones(3))
+        assert s.compiles("f") == 1
+        f(jnp.ones(5))  # second shape -> second trace, within budget
+        assert s.compiles("f") == 2
+
+    def test_raises_past_budget(self):
+        s = RetraceSentinel(max_compiles=1)
+        f = jax.jit(s.wrap(lambda x: x + 1, "g"))
+        f(jnp.ones(2))
+        with pytest.raises(RetraceError, match="traced for the 2"):
+            f(jnp.ones(3))
+
+    def test_install_proves_single_compile_fit(self):
+        from deeplearning4j_tpu.data.dataset import DataSetIterator
+
+        net = MultiLayerNetwork(_mlp(widths=(16, 4), nIn=8)).init()
+        sentinel = RetraceSentinel(max_compiles=1).install(net, "step")
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 8).astype("float32")
+        y = np.eye(4, dtype="float32")[rng.randint(0, 4, 64)]
+        net.fit(DataSetIterator(x, y, 16), epochs=2)
+        assert sentinel.compiles("step") == 1
+        assert net._score == net._score  # trained, finite-ish
+
+
+# ======================================================================
+# runtime rejection (the PAR03 check at the trainer boundary)
+# ======================================================================
+
+class TestShardBatchRejection:
+    def test_shard_batch_rejects_naming_axis(self):
+        from deeplearning4j_tpu.parallel.mesh import build_mesh
+        from deeplearning4j_tpu.parallel.sharding import shard_batch
+
+        mesh = build_mesh({"data": 8})
+        with pytest.raises(ValueError) as ei:
+            shard_batch(np.ones((13, 4), "float32"), mesh)
+        assert "divisible" in str(ei.value) and "'data'" in str(ei.value)
+
+    def test_shard_batch_rejects_missing_axis(self):
+        from deeplearning4j_tpu.parallel.mesh import build_mesh
+        from deeplearning4j_tpu.parallel.sharding import shard_batch
+
+        mesh = build_mesh({"model": 8})
+        with pytest.raises(ValueError, match="no axis 'data'"):
+            shard_batch(np.ones((16, 4), "float32"), mesh)
+
+    def test_shard_batch_places_divisible(self):
+        from deeplearning4j_tpu.parallel.mesh import build_mesh
+        from deeplearning4j_tpu.parallel.sharding import shard_batch
+
+        mesh = build_mesh({"data": 8})
+        out = shard_batch(np.ones((16, 4), "float32"), mesh)
+        assert out.shape == (16, 4)
+
+    def test_shard_params_strict_mode(self):
+        from deeplearning4j_tpu.parallel.mesh import build_mesh
+        from deeplearning4j_tpu.parallel.sharding import shard_params
+
+        mesh = build_mesh({"model": 8})
+        params = [{"W": jnp.ones((512, 513))}]  # 513 % 8 != 0
+        placed = shard_params(params, mesh)  # default: replicates
+        assert placed[0]["W"].shape == (512, 513)
+        with pytest.raises(ValueError, match="'model'"):
+            shard_params(params, mesh, on_indivisible="error")
+
+
+# ======================================================================
+# plan-aware init + CLI contract + clean-pass gates
+# ======================================================================
+
+class TestPlanAwareInit:
+    def test_clean_plan_passes(self):
+        net = MultiLayerNetwork(_mlp())
+        net.init(validate=True, mesh=DP4TP2)  # must not raise
+
+    def test_bad_batch_raises_with_par03(self):
+        conf = _mlp()
+        with pytest.raises(ConfigValidationError) as ei:
+            MultiLayerNetwork(conf).init(mesh={"data": 3})
+        assert "PAR03" in str(ei.value)
+
+    def test_batch_size_threads_through_init(self):
+        # the gate must check the batch the user will TRAIN with, not
+        # the default: 32 % 4 == 0 would pass, 50 % 4 != 0 must raise
+        conf = _mlp()
+        MultiLayerNetwork(conf).init(mesh={"data": 4})  # default passes
+        with pytest.raises(ConfigValidationError) as ei:
+            MultiLayerNetwork(conf).init(mesh={"data": 4}, batchSize=50)
+        assert "PAR03" in str(ei.value)
+
+    def test_hbm_budget_raises_with_par06(self):
+        conf = _mlp(widths=(2048, 10), nIn=2048)
+        with pytest.raises(ConfigValidationError) as ei:
+            MultiLayerNetwork(conf).init(mesh={"data": 1},
+                                         hbm_gb=0.00001)
+        assert "PAR06" in str(ei.value)
+
+
+class TestCliContract:
+    def test_exit_codes(self, tmp_path):
+        from deeplearning4j_tpu.analysis.cli import main
+
+        # 2: --mesh without --parallel / bad mesh spec / no input
+        assert main(["--mesh", "data=4"]) == 2
+        assert main(["--parallel"]) == 2
+        clean = tmp_path / "clean.py"
+        clean.write_text("x = 1\n")
+        assert main(["--parallel", "--mesh", "bogus", str(clean)]) == 2
+        # 0: clean source under the parallel passes
+        assert main(["--parallel", str(clean)]) == 0
+        # 1: retrace hazards found
+        bad = tmp_path / "bad.py"
+        bad.write_text(_RETRACE_FIXTURE)
+        assert main(["--parallel", str(bad)]) == 1
+
+    def test_parallel_model_json(self, tmp_path):
+        from deeplearning4j_tpu.analysis.cli import main
+
+        p = tmp_path / "model.json"
+        p.write_text(_mlp().toJson())
+        assert main(["--parallel", "--mesh", "data=4", str(p)]) == 0
+        assert main(["--parallel", "--mesh", "data=3", str(p)]) == 1
+
+    def test_parallel_json_output_carries_plan(self, tmp_path, capsys):
+        import json
+
+        from deeplearning4j_tpu.analysis.cli import main
+
+        p = tmp_path / "model.json"
+        p.write_text(_mlp().toJson())
+        assert main(["--parallel", "--mesh", "data=4", "--json",
+                     str(p)]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["ok"]
+        assert out["reports"][0]["plan"]["memory"]["total_bytes"] > 0
+
+
+@pytest.mark.lint
+class TestSelfCheck:
+    """Tier-1 'lint' gate extension: the partition analyzer over zoo
+    models on the canonical meshes (the --parallel --zoo acceptance
+    gate; the representative subset runs always, the full corpus under
+    -m slow)."""
+
+    def test_zoo_subset_plans_cleanly_on_canonical_meshes(self):
+        from deeplearning4j_tpu.zoo.models import (
+            LeNet, SimpleCNN, TextGenerationLSTM, UNet,
+        )
+
+        for mesh in (DP4TP2, DP2PP4):
+            for model in (LeNet(numClasses=10), SimpleCNN(numClasses=5),
+                          TextGenerationLSTM(), UNet(numClasses=2)):
+                rep = validate_plan(model, mesh, batchSize=8)
+                assert rep.ok, rep.format()
+
+    @pytest.mark.slow
+    def test_zoo_corpus_plans_cleanly_on_canonical_meshes(self):
+        from deeplearning4j_tpu.analysis import zoo_corpus
+
+        bad = {}
+        for mesh in (DP4TP2, DP2PP4):
+            for name, model in zoo_corpus():
+                rep = validate_plan(model, mesh, batchSize=8)
+                if not rep.ok:
+                    bad[f"{name}@{mesh}"] = rep.format()
+        assert not bad, bad
