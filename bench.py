@@ -657,6 +657,50 @@ def bench_prefetch():
             "batches": NB, "batch": B, "host_cores": cores, "note": note}
 
 
+def bench_fit_dataset():
+    """fitDataSet(iterator, stepsPerSync=k) vs per-batch fit() over the
+    SAME fresh-batch stream — the on-device multi-batch epoch loop
+    (VERDICT r5 item #2): k batches staged as one stacked device buffer,
+    one jitted fori_loop, one host sync per k batches, double-buffered
+    H2D. Same self-protection as the fitSteps A/B: the faster variant is
+    each record's headline, the other rides underneath — on backends
+    where XLA's while-loop lowering loses (CPU convs), the loop must
+    EARN the slot."""
+    from deeplearning4j_tpu.zoo import LeNet
+    from deeplearning4j_tpu.ndarray import DataType
+    from deeplearning4j_tpu.data.iterators import RandomDataSetIterator
+
+    B = 64
+    NB = 4 if SMOKE else 32     # fresh batches per epoch
+    K = 2 if SMOKE else 8       # stepsPerSync
+    net = LeNet(numClasses=10, inputShape=(1, 28, 28),
+                dataType=DataType.BFLOAT16).init()
+    it = RandomDataSetIterator(NB, (B, 1, 28, 28), (B, 10))
+
+    net.fit(it)                  # compile + warm the per-batch program
+    t0 = time.perf_counter()
+    net.fit(it)
+    fit_s = time.perf_counter() - t0
+
+    net.fitDataSet(it, stepsPerSync=K)   # compile + warm the k-loop
+    t0 = time.perf_counter()
+    net.fitDataSet(it, stepsPerSync=K)
+    loop_s = time.perf_counter() - t0
+    syncs = net._fit_dataset_syncs
+
+    return _pick_faster(
+        "images_per_sec",
+        {"images_per_sec": round(NB * B / loop_s, 1),
+         "epoch_s": round(loop_s, 3), "batch": B, "batches": NB,
+         "steps_per_sync": K, "host_syncs": syncs,
+         "note": f"fitDataSet(stepsPerSync={K}): k-stack on-device "
+                 "loop, double-buffered staging, one loss fetch per "
+                 f"{K} fresh batches"},
+        {"images_per_sec": round(NB * B / fit_s, 1),
+         "epoch_s": round(fit_s, 3), "batch": B, "batches": NB,
+         "note": "fit(iterator): per-batch transfer + loss fetch"})
+
+
 def bench_resilience():
     """Overhead of the resilient training runtime (runtime/resilience.py):
     (a) the non-finite step guard — an all-finite reduction over loss +
@@ -825,6 +869,7 @@ SECONDARY_CONFIGS = [("attention", "bench_attention"),
                      ("lenet_mnist", "bench_lenet"),
                      ("samediff_mlp", "bench_samediff_mlp"),
                      ("lstm_tbptt", "bench_lstm_tbptt"),
+                     ("fit_dataset", "bench_fit_dataset"),
                      ("prefetch", "bench_prefetch"),
                      ("resilience", "bench_resilience"),
                      ("analysis", "bench_analysis"),
@@ -1024,7 +1069,55 @@ def _budget(cap):
     return min(cap, int(_DEADLINE - time.time()) - 30)
 
 
+_PROBE_CODE = "import jax; print(len(jax.devices()), flush=True)"
+
+
+def _tunnel_probe(timeout_s=60, code=_PROBE_CODE):
+    """Bounded TPU liveness check (VERDICT r5 item #10): run
+    jax.devices() in a SUBPROCESS with a hard timeout — the observed
+    tunnel hang sits inside a blocking C call, so only a process
+    boundary can bound it. Returns (True, device_count) when the
+    backend answers, (False, reason) on hang/error — the caller then
+    emits a clean `tunnel_dead` marker per config instead of burning
+    the 780 s headline budget discovering the same hang."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=timeout_s, cwd=here)
+    except subprocess.TimeoutExpired:
+        return False, f"jax.devices() hung > {timeout_s}s (tunnel dead?)"
+    except Exception as e:
+        return False, f"{type(e).__name__}: {e}"[:200]
+    out = (r.stdout or "").strip().splitlines()
+    if r.returncode == 0 and out and out[-1].isdigit():
+        return True, int(out[-1])
+    return False, ((r.stderr or r.stdout or "").strip()[-200:]
+                   or f"probe exited {r.returncode} with no output")
+
+
+def _emit_tunnel_dead(reason):
+    """Mark every TPU-bound config `tunnel_dead`, still bank the
+    CPU-only grad_sharing config (it never touches the chip), and emit
+    the error line — the whole run resolves in ~2 min instead of
+    rc=1 noise after 25 min of watchdog burn."""
+    for name, _ in SECONDARY_CONFIGS:
+        _CONFIGS[name] = {"error": "tunnel_dead"}
+    try:
+        _CONFIGS["grad_sharing"] = bench_grad_sharing_virtual(_budget(300))
+    except Exception as e:
+        _CONFIGS["grad_sharing"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    _error_line(f"tunnel_dead: {reason}")
+
+
 def main():
+    # fail-fast tunnel probe: 60 s bounded jax.devices() before any
+    # budget is spent (skipped in SMOKE — that run is pinned to CPU)
+    if not SMOKE:
+        alive, info = _tunnel_probe(60)
+        if not alive:
+            _emit_tunnel_dead(info)
+            sys.exit(1)
     # headline FIRST (own subprocess, like every TPU config): if the chip
     # degrades mid-run the flagship number is already banked and
     # _error_line reports it even on a later hard stop

@@ -919,7 +919,11 @@ class SameDiff:
                 grads = {n: g + tc.weightDecay * params[n]
                          for n, g in grads.items()}
             upd, new_state = updater.apply(grads, ustate, it, params=params)
-            new_params = {n: params[n] - upd[n] for n in params}
+            # cast keeps param dtype stable (python-float updater
+            # hyperparams otherwise promote f32 params to f64 under x64,
+            # which would also break fitDataSet's dtype-stable fori carry)
+            new_params = {n: (params[n] - upd[n]).astype(params[n].dtype)
+                          for n in params}
             return loss, new_params, new_state
 
         return step
@@ -981,6 +985,131 @@ class SameDiff:
         self._train_state = state
         self._iteration += numSteps
         return float(loss)
+
+    def fitDataSet(self, iterator, stepsPerSync=1, epochs=1,
+                   listeners=None):
+        """Epoch training with one host sync and one device transfer per
+        `stepsPerSync` fresh batches — the SameDiff form of
+        MultiLayerNetwork.fitDataSet: k batches from the iterator are
+        stacked into [k, ...] placeholder buffers and one jitted
+        lax.fori_loop indexes batch i per step with the donated
+        param/updater-state carry. Staging is double-buffered (block
+        n+1's async device_put and dispatch are in flight before the
+        host blocks on block n's losses). Per-step RNG and iteration
+        streams match fit() exactly; the ragged final stack runs through
+        the per-batch fit step, so the k-loop never retraces. Returns
+        the loss history (one float per step, fit() parity); the call's
+        host-sync count lands on `self._fit_dataset_syncs`."""
+        from deeplearning4j_tpu.data.iterators import iter_stacks
+        from deeplearning4j_tpu.nn.multilayer import run_staged_blocks
+
+        if self._tc is None:
+            raise ValueError("setTrainingConfig first")
+        k = int(stepsPerSync)
+        if k < 1:
+            raise ValueError(f"stepsPerSync must be >= 1, got {k}")
+        if epochs > 1 and not hasattr(iterator, "reset"):
+            # a plain iterable is exhausted after epoch 1 — later epochs
+            # would silently train zero batches and return a short
+            # history; the nn fitDataSet paths fail loudly the same way
+            # (their fit(iterator) calls reset() unconditionally)
+            raise ValueError(
+                f"fitDataSet(epochs={epochs}) needs a resettable "
+                "iterator (with reset()/hasNext()/next()); a plain "
+                "iterable can only run one epoch")
+        tc = self._tc
+        if k == 1:
+            history = []
+            for _ in range(epochs):
+                if hasattr(iterator, "reset"):
+                    iterator.reset()
+                for stack in iter_stacks(iterator, 1):
+                    history.extend(self.fit(data=stack[0],
+                                            listeners=listeners))
+            self._fit_dataset_syncs = len(history)  # one per batch
+            return history
+        loss_names = self._loss_names()
+        var_names = sorted(n for n, v in self._vars.items()
+                           if v.variableType == VariableType.VARIABLE)
+        updater = tc.updater
+        ckey = ("fitDataSet", k, tuple(var_names), tuple(loss_names),
+                id(tc), len(self._ops))
+        jloop = self._jit_cache.get(ckey)
+        if jloop is None:
+            step = self._fit_step_fn(tc, loss_names, updater)
+            base_key = jax.random.key(0)
+
+            def loop(params, ustate, consts, phs_stacked, it0):
+                def body(i, carry):
+                    p, s, losses = carry
+                    it = it0 + i
+                    phs = {n: jax.lax.dynamic_index_in_dim(
+                        a, i, 0, keepdims=False)
+                        for n, a in phs_stacked.items()}
+                    loss, p, s = step(p, s, consts, phs, it,
+                                      jax.random.fold_in(base_key, it))
+                    return (p, s,
+                            losses.at[i].set(loss.astype(jnp.float32)))
+
+                return jax.lax.fori_loop(
+                    0, k, body,
+                    (params, ustate, jnp.zeros((k,), jnp.float32)))
+
+            # RetraceSentinel.install_fit_dataset routes the loop
+            # through this hook so compiles are counted exactly
+            wrap = getattr(self, "_fit_dataset_wrap", None)
+            if wrap is not None:
+                loop = wrap(loop)
+            jloop = jax.jit(loop, donate_argnums=(0, 1))
+            self._jit_cache[ckey] = jloop
+
+        history = []
+        self._fit_dataset_syncs = 0
+
+        def consume(losses):
+            self._fit_dataset_syncs += 1
+            vals = np.asarray(losses)   # THE host sync for this block
+            for v in vals:
+                self._iteration += 1
+                history.append(float(v))
+                for l in (listeners or []):
+                    l.iterationDone(self, self._iteration, float(v))
+            for l in (listeners or []):
+                getattr(l, "onSyncBoundary", lambda *a: None)(
+                    self, self._iteration, vals)
+
+        it_next = 0   # dispatch-side iteration cursor, reset per epoch
+
+        def dispatch(batches):
+            nonlocal it_next
+            phs_list = [self._batch_to_placeholders(b, tc)
+                        for b in batches]
+            stacked = jax.device_put(
+                {n: np.stack([np.asarray(p[n]) for p in phs_list])
+                 for n in phs_list[0]})
+            params = {n: self._arrays[n] for n in var_names}
+            consts = {n: a for n, a in self._arrays.items()
+                      if n not in params}
+            state = self._train_state_for(params, updater)
+            params, state, losses = jloop(
+                params, state, consts, stacked,
+                jnp.asarray(it_next, jnp.int32))
+            it_next += k
+            # write back per block: the inputs were donated, so a
+            # stale self._arrays entry would point at a dead buffer
+            self._arrays.update(params)
+            self._train_state = state
+            return losses
+
+        for _ in range(epochs):
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+            it_next = self._iteration
+            tail = run_staged_blocks(iterator, k, dispatch, consume)
+            for b in tail:   # ragged remainder: per-batch step, no
+                history.extend(self.fit(data=b, listeners=listeners))
+                self._fit_dataset_syncs += 1   # k-loop retrace
+        return history
 
     def _batch_to_placeholders(self, b, tc, bind_labels=True):
         from deeplearning4j_tpu.data import DataSet

@@ -70,6 +70,15 @@ def writeColumnar(path, schema: Schema, records):
             pos = 0
             for i, c in enumerate(chunks):
                 pos += len(c)
+                if pos > 0xFFFFFFFF:
+                    # guard BEFORE the uint32 store: modern numpy raises
+                    # an opaque OverflowError here, older numpy silently
+                    # wraps and corrupts every later offset
+                    raise ValueError(
+                        f"column {name!r} utf-8 blob exceeds the NDC1 "
+                        f"uint32 offset limit (4 GiB) at row {i}: split "
+                        "the records across multiple files (the format "
+                        "has no u8-offset escape hatch yet)")
                 offs[i + 1] = pos
             blocks.append(offs.tobytes() + b"".join(chunks))
         blocks.append(valid.tobytes())

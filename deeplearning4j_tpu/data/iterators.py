@@ -33,6 +33,91 @@ def _data_dir() -> Path:
                                os.path.expanduser("~/.deeplearning4j")))
 
 
+# ------------------------------------------------------- k-batch staging
+def iter_stacks(iterator, k: int):
+    """Yield lists of up to `k` consecutive batches from a
+    DataSetIterator (or any object with hasNext/next, or a plain
+    iterable). Every yielded list except possibly the last has exactly
+    `k` entries — the staging unit of ``fitDataSet(stepsPerSync=k)``;
+    the short final list is the ragged tail the caller runs through
+    plain per-batch ``fit()``."""
+    k = int(k)
+    if k < 1:
+        raise ValueError(f"stepsPerSync must be >= 1, got {k}")
+    buf = []
+    if hasattr(iterator, "hasNext"):
+        while iterator.hasNext():
+            buf.append(iterator.next())
+            if len(buf) == k:
+                yield buf
+                buf = []
+    else:
+        for ds in iterator:
+            buf.append(ds)
+            if len(buf) == k:
+                yield buf
+                buf = []
+    if buf:
+        yield buf
+
+
+def _to_numpy(a):
+    if a is None:
+        return None
+    return np.asarray(a.toNumpy() if hasattr(a, "toNumpy") else a)
+
+
+def stack_mask_group(arrs, what):
+    """Stack one mask component across a k-batch group. All-None stays
+    None; mixed presence synthesises an all-ones mask for the maskless
+    batches (semantically "nothing masked" — the padded final batch of
+    an epoch is the one batch that carries a mask, and it must be able
+    to share a stack with unmasked ones). Shapes must agree: the stack
+    is one fixed-shape device buffer."""
+    if all(a is None for a in arrs):
+        return None
+    template = next(a for a in arrs if a is not None)
+    filled = [np.ones_like(template) if a is None else a for a in arrs]
+    shapes = {a.shape for a in filled}
+    if len(shapes) > 1:
+        raise ValueError(
+            f"fitDataSet stack has ragged {what} shapes {sorted(shapes)}: "
+            "device staging needs one fixed shape per component (the "
+            "built-in iterators pad their final batch already)")
+    return np.stack(filled)
+
+
+def stack_datasets(batches):
+    """Stack k DataSets into one host-side [k, B, ...] stack per
+    component -> (features, labels, featuresMask, labelsMask), masks
+    None when absent everywhere. The stack is what
+    ``fitDataSet(stepsPerSync=k)`` ships to the device in ONE transfer;
+    the jitted k-loop ``dynamic_index_in_dim``s batch i per step."""
+
+    def stack(getter, what):
+        arrs = [_to_numpy(getattr(ds, getter)()) for ds in batches]
+        if any(a is None for a in arrs):
+            if all(a is None for a in arrs):
+                return None
+            raise ValueError(
+                f"fitDataSet stack has batches with and without {what}")
+        shapes = {a.shape for a in arrs}
+        if len(shapes) > 1:
+            raise ValueError(
+                f"fitDataSet stack has ragged {what} shapes "
+                f"{sorted(shapes)}: device staging needs one fixed shape "
+                "per component (the built-in iterators pad their final "
+                "batch already)")
+        return np.stack(arrs)
+
+    return (stack("getFeatures", "features"),
+            stack("getLabels", "labels"),
+            stack_mask_group([_to_numpy(ds.getFeaturesMaskArray())
+                              for ds in batches], "features-mask"),
+            stack_mask_group([_to_numpy(ds.getLabelsMaskArray())
+                              for ds in batches], "labels-mask"))
+
+
 # ------------------------------------------------------------------ IRIS
 def _iris_arrays():
     try:  # sklearn ships the CSV inside the wheel — no network needed

@@ -113,7 +113,10 @@ class BasicNDArrayCompressor:
         if algo == "INT8":
             if not np.issubdtype(x.dtype, np.floating):
                 raise ValueError("INT8 compression needs a float array")
-            scale = float(np.max(np.abs(x))) / 127.0 or 1.0
+            # size-0 arrays short-circuit: np.max of an empty array is a
+            # bare numpy ValueError, not a codec answer (ADVICE r5 #5)
+            scale = (float(np.max(np.abs(x))) / 127.0 or 1.0) \
+                if x.size else 1.0
             q = np.clip(np.round(x / scale), -127, 127).astype(np.int8)
             return CompressedNDArray(algo, q, x.shape, x.dtype,
                                      extra=np.float32(scale))

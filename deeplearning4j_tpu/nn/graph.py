@@ -569,6 +569,77 @@ class ComputationGraph:
             lst.iterationDone(self, self._iteration, self._epoch)
         return self
 
+    def _stack_batches(self, batches):
+        """k DataSets/MultiDataSets -> stacked [k, ...] host arrays in
+        the train step's (inputs dict, labels list, fmasks, lmasks)
+        structure — fitDataSet's one-transfer staging unit."""
+        from deeplearning4j_tpu.data.iterators import stack_mask_group
+
+        ex = [self._extract_ds(ds) for ds in batches]
+        inputs_l = [e[0] for e in ex]
+        labs_l = [e[1] for e in ex]
+        fms_l = [e[2] for e in ex]
+        lms_l = [e[3] for e in ex]
+        X = {n: np.stack([np.asarray(d[n]) for d in inputs_l])
+             for n in self.conf.networkInputs}
+        Y = [np.stack([np.asarray(ls[j]) for ls in labs_l])
+             for j in range(len(labs_l[0]))]
+        if all(f is None for f in fms_l):
+            FM = None
+        else:
+            # per-input None entries (a masked sequence input alongside a
+            # static one) synthesize all-ones exactly like whole-batch
+            # Nones — same guard shape as the labels-mask branch below
+            names = list(next(f for f in fms_l if f is not None))
+            FM = {n: stack_mask_group(
+                [None if f is None or f.get(n) is None
+                 else np.asarray(f[n]) for f in fms_l],
+                f"features-mask[{n}]") for n in names}
+        if all(m is None for m in lms_l):
+            LM = None
+        else:
+            LM = [stack_mask_group(
+                [None if m is None or m[j] is None else np.asarray(m[j])
+                 for m in lms_l], f"labels-mask[{j}]")
+                for j in range(len(labs_l[0]))]
+        return X, Y, FM, LM
+
+    def fitDataSet(self, iterator, stepsPerSync=1, epochs=None):
+        """Epoch training with one host sync and one transfer per
+        `stepsPerSync` fresh batches — the ComputationGraph form of
+        MultiLayerNetwork.fitDataSet (see there for the staging and
+        double-buffering contract). The iterator may yield DataSets or
+        MultiDataSets (multi-input/-output graphs stack every component);
+        the ragged final stack runs through plain fit()."""
+        from deeplearning4j_tpu.nn.multilayer import (fit_dataset_jit,
+                                                      run_fit_dataset_epoch)
+
+        self._require_init()
+        k = int(stepsPerSync)
+        if k < 1:
+            raise ValueError(f"stepsPerSync must be >= 1, got {k}")
+        if k == 1:
+            it0 = self._iteration
+            self.fit(iterator, epochs=epochs)
+            self._fit_dataset_syncs = self._iteration - it0  # 1/batch
+            return self
+        if self.conf.backpropType == "tbptt":
+            raise ValueError(
+                "fitDataSet does not support truncated BPTT: use fit() "
+                "(per-batch windows) or fitSteps()")
+        jloop = fit_dataset_jit(self, k)
+        self._fit_dataset_syncs = 0
+        for _ in range(epochs or 1):
+            iterator.reset()
+            for lst in self._listeners:
+                getattr(lst, "onEpochStart", lambda m: None)(self)
+            self._fit_dataset_syncs += run_fit_dataset_epoch(
+                self, iterator, k, self._stack_batches, self._fit_ds, jloop)
+            for lst in self._listeners:
+                getattr(lst, "onEpochEnd", lambda m: None)(self)
+            self._epoch += 1
+        return self
+
     def _fit_tbptt(self, inputs, labels, fmasks, lmasks):
         """Truncated BPTT over the DAG: split time ([B,C,T] axis 2) into
         tbpttFwdLength windows, carrying recurrent h/c across windows

@@ -91,6 +91,208 @@ def run_tbptt(net, T, L, jit_call):
     net._states = net._strip_carries(net._states)
 
 
+def pick_batch(i, tree):
+    """Batch i of a stacked [k, ...] pytree (None components pass
+    through): the per-step slice of fitDataSet's staged device buffer."""
+    return jax.tree_util.tree_map(
+        lambda a: jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
+        tree)
+
+
+def make_fit_dataset_loop(net, k, step_fn=None, guarded=False,
+                          max_bad=None):
+    """The on-device k-fresh-batch training loop shared by
+    MultiLayerNetwork, ComputationGraph, ParallelWrapper and
+    ResilientFit: a lax.fori_loop whose step i ``dynamic_index_in_dim``s
+    batch i out of the stacked [k, B, ...] buffers and runs the
+    canonical train step with the donated params/updater/state carry —
+    the whole epoch block is ONE executable with ONE host sync
+    (vs fitSteps, which runs k steps on one batch: this is the
+    fresh-data generalisation, VERDICT r5 item #2).
+
+    step_fn defaults to net._train_step; a distributed wrapper passes
+    its own (e.g. the int8-allreduce step). guarded=True expects the
+    non_finite_guard signature (returns an extra ok flag) and the loop
+    then also carries a k-vector of per-step ok flags, so the host can
+    replay exactly which steps were skipped; it takes one extra runtime
+    arg `bad0` (the consecutive-bad count entering the block) and, with
+    `max_bad`, FREEZES the params/updater/state carry from the step
+    where the count reaches `max_bad` — the k=1 path raises
+    NonFiniteStepError before ever training the next batch, so later
+    steps of an aborting block must not train either (the host replays
+    the flags and raises at the same step, params bitwise-matching).
+
+    Returns (params, upd, states, losses[k][, oks[k], bad]) — the loss
+    k-vector is replayed host-side through the TrainingListener chain.
+    """
+    seed_key = jax.random.key(net.conf.seed ^ 0x5EED)
+    step = step_fn if step_fn is not None else net._train_step
+
+    def loop(params, upd, states, it0, xs, ys, fms, lms, bad0=None):
+        def body(i, carry):
+            if guarded:
+                p0, u0, s0, losses, oks, bad = carry
+                p, u, s = p0, u0, s0
+            else:
+                p, u, s, losses = carry
+            it = it0 + i
+            key = jax.random.fold_in(seed_key, it)
+            out = step(p, u, s, it, pick_batch(i, xs), pick_batch(i, ys),
+                       key, pick_batch(i, fms), pick_batch(i, lms))
+            if guarded:
+                p, u, s, loss, ok = out
+                if max_bad is not None:
+                    # an earlier step of THIS block hit the abort
+                    # threshold: k=1 raised there, so this step must
+                    # not train — keep the pre-step carry
+                    alive = bad < max_bad
+                    p, u, s = jax.tree_util.tree_map(
+                        lambda n, o: jnp.where(alive, n, o),
+                        (p, u, s), (p0, u0, s0))
+                    bad = jnp.where(alive,
+                                    jnp.where(ok, 0, bad + 1), bad)
+                else:
+                    bad = jnp.where(ok, 0, bad + 1)
+            else:
+                p, u, s, loss = out
+            # strip the transient h/c entries the step may add: the fori
+            # carry must be structure-stable (persistent state like BN
+            # stats survives; same rule as fitSteps)
+            res = (p, u, net._strip_carries(s),
+                   losses.at[i].set(loss.astype(jnp.float32)))
+            if guarded:
+                res = res + (oks.at[i].set(ok), bad)
+            return res
+
+        init = (params, upd, net._strip_carries(states),
+                jnp.zeros((k,), jnp.float32))
+        if guarded:
+            b0 = jnp.int32(0) if bad0 is None else bad0.astype(jnp.int32)
+            init = init + (jnp.ones((k,), bool), b0)
+        return jax.lax.fori_loop(0, k, body, init)
+
+    return loop
+
+
+def fit_dataset_jit(net, k, step_fn=None, guarded=False, owner=None,
+                    max_bad=None):
+    """Cached jit of make_fit_dataset_loop (one compile per k across an
+    epoch — RetraceSentinel-provable via install_fit_dataset, which
+    routes the loop through net._fit_dataset_wrap before jitting).
+
+    `owner` holds the cache when a harness (ParallelWrapper,
+    ResilientFit) builds loops around its own step for someone else's
+    net — the wrap hook is still read from the net, where
+    install_fit_dataset sets it for both. Solver (optax) states alias
+    the param buffers, so params/upd donation follows net._solver
+    exactly as _make_jit_train does."""
+    cache_owner = owner if owner is not None else net
+    cache = getattr(cache_owner, "_fit_dataset_cache", None)
+    if cache is None:
+        cache = cache_owner._fit_dataset_cache = {}
+    jloop = cache.get(k)
+    if jloop is None:
+        loop = make_fit_dataset_loop(net, k, step_fn=step_fn,
+                                     guarded=guarded, max_bad=max_bad)
+        wrap = getattr(net, "_fit_dataset_wrap", None)
+        if wrap is not None:
+            loop = wrap(loop)
+        jloop = jax.jit(
+            loop,
+            donate_argnums=(0, 1, 2) if getattr(net, "_solver", None)
+            is None else (2,))
+        cache[k] = jloop
+    return jloop
+
+
+def run_staged_blocks(iterator, k, dispatch, consume):
+    """The double-buffered block driver shared by every fitDataSet
+    implementation (MultiLayerNetwork/ComputationGraph via
+    run_fit_dataset_epoch, SameDiff directly). For each FULL stack of k
+    fresh batches, `dispatch(batches)` stages and launches the jitted
+    k-loop and returns the block's (device-resident) losses; `consume`
+    blocks on them one block BEHIND the launch — the transfer of stack
+    n+1 and its dispatch are already in flight while the host blocks on
+    stack n's losses, so H2D overlaps compute on multi-core hosts and
+    the tunneled rig alike.
+
+    Returns the ragged final stack (< k batches, possibly empty) for
+    the caller to run through its plain per-batch fit — never through
+    the k-loop, which therefore never retraces on a ragged shape."""
+    from deeplearning4j_tpu.data.iterators import iter_stacks
+
+    pending = None     # (losses device array) of the in-flight block
+    tail = []
+    try:
+        for batches in iter_stacks(iterator, k):
+            if len(batches) < k:
+                tail = batches
+                break
+            out = dispatch(batches)
+            if pending is not None:
+                consume(pending)
+            pending = out
+    finally:
+        # drain in a finally: a mid-epoch error (ragged stack, shard
+        # rejection) lands AFTER a block was dispatched and the model's
+        # params reassigned — consuming the in-flight block here keeps
+        # the iteration counter (the RNG/saveEvery/resume key) in step
+        # with the params instead of up to k steps behind them
+        if pending is not None:
+            consume(pending)
+    return tail
+
+
+def run_fit_dataset_epoch(net, iterator, k, stack_fn, fit_one, jloop,
+                          place=None):
+    """One epoch of device-staged k-step blocks with double-buffered
+    transfer overlap (run_staged_blocks above drives the
+    stage → launch → lagged-consume cadence).
+
+    The loss k-vector is replayed per-step through the listener chain
+    (score/iteration advance exactly as per-batch fit() would), then
+    onSyncBoundary fires once per block. The ragged final stack
+    (< k batches) runs through `fit_one` — plain per-batch fit.
+
+    Returns the number of host syncs performed: one per full k-block
+    plus one per ragged-tail batch — ⌈n/k⌉ for n batches whenever k
+    divides n (or the tail is a single batch); a longer tail pays the
+    ordinary per-batch sync for each of its batches."""
+    syncs = 0
+    it_next = net._iteration   # dispatch-side iteration cursor
+
+    def consume(losses):
+        nonlocal syncs
+        syncs += 1
+        vals = np.asarray(losses)   # THE host sync for this block
+        for v in vals:
+            net._score = float(v)
+            net._iteration += 1
+            for lst in net._listeners:
+                lst.iterationDone(net, net._iteration, net._epoch)
+        for lst in net._listeners:
+            getattr(lst, "onSyncBoundary", lambda *a: None)(
+                net, net._iteration, vals)
+
+    def dispatch(batches):
+        nonlocal it_next
+        staged = stack_fn(batches)
+        staged = jax.device_put(staged) if place is None \
+            else place(staged)
+        xs, ys, fms, lms = staged
+        net._params, net._upd_states, net._states, losses = jloop(
+            net._params, net._upd_states, net._states,
+            jnp.asarray(it_next, jnp.int32), xs, ys, fms, lms)
+        it_next += k
+        return losses
+
+    tail = run_staged_blocks(iterator, k, dispatch, consume)
+    for ds in tail:
+        fit_one(ds)
+        syncs += 1
+    return syncs
+
+
 def _grad_normalize(grads_per_layer, mode, threshold):
     """Gradient clipping/normalization (reference:
     org.deeplearning4j.nn.conf.GradientNormalization, applied in
@@ -638,6 +840,52 @@ class MultiLayerNetwork:
         # end of every step to keep the fori carry structure stable
         for lst in self._listeners:
             lst.iterationDone(self, self._iteration, self._epoch)
+        return self
+
+    def fitDataSet(self, iterator, stepsPerSync=1, epochs=None):
+        """Epoch training with ONE host sync and ONE device transfer per
+        `stepsPerSync` fresh batches: pull k batches from the iterator,
+        stage them as a stacked [k, B, ...] device buffer, and run a
+        single jitted lax.fori_loop that indexes batch i per step with
+        the donated param/updater carry — fit(iterator) semantics
+        (same trajectory, RNG stream, iteration counters, listener
+        replay) without the per-batch dispatch+fetch tax fitSteps only
+        removed for repeated batches. Staging is double-buffered: stack
+        n+1's async device_put is in flight before the host blocks on
+        stack n's losses. The ragged final stack (< k batches) runs
+        through plain fit(), so the k-loop compiles exactly once.
+
+        stepsPerSync=1 is exactly fit(iterator). The total host-sync
+        count of the call (one per k-block + one per tail batch) is
+        recorded on `self._fit_dataset_syncs`.
+        """
+        from deeplearning4j_tpu.data.iterators import stack_datasets
+
+        self._require_init()
+        k = int(stepsPerSync)
+        if k < 1:
+            raise ValueError(f"stepsPerSync must be >= 1, got {k}")
+        if k == 1:
+            it0 = self._iteration
+            self.fit(iterator, epochs=epochs)
+            self._fit_dataset_syncs = self._iteration - it0  # 1/batch
+            return self
+        if self.conf.backpropType == BackpropType.TruncatedBPTT:
+            raise ValueError(
+                "fitDataSet does not support truncated BPTT: the k-batch "
+                "stack would need a second on-device window sweep per "
+                "step; use fit() (per-batch windows) or fitSteps()")
+        jloop = fit_dataset_jit(self, k)
+        self._fit_dataset_syncs = 0
+        for _ in range(epochs or 1):
+            iterator.reset()
+            for lst in self._listeners:
+                getattr(lst, "onEpochStart", lambda m: None)(self)
+            self._fit_dataset_syncs += run_fit_dataset_epoch(
+                self, iterator, k, stack_datasets, self._fit_batch, jloop)
+            for lst in self._listeners:
+                getattr(lst, "onEpochEnd", lambda m: None)(self)
+            self._epoch += 1
         return self
 
     # ----- unsupervised layerwise pretraining (VAE etc.) --------------

@@ -30,6 +30,15 @@ class TrainingListener:
     def onEpochEnd(self, model) -> None:
         pass
 
+    # ----- staged-epoch hook (fitDataSet / ResilientFit blocks) -------
+    def onSyncBoundary(self, model, iteration: int, scores) -> None:
+        """fitDataSet(stepsPerSync=k) finished one k-step device block:
+        `scores` is the block's per-step loss vector (numpy, length k),
+        already replayed through iterationDone. The ONLY point inside a
+        staged epoch where host-side state is fresh — per-iteration
+        hooks between sync boundaries observe scores replayed from the
+        block's k-vector, not a live device fetch."""
+
     # ----- resilience hooks (runtime.resilience.ResilientFit) ---------
     def onStepSkipped(self, model, iteration: int, epoch: int,
                       loss: float) -> None:

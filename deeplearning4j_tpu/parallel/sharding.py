@@ -51,6 +51,19 @@ def shard_batch(arr, mesh: Mesh, batch_axis=DATA_AXIS, dim=0):
     return jax.device_put(arr, NamedSharding(mesh, P(*spec)))
 
 
+def shard_batch_stack(tree, mesh: Mesh, batch_axis=DATA_AXIS):
+    """Place a fitDataSet staging stack — a pytree of [k, B, ...] arrays
+    (None components pass through) — with the BATCH dim (dim 1) sharded
+    over `batch_axis` and the k staging dim replicated, through the same
+    divisibility-checked shard_batch every trainer uses. Each of the k
+    steps of the on-device loop then indexes a correctly-sharded global
+    batch."""
+    import jax.tree_util as jtu
+
+    return jtu.tree_map(
+        lambda a: shard_batch(a, mesh, batch_axis=batch_axis, dim=1), tree)
+
+
 def spec_for_param(name: str, shape, model_axis=MODEL_AXIS, min_shard_size=2 ** 16):
     """PartitionSpec for one parameter array by name/shape convention."""
     if int(np.prod(shape)) < min_shard_size:

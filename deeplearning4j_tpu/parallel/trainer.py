@@ -292,6 +292,76 @@ class ParallelWrapper:
         for lst in n._listeners:
             lst.iterationDone(n, n._iteration, n._epoch)
 
+    def fitDataSet(self, iterator, stepsPerSync=1, epochs=None):
+        """Sharded form of MultiLayerNetwork.fitDataSet: k fresh batches
+        are staged as ONE [k, B, ...] stack per component, placed with
+        the batch dim sharded over the data axis (sharding.
+        shard_batch_stack — the same divisibility-checked shard_batch
+        every trainer uses, never padding), and trained by one jitted
+        lax.fori_loop whose step i indexes a correctly-sharded global
+        batch — GSPMD inserts the gradient collectives inside the loop.
+        One host sync and one transfer per k batches; double-buffered
+        staging; ragged tail through the per-batch sharded fit path.
+        Supports gradient_compression None (dense psum via GSPMD) and
+        'int8' (explicit shard_map allreduce)."""
+        from deeplearning4j_tpu.data.iterators import stack_datasets
+        from deeplearning4j_tpu.nn.multilayer import (
+            fit_dataset_jit, run_fit_dataset_epoch)
+        from deeplearning4j_tpu.parallel.sharding import shard_batch_stack
+
+        n = self.net
+        n._require_init()
+        k = int(stepsPerSync)
+        if k < 1:
+            raise ValueError(f"stepsPerSync must be >= 1, got {k}")
+        if k == 1:
+            it0 = n._iteration
+            self.fit(iterator, epochs=epochs)
+            self._fit_dataset_syncs = n._iteration - it0  # 1/batch
+            return self
+        if self.gradient_compression == "threshold":
+            raise ValueError(
+                "fitDataSet supports gradient_compression None/'int8'; "
+                "the 'threshold' step threads per-replica residual state "
+                "through a different arity — use fit()")
+        bp = getattr(n.conf, "backpropType", None)
+        if bp == "tbptt" or str(getattr(bp, "name", bp)) == "TruncatedBPTT":
+            raise ValueError(
+                "fitDataSet does not support truncated BPTT; use fit()")
+        if self._is_graph() and (len(n.conf.networkInputs) != 1
+                                 or len(n.conf.networkOutputs) != 1):
+            raise ValueError(
+                "ParallelWrapper supports single-input/single-output "
+                "ComputationGraphs")
+        step = self.trainStep()
+        if self._jit is None:
+            self._place_replicated()
+            self._build_jit()
+        jloop = fit_dataset_jit(n, k, step_fn=step, owner=self)
+
+        if self._is_graph():
+            name = n.conf.networkInputs[0]
+
+            def stack_fn(batches):
+                x, y, fm, lm = stack_datasets(batches)
+                return ({name: x}, [y],
+                        None if fm is None else {name: fm},
+                        None if lm is None else [lm])
+        else:
+            stack_fn = stack_datasets
+
+        def place(staged):
+            return shard_batch_stack(staged, self.mesh, self.batch_axis)
+
+        self._fit_dataset_syncs = 0
+        for _ in range(epochs or 1):
+            iterator.reset()
+            self._fit_dataset_syncs += run_fit_dataset_epoch(
+                n, iterator, k, stack_fn, self._fit_batch, jloop,
+                place=place)
+            n._epoch += 1
+        return self
+
     def trainStep(self):
         """The un-jitted per-batch step function with the canonical
         `(params, upd, states, it, x, y, key, fmask, lmask) ->
@@ -385,6 +455,17 @@ class ParameterAveragingTrainingMaster(ParallelWrapper):
             "its own _fit_batch. Wrap ParallelWrapper/"
             "SharedTrainingMaster in ResilientFit instead, or run this "
             "master without the non-finite guard")
+
+    def fitDataSet(self, iterator, stepsPerSync=1, epochs=None):
+        if int(stepsPerSync) == 1:
+            return self.fit(iterator, epochs=epochs)
+        raise ValueError(
+            "ParameterAveragingTrainingMaster does not support "
+            "stepsPerSync > 1: it picks a different executable per "
+            "iteration host-side (averaging vs local step), which a "
+            "single traced k-loop cannot express without paying the "
+            "full-state pmean every step; use ParallelWrapper/"
+            "SharedTrainingMaster for the k-stack loop")
 
     def averagingFrequency(self, k):
         if self._jit is not None:

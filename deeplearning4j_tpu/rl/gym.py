@@ -74,14 +74,18 @@ class GymEnv(MDP):
             self._seed_pending = False  # gym seeds once, on first reset
             # probe reset(seed=) directly — signature inspection can't
             # see through **kwargs wrappers (TimeLimit et al. forward
-            # seed inward). Only an API-mismatch TypeError (its message
-            # names the seed argument) falls back to the classic
-            # env.seed() path; a TypeError raised by a bug INSIDE the
-            # env propagates instead of silently re-running unseeded.
+            # seed inward). Only an argument-mismatch TypeError (the
+            # interpreter's "unexpected keyword argument 'seed'" shape)
+            # falls back to the classic env.seed() path; a TypeError
+            # raised by a bug INSIDE the env — even one whose message
+            # mentions 'seed' — propagates instead of silently
+            # re-running unseeded.
             try:
                 out = self._env.reset(seed=self._seed)
             except TypeError as e:
-                if "seed" not in str(e):
+                msg = str(e)
+                if not ("unexpected keyword argument" in msg
+                        and "seed" in msg):
                     raise
                 seed_fn = getattr(self._env, "seed", None)
                 if callable(seed_fn):

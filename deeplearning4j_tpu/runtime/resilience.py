@@ -37,6 +37,7 @@ import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from deeplearning4j_tpu.util import sharded_checkpoint as _ckpt
 
@@ -331,6 +332,7 @@ class ResilientFit:
         self.retryPolicy = retryPolicy or RetryPolicy()
         self.injector = injector
         self._jit = None
+        self._guarded = None
         self._bad = 0
         self.skippedSteps = 0
 
@@ -343,7 +345,24 @@ class ResilientFit:
             step = non_finite_guard(self.wrapper.trainStep())
         else:
             step = non_finite_guard(self.net._train_step)
+        self._guarded = step
         self._jit = jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _loop_jit(self, k):
+        """Guarded k-block loop for fit(stepsPerSync=k): the non-finite
+        guard wraps EVERY step inside the on-device loop (a bad step's
+        params/updater/state are rolled back in place, exactly the k=1
+        semantics), and the loop returns k-vectors of losses and ok
+        flags that the host-side guard accounting consumes at the sync
+        boundary. max_bad freezes the carry on device from the step
+        where the consecutive-bad count reaches the abort threshold —
+        the k=1 path raises before training the next batch, so an
+        aborting block's params must not contain later steps either."""
+        from deeplearning4j_tpu.nn.multilayer import fit_dataset_jit
+
+        return fit_dataset_jit(self.net, k, step_fn=self._guarded,
+                               guarded=True, owner=self,
+                               max_bad=self.maxBad)
 
     # ----- checkpoint / resume ----------------------------------------
     def _fire(self, hook, *args):
@@ -390,15 +409,29 @@ class ResilientFit:
         return int(extra.get("batch_in_epoch", 0))
 
     # ----- the loop ----------------------------------------------------
-    def fit(self, data, epochs: int = 1):
+    def fit(self, data, epochs: int = 1, stepsPerSync: int = 1):
         """Train until `epochs` epochs are complete, resuming from the
         latest checkpoint when one exists. `data` is a DataSetIterator;
         its order must be replayable (deterministic/seeded) for resumed
-        runs to match uninterrupted ones."""
+        runs to match uninterrupted ones.
+
+        stepsPerSync=k > 1 runs the device-staged k-batch block loop
+        (MultiLayerNetwork.fitDataSet mechanics) with the non-finite
+        guard inside the loop: one host sync per k fresh batches, the
+        guard consuming the block's k-vector of losses/ok flags, and
+        checkpoint + injected-preemption points at the k-step sync
+        boundaries (a save cadence that lands mid-block commits at the
+        block's end). The parameter trajectory — including which steps
+        are skipped — is identical to stepsPerSync=1; the ragged final
+        block runs through the per-batch guarded step."""
         net = self.net
         net._require_init()
+        k = int(stepsPerSync)
+        if k < 1:
+            raise ValueError(f"stepsPerSync must be >= 1, got {k}")
         replay = self._maybe_resume()
         self._build_jit()
+        jloop = self._loop_jit(k) if k > 1 else None
         self._bad = 0
         while net._epoch < int(epochs):
             data.reset()
@@ -406,20 +439,41 @@ class ResilientFit:
             if skip == 0:
                 self._fire("onEpochStart")
             b = 0
+            buf = []
             while self._has_next(data):
                 ds = retry(data.next, self.retryPolicy)
                 b += 1
                 if b <= skip:
                     continue  # replayed: already folded into the params
+                if k == 1:
+                    self._step(ds)
+                    self._boundary(b, 1)
+                else:
+                    buf.append(ds)
+                    if len(buf) == k:
+                        self._block_step(buf, jloop)
+                        buf = []
+                        self._boundary(b, k)
+            for i, ds in enumerate(buf):
+                # ragged tail: per-batch guarded step, no k-loop retrace
                 self._step(ds)
-                if (self.saveEvery > 0
-                        and net._iteration % self.saveEvery == 0):
-                    self._save(b)
-                if self.injector is not None:
-                    self.injector.maybe_kill(net._iteration)
+                self._boundary(b - len(buf) + i + 1, 1)
             self._fire("onEpochEnd")
             net._epoch += 1
         return net
+
+    def _boundary(self, b, steps):
+        """Checkpoint/injected-preemption hooks at a sync boundary that
+        just advanced the iteration counter by `steps`. A saveEvery
+        cadence that fires anywhere inside the block saves once, at the
+        block's end (the first host-visible state)."""
+        net = self.net
+        if self.saveEvery > 0 and \
+                net._iteration // self.saveEvery > \
+                (net._iteration - steps) // self.saveEvery:
+            self._save(b)
+        if self.injector is not None:
+            self.injector.maybe_kill(net._iteration)
 
     def _has_next(self, data) -> bool:
         """hasNext with the same backoff as next() — a record-reader-
@@ -467,8 +521,16 @@ class ResilientFit:
         net._params, net._upd_states, net._states, loss, ok = self._jit(
             net._params, net._upd_states, net._states,
             jnp.asarray(net._iteration, jnp.int32), x, y, key, fmask, lmask)
+        self._account_step(loss, bool(ok))
+
+    def _account_step(self, loss, ok):
+        """Per-step guard accounting, shared by the k=1 path and the
+        k-vector replay at a block's sync boundary: score/iteration
+        advance, skip events, the consecutive-bad abort. The two paths
+        MUST fire identically — tests assert the same skip-event stream
+        for stepsPerSync=1 and k>1 on the same faults."""
+        net = self.net
         net._score = float(loss)
-        ok = bool(ok)
         net._iteration += 1
         if ok:
             self._bad = 0
@@ -485,3 +547,44 @@ class ResilientFit:
                 f"{net._score}) at iteration {net._iteration} — aborting "
                 f"instead of skipping forever; lower the learning rate "
                 f"or enable gradient clipping")
+
+    def _block_step(self, batches, jloop):
+        """One stepsPerSync block: stage k batches as a stacked device
+        buffer (sharded over the wrapper's mesh when present), run the
+        guarded on-device k-loop, then consume the k-vector of
+        losses/ok flags in ONE host sync — per-step guard accounting
+        (skip events, consecutive-bad abort) replays host-side exactly
+        as the k=1 path fires it."""
+        from deeplearning4j_tpu.data.iterators import stack_datasets
+
+        net = self.net
+        k = len(batches)
+        start = net._iteration
+        xs, ys, fms, lms = stack_datasets(batches)
+        if self.injector is not None:
+            for i in range(k):
+                xs[i] = np.asarray(
+                    self.injector.maybe_poison(start + i, xs[i]))
+        staged = (xs, ys, fms, lms)
+        if self.wrapper is not None:
+            from deeplearning4j_tpu.parallel.sharding import \
+                shard_batch_stack
+
+            staged = shard_batch_stack(staged, self.wrapper.mesh,
+                                       self.wrapper.batch_axis)
+        else:
+            staged = jax.device_put(staged)
+        xs, ys, fms, lms = staged
+        (net._params, net._upd_states, net._states, losses, oks, _bad) = \
+            jloop(net._params, net._upd_states, net._states,
+                  jnp.asarray(start, jnp.int32), xs, ys, fms, lms,
+                  jnp.asarray(self._bad, jnp.int32))
+        losses = np.asarray(losses)  # the block's one host sync
+        oks = np.asarray(oks)
+        for i in range(k):
+            # raises at the same step k=1 would; the device loop froze
+            # the carry from that step on, so params match bitwise
+            self._account_step(losses[i], bool(oks[i]))
+        for lst in net._listeners:
+            getattr(lst, "onSyncBoundary", lambda *a: None)(
+                net, net._iteration, losses)

@@ -137,10 +137,19 @@ def _result_bytes(result_text):
 
 
 def _parse_module(hlo_text):
-    """-> (sizes, computations, entry_name) where computations maps
-    name -> [(name, op, out_bytes, operand_names, attached_comps,
-    is_root)]."""
+    """-> (sizes, comp_sizes, computations, entry_name) where
+    computations maps name -> [(name, op, out_bytes, operand_names,
+    attached_comps, is_root)].
+
+    HLO instruction names are only guaranteed unique PER COMPUTATION —
+    a name reused inside a fusion/called computation must not overwrite
+    an ENTRY buffer's size (ADVICE r5 #1) — so sizes are recorded both
+    per computation (`comp_sizes`, the authoritative scope for operand
+    resolution) and module-wide (`sizes`, the fallback for names a
+    computation references but does not define, e.g. cross-computation
+    references in synthetic test modules)."""
     sizes = {}
+    comp_sizes = {}
     comps = {}
     cur = None
     entry = None
@@ -153,13 +162,19 @@ def _parse_module(hlo_text):
             if cm:
                 cur = cm.group(2)
                 comps[cur] = []
+                comp_sizes[cur] = {}
                 if cm.group(1):
                     entry = cur
             elif s == "}":
                 cur = None
             continue
         name, result, op, rest = m.groups()
-        sizes[name] = _result_bytes(result)
+        nbytes = _result_bytes(result)
+        # module-wide fallback keeps the FIRST definition: a later
+        # fusion-internal reuse of an entry name cannot reprice it
+        sizes.setdefault(name, nbytes)
+        if cur is not None:
+            comp_sizes[cur][name] = nbytes
         # operands = instruction names before the first metadata key;
         # stop there to avoid charging called-computation names
         arg_text = rest.split("), ")[0] if "), " in rest else rest
@@ -169,26 +184,31 @@ def _parse_module(hlo_text):
             attached.extend(t.strip().lstrip("%")
                             for t in lst.split(",") if t.strip())
         if cur is not None:
-            comps[cur].append((name, op, sizes[name], operands, attached,
+            comps[cur].append((name, op, nbytes, operands, attached,
                                s.startswith("ROOT ")))
-    return sizes, comps, entry
+    return sizes, comp_sizes, comps, entry
 
 
-def _fusion_bytes(fname, callsite_operands, out_bytes, sizes, comps):
+def _fusion_bytes(fname, callsite_operands, out_bytes, caller_sizes,
+                  inner_sizes, comps):
     """(bytes, out, in) of one fusion call site with XLA's utilization
     scaling: an in-place DUS root writes only the update region, and a
     parameter consumed exclusively via dynamic-slice is charged the
     slice size (HloCostAnalysis fusion handling). Falls back to the
     plain parameters+root charge when the fused computation is
-    unavailable."""
+    unavailable.
+
+    Two size scopes (HLO names are unique per computation only):
+    `caller_sizes` resolves the CALLSITE operands, `inner_sizes` the
+    fusion-internal instructions — a shared name must never cross."""
     insts = comps.get(fname)
-    known = [t for t in callsite_operands if t in sizes]
+    known = [t for t in callsite_operands if t in caller_sizes]
     if not insts:
         seen, in_bytes = set(), 0
         for t in known:
             if t not in seen:
                 seen.add(t)
-                in_bytes += sizes[t]
+                in_bytes += caller_sizes[t]
         return out_bytes + in_bytes, out_bytes, in_bytes
 
     param_of = {}     # inner parameter name -> callsite operand name
@@ -210,15 +230,15 @@ def _fusion_bytes(fname, callsite_operands, out_bytes, sizes, comps):
     dus_aliased = None   # inner name feeding the in-place DUS operand 0
     out_eff = out_bytes
     if root is not None and root[1] == "dynamic-update-slice":
-        r_ops = [t for t in root[2] if t in sizes]
+        r_ops = [t for t in root[2] if t in inner_sizes]
         if len(r_ops) >= 2:
-            out_eff = sizes[r_ops[1]]    # update region only
+            out_eff = inner_sizes[r_ops[1]]    # update region only
             dus_aliased = r_ops[0]
 
     def data_operand(operands):
         """First operand that names an instruction (the token list also
-        carries dtype/dim text, which never resolves in `sizes`)."""
-        return next((t for t in operands if t in sizes), None)
+        carries dtype/dim text, which never resolves in the scope)."""
+        return next((t for t in operands if t in inner_sizes), None)
 
     in_bytes = 0
     for pname, site_name in param_of.items():
@@ -234,7 +254,7 @@ def _fusion_bytes(fname, callsite_operands, out_bytes, sizes, comps):
                             if o == "dynamic-slice"
                             and data_operand(ops2) == pname)
         else:
-            in_bytes += sizes[site_name]
+            in_bytes += caller_sizes[site_name]
     return out_eff + in_bytes, out_eff, in_bytes
 
 
@@ -271,19 +291,35 @@ def ledger(hlo_text, top=15):
     instructions inside call/while/conditional bodies count under their
     own opcodes, not under the call site's.
     """
-    sizes, comps, entry = _parse_module(hlo_text)
+    sizes, comp_sizes, comps, entry = _parse_module(hlo_text)
     if entry is None:
         # single anonymous/first computation (inline test modules)
         entry = next(iter(comps)) if comps else None
 
     by_op = {}
     visiting = set()
+    scopes = {}
 
-    def inst_bytes(op, out_bytes, operands, attached):
+    def scoped(cname):
+        """Operand-size scope for one computation: its OWN definitions
+        first (HLO names are unique per computation, so a fusion-
+        internal name reuse can't misprice an entry instruction —
+        ADVICE r5 #1), module-wide first-definition fallback for names
+        it references but does not define. ChainMap: two-level lookup
+        without copying the module-wide table per computation."""
+        from collections import ChainMap
+
+        sc = scopes.get(cname)
+        if sc is None:
+            sc = ChainMap(comp_sizes.get(cname, {}), sizes)
+            scopes[cname] = sc
+        return sc
+
+    def inst_bytes(op, out_bytes, operands, attached, sc):
         if op == "fusion" and attached:
-            return _fusion_bytes(attached[0], operands, out_bytes, sizes,
-                                 comps)
-        return _instruction_bytes(op, out_bytes, operands, sizes)
+            return _fusion_bytes(attached[0], operands, out_bytes, sc,
+                                 scoped(attached[0]), comps)
+        return _instruction_bytes(op, out_bytes, operands, sc)
 
     def comp_cost(cname):
         """Total bytes of one computation, recursing through
@@ -293,6 +329,7 @@ def ledger(hlo_text, top=15):
         if cname in visiting or cname not in comps:
             return 0
         visiting.add(cname)
+        sc = scoped(cname)
         total = 0
         for name, op, out_bytes, operands, attached, _root in comps[cname]:
             if op in _FREE_OPS:
@@ -300,7 +337,7 @@ def ledger(hlo_text, top=15):
             if op in _SUBCOMP_OPS:
                 total += sum(comp_cost(a) for a in attached)
                 continue
-            nbytes, _, _ = inst_bytes(op, out_bytes, operands, attached)
+            nbytes, _, _ = inst_bytes(op, out_bytes, operands, attached, sc)
             total += nbytes
             by_op[op] = by_op.get(op, 0) + nbytes
         visiting.discard(cname)
@@ -308,6 +345,7 @@ def ledger(hlo_text, top=15):
 
     rows = []
     total = 0
+    entry_scope = scoped(entry) if entry is not None else dict(sizes)
     for name, op, out_bytes, operands, attached, _root in comps.get(entry, []):
         if op in _FREE_OPS:
             continue
@@ -317,7 +355,8 @@ def ledger(hlo_text, top=15):
             rows.append({"name": name, "op": op, "bytes": sub,
                          "out_bytes": 0, "in_bytes": sub})
             continue
-        nbytes, ob, ib = inst_bytes(op, out_bytes, operands, attached)
+        nbytes, ob, ib = inst_bytes(op, out_bytes, operands, attached,
+                                    entry_scope)
         total += nbytes
         by_op[op] = by_op.get(op, 0) + nbytes
         rows.append({"name": name, "op": op, "bytes": nbytes,
@@ -351,15 +390,43 @@ def _boundary_layer_objects(net):
     return [l for l in layers if type(l).__name__ in _BOUNDARY_LAYERS]
 
 
+def _input_shapes(net, x_shape):
+    """Normalize `x_shape` into {input_name: shape} for a
+    ComputationGraph (ADVICE r5 #3: multi-input graphs pass a dict of
+    input shapes; a bare tuple keeps working for single-input graphs),
+    or return the tuple unchanged for a MultiLayerNetwork."""
+    if hasattr(net, "layers"):  # MultiLayerNetwork: one positional input
+        if isinstance(x_shape, dict):
+            raise ValueError(
+                "MultiLayerNetwork takes one input shape tuple, not a "
+                "dict")
+        return tuple(x_shape)
+    names = list(net.conf.networkInputs)
+    if isinstance(x_shape, dict):
+        missing = [n for n in names if n not in x_shape]
+        if missing:
+            raise ValueError(
+                f"x_shape dict is missing graph input(s) {missing} "
+                f"(graph inputs: {names})")
+        return {n: tuple(x_shape[n]) for n in names}
+    if len(names) == 1:
+        return {names[0]: tuple(x_shape)}
+    raise ValueError(
+        f"graph has {len(names)} inputs ({names}); pass x_shape as a "
+        "dict of input shapes, e.g. {name: (B, ...), ...}")
+
+
 def boundary_activation_elems(net, x_shape):
     """Per-layer boundary activation element counts via jax.eval_shape
     (abstract — nothing executes). Only conv/dense/pool boundaries
     count; elementwise chains between them are fusable and carry no
     unavoidable HBM traffic. Works for MultiLayerNetwork AND
     ComputationGraph by recording each boundary layer's forward output
-    shape during the abstract trace."""
+    shape during the abstract trace; multi-input graphs pass `x_shape`
+    as a {input_name: shape} dict."""
     import jax
 
+    shapes = _input_shapes(net, x_shape)
     recorded = []
     wrapped = []
     for layer in _boundary_layer_objects(net):
@@ -376,17 +443,17 @@ def boundary_activation_elems(net, x_shape):
         layer.forward = mk(orig)  # instance attr shadows the class method
         wrapped.append(layer)
     try:
-        x = jax.ShapeDtypeStruct(tuple(x_shape),
-                                 np.dtype(net._compute_dtype))
+        dt = np.dtype(net._compute_dtype)
         if hasattr(net, "layers"):
+            x = jax.ShapeDtypeStruct(shapes, dt)
             jax.eval_shape(
                 lambda xx: net._forward_infer(net._params, net._states, xx),
                 x)
         else:
-            name = net.conf.networkInputs[0]
+            xs = {n: jax.ShapeDtypeStruct(s, dt) for n, s in shapes.items()}
             jax.eval_shape(
-                lambda xx: net._forward_infer(net._params, net._states,
-                                              {name: xx}), x)
+                lambda inputs: net._forward_infer(net._params, net._states,
+                                                  inputs), xs)
     finally:
         for layer in wrapped:
             del layer.__dict__["forward"]
@@ -411,7 +478,11 @@ def train_step_floor(net, x_shape, optimizer_slots=1):
     pb = np.dtype(net._param_dtype).itemsize
     P = int(sum(a.size for a in _tree_leaves(net._params)))
     A = int(sum(boundary_activation_elems(net, x_shape)))
-    Bx = int(np.prod(x_shape))
+    shapes = _input_shapes(net, x_shape)
+    if isinstance(shapes, dict):  # multi-input graph: every batch reads
+        Bx = int(sum(np.prod(s) for s in shapes.values()))
+    else:
+        Bx = int(np.prod(shapes))
     # when compute dtype == param dtype there IS no separate cast copy:
     # fwd+bwd read the master buffers directly (2 reads) — charging the
     # 3-touch copy there would push the "floor" ABOVE real programs

@@ -124,6 +124,41 @@ class TestHeadlineSelection:
             assert rec["images_per_sec"] > 0
 
 
+class TestTunnelProbe:
+    """The fail-fast tunnel probe (VERDICT r5 #10): a bounded
+    subprocess jax.devices() before the headline, so a dead tunnel
+    costs 60 s + a clean `tunnel_dead` record instead of the whole
+    780 s headline budget."""
+
+    def test_alive_returns_device_count(self):
+        alive, n = bench._tunnel_probe(60, code="print(8)")
+        assert alive is True and n == 8
+
+    def test_hang_is_bounded_and_reported(self):
+        alive, why = bench._tunnel_probe(
+            1, code="import time; time.sleep(30)")
+        assert alive is False and "hung" in why
+
+    def test_failing_probe_reports_stderr(self):
+        alive, why = bench._tunnel_probe(
+            30, code="raise RuntimeError('no TPU behind tunnel')")
+        assert alive is False and "no TPU behind tunnel" in why
+
+    def test_emit_tunnel_dead_marks_configs_and_banks_cpu_leg(
+            self, monkeypatch, capsys):
+        monkeypatch.setattr(bench, "bench_grad_sharing_virtual",
+                            lambda budget: {"cpu_only": True})
+        monkeypatch.setattr(bench, "_CONFIGS", {})
+        bench._emit_tunnel_dead("jax.devices() hung > 60s")
+        for name, _ in bench.SECONDARY_CONFIGS:
+            assert bench._CONFIGS[name] == {"error": "tunnel_dead"}
+        # the CPU-only virtual-mesh config never touches the chip: banked
+        assert bench._CONFIGS["grad_sharing"] == {"cpu_only": True}
+        line = json.loads(capsys.readouterr().out.splitlines()[-1])
+        assert "tunnel_dead" in line["error"]
+        assert line["configs"]["fit_dataset"] == {"error": "tunnel_dead"}
+
+
 class TestMaxpoolABSelection:
     def test_argmax_winning_flips_default(self, stub, monkeypatch):
         monkeypatch.setattr(bench, "bench_maxpool_backward",
