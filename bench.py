@@ -55,9 +55,6 @@ BASELINE_IMG_PER_SEC = 800.0  # nd4j-cuda + cuDNN fp16, V100, batch 128+
 # config wins.
 CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          ".jax_cache")
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", CACHE_DIR)
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 
 # DL4J_BENCH_SMOKE=1: tiny-shape CPU rehearsal of the ENTIRE bench
 # pipeline (headline A/B legs, ledger wiring, partial banking,
@@ -69,6 +66,15 @@ if SMOKE:
     import jax as _jax  # pin before any backend init (see conftest.py)
 
     _jax.config.update("jax_platforms", "cpu")
+else:
+    # persistent cache only on real runs: it exists to save TPU compile
+    # budget, and on this container's jaxlib a warm-cache run can
+    # segfault deserializing a donated-buffer executable (the conftest
+    # note; reproduced killing the round-6 SMOKE secondaries group) —
+    # a CPU rehearsal gets seconds-cheap compiles and zero risk instead
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", CACHE_DIR)
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 
 # The tunneled test TPU goes unresponsive for hours at a stretch
 # (BENCH_NOTES.md). If THIS run cannot reach the chip, the error record
@@ -132,6 +138,10 @@ def bench_resnet50():
                 s2d["hbm_ledger"] = dict(rec["hbm_ledger"],
                                          note="computed on the "
                                               "standard-stem program")
+            if "hbm_attribution" in rec:
+                s2d["hbm_attribution"] = dict(
+                    rec["hbm_attribution"],
+                    note="computed on the standard-stem program")
             rec = s2d
         else:
             rec["stem_space_to_depth"] = {k: s2d[k] for k in
@@ -140,7 +150,43 @@ def bench_resnet50():
     except Exception as e:
         rec["stem_space_to_depth"] = {"error": f"{type(e).__name__}: {e}"[:200]}
     print("\nBENCHREC-PARTIAL " + json.dumps(rec), flush=True)
-    # Third A/B: checkpointPolicy="save_conv_outputs" (named-residual
+    # Third A/B: the round-6 dtype-tail policy. The library default
+    # ("compute") keeps activation-scale BN/loss math in bf16 with fp32
+    # only in fused reduce accumulators; the "wide" leg recompiles with
+    # the legacy fp32 tails. cost_analysis bytes/step of both legs are
+    # recorded — the byte cut is provable on CPU/SMOKE, the rate decides
+    # the headline exactly like the other A/Bs.
+    if os.environ.get("DL4J_TPU_TAIL_AB", "") != "off":
+        try:
+            wd = _measure_resnet50(rec["stem"], tail_mode="wide")
+            sub = {k: wd[k] for k in ("images_per_sec", "step_ms", "mfu",
+                                      "hbm_bytes_per_step")}
+            rec["dtype_tail_ab"] = {
+                "wide": sub,
+                "compute": {k: rec[k] for k in
+                            ("images_per_sec", "step_ms", "mfu",
+                             "hbm_bytes_per_step")},
+                "bytes_cut": round(wd["hbm_bytes_per_step"]
+                                   - rec["hbm_bytes_per_step"], 1),
+                "headline_uses": "compute",
+            }
+            if wd["images_per_sec"] > rec["images_per_sec"]:
+                # self-protection: if the wide tail measures FASTER on
+                # this backend the headline must not carry a
+                # self-inflicted regression — flip, carry the banked
+                # analyses, and say so
+                for carry in ("maxpool_backward_ab", "stem",
+                              "stem_space_to_depth", "stem_standard",
+                              "hbm_ledger", "hbm_attribution",
+                              "dtype_tail_ab"):
+                    if carry in rec:
+                        wd[carry] = rec[carry]
+                wd["dtype_tail_ab"]["headline_uses"] = "wide"
+                rec = wd
+        except Exception as e:
+            rec["dtype_tail_ab"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        print("\nBENCHREC-PARTIAL " + json.dumps(rec), flush=True)
+    # Fourth A/B: checkpointPolicy="save_conv_outputs" (named-residual
     # remat — recompute BN/relu/add tails in the backward instead of
     # storing them; trades recompute FLOPs for HBM traffic, the round-4
     # BENCH_NOTES lever). Same self-protection as the maxpool A/B: the
@@ -156,7 +202,8 @@ def bench_resnet50():
                                     "hbm_bytes_per_step")}
                 for carry in ("maxpool_backward_ab", "stem",
                               "stem_space_to_depth", "stem_standard",
-                              "hbm_ledger"):
+                              "hbm_ledger", "hbm_attribution",
+                              "dtype_tail_ab"):
                     if carry in rec:
                         rm[carry] = rec[carry]
                 rm["headline_uses_remat"] = True
@@ -168,7 +215,31 @@ def bench_resnet50():
     return rec
 
 
-def _measure_resnet50(stem, remat=False):
+class _tail_mode:
+    """Trace-time dtype-tail override for the BN/loss tails (ops/norm
+    and nn/losses _TAIL_MODE): the round-6 dtype-policy A/B flips both
+    to "wide" (the pre-round-6 fp32 activation-scale lowering) around
+    one leg's lower+compile, then restores."""
+
+    def __init__(self, mode):
+        self.mode = mode
+
+    def __enter__(self):
+        from deeplearning4j_tpu.nn import losses as _lo
+        from deeplearning4j_tpu.ops import norm as _no
+
+        self._mods = (_lo, _no)
+        self._old = (_lo._TAIL_MODE, _no._TAIL_MODE)
+        if self.mode is not None:
+            _lo._TAIL_MODE = _no._TAIL_MODE = self.mode
+        return self
+
+    def __exit__(self, *exc):
+        self._mods[0]._TAIL_MODE, self._mods[1]._TAIL_MODE = self._old
+        return False
+
+
+def _measure_resnet50(stem, remat=False, tail_mode=None):
     import jax
     import jax.numpy as jnp
 
@@ -198,10 +269,14 @@ def _measure_resnet50(stem, remat=False):
 
     # ONE compile: the AOT executable serves cost_analysis AND the timing
     # loop (lower().compile() does not populate the jit dispatch cache, so
-    # calling `step` afterwards would compile ResNet-50 a second time)
+    # calling `step` afterwards would compile ResNet-50 a second time).
+    # tail_mode (the dtype-policy A/B) is a trace-time switch, so it
+    # wraps exactly the lower().
     t0 = time.perf_counter()
-    compiled = step.lower(net._params, net._upd_states, net._states, it0,
-                          inputs, [y], key, None, None).compile()
+    with _tail_mode(tail_mode):
+        lowered = step.lower(net._params, net._upd_states, net._states,
+                             it0, inputs, [y], key, None, None)
+        compiled = lowered.compile()
     compile_s = time.perf_counter() - t0
     ca = compiled.cost_analysis()
     if isinstance(ca, (list, tuple)):
@@ -210,7 +285,8 @@ def _measure_resnet50(stem, remat=False):
             "bytes_accessed": float((ca or {}).get("bytes accessed", 0.0))}
 
     ledger_rec = None
-    if stem == "standard" and not remat:
+    attribution_rec = None
+    if stem == "standard" and not remat and tail_mode is None:
         # per-op HBM table + analytic roofline floor (VERDICT r4 #2):
         # pure host-side HLO text parsing + abstract shape eval, cheap
         try:
@@ -231,6 +307,24 @@ def _measure_resnet50(stem, remat=False):
             }
         except Exception as e:
             ledger_rec = {"error": f"{type(e).__name__}: {e}"[:200]}
+        # round-6 attribution: the per-category bill of the ledger-vs-
+        # floor gap (hbm_ledger.attribute_ledger), plus the dtype-policy
+        # audit — zero wide-float activation-scale buffers is the
+        # acceptance bar for the bf16 tail fix
+        try:
+            from deeplearning4j_tpu.util import hbm_ledger
+            att = hbm_ledger.attribute_ledger(
+                compiled, net=net, x_shape=(B, image, image, 3),
+                optimizer_slots=1, top=3)
+            # model-policy audit on the PRE-OPT lowering (backend
+            # passes widen things the model never asked for — see
+            # hbm_ledger.pre_opt_hlo)
+            att["wide_activation_buffers"] = len(
+                hbm_ledger.audit_activation_dtypes(
+                    hbm_ledger.pre_opt_hlo(lowered), net=net))
+            attribution_rec = att
+        except Exception as e:
+            attribution_rec = {"error": f"{type(e).__name__}: {e}"[:200]}
 
     p, u, s = net._params, net._upd_states, net._states
     for it in range(1 if SMOKE else 2):  # warmup (compiled-step runs)
@@ -259,6 +353,8 @@ def _measure_resnet50(stem, remat=False):
     }
     if ledger_rec is not None:
         rec["hbm_ledger"] = ledger_rec
+    if attribution_rec is not None:
+        rec["hbm_attribution"] = attribution_rec
     return rec
 
 
@@ -688,17 +784,163 @@ def bench_fit_dataset():
     loop_s = time.perf_counter() - t0
     syncs = net._fit_dataset_syncs
 
+    # round-6 layout A/B: host-canonical staging (library default —
+    # the staged stack arrives NHWC + compute dtype, no per-step entry
+    # transpose/convert in the loop program) vs the legacy "device"
+    # staging. cost_analysis bytes of both loop executables are the
+    # CPU-provable half; wall time picks the loop leg's headline.
+    from deeplearning4j_tpu.nn import multilayer as _ml
+    canon_rec = None
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.data.iterators import (iter_stacks,
+                                                       stack_datasets)
+
+        # the primary loop above ran under the AMBIENT staging mode
+        # (host by default, device if DL4J_TPU_CANON_STAGING=device) —
+        # the counter-leg must time the OPPOSITE mode, not
+        # unconditionally "device", or an env-overridden run would A/B
+        # device against itself and label the noise "host"
+        ambient_host = _ml.canon_staging_on()
+        old = _ml._CANON_STAGING
+        try:
+            _ml._CANON_STAGING = "device" if ambient_host else "host"
+            net.fitDataSet(it, stepsPerSync=K)  # compile+warm counter-leg
+            t0 = time.perf_counter()
+            net.fitDataSet(it, stepsPerSync=K)
+            other_s = time.perf_counter() - t0
+        finally:
+            _ml._CANON_STAGING = old
+        host_s, dev_s = ((loop_s, other_s) if ambient_host
+                         else (other_s, loop_s))
+
+        def loop_cost_bytes(canon):
+            jl = _ml.fit_dataset_jit(net, K, canonical=canon)  # cached
+            it.reset()
+            batches = next(iter_stacks(it, K))
+            xs, ys, fms, lms = (net._stack_canonical(batches) if canon
+                                else stack_datasets(batches))
+            ca = jl.lower(net._params, net._upd_states, net._states,
+                          jnp.asarray(0, jnp.int32), xs, ys, fms,
+                          lms).compile().cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            # per k-block program; /K for the per-step bill
+            return float((ca or {}).get("bytes accessed", 0.0)) / K
+
+        host_b = loop_cost_bytes(True)
+        dev_b = loop_cost_bytes(False)
+        canon_rec = {
+            "host_bytes_per_step": round(host_b, 1),
+            "device_bytes_per_step": round(dev_b, 1),
+            "bytes_cut_per_step": round(dev_b - host_b, 1),
+            "host_epoch_s": round(host_s, 3),
+            "device_epoch_s": round(dev_s, 3),
+            "headline_uses": "host" if host_s <= dev_s else "device",
+        }
+        if other_s < loop_s:
+            loop_s = other_s  # self-protection: faster leg is the number
+    except Exception as e:
+        canon_rec = {"error": f"{type(e).__name__}: {e}"[:200]}
+
+    loop_rec = {
+        "images_per_sec": round(NB * B / loop_s, 1),
+        "epoch_s": round(loop_s, 3), "batch": B, "batches": NB,
+        "steps_per_sync": K, "host_syncs": syncs,
+        "note": f"fitDataSet(stepsPerSync={K}): k-stack on-device "
+                "loop, double-buffered staging, one loss fetch per "
+                f"{K} fresh batches"}
+    if canon_rec is not None:
+        loop_rec["canon_staging_ab"] = canon_rec
     return _pick_faster(
         "images_per_sec",
-        {"images_per_sec": round(NB * B / loop_s, 1),
-         "epoch_s": round(loop_s, 3), "batch": B, "batches": NB,
-         "steps_per_sync": K, "host_syncs": syncs,
-         "note": f"fitDataSet(stepsPerSync={K}): k-stack on-device "
-                 "loop, double-buffered staging, one loss fetch per "
-                 f"{K} fresh batches"},
+        loop_rec,
         {"images_per_sec": round(NB * B / fit_s, 1),
          "epoch_s": round(fit_s, 3), "batch": B, "batches": NB,
          "note": "fit(iterator): per-batch transfer + loss fetch"})
+
+
+def bench_int8_inference():
+    """ResNet-50 batch inference img/s: weight-only int8 (nn/quantize)
+    vs bf16, both as one AOT executable serving cost_analysis AND the
+    timing loop. The attribution story is the weight term: int8 halves
+    the resident/streamed weight bytes vs bf16 (param_bytes reported
+    both ways) — on a bandwidth-bound chip that is the inference
+    speedup ceiling. Top-1 agreement between the two legs is recorded
+    so a quantization-quality regression cannot hide in a throughput
+    table. SMOKE runs the full plumbing at tiny shapes."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ndarray import DataType
+    from deeplearning4j_tpu.nn import Nesterovs
+    from deeplearning4j_tpu.nn import quantize as _q
+    from deeplearning4j_tpu.zoo import ResNet50
+
+    B, image, classes = (4, 32, 8) if SMOKE else (128, 224, 1000)
+    iters = 2 if SMOKE else 20
+    net = ResNet50(numClasses=classes, inputShape=(3, image, image),
+                   updater=Nesterovs(0.1, 0.9),
+                   dataType=DataType.BFLOAT16, dataFormat="NHWC").init()
+    rng = np.random.RandomState(0)
+    x = jax.device_put(jnp.asarray(rng.rand(B, image, image, 3),
+                                   jnp.bfloat16))
+    inputs = {"input": x}
+    states = net._strip_carries(net._states)
+
+    def first(out):
+        return out[0] if isinstance(out, (list, tuple)) else out
+
+    def measure(fn, *args):
+        compiled = jax.jit(fn).lower(*args).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        nbytes = float((ca or {}).get("bytes accessed", 0.0))
+        out = compiled(*args)
+        jnp.asarray(first(out)).block_until_ready()  # compile+warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = compiled(*args)
+        o = jnp.asarray(first(out))
+        o.block_until_ready()
+        return (time.perf_counter() - t0) / iters, nbytes, o
+
+    # bf16 leg: params pre-cast to bf16 on host — inference has no fp32
+    # master to protect, and the cast copy would pollute the weight-
+    # traffic comparison
+    p16 = jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16)
+        if jnp.issubdtype(a.dtype, jnp.floating) else a, net._params)
+    bf16_s, bf16_b, o16 = measure(
+        lambda p, xx: net._forward_infer(p, states, xx), p16, inputs)
+
+    qp, sc = _q.quantize_params_int8(net._params)
+    int8_s, int8_b, o8 = measure(
+        lambda q, s, xx: net._forward_infer(
+            _q.dequantize_params(q, s, net._compute_dtype), states, xx),
+        qp, sc, inputs)
+
+    agree = float(jnp.mean((jnp.argmax(o16.astype(jnp.float32), -1)
+                            == jnp.argmax(o8.astype(jnp.float32), -1))
+                           .astype(jnp.float32)))
+    return {
+        "bf16_img_per_sec": round(B / bf16_s, 1),
+        "int8_img_per_sec": round(B / int8_s, 1),
+        "speedup": round(bf16_s / int8_s, 3),
+        "bf16_bytes_per_step": bf16_b,
+        "int8_bytes_per_step": int8_b,
+        "weight_bytes_bf16": _q.param_bytes(p16),
+        "weight_bytes_int8": _q.param_bytes(qp),
+        "top1_agreement": round(agree, 4),
+        "batch": B,
+        "note": ("weight-only int8 (symmetric per-channel absmax, "
+                 "nn/quantize) vs bf16 ResNet-50 batch inference; "
+                 "weight_bytes_* is the resident/streamed weight cut "
+                 "the attribution prices"),
+    }
 
 
 def bench_resilience():
@@ -870,6 +1112,7 @@ SECONDARY_CONFIGS = [("attention", "bench_attention"),
                      ("samediff_mlp", "bench_samediff_mlp"),
                      ("lstm_tbptt", "bench_lstm_tbptt"),
                      ("fit_dataset", "bench_fit_dataset"),
+                     ("int8_inference", "bench_int8_inference"),
                      ("prefetch", "bench_prefetch"),
                      ("resilience", "bench_resilience"),
                      ("analysis", "bench_analysis"),

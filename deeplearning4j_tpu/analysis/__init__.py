@@ -1,6 +1,6 @@
 """Pre-compilation static analysis.
 
-Five passes, one CLI (``python -m deeplearning4j_tpu.analysis``):
+Six passes, one CLI (``python -m deeplearning4j_tpu.analysis``):
 
 - shape/dtype inference over model configs (shapes.validate_model)
 - SameDiff graph validation (samediff_check.validate_samediff)
@@ -10,6 +10,9 @@ Five passes, one CLI (``python -m deeplearning4j_tpu.analysis``):
   (partitioning.validate_plan, CLI ``--parallel``)
 - recompilation-hazard lint + runtime compile counter
   (retrace.lint_retrace_paths / retrace.RetraceSentinel)
+- HBM gap attribution + dtype-policy audit of a named subject's
+  compiled train step (hbm.run_attribution, CLI ``--attribution`` —
+  the one pass that pays a host XLA compile)
 
 See docs/ANALYSIS.md for the diagnostic catalogue and suppression
 syntax. ``MultiLayerNetwork.init(validate=True)`` /

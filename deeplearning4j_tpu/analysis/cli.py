@@ -63,6 +63,14 @@ def _build_parser():
                    help="per-chip HBM budget in GB for the PAR06 fit "
                         "prediction (no budget: the prediction is "
                         "reported but never fails)")
+    p.add_argument("--attribution", nargs="?", const="lenet",
+                   metavar="SUBJECT",
+                   help="compile SUBJECT's train step on the host "
+                        "backend and print the HBM gap attribution "
+                        "(floor vs layout/dtype/double-touch/collective "
+                        "bins) + dtype-policy audit; subjects: lenet "
+                        "(default), resnet_block. Pays a host XLA "
+                        "compile, unlike the static passes")
     return p
 
 
@@ -178,6 +186,23 @@ def main(argv=None):
         for code, desc in ALL_CODES.items():
             print(f"{code}  {desc}")
         return 0
+
+    if args.attribution:
+        from deeplearning4j_tpu.analysis.hbm import run_attribution
+
+        try:
+            rec, text = run_attribution(args.attribution,
+                                        batch_size=args.batch_size)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        if args.as_json:
+            print(_json.dumps(rec, indent=2))
+        else:
+            print(text)
+        # a dtype-policy leak in the bf16 subject is an error a CI gate
+        # wired to this command must see
+        return 1 if rec["wide_activation_buffers"] else 0
 
     if not args.zoo and not args.paths:
         _build_parser().print_usage()

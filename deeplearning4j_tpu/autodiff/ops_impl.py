@@ -485,52 +485,71 @@ def _simple_rnn(x, w, u, b, h0=None):
 
 
 # ---- loss ----
+# Dtype policy (round 6, the SameDiff loss tail): per-element loss math
+# stays in the graph's compute dtype; the reductions accumulate in
+# >= fp32 (`dtype=` on the reduce — XLA fuses the widening convert into
+# the reduction, so nothing fp32 materialises at activation scale) and
+# the returned loss scalar/per-example vector is fp32(+) for a sub-fp32
+# graph. Cross-entropy uses the vector-scale-fp32 log_softmax shared
+# with nn/losses so the [.., O] log-prob tensor keeps the input dtype.
+
+
+def _acc_t(x):
+    return jnp.promote_types(x.dtype, jnp.float32)
+
+
 def _reduce_loss(per_ex, reduction):
     if reduction == "MEAN_BY_WEIGHT" or reduction == "MEAN":
-        return jnp.mean(per_ex)
+        return jnp.mean(per_ex, dtype=_acc_t(per_ex))
     if reduction == "SUM":
-        return jnp.sum(per_ex)
+        return jnp.sum(per_ex, dtype=_acc_t(per_ex))
     return per_ex
 
 
 @op("lossMSE")
 def _loss_mse(labels, predictions, reduction="MEAN"):
-    return _reduce_loss(jnp.mean(jnp.square(predictions - labels), axis=-1),
-                        reduction)
+    per = jnp.mean(jnp.square(predictions - labels), axis=-1,
+                   dtype=_acc_t(predictions))
+    return _reduce_loss(per, reduction)
 
 
 @op("lossMAE")
 def _loss_mae(labels, predictions, reduction="MEAN"):
-    return _reduce_loss(jnp.mean(jnp.abs(predictions - labels), axis=-1),
-                        reduction)
+    return _reduce_loss(jnp.mean(jnp.abs(predictions - labels), axis=-1,
+                                 dtype=_acc_t(predictions)), reduction)
 
 
 @op("lossLog")
 def _loss_log(labels, predictions, reduction="MEAN", epsilon=1e-7):
     p = jnp.clip(predictions, epsilon, 1.0 - epsilon)
     per = -jnp.mean(labels * jnp.log(p) + (1 - labels) * jnp.log(1 - p),
-                    axis=-1)
+                    axis=-1, dtype=_acc_t(predictions))
     return _reduce_loss(per, reduction)
 
 
 @op("softmaxCrossEntropy")
 def _loss_sce(labels, logits, reduction="MEAN"):
-    per = -jnp.sum(labels * jax.nn.log_softmax(logits, axis=-1), axis=-1)
+    from deeplearning4j_tpu.nn.losses import _log_softmax
+
+    per = -jnp.sum(labels.astype(logits.dtype) * _log_softmax(logits),
+                   axis=-1, dtype=_acc_t(logits))
     return _reduce_loss(per, reduction)
 
 
 @op("sparseSoftmaxCrossEntropy")
 def _loss_ssce(labels, logits, reduction="MEAN"):
-    lp = jax.nn.log_softmax(logits, axis=-1)
+    from deeplearning4j_tpu.nn.losses import _log_softmax
+
+    lp = _log_softmax(logits)
     per = -jnp.take_along_axis(
         lp, labels.astype(jnp.int32)[..., None], axis=-1)[..., 0]
-    return _reduce_loss(per, reduction)
+    return _reduce_loss(per.astype(_acc_t(logits)), reduction)
 
 
 @op("lossHinge")
 def _loss_hinge(labels, predictions, reduction="MEAN"):
     per = jnp.mean(jnp.maximum(0.0, 1.0 - (2.0 * labels - 1.0) * predictions),
-                   axis=-1)
+                   axis=-1, dtype=_acc_t(predictions))
     return _reduce_loss(per, reduction)
 
 
@@ -538,7 +557,8 @@ def _loss_hinge(labels, predictions, reduction="MEAN"):
 def _loss_huber(labels, predictions, delta=1.0, reduction="MEAN"):
     d = jnp.abs(predictions - labels)
     per = jnp.mean(jnp.where(d <= delta, 0.5 * d * d,
-                             delta * d - 0.5 * delta * delta), axis=-1)
+                             delta * d - 0.5 * delta * delta), axis=-1,
+                   dtype=_acc_t(predictions))
     return _reduce_loss(per, reduction)
 
 
@@ -546,13 +566,14 @@ def _loss_huber(labels, predictions, delta=1.0, reduction="MEAN"):
 def _loss_kld(labels, predictions, reduction="MEAN", epsilon=1e-7):
     l = jnp.clip(labels, epsilon, 1.0)
     p = jnp.clip(predictions, epsilon, 1.0)
-    return _reduce_loss(jnp.sum(l * jnp.log(l / p), axis=-1), reduction)
+    return _reduce_loss(jnp.sum(l * jnp.log(l / p), axis=-1,
+                                dtype=_acc_t(predictions)), reduction)
 
 
 @op("lossPoisson")
 def _loss_poisson(labels, predictions, reduction="MEAN"):
     per = jnp.mean(predictions - labels * jnp.log(predictions + 1e-7),
-                   axis=-1)
+                   axis=-1, dtype=_acc_t(predictions))
     return _reduce_loss(per, reduction)
 
 
@@ -561,7 +582,8 @@ def _loss_cosine(labels, predictions, dimension=-1, reduction="MEAN"):
     ln = labels / (jnp.linalg.norm(labels, axis=dimension, keepdims=True) + 1e-12)
     pn = predictions / (jnp.linalg.norm(predictions, axis=dimension,
                                         keepdims=True) + 1e-12)
-    return _reduce_loss(1.0 - jnp.sum(ln * pn, axis=dimension), reduction)
+    return _reduce_loss(1.0 - jnp.sum(ln * pn, axis=dimension,
+                                      dtype=_acc_t(predictions)), reduction)
 
 
 # ---- bitwise (int ops) ----

@@ -905,7 +905,16 @@ class SameDiff:
                 env.update(phs)
                 outs = self._run_graph(env, loss_names, train=True,
                                        rng=rng)
-                loss = sum(jnp.sum(o) for o in outs.values())
+                # loss-tail policy (round 6): a marked loss variable may
+                # be per-example (reduction NONE) in a sub-fp32 graph —
+                # accumulate its sum in fp32 INSIDE the reduce (the
+                # widening convert fuses; no fp32 activation-scale
+                # buffer materialises) so the training loss is fp32
+                # regardless of compute dtype
+                loss = sum(
+                    jnp.sum(o, dtype=jnp.promote_types(o.dtype,
+                                                       jnp.float32))
+                    for o in outs.values())
                 if tc.l2:
                     loss = loss + tc.l2 * sum(
                         jnp.sum(jnp.square(a)) for a in p.values())

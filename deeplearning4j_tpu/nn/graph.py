@@ -141,7 +141,14 @@ class ComputationGraph:
         return bool(its) and all(
             getattr(it, "format", "NCHW") == "NHWC" for it in its)
 
-    def _entry(self, name, x):
+    def _entry(self, name, x, already_internal=False):
+        if already_internal:
+            # staged on host in internal layout + compute dtype
+            # (fitDataSet canonical staging): no transpose/convert HLO
+            return x.astype(self._compute_dtype)
+        # cast BEFORE the relayout so the transpose moves compute-dtype
+        # bytes, not fp32 (see MultiLayerNetwork._entry)
+        x = x.astype(self._compute_dtype)
         it = self.conf.inputTypes.get(name)
         if it is not None and it.kind == InputType.CNN and x.ndim == 4:
             if getattr(it, "format", "NCHW") != "NHWC":
@@ -149,9 +156,31 @@ class ComputationGraph:
         if it is not None and it.kind == InputType.CNN_FLAT and x.ndim == 2:
             x = x.reshape(x.shape[0], it.channels, it.height, it.width)
             x = jnp.transpose(x, (0, 2, 3, 1))
-        return x.astype(self._compute_dtype)
+        return x
 
-    def _run_graph(self, params, states, inputs, train, key, fmasks):
+    def _canon_host(self, name, x, stacked=False):
+        """HOST-side equivalent of _entry for one input (see
+        MultiLayerNetwork._canon_host): numpy layout + dtype
+        canonicalisation of a staged [k, B, ...] stack."""
+        from deeplearning4j_tpu.nn.multilayer import host_to_nhwc
+
+        x = np.asarray(x)
+        it = self.conf.inputTypes.get(name)
+        o = 1 if stacked else 0
+        if it is not None and it.kind == InputType.CNN \
+                and x.ndim == 4 + o:
+            if getattr(it, "format", "NCHW") != "NHWC":
+                x = host_to_nhwc(x, stacked)
+        elif it is not None and it.kind == InputType.CNN_FLAT \
+                and x.ndim == 2 + o:
+            x = x.reshape(*x.shape[:o + 1], it.channels, it.height,
+                          it.width)
+            x = host_to_nhwc(x, stacked)
+        return np.ascontiguousarray(
+            x.astype(np.dtype(self._compute_dtype), copy=False))
+
+    def _run_graph(self, params, states, inputs, train, key, fmasks,
+                   canonical=False):
         """inputs: dict name->array. Returns (activations dict, preacts of
         output layers, new states). Masks propagate node-to-node: a node's
         mask is its first input's mask (reference:
@@ -162,7 +191,7 @@ class ComputationGraph:
         preacts = {}
         B = None
         for idx, name in enumerate(self.conf.networkInputs):
-            x = self._entry(name, inputs[name])
+            x = self._entry(name, inputs[name], already_internal=canonical)
             B = x.shape[0] if B is None else B
             acts[name] = x
             masks[name] = None if fmasks is None else fmasks.get(name)
@@ -258,17 +287,27 @@ class ComputationGraph:
             pre = preacts[name]
             y = labels[i]
             lmask = None if lmasks is None else lmasks[i]
-            ldt = jnp.promote_types(pre.dtype, jnp.float32)
+            # round-6 loss-tail policy: activation-scale loss math in
+            # the compute dtype, fp32 only inside the losses.py reduce
+            # accumulators (see nn/losses.tail_dtype); composite heads
+            # below keep the wide tail — their multi-term math is not
+            # covered by the fp32-accumulator policy
+            ldt = _losses.tail_dtype(pre.dtype)
             pre = pre.astype(ldt)
-            y = y.astype(ldt)
             if hasattr(layer, "computeLoss"):
                 # composite-loss heads (e.g. objdetect.Yolo2OutputLayer) own
                 # their full loss computation and expect the reference's
-                # NCHW label layout — restore it for NHWC-format networks
+                # NCHW label layout — restore it for NHWC-format networks.
+                # Their labels skip the ldt downcast: the head runs wide,
+                # and rounding fp32 box coordinates to bf16 first would
+                # lose label precision for nothing.
+                wdt = jnp.promote_types(pre.dtype, jnp.float32)
+                pre, y = pre.astype(wdt), y.astype(wdt)
                 if self._api_nhwc and y.ndim == 4:
                     y = jnp.transpose(y, (0, 3, 1, 2))
                 total = total + layer.computeLoss(pre, y, lmask)
                 continue
+            y = y.astype(ldt)
             if pre.ndim == 3:  # NCW preact: loss over [B,T,O]
                 pre = jnp.transpose(pre, (0, 2, 1))
                 y = jnp.transpose(y, (0, 2, 1))
@@ -289,7 +328,7 @@ class ComputationGraph:
         return reg
 
     def _loss_fn(self, params, states, inputs, labels, key, fmasks, lmasks,
-                 use_carries=False):
+                 use_carries=False, canonical=False):
         # frozen layers: structurally zero grads so XLA eliminates their
         # backward pass (see MultiLayerNetwork._loss_fn)
         params = {n: jax.tree_util.tree_map(jax.lax.stop_gradient, p)
@@ -297,19 +336,23 @@ class ComputationGraph:
                   for n, p in params.items()}
         run_states = states if use_carries else self._strip_carries(states)
         _, preacts, new_states = self._run_graph(
-            params, run_states, inputs, True, key, fmasks)
+            params, run_states, inputs, True, key, fmasks,
+            canonical=canonical)
         loss = self._loss(preacts, labels, lmasks) + self._regularization(params)
         return loss, new_states
 
     def _train_step(self, params, upd_states, states, iteration, inputs, labels,
                     key, fmasks, lmasks, use_carries=False,
                     grad_transform=None, loss_transform=None,
-                    state_transform=None):
+                    state_transform=None, canonical_inputs=False):
         """The *_transform hooks mirror MultiLayerNetwork._train_step:
         distributed wrappers (parallel.trainer) splice in cross-shard
-        allreduce/pmean without duplicating the updater loop."""
+        allreduce/pmean without duplicating the updater loop.
+        canonical_inputs=True: inputs staged host-side in the internal
+        layout + compute dtype (fitDataSet canonical staging)."""
         (loss, new_states), grads = jax.value_and_grad(
-            self._ckpt_loss_fn(use_carries), has_aux=True)(
+            self._ckpt_loss_fn(use_carries, canonical_inputs),
+            has_aux=True)(
             params, states, inputs, labels, key, fmasks, lmasks)
         if grad_transform is not None:
             grads = grad_transform(grads)
@@ -321,7 +364,7 @@ class ComputationGraph:
             from deeplearning4j_tpu.nn import solvers as _solvers
 
             def value_fn(ps):
-                return self._ckpt_loss_fn(use_carries)(
+                return self._ckpt_loss_fn(use_carries, canonical_inputs)(
                     ps, states, inputs, labels, key, fmasks, lmasks)[0]
 
             new_params, new_upd = _solvers.solver_update(
@@ -357,7 +400,7 @@ class ComputationGraph:
             new_upd[name] = us
         return new_params, new_upd, new_states, loss
 
-    def _ckpt_loss_fn(self, use_carries):
+    def _ckpt_loss_fn(self, use_carries, canonical=False):
         """_loss_fn, under the conf's named-residual remat policy when
         one is set. With checkpointPolicy="save_conv_outputs" the whole
         loss is a jax.checkpoint region whose policy saves ONLY tensors
@@ -368,7 +411,8 @@ class ComputationGraph:
         elementwise intermediate at the cost of re-reading the saved
         conv outputs — the BENCH_NOTES.md round-4 HBM lever."""
         def base(p, s, i, l, k, fm, lm):
-            return self._loss_fn(p, s, i, l, k, fm, lm, use_carries)
+            return self._loss_fn(p, s, i, l, k, fm, lm, use_carries,
+                                 canonical)
 
         if getattr(self.conf, "checkpointPolicy", None) != \
                 "save_conv_outputs":
@@ -604,6 +648,14 @@ class ComputationGraph:
                 for j in range(len(labs_l[0]))]
         return X, Y, FM, LM
 
+    def _stack_batches_canonical(self, batches):
+        """_stack_batches with every input stack canonicalised on host
+        (internal layout + compute dtype — see _canon_host); pairs with
+        fit_dataset_jit(canonical=True)."""
+        X, Y, FM, LM = self._stack_batches(batches)
+        X = {n: self._canon_host(n, x, stacked=True) for n, x in X.items()}
+        return X, Y, FM, LM
+
     def fitDataSet(self, iterator, stepsPerSync=1, epochs=None):
         """Epoch training with one host sync and one transfer per
         `stepsPerSync` fresh batches — the ComputationGraph form of
@@ -627,14 +679,21 @@ class ComputationGraph:
             raise ValueError(
                 "fitDataSet does not support truncated BPTT: use fit() "
                 "(per-batch windows) or fitSteps()")
-        jloop = fit_dataset_jit(self, k)
+        # layout hygiene (round 6): host-canonical staging, same A/B
+        # toggle as MultiLayerNetwork.fitDataSet
+        from deeplearning4j_tpu.nn.multilayer import canon_staging_on
+
+        canon = canon_staging_on()
+        jloop = fit_dataset_jit(self, k, canonical=canon)
+        stack = (self._stack_batches_canonical if canon
+                 else self._stack_batches)
         self._fit_dataset_syncs = 0
         for _ in range(epochs or 1):
             iterator.reset()
             for lst in self._listeners:
                 getattr(lst, "onEpochStart", lambda m: None)(self)
             self._fit_dataset_syncs += run_fit_dataset_epoch(
-                self, iterator, k, self._stack_batches, self._fit_ds, jloop)
+                self, iterator, k, stack, self._fit_ds, jloop)
             for lst in self._listeners:
                 getattr(lst, "onEpochEnd", lambda m: None)(self)
             self._epoch += 1

@@ -9,14 +9,61 @@ reference pairs separate activation and loss kernels and special-cases
 "softmax+mcxent" for stability; jax.nn gives us the stable forms directly).
 Masking matches the reference's per-timestep mask semantics: masked
 elements contribute zero loss and the mean is over unmasked elements.
+
+Dtype policy (round 6, the loss-tail fix): under a sub-fp32 compute
+dtype the default "compute" tail keeps every ACTIVATION-SCALE tensor
+(preactivations, per-element losses, log-probabilities) in the compute
+dtype — fp32 appears only in reduction accumulators (``jnp.sum(...,
+dtype=f32)``, where XLA fuses the widening convert into the reduce) and
+in vector-scale terms like the per-row logsumexp. The round-5 HBM
+attribution named fp32 activation-scale buffers in the loss/softmax
+tails as a ``dtype_widening`` bin; trainers used to cast the whole
+preact to fp32 before calling in here, which materialised exactly those
+buffers. The legacy all-fp32 tail stays available as mode "wide"
+(module global `_TAIL_MODE`, initial value from DL4J_TPU_LOSS_TAIL) so
+bench.py can A/B the two lowerings. `tail_dtype(dtype)` is the policy
+switch the trainers consult before casting.
 """
 
 from __future__ import annotations
+
+import os
 
 import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.nn import activations as _act
+
+#: "compute" (default) = activation-scale loss math in the compute
+#: dtype with fp32 accumulators; "wide" = the pre-round-6 all-fp32
+#: tail. Read at TRACE time.
+_TAIL_MODE = os.environ.get("DL4J_TPU_LOSS_TAIL", "compute")
+
+
+def tail_dtype(dtype):
+    """The dtype a trainer should cast preact/labels to before the loss
+    tail: fp32(+) in "wide" mode or when the compute dtype is already
+    >= fp32 (fp64 gradient-check oracles keep fp64); the compute dtype
+    itself otherwise — the fp32 accumulation then happens INSIDE the
+    reduces here, where it never materialises at activation scale."""
+    wide = jnp.promote_types(dtype, jnp.float32)
+    if _TAIL_MODE == "wide" or dtype == wide:
+        return wide
+    return dtype
+
+
+def _log_softmax(preact):
+    """log_softmax whose fp32 appears only at vector scale: max and the
+    logsumexp accumulate in fp32 (fused into the reduces), the [.., O]
+    tensors stay in the input dtype. In "wide" mode (fp32 input) this
+    is exactly jax.nn.log_softmax."""
+    ft = jnp.promote_types(preact.dtype, jnp.float32)
+    if preact.dtype == ft:
+        return jax.nn.log_softmax(preact, axis=-1)
+    m = jnp.max(preact, axis=-1, keepdims=True)
+    s = preact - m
+    lse = jnp.log(jnp.sum(jnp.exp(s), axis=-1, keepdims=True, dtype=ft))
+    return s - lse.astype(preact.dtype)
 
 
 class LossFunctions:
@@ -41,15 +88,23 @@ class LossFunctions:
 
 
 def _apply_mask_mean(per_elem, mask):
-    """Mean over unmasked elements. per_elem has shape [batch, ...]."""
+    """Mean over unmasked elements. per_elem has shape [batch, ...].
+    Reductions accumulate in fp32 (`dtype=ft` fuses the widening convert
+    into the reduce — nothing fp32 materialises at activation scale);
+    the returned scalar is always >= fp32."""
+    ft = jnp.promote_types(per_elem.dtype, jnp.float32)
     if mask is None:
-        return jnp.mean(jnp.sum(per_elem, axis=tuple(range(1, per_elem.ndim))))
+        return jnp.mean(jnp.sum(per_elem, axis=tuple(range(1, per_elem.ndim)),
+                                dtype=ft))
     # mask is per example/timestep ([batch] or [batch, time]); broadcast over
     # the output dim and normalise by the unmasked count, like the reference.
-    n_unmasked = jnp.maximum(jnp.sum(mask), 1.0)
+    # Cast the mask DOWN to the loss dtype first: a fp32 mask would promote
+    # the whole per-element product back to activation-scale fp32.
+    mask = mask.astype(per_elem.dtype)
+    n_unmasked = jnp.maximum(jnp.sum(mask, dtype=ft), 1.0)
     while mask.ndim < per_elem.ndim:
         mask = mask[..., None]
-    return jnp.sum(per_elem * mask) / n_unmasked
+    return jnp.sum(per_elem * mask, dtype=ft) / n_unmasked
 
 
 def compute(loss_name, labels, preact, activation="identity", mask=None, weights=None):
@@ -63,12 +118,12 @@ def compute(loss_name, labels, preact, activation="identity", mask=None, weights
 
     if name in ("mcxent", "negativeloglikelihood"):
         if activation == "softmax":
-            logp = jax.nn.log_softmax(preact, axis=-1)
+            logp = _log_softmax(preact)
         else:
             logp = jnp.log(jnp.clip(act(preact), 1e-10, 1.0))
         per = -labels * logp
         if weights is not None:
-            per = per * weights
+            per = per * jnp.asarray(weights, per.dtype)
         return _apply_mask_mean(per, mask)
 
     if name == "sparse_mcxent":
@@ -78,13 +133,15 @@ def compute(loss_name, labels, preact, activation="identity", mask=None, weights
         if idx.ndim == preact.ndim and idx.shape[-1] == 1:
             idx = idx[..., 0]
         if activation == "softmax":
-            logp = jax.nn.log_softmax(preact, axis=-1)
+            logp = _log_softmax(preact)
         else:
             logp = jnp.log(jnp.clip(act(preact), 1e-10, 1.0))
         per = -jnp.take_along_axis(logp, idx[..., None], axis=-1)
         if weights is not None:
-            # per-CLASS weights gather by each example's own class
-            per = per * jnp.asarray(weights)[idx][..., None]
+            # per-CLASS weights gather by each example's own class;
+            # cast DOWN to the loss dtype — fp32 weights would promote
+            # the activation-scale product back to fp32
+            per = per * jnp.asarray(weights, per.dtype)[idx][..., None]
         return _apply_mask_mean(per, mask)
 
     if name == "xent":
@@ -95,7 +152,7 @@ def compute(loss_name, labels, preact, activation="identity", mask=None, weights
             p = jnp.clip(act(preact), 1e-10, 1.0 - 1e-10)
             per = -(labels * jnp.log(p) + (1 - labels) * jnp.log1p(-p))
         if weights is not None:
-            per = per * weights
+            per = per * jnp.asarray(weights, per.dtype)
         return _apply_mask_mean(per, mask)
 
     out = act(preact)
@@ -138,7 +195,7 @@ def compute(loss_name, labels, preact, activation="identity", mask=None, weights
         raise ValueError(f"Unknown loss function '{loss_name}'")
 
     if weights is not None:
-        per = per * weights
+        per = per * jnp.asarray(weights, per.dtype)
     if name in ("mse", "mape", "msle"):
         # mean over the output dim as well (reference LossMSE/LossMAPE/
         # LossMSLE all divide by labels.size(1))

@@ -17,6 +17,7 @@ reference's signatures.
 from __future__ import annotations
 
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -99,8 +100,41 @@ def pick_batch(i, tree):
         tree)
 
 
+#: fitDataSet staging layout policy (round 6, the layout-hygiene fix):
+#: "host" (default) canonicalises the staged feature stack on the HOST —
+#: API layout -> internal NHWC/NDHWC and fp32 -> compute dtype BEFORE
+#: device_put — so the compiled k-loop never carries the per-step entry
+#: transpose+convert the HBM attribution names in its layout_copies /
+#: dtype_widening bins, and the H2D transfer itself halves under bf16.
+#: "device" keeps the legacy in-program conversion (the A/B leg in
+#: bench.py and the attribution tests flip this). Read at fitDataSet
+#: call time, so a test/bench can toggle the module global directly.
+_CANON_STAGING = os.environ.get("DL4J_TPU_CANON_STAGING", "host")
+
+
+def canon_staging_on():
+    return _CANON_STAGING != "device"
+
+
+def host_to_nhwc(x, stacked=False):
+    """numpy NCHW -> NHWC, optionally under a leading [k] staging dim —
+    the ONE definition of the stacked-axis transpose arithmetic shared
+    by MultiLayerNetwork._canon_host and ComputationGraph._canon_host
+    (each dispatches on the input KINDS its own _entry handles; the
+    axis math must not fork)."""
+    o = 1 if stacked else 0
+    return np.transpose(x, (*range(o), o, o + 2, o + 3, o + 1))
+
+
+def host_to_ndhwc(x, stacked=False):
+    """numpy NCDHW -> NDHWC, optionally under a leading [k] staging
+    dim (see host_to_nhwc)."""
+    o = 1 if stacked else 0
+    return np.transpose(x, (*range(o), o, o + 2, o + 3, o + 4, o + 1))
+
+
 def make_fit_dataset_loop(net, k, step_fn=None, guarded=False,
-                          max_bad=None):
+                          max_bad=None, canonical=False):
     """The on-device k-fresh-batch training loop shared by
     MultiLayerNetwork, ComputationGraph, ParallelWrapper and
     ResilientFit: a lax.fori_loop whose step i ``dynamic_index_in_dim``s
@@ -126,7 +160,16 @@ def make_fit_dataset_loop(net, k, step_fn=None, guarded=False,
     k-vector is replayed host-side through the TrainingListener chain.
     """
     seed_key = jax.random.key(net.conf.seed ^ 0x5EED)
-    step = step_fn if step_fn is not None else net._train_step
+    if step_fn is not None:
+        step = step_fn
+    elif canonical:
+        # the staged stack is already in the internal layout + compute
+        # dtype (host canonicalisation, see _CANON_STAGING): the step
+        # must not emit the entry transpose/convert again
+        step = lambda *a, **kw: net._train_step(
+            *a, canonical_inputs=True, **kw)
+    else:
+        step = net._train_step
 
     def loop(params, upd, states, it0, xs, ys, fms, lms, bad0=None):
         def body(i, carry):
@@ -175,7 +218,7 @@ def make_fit_dataset_loop(net, k, step_fn=None, guarded=False,
 
 
 def fit_dataset_jit(net, k, step_fn=None, guarded=False, owner=None,
-                    max_bad=None):
+                    max_bad=None, canonical=False):
     """Cached jit of make_fit_dataset_loop (one compile per k across an
     epoch — RetraceSentinel-provable via install_fit_dataset, which
     routes the loop through net._fit_dataset_wrap before jitting).
@@ -190,10 +233,13 @@ def fit_dataset_jit(net, k, step_fn=None, guarded=False, owner=None,
     cache = getattr(cache_owner, "_fit_dataset_cache", None)
     if cache is None:
         cache = cache_owner._fit_dataset_cache = {}
-    jloop = cache.get(k)
+    # canonical staging changes the traced program (no entry transpose/
+    # convert), so it must key the cache alongside k
+    jloop = cache.get((k, bool(canonical)))
     if jloop is None:
         loop = make_fit_dataset_loop(net, k, step_fn=step_fn,
-                                     guarded=guarded, max_bad=max_bad)
+                                     guarded=guarded, max_bad=max_bad,
+                                     canonical=canonical)
         wrap = getattr(net, "_fit_dataset_wrap", None)
         if wrap is not None:
             loop = wrap(loop)
@@ -201,7 +247,7 @@ def fit_dataset_jit(net, k, step_fn=None, guarded=False, owner=None,
             loop,
             donate_argnums=(0, 1, 2) if getattr(net, "_solver", None)
             is None else (2,))
-        cache[k] = jloop
+        cache[(k, bool(canonical))] = jloop
     return jloop
 
 
@@ -455,21 +501,61 @@ class MultiLayerNetwork:
         return (it is not None and it.kind == InputType.CNN
                 and getattr(it, "format", "NCHW") == "NHWC")
 
-    def _entry(self, x):
-        """API-format input -> internal format (one transpose at entry)."""
+    def _entry(self, x, already_internal=False):
+        """API-format input -> internal format (one transpose at entry).
+        already_internal=True: the caller staged the input in the
+        internal layout + compute dtype on the HOST (fitDataSet
+        canonical staging) — no transpose/convert HLO is emitted, which
+        is exactly the layout_copies/dtype_widening traffic the HBM
+        attribution charged to this entry."""
+        if already_internal:
+            return x.astype(self._compute_dtype)  # no-op when staged
+        # cast BEFORE the transpose: the relayout then moves compute-
+        # dtype bytes, not fp32 — the audit caught the old order as a
+        # wide activation-scale transpose
+        x = x.astype(self._compute_dtype)
         it = self.conf.inputType
         if it.kind == InputType.CNN and x.ndim == 4:
             if getattr(it, "format", "NCHW") != "NHWC":
                 x = jnp.transpose(x, (0, 2, 3, 1))  # NCHW -> NHWC
         elif it.kind == InputType.CNN3D and x.ndim == 5:
             x = jnp.transpose(x, (0, 2, 3, 4, 1))  # NCDHW -> NDHWC
-        return x.astype(self._compute_dtype)
+        return x
+
+    def _canon_host(self, x, stacked=False):
+        """HOST-side equivalent of _entry: numpy transpose to the
+        internal NHWC/NDHWC layout + cast to the compute dtype
+        (ml_dtypes bf16 casts round-to-nearest-even exactly like XLA's
+        convert, so the staged trajectory is bitwise the legacy one).
+        stacked=True shifts every axis by the leading [k] staging dim."""
+        x = np.asarray(x)
+        it = self.conf.inputType
+        o = 1 if stacked else 0
+        if it is not None and it.kind == InputType.CNN \
+                and x.ndim == 4 + o:
+            if getattr(it, "format", "NCHW") != "NHWC":
+                x = host_to_nhwc(x, stacked)
+        elif it is not None and it.kind == InputType.CNN3D \
+                and x.ndim == 5 + o:
+            x = host_to_ndhwc(x, stacked)
+        return np.ascontiguousarray(
+            x.astype(np.dtype(self._compute_dtype), copy=False))
+
+    def _stack_canonical(self, batches):
+        """stack_datasets with the feature stack canonicalised on host
+        (labels/masks stack unchanged — their layout work is loss-tail
+        business and they are batch-scale, not the 46.8 GB bill)."""
+        from deeplearning4j_tpu.data.iterators import stack_datasets
+
+        xs, ys, fms, lms = stack_datasets(batches)
+        return self._canon_host(xs, stacked=True), ys, fms, lms
 
     def _cast_params(self, p):
         return cast_params(p, self._compute_dtype, self._param_dtype)
 
-    def _run_layers(self, params, states, x, train, key, fmask):
-        h = self._entry(x)
+    def _run_layers(self, params, states, x, train, key, fmask,
+                    entry_done=False):
+        h = self._entry(x, already_internal=entry_done)
         new_states = []
         for i, layer in enumerate(self.layers):
             pp = self.conf.preprocessors.get(i)
@@ -516,11 +602,12 @@ class MultiLayerNetwork:
             new_states.append(s)
         return h, new_states
 
-    def _ckpt_loss_fn(self, use_carries):
+    def _ckpt_loss_fn(self, use_carries, canonical=False):
         """_loss_fn under the conf's named-residual remat policy when one
         is set (see ComputationGraph._ckpt_loss_fn — same contract)."""
         def base(p, s, x, y, k, fm, lm):
-            return self._loss_fn(p, s, x, y, k, fm, lm, use_carries)
+            return self._loss_fn(p, s, x, y, k, fm, lm, use_carries,
+                                 canonical)
 
         if getattr(self.conf, "checkpointPolicy", None) != \
                 "save_conv_outputs":
@@ -534,7 +621,12 @@ class MultiLayerNetwork:
         if hasattr(last, "computeLoss"):
             # composite-loss heads (e.g. objdetect.Yolo2OutputLayer) own
             # their full loss computation and expect the reference's NCHW
-            # label layout — restore it for NHWC-format networks
+            # label layout — restore it for NHWC-format networks. Their
+            # multi-term math is not covered by the losses.py fp32-
+            # accumulator policy, so they always run wide regardless of
+            # the tail mode (activation-scale, but one head tensor).
+            wdt = jnp.promote_types(preact.dtype, jnp.float32)
+            preact, labels = preact.astype(wdt), labels.astype(wdt)
             if self._api_nhwc and labels.ndim == 4:
                 labels = jnp.transpose(labels, (0, 3, 1, 2))
             return last.computeLoss(preact, labels, lmask)
@@ -561,7 +653,20 @@ class MultiLayerNetwork:
                 reg = reg + layer.regularization(p)
         return reg
 
-    def _loss_fn(self, params, states, x, y, key, fmask, lmask, use_carries):
+    def _tail_cast(self, preact, y):
+        """(preact, labels) cast for the loss tail: both to tail_dtype,
+        EXCEPT labels of a composite head (computeLoss) — those heads
+        re-widen to fp32 in _loss_from_preact, so downcasting their
+        fp32 labels (box coordinates etc.) here would round them for
+        nothing."""
+        ldt = _losses.tail_dtype(preact.dtype)
+        labels = _unwrap(y)
+        if not hasattr(self.layers[-1], "computeLoss"):
+            labels = labels.astype(ldt)
+        return preact.astype(ldt), labels
+
+    def _loss_fn(self, params, states, x, y, key, fmask, lmask, use_carries,
+                 canonical=False):
         # frozen layers (transfer learning): structurally zero grads — XLA
         # dead-code-eliminates their whole backward pass, which is the TPU
         # equivalent of the reference's FrozenLayer wrapper skipping backprop
@@ -569,22 +674,30 @@ class MultiLayerNetwork:
                   if getattr(l, "frozen", False) else p
                   for l, p in zip(self.layers, params)]
         run_states = states if use_carries else self._strip_carries(states)
-        preact, new_states = self._run_layers(params, run_states, x, True, key, fmask)
-        # loss math in >= fp32 (bf16 compute still gets an fp32 loss; fp64
-        # gradient checks keep fp64)
-        ldt = jnp.promote_types(preact.dtype, jnp.float32)
-        loss = self._loss_from_preact(preact.astype(ldt), _unwrap(y).astype(ldt), lmask)
+        preact, new_states = self._run_layers(params, run_states, x, True,
+                                              key, fmask,
+                                              entry_done=canonical)
+        # loss-tail dtype policy (round 6): under bf16 compute the
+        # activation-scale loss math stays bf16 — fp32 appears only in
+        # the fused reduce accumulators inside nn/losses (tail_dtype
+        # returns fp32 in "wide" mode and for fp32/fp64 nets, where the
+        # old promote-to-fp32 behaviour is unchanged)
+        loss = self._loss_from_preact(*self._tail_cast(preact, y), lmask)
         loss = loss + self._regularization(params)
         return loss, new_states
 
     def _train_step(self, params, upd_states, states, iteration, x, y, key,
                     fmask, lmask, use_carries=False, grad_transform=None,
-                    loss_transform=None, state_transform=None):
+                    loss_transform=None, state_transform=None,
+                    canonical_inputs=False):
         """The fused step. The *_transform hooks let distributed wrappers
         (parallel.trainer) splice in an explicit cross-shard allreduce /
-        pmean without duplicating the updater loop."""
+        pmean without duplicating the updater loop. canonical_inputs=True
+        asserts x is already in the internal layout + compute dtype
+        (fitDataSet host staging) and skips the entry transpose/convert."""
         (loss, new_states), grads = jax.value_and_grad(
-            self._ckpt_loss_fn(use_carries), has_aux=True)(
+            self._ckpt_loss_fn(use_carries, canonical_inputs),
+            has_aux=True)(
             params, states, x, y, key, fmask, lmask)
         if grad_transform is not None:
             grads = grad_transform(grads)
@@ -600,7 +713,7 @@ class MultiLayerNetwork:
             from deeplearning4j_tpu.nn import solvers as _solvers
 
             def value_fn(ps):
-                return self._ckpt_loss_fn(use_carries)(
+                return self._ckpt_loss_fn(use_carries, canonical_inputs)(
                     ps, states, x, y, key, fmask, lmask)[0]
 
             new_params, new_upd = _solvers.solver_update(
@@ -668,8 +781,7 @@ class MultiLayerNetwork:
     def _loss_only(self, params, states, x, y, fmask=None, lmask=None):
         preact, _ = self._run_layers(params, self._strip_carries(states),
                                      x, False, None, fmask)
-        ldt = jnp.promote_types(preact.dtype, jnp.float32)
-        loss = self._loss_from_preact(preact.astype(ldt), _unwrap(y).astype(ldt), lmask)
+        loss = self._loss_from_preact(*self._tail_cast(preact, y), lmask)
         return loss + self._regularization(params)
 
     @staticmethod
@@ -875,14 +987,20 @@ class MultiLayerNetwork:
                 "fitDataSet does not support truncated BPTT: the k-batch "
                 "stack would need a second on-device window sweep per "
                 "step; use fit() (per-batch windows) or fitSteps()")
-        jloop = fit_dataset_jit(self, k)
+        # layout hygiene (round 6): canonicalise the staged stack on the
+        # host (internal layout + compute dtype) so the k-loop program
+        # carries no per-step entry transpose/convert — see
+        # _CANON_STAGING for the A/B toggle
+        canon = canon_staging_on()
+        jloop = fit_dataset_jit(self, k, canonical=canon)
+        stack = self._stack_canonical if canon else stack_datasets
         self._fit_dataset_syncs = 0
         for _ in range(epochs or 1):
             iterator.reset()
             for lst in self._listeners:
                 getattr(lst, "onEpochStart", lambda m: None)(self)
             self._fit_dataset_syncs += run_fit_dataset_epoch(
-                self, iterator, k, stack_datasets, self._fit_batch, jloop)
+                self, iterator, k, stack, self._fit_batch, jloop)
             for lst in self._listeners:
                 getattr(lst, "onEpochEnd", lambda m: None)(self)
             self._epoch += 1

@@ -1,0 +1,102 @@
+"""Weight-only int8 quantization for batch inference.
+
+Reference: the upstream CUDA stack ships no int8 path; this is a
+TPU-first capability motivated by the round-5 HBM attribution: batch
+inference on a bandwidth-bound chip is priced by WEIGHT traffic, and an
+int8 weight read moves half the bytes of the bf16 read (a quarter of
+fp32). Scheme: symmetric absmax quantization, per-output-channel for
+matrix/conv weights (last axis of the HWIO/IO layouts used throughout);
+vector leaves (biases, BN gamma/beta — a negligible byte slice with an
+outsized accuracy risk under a shared scale) pass through unquantized
+in the tree API, while quantize_leaf_int8 offers per-tensor scaling for
+direct use — q = round(w * 127 / absmax) stored as int8,
+dequantized to the compute dtype INSIDE the jitted forward, so the HBM
+resident and transferred weights are the int8 buffers and XLA fuses the
+dequant multiply into each consumer.
+
+This is inference-only machinery: training keeps fp32 masters. The
+bench.py `int8_inference` leg A/Bs it against bf16 on ResNet-50 and the
+attribution engine quantifies the weight-bandwidth cut.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def quantize_leaf_int8(w):
+    """One float array -> (int8 q, float32 scale) with symmetric absmax
+    scaling; scale is per-output-channel (last axis) for ndim >= 2,
+    per-tensor for vectors. w == q * scale up to 1/254 absolute-of-max
+    rounding error."""
+    w = jnp.asarray(w)
+    wf = w.astype(jnp.float32)
+    if w.ndim >= 2:
+        absmax = jnp.max(jnp.abs(wf), axis=tuple(range(w.ndim - 1)))
+    else:
+        absmax = jnp.max(jnp.abs(wf))
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def quantize_params_int8(params):
+    """Quantize every float MATRIX/CONV leaf (ndim >= 2) of a params
+    pytree (list- or dict-structured, both network classes) ->
+    (q_params, scales) with IDENTICAL tree structure. Vector leaves
+    (biases, BN gamma/beta) stay in their float dtype: they are a
+    negligible slice of the weight bytes the int8 cut targets, and a
+    shared absmax scale on a small-magnitude shift term (a BN beta
+    spanning [-0.01, 3]) would cost up to 100% relative error on the
+    small entries. Passed-through leaves keep their value and get a
+    dummy 1.0 scale — None would vanish as an empty subtree and break
+    the paired tree_map in dequantize_params."""
+    def q(a):
+        aj = jnp.asarray(a)
+        if aj.ndim >= 2 and jnp.issubdtype(aj.dtype, jnp.floating):
+            return quantize_leaf_int8(a)
+        return a, jnp.float32(1.0)
+
+    pairs = jax.tree_util.tree_map(q, params)
+    qp = jax.tree_util.tree_map(lambda t: t[0], pairs,
+                                is_leaf=lambda x: isinstance(x, tuple))
+    sc = jax.tree_util.tree_map(lambda t: t[1], pairs,
+                                is_leaf=lambda x: isinstance(x, tuple))
+    return qp, sc
+
+
+def dequantize_params(q_params, scales, dtype):
+    """int8 pytree -> compute-dtype pytree (traced: the per-channel
+    multiply fuses into each weight's consumer under jit). Leaves that
+    are not int8 pass through unchanged."""
+    def deq(q, s):
+        if jnp.asarray(q).dtype != jnp.int8:
+            return q
+        return (q.astype(jnp.float32) * s).astype(dtype)
+
+    return jax.tree_util.tree_map(deq, q_params, scales)
+
+
+def int8_infer_fn(net):
+    """(jitted_fn, q_params, scales) for weight-only int8 batch
+    inference on an initialised network: jitted_fn(q_params, scales, x)
+    runs the standard inference forward with weights dequantized in-
+    graph. Donation is deliberately off — inference reuses the same
+    weight buffers every batch."""
+    q_params, scales = quantize_params_int8(net._params)
+    states = net._strip_carries(net._states)
+
+    def infer(qp, sc, x):
+        p = dequantize_params(qp, sc, net._compute_dtype)
+        return net._forward_infer(p, states, x)
+
+    return jax.jit(infer), q_params, scales
+
+
+def param_bytes(params):
+    """Total bytes of the array leaves of a params pytree — the
+    weight-traffic term the int8 A/B cuts."""
+    return int(sum(a.size * a.dtype.itemsize
+                   for a in jax.tree_util.tree_leaves(params)
+                   if hasattr(a, "dtype")))

@@ -37,6 +37,13 @@ def _block_attn(q, k, v, carry, mask_value=-1e30, mask=None):
     m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
     correction = jnp.exp(m - m_new)
     p = jnp.exp(scores - m_new[..., None])
+    if mask is not None:
+        # zero masked probabilities EXPLICITLY: when a whole block (or
+        # row) is masked, m_new itself is mask_value and exp(scores -
+        # m_new) == 1 — the finite sentinel normalises itself away and
+        # a fully-masked row would silently attend uniformly. With the
+        # hard zero, l stays 0 there and the l==0 guard below emits 0.
+        p = jnp.where(mask, p, jnp.zeros((), p.dtype))
     l_new = l * correction + jnp.sum(p, axis=-1)
     acc_new = acc * correction[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v)
     return acc_new, m_new, l_new
@@ -92,18 +99,41 @@ def blockwise_attention(q, k, v, block_size=512, causal=False, key_mask=None):
     return acc / jnp.where(l == 0, 1.0, l)[..., None]
 
 
-def dot_product_attention(q, k, v, mask=None, causal=False):
+def dot_product_attention(q, k, v, mask=None, causal=False, key_mask=None):
     """Plain fused attention (XLA materialises and fuses the scores).
-    Fine for short T; blockwise_attention for long T."""
+    Fine for short T; blockwise_attention for long T.
+
+    key_mask: optional [B, Tk] bool validity of key positions — the
+    ragged-batch mask the blockwise path has always taken. Semantics
+    match blockwise_attention exactly: masked keys get no weight, and a
+    row whose keys are ALL masked emits 0 (softmax alone would emit the
+    uniform average of v, a silent garbage read)."""
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    valid = None
     if causal:
         T, Tk = q.shape[2], k.shape[2]
         cm = jnp.arange(T)[:, None] >= jnp.arange(Tk)[None, :]
         scores = jnp.where(cm[None, None], scores, -1e30)
+        valid = cm[None, None]
+    if key_mask is not None:
+        kmb = key_mask[:, None, None, :]
+        scores = jnp.where(kmb, scores, -1e30)
+        valid = kmb if valid is None else valid & kmb
     if mask is not None:
         scores = jnp.where(mask, scores, -1e30)
+        if valid is not None and key_mask is not None:
+            valid = valid & mask
     p = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    if key_mask is not None:
+        # a row with NO valid key under the COMBINED causal+key_mask
+        # (+mask) constraint has all scores -1e30 — softmax would emit
+        # the uniform average of v, silently reading masked positions.
+        # Per-row validity (not just any(key_mask)) matches blockwise's
+        # l == 0 guard exactly.
+        any_valid = jnp.any(valid, axis=-1)[..., None]
+        o = jnp.where(any_valid, o, jnp.zeros((), o.dtype))
+    return o
 
 
 def multi_head_attention(x, Wq, Wk, Wv, Wo, nHeads, causal=False,

@@ -3,15 +3,42 @@
 Reference: BatchNormalization (+ CudnnBatchNormalizationHelper) and
 LocalResponseNormalization layer impls. On TPU both are bandwidth-bound
 elementwise/reduction patterns that XLA fuses; no helper split needed.
+
+Dtype policy (round 6, the BN tail fix): under a sub-fp32 compute dtype
+(bf16/fp16) the default "compute" tail keeps every ACTIVATION-SCALE
+tensor in the compute dtype — fp32 appears only in the vector-scale
+statistics (mean/var/inv/dgamma/dbeta) and inside reduction
+accumulators, where XLA fuses the widening convert into the reduce and
+no fp32 buffer ever reaches HBM. The round-5 attribution named fp32
+activation-scale buffers in the BN tails as a dtype_widening bin; this
+removes the source. The previous math (all BN arithmetic in fp32,
+cast at the layer edge) stays available as mode "wide" — module global
+`_TAIL_MODE`, initial value from DL4J_TPU_BN_TAIL — so bench.py can A/B
+the two lowerings instead of trusting the analysis
+(tests/test_hbm_attribution.py pins that "compute" passes the
+activation-dtype audit and "wide" fails it).
 """
 
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+#: "compute" (default) = activation-scale BN math in the compute dtype,
+#: fp32 only for vector-scale stats + fused reduce accumulators;
+#: "wide" = the pre-round-6 all-fp32 tail. Read at TRACE time.
+_TAIL_MODE = os.environ.get("DL4J_TPU_BN_TAIL", "compute")
+
+
+def _wide_tail(x):
+    """True when BN should run its activation-scale math in fp32: the
+    legacy mode, or a compute dtype that is already >= fp32."""
+    ft = jnp.promote_types(x.dtype, jnp.float32)
+    return _TAIL_MODE == "wide" or x.dtype == ft
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
@@ -31,12 +58,26 @@ def _bn_train(x, gamma, beta, eps):
 def _bn_train_fwd_math(x, gamma, beta, eps):
     axes = tuple(range(x.ndim - 1))
     ft = jnp.promote_types(x.dtype, jnp.float32)
-    xf = x.astype(ft)
-    mean = jnp.mean(xf, axis=axes)
-    var = jnp.var(xf, axis=axes)
+    if _wide_tail(x):
+        xf = x.astype(ft)
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.var(xf, axis=axes)
+        inv = lax.rsqrt(var + eps)
+        y = (xf - mean) * inv * gamma.astype(ft) + beta.astype(ft)
+        return y.astype(x.dtype), mean, var, inv
+    # compute-dtype tail: stats accumulate in fp32 INSIDE the reduces
+    # (jnp.mean(..., dtype=ft) — the convert fuses, nothing fp32 at
+    # activation scale materialises); the normalisation itself runs in
+    # the compute dtype with the fp32 vector statistics cast down once.
+    # var is E[(x - round(mean))^2]: the (mean - round(mean))^2 bias is
+    # below the compute dtype's own resolution.
+    mean = jnp.mean(x, axis=axes, dtype=ft)
+    xc = x - mean.astype(x.dtype)
+    var = jnp.mean(jnp.square(xc), axis=axes, dtype=ft)
     inv = lax.rsqrt(var + eps)
-    y = (xf - mean) * inv * gamma.astype(ft) + beta.astype(ft)
-    return y.astype(x.dtype), mean, var, inv
+    scale = (inv * gamma.astype(ft)).astype(x.dtype)
+    y = xc * scale + beta.astype(x.dtype)
+    return y, mean, var, inv
 
 
 def _bn_train_fwd(x, gamma, beta, eps):
@@ -52,13 +93,25 @@ def _bn_train_bwd(eps, res, cts):
     n = 1
     for a in axes:
         n *= x.shape[a]
-    dyf = dy.astype(ft)
-    xhat = (x.astype(ft) - mean) * inv
-    dbeta = jnp.sum(dyf, axis=axes)
-    dgamma = jnp.sum(dyf * xhat, axis=axes)
-    dx = (gamma.astype(ft) * inv / n) * (n * dyf - dbeta - xhat * dgamma)
-    return (dx.astype(x.dtype), dgamma.astype(gamma.dtype),
-            dbeta.astype(gamma.dtype))
+    if _wide_tail(x):
+        dyf = dy.astype(ft)
+        xhat = (x.astype(ft) - mean) * inv
+        dbeta = jnp.sum(dyf, axis=axes)
+        dgamma = jnp.sum(dyf * xhat, axis=axes)
+        dx = (gamma.astype(ft) * inv / n) * (n * dyf - dbeta
+                                             - xhat * dgamma)
+        return (dx.astype(x.dtype), dgamma.astype(gamma.dtype),
+                dbeta.astype(gamma.dtype))
+    # compute-dtype tail: dy/xhat stay in the compute dtype; the dbeta/
+    # dgamma accumulators widen inside their reduces (fused), and the
+    # fp32 vector terms are cast down once for the elementwise dx pass
+    xhat = (x - mean.astype(x.dtype)) * inv.astype(x.dtype)
+    dbeta = jnp.sum(dy, axis=axes, dtype=ft)
+    dgamma = jnp.sum(dy * xhat, axis=axes, dtype=ft)
+    k = (gamma.astype(ft) * inv / n).astype(x.dtype)
+    dx = k * (n * dy - dbeta.astype(x.dtype)
+              - xhat * dgamma.astype(x.dtype))
+    return (dx, dgamma.astype(gamma.dtype), dbeta.astype(gamma.dtype))
 
 
 _bn_train.defvjp(_bn_train_fwd, _bn_train_bwd)
@@ -87,12 +140,22 @@ def batch_norm(x, gamma, beta, running_mean, running_var, *, train: bool,
     mean = running_mean.astype(ft)
     var = running_var.astype(ft)
     inv = lax.rsqrt(var + eps)
-    y = (x.astype(ft) - mean) * inv
-    if gamma is not None:
-        y = y * gamma.astype(ft)
+    if _wide_tail(x):
+        y = (x.astype(ft) - mean) * inv
+        if gamma is not None:
+            y = y * gamma.astype(ft)
+        if beta is not None:
+            y = y + beta.astype(ft)
+        return y.astype(x.dtype), running_mean, running_var
+    # compute-dtype tail: fold the whole affine into two fp32 VECTORS
+    # (scale, shift) computed once, cast down once — y = x*a + b with no
+    # activation-scale widening
+    a = inv if gamma is None else inv * gamma.astype(ft)
+    b = -mean * a
     if beta is not None:
-        y = y + beta.astype(ft)
-    return y.astype(x.dtype), running_mean, running_var
+        b = b + beta.astype(ft)
+    y = x * a.astype(x.dtype) + b.astype(x.dtype)
+    return y, running_mean, running_var
 
 
 def lrn(x, k=2.0, n=5, alpha=1e-4, beta=0.75):

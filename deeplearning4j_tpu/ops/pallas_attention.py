@@ -181,6 +181,14 @@ def _choose_impl(T, *, on_tpu, force_streaming=False, has_mask=False,
     backend) against the banked hardware table without running a kernel
     (tests/test_attention.py::TestDispatchTable)."""
     if has_mask:
+        # the pallas kernel carries no mask; below the fused/flash
+        # crossover the fused form (key_mask support in
+        # dot_product_attention, round 6) beats the blockwise scan —
+        # the [T,T] score tile fits on-chip and masking is one
+        # jnp.where. Longer masked T keeps the O(T)-memory scan, as
+        # does an explicit bounded-memory request.
+        if T < _MIN_FLASH_SEQ and not force_streaming:
+            return "fused"
         return "blockwise"
     if interpret:
         return "flash"
@@ -217,7 +225,8 @@ def flash_attention(q, k, v, causal=False, key_mask=None,
     impl = _choose_impl(T, on_tpu=_on_tpu(), force_streaming=force_streaming,
                         has_mask=key_mask is not None, interpret=_INTERPRET)
     if impl == "fused":
-        return dot_product_attention(q, k, v, causal=causal)
+        return dot_product_attention(q, k, v, causal=causal,
+                                     key_mask=key_mask)
     if impl == "blockwise":
         return blockwise_attention(q, k, v, block_size=block_k, causal=causal,
                                    key_mask=key_mask)

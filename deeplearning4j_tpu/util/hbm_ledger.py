@@ -136,10 +136,29 @@ def _result_bytes(result_text):
     return total
 
 
+def _result_meta(result_text):
+    """(dtype_str, elems) of a single-shape non-tuple result; None for
+    tuples, tokens and anything else the classifier cannot reason
+    about (attribution then treats the buffer as opaque)."""
+    s = result_text.strip()
+    if s.startswith("("):
+        return None
+    found = _SHAPE_RE.findall(result_text)
+    if len(found) != 1:
+        return None
+    dt, dims = found[0]
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return dt, n
+
+
 def _parse_module(hlo_text):
-    """-> (sizes, comp_sizes, computations, entry_name) where
-    computations maps name -> [(name, op, out_bytes, operand_names,
-    attached_comps, is_root)].
+    """-> (sizes, comp_sizes, computations, entry_name, meta, comp_meta)
+    where computations maps name -> [(name, op, out_bytes,
+    operand_names, attached_comps, is_root)] and meta/comp_meta carry
+    (dtype, elems) per instruction for the attribution classifier.
 
     HLO instruction names are only guaranteed unique PER COMPUTATION —
     a name reused inside a fusion/called computation must not overwrite
@@ -150,6 +169,8 @@ def _parse_module(hlo_text):
     references in synthetic test modules)."""
     sizes = {}
     comp_sizes = {}
+    meta = {}
+    comp_meta = {}
     comps = {}
     cur = None
     entry = None
@@ -163,6 +184,7 @@ def _parse_module(hlo_text):
                 cur = cm.group(2)
                 comps[cur] = []
                 comp_sizes[cur] = {}
+                comp_meta[cur] = {}
                 if cm.group(1):
                     entry = cur
             elif s == "}":
@@ -170,11 +192,16 @@ def _parse_module(hlo_text):
             continue
         name, result, op, rest = m.groups()
         nbytes = _result_bytes(result)
+        rmeta = _result_meta(result)
         # module-wide fallback keeps the FIRST definition: a later
         # fusion-internal reuse of an entry name cannot reprice it
         sizes.setdefault(name, nbytes)
+        if rmeta is not None:
+            meta.setdefault(name, rmeta)
         if cur is not None:
             comp_sizes[cur][name] = nbytes
+            if rmeta is not None:
+                comp_meta[cur][name] = rmeta
         # operands = instruction names before the first metadata key;
         # stop there to avoid charging called-computation names
         arg_text = rest.split("), ")[0] if "), " in rest else rest
@@ -186,7 +213,7 @@ def _parse_module(hlo_text):
         if cur is not None:
             comps[cur].append((name, op, nbytes, operands, attached,
                                s.startswith("ROOT ")))
-    return sizes, comp_sizes, comps, entry
+    return sizes, comp_sizes, comps, entry, meta, comp_meta
 
 
 def _fusion_bytes(fname, callsite_operands, out_bytes, caller_sizes,
@@ -291,7 +318,8 @@ def ledger(hlo_text, top=15):
     instructions inside call/while/conditional bodies count under their
     own opcodes, not under the call site's.
     """
-    sizes, comp_sizes, comps, entry = _parse_module(hlo_text)
+    sizes, comp_sizes, comps, entry, _meta, _comp_meta = \
+        _parse_module(hlo_text)
     if entry is None:
         # single anonymous/first computation (inline test modules)
         entry = next(iter(comps)) if comps else None
@@ -543,3 +571,381 @@ def _tree_leaves(t):
     import jax
 
     return jax.tree_util.tree_leaves(t)
+
+
+# ---------------------------------------------------------------------
+# attribution engine: name the gap between ledger total and floor
+# ---------------------------------------------------------------------
+#
+# The round-5 ledger proved the flagship moves ~3.95x the analytic floor
+# and stopped there. attribute_ledger() finishes the sentence: every
+# charged byte is classified into the floor (the bytes the MODEL needs)
+# or a named overhead bin (the bytes the LOWERING added), so "35 GB of
+# lowering overhead" becomes a per-category bill the next fix can be
+# measured against.
+#
+# Bin conventions (chosen so no charged byte lands in two bins and the
+# invariant floor + bins + uncategorized == ledger total holds exactly):
+#
+#   layout_copies     full bytes of relayout instructions — copy /
+#                     copy-start/-done / transpose / pad / reshape /
+#                     slice / concatenate / reverse / broadcast — and of
+#                     fusions whose ROOT is one (XLA's copy/transpose
+#                     fusions). The floor contains no relayouts, so the
+#                     whole row is overhead.
+#   dtype_widening    the WIDENING EXCESS of buffers wider than the
+#                     compute dtype at activation scale: a f32 buffer in
+#                     a bf16-policy step is half excess — the floor
+#                     already prices the bf16-equivalent touch. Charged
+#                     on writes and on every read.
+#   grad_double_touch reads BEYOND THE FIRST of compute-dtype
+#                     activation-scale buffers (the dX-conv + dW-conv
+#                     both re-reading a boundary activation is the
+#                     canonical case). The floor's 4-touch model allows
+#                     one backward read per buffer; extra reads are
+#                     overhead.
+#   collective        full bytes of cross-replica traffic (all-reduce /
+#                     all-gather / reduce-scatter / collective-permute /
+#                     all-to-all) — the data-parallel weight-update bill
+#                     (cf. Xu et al., cross-replica sharding of weight
+#                     update); the single-chip floor has none.
+#
+# "Activation scale" = more elements than the largest parameter leaf:
+# master params, grads and updater state are at most param-sized, so
+# anything bigger must be batch/spatial data. uncategorized is the
+# remainder; it holds the floor itself (params/grads/updater/input/
+# activation traffic is not re-identified buffer-by-buffer) plus
+# whatever the bins cannot name — a large POSITIVE uncategorized on a
+# gap-heavy program means the bins missed something and is reported,
+# never hidden.
+
+#: cross-replica traffic (async start/done forms included)
+_COLLECTIVE_OPS = {
+    "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
+    "all-to-all", "all-reduce-start", "all-reduce-done",
+    "all-gather-start", "all-gather-done", "collective-permute-start",
+    "collective-permute-done", "reduce-scatter-start",
+    "reduce-scatter-done",
+}
+
+#: pure-relayout opcodes: they move bytes without computing anything the
+#: floor model recognises
+_LAYOUT_OPS = {"copy", "copy-start", "copy-done", "transpose", "pad",
+               "reshape", "slice", "concatenate", "reverse", "broadcast"}
+
+_FLOAT_DTYPES = frozenset(d for d in _DTYPE_BITS
+                          if d[0] == "f" or d.startswith("bf"))
+
+
+def _walk_charged_rows(mod):
+    """Every charged instruction as a flat row list, recursing through
+    call/while/conditional per call site exactly as ledger() does —
+    sum(row bytes) == ledger()['total_bytes'] by construction. Fusions
+    are one call-site-priced row annotated with their root opcode (the
+    relayout-fusion marker); free ops never appear.
+
+    Row: (scope, name, op, bytes, out_bytes, in_bytes, out_meta,
+    reads, root_op) with reads = [(operand, bytes, meta), ...] over the
+    distinct resolved operands."""
+    from collections import ChainMap
+
+    sizes, comp_sizes, comps, entry, meta, comp_meta = mod
+    if entry is None:
+        entry = next(iter(comps)) if comps else None
+
+    size_scopes, meta_scopes = {}, {}
+
+    def scoped(cname):
+        sc = size_scopes.get(cname)
+        if sc is None:
+            sc = ChainMap(comp_sizes.get(cname, {}), sizes)
+            size_scopes[cname] = sc
+            meta_scopes[cname] = ChainMap(comp_meta.get(cname, {}), meta)
+        return sc, meta_scopes[cname]
+
+    rows = []
+    visiting = set()
+
+    def walk(cname):
+        if cname in visiting or cname not in comps:
+            return
+        visiting.add(cname)
+        sc, mc = scoped(cname)
+        for name, op, out_bytes, operands, attached, _root in comps[cname]:
+            if op in _FREE_OPS:
+                continue
+            if op in _SUBCOMP_OPS:
+                for a in attached:
+                    walk(a)
+                continue
+            root_op = None
+            if op == "fusion" and attached:
+                insts = comps.get(attached[0]) or ()
+                for iname, iop, _b, _o, _a, is_root in insts:
+                    if is_root:
+                        root_op = iop
+                nbytes, ob, ib = _fusion_bytes(
+                    attached[0], operands, out_bytes, sc,
+                    scoped(attached[0])[0], comps)
+            else:
+                nbytes, ob, ib = _instruction_bytes(op, out_bytes,
+                                                    operands, sc)
+            reads, seen = [], set()
+            for t in operands:
+                if t in sc and t not in seen:
+                    seen.add(t)
+                    reads.append((t, sc[t], mc.get(t)))
+            rows.append((cname, name, op, nbytes, ob, ib,
+                         mc.get(name), reads, root_op))
+        visiting.discard(cname)
+
+    if entry is not None:
+        walk(entry)
+    return rows
+
+
+def _is_scale(m, threshold_elems):
+    return m is not None and m[1] > threshold_elems
+
+
+def attribute_ledger(compiled, net=None, x_shape=None, optimizer_slots=1,
+                     compute_dtype=None, act_threshold_elems=None, top=6):
+    """Classify every charged byte of a compiled train step into the
+    analytic floor vs named lowering-overhead bins (see the bin table
+    above). `compiled` is a compiled executable or raw HLO text.
+
+    With `net` (+ `x_shape`) the floor, the compute dtype and the
+    activation-scale threshold all come from the model; without a net,
+    pass `compute_dtype` and `act_threshold_elems` explicitly and the
+    report is bins-only (floor 0). Invariant, exact by construction:
+
+        floor_bytes + sum(bins) + uncategorized_bytes == ledger total
+    """
+    hlo = compiled if isinstance(compiled, str) else compiled.as_text()
+    mod = _parse_module(hlo)
+    rows = _walk_charged_rows(mod)
+    total = sum(r[3] for r in rows)
+
+    if net is not None:
+        if compute_dtype is None:
+            compute_dtype = net._compute_dtype
+        if act_threshold_elems is None:
+            act_threshold_elems = max(
+                (int(a.size) for a in _tree_leaves(net._params)), default=0)
+    if compute_dtype is None or act_threshold_elems is None:
+        raise ValueError(
+            "attribute_ledger needs a net (for the compute dtype and the "
+            "activation-scale threshold) or explicit compute_dtype= and "
+            "act_threshold_elems=")
+    cbits = np.dtype(compute_dtype).itemsize * 8
+    thr = int(act_threshold_elems)
+
+    floor = None
+    if net is not None and x_shape is not None:
+        floor = train_step_floor(net, x_shape,
+                                 optimizer_slots=optimizer_slots)
+
+    bins = {"layout_copies": 0, "dtype_widening": 0,
+            "grad_double_touch": 0, "collective": 0}
+    contrib = {k: [] for k in bins}
+
+    def wide_excess(m, nbytes):
+        """Excess bytes of one wide-float activation-scale touch."""
+        dt = m[0]
+        if dt not in _FLOAT_DTYPES or _DTYPE_BITS[dt] <= cbits:
+            return 0
+        return int(round(nbytes * (1.0 - cbits / _DTYPE_BITS[dt])))
+
+    read_counts = {}  # (scope, operand) -> [count, bytes, meta]
+    for scope, name, op, nbytes, ob, ib, out_meta, reads, root_op in rows:
+        if op in _COLLECTIVE_OPS or root_op in _COLLECTIVE_OPS:
+            bins["collective"] += nbytes
+            # param-scale collectives are the dp weight-update bill
+            # (gradient all-reduce — Xu et al.); activation-scale ones
+            # are tensor/sequence-parallel traffic. The split names
+            # which fix applies (cross-replica update sharding vs
+            # layout/sharding of activations).
+            kind = ("activation" if _is_scale(out_meta, thr)
+                    else "weight_update")
+            contrib["collective"].append((f"{name} [{kind}]", op, nbytes))
+            continue
+        if op in _LAYOUT_OPS or (op == "fusion"
+                                 and root_op in _LAYOUT_OPS):
+            bins["layout_copies"] += nbytes
+            contrib["layout_copies"].append((name, op, nbytes))
+            continue
+        wid = 0
+        if _is_scale(out_meta, thr):
+            wid += wide_excess(out_meta, ob)
+        for t, b, m in reads:
+            if _is_scale(m, thr):
+                wid += wide_excess(m, b)
+        wid = min(wid, nbytes)
+        if wid:
+            bins["dtype_widening"] += wid
+            contrib["dtype_widening"].append((name, op, wid))
+        for t, b, m in reads:
+            rc = read_counts.get((scope, t))
+            if rc is None:
+                read_counts[(scope, t)] = [1, b, m]
+            else:
+                rc[0] += 1
+
+    for (scope, t), (count, b, m) in read_counts.items():
+        if count < 2 or not _is_scale(m, thr):
+            continue
+        dt = m[0]
+        if dt in _FLOAT_DTYPES and _DTYPE_BITS[dt] <= cbits:
+            extra = (count - 1) * b
+            bins["grad_double_touch"] += extra
+            contrib["grad_double_touch"].append((t, f"{count} reads",
+                                                 extra))
+
+    floor_bytes = floor["floor_bytes"] if floor else 0
+    binsum = sum(bins.values())
+    gap = total - floor_bytes if floor else None
+    rec = {
+        "ledger_total_bytes": int(total),
+        "floor_bytes": int(floor_bytes),
+        "floor_terms": dict(floor["terms"]) if floor else {},
+        "bins": {k: int(v) for k, v in bins.items()},
+        "bin_top": {
+            k: [{"name": n, "op": o, "bytes": int(b)}
+                for n, o, b in sorted(v, key=lambda r: -r[2])[:top]]
+            for k, v in contrib.items()},
+        "uncategorized_bytes": int(total - floor_bytes - binsum),
+        "compute_dtype": str(np.dtype(compute_dtype)),
+        "act_threshold_elems": thr,
+    }
+    if gap is not None:
+        rec["gap_bytes"] = int(gap)
+        rec["named_gap_frac"] = round(binsum / gap, 4) if gap > 0 else None
+    return rec
+
+
+def pre_opt_hlo(lowered):
+    """Pre-optimization HLO text of a jax Lowered — the MODEL's dtype
+    request, before backend passes rewrite it. The dtype-policy audit
+    must read THIS form: backend optimization adds widenings the model
+    never asked for (XLA:CPU promotes bf16 convolutions to f32 wholesale
+    because its conv kernels are fp32-only; TPU does not), and a policy
+    gate that flags backend artifacts would be red forever on CI
+    hosts."""
+    try:
+        return lowered.as_text(dialect="hlo")
+    except Exception:
+        return lowered.compiler_ir(dialect="hlo").as_hlo_text()
+
+
+def audit_activation_dtypes(compiled, net=None, compute_dtype=None,
+                            act_threshold_elems=None):
+    """HLO dtype-policy audit: every charged buffer of the step that is
+    a FLOAT WIDER than the compute dtype at activation scale — the
+    buffers the dtype_widening bin prices. A bf16-policy step that
+    honours the round-6 tail policy (fp32 only in vector-scale
+    statistics and fused reduce accumulators) returns [].
+
+    `compiled` may be a compiled executable, a raw HLO string, or —
+    the form a MODEL-policy CI gate should use — the pre_opt_hlo() text
+    of the unoptimized lowering, which excludes backend-forced
+    widenings (see pre_opt_hlo).
+
+    Walks the same charged rows as the ledger (entry computation,
+    recursing through call/while/conditional; fusion interiors stay in
+    registers/VMEM and are exempt — only buffers that reach HBM can
+    leak). Returns [{"scope", "name", "op", "dtype", "elems", "bytes"}]
+    sorted largest first; assert_activation_dtype_clean raises with the
+    offender table so a CI gate reads the leak, not just the failure."""
+    hlo = compiled if isinstance(compiled, str) else compiled.as_text()
+    if net is not None:
+        if compute_dtype is None:
+            compute_dtype = net._compute_dtype
+        if act_threshold_elems is None:
+            act_threshold_elems = max(
+                (int(a.size) for a in _tree_leaves(net._params)), default=0)
+    if compute_dtype is None or act_threshold_elems is None:
+        raise ValueError(
+            "audit_activation_dtypes needs a net or explicit "
+            "compute_dtype= and act_threshold_elems=")
+    cbits = np.dtype(compute_dtype).itemsize * 8
+    thr = int(act_threshold_elems)
+    mod = _parse_module(hlo)
+    _sizes, _csizes, comps, _entry_name, _m, _cm = mod
+
+    consumer_ops = {}  # scope -> {producer: {consumer ops}}
+
+    def consumers(scope, name):
+        sc = consumer_ops.get(scope)
+        if sc is None:
+            sc = {}
+            for cn, cop, _b, operands, _a, _r in comps.get(scope, ()):
+                for t in operands:
+                    sc.setdefault(t, set()).add(cop)
+            consumer_ops[scope] = sc
+        return sc.get(name, set())
+
+    offenders = []
+    for scope, name, op, nbytes, ob, _ib, out_meta, _reads, _root in \
+            _walk_charged_rows(mod):
+        if not _is_scale(out_meta, thr):
+            continue
+        dt, elems = out_meta
+        if dt not in _FLOAT_DTYPES or _DTYPE_BITS[dt] <= cbits:
+            continue
+        if op == "convert":
+            # the SANCTIONED wide idiom: a widening convert consumed
+            # ONLY by reductions is the `jnp.sum(..., dtype=f32)`
+            # fused accumulator — backend fusion folds it into the
+            # reduce and nothing wide reaches HBM. Any other consumer
+            # makes it a real materialisation.
+            cons = consumers(scope, name)
+            if cons and cons <= {"reduce", "reduce-window"}:
+                continue
+        offenders.append({"scope": scope, "name": name, "op": op,
+                          "dtype": dt, "elems": int(elems),
+                          "bytes": int(ob)})
+    offenders.sort(key=lambda r: -r["bytes"])
+    return offenders
+
+
+def assert_activation_dtype_clean(compiled, net=None, compute_dtype=None,
+                                  act_threshold_elems=None):
+    """Raise AssertionError naming every wide-float activation-scale
+    buffer in the compiled step (audit_activation_dtypes); the CI form
+    of the round-6 acceptance bar 'zero ENTRY-scope f32 activation-
+    scale buffers in the bf16 flagship step'."""
+    off = audit_activation_dtypes(compiled, net=net,
+                                  compute_dtype=compute_dtype,
+                                  act_threshold_elems=act_threshold_elems)
+    if off:
+        lines = [f"  {r['name'][:48]:<50} {r['op']:<16} {r['dtype']:<5} "
+                 f"{r['elems']:>12} elems  {r['bytes']:>12} B"
+                 for r in off[:12]]
+        raise AssertionError(
+            f"{len(off)} wide-float activation-scale buffer(s) in a "
+            "step whose compute dtype should bound activation widths "
+            "(dtype_widening leak):\n" + "\n".join(lines))
+
+
+def format_attribution(rec, gb=True):
+    """Human-readable attribution table (the analysis CLI surface)."""
+    unit, div = ("GB", 1e9) if gb else ("MB", 1e6)
+
+    def f(b):
+        return f"{b / div:10.3f} {unit}"
+
+    lines = [f"ledger total     {f(rec['ledger_total_bytes'])}",
+             f"analytic floor   {f(rec['floor_bytes'])}"]
+    for term, b in rec["floor_terms"].items():
+        lines.append(f"  floor.{term:<22} {f(b)}")
+    if "gap_bytes" in rec:
+        lines.append(f"gap (total-floor){f(rec['gap_bytes'])}")
+    for name, b in sorted(rec["bins"].items(), key=lambda kv: -kv[1]):
+        lines.append(f"  bin.{name:<24} {f(b)}")
+        for t in rec["bin_top"].get(name, [])[:3]:
+            lines.append(f"      {t['name'][:40]:<42} {t['op'][:16]:<17}"
+                         f"{f(t['bytes'])}")
+    lines.append(f"uncategorized    {f(rec['uncategorized_bytes'])}")
+    if rec.get("named_gap_frac") is not None:
+        lines.append(f"named gap fraction  {rec['named_gap_frac']:.1%}")
+    return "\n".join(lines)

@@ -410,10 +410,104 @@ class TestDispatchTable:
     def test_mask_and_cpu_routes(self):
         from deeplearning4j_tpu.ops.pallas_attention import _choose_impl
 
-        # ragged masks always stream, on every backend
+        # LONG ragged masks still stream, on every backend
         assert _choose_impl(4096, on_tpu=True, has_mask=True) == "blockwise"
         # CPU: fused up to 2048, blockwise beyond (memory, not speed)
         assert _choose_impl(512, on_tpu=False) == "fused"
         assert _choose_impl(8192, on_tpu=False) == "blockwise"
         # interpreter-mode tests force the kernel path
         assert _choose_impl(64, on_tpu=False, interpret=True) == "flash"
+
+    def test_masked_short_seq_routes_fused(self):
+        """The round-6 mask dimension: below the fused/flash crossover
+        a masked call takes the fused path (dot_product_attention grew
+        key_mask support) instead of unconditionally paying the
+        blockwise scan; an explicit bounded-memory request still
+        streams."""
+        from deeplearning4j_tpu.ops.pallas_attention import (
+            _MIN_FLASH_SEQ, _choose_impl)
+
+        for on_tpu in (True, False):
+            assert _choose_impl(256, on_tpu=on_tpu,
+                                has_mask=True) == "fused"
+            assert _choose_impl(_MIN_FLASH_SEQ - 1, on_tpu=on_tpu,
+                                has_mask=True) == "fused"
+            # at/after the crossover: the scan's O(T) memory wins
+            assert _choose_impl(_MIN_FLASH_SEQ, on_tpu=on_tpu,
+                                has_mask=True) == "blockwise"
+            # bounded-memory contract outranks the mask fast path
+            assert _choose_impl(256, on_tpu=on_tpu, has_mask=True,
+                                force_streaming=True) == "blockwise"
+
+
+class TestFusedMaskParity:
+    """dot_product_attention(key_mask=...) vs the blockwise-masked
+    reference: same semantics (masked keys ignored, fully-masked rows
+    emit 0), so the round-6 dispatch rewire cannot change results."""
+
+    def _qkv(self, B=2, H=2, T=16, D=8):
+        rng = np.random.RandomState(3)
+        mk = lambda: jnp.asarray(rng.randn(B, H, T, D).astype("float32"))
+        return mk(), mk(), mk()
+
+    def test_fused_masked_equals_blockwise_masked(self):
+        from deeplearning4j_tpu.ops.attention import (
+            blockwise_attention, dot_product_attention)
+
+        q, k, v = self._qkv()
+        km = np.ones((2, 16), bool)
+        km[0, 10:] = False   # ragged batch row
+        km[1, :] = True
+        km = jnp.asarray(km)
+        o_f = dot_product_attention(q, k, v, key_mask=km)
+        o_b = blockwise_attention(q, k, v, block_size=4, key_mask=km)
+        np.testing.assert_allclose(np.asarray(o_f), np.asarray(o_b),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_fully_masked_rows_emit_zero(self):
+        from deeplearning4j_tpu.ops.attention import (
+            blockwise_attention, dot_product_attention)
+
+        q, k, v = self._qkv()
+        km = np.ones((2, 16), bool)
+        km[0, :] = False     # every key of batch 0 masked
+        km = jnp.asarray(km)
+        o_f = dot_product_attention(q, k, v, key_mask=km)
+        o_b = blockwise_attention(q, k, v, block_size=4, key_mask=km)
+        assert np.all(np.asarray(o_f[0]) == 0)
+        np.testing.assert_allclose(np.asarray(o_f), np.asarray(o_b),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_causal_key_mask_row_with_no_valid_key(self):
+        """A row whose only causally-visible keys are ALL masked (query
+        0 with key 0 padding) must emit 0 on both paths: the fused
+        zero-row guard has to consider the COMBINED causal+key_mask
+        validity, not just any(key_mask)."""
+        from deeplearning4j_tpu.ops.attention import (
+            blockwise_attention, dot_product_attention)
+
+        q, k, v = self._qkv()
+        km = np.ones((2, 16), bool)
+        km[0, 0] = False     # query row 0 of batch 0 sees no valid key
+        km = jnp.asarray(km)
+        o_f = dot_product_attention(q, k, v, causal=True, key_mask=km)
+        o_b = blockwise_attention(q, k, v, block_size=4, causal=True,
+                                  key_mask=km)
+        assert np.all(np.asarray(o_f[0, :, 0]) == 0)
+        np.testing.assert_allclose(np.asarray(o_f), np.asarray(o_b),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_flash_attention_mask_dispatch_parity(self):
+        """The public entry: flash_attention with a key_mask at short T
+        (now the fused path) matches the explicit blockwise scan."""
+        from deeplearning4j_tpu.ops.attention import blockwise_attention
+        from deeplearning4j_tpu.ops.pallas_attention import flash_attention
+
+        q, k, v = self._qkv()
+        km = np.ones((2, 16), bool)
+        km[0, 7:] = False
+        km = jnp.asarray(km)
+        o = flash_attention(q, k, v, key_mask=km)
+        o_ref = blockwise_attention(q, k, v, block_size=4, key_mask=km)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
+                                   rtol=2e-5, atol=2e-5)

@@ -34,7 +34,13 @@ def stub(monkeypatch):
                         _pooling._BACKWARD_IMPL)
     calls = []
 
-    def fake_measure(stem, remat=False):
+    def fake_measure(stem, remat=False, tail_mode=None):
+        if tail_mode is not None:
+            # the round-6 dtype-tail leg: serve the ("<stem>", "wide")
+            # entry when a test provides one, else a slow losing leg so
+            # selection tests written before the leg stay untouched
+            calls.append((stem, f"tail:{tail_mode}"))
+            return dict(stub.table.get((stem, tail_mode), _rec(1.0)))
         calls.append((stem, remat))
         return dict(stub.table[(stem, remat)])
 
@@ -118,10 +124,47 @@ class TestHeadlineSelection:
         bench.bench_resnet50()
         partials = [l for l in capsys.readouterr().out.splitlines()
                     if l.startswith("BENCHREC-PARTIAL ")]
-        assert len(partials) == 2  # post-maxpool and post-stem banking
+        # post-maxpool, post-stem and post-dtype-tail banking
+        assert len(partials) == 3
         for p in partials:
             rec = json.loads(p[len("BENCHREC-PARTIAL "):])
             assert rec["images_per_sec"] > 0
+
+    def test_dtype_tail_ab_records_bytes_and_can_flip(self, stub):
+        stub.table = {("standard", False): _rec(1000.0),
+                      ("space_to_depth", False): _rec(900.0),
+                      ("standard", "wide"): _rec(
+                          1200.0, hbm_bytes_per_step=1.2e10),
+                      ("standard", True): _rec(800.0)}
+        rec = bench.bench_resnet50()
+        # wide measured faster on this (stubbed) backend: the headline
+        # flips — self-protection — but the byte cut of the compute
+        # tail stays recorded either way
+        assert rec["images_per_sec"] == 1200.0
+        ab = rec["dtype_tail_ab"]
+        assert ab["headline_uses"] == "wide"
+        assert ab["bytes_cut"] == pytest.approx(0.2e10)
+        assert ab["compute"]["images_per_sec"] == 1000.0
+
+    def test_dtype_tail_ab_compute_wins_keeps_headline(self, stub):
+        stub.table = {("standard", False): _rec(1000.0),
+                      ("space_to_depth", False): _rec(900.0),
+                      ("standard", "wide"): _rec(
+                          700.0, hbm_bytes_per_step=1.2e10),
+                      ("standard", True): _rec(800.0)}
+        rec = bench.bench_resnet50()
+        assert rec["images_per_sec"] == 1000.0
+        assert rec["dtype_tail_ab"]["headline_uses"] == "compute"
+        assert ("standard", "tail:wide") in stub.calls
+
+    def test_dtype_tail_opt_out_env(self, stub, monkeypatch):
+        stub.table = {("standard", False): _rec(1000.0),
+                      ("space_to_depth", False): _rec(900.0),
+                      ("standard", True): _rec(800.0)}
+        monkeypatch.setenv("DL4J_TPU_TAIL_AB", "off")
+        rec = bench.bench_resnet50()
+        assert "dtype_tail_ab" not in rec
+        assert all(not str(c[1]).startswith("tail:") for c in stub.calls)
 
 
 class TestTunnelProbe:

@@ -126,6 +126,7 @@ class TestInt8Quantization:
 
 
 class TestInt8ZooGraph:
+    @pytest.mark.slow  # tier-1 budget (round 6): heavy compile-parity leg
     def test_resnet50_graph_int8_logit_parity(self):
         """VERDICT r4 #7's zoo bar: Int8Inference must wrap a zoo
         ComputationGraph (ResNet-50) and track its fp32 logits — cosine

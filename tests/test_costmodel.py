@@ -125,6 +125,7 @@ ENTRY %main () -> f32[2] {
             pytest.approx(2 / 3)
         assert r["weighted_overlap"] == pytest.approx(1 / 3, abs=1e-3)
 
+    @pytest.mark.slow  # tier-1 budget (round 6): heavy compile-parity leg
     def test_flagship_schedule_interleaves_grad_allreduces(self):
         # The measured claim behind SCALING.md: XLA emits per-layer grad
         # all-reduces THROUGH the backward schedule (many of them, with

@@ -1,0 +1,376 @@
+"""HBM gap attribution engine + dtype-policy audit + bytes/step gates
+(util/hbm_ledger.attribute_ledger / audit_activation_dtypes,
+analysis/hbm CLI subjects).
+
+Three layers of proof, cheapest first:
+
+- synthetic HLO modules pin each bin's classification rule in
+  isolation (layout relayouts, dtype widening, gradient double-touch,
+  collective split) and the floor+bins+uncategorized == total
+  invariant exactly;
+- one REAL compile per CLI subject (module-scoped fixtures — LeNet and
+  the resnet_block both serve the attribution invariant, the
+  cost_analysis oracle, the dtype audit and the bytes/step regression
+  gate from a single XLA compile each);
+- the bytes/step gates pin the CPU ledger total so a future PR cannot
+  silently regress the bandwidth bill (ceilings = measured 2026-08-03
+  on this container's jaxlib +10% headroom; a breach means the step
+  program got fatter, not that the clock drifted).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.util import hbm_ledger as H
+
+
+def _attr(hlo, **kw):
+    kw.setdefault("compute_dtype", jnp.bfloat16)
+    kw.setdefault("act_threshold_elems", 1000)
+    return H.attribute_ledger(hlo, **kw)
+
+
+class TestBinsSynthetic:
+    def test_layout_bin_takes_full_relayout_bytes(self):
+        # transpose + copy at activation scale: full bytes (out + in)
+        # land in layout_copies, nothing else
+        hlo = ("ENTRY e {\n"
+               "  %a = bf16[64,64]{1,0} iota(), iota_dimension=0\n"
+               "  %t = bf16[64,64]{0,1} transpose(%a), dimensions={1,0}\n"
+               "  %c = bf16[64,64]{1,0} copy(%t)\n"
+               "}\n")
+        rec = _attr(hlo)
+        n = 64 * 64 * 2
+        assert rec["bins"]["layout_copies"] == 4 * n  # 2 ops x (out+in)
+        assert rec["bins"]["dtype_widening"] == 0
+        assert rec["uncategorized_bytes"] == rec["ledger_total_bytes"] \
+            - 4 * n
+
+    def test_dtype_widening_charges_the_excess_only(self):
+        # a f32 activation-scale tensor in a bf16-policy step: half of
+        # every touch is excess (32 -> 16 bits)
+        hlo = ("ENTRY e {\n"
+               "  %w = f32[64,64]{1,0} iota(), iota_dimension=0\n"
+               "  %y = f32[64,64]{1,0} add(%w, %w)\n"
+               "}\n")
+        rec = _attr(hlo)
+        n = 64 * 64 * 4
+        # iota row: out excess n/2; add row: out excess n/2 + one
+        # distinct read excess n/2
+        assert rec["bins"]["dtype_widening"] == n + n // 2
+        assert rec["ledger_total_bytes"] == rec["floor_bytes"] \
+            + sum(rec["bins"].values()) + rec["uncategorized_bytes"]
+
+    def test_widening_ignores_sub_threshold_and_param_scale(self):
+        hlo = ("ENTRY e {\n"
+               "  %w = f32[10,10]{1,0} iota(), iota_dimension=0\n"
+               "  %y = f32[10,10]{1,0} add(%w, %w)\n"
+               "}\n")
+        rec = _attr(hlo)  # 100 elems < 1000 threshold: param scale
+        assert rec["bins"]["dtype_widening"] == 0
+
+    def test_grad_double_touch_counts_reads_beyond_first(self):
+        # one bf16 activation-scale buffer read by THREE consumers in
+        # the same scope: 2 extra reads billed
+        hlo = ("ENTRY e {\n"
+               "  %a = bf16[64,64]{1,0} iota(), iota_dimension=0\n"
+               "  %u = bf16[64,64]{1,0} add(%a, %a)\n"
+               "  %v = bf16[64,64]{1,0} multiply(%a, %u)\n"
+               "  %w = bf16[64,64]{1,0} subtract(%a, %v)\n"
+               "}\n")
+        rec = _attr(hlo)
+        assert rec["bins"]["grad_double_touch"] == 2 * 64 * 64 * 2
+
+    def test_collective_bin_and_weight_update_split(self):
+        hlo = ("ENTRY e {\n"
+               "  %g = f32[512]{0} iota(), iota_dimension=0\n"
+               "  %r = f32[512]{0} all-reduce(%g), to_apply=%add\n"
+               "  %a = bf16[2048]{0} iota(), iota_dimension=0\n"
+               "  %s = bf16[2048]{0} all-gather(%a), dimensions={0}\n"
+               "}\n")
+        rec = _attr(hlo)
+        # both collectives fully binned (out+in each)
+        assert rec["bins"]["collective"] == 2 * 512 * 4 + 2 * 2048 * 2
+        kinds = {t["name"]: t for t in rec["bin_top"]["collective"]}
+        assert any("[weight_update]" in n for n in kinds)  # param scale
+        assert any("[activation]" in n for n in kinds)     # > threshold
+
+    def test_invariant_exact_on_mixed_module(self):
+        hlo = ("ENTRY e {\n"
+               "  %a = bf16[64,64]{1,0} iota(), iota_dimension=0\n"
+               "  %t = bf16[64,64]{0,1} transpose(%a), dimensions={1,0}\n"
+               "  %f = f32[64,64]{1,0} convert(%t)\n"
+               "  %y = f32[64,64]{1,0} add(%f, %f)\n"
+               "  %r = f32[64]{0} all-reduce(%y), to_apply=%add\n"
+               "}\n")
+        rec = _attr(hlo)
+        assert rec["ledger_total_bytes"] == rec["floor_bytes"] \
+            + sum(rec["bins"].values()) + rec["uncategorized_bytes"]
+        assert rec["ledger_total_bytes"] == H.ledger(hlo)["total_bytes"]
+
+
+class TestAuditSynthetic:
+    def test_wide_activation_buffer_flagged(self):
+        hlo = ("ENTRY e {\n"
+               "  %a = f32[64,64]{1,0} iota(), iota_dimension=0\n"
+               "  %y = f32[64,64]{1,0} add(%a, %a)\n"
+               "}\n")
+        off = H.audit_activation_dtypes(hlo, compute_dtype=jnp.bfloat16,
+                                        act_threshold_elems=1000)
+        assert {r["name"] for r in off} == {"a", "y"}
+        with pytest.raises(AssertionError, match="activation-scale"):
+            H.assert_activation_dtype_clean(
+                hlo, compute_dtype=jnp.bfloat16, act_threshold_elems=1000)
+
+    def test_fused_accumulator_convert_is_exempt(self):
+        # convert consumed ONLY by a reduce = the jnp.sum(dtype=f32)
+        # idiom: sanctioned (fuses into the reduction)
+        hlo = ("ENTRY e {\n"
+               "  %a = bf16[64,64]{1,0} iota(), iota_dimension=0\n"
+               "  %f = f32[64,64]{1,0} convert(%a)\n"
+               "  %s = f32[64]{0} reduce(%f, %z), dimensions={1}, "
+               "to_apply=%add\n"
+               "}\n")
+        off = H.audit_activation_dtypes(hlo, compute_dtype=jnp.bfloat16,
+                                        act_threshold_elems=1000)
+        assert off == []
+
+    def test_convert_with_non_reduce_consumer_still_flagged(self):
+        hlo = ("ENTRY e {\n"
+               "  %a = bf16[64,64]{1,0} iota(), iota_dimension=0\n"
+               "  %f = f32[64,64]{1,0} convert(%a)\n"
+               "  %y = f32[64,64]{1,0} add(%f, %f)\n"
+               "}\n")
+        off = H.audit_activation_dtypes(hlo, compute_dtype=jnp.bfloat16,
+                                        act_threshold_elems=1000)
+        assert {r["name"] for r in off} == {"f", "y"}
+
+
+# ---------------------------------------------------------------------
+# real compiles: one per subject, shared by every assertion below
+# ---------------------------------------------------------------------
+
+#: CPU ledger-total ceilings (measured 2026-08-03 +10%): the bytes/step
+#: regression gate. A breach means the compiled train step moves more
+#: bytes than this round shipped — name the regression, don't ship it.
+LENET_B64_CEILING = 142_000_000       # measured 129,135,086
+RESNET_BLOCK_B32_CEILING = 69_500_000  # measured 63,121,644
+
+
+@pytest.fixture(scope="module")
+def lenet_subject():
+    from deeplearning4j_tpu.analysis.hbm import (build_subject,
+                                                 lower_train_step)
+
+    net, x_shape, slots = build_subject("lenet", batch_size=64)
+    lowered = lower_train_step(net, x_shape)
+    return net, x_shape, slots, lowered, lowered.compile()
+
+
+@pytest.fixture(scope="module")
+def resnet_block_subject():
+    from deeplearning4j_tpu.analysis.hbm import (build_subject,
+                                                 lower_train_step)
+
+    net, x_shape, slots = build_subject("resnet_block", batch_size=32)
+    lowered = lower_train_step(net, x_shape)
+    return net, x_shape, slots, lowered, lowered.compile()
+
+
+def _cost_bytes(compiled):
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return float((ca or {}).get("bytes accessed", 0.0))
+
+
+class TestLeNetGate:
+    def test_attribution_invariant_and_cost_oracle(self, lenet_subject):
+        net, x_shape, slots, _low, compiled = lenet_subject
+        rec = H.attribute_ledger(compiled, net=net, x_shape=x_shape,
+                                 optimizer_slots=slots)
+        # exact by construction
+        assert rec["ledger_total_bytes"] == rec["floor_bytes"] \
+            + sum(rec["bins"].values()) + rec["uncategorized_bytes"]
+        # and the total reproduces XLA's own cost model within 1%
+        assert rec["ledger_total_bytes"] == pytest.approx(
+            _cost_bytes(compiled), rel=0.01)
+        assert rec["floor_bytes"] > 0
+        assert rec["gap_bytes"] > 0
+
+    def test_bytes_per_step_regression_gate(self, lenet_subject):
+        _net, _xs, _slots, _low, compiled = lenet_subject
+        total = H.ledger_for_compiled(compiled)["total_bytes"]
+        assert total <= LENET_B64_CEILING, (
+            f"LeNet b64 train step moves {total} bytes on CPU — above "
+            f"the round-6 ceiling {LENET_B64_CEILING}. The bandwidth "
+            "bill regressed; run `python -m deeplearning4j_tpu.analysis "
+            "--attribution lenet` to see which bin grew.")
+
+    def test_dtype_audit_clean_on_model_lowering(self, lenet_subject):
+        net, _xs, _slots, lowered, _c = lenet_subject
+        H.assert_activation_dtype_clean(H.pre_opt_hlo(lowered), net=net)
+
+
+class TestResNetBlockGate:
+    def test_attribution_invariant_and_cost_oracle(self,
+                                                   resnet_block_subject):
+        net, x_shape, slots, _low, compiled = resnet_block_subject
+        rec = H.attribute_ledger(compiled, net=net, x_shape=x_shape,
+                                 optimizer_slots=slots)
+        assert rec["ledger_total_bytes"] == rec["floor_bytes"] \
+            + sum(rec["bins"].values()) + rec["uncategorized_bytes"]
+        assert rec["ledger_total_bytes"] == pytest.approx(
+            _cost_bytes(compiled), rel=0.01)
+
+    def test_bytes_per_step_regression_gate(self, resnet_block_subject):
+        _net, _xs, _slots, _low, compiled = resnet_block_subject
+        total = H.ledger_for_compiled(compiled)["total_bytes"]
+        assert total <= RESNET_BLOCK_B32_CEILING
+
+    def test_dtype_audit_clean_compute_tail_dirty_wide_tail(
+            self, resnet_block_subject):
+        """THE round-6 contrast: the default compute-dtype BN/loss
+        tails pass the audit; flipping to the legacy wide tails on the
+        same model fails it — proving the audit detects exactly the
+        lowering difference the fix removed (the norm.py docstring's
+        promise)."""
+        from deeplearning4j_tpu.analysis.hbm import (build_subject,
+                                                     lower_train_step)
+        from deeplearning4j_tpu.nn import losses as _losses
+        from deeplearning4j_tpu.ops import norm as _norm
+
+        net, _xs, _slots, lowered, _c = resnet_block_subject
+        H.assert_activation_dtype_clean(H.pre_opt_hlo(lowered), net=net)
+
+        old = (_norm._TAIL_MODE, _losses._TAIL_MODE)
+        try:
+            _norm._TAIL_MODE = _losses._TAIL_MODE = "wide"
+            net2, xs2, _ = build_subject("resnet_block", batch_size=32)
+            low2 = lower_train_step(net2, xs2)
+            off = H.audit_activation_dtypes(H.pre_opt_hlo(low2), net=net2)
+        finally:
+            _norm._TAIL_MODE, _losses._TAIL_MODE = old
+        assert len(off) > 0  # the wide tail leaks, and the audit sees it
+
+
+class TestWeightUpdateModel:
+    def test_dp_weight_update_arithmetic(self):
+        from deeplearning4j_tpu.parallel.sharding import \
+            dp_weight_update_bytes
+
+        G = 100 * 4  # 100 fp32 grads
+        rec = dp_weight_update_bytes(G, dp=4)
+        assert rec["allreduce_bytes"] == 2 * 3 * G // 4
+        assert rec["update_replicated_bytes"] == 2 * G + 2 * G + G
+        assert rec["update_sharded_bytes"] == (2 * G + 2 * G + G) // 4
+        assert rec["sharding_saves_bytes"] == \
+            rec["update_replicated_bytes"] - rec["update_sharded_bytes"]
+        with pytest.raises(ValueError):
+            dp_weight_update_bytes(G, dp=0)
+
+    def test_dp1_degenerates_to_zero_collective(self):
+        from deeplearning4j_tpu.parallel.sharding import \
+            dp_weight_update_bytes
+
+        assert dp_weight_update_bytes(4096, dp=1)["allreduce_bytes"] == 0
+
+
+class TestCanonicalStaging:
+    def test_fit_dataset_parity_and_byte_cut(self):
+        """Host-canonical staging (the round-6 layout fix, default ON)
+        must train the SAME trajectory as legacy device staging and
+        compile a k-loop that moves fewer bytes (no per-step entry
+        transpose/convert, fp32->bf16 transfer halved)."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.data.dataset import DataSetIterator
+        from deeplearning4j_tpu.data.iterators import (iter_stacks,
+                                                       stack_datasets)
+        from deeplearning4j_tpu.ndarray import DataType
+        from deeplearning4j_tpu.nn import multilayer as _ml
+        from deeplearning4j_tpu.zoo import LeNet
+
+        B, NB, K = 8, 4, 2
+        rng = np.random.RandomState(7)
+        X = rng.rand(NB * B, 1, 28, 28).astype("float32")
+        Y = np.eye(10, dtype="float32")[rng.randint(0, 10, NB * B)]
+
+        def run(mode):
+            old = _ml._CANON_STAGING
+            _ml._CANON_STAGING = mode
+            try:
+                net = LeNet(numClasses=10, inputShape=(1, 28, 28),
+                            dataType=DataType.BFLOAT16).init()
+                net.fitDataSet(DataSetIterator(X, Y, B), stepsPerSync=K)
+                return net
+            finally:
+                _ml._CANON_STAGING = old
+
+        net_h = run("host")
+        net_d = run("device")
+        # same trajectory: the host-side cast/transpose is bitwise the
+        # in-program one (RTNE both sides)
+        for a, b in zip(jax.tree_util.tree_leaves(net_h._params),
+                        jax.tree_util.tree_leaves(net_d._params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_canonical_staging_removes_entry_transpose(self):
+        """Program-structure proof of the layout fix on an fp32 NCHW
+        conv net: the device-staged k-loop lowering carries a per-step
+        activation-scale entry transpose, the canonical one carries
+        none — and the canonical program's cost_analysis bytes are
+        never worse. (On XLA:CPU layout assignment can rewrite the
+        transpose to a free bitcast, so equality of bytes is allowed;
+        on TPU the staged bf16 NHWC feed skips a real relayout+convert,
+        which is the bin the attribution named.)"""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.data.dataset import DataSetIterator
+        from deeplearning4j_tpu.data.iterators import (iter_stacks,
+                                                       stack_datasets)
+        from deeplearning4j_tpu.nn import (ConvolutionLayer, InputType,
+                                           MultiLayerNetwork,
+                                           NeuralNetConfiguration,
+                                           Nesterovs, OutputLayer)
+        from deeplearning4j_tpu.nn import multilayer as _ml
+
+        B, NB, K = 8, 4, 2
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(3).updater(Nesterovs(0.1, 0.9))
+                .activation("relu").list()
+                .layer(ConvolutionLayer(nOut=8, kernelSize=(3, 3)))
+                .layer(OutputLayer(nOut=10, activation="softmax",
+                                   lossFunction="mcxent"))
+                .setInputType(InputType.convolutional(16, 16, 3))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(5)
+        X = rng.rand(NB * B, 3, 16, 16).astype("float32")
+        Y = np.eye(10, dtype="float32")[rng.randint(0, 10, NB * B)]
+
+        import re
+
+        def lower_loop(canon):
+            jl = _ml.fit_dataset_jit(net, K, canonical=canon)
+            batches = next(iter_stacks(DataSetIterator(X, Y, B), K))
+            xs, ys, fms, lms = (net._stack_canonical(batches) if canon
+                                else stack_datasets(batches))
+            return jl.lower(net._params, net._upd_states, net._states,
+                            jnp.asarray(0, jnp.int32), xs, ys, fms, lms)
+
+        entry_t = re.compile(
+            r"=\s*f32\[8,16,16,3\]\S*\s+transpose\(")
+
+        def entry_transposes(lowered):
+            return sum(1 for line in H.pre_opt_hlo(lowered).splitlines()
+                       if entry_t.search(line))
+
+        low_h, low_d = lower_loop(True), lower_loop(False)
+        assert entry_transposes(low_d) > 0   # legacy pays it per step
+        assert entry_transposes(low_h) == 0  # canonical never emits it
+        hb = _cost_bytes(low_h.compile())
+        db = _cost_bytes(low_d.compile())
+        assert hb <= db, (hb, db)

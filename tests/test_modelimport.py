@@ -460,11 +460,13 @@ class TestKerasApplicationsImport:
         self._parity(tf.keras.applications.MobileNet(
             weights=None, input_shape=(64, 64, 3), classes=5))
 
+    @pytest.mark.slow  # tier-1 budget (round 6): heavy compile-parity leg
     def test_mobilenet_v2_exact(self):
         keras.utils.set_random_seed(4)
         self._parity(tf.keras.applications.MobileNetV2(
             weights=None, input_shape=(64, 64, 3), classes=5))
 
+    @pytest.mark.slow  # tier-1 budget (round 6): heavy compile-parity leg
     def test_densenet_config_imports(self):
         keras.utils.set_random_seed(5)
         km = tf.keras.applications.DenseNet121(
@@ -555,6 +557,7 @@ class TestEfficientNetImport:
                            match="axis"):
             KerasModelImport.importKerasModelAndWeights(km.to_json())
 
+    @pytest.mark.slow  # tier-1 budget (round 6): heavy compile-parity leg
     def test_efficientnetb0_exact(self):
         # the full architecture: Rescaling/Normalization stem, MBConv
         # blocks with broadcasting SE Multiply, swish, DepthwiseConv2D
@@ -569,6 +572,7 @@ class TestEfficientNetImport:
         ours = np.asarray(net.output(x.transpose(0, 3, 1, 2)).jax())
         np.testing.assert_allclose(ours, golden, rtol=1e-3, atol=1e-4)
 
+    @pytest.mark.slow  # tier-1 budget (round 6): heavy compile-parity leg
     @pytest.mark.parametrize("app,size", [
         ("EfficientNetV2B0", 64), ("Xception", 96), ("ResNet50V2", 64)])
     def test_more_applications_exact(self, app, size):

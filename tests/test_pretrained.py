@@ -44,6 +44,7 @@ class TestResNet50Pretrained:
         ours = np.asarray(net.output(x.transpose(0, 3, 1, 2)).jax())
         np.testing.assert_allclose(ours, golden, rtol=1e-3, atol=1e-5)
 
+    @pytest.mark.slow  # tier-1 budget (round 6): heavy compile-parity leg
     def test_convert_to_native_checkpoint_roundtrip(self, resnet_h5, tmp_path):
         path, x, golden = resnet_h5
         model = ResNet50(numClasses=10, inputShape=(3, 64, 64))
@@ -55,6 +56,7 @@ class TestResNet50Pretrained:
         np.testing.assert_array_equal(a, b)
         np.testing.assert_allclose(b, golden, rtol=1e-3, atol=1e-5)
 
+    @pytest.mark.slow  # tier-1 budget (round 6): heavy compile-parity leg
     def test_transfer_learning_finetunes_from_pretrained(self, resnet_h5):
         from deeplearning4j_tpu.nn.transfer import TransferLearning
 

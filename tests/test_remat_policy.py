@@ -160,6 +160,7 @@ class TestSaveConvOutputsPolicy:
                                    remat.params().toNumpy(),
                                    rtol=1e-5, atol=1e-7)
 
+    @pytest.mark.slow  # tier-1 budget (round 6): heavy compile-parity leg
     def test_zoo_flagship_threads_policy(self):
         from deeplearning4j_tpu.zoo import ResNet50
 

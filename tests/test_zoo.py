@@ -167,6 +167,7 @@ class TestZooTailConvergence:
         x, y, _ = self._cluster_data(8, 3, 64, 3)
         self._assert_converges(net, x, y, iters=20)
 
+    @pytest.mark.slow  # tier-1 budget (round 6): heavy compile-parity leg
     def test_xception_converges(self):
         from deeplearning4j_tpu.zoo import Xception
         from deeplearning4j_tpu.nn import Adam
@@ -280,6 +281,7 @@ class TestZooUpstreamTail:
         names = set(conf.nodes)
         assert {"route_s2d", "route_cat"} <= names
 
+    @pytest.mark.slow  # tier-1 budget (round 6): heavy compile-parity leg
     def test_inception_resnet_v1(self):
         from deeplearning4j_tpu.zoo import InceptionResNetV1
 
@@ -298,6 +300,7 @@ class TestZooUpstreamTail:
         net.fit(x, y)
         assert np.isfinite(net.score())
 
+    @pytest.mark.slow  # tier-1 budget (round 6): heavy compile-parity leg
     def test_facenet_nn4_small2(self):
         from deeplearning4j_tpu.zoo import FaceNetNN4Small2
 
@@ -313,6 +316,7 @@ class TestZooUpstreamTail:
         net.fit(x, y)
         assert np.isfinite(net.score())
 
+    @pytest.mark.slow  # tier-1 budget (round 6): heavy compile-parity leg
     def test_nasnet(self):
         from deeplearning4j_tpu.zoo import NASNet
 
