@@ -1213,21 +1213,33 @@ def _run_secondaries_subprocess(budget, deadline_capped=False, sink=None):
 
 def bench_grad_sharing_virtual(timeout_s=600):
     """BASELINE config 5 on the virtual 8-device CPU mesh (one physical
-    chip available — this certifies the sharded psum path, not ICI perf)."""
+    chip available — this certifies the sharded psum path, not ICI
+    perf), plus the round-7 replicated-vs-ZeRO-sharded weight-update
+    A/B: same model/updater/data through ParallelWrapper with
+    weight_update='replicated' vs 'sharded' (reduce-scatter -> 1/dp
+    shard update -> all-gather, Xu et al.), with trajectory parity and
+    the measured per-chip updater-state bytes recorded. Wall-clock here
+    is CPU time — the A/B certifies correctness + the state-bytes cut;
+    the bandwidth win is priced by dp_weight_update_bytes and the
+    hbm_ledger weight_update bin (tests/test_zero_sharding.py gates
+    it)."""
     code = r"""
 import json, time
 import jax
 jax.config.update("jax_platforms", "cpu")
+import jax.tree_util as jtu
 import numpy as np
 from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
     MultiLayerNetwork, DenseLayer, OutputLayer, Adam)
-from deeplearning4j_tpu.parallel import SharedTrainingMaster
-conf = (NeuralNetConfiguration.Builder().seed(1).updater(Adam(1e-2))
-        .activation("relu").list()
-        .layer(DenseLayer(nOut=512)).layer(DenseLayer(nOut=256))
-        .layer(OutputLayer(nOut=10, activation="softmax"))
-        .setInputType(InputType.feedForward(784)).build())
-net = MultiLayerNetwork(conf).init()
+from deeplearning4j_tpu.parallel import (SharedTrainingMaster,
+    ParallelWrapper, data_parallel_mesh)
+def make_conf():
+    return (NeuralNetConfiguration.Builder().seed(1).updater(Adam(1e-2))
+            .activation("relu").list()
+            .layer(DenseLayer(nOut=512)).layer(DenseLayer(nOut=256))
+            .layer(OutputLayer(nOut=10, activation="softmax"))
+            .setInputType(InputType.feedForward(784)).build())
+net = MultiLayerNetwork(make_conf()).init()
 rng = np.random.RandomState(0)
 x = rng.randn(512, 784).astype("float32")
 y = np.eye(10, dtype="float32")[rng.randint(0, 10, 512)]
@@ -1237,10 +1249,45 @@ t0 = time.perf_counter(); n = 30
 for _ in range(n):
     m.fit(x, y)
 dt = (time.perf_counter() - t0) / n
-print(json.dumps({"cpu_mesh_steps_per_sec": round(1/dt, 1),
-                  "global_batch": 512,
-                  "devices": len(jax.devices()),
-                  "compression": m.gradient_compression}))
+rec = {"cpu_mesh_steps_per_sec": round(1/dt, 1),
+       "global_batch": 512,
+       "devices": len(jax.devices()),
+       "compression": m.gradient_compression}
+# ---- replicated-vs-sharded weight update A/B ----
+ab = {}
+nets = {}
+for mode in ("replicated", "sharded"):
+    wnet = MultiLayerNetwork(make_conf()).init()
+    pw = ParallelWrapper(wnet, mesh=data_parallel_mesh(),
+                         weight_update=mode)
+    pw.fit(x, y)
+    t0 = time.perf_counter(); n = 20
+    for _ in range(n):
+        pw.fit(x, y)
+    sps = n / (time.perf_counter() - t0)
+    entry = {"steps_per_sec": round(sps, 1)}
+    if mode == "sharded":
+        entry["opt_state_bytes_per_chip"] = \
+            pw._zero.per_chip_state_bytes(wnet._upd_states)
+    else:
+        entry["opt_state_bytes_per_chip"] = sum(
+            int(np.prod(l.shape)) * l.dtype.itemsize
+            for l in jtu.tree_leaves(wnet._upd_states))
+    ab[mode] = entry
+    nets[mode] = wnet
+maxdiff = max(float(np.abs(np.asarray(a) - np.asarray(b)).max())
+              for a, b in zip(jtu.tree_leaves(nets["replicated"]._params),
+                              jtu.tree_leaves(nets["sharded"]._params)))
+ab["parity_maxdiff"] = maxdiff
+ab["state_bytes_cut"] = (ab["replicated"]["opt_state_bytes_per_chip"]
+                         - ab["sharded"]["opt_state_bytes_per_chip"])
+rec["weight_update_ab"] = ab
+# house selection: the trajectory is parity-gated, so the mode is a
+# pure perf/memory knob — report which one this backend would pick
+rec["weight_update_mode"] = (
+    "sharded" if ab["sharded"]["steps_per_sec"]
+    >= ab["replicated"]["steps_per_sec"] else "replicated")
+print(json.dumps(rec))
 """
     env = dict(os.environ)
     env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
@@ -1399,6 +1446,12 @@ def main():
         "unit": "images/sec",
         "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
         "mfu": headline["mfu"],
+        # which weight-update path the dp trainers ran this round (the
+        # round-7 ZeRO A/B lives in configs.grad_sharing.weight_update_ab;
+        # the single-chip headline itself has no dp update to shard) —
+        # recorded at top level so BENCH_r06+ is attributable
+        "weight_update_mode": configs.get("grad_sharing", {}).get(
+            "weight_update_mode", "replicated"),
         "resnet50": headline,
         "configs": configs,
     }

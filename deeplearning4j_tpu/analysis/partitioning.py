@@ -114,7 +114,9 @@ class ShardingPlan:
 
     def __init__(self, batch_axis=_UNSET, model_axis=_UNSET,
                  pipe_axis=_UNSET, param_specs=None,
-                 min_shard_size=2 ** 16, microbatches=None):
+                 min_shard_size=2 ** 16, microbatches=None,
+                 weight_update="replicated",
+                 weight_update_min_shard=2 ** 16):
         # axes the user wrote down themselves get strict PAR01 checking;
         # the canonical defaults adapt to whatever the mesh carries
         self.explicit_axes = set()
@@ -136,6 +138,19 @@ class ShardingPlan:
         self.param_specs = dict(param_specs or {})
         self.min_shard_size = int(min_shard_size)
         self.microbatches = microbatches
+        # ZeRO cross-replica weight-update sharding (runtime twin:
+        # ParallelWrapper(weight_update="sharded") /
+        # parallel.sharding.ZeroShardedUpdate): "sharded" divides the
+        # per-chip updater-state residency by the data-parallel degree
+        # for every ELIGIBLE param leaf (>= weight_update_min_shard
+        # elements and divisible by dp; the rest replicate — the
+        # explicit pad-or-replicate policy, reported as PAR03 info)
+        if weight_update not in ("replicated", "sharded"):
+            raise ValueError(
+                "weight_update must be 'replicated' or 'sharded', got "
+                f"{weight_update!r}")
+        self.weight_update = weight_update
+        self.weight_update_min_shard = int(weight_update_min_shard)
 
     def spec_for(self, layer_key, pname, shape):
         """(spec tuple, explicit?) for one parameter."""
@@ -452,8 +467,18 @@ def _predict_hbm(report, conf, rows, axes, plan, batchSize, dataType,
             factors_by[(row["key"], pname)] = \
                 factors if factors is not None else [1] * len(shape)
 
+    # ZeRO weight-update sharding (PAR06 factor): under
+    # plan.weight_update == "sharded" each ELIGIBLE param leaf's updater
+    # state lives in 1/dp flat shards (runtime:
+    # parallel.sharding.ZeroShardedUpdate); ineligible leaves — below
+    # weight_update_min_shard or indivisible by dp — REPLICATE (the
+    # explicit pad-or-replicate policy, surfaced per leaf as PAR03)
+    dp_w = dp if (plan.weight_update == "sharded"
+                  and plan.batch_axis is not None) else 1
+
     param_elems = 0
-    opt_elems = 0
+    opt_tp = 0.0     # per-chip state under the tp plan alone
+    opt_chip = 0.0   # per-chip state with weight-update sharding on top
     act_bytes = 0
     for row in rows:
         key = row["key"]
@@ -461,19 +486,48 @@ def _predict_hbm(report, conf, rows, axes, plan, batchSize, dataType,
             continue
         shapes = row.get("param_shapes") or {}
         layer_elems = 0
+        elig_elems = 0
         for pname, shape in shapes.items():
             factors = factors_by[(key, pname)]
             n = int(np.prod(shape)) if shape else 1
             layer_elems += n // max(1, int(np.prod(factors)))
+            if dp_w > 1 and n >= plan.weight_update_min_shard:
+                if n % dp_w == 0:
+                    elig_elems += n
+                else:
+                    report.add(
+                        "PAR03", WARNING,
+                        f"layer {key} param '{pname}' (weight-update "
+                        "sharding)",
+                        f"{n} elements are not divisible by the "
+                        f"data-parallel degree {dp_w}: the ZeRO update "
+                        "REPLICATES this leaf's updater state instead "
+                        "of padding (parallel.sharding."
+                        "ZeroShardedUpdate eligibility)",
+                        hint="pad the layer width so the flat size "
+                             "divides dp, or accept the replicated "
+                             "fallback")
         param_elems += layer_elems
         if layer_elems:
             u = _layer_updater(conf, key)
             full = int(sum(int(np.prod(s)) for s in shapes.values()))
             state = _updater_state_elems(u, shapes)
-            # updater state shards exactly like its params
-            opt_elems += int(state * (layer_elems / max(1, full)))
+            # updater state shards exactly like its params (state
+            # leaves mirror param leaves for every known updater)
+            share = layer_elems / max(1, full)
+            opt_tp += state * share
+            if dp_w > 1:
+                f_e = elig_elems / max(1, full)
+                # eligible leaves: 1/dp regardless of tp (the ZeRO
+                # flat view re-shards over the data axis); the rest
+                # follow the tp placement
+                opt_chip += state * (f_e / dp_w + (1 - f_e) * share)
+            else:
+                opt_chip += state * share
         if row["type"] in _BOUNDARY_LAYERS:
             act_bytes += row["activation_bytes"] // dp
+    opt_elems = int(opt_tp)
+    wf = (opt_tp / opt_chip) if opt_chip else 1.0
 
     in_bytes = 0
     if rows:
@@ -481,9 +535,15 @@ def _predict_hbm(report, conf, rows, axes, plan, batchSize, dataType,
         in_elems = int(np.prod(first.get("out_shape") or (batchSize,)))
         in_bytes = in_elems * compute_b // dp  # same order as layer 0 out
 
+    # wf may be < 1: on a tp-heavy mesh (tp > dp) the ZeRO layout's
+    # 1/dp-over-the-data-axis state holds MORE per chip than the tp
+    # placement would — the fit prediction must charge that honestly
+    # instead of clamping to the cheaper layout
     terms = static_memory_terms(param_elems, opt_elems, act_bytes,
-                                compute_b, param_b, input_bytes=in_bytes)
+                                compute_b, param_b, input_bytes=in_bytes,
+                                weight_update_sharding=wf)
     terms["per_chip_gb"] = round(terms["total_bytes"] / 1e9, 4)
+    terms["weight_update"] = plan.weight_update
     terms["mesh"] = dict(axes)
     terms["pipeline_stages"] = pp if balance is not None else 1
     return terms

@@ -927,26 +927,68 @@ class SameDiff:
             if tc.weightDecay:
                 grads = {n: g + tc.weightDecay * params[n]
                          for n, g in grads.items()}
-            upd, new_state = updater.apply(grads, ustate, it, params=params)
-            # cast keeps param dtype stable (python-float updater
-            # hyperparams otherwise promote f32 params to f64 under x64,
-            # which would also break fitDataSet's dtype-stable fori carry)
-            new_params = {n: (params[n] - upd[n]).astype(params[n].dtype)
-                          for n in params}
+            # the weight-update hook (see MultiLayerNetwork._train_step):
+            # shardWeightUpdate installs ZeroShardedUpdate here — the
+            # optimizer then runs on 1/dp shards of params and updater
+            # state (reduce-scatter -> shard update -> all-gather); the
+            # default is the shared apply-and-subtract. The hook changes
+            # the state SHAPES, so jit's shape-keyed retrace always
+            # re-reads it — no stale-cache hazard.
+            impl = getattr(self, "_update_impl", None)
+            if impl is None:
+                from deeplearning4j_tpu.nn.multilayer import \
+                    default_param_update
+                impl = default_param_update
+            new_params, new_state = impl(updater, grads, ustate, it,
+                                         params)
             return loss, new_params, new_state
 
         return step
 
+    def shardWeightUpdate(self, mesh=None, batch_axis=None,
+                          min_shard_size=2 ** 16):
+        """Enable the ZeRO-style cross-replica sharded weight update
+        (Xu et al., arXiv:2004.13336) for this graph's training: the
+        updater state is allocated in 1/dp shards over the mesh's data
+        axis, gradients reduce-scatter into the matching shards, the
+        optimizer updates only the local shard, and the fresh params
+        all-gather for the next forward. Pass mesh=None for a
+        data-parallel mesh over all local devices. Call BEFORE fit();
+        an existing updater state is re-placed sharded bitwise.
+        shardWeightUpdate(None) semantics need a mesh with a data axis;
+        pass the same mesh your batch placement uses."""
+        from deeplearning4j_tpu.parallel import mesh as _pmesh
+        from deeplearning4j_tpu.parallel.sharding import ZeroShardedUpdate
+
+        mesh = mesh if mesh is not None else _pmesh.data_parallel_mesh()
+        self._update_impl = ZeroShardedUpdate(
+            mesh, axis=batch_axis or _pmesh.DATA_AXIS,
+            min_shard_size=min_shard_size)
+        state = getattr(self, "_train_state", None)
+        if state is not None:
+            self._train_state = self._update_impl.place_state(state)
+        return self
+
     def _train_state_for(self, params, updater):
         state = getattr(self, "_train_state", None)
+        impl = getattr(self, "_update_impl", None)
         if state is None:
-            state = updater.init(params)
             pending = getattr(self, "_pending_updater_leaves", None)
             if pending is not None:
-                leaves, treedef = jax.tree_util.tree_flatten(state)
+                # checkpoints hold the canonical full-shape layout
+                treedef = jax.tree_util.tree_structure(
+                    jax.eval_shape(updater.init, params))
                 state = jax.tree_util.tree_unflatten(
                     treedef, [jnp.asarray(l) for l in pending])
                 self._pending_updater_leaves = None
+                if impl is not None:
+                    state = impl.place_state(state)
+            elif impl is not None:
+                # ZeRO mode: allocated sharded from init — each chip only
+                # ever materialises its 1/dp shard of the moments
+                state = impl.init_state(updater, params)
+            else:
+                state = updater.init(params)
         return state
 
     def fitSteps(self, features=None, labels=None, numSteps=1, data=None):
@@ -1215,7 +1257,19 @@ class SameDiff:
             z.writestr("arrays.npz", buf.getvalue())
             if saveUpdaterState and getattr(self, "_train_state", None) is not None:
                 sbuf = io.BytesIO()
-                leaves, treedef = jax.tree_util.tree_flatten(self._train_state)
+                state = self._train_state
+                impl = getattr(self, "_update_impl", None)
+                if impl is not None and self._tc is not None:
+                    # ZeRO sharded mode: gather + restore the canonical
+                    # full-shape layout, so the checkpoint restores into
+                    # any mode bitwise (reshape is lossless)
+                    var_names = sorted(
+                        n for n, v in self._vars.items()
+                        if v.variableType == VariableType.VARIABLE)
+                    state = impl.unview_state(
+                        state, self._tc.updater,
+                        {n: self._arrays[n] for n in var_names})
+                leaves, treedef = jax.tree_util.tree_flatten(state)
                 np.savez(sbuf, *[np.asarray(l) for l in leaves])
                 z.writestr("updater.npz", sbuf.getvalue())
 

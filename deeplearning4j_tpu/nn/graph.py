@@ -19,7 +19,9 @@ from deeplearning4j_tpu.nn import updaters as _upd
 from deeplearning4j_tpu.nn.conf import layers as L
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.multilayer import (_grad_normalize, _unwrap,
-                                               cast_params, strip_carries,
+                                               cast_params,
+                                               default_param_update,
+                                               strip_carries,
                                                checkpointed_forward)
 
 
@@ -383,15 +385,18 @@ class ComputationGraph:
         glist = _grad_normalize([grads[n] for n in self._layer_names],
                                 self.conf.gradientNormalization,
                                 self.conf.gradientNormalizationThreshold)
+        # the weight-update hook (see MultiLayerNetwork._train_step):
+        # ZeroShardedUpdate runs the optimizer on 1/dp shards here
+        update_impl = getattr(self, "_update_impl", None) \
+            or default_param_update
         new_params, new_upd = dict(params), dict(upd_states)
         for name, g in zip(self._layer_names, glist):
             if not params[name] or getattr(self.conf.nodes[name].payload,
                                            "frozen", False):
                 continue
-            upd, us = self._updaters[name].apply(g, upd_states[name], iteration,
-                                                 params=params[name])
-            np_n = jax.tree_util.tree_map(
-                lambda p, u: (p - u).astype(p.dtype), params[name], upd)
+            np_n, us = update_impl(self._updaters[name], g,
+                                   upd_states[name], iteration,
+                                   params[name])
             cs = getattr(self.conf.nodes[name].payload, "constraints", None)
             if cs:
                 from deeplearning4j_tpu.nn.conf.constraint import apply_constraints

@@ -339,6 +339,19 @@ def run_fit_dataset_epoch(net, iterator, k, stack_fn, fit_one, jloop,
     return syncs
 
 
+def default_param_update(updater, grads, upd_state, iteration, params):
+    """The canonical apply-and-subtract for one trainable unit (a layer's
+    params dict, or SameDiff's whole variable dict) — the default
+    `_update_impl` every network type shares. A distributed trainer may
+    swap in parallel.sharding.ZeroShardedUpdate (same signature) for the
+    cross-replica sharded weight update."""
+    upd, us = updater.apply(grads, upd_state, iteration, params=params)
+    # cast keeps param dtype stable (python-float hyperparams would
+    # otherwise promote under x64)
+    return jax.tree_util.tree_map(
+        lambda p, u: (p - u).astype(p.dtype), params, upd), us
+
+
 def _grad_normalize(grads_per_layer, mode, threshold):
     """Gradient clipping/normalization (reference:
     org.deeplearning4j.nn.conf.GradientNormalization, applied in
@@ -735,18 +748,22 @@ class MultiLayerNetwork:
             return new_params, new_upd, new_states, loss
         grads = _grad_normalize(grads, self.conf.gradientNormalization,
                                 self.conf.gradientNormalizationThreshold)
+        # the weight-update hook: a distributed trainer may install
+        # parallel.sharding.ZeroShardedUpdate here to run the optimizer
+        # on 1/dp shards (reduce-scatter -> shard update -> all-gather);
+        # default is the plain apply-and-subtract below. Read at trace
+        # time; the hook changes the updater-state SHAPES, so a stale
+        # jit cache cannot silently keep the old program.
+        update_impl = getattr(self, "_update_impl", None) \
+            or default_param_update
         new_params, new_upd_states = [], []
         for i in range(len(self.layers)):
             if not params[i] or getattr(self.layers[i], "frozen", False):
                 new_params.append(params[i])
                 new_upd_states.append(upd_states[i])
                 continue
-            upd, us = self._updaters[i].apply(grads[i], upd_states[i], iteration,
-                                              params=params[i])
-            # cast keeps param dtype stable (python-float hyperparams would
-            # otherwise promote under x64)
-            np_i = jax.tree_util.tree_map(
-                lambda p, u: (p - u).astype(p.dtype), params[i], upd)
+            np_i, us = update_impl(self._updaters[i], grads[i],
+                                   upd_states[i], iteration, params[i])
             cs = getattr(self.layers[i], "constraints", None)
             if cs:
                 from deeplearning4j_tpu.nn.conf.constraint import apply_constraints

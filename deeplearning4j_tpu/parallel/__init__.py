@@ -12,7 +12,10 @@ from deeplearning4j_tpu.parallel.mesh import (
 from deeplearning4j_tpu.parallel.trainer import (
     ParallelWrapper, SharedTrainingMaster, ParameterAveragingTrainingMaster,
 )
-from deeplearning4j_tpu.parallel.sharding import shard_params, replicate_params, spec_for_param
+from deeplearning4j_tpu.parallel.sharding import (
+    ZeroShardedUpdate, dp_weight_update_bytes, replicate_params,
+    shard_params, spec_for_param,
+)
 from deeplearning4j_tpu.parallel.sequence import ring_attention, ulysses_attention
 from deeplearning4j_tpu.parallel.pipeline import PipelineParallel, partition_stages
 from deeplearning4j_tpu.parallel.multihost import (
@@ -32,7 +35,8 @@ __all__ = [
     "build_mesh", "data_parallel_mesh", "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS",
     "PIPE_AXIS", "ParallelWrapper", "SharedTrainingMaster",
     "ParameterAveragingTrainingMaster", "shard_params",
-    "replicate_params", "spec_for_param", "ring_attention", "ulysses_attention",
+    "replicate_params", "spec_for_param", "ZeroShardedUpdate",
+    "dp_weight_update_bytes", "ring_attention", "ulysses_attention",
     "PipelineParallel", "partition_stages",
     "initializeMultiHost", "hybrid_mesh", "is_coordinator", "num_hosts",
     "ParallelInference",

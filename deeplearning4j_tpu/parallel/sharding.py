@@ -21,6 +21,7 @@ from __future__ import annotations
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
@@ -117,8 +118,159 @@ def replicate_params(params, mesh: Mesh):
     return jax.device_put(params, NamedSharding(mesh, P()))
 
 
+class ZeroShardedUpdate:
+    """ZeRO-style cross-replica weight-update sharding (Xu et al.,
+    "Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+    Training", arXiv:2004.13336).
+
+    Installed as a network's ``_update_impl`` hook (MultiLayerNetwork /
+    ComputationGraph per-layer update, SameDiff whole-dict update). The
+    forward/backward is UNTOUCHED — same GSPMD program, same global-batch
+    loss/BN semantics as the replicated path. Only the weight update is
+    re-annotated, exactly the paper's transformation:
+
+      * each eligible gradient leaf is viewed flat and constrained to
+        1/dp shards over the data axis — the SPMD partitioner lowers the
+        gradient reduction feeding it as a reduce-scatter (TPU; XLA:CPU
+        lacks the ReduceScatterCreator pass and emits the equivalent
+        all-reduce + dynamic-slice, see dp_weight_update_bytes),
+      * the optimizer applies to ONLY the local shard of params and
+        updater state (updater state is ALLOCATED sharded from init —
+        each chip ever holds 1/dp of the fp32 moments, which is where
+        the HBM win for big optimizers comes from),
+      * the fresh flat params are constrained back to replicated — one
+        all-gather — and reshaped for the next forward.
+
+    Eligibility is per LEAF on the total element count n: a leaf shards
+    when ``n >= min_shard_size and n % dp == 0``; anything else —
+    scalar/vector leaves (biases, BN gamma/beta) below min_shard_size,
+    or sizes dp does not divide — stays REPLICATED (the explicit
+    pad-or-replicate policy: never pad; the partition-plan analyzer
+    reports the same fallback statically as PAR03). Because the view is
+    a reshape and replicated-leaf math is byte-for-byte the default
+    update, a model with no eligible leaves trains bitwise-identically
+    to the replicated path.
+    """
+
+    def __init__(self, mesh: Mesh, axis=DATA_AXIS, min_shard_size=2 ** 16):
+        if axis not in mesh.shape:
+            raise ValueError(
+                f"mesh has no axis '{axis}' (axes: {list(mesh.shape)}); "
+                "build the mesh with a data-parallel axis or pass axis=")
+        self.mesh = mesh
+        self.axis = axis
+        self.dp = int(mesh.shape[axis])
+        self.min_shard_size = int(min_shard_size)
+        self._sharded = NamedSharding(mesh, P(axis))
+        self._repl = NamedSharding(mesh, P())
+
+    # ----- eligibility / views ----------------------------------------
+    def eligible(self, leaf) -> bool:
+        """Shard-or-replicate decision for one array/abstract leaf (by
+        total element count — the flat view shards dim 0 of the
+        flattened vector, so leading-dim divisibility is irrelevant)."""
+        n = int(np.prod(leaf.shape)) if hasattr(leaf, "shape") else int(leaf)
+        return n > 0 and n >= self.min_shard_size and n % self.dp == 0
+
+    def _tmap(self, f, *trees):
+        return jax.tree_util.tree_map(f, *trees)
+
+    def view(self, tree):
+        """Traced: eligible leaves -> flat 1-D views constrained to 1/dp
+        shards over the data axis; ineligible leaves pass through."""
+        wsc = jax.lax.with_sharding_constraint
+        return self._tmap(
+            lambda a: wsc(a.reshape(-1), self._sharded)
+            if self.eligible(a) else a, tree)
+
+    def constrain_state(self, state):
+        """Traced: pin eligible (already-flat) state leaves to the
+        sharded layout so the carry cannot silently replicate."""
+        wsc = jax.lax.with_sharding_constraint
+        return self._tmap(
+            lambda a: wsc(a, self._sharded) if self.eligible(a) else a,
+            state)
+
+    # ----- the update hook --------------------------------------------
+    def __call__(self, updater, grads, upd_state, iteration, params):
+        """reduce-scatter(grads) -> local 1/dp shard update -> all-gather
+        (params). Drop-in for the default apply-and-subtract: returns
+        (new_params at full shape, new updater state in the sharded view
+        layout)."""
+        wsc = jax.lax.with_sharding_constraint
+        gv = self.view(grads)
+        pv = self.view(params)
+        upd, new_state = updater.apply(gv, upd_state, iteration, params=pv)
+        new_state = self.constrain_state(new_state)
+        new_pv = self._tmap(
+            lambda p, u: (p - u).astype(p.dtype), pv, upd)
+        # pin the POST-cast result sharded before replicating: without
+        # this the partitioner may sink the param-dtype convert past the
+        # all-gather and move a wider intermediate (x64 promotes updater
+        # scalar math to f64) — the gather must carry param-dtype bytes
+        new_pv = self.constrain_state(new_pv)
+        # all-gather the fresh shards back to the replicated full-shape
+        # params the next forward reads
+        return self._tmap(
+            lambda full, flat: wsc(flat, self._repl).reshape(full.shape)
+            if self.eligible(full) else flat,
+            params, new_pv), new_state
+
+    # ----- state allocation / (un)view --------------------------------
+    def init_state(self, updater, params):
+        """Fresh updater state ALLOCATED in the sharded layout: init runs
+        under jit with sharded out_shardings, so each chip materialises
+        only its 1/dp shard — no full-size state buffer ever exists
+        (ISSUE: 'allocated sharded from init, not sliced from a
+        replicated copy')."""
+        views = self._tmap(
+            lambda a: a.reshape(-1) if self.eligible(a) else a, params)
+        shapes = jax.eval_shape(updater.init, views)
+        if not jax.tree_util.tree_leaves(shapes):
+            return updater.init(views)  # stateless (Sgd/NoOp): ()/empty
+        shardings = self._tmap(
+            lambda s: self._sharded if self.eligible(s) else self._repl,
+            shapes)
+        return jax.jit(updater.init, out_shardings=shardings)(views)
+
+    def place_state(self, state):
+        """Re-place an EXISTING state tree (full-shape or already
+        viewed) into the sharded layout — the mid-training switch and
+        checkpoint-restore path; values are preserved bitwise (the view
+        is a reshape)."""
+        def place(a):
+            a = jnp.asarray(a)
+            if self.eligible(a):
+                return jax.device_put(a.reshape(-1), self._sharded)
+            return jax.device_put(a, self._repl)
+
+        return self._tmap(place, state)
+
+    def unview_state(self, state, updater, params):
+        """Sharded view layout -> the canonical full-shape state layout
+        (checkpoints save THIS form, so a sharded-mode save restores
+        into any mode bitwise; reshape is lossless)."""
+        template = jax.eval_shape(updater.init, params)
+        return self._tmap(
+            lambda s, t: jnp.reshape(s, t.shape), state, template)
+
+    def per_chip_state_bytes(self, state) -> int:
+        """Measured per-chip resident bytes of one state tree (device
+        0's addressable shards) — the number the analytic
+        dp_weight_update_bytes(sharded=True) opt_state_resident_bytes
+        bill is judged against."""
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(state):
+            if not hasattr(leaf, "addressable_shards"):
+                total += int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+                continue
+            dev0 = leaf.addressable_shards[0]
+            total += int(np.prod(dev0.data.shape)) * leaf.dtype.itemsize
+        return total
+
+
 def dp_weight_update_bytes(grad_bytes, dp, master_bytes=None,
-                           opt_state_bytes=None):
+                           opt_state_bytes=None, sharded=False):
     """Analytic per-replica HBM bytes of the data-parallel weight-update
     path — the model the hbm_ledger attribution's `collective` bin
     (weight_update rows) is judged against, and the bill cross-replica
@@ -142,7 +294,41 @@ def dp_weight_update_bytes(grad_bytes, dp, master_bytes=None,
     (fp32) grads. Returns the terms plus `sharding_saves_bytes` — the
     per-replica HBM cut the sharded update offers; compare it against
     the attribution's measured weight_update collective rows before
-    spending a live window on the rewrite."""
+    spending a live window on the rewrite.
+
+    sharded=True returns the ZeRO bill of the IMPLEMENTED scheme
+    (ZeroShardedUpdate) — the analytic yardstick its measured
+    weight_update collective bin and per-chip updater-state bytes are
+    CI-gated against. Terms per replica:
+
+      reduce_scatter_bytes  (dp-1)/dp * G on the wire (the gradient
+                            reduction, scattered instead of replicated)
+      all_gather_bytes      (dp-1)/dp * M on the wire (the fresh params)
+      update_bytes          (2M + 2S + G)/dp — the optimizer touches
+                            only the local shard
+      opt_state_resident_bytes  S/dp per chip (state allocated sharded)
+      hlo_collective_bytes  the per-replica HBM bytes the hbm_ledger
+                            charges the COLLECTIVE rows of the
+                            PARTITIONED step, by lowering:
+                              reduce_scatter:    rs (out G/dp + in G)
+                                                 + ag (out M + in M/dp)
+                                                 — what TPU emits;
+                              all_reduce_gather: XLA:CPU lacks the
+                                                 ReduceScatterCreator
+                                                 pass and lowers the
+                                                 scattered reduction as
+                                                 all-reduce (2G) + a
+                                                 local dynamic-slice
+                                                 (not a collective),
+                                                 plus the same param
+                                                 all-gather — the form
+                                                 the tier-1 CPU gate
+                                                 prices.
+                            Both models cover the ELIGIBLE (actually
+                            sharded) bytes; leaves the replicate
+                            fallback keeps pay the plain 2G all-reduce
+                            on top (the caller adds that term).
+    """
     G = int(grad_bytes)
     M = G if master_bytes is None else int(master_bytes)
     S = G if opt_state_bytes is None else int(opt_state_bytes)
@@ -151,10 +337,29 @@ def dp_weight_update_bytes(grad_bytes, dp, master_bytes=None,
     allreduce = 2 * (dp - 1) * G // dp
     update_repl = 2 * M + 2 * S + G
     update_shard = (2 * M + 2 * S + G) // dp
-    return {
+    rec = {
         "allreduce_bytes": allreduce,
         "update_replicated_bytes": update_repl,
         "update_sharded_bytes": update_shard,
         "sharding_saves_bytes": update_repl - update_shard,
         "dp": int(dp),
+        "mode": "sharded" if sharded else "replicated",
     }
+    if not sharded:
+        rec["update_bytes"] = update_repl
+        rec["opt_state_resident_bytes"] = S
+        return rec
+    rs = (dp - 1) * G // dp
+    ag = (dp - 1) * M // dp
+    rec.update({
+        "reduce_scatter_bytes": rs,
+        "all_gather_bytes": ag,
+        "collective_wire_bytes": rs + ag,
+        "update_bytes": update_shard,
+        "opt_state_resident_bytes": S // dp,
+        "hlo_collective_bytes": {
+            "reduce_scatter": (G + G // dp) + (M + M // dp),
+            "all_reduce_gather": 2 * G + (M + M // dp),
+        },
+    })
+    return rec

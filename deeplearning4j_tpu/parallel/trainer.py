@@ -46,7 +46,8 @@ class ParallelWrapper:
 
     def __init__(self, net, mesh=None, gradient_compression=None,
                  batch_axis=_mesh.DATA_AXIS, threshold=1e-3,
-                 targetSparsity=None):
+                 targetSparsity=None, weight_update="replicated",
+                 min_shard_size=2 ** 16):
         if getattr(net, "_solver", None) is not None:
             raise ValueError(
                 "distributed trainers require "
@@ -69,6 +70,29 @@ class ParallelWrapper:
         if gradient_compression not in (None, "int8", "threshold"):
             raise ValueError(
                 "gradient_compression must be None, 'int8' or 'threshold'")
+        if weight_update not in ("replicated", "sharded"):
+            raise ValueError(
+                "weight_update must be 'replicated' or 'sharded', got "
+                f"{weight_update!r}")
+        if weight_update == "sharded" and gradient_compression is not None:
+            raise ValueError(
+                f"weight_update='sharded' requires gradient_compression="
+                f"None (got {gradient_compression!r}): the compressed "
+                "steps run inside an explicit shard_map, where the "
+                "GSPMD sharding annotations the ZeRO update relies on "
+                "(reduce-scatter -> shard update -> all-gather) cannot "
+                "apply. Use the dense psum path, or keep the update "
+                "replicated.")
+        self.weight_update = weight_update
+        self.min_shard_size = int(min_shard_size)
+        self._zero = None
+        if weight_update == "sharded":
+            from deeplearning4j_tpu.parallel.sharding import \
+                ZeroShardedUpdate
+
+            self._zero = ZeroShardedUpdate(
+                self.mesh, axis=self.batch_axis,
+                min_shard_size=self.min_shard_size)
 
     # ------------------------------------------------------------------
     def _shard_batch(self, arr):
@@ -81,11 +105,79 @@ class ParallelWrapper:
         return shard_batch(arr, self.mesh, batch_axis=self.batch_axis)
 
     def _place_replicated(self):
-        """Move the net's params/opt/layer state onto the mesh, replicated."""
+        """Move the net's params/opt/layer state onto the mesh: params
+        and layer state replicated always; the updater state replicated
+        (default) or in the ZeRO 1/dp-shard layout when
+        weight_update='sharded' (the hook + sharded allocation live in
+        _place_sharded_update). Idempotent — ResilientFit re-runs it
+        after every checkpoint restore."""
         n = self.net
         n._params = jax.device_put(n._params, self._repl)
-        n._upd_states = jax.device_put(n._upd_states, self._repl)
         n._states = jax.device_put(n._states, self._repl)
+        if self._zero is not None:
+            self._place_sharded_update()
+        else:
+            self._uninstall_sharded_update()
+            n._upd_states = jax.device_put(n._upd_states, self._repl)
+
+    def _uninstall_sharded_update(self):
+        """Remove a PREVIOUS sharded-mode wrapper's ZeRO hook from the
+        net and restore the canonical full-shape updater state: a stale
+        `_update_impl` would keep running the sharded update against
+        the old wrapper's mesh (and ParameterAveragingTrainingMaster's
+        shard_map step would die deep in tracing on the flat-view
+        state — exactly the failure its construction check exists to
+        prevent)."""
+        n = self.net
+        if getattr(n, "_update_impl", None) is None:
+            return
+        unview = getattr(n, "_upd_state_unview", None)
+        if unview is not None:
+            n._upd_states = unview(n._upd_states)
+        n._update_impl = None
+        n._upd_state_unview = None
+
+    def _update_units(self):
+        """(key, updater, params) per trainable unit, both net types."""
+        n = self.net
+        if self._is_graph():
+            return [(name, n._updaters[name], n._params[name])
+                    for name in n._layer_names]
+        return [(i, n._updaters[i], n._params[i])
+                for i in range(len(n.layers))]
+
+    def _place_sharded_update(self):
+        """Install the ZeRO update hook and put the updater state into
+        the sharded layout: a fresh net (iteration 0) ALLOCATES the
+        state sharded — each chip only ever materialises its 1/dp shard
+        of the fp32 moments — while mid-training state (including a
+        restored checkpoint's canonical full-shape layout) is re-placed
+        bitwise (the view is a reshape)."""
+        n, z = self.net, self._zero
+        n._update_impl = z
+        n._upd_state_unview = self._unview_upd_states
+        fresh = n._iteration == 0
+        new = dict(n._upd_states) if self._is_graph() \
+            else list(n._upd_states)
+        for key, u, p in self._update_units():
+            if not p:
+                continue
+            new[key] = z.init_state(u, p) if fresh \
+                else z.place_state(n._upd_states[key])
+        n._upd_states = new
+
+    def _unview_upd_states(self, upd_states):
+        """Sharded view layout -> the canonical full-shape updater-state
+        layout (installed as net._upd_state_unview; checkpoints save the
+        canonical form so a sharded-mode save restores into any mode
+        bitwise — see util.sharded_checkpoint._net_state)."""
+        z = self._zero
+        new = dict(upd_states) if self._is_graph() else list(upd_states)
+        for key, u, p in self._update_units():
+            if not p:
+                continue
+            new[key] = z.unview_state(upd_states[key], u, p)
+        return new
 
     def _build_jit(self):
         n = self.net
@@ -414,6 +506,10 @@ class SharedTrainingMaster(ParallelWrapper):
             kw.setdefault("threshold",
                           getattr(thresholdAlgorithm, "threshold",
                                   thresholdAlgorithm))
+        if kw.get("weight_update") == "sharded":
+            # the ZeRO update needs the dense GSPMD psum path; asking for
+            # it implies opting out of this master's int8 default
+            kw.setdefault("gradient_compression", None)
         kw.setdefault("gradient_compression", "int8")
         super().__init__(net, mesh=mesh, **kw)
 
@@ -432,7 +528,7 @@ class ParameterAveragingTrainingMaster(ParallelWrapper):
     """
 
     def __init__(self, net, mesh=None, averagingFrequency=5,
-                 batch_axis=_mesh.DATA_AXIS):
+                 batch_axis=_mesh.DATA_AXIS, weight_update="replicated"):
         from deeplearning4j_tpu.nn.graph import ComputationGraph
 
         if isinstance(net, ComputationGraph):
@@ -441,7 +537,21 @@ class ParameterAveragingTrainingMaster(ParallelWrapper):
                 "MultiLayerNetwork; for ComputationGraph data-parallel "
                 "training use ParallelWrapper/SharedTrainingMaster "
                 "(single-input/-output graphs)")
-        super().__init__(net, mesh=mesh, batch_axis=batch_axis)
+        if weight_update == "sharded":
+            # reject HERE, not deep in jit tracing: this master keeps a
+            # PER-REPLICA stacked copy of params+updater state (local
+            # steps, periodic pmean) — there is no single cross-replica
+            # update to shard, and the stacked state's leading replica
+            # axis would collide with the ZeRO flat-shard views
+            raise ValueError(
+                "ParameterAveragingTrainingMaster does not support "
+                "weight_update='sharded': its replicas take LOCAL "
+                "updater steps on per-replica state, so there is no "
+                "cross-replica weight update to shard. The ZeRO-style "
+                "sharded update is supported by ParallelWrapper and "
+                "SharedTrainingMaster(gradient_compression=None).")
+        super().__init__(net, mesh=mesh, batch_axis=batch_axis,
+                         weight_update=weight_update)
         if int(averagingFrequency) < 1:
             raise ValueError("averagingFrequency must be >= 1")
         self._avg_freq = int(averagingFrequency)
@@ -479,6 +589,9 @@ class ParameterAveragingTrainingMaster(ParallelWrapper):
     def _place_replicated(self):
         """Give every replica its own (initially identical) copy: stack each
         leaf along a leading replica axis sharded over the data axis."""
+        # a net previously trained under a sharded-update wrapper must
+        # shed the ZeRO hook + flat-view state before stacking
+        self._uninstall_sharded_update()
         n, dp = self.net, self.mesh.shape[self.batch_axis]
 
         def stack(tree):

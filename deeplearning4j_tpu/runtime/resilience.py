@@ -405,6 +405,15 @@ class ResilientFit:
         net._iteration = restored._iteration
         net._epoch = restored._epoch
         extra = _ckpt.read_manifest(path).get("extra", {})
+        if self.wrapper is not None and self._jit is not None:
+            # a restore into an already-built step must re-place the
+            # state onto the mesh: checkpoints hold the CANONICAL
+            # full-shape updater-state layout, and under the ZeRO
+            # sharded update (weight_update='sharded') the live carry is
+            # the 1/dp flat-shard view — re-placement is bitwise (the
+            # view is a reshape). On a fresh resume _build_jit does this
+            # via the same _place_replicated.
+            self.wrapper._place_replicated()
         self._fire("onCheckpointRestored", path, net._iteration)
         return int(extra.get("batch_in_epoch", 0))
 

@@ -533,7 +533,7 @@ def train_step_floor(net, x_shape, optimizer_slots=1):
 
 def static_memory_terms(param_elems, opt_state_elems, boundary_act_bytes,
                         compute_itemsize, param_itemsize, input_bytes=0,
-                        grad_itemsize=None):
+                        grad_itemsize=None, weight_update_sharding=1.0):
     """Per-chip HBM RESIDENCY at the train step's high-water mark,
     computed from already-placed (per-chip) element counts — the caller
     (analysis/partitioning.py) applies the sharding plan's division
@@ -544,19 +544,41 @@ def static_memory_terms(param_elems, opt_state_elems, boundary_act_bytes,
       grads:       one gradient buffer per param (fp32 — the updaters
                    consume fp32 grads)
       optimizer:   the updater's state leaves (exact count, not slots x
-                   params — Sgd holds nothing, Adam holds 2x)
+                   params — Sgd holds nothing, Adam holds 2x), divided
+                   by `weight_update_sharding`
       cast copy:   a compute-dtype copy of the params, only when the
                    compute dtype differs from the param dtype
       activations: every conv/dense/pool boundary buffer simultaneously
                    live at the start of the backward pass (the
                    high-water mark without rematerialisation)
       input:       the device-resident batch
+
+    weight_update_sharding is the ZeRO cross-replica weight-update
+    sharding factor (parallel.sharding.ZeroShardedUpdate): under
+    weight_update='sharded' each chip holds only 1/dp of the updater
+    state — params stay replicated (the forward needs them) and the
+    gradient buffer is still materialised whole before its
+    reduce-scatter, so ONLY the optimizer term divides. Pass the
+    EFFECTIVE factor (opt_state_elems-layout bytes / actual per-chip
+    bytes): leaves below min_shard_size or indivisible by dp stay
+    replicated, so the effective factor is <= dp (the partition-plan
+    analyzer's PAR06 pass computes it exactly from the per-leaf
+    eligibility rule). The factor may be BELOW 1: when
+    `opt_state_elems` already reflects a tensor-parallel division finer
+    than dp (tp > dp), the ZeRO flat view's 1/dp-over-the-data-axis
+    layout genuinely holds MORE per chip than the tp layout would — the
+    residency model must report that, not clamp it away.
     """
     gb = param_itemsize if grad_itemsize is None else grad_itemsize
+    wf = float(weight_update_sharding)
+    if wf <= 0.0:
+        raise ValueError(
+            f"weight_update_sharding must be > 0, got {wf}")
     terms = {
         "params_bytes": int(param_elems * param_itemsize),
         "grads_bytes": int(param_elems * gb),
-        "optimizer_state_bytes": int(opt_state_elems * param_itemsize),
+        "optimizer_state_bytes": int(opt_state_elems * param_itemsize
+                                     / wf),
         "params_cast_copy_bytes": (int(param_elems * compute_itemsize)
                                    if compute_itemsize != param_itemsize
                                    else 0),
@@ -564,6 +586,7 @@ def static_memory_terms(param_elems, opt_state_elems, boundary_act_bytes,
         "input_bytes": int(input_bytes),
     }
     terms["total_bytes"] = int(sum(terms.values()))
+    terms["weight_update_sharding"] = round(wf, 4)
     return terms
 
 

@@ -20,14 +20,22 @@ from deeplearning4j_tpu.util import serde
 
 
 def _net_payload(net, saveUpdater: bool) -> dict:
+    upd = net._upd_states \
+        if saveUpdater and getattr(net, "_solver", None) is None else None
+    # ZeRO sharded weight update: the live state holds flat 1/dp-shard
+    # views; save the CANONICAL full-shape layout (lossless reshape) so
+    # the file restores into any mode — same contract as
+    # sharded_checkpoint._net_state
+    unview = getattr(net, "_upd_state_unview", None)
+    if upd is not None and unview is not None:
+        upd = unview(upd)
     return {
         "conf": net.conf,
         "params": net._params,
         "states": net._strip_carries(net._states),
         # solver (LBFGS/CG) memory is optax state — batch-local and
         # out-of-package for the codec; restore re-inits it (initFrom)
-        "upd_states": net._upd_states
-        if saveUpdater and getattr(net, "_solver", None) is None else None,
+        "upd_states": upd,
         "iteration": net._iteration,
         "epoch": net._epoch,
     }

