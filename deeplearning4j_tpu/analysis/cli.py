@@ -71,6 +71,19 @@ def _build_parser():
                         "bins) + dtype-policy audit; subjects: lenet "
                         "(default), resnet_block. Pays a host XLA "
                         "compile, unlike the static passes")
+    p.add_argument("--precompile", nargs="?", const="all",
+                   metavar="SUBJECT",
+                   help="populate the AOT executable cache "
+                        "(runtime.aot, docs/COMPILE.md) for SUBJECT "
+                        "(lenet, resnet_block, or 'all') and print "
+                        "per-key compile seconds; persists to "
+                        "--cache-dir (or $DL4J_TPU_AOT_CACHE) so later "
+                        "processes — trainers, serving, --attribution "
+                        "reruns — warm-start")
+    p.add_argument("--cache-dir", default=None, metavar="DIR",
+                   help="executable-cache directory for --precompile/"
+                        "--attribution (default: $DL4J_TPU_AOT_CACHE, "
+                        "else memory-only)")
     return p
 
 
@@ -185,6 +198,50 @@ def main(argv=None):
 
         for code, desc in ALL_CODES.items():
             print(f"{code}  {desc}")
+        return 0
+
+    aot_cache = None
+    if args.cache_dir or args.precompile or args.attribution:
+        # an explicit dir (or the env var) turns on the persistent tier
+        # for every compile this command pays; the handle is kept so
+        # the --precompile report works even when the session cache is
+        # vetoed (DL4J_TPU_AOT=off / multihost make session_cache()
+        # return None — an explicitly-passed cache still functions)
+        from deeplearning4j_tpu.runtime import aot
+
+        aot_cache = aot.enable(args.cache_dir)
+
+    if args.precompile:
+        from deeplearning4j_tpu.analysis.hbm import (SUBJECTS,
+                                                     precompile_subject)
+
+        subjects = SUBJECTS if args.precompile == "all" \
+            else (args.precompile,)
+        records = {}
+        try:
+            for s in subjects:
+                records[s] = precompile_subject(
+                    s, batch_size=args.batch_size, cache=aot_cache)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        cache = aot_cache
+        if args.as_json:
+            print(_json.dumps({"subjects": records,
+                               "cache_dir": cache.directory,
+                               "stats": cache.stats}, indent=2))
+        else:
+            for s, rep in records.items():
+                print(f"{s}:")
+                for entry, r in rep.items():
+                    print(f"  {entry:<24} {r['status']:<5} "
+                          f"{r['seconds']:>8.3f} s  {r['key'][:16]}")
+            total = sum(r["seconds"] for rep in records.values()
+                        for r in rep.values())
+            where = cache.directory or "memory only (set --cache-dir or "\
+                                       "$DL4J_TPU_AOT_CACHE to persist)"
+            print(f"\n{sum(len(r) for r in records.values())} key(s), "
+                  f"{total:.1f} s total; cache: {where}")
         return 0
 
     if args.attribution:

@@ -94,8 +94,64 @@ def lower_train_step(net, x_shape, n_classes=10):
         key, None, None)
 
 
-def compile_train_step(net, x_shape, n_classes=10):
-    return lower_train_step(net, x_shape, n_classes).compile()
+def _aot_key(net, x_shape, n_classes):
+    """Cache key for one attribution subject's compiled step: the
+    lowering is fully determined by (net config, example shapes,
+    ambient toggles), all of which the key embeds."""
+    from deeplearning4j_tpu.runtime import aot
+
+    try:
+        fp = aot.network_fingerprint(net)
+    except Exception:
+        return None
+    return aot.cache_key(fp, "hbm_train_step",
+                         f"x={tuple(x_shape)},n={int(n_classes)}")
+
+
+def compile_train_step(net, x_shape, n_classes=10, cache=None,
+                       lowered=None):
+    """lower + compile one canonical train step, through the AOT
+    executable cache when one is active (runtime.aot) — a second
+    ``--attribution`` run (or the bytes-gate tests after the CLI) gets
+    the executable warm instead of re-paying the subject's XLA compile.
+    The lowering here carries no donation, so the cached artifact is
+    the serialization-safe form. Pass `lowered` when the caller already
+    lowered (e.g. for the pre-opt dtype audit) — this is the ONE
+    definition of the subject key/entry, so every compile of a subject
+    lands on the same cache slot."""
+    from deeplearning4j_tpu.runtime import aot
+
+    if lowered is None:
+        lowered = lower_train_step(net, x_shape, n_classes)
+    return aot.compile_lowered(lowered,
+                               key=_aot_key(net, x_shape, n_classes),
+                               cache=cache, entry="hbm_train_step")
+
+
+def precompile_subject(subject, batch_size=32, cache=None):
+    """CLI ``--precompile``: populate the AOT executable cache for one
+    subject — the network's own train/inference entry points (what the
+    trainers and the serving tier dispatch to) plus the attribution
+    lowering — and report per-key compile-or-load seconds. Returns
+    {entry: {key, status, seconds}}."""
+    from deeplearning4j_tpu.runtime import aot
+
+    cache = cache if cache is not None else \
+        (aot.session_cache() or aot.enable())
+    net, x_shape, _slots = build_subject(subject, batch_size)
+    report = dict(net.precompile(batchSize=batch_size, cache=cache))
+    key = _aot_key(net, x_shape, 10)
+    before = cache.stats["misses"]
+    import time as _time
+
+    t0 = _time.perf_counter()
+    compile_train_step(net, x_shape, cache=cache)
+    status = "cold" if cache.stats["misses"] > before else "warm"
+    report["hbm_train_step"] = {
+        "key": key, "status": status,
+        "seconds": round(cache.seconds.get(
+            key, _time.perf_counter() - t0), 3)}
+    return report
 
 
 def run_attribution(subject="lenet", batch_size=32):
@@ -106,7 +162,7 @@ def run_attribution(subject="lenet", batch_size=32):
 
     net, x_shape, slots = build_subject(subject, batch_size)
     lowered = lower_train_step(net, x_shape)
-    compiled = lowered.compile()
+    compiled = compile_train_step(net, x_shape, lowered=lowered)
     rec = hbm_ledger.attribute_ledger(compiled, net=net, x_shape=x_shape,
                                       optimizer_slots=slots)
     rec["subject"] = subject

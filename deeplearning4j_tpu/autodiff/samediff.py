@@ -713,6 +713,68 @@ class SameDiff:
     def _base_env(self):
         return dict(self._arrays)
 
+    def _aot_jit(self, fn, entry, donate_argnums=()):
+        """jit `fn` through the AOT executable cache (runtime.aot):
+        keyed by the graph's structural fingerprint (ops, variables,
+        training config — array VALUES ride as arguments and stay out
+        of the key) so equal graphs share one executable and
+        precompile() can warm-start from disk. The fingerprint is
+        snapshotted here — every graph mutation clears _jit_cache, so a
+        stale snapshot cannot outlive the program it names."""
+        from deeplearning4j_tpu.runtime import aot
+
+        try:
+            fp = aot.samediff_fingerprint(self)
+        except Exception:
+            fp = None
+        if fp is None:
+            return jax.jit(fn, donate_argnums=donate_argnums)
+        return aot.cached_jit(fn, entry=entry, fingerprint=fp,
+                              donate_argnums=donate_argnums)
+
+    def precompile(self, features=None, labels=None, data=None,
+                   cache=None):
+        """AOT warm-start of the training step for one batch signature
+        (see MultiLayerNetwork.precompile): pass one example batch —
+        (features, labels) arrays or a DataSet — and the fit-step
+        executable is compiled (or loaded from the persistent cache)
+        without running a step. Returns {entry: {key, status,
+        seconds}}."""
+        if self._tc is None:
+            raise ValueError("setTrainingConfig first")
+        tc = self._tc
+        loss_names = self._loss_names()
+        var_names = sorted(n for n, v in self._vars.items()
+                           if v.variableType == VariableType.VARIABLE)
+        ckey = ("fit", tuple(var_names), tuple(loss_names), id(tc),
+                len(self._ops))
+        jstep = self._jit_cache.get(ckey)
+        if jstep is None:
+            jstep = self._aot_jit(
+                self._fit_step_fn(tc, loss_names, tc.updater),
+                "fit_step", donate_argnums=(0, 1))
+            self._jit_cache[ckey] = jstep
+        if not hasattr(jstep, "warm"):
+            return {}
+        b = data if data is not None else (features, labels)
+        phs = self._batch_to_placeholders(b, tc)
+        params = {n: self._arrays[n] for n in var_names}
+        consts = {n: a for n, a in self._arrays.items()
+                  if n not in params}
+        state = self._train_state_for(params, tc.updater)
+        # fit() passes the python-int iteration and a fold_in key;
+        # mirror both exactly or the warm signature misses
+        rng = jax.random.fold_in(jax.random.key(0), self._iteration)
+        key_, status, secs = jstep.warm(params, state, consts, phs,
+                                        self._iteration, rng,
+                                        cache=cache)
+        # _train_state_for may have materialized fresh updater state;
+        # keep it (fit would rebuild the identical thing)
+        self._train_state = state
+        return {} if status is None else {
+            "fit_step": {"key": key_, "status": status,
+                         "seconds": round(secs, 3)}}
+
     def output(self, placeholders, outputs):
         """Compile-and-run the slice for `outputs` (reference:
         SameDiff.output/exec → InferenceSession; here: one jax.jit)."""
@@ -728,7 +790,7 @@ class SameDiff:
                 env = dict(arrays)
                 env.update(phs)
                 return self._run_graph(env, out_names)
-            fn = jax.jit(run)
+            fn = self._aot_jit(run, f"output[{','.join(out_names)}]")
             self._jit_cache[key] = fn
         res = fn(self._arrays, ph)
         return {k: INDArray(v) for k, v in res.items()}
@@ -821,7 +883,9 @@ class SameDiff:
                 outs = self._run_graph(env, loss_names)
                 return sum(jnp.sum(o) for o in outs.values())
 
-            fn = jax.jit(jax.grad(loss_fn, argnums=(0, 1)))
+            fn = self._aot_jit(
+                jax.grad(loss_fn, argnums=(0, 1)),
+                f"grad[{','.join(wrt_names)};{','.join(loss_names)}]")
             self._jit_cache[key] = fn
 
         w_arrays = {n: self._arrays[n] for n in w_names}
@@ -864,9 +928,9 @@ class SameDiff:
                 len(self._ops))
         jstep = self._jit_cache.get(ckey)
         if jstep is None:
-            jstep = jax.jit(
+            jstep = self._aot_jit(
                 self._fit_step_fn(tc, loss_names, updater),
-                donate_argnums=(0, 1))
+                "fit_step", donate_argnums=(0, 1))
             self._jit_cache[ckey] = jstep
 
         params = {n: self._arrays[n] for n in var_names}
@@ -964,6 +1028,9 @@ class SameDiff:
         self._update_impl = ZeroShardedUpdate(
             mesh, axis=batch_axis or _pmesh.DATA_AXIS,
             min_shard_size=min_shard_size)
+        # the hook changes the traced program: drop cached steps so the
+        # AOT fingerprints (which embed the update mode) are re-derived
+        self._jit_cache.clear()
         state = getattr(self, "_train_state", None)
         if state is not None:
             self._train_state = self._update_impl.place_state(state)
@@ -1025,7 +1092,8 @@ class SameDiff:
                 return jax.lax.fori_loop(
                     0, numSteps, body, (params, ustate, jnp.float32(0)))
 
-            jloop = jax.jit(loop, donate_argnums=(0, 1))
+            jloop = self._aot_jit(loop, f"fit_steps[{numSteps}]",
+                                  donate_argnums=(0, 1))
             self._jit_cache[ckey] = jloop
         params = {n: self._arrays[n] for n in var_names}
         consts = {n: a for n, a in self._arrays.items() if n not in params}
@@ -1107,11 +1175,15 @@ class SameDiff:
                     (params, ustate, jnp.zeros((k,), jnp.float32)))
 
             # RetraceSentinel.install_fit_dataset routes the loop
-            # through this hook so compiles are counted exactly
+            # through this hook so compiles are counted exactly; a
+            # wrapped loop stays on the plain jit (an AOT cache hit
+            # would hide the trace the wrapper exists to count)
             wrap = getattr(self, "_fit_dataset_wrap", None)
             if wrap is not None:
-                loop = wrap(loop)
-            jloop = jax.jit(loop, donate_argnums=(0, 1))
+                jloop = jax.jit(wrap(loop), donate_argnums=(0, 1))
+            else:
+                jloop = self._aot_jit(loop, f"fit_dataset[k={k}]",
+                                      donate_argnums=(0, 1))
             self._jit_cache[ckey] = jloop
 
         history = []

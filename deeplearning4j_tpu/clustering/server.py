@@ -74,11 +74,12 @@ class NearestNeighborsServer(HttpServerOwner):
         return None if X is None else int(np.asarray(X).shape[0])
 
     # ----- HTTP layer --------------------------------------------------
-    def start(self, port=9200, requestDeadline=None):
+    def start(self, port=9200, requestDeadline=None, warmup=None):
         """Serve on 127.0.0.1:<port> (0 = ephemeral); returns self.
         GET /healthz answers readiness (503 while setReady(False), e.g.
         during an index rebuild); requestDeadline (seconds) bounds each
-        request — see util.httpserve."""
+        request; `warmup` (callable, e.g. a precompile closure) gates
+        readiness until the executables are hot — see util.httpserve."""
         srv = self
 
         class Handler(JsonHandler):
@@ -109,4 +110,5 @@ class NearestNeighborsServer(HttpServerOwner):
                     return self._json(
                         {"error": f"{type(e).__name__}: {e}"}, 400)
 
-        return self._serve(Handler, port, requestDeadline=requestDeadline)
+        return self._serve(Handler, port, requestDeadline=requestDeadline,
+                           warmup=warmup)

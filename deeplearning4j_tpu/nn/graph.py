@@ -59,19 +59,29 @@ class ComputationGraph:
                     "gradient clipping.", stacklevel=2)
         else:
             self._solver = None
+        from deeplearning4j_tpu.runtime import aot
+
         self._jit_train = self._make_jit_train()
-        self._jit_forward = jax.jit(self._forward_infer)
-        self._jit_loss = jax.jit(self._loss_only)
+        self._jit_forward = aot.cached_jit(self._forward_infer, owner=self,
+                                           entry="forward_infer")
+        self._jit_loss = aot.cached_jit(self._loss_only, owner=self,
+                                        entry="loss_only")
 
     def _make_jit_train(self, step_fn=None):
         """Canonical train-step jit; see MultiLayerNetwork._make_jit_train
-        (RetraceSentinel.install re-jits a wrapped step through this)."""
-        return jax.jit(step_fn or self._train_step,
-                       static_argnames=("use_carries",),
-                       # optax solver states alias the param
-                       # buffers (see MultiLayerNetwork)
-                       donate_argnums=(0, 1, 2)
-                       if self._solver is None else (2,))
+        (RetraceSentinel.install re-jits a wrapped step through this;
+        the unwrapped form routes through the AOT executable cache)."""
+        # optax solver states alias the param buffers (see
+        # MultiLayerNetwork)
+        donate = (0, 1, 2) if self._solver is None else (2,)
+        if step_fn is not None:
+            return jax.jit(step_fn, static_argnames=("use_carries",),
+                           donate_argnums=donate)
+        from deeplearning4j_tpu.runtime import aot
+
+        return aot.cached_jit(
+            self._train_step, owner=self, entry="train_step",
+            static_argnames=("use_carries",), donate_argnums=donate)
 
     # ------------------------------------------------------------------
     def init(self, validate=False, mesh=None, hbm_gb=None, plan=None,
@@ -127,6 +137,57 @@ class ComputationGraph:
     def _require_init(self):
         if self._params is None:
             raise RuntimeError("Call net.init() before fit/output/score")
+
+    def _example_shapes(self, batchSize, featuresShape=None,
+                        labelsShape=None):
+        """(featuresShape, labelsShape) for a precompile example batch —
+        the ONE derivation shared by ComputationGraph.precompile and
+        ParallelWrapper.precompile (single-input/single-output graphs;
+        vertex outputs and composite-loss heads need explicit
+        labelsShape)."""
+        from deeplearning4j_tpu.nn.multilayer import (
+            shape_for_input_type, shape_for_output_type)
+
+        if len(self.conf.networkInputs) != 1 \
+                or len(self.conf.networkOutputs) != 1:
+            raise ValueError(
+                "precompile supports single-input/single-output "
+                "ComputationGraphs; warm a multi-IO graph by fitting "
+                "one real (or zero) MultiDataSet")
+        if featuresShape is None:
+            featuresShape = shape_for_input_type(
+                self.conf.inputTypes.get(self.conf.networkInputs[0]),
+                batchSize)
+        if labelsShape is None:
+            out_node = self.conf.nodes[self.conf.networkOutputs[0]]
+            if out_node.kind != "layer" \
+                    or hasattr(out_node.payload, "computeLoss"):
+                raise ValueError(
+                    "precompile needs labelsShape=... for this output "
+                    "(vertex output or composite-loss head)")
+            ot = out_node.payload.getOutputType(out_node.layerInputType)
+            labelsShape = shape_for_output_type(
+                ot, batchSize, api_nhwc=self._api_nhwc,
+                t_fallback=featuresShape[-1]
+                if len(featuresShape) == 3 else None)
+        return featuresShape, labelsShape
+
+    def precompile(self, batchSize=32, featuresShape=None,
+                   labelsShape=None, entries=("train", "infer"),
+                   stepsPerSync=None, cache=None):
+        """AOT warm-start for single-input/single-output graphs: see
+        MultiLayerNetwork.precompile. Multi-IO graphs have no canonical
+        example batch — warm those by running one real batch."""
+        from deeplearning4j_tpu.nn.multilayer import precompile_network
+
+        featuresShape, labelsShape = self._example_shapes(
+            batchSize, featuresShape, labelsShape)
+        in_name = self.conf.networkInputs[0]
+        return precompile_network(
+            self, batchSize=batchSize, featuresShape=featuresShape,
+            labelsShape=labelsShape, entries=entries,
+            stepsPerSync=stepsPerSync, cache=cache,
+            wrap_args=lambda x, y: ({in_name: x}, [y]))
 
     # ------------------------------------------------------------------
     def _cast_params(self, p):

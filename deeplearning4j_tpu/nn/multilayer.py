@@ -218,7 +218,7 @@ def make_fit_dataset_loop(net, k, step_fn=None, guarded=False,
 
 
 def fit_dataset_jit(net, k, step_fn=None, guarded=False, owner=None,
-                    max_bad=None, canonical=False):
+                    max_bad=None, canonical=False, aot_extra=None):
     """Cached jit of make_fit_dataset_loop (one compile per k across an
     epoch — RetraceSentinel-provable via install_fit_dataset, which
     routes the loop through net._fit_dataset_wrap before jitting).
@@ -228,7 +228,15 @@ def fit_dataset_jit(net, k, step_fn=None, guarded=False, owner=None,
     net — the wrap hook is still read from the net, where
     install_fit_dataset sets it for both. Solver (optax) states alias
     the param buffers, so params/upd donation follows net._solver
-    exactly as _make_jit_train does."""
+    exactly as _make_jit_train does.
+
+    AOT routing: the loop compiles through the runtime.aot executable
+    cache when a session cache is enabled AND the program's provenance
+    is fully describable — the net's own step (step_fn None), or a
+    caller-passed step whose identity the caller encodes in
+    `aot_extra` (ParallelWrapper passes its mesh/compression mode). A
+    wrapped loop (RetraceSentinel counting traces) or an anonymous
+    step_fn stays on the plain jit."""
     cache_owner = owner if owner is not None else net
     cache = getattr(cache_owner, "_fit_dataset_cache", None)
     if cache is None:
@@ -241,14 +249,158 @@ def fit_dataset_jit(net, k, step_fn=None, guarded=False, owner=None,
                                      guarded=guarded, max_bad=max_bad,
                                      canonical=canonical)
         wrap = getattr(net, "_fit_dataset_wrap", None)
+        donate = (0, 1, 2) if getattr(net, "_solver", None) is None \
+            else (2,)
         if wrap is not None:
-            loop = wrap(loop)
-        jloop = jax.jit(
-            loop,
-            donate_argnums=(0, 1, 2) if getattr(net, "_solver", None)
-            is None else (2,))
+            jloop = jax.jit(wrap(loop), donate_argnums=donate)
+        elif step_fn is not None and aot_extra is None:
+            jloop = jax.jit(loop, donate_argnums=donate)
+        else:
+            from deeplearning4j_tpu.runtime import aot
+
+            entry = (f"fit_dataset[k={k},canonical={bool(canonical)},"
+                     f"guarded={bool(guarded)},max_bad={max_bad}]"
+                     + (aot_extra or ""))
+            jloop = aot.cached_jit(loop, owner=net, entry=entry,
+                                   donate_argnums=donate)
         cache[(k, bool(canonical))] = jloop
     return jloop
+
+
+#: precompile()'s per-entry example-argument builders live beside the
+#: call sites they must mirror — a drifted example would warm a program
+#: the real fit/output never runs
+def shape_for_input_type(it, batchSize):
+    """API-layout feature shape for one InputType (None → caller must
+    pass featuresShape explicitly; raises naming the gap)."""
+    from deeplearning4j_tpu.nn.conf.inputs import InputType as IT
+
+    B = int(batchSize)
+    if it is None:
+        raise ValueError(
+            "precompile needs featuresShape=... for a conf with no "
+            "declared InputType")
+    if it.kind == IT.FF:
+        return (B, it.size)
+    if it.kind == IT.CNN_FLAT:
+        # convolutionalFlat accepts flat [B, h*w*c] or NCHW; the NCHW
+        # feed is what the zoo/bench paths use — precompile warms that
+        # form (pass featuresShape=(B, h*w*c) for flat-fed pipelines)
+        return (B, it.channels, it.height, it.width)
+    if it.kind == IT.CNN:
+        return (B, it.height, it.width, it.channels) \
+            if getattr(it, "format", "NCHW") == "NHWC" \
+            else (B, it.channels, it.height, it.width)
+    if it.kind == IT.CNN3D:
+        return (B, it.channels, it.depth, it.height, it.width)
+    if it.kind == IT.RNN:
+        T = it.dims.get("timeSeriesLength")
+        if not T:
+            raise ValueError(
+                "precompile needs featuresShape=(B, size, T) for a "
+                "recurrent InputType with no timeSeriesLength")
+        return (B, it.size, T)
+    raise ValueError(f"unsupported InputType {it!r}; pass "
+                     "featuresShape explicitly")
+
+
+def shape_for_output_type(ot, batchSize, api_nhwc=False, t_fallback=None):
+    """API-layout labels shape for one output-layer InputType."""
+    from deeplearning4j_tpu.nn.conf.inputs import InputType as IT
+
+    B = int(batchSize)
+    if ot.kind == IT.FF:
+        return (B, ot.size)
+    if ot.kind == IT.RNN:
+        T = ot.dims.get("timeSeriesLength") or t_fallback
+        if not T:
+            raise ValueError(
+                "precompile needs labelsShape=(B, size, T) for a "
+                "recurrent output with no timeSeriesLength")
+        return (B, ot.size, T)
+    if ot.kind == IT.CNN:
+        # _loss_from_preact expects API labels NCHW unless the net
+        # declares NHWC end-to-end
+        return (B, ot.height, ot.width, ot.channels) if api_nhwc \
+            else (B, ot.channels, ot.height, ot.width)
+    raise ValueError(f"unsupported output type {ot!r}; pass "
+                     "labelsShape explicitly")
+
+
+def example_batch(net, batchSize, featuresShape=None, labelsShape=None):
+    """(x, y) example arrays for one training batch of `net` in the API
+    layout/dtype fit() receives. Shapes are derived from the conf's
+    InputType and the last layer's output type; recurrent inputs with
+    no declared timeSeriesLength (and composite heads with bespoke
+    label layouts) need explicit shapes."""
+    if featuresShape is None:
+        featuresShape = shape_for_input_type(net.conf.inputType,
+                                             batchSize)
+    if labelsShape is None:
+        last = net.layers[-1]
+        if hasattr(last, "computeLoss"):
+            raise ValueError(
+                f"precompile needs labelsShape=... for composite head "
+                f"{type(last).__name__} (bespoke label layout)")
+        ot = last.getOutputType(net.conf.layerInputTypes[-1])
+        labelsShape = shape_for_output_type(
+            ot, batchSize, api_nhwc=net._api_nhwc,
+            t_fallback=featuresShape[-1] if len(featuresShape) == 3
+            else None)
+    return (np.zeros(featuresShape, np.float32),
+            np.zeros(labelsShape, np.float32))
+
+
+def precompile_network(net, batchSize=32, featuresShape=None,
+                       labelsShape=None, entries=("train", "infer"),
+                       stepsPerSync=None, cache=None, wrap_args=None):
+    """Shared MultiLayerNetwork/ComputationGraph precompile driver:
+    warm (or AOT-compile + persist) the selected entry points at one
+    batch signature. wrap_args adapts (x, y) into the network-type call
+    convention (ComputationGraph's inputs-dict/labels-list)."""
+    net._require_init()
+    x, y = example_batch(net, batchSize, featuresShape, labelsShape)
+    key = jax.random.fold_in(jax.random.key(net.conf.seed ^ 0x5EED), 0)
+    it0 = jnp.asarray(0, jnp.int32)
+    adapt = wrap_args or (lambda xx, yy: (xx, yy))
+    report = {}
+
+    def record(name, res):
+        k_, status, secs = res
+        if status is not None:
+            report[name] = {"key": k_, "status": status,
+                            "seconds": round(secs, 3)}
+
+    if "train" in entries:
+        xx, yy = adapt(jnp.asarray(x), jnp.asarray(y))
+        record("train_step", net._jit_train.warm(
+            net._params, net._upd_states, net._states, it0, xx, yy,
+            key, None, None, cache=cache))
+    if "infer" in entries:
+        xx, _ = adapt(jnp.asarray(x), jnp.asarray(y))
+        record("forward_infer", net._jit_forward.warm(
+            net._params, net._states, xx, cache=cache))
+    if stepsPerSync and int(stepsPerSync) > 1:
+        k = int(stepsPerSync)
+        canon = canon_staging_on()
+        from deeplearning4j_tpu.data.dataset import DataSet
+
+        batches = [DataSet(x, y) for _ in range(k)]
+        if hasattr(net, "_stack_batches"):  # ComputationGraph
+            stack = (net._stack_batches_canonical if canon
+                     else net._stack_batches)(batches)
+        else:
+            from deeplearning4j_tpu.data.iterators import stack_datasets
+
+            stack = net._stack_canonical(batches) if canon \
+                else stack_datasets(batches)
+        staged = jax.device_put(stack)
+        jloop = fit_dataset_jit(net, k, canonical=canon)
+        if hasattr(jloop, "warm"):
+            record(f"fit_dataset[k={k}]", jloop.warm(
+                net._params, net._upd_states, net._states, it0, *staged,
+                cache=cache))
+    return report
 
 
 def run_staged_blocks(iterator, k, dispatch, consume):
@@ -421,24 +573,37 @@ class MultiLayerNetwork:
                     "gradient clipping.", stacklevel=2)
         else:
             self._solver = None
+        from deeplearning4j_tpu.runtime import aot
+
         self._jit_train = self._make_jit_train()
-        self._jit_forward = jax.jit(self._forward_infer)
-        self._jit_loss = jax.jit(self._loss_only)
+        self._jit_forward = aot.cached_jit(self._forward_infer, owner=self,
+                                           entry="forward_infer")
+        self._jit_loss = aot.cached_jit(self._loss_only, owner=self,
+                                        entry="loss_only")
 
     def _make_jit_train(self, step_fn=None):
         """The canonical jit of the train step. Factored out so
         instrumentation (analysis.retrace.RetraceSentinel.install) can
         re-jit a wrapped step under the SAME options — static args and
         donation must match or the counter would measure a different
-        program."""
-        return jax.jit(
-            step_fn or self._train_step,
-            static_argnames=("use_carries",),
-            # solver (optax) states alias the param buffers (L-BFGS
-            # keeps previous params/updates); donating both would be
-            # `f(donate(a), donate(a))` — donate states only there
-            donate_argnums=(0, 1, 2) if self._solver is None else (2,),
-        )
+        program. The unwrapped form routes through the AOT executable
+        cache (runtime.aot) when a session cache is enabled: equal
+        configs at equal signatures share ONE compile, and precompile()
+        can warm-start it from disk; a WRAPPED step (sentinel counting
+        traces) always gets the plain jit — a cache hit would hide the
+        trace the wrapper exists to count."""
+        # solver (optax) states alias the param buffers (L-BFGS
+        # keeps previous params/updates); donating both would be
+        # `f(donate(a), donate(a))` — donate states only there
+        donate = (0, 1, 2) if self._solver is None else (2,)
+        if step_fn is not None:
+            return jax.jit(step_fn, static_argnames=("use_carries",),
+                           donate_argnums=donate)
+        from deeplearning4j_tpu.runtime import aot
+
+        return aot.cached_jit(
+            self._train_step, owner=self, entry="train_step",
+            static_argnames=("use_carries",), donate_argnums=donate)
 
     # ------------------------------------------------------------------
     # initialization
@@ -501,6 +666,24 @@ class MultiLayerNetwork:
                                 for u, p in zip(self._updaters, params)]
         self._iteration = 0
         return self
+
+    def precompile(self, batchSize=32, featuresShape=None,
+                   labelsShape=None, entries=("train", "infer"),
+                   stepsPerSync=None, cache=None):
+        """AOT warm-start: compile (or load from the persistent
+        executable cache) the train-step / inference / fitDataSet
+        programs for one batch signature BEFORE the first real batch,
+        so a fresh process starts training/serving in milliseconds
+        instead of paying XLA compile seconds (docs/COMPILE.md).
+
+        entries: any of "train", "infer"; stepsPerSync=k additionally
+        warms the fitDataSet k-loop. cache: an aot.ExecutableCache (or
+        None for the session cache, enabling a memory one if none is
+        active). Returns {entry: {key, status cold|warm, seconds}}."""
+        return precompile_network(
+            self, batchSize=batchSize, featuresShape=featuresShape,
+            labelsShape=labelsShape, entries=entries,
+            stepsPerSync=stepsPerSync, cache=cache)
 
     # ------------------------------------------------------------------
     # pure functions (traced under jit)

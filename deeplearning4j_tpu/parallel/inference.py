@@ -35,19 +35,33 @@ class ParallelInference:
         chunked host-side (reference: ParallelInference.batchLimit).
     """
 
-    def __init__(self, model, mesh=None, batchLimit=0):
+    def __init__(self, model, mesh=None, batchLimit=0, batchBuckets=None):
         model._require_init()
         self.model = model
         self.mesh = mesh if mesh is not None else \
             build_mesh({DATA_AXIS: len(jax.devices())})
         self.batchLimit = int(batchLimit)
         self._n = self.mesh.shape[DATA_AXIS]
+        # padding-bucket executable cache: request batches are padded UP
+        # to the nearest bucket so the serving tier compiles one
+        # executable per bucket, never one per request size (the retrace
+        # budget is len(buckets) — aot.sentinel_budget). None keeps the
+        # legacy exact-size dispatch (one compile per distinct B).
+        self.batchBuckets = None if batchBuckets is None else \
+            tuple(sorted(int(b) for b in batchBuckets))
         rep = NamedSharding(self.mesh, P())
         shard = NamedSharding(self.mesh, P(DATA_AXIS))
-        # prefix-pytree shardings: params/states replicated, batch sharded
-        self._jit = jax.jit(model._forward_infer,
-                            in_shardings=(rep, rep, shard),
-                            out_shardings=shard)
+        # prefix-pytree shardings: params/states replicated, batch
+        # sharded; compiled through the AOT executable cache so a warm
+        # process serves its first request without paying XLA
+        from deeplearning4j_tpu.runtime import aot
+
+        self._jit = aot.cached_jit(
+            model._forward_infer, owner=model,
+            entry="parallel_inference",
+            extra=f"|pi[mesh={sorted(dict(self.mesh.shape).items())}]",
+            in_shardings=(rep, rep, shard),
+            out_shardings=shard)
 
     # upstream builder-pattern compatibility --------------------------
     class Builder:
@@ -55,6 +69,7 @@ class ParallelInference:
             self._model = model
             self._mesh = None
             self._batchLimit = 0
+            self._batchBuckets = None
 
         def workers(self, n):
             self._mesh = build_mesh({DATA_AXIS: int(n)})
@@ -62,6 +77,10 @@ class ParallelInference:
 
         def batchLimit(self, n):
             self._batchLimit = int(n)
+            return self
+
+        def batchBuckets(self, *sizes):
+            self._batchBuckets = tuple(int(s) for s in sizes)
             return self
 
         def inferenceMode(self, _mode):
@@ -72,17 +91,78 @@ class ParallelInference:
 
         def build(self):
             return ParallelInference(self._model, mesh=self._mesh,
-                                     batchLimit=self._batchLimit)
+                                     batchLimit=self._batchLimit,
+                                     batchBuckets=self._batchBuckets)
 
     # -----------------------------------------------------------------
+    def _target_batch(self, B):
+        """The dispatch batch for B requested rows: bucket-canonicalised
+        (when batchBuckets is set), then rounded up to a multiple of the
+        mesh size (XLA needs equal shards)."""
+        if self.batchBuckets:
+            from deeplearning4j_tpu.runtime.aot import bucket_batch
+
+            B = bucket_batch(B, self.batchBuckets)
+        return B + ((-B) % self._n)
+
     def _pad(self, a, B):
-        """Pad the batch axis to a multiple of the mesh size (XLA needs
-        equal shards); surplus rows are sliced off after the dispatch."""
-        rem = (-B) % self._n
-        if rem == 0:
-            return a
-        return np.concatenate(
-            [a, np.zeros((rem,) + tuple(a.shape[1:]), a.dtype)], axis=0)
+        """Pad the batch axis up to _target_batch(B); surplus rows are
+        sliced off after the dispatch."""
+        from deeplearning4j_tpu.runtime.aot import pad_batch
+
+        return pad_batch(a, self._target_batch(B))
+
+    def precompile(self, batchSizes=None, featuresShape=None,
+                   cache=None):
+        """AOT warm-start of the sharded forward for every batch bucket
+        (or the given batchSizes): a serving process hits its first
+        request with a hot executable. featuresShape: per-example shape
+        override (derived from the model conf's InputType otherwise).
+        Returns {batch: {key, status, seconds}}."""
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.multilayer import shape_for_input_type
+
+        sizes = tuple(batchSizes) if batchSizes is not None else \
+            (self.batchBuckets or ())
+        if not sizes:
+            raise ValueError(
+                "precompile needs batchSizes=... or batchBuckets set at "
+                "construction")
+        from deeplearning4j_tpu.nn.graph import ComputationGraph as _CG
+
+        if isinstance(self.model, _CG) \
+                and len(self.model.conf.networkInputs) != 1:
+            # output() serves multi-input graphs fine, but there is no
+            # canonical single example feed to warm with — fail HERE
+            # with intent, not mid-trace with a KeyError
+            raise ValueError(
+                "precompile supports single-input ComputationGraphs; "
+                "warm a multi-input graph by running one real batch "
+                "through output()")
+        report = {}
+        for B in sizes:
+            Bt = self._target_batch(int(B))
+            if featuresShape is not None:
+                shape = (Bt,) + tuple(featuresShape)
+                x = np.zeros(shape, np.float32)
+            elif isinstance(self.model, ComputationGraph):
+                name = self.model.conf.networkInputs[0]
+                it = self.model.conf.inputTypes.get(name)
+                x = np.zeros(shape_for_input_type(it, Bt), np.float32)
+            else:
+                x = np.zeros(shape_for_input_type(
+                    self.model.conf.inputType, Bt), np.float32)
+            if isinstance(self.model, ComputationGraph):
+                feed = {self.model.conf.networkInputs[0]: x}
+            else:
+                feed = x
+            k_, status, secs = self._jit.warm(
+                self.model._params, self.model._states, feed,
+                cache=cache)
+            if status is not None:
+                report[int(B)] = {"key": k_, "status": status,
+                                  "seconds": round(secs, 3)}
+        return report
 
     def _run(self, inputs, B):
         from deeplearning4j_tpu.nn.graph import ComputationGraph

@@ -179,6 +179,15 @@ class ParallelWrapper:
             new[key] = z.unview_state(upd_states[key], u, p)
         return new
 
+    def _aot_extra(self):
+        """Key suffix describing program context the net's config hash
+        cannot see: the mesh, the compression mode and the weight-update
+        mode all change the traced program."""
+        return (f"|pw[mesh={sorted(dict(self.mesh.shape).items())},"
+                f"axis={self.batch_axis},"
+                f"comp={self.gradient_compression},"
+                f"wu={self.weight_update}]")
+
     def _build_jit(self):
         n = self.net
         if self.gradient_compression == "threshold":
@@ -194,13 +203,22 @@ class ParallelWrapper:
             t = jax.device_put(jnp.asarray(self.threshold, jnp.float32),
                                self._repl)
             self._residual = (res, t)
+            # threshold mode threads adaptive residual state through a
+            # different arity and its threshold value is trace-baked:
+            # stays on the plain jit (no AOT caching)
             self._jit = jax.jit(self._threshold_step,
                                 donate_argnums=(0, 1, 2, 3))
             return
         step = n._train_step if self.gradient_compression is None \
             else self._compressed_step
-        # params/opt/state replicated; batch args sharded over the data axis
-        self._jit = jax.jit(step, donate_argnums=(0, 1, 2))
+        # params/opt/state replicated; batch args sharded over the data
+        # axis. Routed through the AOT executable cache (runtime.aot):
+        # the extra key part carries the mesh/compression/update mode.
+        from deeplearning4j_tpu.runtime import aot
+
+        self._jit = aot.cached_jit(step, owner=n, entry="pw_train_step",
+                                   extra=self._aot_extra(),
+                                   donate_argnums=(0, 1, 2))
 
     def _compressed_step(self, params, upd_states, states, iteration, x, y,
                          key, fmask, lmask):
@@ -429,7 +447,8 @@ class ParallelWrapper:
         if self._jit is None:
             self._place_replicated()
             self._build_jit()
-        jloop = fit_dataset_jit(n, k, step_fn=step, owner=self)
+        jloop = fit_dataset_jit(n, k, step_fn=step, owner=self,
+                                aot_extra=self._aot_extra())
 
         if self._is_graph():
             name = n.conf.networkInputs[0]
@@ -453,6 +472,50 @@ class ParallelWrapper:
                 place=place)
             n._epoch += 1
         return self
+
+    def precompile(self, batchSize=32, featuresShape=None,
+                   labelsShape=None, cache=None):
+        """AOT warm-start of the sharded train step (see
+        MultiLayerNetwork.precompile): places the model on the mesh,
+        builds the distributed step and compiles (or loads from the
+        persistent cache) its executable for one GLOBAL batch
+        signature. Composes with weight_update='sharded' — the ZeRO
+        layout is part of the cache key, and the updater state is
+        allocated sharded before the warm lowering, exactly as fit()
+        would. The threshold-compression mode is not cacheable (its
+        step threads residual state); precompile returns {} there."""
+        from deeplearning4j_tpu.nn.multilayer import example_batch
+
+        n = self.net
+        n._require_init()
+        if self._jit is None:
+            self._place_replicated()
+            self._build_jit()
+        if not hasattr(self._jit, "warm"):
+            return {}
+        if self._is_graph():
+            featuresShape, labelsShape = n._example_shapes(
+                batchSize, featuresShape, labelsShape)
+            x = np.zeros(featuresShape, np.float32)
+            y = np.zeros(labelsShape, np.float32)
+        else:
+            x, y = example_batch(n, batchSize, featuresShape,
+                                 labelsShape)
+        x = self._shard_batch(jnp.asarray(x))
+        y = self._shard_batch(jnp.asarray(y))
+        if self._is_graph():
+            x = {n.conf.networkInputs[0]: x}
+            y = [y]
+        key = jax.random.fold_in(
+            jax.random.key(n.conf.seed ^ 0x5EED), n._iteration)
+        res = self._jit.warm(
+            n._params, n._upd_states, n._states,
+            jnp.asarray(n._iteration, jnp.int32), x, y, key, None, None,
+            cache=cache)
+        k_, status, secs = res
+        return {} if status is None else {
+            "pw_train_step": {"key": k_, "status": status,
+                              "seconds": round(secs, 3)}}
 
     def trainStep(self):
         """The un-jitted per-batch step function with the canonical

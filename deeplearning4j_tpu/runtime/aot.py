@@ -1,0 +1,704 @@
+"""AOT compilation + persistent executable cache.
+
+The suite and production cold-start are COMPILE-dominated: every
+process (and every fresh network instance) pays XLA seconds-to-minutes
+re-compiling programs that are byte-identical to ones already compiled.
+jax's own answer (``jax_compilation_cache_dir``) segfaults on this
+jaxlib 0.4.36 deserializing donated-buffer executables (see
+tests/conftest.py), so this module is our own layer, in the spirit of
+whole-program compilation (arXiv:1810.09868 — compile the WHOLE step
+once, then reuse the executable):
+
+* ``ExecutableCache`` — a two-level (in-memory + on-disk) store of
+  compiled XLA executables keyed by a content hash of everything that
+  shapes the traced program: the network configuration JSON, the entry
+  point, the abstract call signature (shapes/dtypes/shardings), the
+  dtype-policy toggles, the weight-update/sharding mode, and the
+  jax/jaxlib/package versions (a version bump invalidates stale
+  artifacts; a corrupted or stale file falls back to a fresh compile).
+
+* the donation-segfault workaround — cached executables are compiled
+  with donation STRIPPED (``donate_argnums=()``), which is the form
+  jaxlib 0.4.36 round-trips safely, and donation is re-applied at call
+  time by the wrapper: after the executable returns, the buffers at the
+  donated positions are explicitly deleted (guarded against
+  input-to-output aliasing), so the caller-visible contract — donated
+  inputs are invalid after the call, memory is released promptly — is
+  preserved. Stripping donation cannot change math (aliasing is a
+  buffer-assignment concern), which is why a warm-started fit is
+  bitwise-identical to a cold one.
+
+* ``cached_jit`` — a drop-in ``jax.jit`` replacement the network
+  classes build their train/forward/loss steps with. With no cache
+  enabled it IS the plain donated jit (zero behavior change); with a
+  session cache enabled every first call per signature goes
+  key-lookup → deserialize-or-compile, so two networks with equal
+  configs share ONE executable instead of compiling twice.
+
+* ``precompile`` warm-start — ``network.precompile(...)`` (all three
+  network types), ``ParallelWrapper.precompile(...)`` and
+  ``ParallelInference.precompile(...)`` drive ``CachedJit.warm`` with
+  example abstract arguments so serving processes and trainers hit the
+  first real batch with a hot executable.
+
+* shape-bucket canonicalization — ``bucket_batch`` rounds request
+  batch sizes up to a small fixed set of buckets so a serving tier
+  compiles one executable per bucket, never one per request size; the
+  bucket count is the retrace budget to hand RetraceSentinel
+  (``sentinel_budget``).
+
+Scope: single-process jax only (``jax.process_count() > 1`` disables
+the cache — multihost executables embed device assignments that do not
+round-trip across launches).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import tempfile
+import time
+
+import jax
+import numpy as np
+
+__all__ = [
+    "ExecutableCache", "CachedJit", "cached_jit", "compile_lowered",
+    "enable", "disable", "session_cache", "ambient_fingerprint",
+    "network_fingerprint", "samediff_fingerprint", "abstract_signature",
+    "bucket_batch", "pad_batch", "sentinel_budget",
+    "DEFAULT_BATCH_BUCKETS",
+]
+
+#: bump when the on-disk artifact layout changes — old files become
+#: stale (fresh compile + overwrite), never a crash
+CACHE_FORMAT = 1
+
+#: env var naming a directory for the persistent tier; unset = the
+#: session cache (when enabled) is memory-only
+CACHE_DIR_ENV = "DL4J_TPU_AOT_CACHE"
+
+#: kill switch: DL4J_TPU_AOT=off ignores enable()/env-dir entirely
+AOT_ENV = "DL4J_TPU_AOT"
+
+
+def _package_version():
+    from deeplearning4j_tpu import __version__
+
+    return __version__
+
+
+# ----------------------------------------------------------------------
+# fingerprints: everything that shapes the traced program
+# ----------------------------------------------------------------------
+
+def ambient_fingerprint():
+    """Process-level facts that change the compiled program without
+    appearing in any argument: versions (stale-cache invalidation),
+    backend, device count, x64 mode, and the module-global A/B toggles
+    (loss/BN tail modes, pooling backward impl, attention windows) the
+    bench flips — a cache hit across two of THESE states would replay
+    the wrong program."""
+    from deeplearning4j_tpu.nn import losses as _losses
+    from deeplearning4j_tpu.ops import norm as _norm
+    from deeplearning4j_tpu.ops import pallas_attention as _pattn
+    from deeplearning4j_tpu.ops import pooling as _pooling
+
+    return {
+        "format": CACHE_FORMAT,
+        "package": _package_version(),
+        "jax": jax.__version__,
+        "jaxlib": __import__("jaxlib").__version__,
+        "backend": jax.default_backend(),
+        "devices": len(jax.devices()),
+        "x64": bool(jax.config.jax_enable_x64),
+        "loss_tail": _losses._TAIL_MODE,
+        "bn_tail": _norm._TAIL_MODE,
+        "maxpool_bwd": _pooling._BACKWARD_IMPL,
+        "argmax_bwd_win": _pooling._ARGMAX_BWD_MAX_WINDOW,
+        "flash_window": (_pattn._MIN_FLASH_SEQ, _pattn._BLOCKWISE_WINDOW,
+                         _pattn._INTERPRET),
+    }
+
+
+def _sha(text):
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()
+
+
+def network_fingerprint(net):
+    """Stable content hash of a MultiLayerNetwork/ComputationGraph's
+    traced-program identity: the config JSON (layers, updaters,
+    frozen flags, remat policy, dtype — everything serde serializes)
+    plus the pieces that live OUTSIDE the conf: the weight-update hook
+    (ZeRO sharding changes the program and its mesh is not conf state)
+    and the solver algo. Raises if the conf cannot serialize — callers
+    treat that as "not cacheable", never as an error."""
+    impl = getattr(net, "_update_impl", None)
+    impl_desc = "none" if impl is None else (
+        f"{type(impl).__name__}:{getattr(impl, 'axis', None)}:"
+        f"{getattr(impl, 'min_shard_size', None)}:"
+        f"{tuple(sorted(dict(getattr(impl, 'mesh', None).shape).items())) if getattr(impl, 'mesh', None) is not None else None}")
+    return _sha("|".join([
+        type(net).__name__,
+        net.conf.toJson(),
+        impl_desc,
+        "solver" if getattr(net, "_solver", None) is not None else "sgd",
+    ]))
+
+
+def samediff_fingerprint(sd):
+    """Structural hash of a SameDiff graph + its TrainingConfig: op
+    list (names/inputs/outputs/attrs), variable table (name, type,
+    dtype/shape of stored arrays — values ride as runtime arguments and
+    do not bake into the program), loss variables, and the training
+    config (updater + regularization) when set."""
+    parts = [f"{o.opName}({','.join(o.inputs)})->"
+             f"({','.join(o.outputs)}){sorted(o.kwargs.items())!r}"
+             for o in sd._ops]
+    for n in sorted(sd._vars):
+        v = sd._vars[n]
+        a = sd._arrays.get(n)
+        parts.append(
+            f"{n}:{v.variableType}:"
+            f"{None if a is None else (tuple(a.shape), str(a.dtype))}:"
+            f"{getattr(v, '_ph_shape', None)}:{getattr(v, '_ph_dtype', None)}")
+    parts.append(f"loss={sd._loss_vars}")
+    tc = sd._tc
+    if tc is not None:
+        from deeplearning4j_tpu.util import serde
+
+        try:
+            upd = serde.to_json(tc.updater)
+        except Exception:
+            upd = repr(vars(tc.updater)) if hasattr(tc.updater, "__dict__") \
+                else repr(tc.updater)
+        parts.append(f"tc:{upd}:{tc.l1}:{tc.l2}:{tc.weightDecay}:"
+                     f"{tc.dataSetFeatureMapping}:{tc.dataSetLabelMapping}:"
+                     f"{tc.lossVariables}")
+    impl = getattr(sd, "_update_impl", None)
+    parts.append("zero" if impl is not None else "dense")
+    return _sha("|".join(parts))
+
+
+def _leaf_sig(leaf):
+    """Hashable per-leaf signature — (aval, sharding) OBJECT pairs for
+    jax arrays (both hash/compare by value; no string building on the
+    per-call hot path — stringification happens once per first-seen
+    signature in _sig_repr). np/python leaves carry no sharding."""
+    if isinstance(leaf, jax.Array):
+        return (leaf.aval, leaf.sharding)
+    if isinstance(leaf, np.ndarray):
+        return (tuple(leaf.shape), str(leaf.dtype), None)
+    if isinstance(leaf, jax.ShapeDtypeStruct):
+        # normalize to the signature an equivalent CONCRETE array would
+        # produce, so warm(ShapeDtypeStruct(...)) primes the same table/
+        # cache entry the real call looks up (an SDS without an explicit
+        # sharding matches the default single-device placement)
+        from jax.core import ShapedArray
+        from jax.sharding import SingleDeviceSharding
+
+        sh = getattr(leaf, "sharding", None)
+        if sh is None:
+            sh = SingleDeviceSharding(jax.devices()[0])
+        return (ShapedArray(leaf.shape, leaf.dtype), sh)
+    # python scalar: jit would trace it weak-typed; keep the type in
+    # the key so int/float streams don't collide
+    return ("py", type(leaf).__name__)
+
+
+def abstract_signature(args):
+    """Hashable signature of a call's positional args: pytree structure
+    + per-leaf (aval, sharding). The same function at the same
+    signature lowers to the same program."""
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    return (treedef, tuple(_leaf_sig(leaf) for leaf in leaves))
+
+
+def _sig_repr(sig):
+    """Stable string form of a signature for the sha256 disk key —
+    computed once per first-seen signature, never on the dispatch hot
+    path. Aval/sharding objects repr deterministically across
+    processes (device ids, mesh axes, dtype names)."""
+    if isinstance(sig, str):
+        return sig
+    treedef, leaf_sigs = sig
+    parts = []
+    for ls in leaf_sigs:
+        parts.append(",".join(repr(c) for c in ls))
+    return f"{treedef}|{';'.join(parts)}"
+
+
+def cache_key(base_fp, entry, sig, ambient=None):
+    """The on-disk cache key: sha256 over (ambient fingerprint, program
+    fingerprint, entry-point name, abstract signature)."""
+    amb = ambient if ambient is not None else ambient_fingerprint()
+    return _sha("|".join([repr(sorted(amb.items())), base_fp, entry,
+                          _sig_repr(sig)]))
+
+
+# ----------------------------------------------------------------------
+# the cache
+# ----------------------------------------------------------------------
+
+class ExecutableCache:
+    """Two-level executable store.
+
+    Memory tier: key -> jax.stages.Compiled, shared by every network in
+    the process (the tier-1 win: N identical configs, 1 compile).
+    Disk tier (optional ``directory``): pickled
+    (meta, payload, in_tree, out_tree) per key, written atomically
+    (tmp + rename); ``meta`` embeds the ambient fingerprint so a
+    package/jax/jaxlib version bump or toggle flip makes the artifact
+    stale (removed + recompiled) instead of silently wrong. A file that
+    fails to unpickle or deserialize is removed and treated as a miss —
+    a corrupted cache can cost a compile, never correctness.
+    """
+
+    #: per-artifact disk ceiling: a single serialized executable larger
+    #: than this stays memory-only (keeps a shared cache dir bounded;
+    #: the XLA:CPU artifacts measured so far are ~0.05-1 MB)
+    max_artifact_bytes = 64 * 1024 * 1024
+
+    def __init__(self, directory=None):
+        self.directory = os.path.expanduser(str(directory)) \
+            if directory else None
+        if self.directory:
+            # artifacts are pickles: loading one executes whatever it
+            # encodes, so the directory must be writable ONLY by the
+            # trusting user — created 0700, files land 0600 (mkstemp)
+            os.makedirs(self.directory, mode=0o700, exist_ok=True)
+        self._mem = {}
+        self.stats = {"mem_hits": 0, "disk_hits": 0, "misses": 0,
+                      "puts": 0, "stale": 0, "corrupt": 0,
+                      "oversize": 0}
+        #: key -> seconds of the compile (miss) or load (disk hit);
+        #: the CLI --precompile report reads this
+        self.seconds = {}
+
+    # -- paths ----------------------------------------------------------
+    def _path(self, key):
+        return os.path.join(self.directory, key + ".aotx")
+
+    def __contains__(self, key):
+        return key in self._mem or (
+            self.directory is not None and os.path.exists(self._path(key)))
+
+    # -- read -----------------------------------------------------------
+    def get(self, key, ambient=None):
+        """-> Compiled or None. Memory first; then disk (deserialize +
+        promote to memory). Stale/corrupted disk entries are removed."""
+        hit = self._mem.get(key)
+        if hit is not None:
+            self.stats["mem_hits"] += 1
+            return hit
+        if self.directory is None:
+            return None
+        path = self._path(key)
+        if not os.path.exists(path):
+            return None
+        t0 = time.perf_counter()
+        try:
+            with open(path, "rb") as fh:
+                meta, payload, in_tree, out_tree = pickle.load(fh)
+        except Exception:
+            self.stats["corrupt"] += 1
+            self._remove(path)
+            return None
+        amb = ambient if ambient is not None else ambient_fingerprint()
+        if meta.get("ambient") != amb:
+            self.stats["stale"] += 1
+            self._remove(path)
+            return None
+        try:
+            from jax.experimental import serialize_executable as _se
+
+            compiled = _se.deserialize_and_load(payload, in_tree, out_tree)
+        except Exception:
+            self.stats["corrupt"] += 1
+            self._remove(path)
+            return None
+        self.seconds[key] = time.perf_counter() - t0
+        self.stats["disk_hits"] += 1
+        self._mem[key] = compiled
+        return compiled
+
+    @staticmethod
+    def _remove(path):
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+    # -- write ----------------------------------------------------------
+    def put(self, key, compiled, ambient=None, entry=None):
+        """Store in memory and (when a directory is configured)
+        serialize to disk atomically. Serialization failures are
+        swallowed — the memory tier still works and the next process
+        simply recompiles."""
+        self._mem[key] = compiled
+        self.stats["puts"] += 1
+        if self.directory is None:
+            return
+        try:
+            from jax.experimental import serialize_executable as _se
+
+            payload, in_tree, out_tree = _se.serialize(compiled)
+            if len(payload) > self.max_artifact_bytes:
+                self.stats["oversize"] += 1
+                return
+            meta = {"ambient":
+                    ambient if ambient is not None else ambient_fingerprint(),
+                    "entry": entry}
+            fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "wb") as fh:
+                    pickle.dump((meta, payload, in_tree, out_tree), fh)
+                os.replace(tmp, self._path(key))
+            except BaseException:
+                self._remove(tmp)
+                raise
+        except Exception:
+            pass
+
+    def clear_memory(self):
+        """Drop the in-process tier (tests simulate a second process by
+        clearing memory and re-reading disk)."""
+        self._mem.clear()
+
+    def clear(self):
+        self.clear_memory()
+        if self.directory:
+            for name in os.listdir(self.directory):
+                if name.endswith(".aotx"):
+                    self._remove(os.path.join(self.directory, name))
+
+
+# ----------------------------------------------------------------------
+# session cache
+# ----------------------------------------------------------------------
+
+_SESSION = None
+_SESSION_INIT = False
+
+
+def enable(directory=None):
+    """Turn on the process-wide session cache. directory=None falls
+    back to $DL4J_TPU_AOT_CACHE (memory-only if unset); directory=False
+    forces memory-only even when the env var is set (the test suite
+    uses this — see tests/conftest.py on why the suite must never
+    deserialize). Idempotent — re-enabling with the same directory
+    keeps the existing cache. Returns the ExecutableCache."""
+    global _SESSION, _SESSION_INIT
+    if directory is False:
+        directory = None
+    else:
+        directory = directory or os.environ.get(CACHE_DIR_ENV) or None
+    # compare in the same (expanduser'd) form ExecutableCache stores,
+    # or re-enabling with a '~' path would discard the live cache
+    norm = os.path.expanduser(str(directory)) if directory else None
+    if _SESSION is not None and _SESSION.directory == norm:
+        _SESSION_INIT = True
+        return _SESSION
+    _SESSION = ExecutableCache(directory)
+    _SESSION_INIT = True
+    return _SESSION
+
+
+def disable():
+    """Turn the session cache off (networks fall back to plain jit)."""
+    global _SESSION, _SESSION_INIT
+    _SESSION = None
+    _SESSION_INIT = True
+
+
+def session_cache():
+    """The active session cache or None. First call auto-enables a
+    disk-backed cache iff DL4J_TPU_AOT_CACHE is set (so a warm-started
+    process needs no code change); DL4J_TPU_AOT=off vetoes everything;
+    multihost always disables (device assignments in serialized
+    executables do not survive across launches)."""
+    global _SESSION_INIT
+    if os.environ.get(AOT_ENV, "").lower() in ("off", "0", "false"):
+        return None
+    if not _SESSION_INIT:
+        _SESSION_INIT = True
+        if os.environ.get(CACHE_DIR_ENV):
+            enable()
+    if _SESSION is not None and jax.process_count() > 1:
+        return None
+    return _SESSION
+
+
+# ----------------------------------------------------------------------
+# donation emulation
+# ----------------------------------------------------------------------
+
+class _AotCall:
+    """A cached (donation-stripped) executable + call-time re-donation:
+    after the call, delete the array leaves at the donated argument
+    positions — the same "this buffer is dead now" contract the donated
+    jit gives callers, minus XLA's in-place aliasing (peak memory
+    during the step is higher; see docs/COMPILE.md). Leaves that alias
+    an output object are skipped, and deletion failures are ignored —
+    deletion is a memory hint, never a correctness step."""
+
+    __slots__ = ("compiled", "donate_argnums")
+
+    def __init__(self, compiled, donate_argnums=()):
+        self.compiled = compiled
+        self.donate_argnums = tuple(donate_argnums)
+
+    def __call__(self, *args):
+        out = self.compiled(*args)
+        if self.donate_argnums:
+            out_ids = {id(leaf) for leaf in jax.tree_util.tree_leaves(out)}
+            for i in self.donate_argnums:
+                if i >= len(args):
+                    continue
+                for leaf in jax.tree_util.tree_leaves(args[i]):
+                    if isinstance(leaf, jax.Array) \
+                            and id(leaf) not in out_ids:
+                        try:
+                            if not leaf.is_deleted():
+                                leaf.delete()
+                        except Exception:
+                            pass
+        return out
+
+
+def compile_lowered(lowered, key=None, cache=None, entry=None,
+                    donate_argnums=()):
+    """Compile a jax.stages.Lowered through a cache: warm hit returns
+    the deserialized executable (wrapped for re-donation when
+    donate_argnums is given), miss pays lowered.compile() and stores
+    it. With no cache this is exactly ``lowered.compile()``. The
+    lowering itself must have donation STRIPPED — a donated lowering
+    would produce the artifact class jaxlib 0.4.36 cannot deserialize."""
+    cache = cache if cache is not None else session_cache()
+    if cache is None or key is None:
+        compiled = lowered.compile()
+    else:
+        compiled = cache.get(key)
+        if compiled is None:
+            cache.stats["misses"] += 1
+            t0 = time.perf_counter()
+            compiled = lowered.compile()
+            cache.seconds[key] = time.perf_counter() - t0
+            cache.put(key, compiled, entry=entry)
+    if donate_argnums:
+        return _AotCall(compiled, donate_argnums)
+    return compiled
+
+
+# ----------------------------------------------------------------------
+# CachedJit — the drop-in jit the network classes build steps with
+# ----------------------------------------------------------------------
+
+#: table sentinel: this signature failed through the AOT path once —
+#: the plain jit owns it permanently (see CachedJit.__call__)
+_BAD_ENTRY = object()
+
+
+class CachedJit:
+    """jit wrapper with an AOT fast path.
+
+    Call behavior per invocation:
+      * no session/pinned cache, or keyword args (static-arg paths), or
+        an unfingerprintable owner -> the plain fallback jit, donation
+        and all (exactly the pre-AOT behavior);
+      * cache active -> signature lookup in the per-instance table; a
+        first-seen signature computes the content key and goes through
+        the cache (deserialize or compile-without-donation + store),
+        then dispatches to the cached executable with call-time
+        re-donation.
+
+    ``owner`` supplies the program fingerprint lazily (the conf JSON
+    hash); ``extra`` folds caller context the fingerprint cannot see
+    (e.g. a ParallelWrapper's mesh/compression mode) into the key.
+    """
+
+    def __init__(self, fn, owner=None, entry="step", extra="",
+                 donate_argnums=(), fingerprint=None, **jit_kwargs):
+        self._fn = fn
+        self._owner = owner
+        self._entry = entry
+        self._extra = extra
+        self._donate = tuple(donate_argnums or ())
+        self._jit_kwargs = dict(jit_kwargs)
+        self._fallback = jax.jit(fn, donate_argnums=self._donate,
+                                 **jit_kwargs)
+        # donation-stripped twin: the ONLY jit the AOT path lowers
+        # through, so every cached artifact is the serialization-safe
+        # form (the conftest segfault workaround)
+        self._bare = jax.jit(fn, **jit_kwargs)
+        self._table = {}
+        self._fingerprint = fingerprint  # explicit > owner-derived
+        self._fp_failed = False
+        self._pinned_cache = None
+        # identity of the owner's weight-update hook when the
+        # fingerprint was derived: installing/removing the ZeRO hook
+        # changes the traced program, so a change invalidates the
+        # derived fingerprint + table (checked per call, id() cheap)
+        self._seen_impl = object()
+
+    # -- key plumbing ----------------------------------------------------
+    def pin_cache(self, cache):
+        """Use this cache regardless of the session cache (precompile
+        with an explicit cache pins it so later fit() calls keep
+        hitting the same store)."""
+        self._pinned_cache = cache
+        return self
+
+    def _cache(self):
+        return self._pinned_cache if self._pinned_cache is not None \
+            else session_cache()
+
+    def _base_fp(self):
+        if self._fp_failed:
+            return None
+        if self._fingerprint is None:
+            if self._owner is None:
+                self._fp_failed = True
+                return None
+            try:
+                self._fingerprint = network_fingerprint(self._owner)
+            except Exception:
+                self._fp_failed = True
+                return None
+        return self._fingerprint
+
+    def invalidate(self):
+        """Forget the derived fingerprint + signature table (the owner's
+        program identity changed, e.g. a weight-update hook was
+        installed)."""
+        if self._owner is not None:
+            self._fingerprint = None
+        self._fp_failed = False
+        self._table.clear()
+        return self
+
+    def _check_impl(self):
+        if self._owner is None:
+            return
+        cur = id(getattr(self._owner, "_update_impl", None))
+        if cur != self._seen_impl:
+            self._seen_impl = cur
+            self.invalidate()
+
+    # -- dispatch --------------------------------------------------------
+    def _entry_for(self, args, cache):
+        self._check_impl()
+        sig = abstract_signature(args)
+        ent = self._table.get(sig)
+        if ent is None:
+            fp = self._base_fp()
+            if fp is None:
+                return None, None
+            key = cache_key(fp, self._entry + self._extra, sig)
+            compiled = cache.get(key)
+            if compiled is None:
+                cache.stats["misses"] += 1
+                t0 = time.perf_counter()
+                compiled = self._bare.lower(*args).compile()
+                cache.seconds[key] = time.perf_counter() - t0
+                cache.put(key, compiled, entry=self._entry)
+            ent = (_AotCall(compiled, self._donate), key)
+            self._table[sig] = ent
+        return ent
+
+    def __call__(self, *args, **kwargs):
+        cache = self._cache()
+        if cache is None or kwargs:
+            return self._fallback(*args, **kwargs)
+        ent, _key = self._entry_for(args, cache)
+        if ent is None or ent is _BAD_ENTRY:
+            return self._fallback(*args)
+        try:
+            return ent(*args)
+        except TypeError:
+            # aval disagreement the signature didn't capture —
+            # blacklist the entry so the plain jit owns this call
+            # pattern from here on (no retry-per-call)
+            self._table[abstract_signature(args)] = (_BAD_ENTRY, None)
+            return self._fallback(*args)
+
+    def warm(self, *args, cache=None):
+        """Populate the cache + dispatch table for this signature
+        WITHOUT executing (args may be ShapeDtypeStructs). Returns
+        (key, status, seconds): status "warm" = served from cache,
+        "cold" = compiled now, None = not cacheable."""
+        if cache is not None:
+            self.pin_cache(cache)
+        c = self._cache()
+        if c is None:
+            c = self.pin_cache(enable())._cache()
+        before = dict(c.stats)
+        ent, key = self._entry_for(args, c)
+        if ent is None or ent is _BAD_ENTRY:
+            return None, None, 0.0
+        status = "cold" if c.stats["misses"] > before["misses"] else "warm"
+        return key, status, c.seconds.get(key, 0.0)
+
+    # -- jit API passthrough --------------------------------------------
+    def lower(self, *args, **kwargs):
+        return self._fallback.lower(*args, **kwargs)
+
+    def eval_shape(self, *args, **kwargs):
+        return self._fallback.eval_shape(*args, **kwargs)
+
+    @property
+    def __wrapped__(self):
+        return self._fn
+
+
+def cached_jit(fn, owner=None, entry="step", extra="", donate_argnums=(),
+               fingerprint=None, **jit_kwargs):
+    """Build a CachedJit (see class docstring). Drop-in for
+    ``jax.jit(fn, donate_argnums=..., **jit_kwargs)``."""
+    return CachedJit(fn, owner=owner, entry=entry, extra=extra,
+                     donate_argnums=donate_argnums,
+                     fingerprint=fingerprint, **jit_kwargs)
+
+
+# ----------------------------------------------------------------------
+# shape buckets
+# ----------------------------------------------------------------------
+
+#: serving-tier batch buckets: one executable per bucket, never one
+#: per request size
+DEFAULT_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
+
+def bucket_batch(n, buckets=DEFAULT_BATCH_BUCKETS):
+    """Smallest bucket >= n; past the largest bucket, the next multiple
+    of it (so compiles stay bounded: len(buckets) + overflow sizes)."""
+    n = int(n)
+    if n < 1:
+        raise ValueError(f"batch size must be >= 1, got {n}")
+    for b in buckets:
+        if n <= b:
+            return b
+    top = max(buckets)
+    return ((n + top - 1) // top) * top
+
+
+def pad_batch(arr, bucket):
+    """Zero-pad arr's leading (batch) axis up to `bucket` (host-side,
+    numpy). Caller slices the surplus rows off the output."""
+    arr = np.asarray(arr)
+    pad = bucket - arr.shape[0]
+    if pad < 0:
+        raise ValueError(
+            f"batch {arr.shape[0]} exceeds bucket {bucket}")
+    if pad == 0:
+        return arr
+    return np.concatenate(
+        [arr, np.zeros((pad,) + arr.shape[1:], arr.dtype)], axis=0)
+
+
+def sentinel_budget(buckets=DEFAULT_BATCH_BUCKETS, entries=1):
+    """The retrace budget a bucketized call site is allowed: one
+    compile per bucket per entry point — hand to
+    RetraceSentinel(max_compiles=...)."""
+    return len(tuple(buckets)) * int(entries)

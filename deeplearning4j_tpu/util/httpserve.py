@@ -79,9 +79,13 @@ class JsonHandler(http.server.BaseHTTPRequestHandler):
         if self.path.split("?", 1)[0] == "/healthz":
             owner = self._owner()
             ready = owner.ready if owner is not None else True
-            return self._json(
-                {"status": "ok" if ready else "unready"},
-                200 if ready else 503)
+            body = {"status": "ok" if ready else "unready"}
+            err = getattr(owner, "_warmup_error", None)
+            if not ready and err is not None:
+                # an operator must be able to tell "still warming" from
+                # "warmup crashed" without shell access to the pod
+                body["warmupError"] = err
+            return self._json(body, 200 if ready else 503)
         self._dispatch("GET")
 
     def do_POST(self):
@@ -145,6 +149,7 @@ class HttpServerOwner:
     _httpd = None
     _thread = None
     _ready = True
+    _warmup_error = None    # last warmup failure, surfaced on /healthz
     requestDeadline = None  # seconds; None/0 disables
 
     @property
@@ -164,18 +169,40 @@ class HttpServerOwner:
         self._ready = bool(ready)
         return self
 
-    def _serve(self, handler_cls, port, requestDeadline=None):
+    def _serve(self, handler_cls, port, requestDeadline=None,
+               warmup=None):
+        """Start serving. `warmup` (optional callable) is the AOT
+        warm-start hook: the server binds and answers immediately, but
+        /healthz reports 503 until warmup() returns on a background
+        thread — a pod scheduler holds traffic exactly until the
+        executables are hot (pair with ``model.precompile`` /
+        ``ParallelInference.precompile``, docs/COMPILE.md). A warmup
+        failure leaves the server unready rather than crashing it."""
         if self._httpd is not None:
             return self
         if requestDeadline is not None:
             self.requestDeadline = float(requestDeadline) or None
-        self._ready = True  # a restart clears any previous drain
+        self._warmup_error = None
+        self._ready = warmup is None  # a restart clears any previous drain
         self._httpd = http.server.ThreadingHTTPServer(
             ("127.0.0.1", port), handler_cls)
         self._httpd.owner = self
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
+        if warmup is not None:
+            def _warm():
+                try:
+                    warmup()
+                except Exception as e:
+                    # stay unready; /healthz carries the reason so 503
+                    # "still warming" and 503 "warmup crashed" are
+                    # distinguishable from outside the pod
+                    self._warmup_error = f"{type(e).__name__}: {e}"[:500]
+                    return
+                self._ready = True
+
+            threading.Thread(target=_warm, daemon=True).start()
         return self
 
     def stop(self):

@@ -159,24 +159,18 @@ LENET_B64_CEILING = 142_000_000       # measured 129,135,086
 RESNET_BLOCK_B32_CEILING = 69_500_000  # measured 63,121,644
 
 
-@pytest.fixture(scope="module")
-def lenet_subject():
-    from deeplearning4j_tpu.analysis.hbm import (build_subject,
-                                                 lower_train_step)
-
-    net, x_shape, slots = build_subject("lenet", batch_size=64)
-    lowered = lower_train_step(net, x_shape)
-    return net, x_shape, slots, lowered, lowered.compile()
-
+# the compiles live in SESSION-scoped conftest fixtures (one per run,
+# shared with any other module that interrogates the same subjects, and
+# routed through the AOT executable cache — docs/COMPILE.md)
 
 @pytest.fixture(scope="module")
-def resnet_block_subject():
-    from deeplearning4j_tpu.analysis.hbm import (build_subject,
-                                                 lower_train_step)
+def lenet_subject(lenet_compiled_subject):
+    return lenet_compiled_subject
 
-    net, x_shape, slots = build_subject("resnet_block", batch_size=32)
-    lowered = lower_train_step(net, x_shape)
-    return net, x_shape, slots, lowered, lowered.compile()
+
+@pytest.fixture(scope="module")
+def resnet_block_subject(resnet_block_compiled_subject):
+    return resnet_block_compiled_subject
 
 
 def _cost_bytes(compiled):
