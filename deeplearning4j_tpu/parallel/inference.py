@@ -6,12 +6,30 @@ batches (INPLACE/BATCHED modes, observables for async callers). The
 queue exists because each cuda device needs its own host thread and
 model replica. TPU-native design: ONE jitted forward whose input is
 sharded over the mesh's data axis — XLA splits the batch across chips,
-weights stay replicated, and there is no host-side queue to tune. The
-`workers(n)` knob becomes the mesh size; INPLACE vs BATCHED collapses
-into the single SPMD dispatch.
+weights stay replicated, and there is no per-device host thread to
+tune. The `workers(n)` knob becomes the mesh size.
+
+The upstream modes map onto two dispatch disciplines:
+
+* ``INPLACE`` / ``SEQUENTIAL`` — synchronous: every ``output()`` call
+  is one SPMD dispatch (padded to its batch bucket when
+  ``batchBuckets`` is set).
+* ``BATCHED`` — queued-batched: concurrent ``output()`` callers feed a
+  bounded request queue (``queueLimit``) and a dynamic micro-batcher
+  (serving.queue.MicroBatcher) coalesces them into ONE padded,
+  mesh-sharded dispatch per micro-batch — the continuous-batching
+  serving discipline (docs/SERVING.md). Queue overflow raises
+  ``QueueFullError`` (backpressure), never a hang.
+
+Weight-only int8 (``int8=True``) consumes nn/quantize: weights are
+quantized once at construction and dequantized in-graph, so the
+resident/streamed weight bytes are the int8 buffers (the PR-5
+bandwidth story applied to serving).
 """
 
 from __future__ import annotations
+
+import threading
 
 import numpy as np
 
@@ -20,6 +38,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.ndarray import INDArray
 from deeplearning4j_tpu.parallel.mesh import build_mesh, DATA_AXIS
+
+#: upstream InferenceMode names -> dispatch discipline (module
+#: docstring); anything else is rejected loudly at construction
+INFERENCE_MODES = ("INPLACE", "SEQUENTIAL", "BATCHED")
 
 
 def _unwrap(x):
@@ -33,20 +55,55 @@ class ParallelInference:
     mesh:  jax.sharding.Mesh with a "data" axis (default: all devices).
     batchLimit: optional max examples per dispatch; larger inputs are
         chunked host-side (reference: ParallelInference.batchLimit).
+    batchBuckets: padding-bucket executable cache sizes (see below).
+    inferenceMode: INPLACE/SEQUENTIAL (sync) or BATCHED (queued
+        micro-batching); unknown modes raise.
+    queueLimit: BATCHED-mode bound on waiting requests (overflow ->
+        serving.QueueFullError, the HTTP tier's 429).
+    maxWaitMs: BATCHED-mode micro-batch hold time (latency/occupancy
+        knob).
+    int8: weight-only int8 serving (nn/quantize) — weights quantized
+        once here, dequantized in-graph per dispatch.
+    clock: injectable clock for the BATCHED queue (tests).
     """
 
-    def __init__(self, model, mesh=None, batchLimit=0, batchBuckets=None):
+    def __init__(self, model, mesh=None, batchLimit=0, batchBuckets=None,
+                 inferenceMode="INPLACE", queueLimit=64, maxWaitMs=2.0,
+                 int8=False, clock=None):
         model._require_init()
+        mode = str(inferenceMode).upper()
+        if mode not in INFERENCE_MODES:
+            raise ValueError(
+                f"unknown inferenceMode {inferenceMode!r}: supported "
+                f"modes are {INFERENCE_MODES} (INPLACE/SEQUENTIAL = one "
+                "sync SPMD dispatch per output() call, BATCHED = queued "
+                "dynamic micro-batching)")
+        if int(queueLimit) < 1:
+            raise ValueError(f"queueLimit must be >= 1, got {queueLimit}")
         self.model = model
         self.mesh = mesh if mesh is not None else \
             build_mesh({DATA_AXIS: len(jax.devices())})
         self.batchLimit = int(batchLimit)
+        self.inferenceMode = mode
+        self.queueLimit = int(queueLimit)
+        self.maxWaitMs = float(maxWaitMs)
+        self._clock = clock
+        self._batcher = None
+        self._batcher_lock = threading.Lock()
+        self._closed = False
         self._n = self.mesh.shape[DATA_AXIS]
         # padding-bucket executable cache: request batches are padded UP
         # to the nearest bucket so the serving tier compiles one
         # executable per bucket, never one per request size (the retrace
         # budget is len(buckets) — aot.sentinel_budget). None keeps the
-        # legacy exact-size dispatch (one compile per distinct B).
+        # legacy exact-size dispatch (one compile per distinct B) —
+        # except in BATCHED mode, where unbounded per-coalesced-size
+        # compiles would defeat the whole tier, so the default bucket
+        # set applies.
+        from deeplearning4j_tpu.runtime import aot
+
+        if batchBuckets is None and mode == "BATCHED":
+            batchBuckets = aot.DEFAULT_BATCH_BUCKETS
         self.batchBuckets = None if batchBuckets is None else \
             tuple(sorted(int(b) for b in batchBuckets))
         rep = NamedSharding(self.mesh, P())
@@ -54,14 +111,39 @@ class ParallelInference:
         # prefix-pytree shardings: params/states replicated, batch
         # sharded; compiled through the AOT executable cache so a warm
         # process serves its first request without paying XLA
-        from deeplearning4j_tpu.runtime import aot
+        self._int8 = bool(int8)
+        if self._int8:
+            from deeplearning4j_tpu.nn import quantize as _q
 
-        self._jit = aot.cached_jit(
-            model._forward_infer, owner=model,
-            entry="parallel_inference",
-            extra=f"|pi[mesh={sorted(dict(self.mesh.shape).items())}]",
-            in_shardings=(rep, rep, shard),
-            out_shardings=shard)
+            self._qp, self._sc = _q.quantize_params_int8(model._params)
+            compute_dtype = model._compute_dtype
+
+            def _fwd_int8(qp, sc, states, x):
+                p = _q.dequantize_params(qp, sc, compute_dtype)
+                return model._forward_infer(p, states, x)
+
+            self._jit = aot.cached_jit(
+                _fwd_int8, owner=model,
+                entry="parallel_inference_int8",
+                extra=f"|pi[mesh={sorted(dict(self.mesh.shape).items())}]",
+                in_shardings=(rep, rep, rep, shard),
+                out_shardings=shard)
+        else:
+            self._jit = aot.cached_jit(
+                model._forward_infer, owner=model,
+                entry="parallel_inference",
+                extra=f"|pi[mesh={sorted(dict(self.mesh.shape).items())}]",
+                in_shardings=(rep, rep, shard),
+                out_shardings=shard)
+
+    def _head_args(self):
+        """The non-batch dispatch arguments (params/states — plus the
+        int8 pair when quantized). Scales/quantized weights are runtime
+        args, not baked constants, so equal-config models share one
+        executable."""
+        if self._int8:
+            return (self._qp, self._sc, self.model._states)
+        return (self.model._params, self.model._states)
 
     # upstream builder-pattern compatibility --------------------------
     class Builder:
@@ -70,6 +152,8 @@ class ParallelInference:
             self._mesh = None
             self._batchLimit = 0
             self._batchBuckets = None
+            self._inferenceMode = "INPLACE"
+            self._queueLimit = 64
 
         def workers(self, n):
             self._mesh = build_mesh({DATA_AXIS: int(n)})
@@ -83,16 +167,22 @@ class ParallelInference:
             self._batchBuckets = tuple(int(s) for s in sizes)
             return self
 
-        def inferenceMode(self, _mode):
-            return self  # INPLACE/BATCHED both lower to one SPMD dispatch
+        def inferenceMode(self, mode):
+            # validated in ParallelInference.__init__ (unknown modes
+            # raise there, loudly)
+            self._inferenceMode = mode
+            return self
 
-        def queueLimit(self, _n):
-            return self  # no host queue in the SPMD design
+        def queueLimit(self, n):
+            self._queueLimit = int(n)
+            return self
 
         def build(self):
             return ParallelInference(self._model, mesh=self._mesh,
                                      batchLimit=self._batchLimit,
-                                     batchBuckets=self._batchBuckets)
+                                     batchBuckets=self._batchBuckets,
+                                     inferenceMode=self._inferenceMode,
+                                     queueLimit=self._queueLimit)
 
     # -----------------------------------------------------------------
     def _target_batch(self, B):
@@ -112,6 +202,16 @@ class ParallelInference:
 
         return pad_batch(a, self._target_batch(B))
 
+    def _place(self, a):
+        """Explicit mesh placement of a padded batch (shard_batch): the
+        micro-batch spans the mesh before the dispatch is issued, and —
+        because precompile() warms with the SAME placed signature —
+        placement can never demote a warm bucket executable to a fresh
+        compile."""
+        from deeplearning4j_tpu.parallel.sharding import shard_batch
+
+        return shard_batch(np.asarray(a), self.mesh)
+
     def precompile(self, batchSizes=None, featuresShape=None,
                    cache=None):
         """AOT warm-start of the sharded forward for every batch bucket
@@ -128,9 +228,7 @@ class ParallelInference:
             raise ValueError(
                 "precompile needs batchSizes=... or batchBuckets set at "
                 "construction")
-        from deeplearning4j_tpu.nn.graph import ComputationGraph as _CG
-
-        if isinstance(self.model, _CG) \
+        if isinstance(self.model, ComputationGraph) \
                 and len(self.model.conf.networkInputs) != 1:
             # output() serves multi-input graphs fine, but there is no
             # canonical single example feed to warm with — fail HERE
@@ -153,36 +251,147 @@ class ParallelInference:
                 x = np.zeros(shape_for_input_type(
                     self.model.conf.inputType, Bt), np.float32)
             if isinstance(self.model, ComputationGraph):
-                feed = {self.model.conf.networkInputs[0]: x}
+                feed = {self.model.conf.networkInputs[0]: self._place(x)}
             else:
-                feed = x
+                feed = self._place(x)
             k_, status, secs = self._jit.warm(
-                self.model._params, self.model._states, feed,
-                cache=cache)
+                *self._head_args(), feed, cache=cache)
             if status is not None:
                 report[int(B)] = {"key": k_, "status": status,
                                   "seconds": round(secs, 3)}
         return report
 
+    def example_shape(self):
+        """Per-example (trailing) feature shape from the model conf's
+        InputType, or None when it cannot be derived (multi-input
+        graphs) — the request-validation contract the serving queue
+        enforces at submit time."""
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.multilayer import shape_for_input_type
+
+        try:
+            if isinstance(self.model, ComputationGraph):
+                if len(self.model.conf.networkInputs) != 1:
+                    return None
+                it = self.model.conf.inputTypes.get(
+                    self.model.conf.networkInputs[0])
+            else:
+                it = self.model.conf.inputType
+            return tuple(shape_for_input_type(it, 1)[1:])
+        except Exception:
+            return None
+
     def _run(self, inputs, B):
         from deeplearning4j_tpu.nn.graph import ComputationGraph
 
         if isinstance(self.model, ComputationGraph):
-            feed = {n: self._pad(np.asarray(a), B)
+            feed = {n: self._place(self._pad(np.asarray(a), B))
                     for n, a in inputs.items()}
-            outs = self._jit(self.model._params, self.model._states, feed)
+            outs = self._jit(*self._head_args(), feed)
             outs = [np.asarray(o)[:B] for o in outs]
             return outs
-        x = self._pad(np.asarray(inputs), B)
-        out = self._jit(self.model._params, self.model._states, x)
+        x = self._place(self._pad(np.asarray(inputs), B))
+        out = self._jit(*self._head_args(), x)
         return [np.asarray(out)[:B]]
+
+    # -- BATCHED mode ---------------------------------------------------
+    def _dispatch_coalesced(self, feats):
+        """ONE padded, bucketed, mesh-sharded dispatch for a
+        host-coalesced batch — the request-path hot function of the
+        serving tier (the MicroBatcher's dispatch callable). Returns
+        the per-row outputs (list for multi-output graphs)."""
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        if isinstance(self.model, ComputationGraph):
+            ins = self.model.conf.networkInputs
+            if len(ins) != 1:
+                raise ValueError(
+                    "queued-batched dispatch coalesces on one batch "
+                    "axis and supports single-input graphs; serve "
+                    "multi-input graphs in INPLACE mode")
+            outs = self._run({ins[0]: feats}, feats.shape[0])
+        else:
+            outs = self._run(feats, feats.shape[0])
+        return outs if len(outs) > 1 else outs[0]
+
+    def _ensure_batcher(self):
+        if self._batcher is not None:  # racing first requests must all
+            return self._batcher       # land on ONE batcher
+        with self._batcher_lock:
+            if self._batcher is not None:
+                return self._batcher
+            from deeplearning4j_tpu.serving.queue import (
+                MicroBatcher, ServingClosedError)
+
+            if self._closed:
+                # a first request racing close() must not resurrect a
+                # fresh batcher on a swapped-out instance — fail like a
+                # closed queue so the host's swap re-route handles it
+                raise ServingClosedError(
+                    "ParallelInference is closed")
+
+            self._batcher = MicroBatcher(
+                self._dispatch_coalesced,
+                max_rows=max(self.batchBuckets),
+                queue_limit=self.queueLimit,
+                max_wait=self.maxWaitMs / 1000.0,
+                bucket_for=self._target_batch,
+                trailing_shape=self.example_shape(),
+                # precompile() warms float32 feeds; pinning the queue to
+                # the same dtype means a stray f64 request can never
+                # change the coalesced signature and force a
+                # request-path compile
+                feature_dtype=np.float32,
+                clock=self._clock,
+                start_thread=self._clock is None)
+        return self._batcher
+
+    def close(self, drain=True):
+        """Stop the BATCHED-mode queue (sync modes keep working). Taken
+        under the batcher lock so a racing first request can never
+        install a fresh batcher after close() looked."""
+        with self._batcher_lock:
+            self._closed = True
+            b = self._batcher
+        if b is not None:
+            b.close(drain=drain)
+        return self
+
+    def _single_array(self, features):
+        """features as ONE coalescable [rows, ...] array, or None when
+        the feed is not queue-batchable (dicts / multi-input graphs)."""
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        if isinstance(features, dict):
+            return None
+        if isinstance(self.model, ComputationGraph):
+            if len(self.model.conf.networkInputs) != 1:
+                return None
+            inputs = self.model._coerce_inputs(features)
+            return np.asarray(next(iter(inputs.values())))
+        return _unwrap(features)
 
     def output(self, features):
         """Run inference with the batch split across the mesh. Accepts a
         single array (MultiLayerNetwork) or an array / list-of-arrays /
         dict for ComputationGraph inputs. Returns INDArray (or a list
-        for multi-output graphs)."""
+        for multi-output graphs).
+
+        In BATCHED mode the call is queued and coalesced with
+        concurrent callers into one micro-batch dispatch; results are
+        sliced back per caller and are bitwise-identical to the sync
+        path (same bucket executables). May raise
+        serving.QueueFullError under backpressure."""
         from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        if self.inferenceMode == "BATCHED":
+            arr = self._single_array(features)
+            if arr is not None:
+                res = self._ensure_batcher().submit(arr)
+                outs = [INDArray(o) for o in
+                        (res if isinstance(res, list) else [res])]
+                return outs[0] if len(outs) == 1 else outs
+            # non-coalescable feed (dict / multi-input): sync dispatch
 
         if isinstance(self.model, ComputationGraph):
             if isinstance(features, dict):

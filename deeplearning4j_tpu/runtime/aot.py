@@ -68,7 +68,7 @@ __all__ = [
     "enable", "disable", "session_cache", "ambient_fingerprint",
     "network_fingerprint", "samediff_fingerprint", "abstract_signature",
     "bucket_batch", "pad_batch", "sentinel_budget",
-    "DEFAULT_BATCH_BUCKETS",
+    "DEFAULT_BATCH_BUCKETS", "CompileWatch",
 ]
 
 #: bump when the on-disk artifact layout changes — old files become
@@ -659,6 +659,53 @@ def cached_jit(fn, owner=None, entry="step", extra="", donate_argnums=(),
     return CachedJit(fn, owner=owner, entry=entry, extra=extra,
                      donate_argnums=donate_argnums,
                      fingerprint=fingerprint, **jit_kwargs)
+
+
+# ----------------------------------------------------------------------
+# warm-path proof
+# ----------------------------------------------------------------------
+
+class CompileWatch:
+    """Context manager proving a region of code compiled nothing.
+
+    Snapshots the cache's miss counter on entry and exposes the delta
+    as ``.misses`` on exit — the warm-swap / serving-soak gate is built
+    on it: after ``precompile()``, "zero request-path compiles" is
+    ``CompileWatch().misses == 0`` over the whole serving window.
+    Counts CACHE misses, i.e. every compile the AOT layer paid; code
+    running outside the cache (fallback jit) is the RetraceSentinel's
+    jurisdiction — use both for a complete proof (docs/SERVING.md).
+    """
+
+    def __init__(self, cache=None):
+        self._explicit = cache
+        self.misses = None
+
+    def __enter__(self):
+        self._cache = self._explicit if self._explicit is not None \
+            else session_cache()
+        if self._cache is None:
+            raise RuntimeError(
+                "CompileWatch needs an active executable cache "
+                "(aot.enable() or an explicit cache) — with no cache "
+                "there is no miss counter to prove warmth against")
+        self._before = self._cache.stats["misses"]
+        return self
+
+    def __exit__(self, *exc):
+        self.misses = self._cache.stats["misses"] - self._before
+        return False
+
+    def assert_no_compiles(self, context="watched region"):
+        if self.misses is None:
+            raise RuntimeError("assert_no_compiles before __exit__")
+        if self.misses:
+            raise RuntimeError(
+                f"{context} paid {self.misses} compile(s) that a warm "
+                "cache should have served — a cold executable reached "
+                "the hot path (precompile the signature, or the key "
+                "changed: see docs/COMPILE.md key anatomy)")
+        return self
 
 
 # ----------------------------------------------------------------------

@@ -1,0 +1,40 @@
+"""Continuous-batching model server.
+
+The serving tier that amortizes XLA dispatches across concurrent
+requests (the classic throughput lever of large-scale serving systems,
+arXiv:1605.08695, applied on top of the one-executable-per-bucket
+compilation model of arXiv:1810.09868):
+
+* ``queue``   — bounded request queue + dynamic micro-batcher: coalesce
+  waiting requests up to the nearest batch bucket (or a max-wait
+  deadline), pad, run ONE dispatch through the per-bucket AOT
+  executable cache, slice results back per request. Injectable clock
+  so latency-path tests run deterministically without sleeps.
+* ``host``    — multi-model host: model name -> (network, dtype policy,
+  optional weight-only int8, batch buckets), each precompiled at
+  registration, with a rolling model swap that warms the new version's
+  executables while the old one keeps serving.
+* ``server``  — the HTTP front (``InferenceServer``): /healthz-gated
+  readiness, queue-full backpressure as 429, per-request deadlines as
+  504.
+* ``loadgen`` — open-loop (Poisson-arrival) load generator recording
+  requests/sec, p50/p99 latency and batch occupancy — the `serving`
+  bench headline.
+
+See docs/SERVING.md.
+"""
+
+from deeplearning4j_tpu.serving.queue import (  # noqa: F401
+    DeadlineExceededError, InferenceRequest, ManualClock, MicroBatcher,
+    QueueFullError, ServingClosedError,
+)
+from deeplearning4j_tpu.serving.host import (  # noqa: F401
+    ModelHost, ServedModel,
+)
+from deeplearning4j_tpu.serving.server import InferenceServer  # noqa: F401
+
+__all__ = [
+    "DeadlineExceededError", "InferenceRequest", "ManualClock",
+    "MicroBatcher", "QueueFullError", "ServingClosedError",
+    "ModelHost", "ServedModel", "InferenceServer",
+]

@@ -1,0 +1,211 @@
+"""Multi-model host: name -> served version, with rolling swap.
+
+Each registered model is a ``ServedModel``: the network, its dtype /
+quantization policy, its batch buckets, and a BATCHED-mode
+``ParallelInference`` (bounded queue + micro-batcher + per-bucket AOT
+executable cache). Registration precompiles every bucket, so the first
+real request of a model's life is served by a hot executable.
+
+Rolling swap (``swap``): the replacement version is built and its
+executables are WARMED while the current version keeps serving; only
+then is the routing entry replaced (an atomic assignment under the
+host lock), and the old version drains its already-queued requests
+through its own hot executables. The request path never sees a cold
+compile and never sees a gap — the /healthz the HTTP tier reports
+stays ready throughout (docs/SERVING.md "Rolling swap").
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+__all__ = ["ServedModel", "ModelHost"]
+
+
+class ServedModel:
+    """One (name, version) entry: network + policy + its BATCHED-mode
+    ParallelInference. Build through ModelHost.register/swap."""
+
+    def __init__(self, name, version, network, mesh=None,
+                 batchBuckets=None, int8=False, queueLimit=64,
+                 maxWaitMs=2.0, clock=None):
+        from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+        self.name = str(name)
+        self.version = int(version)
+        self.network = network
+        self.int8 = bool(int8)
+        self.pi = ParallelInference(
+            network, mesh=mesh, batchBuckets=batchBuckets,
+            inferenceMode="BATCHED", queueLimit=queueLimit,
+            maxWaitMs=maxWaitMs, int8=int8, clock=clock)
+
+    @property
+    def batcher(self):
+        return self.pi._ensure_batcher()
+
+    def warm(self, cache=None):
+        """Precompile every batch bucket (hits are free). Returns the
+        per-bucket {key, status, seconds} report."""
+        return self.pi.precompile(cache=cache)
+
+    def submit(self, features, deadline_s=None):
+        """Queue one request (features [rows, ...]) and block for its
+        sliced result. deadline_s bounds the WHOLE request (queue wait
+        + dispatch): expiry raises DeadlineExceededError whether the
+        request was still queued or the dispatcher is busy. May raise
+        QueueFullError (backpressure)."""
+        b = self.batcher
+        deadline = None if deadline_s is None else \
+            b.clock() + float(deadline_s)
+        return b.submit(features, deadline=deadline, timeout=deadline_s)
+
+    def policy(self):
+        """The policy row the multi-model table reports."""
+        import jax.numpy as jnp
+
+        return {
+            "model": self.name,
+            "version": self.version,
+            "dtype": jnp.dtype(self.network._compute_dtype).name,
+            "int8": self.int8,
+            "batchBuckets": list(self.pi.batchBuckets or ()),
+            "queueLimit": self.pi.queueLimit,
+            "maxWaitMs": self.pi.maxWaitMs,
+            "exampleShape": list(self.pi.example_shape() or ()),
+            "mesh": dict(
+                (k, int(v)) for k, v in self.pi.mesh.shape.items()),
+        }
+
+    def close(self, drain=True):
+        self.pi.close(drain=drain)
+        return self
+
+
+class ModelHost:
+    """name -> ServedModel routing table (module docstring)."""
+
+    def __init__(self, mesh=None, clock=None):
+        self._mesh = mesh
+        self._clock = clock
+        self._models = {}
+        self._registering = set()   # names reserved mid-register
+        self._lock = threading.Lock()
+
+    # -- registration / swap --------------------------------------------
+    def register(self, name, network, *, batchBuckets=None, int8=False,
+                 queueLimit=64, maxWaitMs=2.0, precompile=True):
+        """Serve `network` as `name` (version 1). precompile=True (the
+        production default) warms every bucket executable before the
+        model is routable."""
+        with self._lock:
+            if name in self._models or name in self._registering:
+                raise ValueError(
+                    f"model {name!r} is already registered — use "
+                    "swap() to roll a new version")
+            # reserved so a concurrent register() of the same name
+            # raises instead of silently overwriting the loser
+            self._registering.add(name)
+        try:
+            sm = ServedModel(name, 1, network, mesh=self._mesh,
+                             batchBuckets=batchBuckets, int8=int8,
+                             queueLimit=queueLimit, maxWaitMs=maxWaitMs,
+                             clock=self._clock)
+            report = sm.warm() if precompile else None
+            with self._lock:
+                self._models[name] = sm
+        finally:
+            with self._lock:
+                self._registering.discard(name)
+        return {"model": name, "version": sm.version, "warm": report}
+
+    def swap(self, name, network, **overrides):
+        """Rolling swap to a new version of `name`.
+
+        Sequence: (1) build the replacement with the current policy
+        (override any knob by keyword), (2) WARM its bucket executables
+        while the current version keeps serving, (3) install it
+        atomically, (4) drain the old version — requests already queued
+        complete on the version they were enqueued against, through its
+        own hot executables. No cold compile ever lands on the request
+        path and no request is dropped.
+        """
+        with self._lock:
+            old = self._models.get(name)
+            if old is None:
+                raise KeyError(
+                    f"unknown model {name!r}: register() it first "
+                    f"(registered: {sorted(self._models)})")
+        pol = old.policy()
+        kw = {"batchBuckets": tuple(pol["batchBuckets"]) or None,
+              "int8": pol["int8"], "queueLimit": pol["queueLimit"],
+              "maxWaitMs": pol["maxWaitMs"]}
+        kw.update(overrides)
+        new = ServedModel(name, old.version + 1, network,
+                          mesh=self._mesh, clock=self._clock, **kw)
+        t0 = time.perf_counter()
+        report = new.warm()          # old version is still serving
+        warm_s = time.perf_counter() - t0
+        with self._lock:
+            self._models[name] = new  # atomic routing flip
+        old.close(drain=True)         # queued requests finish on OLD
+        return {"model": name, "version": new.version,
+                "warm": report, "warm_s": round(warm_s, 3)}
+
+    # -- request path ---------------------------------------------------
+    def model(self, name):
+        with self._lock:
+            sm = self._models.get(name)
+        if sm is None:
+            raise KeyError(
+                f"unknown model {name!r} (registered: "
+                f"{sorted(self.names())})")
+        return sm
+
+    def submit(self, name, features, deadline_s=None):
+        """Route one request. Once ENQUEUED, a request completes on the
+        version it was enqueued against even if a swap lands mid-flight
+        (the drain contract). A request that instead loses the
+        resolve/enqueue race against a swap — the old version closed
+        between routing and enqueue — is transparently re-routed to the
+        new version: a rolling swap must never surface as a 5xx."""
+        from deeplearning4j_tpu.serving.queue import ServingClosedError
+
+        feats = np.asarray(features)
+        try:
+            return self.model(name).submit(feats, deadline_s=deadline_s)
+        except ServingClosedError:
+            return self.model(name).submit(feats, deadline_s=deadline_s)
+
+    # -- introspection / lifecycle --------------------------------------
+    def names(self):
+        with self._lock:
+            return sorted(self._models)
+
+    def __contains__(self, name):
+        with self._lock:
+            return name in self._models
+
+    def describe(self):
+        """The multi-model policy table (docs/SERVING.md)."""
+        with self._lock:
+            models = list(self._models.values())
+        return {sm.name: sm.policy() for sm in models}
+
+    def warm_all(self):
+        """(Re)warm every registered model — the HTTP tier's /healthz
+        warmup hook: cache hits are cheap, so gating readiness on this
+        is safe even when registration already precompiled."""
+        with self._lock:
+            models = list(self._models.values())
+        return {sm.name: sm.warm() for sm in models}
+
+    def close(self, drain=True):
+        with self._lock:
+            models = list(self._models.values())
+            self._models.clear()
+        for sm in models:
+            sm.close(drain=drain)

@@ -1,0 +1,384 @@
+"""Bounded request queue + dynamic micro-batcher.
+
+Reference: upstream ParallelInference's worker queue exists because each
+cuda device needs its own host thread and model replica; here the queue
+exists for a different reason — THROUGHPUT. Each XLA dispatch costs the
+same host overhead whether it carries 1 row or 64, so a server facing
+many small concurrent requests should coalesce them into one padded
+device batch and pay the dispatch once per micro-batch, not once per
+request (arXiv:1605.08695's batching lever on top of the
+one-executable-per-bucket model of arXiv:1810.09868).
+
+Mechanics:
+
+* ``submit`` appends to a bounded FIFO; a queue at ``queue_limit``
+  raises ``QueueFullError`` — backpressure the HTTP tier answers as
+  429, never a hang.
+* the scheduler coalesces the FIFO prefix up to ``max_rows`` (the
+  largest batch bucket). It dispatches immediately when the prefix
+  fills a full bucket, and otherwise holds the batch open at most
+  ``max_wait`` seconds measured from the OLDEST waiting request — the
+  latency/occupancy tradeoff knob (docs/SERVING.md).
+* per-request deadlines are honored end-to-end: an expired request is
+  failed with ``DeadlineExceededError`` instead of wasting bucket rows,
+  and ``InferenceRequest.wait(timeout)`` bounds the caller side too.
+* the clock is injectable (``ManualClock``) and the scheduler can be
+  driven synchronously via ``poll()`` — tier-1 latency-path tests run
+  deterministically with no background thread and no sleeps.
+
+The batcher never pads: it hands the host-concatenated rows to the
+``dispatch`` callable (``ParallelInference._dispatch_coalesced``),
+which owns bucket padding, mesh placement and the per-bucket AOT
+executable cache.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+__all__ = [
+    "QueueFullError", "DeadlineExceededError", "ServingClosedError",
+    "InferenceRequest", "MicroBatcher", "ManualClock",
+]
+
+
+class QueueFullError(RuntimeError):
+    """Request queue at queue_limit — backpressure (HTTP 429)."""
+
+
+class DeadlineExceededError(RuntimeError):
+    """Per-request deadline expired before a result (HTTP 504)."""
+
+
+class ServingClosedError(RuntimeError):
+    """Submitted to a closed/draining batcher (HTTP 503)."""
+
+
+class ManualClock:
+    """Deterministic monotonic clock: latency-path tests advance time
+    explicitly instead of sleeping. Pair with a thread-less batcher
+    (``start_thread=False``) driven via ``poll()``."""
+
+    def __init__(self, start=0.0):
+        self.now = float(start)
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, seconds):
+        self.now += float(seconds)
+        return self.now
+
+
+class InferenceRequest:
+    """One enqueued request: features [rows, ...], bookkeeping times,
+    and the completion event the submitting thread blocks on."""
+
+    __slots__ = ("features", "rows", "enqueued_at", "deadline",
+                 "result", "error", "_event")
+
+    def __init__(self, features, enqueued_at, deadline=None):
+        self.features = features
+        self.rows = int(features.shape[0])
+        self.enqueued_at = float(enqueued_at)
+        self.deadline = None if deadline is None else float(deadline)
+        self.result = None
+        self.error = None
+        self._event = threading.Event()
+
+    @property
+    def done(self):
+        return self._event.is_set()
+
+    def finish(self, result):
+        self.result = result
+        self._event.set()
+
+    def fail(self, exc):
+        self.error = exc
+        self._event.set()
+
+    def wait(self, timeout=None):
+        """Block until the batch carrying this request completes.
+        Raises the dispatch failure, the deadline expiry, or — when
+        `timeout` elapses first — DeadlineExceededError (the caller's
+        end of the deadline contract: the client is released even if
+        the dispatcher is wedged mid-batch)."""
+        if not self._event.wait(timeout):
+            raise DeadlineExceededError(
+                f"no result within {timeout:.3f}s")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class MicroBatcher:
+    """Dynamic micro-batcher over a bounded FIFO (module docstring).
+
+    dispatch:     callable(features [R, ...]) -> output [R, ...] or a
+                  list of such arrays (multi-output graphs); row i of
+                  every output must correspond to input row i.
+    max_rows:     coalescing ceiling — the largest batch bucket.
+    queue_limit:  bound on WAITING requests; beyond it submit raises
+                  QueueFullError (HTTP 429).
+    max_wait:     seconds the oldest waiting request may age before a
+                  partial batch dispatches anyway.
+    bucket_for:   rows -> dispatch bucket (occupancy accounting only;
+                  e.g. ParallelInference._target_batch).
+    trailing_shape/feature_dtype: optional per-example contract checked
+                  at submit time — a malformed request is ITS error
+                  (HTTP 400), never a poisoned coalesced batch.
+    clock:        injectable monotonic clock.
+    start_thread: run the background scheduler thread. False = the
+                  owner drives `poll()`/`flush()` explicitly
+                  (deterministic tests).
+    """
+
+    def __init__(self, dispatch, *, max_rows, queue_limit=64,
+                 max_wait=0.002, bucket_for=None, trailing_shape=None,
+                 feature_dtype=None, clock=None, start_thread=True):
+        if int(queue_limit) < 1:
+            raise ValueError(f"queue_limit must be >= 1, got {queue_limit}")
+        if int(max_rows) < 1:
+            raise ValueError(f"max_rows must be >= 1, got {max_rows}")
+        self._dispatch = dispatch
+        self.max_rows = int(max_rows)
+        self.queue_limit = int(queue_limit)
+        self.max_wait = float(max_wait)
+        self.clock = clock if clock is not None else time.monotonic
+        self._bucket_for = bucket_for or (lambda rows: rows)
+        self.trailing_shape = None if trailing_shape is None \
+            else tuple(trailing_shape)
+        self.feature_dtype = feature_dtype
+        self._cond = threading.Condition()
+        self._pending = deque()
+        self._closed = False
+        self.stats = {"requests": 0, "rows": 0, "dispatches": 0,
+                      "dispatched_rows": 0, "coalesced": 0,
+                      "expired": 0, "rejected": 0, "errors": 0}
+        #: (rows, bucket) per dispatch — the occupancy record the
+        #: serving bench histograms
+        self.occupancy = []
+        self._thread = None
+        if start_thread:
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    # -- submit ---------------------------------------------------------
+    def submit(self, features, deadline=None, wait=True, timeout=None):
+        """Enqueue one request of features [rows, ...].
+
+        deadline: absolute time (per this batcher's clock) after which
+        the request must not be dispatched; compute as
+        ``batcher.clock() + seconds``.
+        wait=True blocks for the result (timeout bounds the block and
+        raises DeadlineExceededError); wait=False returns the
+        InferenceRequest for the caller to ``wait()`` on.
+        """
+        features = np.asarray(features)
+        if features.ndim < 1 or features.shape[0] < 1:
+            raise ValueError(
+                f"features must be [rows, ...] with rows >= 1, got "
+                f"shape {features.shape}")
+        if self.trailing_shape is not None \
+                and tuple(features.shape[1:]) != self.trailing_shape:
+            raise ValueError(
+                f"per-example shape {tuple(features.shape[1:])} does not "
+                f"match the model's {self.trailing_shape}")
+        if self.feature_dtype is not None:
+            features = features.astype(self.feature_dtype, copy=False)
+        with self._cond:
+            if self._closed:
+                raise ServingClosedError("batcher is closed")
+            if len(self._pending) >= self.queue_limit:
+                self.stats["rejected"] += 1
+                raise QueueFullError(
+                    f"request queue full ({len(self._pending)} waiting, "
+                    f"queueLimit={self.queue_limit})")
+            req = InferenceRequest(features, self.clock(), deadline)
+            self._pending.append(req)
+            self.stats["requests"] += 1
+            self.stats["rows"] += req.rows
+            self._cond.notify()
+        if wait:
+            return req.wait(timeout)
+        return req
+
+    # -- scheduling core (lock held) ------------------------------------
+    def _expire_locked(self, now):
+        """Fail every WAITING request whose deadline has passed — an
+        expired request must not waste bucket rows. Requests keep FIFO
+        order; expiry can strike anywhere in the queue."""
+        if not self._pending:
+            return
+        keep = deque()
+        for req in self._pending:
+            if req.deadline is not None and now >= req.deadline:
+                self.stats["expired"] += 1
+                req.fail(DeadlineExceededError(
+                    f"deadline passed {now - req.deadline:.3f}s before "
+                    "dispatch"))
+            else:
+                keep.append(req)
+        self._pending = keep
+
+    def _wait_needed_locked(self, now):
+        """None = idle (nothing pending); 0 = dispatch now; > 0 =
+        seconds until the oldest request's max-wait expires."""
+        if not self._pending:
+            return None
+        if self._closed:
+            return 0.0  # draining: flush immediately
+        rows = 0
+        for req in self._pending:
+            rows += req.rows
+            if rows >= self.max_rows:
+                return 0.0  # a full bucket never waits
+        return max(0.0, self.max_wait
+                   - (now - self._pending[0].enqueued_at))
+
+    def _take_batch_locked(self):
+        """Pop the FIFO prefix that fits max_rows (at least one request
+        — an oversized single request dispatches alone; the dispatch
+        side handles overflow buckets)."""
+        batch, rows = [], 0
+        while self._pending:
+            req = self._pending[0]
+            if batch and rows + req.rows > self.max_rows:
+                break
+            batch.append(self._pending.popleft())
+            rows += req.rows
+        return batch
+
+    # -- dispatch (lock NOT held) ---------------------------------------
+    def _run_batch(self, batch):
+        rows = sum(r.rows for r in batch)
+        self.stats["dispatches"] += 1
+        self.stats["dispatched_rows"] += rows
+        self.stats["coalesced"] += len(batch)
+        self.occupancy.append((rows, int(self._bucket_for(rows))))
+        try:
+            feats = batch[0].features if len(batch) == 1 else \
+                np.concatenate([r.features for r in batch], axis=0)
+            outs = self._dispatch(feats)
+        except Exception as e:
+            self.stats["errors"] += len(batch)
+            for r in batch:
+                r.fail(e)
+            return
+        multi = isinstance(outs, (list, tuple))
+        outs_list = [np.asarray(o) for o in (outs if multi else [outs])]
+        off = 0
+        for r in batch:
+            sl = [o[off:off + r.rows] for o in outs_list]
+            off += r.rows
+            r.finish(sl if multi else sl[0])
+
+    # -- drivers --------------------------------------------------------
+    def poll(self, now=None):
+        """One synchronous scheduler pass: expire, then dispatch every
+        batch that is due at `now` (default: the clock). Returns the
+        seconds until the next max-wait expiry, or None when nothing is
+        waiting. This is the thread-less driver deterministic tests
+        (and flush) use."""
+        while True:
+            with self._cond:
+                t = self.clock() if now is None else float(now)
+                self._expire_locked(t)
+                wait_s = self._wait_needed_locked(t)
+                if wait_s is None or wait_s > 0:
+                    return wait_s
+                batch = self._take_batch_locked()
+            if batch:
+                self._run_batch(batch)
+
+    def flush(self):
+        """Dispatch everything pending NOW, regardless of max-wait."""
+        while True:
+            with self._cond:
+                self._expire_locked(self.clock())
+                if not self._pending:
+                    return
+                batch = self._take_batch_locked()
+            self._run_batch(batch)
+
+    def _loop(self):
+        """Background scheduler. Uses the real condition-variable clock
+        for its timed waits — with an injected ManualClock, drive
+        poll() directly instead of starting the thread."""
+        while True:
+            batch = None
+            with self._cond:
+                if self._closed and not self._pending:
+                    return
+                if not self._pending:
+                    self._cond.wait(0.05)
+                    continue
+                now = self.clock()
+                self._expire_locked(now)
+                wait_s = self._wait_needed_locked(now)
+                if wait_s is not None and wait_s > 0:
+                    # bounded: re-evaluates on notify (new arrivals may
+                    # complete a bucket) or when the max-wait expires
+                    self._cond.wait(wait_s)
+                    continue
+                if wait_s is not None:
+                    batch = self._take_batch_locked()
+            if batch:
+                self._run_batch(batch)
+
+    @property
+    def depth(self):
+        """Requests currently waiting (the queue-limit denominator)."""
+        with self._cond:
+            return len(self._pending)
+
+    def close(self, drain=True):
+        """Stop accepting. drain=True completes everything already
+        queued (the rolling-swap contract: enqueued requests finish on
+        the version they were enqueued against); drain=False fails them
+        with ServingClosedError."""
+        with self._cond:
+            self._closed = True
+            if not drain:
+                while self._pending:
+                    self._pending.popleft().fail(
+                        ServingClosedError("batcher closed before "
+                                           "dispatch"))
+            self._cond.notify_all()
+        if drain:
+            self.flush()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        return self
+
+    # -- reporting ------------------------------------------------------
+    def occupancy_summary(self):
+        """Occupancy of every dispatch so far: mean rows/bucket plus a
+        quartile histogram — the 'is max_wait tuned right' signal
+        (docs/SERVING.md)."""
+        if not self.occupancy:
+            return {"dispatches": 0, "mean_occupancy": None,
+                    "histogram": {}}
+        occ = [rows / bucket for rows, bucket in self.occupancy]
+        hist = {"0-25%": 0, "25-50%": 0, "50-75%": 0, "75-100%": 0}
+        for o in occ:
+            if o <= 0.25:
+                hist["0-25%"] += 1
+            elif o <= 0.5:
+                hist["25-50%"] += 1
+            elif o <= 0.75:
+                hist["50-75%"] += 1
+            else:
+                hist["75-100%"] += 1
+        return {"dispatches": len(occ),
+                "mean_occupancy": round(sum(occ) / len(occ), 4),
+                "mean_rows_per_dispatch": round(
+                    sum(r for r, _ in self.occupancy)
+                    / len(self.occupancy), 2),
+                "histogram": hist}

@@ -1,0 +1,124 @@
+"""HTTP front for the continuous-batching model host.
+
+``InferenceServer`` puts a ``ModelHost`` behind the shared stdlib
+serving scaffold (util/httpserve): a threaded loopback HTTP server
+whose per-connection handler threads ARE the concurrent clients the
+micro-batcher coalesces — every in-flight ``:predict`` enqueues into
+the model's bounded queue and blocks for its slice of a coalesced
+dispatch.
+
+Routes:
+
+* ``GET /healthz``                 — readiness (503 until the warmup
+  hook — ``ModelHost.warm_all`` by default — reports every model's
+  bucket executables hot; the pod scheduler gate, docs/COMPILE.md).
+* ``GET /v1/models``               — the multi-model policy table.
+* ``GET /v1/models/<name>``        — one model's policy row (404).
+* ``POST /v1/models/<name>:predict`` — body
+  ``{"instances": [...], "deadlineMs": optional}`` ->
+  ``{"predictions": [...], "model": ..., "version": ..., "rows": n}``.
+
+Backpressure contract (docs/SERVING.md): queue full -> 429, deadline
+exceeded -> 504, unknown model -> 404, malformed request -> 400,
+draining/closed -> 503. Never a hang: every failure mode has a status
+code and the client is always released.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.queue import (
+    DeadlineExceededError, QueueFullError, ServingClosedError,
+)
+from deeplearning4j_tpu.util.httpserve import (
+    HttpError, HttpServerOwner, JsonHandler,
+)
+
+__all__ = ["InferenceServer"]
+
+
+class _InferenceHandler(JsonHandler):
+    def handle_GET(self):
+        host = self._owner().host
+        path = self.path.split("?", 1)[0].rstrip("/")
+        if path == "/v1/models":
+            return self._json({"models": host.describe()})
+        if path.startswith("/v1/models/"):
+            name = path[len("/v1/models/"):]
+            try:
+                return self._json(host.model(name).policy())
+            except KeyError as e:
+                raise HttpError(404, str(e))
+        raise HttpError(404, f"no route {path}")
+
+    def handle_POST(self):
+        host = self._owner().host
+        path = self.path.split("?", 1)[0]
+        if not (path.startswith("/v1/models/")
+                and path.endswith(":predict")):
+            raise HttpError(404, f"no route {path}")
+        name = path[len("/v1/models/"):-len(":predict")]
+        try:
+            body = self._read_json_object()
+        except ValueError as e:
+            raise HttpError(400, str(e))
+        instances = body.get("instances")
+        if instances is None:
+            raise HttpError(400, 'body must carry "instances": [...]')
+        try:
+            feats = np.asarray(instances, dtype=np.float32)
+        except (TypeError, ValueError) as e:
+            raise HttpError(400, f"instances not array-like: {e}")
+        deadline_ms = body.get("deadlineMs")
+        deadline_s = None if deadline_ms is None \
+            else float(deadline_ms) / 1000.0
+        try:
+            try:
+                sm = host.model(name)
+                out = sm.submit(feats, deadline_s=deadline_s)
+            except ServingClosedError:
+                # lost the resolve/enqueue race against a rolling swap:
+                # re-route to the freshly installed version (the host's
+                # zero-5xx swap contract, serving/host.py submit)
+                sm = host.model(name)
+                out = sm.submit(feats, deadline_s=deadline_s)
+        except KeyError as e:
+            raise HttpError(404, str(e))
+        except ValueError as e:       # shape/rows contract violations
+            raise HttpError(400, str(e))
+        except QueueFullError as e:   # backpressure, never a hang
+            raise HttpError(429, str(e))
+        except DeadlineExceededError as e:
+            raise HttpError(504, str(e))
+        except ServingClosedError as e:
+            raise HttpError(503, str(e))
+        preds = [np.asarray(o).tolist() for o in out] \
+            if isinstance(out, list) else np.asarray(out).tolist()
+        return self._json({"predictions": preds, "model": sm.name,
+                           "version": sm.version, "rows": len(feats)})
+
+
+class InferenceServer(HttpServerOwner):
+    """Loopback HTTP server over a ModelHost (module docstring)."""
+
+    def __init__(self, host):
+        self.host = host
+
+    def start(self, port=0, requestDeadline=None, warmup=True):
+        """Bind and serve. warmup=True gates /healthz on
+        ``host.warm_all()`` (503 until every registered model's bucket
+        executables are hot — cheap when registration already
+        precompiled); pass a callable for a custom hook or
+        warmup=None/False to report ready immediately."""
+        w = self.host.warm_all if warmup is True else (warmup or None)
+        return self._serve(_InferenceHandler, port,
+                           requestDeadline=requestDeadline, warmup=w)
+
+    def stop(self, close_host=False):
+        """Stop the HTTP listener. close_host=True also drains and
+        closes every model's queue (the full-shutdown path); the
+        default leaves the host reusable behind a new listener."""
+        super().stop()
+        if close_host:
+            self.host.close(drain=True)

@@ -30,6 +30,18 @@ import json
 import threading
 
 
+class HttpError(Exception):
+    """Typed HTTP failure a handler raises to answer a specific status
+    code with a JSON error body — 404 unknown model, 429 queue-full
+    backpressure, 504 deadline — instead of the generic 500 the
+    dispatch safety net answers for unexpected exceptions."""
+
+    def __init__(self, code, message):
+        super().__init__(message)
+        self.code = int(code)
+        self.message = str(message)
+
+
 class JsonHandler(http.server.BaseHTTPRequestHandler):
     """Request handler base: silenced per-request logging, JSON/body
     writers with correct Content-Length, strict JSON-object body
@@ -54,6 +66,8 @@ class JsonHandler(http.server.BaseHTTPRequestHandler):
                 if self._suppressed and not _force:
                     return  # deadline already answered 503 for us
                 self._responded = True
+        else:
+            self._responded = True  # the dispatch safety net checks it
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
@@ -98,7 +112,20 @@ class JsonHandler(http.server.BaseHTTPRequestHandler):
         owner = self._owner()
         deadline = getattr(owner, "requestDeadline", None)
         if not deadline:
-            return impl()
+            # safety net: a handler exception must reach the CLIENT as
+            # a status code, not as a dropped connection (HttpError
+            # carries its own code; anything else is a 500) — unless a
+            # response is already mid-flight, where a second write
+            # would interleave on the socket
+            try:
+                return impl()
+            except HttpError as e:
+                if not self._responded:
+                    self._json({"error": e.message}, e.code)
+            except Exception as e:
+                if not self._responded:
+                    self._json({"error": f"{type(e).__name__}: {e}"}, 500)
+            return None
         # deadline mode: the handler body runs on a watched daemon
         # thread; if it overruns, THIS thread answers 503 and the
         # worker's eventual write is dropped by the response lock. The
@@ -110,6 +137,11 @@ class JsonHandler(http.server.BaseHTTPRequestHandler):
         def run():
             try:
                 impl()
+            except HttpError as e:
+                try:
+                    self._json({"error": e.message}, e.code)
+                except Exception:
+                    pass
             except Exception as e:
                 try:
                     # parity with the non-deadline path's 500; the

@@ -42,7 +42,12 @@ jax.config.update("jax_enable_x64", True)  # fp64 oracles for gradchecks
 # warm-start children are unaffected, which is why the second-process
 # gates in test_aot_cache stay green). So: no disk tier for the suite
 # itself; the persistent tier is for the bounded precompile warm-start
-# paths (docs/COMPILE.md "Scope and limits").
+# paths (docs/COMPILE.md "Scope and limits"). The serving-tier tests
+# (test_model_server.py) depend on this staying memory-only: their
+# soaks run hundreds of threaded dispatches through the session cache,
+# exactly the pattern the disk tier's deserialization fragility bites —
+# the fresh caches they install are ExecutableCache(None), memory-only
+# by construction.
 
 from deeplearning4j_tpu.runtime import aot as _aot  # noqa: E402
 
