@@ -1103,6 +1103,112 @@ def bench_analysis_parallel():
     }
 
 
+def bench_linalg():
+    """Distributed-linalg workload tier (linalg/, docs/LINALG.md;
+    ROADMAP item 4): sharded-vs-single-device GEMM GFLOP/s (ring SUMMA
+    over the dpxtp mesh vs one plain jitted matmul on one device) and
+    randomized-PCA wall time on a row-sharded tall matrix, with the
+    static per-chip byte bill (linalg.plan) attached so the record is
+    self-describing. On the single tunneled TPU the mesh degenerates to
+    one device — like grad_sharing, the sharded leg then certifies the
+    collective path, not ICI perf; the virtual 8-device CPU twin of
+    this measurement is tier-1's test_linalg."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu import linalg
+    from deeplearning4j_tpu.parallel import (DATA_AXIS, MODEL_AXIS,
+                                             build_mesh)
+
+    devs = jax.devices()
+    n_dev = len(devs)
+    tp = 2 if n_dev % 2 == 0 and n_dev > 1 else 1
+    dp = max(1, n_dev // tp)
+    # dims derived from the mesh so every sharded dim divides its axis
+    # (the never-pad contract) on ANY device count, like the dryrun leg
+    blk = dp * tp
+    base = 512 if SMOKE else 2048
+    dim = max(1, base // blk) * blk
+    reps = 3 if SMOKE else 10
+    rng = np.random.RandomState(0)
+    A = rng.randn(dim, dim).astype("float32")
+    B = rng.randn(dim, dim).astype("float32")
+    flops = 2.0 * dim ** 3
+
+    # single device: plain jitted matmul on device 0
+    a0 = jax.device_put(jnp.asarray(A), devs[0])
+    b0 = jax.device_put(jnp.asarray(B), devs[0])
+    mm = jax.jit(jnp.matmul)
+    t0 = time.perf_counter()
+    jax.block_until_ready(mm(a0, b0))
+    single_compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = mm(a0, b0)
+    jax.block_until_ready(out)
+    single_s = (time.perf_counter() - t0) / reps
+
+    # sharded: ring SUMMA over the dpxtp mesh
+    axes = {DATA_AXIS: dp}
+    if tp > 1:
+        axes[MODEL_AXIS] = tp
+    mesh = build_mesh(axes, devs[: dp * tp])
+    dA = linalg.DistributedMatrix(A, mesh, row_axis=DATA_AXIS,
+                                  col_axis=MODEL_AXIS if tp > 1 else None)
+    dB = linalg.DistributedMatrix(B, mesh, row_axis=DATA_AXIS,
+                                  col_axis=MODEL_AXIS if tp > 1 else None)
+    t0 = time.perf_counter()
+    jax.block_until_ready(linalg.matmul(dA, dB).jax())
+    sharded_compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        outs = linalg.matmul(dA, dB)
+    jax.block_until_ready(outs.jax())
+    sharded_s = (time.perf_counter() - t0) / reps
+    np.testing.assert_allclose(outs.toNumpy(), A @ B, rtol=2e-3,
+                               atol=2e-2)
+
+    # randomized PCA on a row-sharded tall matrix vs host numpy SVD
+    n_rows = (256 if SMOKE else 2048) * blk
+    d_cols = 128 if SMOKE else 256
+    k = 16
+    X = (rng.randn(n_rows, 8) @ rng.randn(8, d_cols)
+         + 0.01 * rng.randn(n_rows, d_cols)).astype("float32")
+    dX = linalg.DistributedMatrix(X, build_mesh({DATA_AXIS: dp * tp},
+                                                devs[: dp * tp]),
+                                  row_axis=DATA_AXIS)
+    t0 = time.perf_counter()
+    comps, ev, mu = linalg.pca(dX, k, n_iter=2)
+    jax.block_until_ready(ev)
+    pca_first_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    comps, ev, mu = linalg.pca(dX, k, n_iter=2)
+    jax.block_until_ready(ev)
+    pca_warm_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    np.linalg.svd(X - X.mean(0), full_matrices=False)
+    numpy_svd_s = time.perf_counter() - t0
+
+    bill = linalg.matmul_plan(dim, dim, dim, dict(mesh.shape),
+                              col_axis=MODEL_AXIS if tp > 1 else None)
+    return {
+        "devices": n_dev, "mesh": dict(mesh.shape), "dim": dim,
+        "gemm_single_gflops": round(flops / single_s / 1e9, 2),
+        "gemm_sharded_gflops": round(flops / sharded_s / 1e9, 2),
+        "gemm_single_compile_s": round(single_compile_s, 3),
+        "gemm_sharded_compile_s": round(sharded_compile_s, 3),
+        "gemm_per_chip_bytes": bill["per_chip_bytes"],
+        "pca": {"rows": n_rows, "cols": d_cols, "k": k,
+                "first_call_s": round(pca_first_s, 3),
+                "warm_call_s": round(pca_warm_s, 3),
+                "numpy_svd_s": round(numpy_svd_s, 3)},
+        "note": ("ring-SUMMA GEMM GFLOP/s sharded vs single device + "
+                 "randomized-PCA wall (warm = executable cached); "
+                 "sharded leg certifies the collective path when only "
+                 "one chip is live (cf. grad_sharing)"),
+    }
+
+
 def bench_aot_cache(budget=None):
     """Cold-vs-warm compile + startup wall for the AOT executable cache
     (runtime/aot.py, docs/COMPILE.md): the round-7 claim is that a
@@ -1393,7 +1499,8 @@ SECONDARY_CONFIGS = [("attention", "bench_attention"),
                      ("analysis", "bench_analysis"),
                      ("analysis_parallel", "bench_analysis_parallel"),
                      ("aot_cache", "bench_aot_cache"),
-                     ("serving", "bench_serving")]
+                     ("serving", "bench_serving"),
+                     ("linalg", "bench_linalg")]
 # attention runs FIRST: the flash-vs-fused table is the one headline
 # perf claim still never captured live (VERDICT r3 weak #1); if the
 # tunnel degrades partway through the secondaries, it must already be

@@ -18,6 +18,12 @@ consistency, pipeline balance, per-chip HBM fit) on every ``--mesh``
 (default: the canonical dp4xtp2 and dp2xpp4 meshes), and adds the
 recompilation-hazard lint (RTC01-03) to source paths.
 
+``--linalg`` validates the canonical distributed-linalg block plans
+(linalg/plan.py: SUMMA GEMM, tall Gram, randomized SVD, CG
+least-squares) on each ``--mesh`` (default dp4xtp2): PAR01/03 axis and
+never-pad divisibility, PAR04 collective lint over the linalg sources,
+and the PAR06 per-chip byte bill against ``--hbm-gb``.
+
 Exit status: 0 = clean (warnings allowed), 1 = errors found,
 2 = usage / unreadable input.
 """
@@ -56,9 +62,18 @@ def _build_parser():
                         "model subjects and the retrace lint (RTC01-03) "
                         "on source paths")
     p.add_argument("--mesh", action="append", dest="meshes", metavar="SPEC",
-                   help="mesh for --parallel as axis=size pairs, e.g. "
-                        "'data=4,model=2'; repeatable (default: the "
-                        "canonical dp4xtp2 and dp2xpp4 meshes)")
+                   help="mesh for --parallel/--linalg as axis=size "
+                        "pairs, e.g. 'data=4,model=2'; repeatable "
+                        "(default: the canonical dp4xtp2 and dp2xpp4 "
+                        "meshes; --linalg defaults to dp4xtp2 only)")
+    p.add_argument("--linalg", action="store_true",
+                   help="statically validate the canonical distributed-"
+                        "linalg block plans (SUMMA GEMM, tall Gram, "
+                        "randomized SVD, CG least-squares) on each "
+                        "--mesh: PAR01/03 axis+divisibility, PAR04 "
+                        "collective lint over the linalg sources, PAR06 "
+                        "per-chip byte bill vs --hbm-gb "
+                        "(linalg/plan.py, docs/LINALG.md)")
     p.add_argument("--hbm-gb", type=float, default=None,
                    help="per-chip HBM budget in GB for the PAR06 fit "
                         "prediction (no budget: the prediction is "
@@ -200,6 +215,16 @@ def main(argv=None):
             print(f"{code}  {desc}")
         return 0
 
+    if args.linalg and (args.parallel or args.zoo or args.paths
+                        or args.precompile or args.attribution):
+        # --linalg is its own subject; letting another subject's block
+        # return first would silently swallow this one's exit status
+        # and un-gate a CI wired to the combined command
+        print("--linalg cannot be combined with --parallel/--zoo/"
+              "--precompile/--attribution/paths; run the subjects as "
+              "separate commands", file=sys.stderr)
+        return 2
+
     aot_cache = None
     if args.cache_dir or args.precompile or args.attribution:
         # an explicit dir (or the env var) turns on the persistent tier
@@ -260,6 +285,41 @@ def main(argv=None):
         # a dtype-policy leak in the bf16 subject is an error a CI gate
         # wired to this command must see
         return 1 if rec["wide_activation_buffers"] else 0
+
+    if args.linalg:
+        from deeplearning4j_tpu.analysis.partitioning import (
+            _mesh_tag, normalize_mesh,
+        )
+        from deeplearning4j_tpu.linalg.plan import (
+            CANONICAL_LINALG_MESH, validate_linalg_plan,
+        )
+
+        try:
+            meshes = ([normalize_mesh(m) for m in args.meshes]
+                      if args.meshes else [dict(CANONICAL_LINALG_MESH)])
+        except (ValueError, TypeError) as e:
+            print(f"bad --mesh: {e}", file=sys.stderr)
+            return 2
+        records = []
+        had_error = False
+        for axes in meshes:
+            rep = validate_linalg_plan(axes, hbm_gb=args.hbm_gb)
+            records.append((f"linalg@{_mesh_tag(axes)}", rep, None))
+            had_error = had_error or not rep.ok
+        if args.as_json:
+            print(_json.dumps(
+                {"reports": [_report_to_json(n, r, w)
+                             for n, r, w in records],
+                 "ok": not had_error}, indent=2))
+        else:
+            for name, rep, _ in records:
+                rep.subject = name
+                print(rep.format(verbose=args.verbose))
+            n_err = sum(len(r.errors) for _, r, _ in records)
+            n_warn = sum(len(r.warnings) for _, r, _ in records)
+            print(f"\n{len(records)} subject(s): {n_err} error(s), "
+                  f"{n_warn} warning(s)")
+        return 1 if had_error else 0
 
     if not args.zoo and not args.paths:
         _build_parser().print_usage()

@@ -57,10 +57,12 @@ __all__ = ["validate_plan", "ShardingPlan", "normalize_mesh",
            "check_collectives", "pipeline_balance"]
 
 
-# canonical mesh axes (parallel/mesh.py); the PAR04 resolver knows them
-# by constant name so `lax.psum(x, DATA_AXIS)` checks without imports
+# canonical mesh axes (parallel/mesh.py + linalg's row/col aliases); the
+# PAR04 resolver knows them by constant name so `lax.psum(x, DATA_AXIS)`
+# and `row_axis=ROW_AXIS` defaults check without imports
 _CANONICAL_AXES = {"DATA_AXIS": "data", "MODEL_AXIS": "model",
-                   "SEQ_AXIS": "seq", "PIPE_AXIS": "pipe"}
+                   "SEQ_AXIS": "seq", "PIPE_AXIS": "pipe",
+                   "ROW_AXIS": "data", "COL_AXIS": "model"}
 
 # skew ratio between effective pipeline-stage loads past which PAR05
 # warns (the schedule runs at the slowest stage's pace)

@@ -19,16 +19,12 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
-
-def _sq_dists(a, b):
-    """[n,d]x[m,d] -> [n,m] squared euclidean distances via the
-    quadratic form (matmul-shaped for the MXU). fp32 precision of this
-    form degrades with the data's distance from the origin, so callers
-    mean-center their data first (distances are translation-invariant)."""
-    return jnp.maximum(
-        jnp.sum(a * a, 1)[:, None] + jnp.sum(b * b, 1)[None, :]
-        - 2.0 * (a @ b.T), 0.0)
+# the quadratic-form distance kernel lives in the distributed-linalg
+# tier now (linalg.sq_dists); imported under the old private name for
+# this module's own uses (and any out-of-tree code bound to it)
+from deeplearning4j_tpu.linalg.distributed import sq_dists as _sq_dists
 
 
 class ClusterSet:
@@ -57,7 +53,7 @@ class KMeansClustering:
     """Reference: KMeansClustering.setup(...).applyTo(points)."""
 
     def __init__(self, clusterCount, maxIterationCount=100,
-                 distanceFunction="euclidean", seed=42):
+                 distanceFunction="euclidean", seed=42, mesh=None):
         if str(distanceFunction).lower() not in ("euclidean", "sqeuclidean"):
             raise ValueError(
                 f"distanceFunction {distanceFunction!r} unsupported "
@@ -67,12 +63,16 @@ class KMeansClustering:
             raise ValueError(f"clusterCount must be >= 1, got {clusterCount}")
         self.maxIter = int(maxIterationCount)
         self.seed = int(seed)
+        # mesh-sharded Lloyd (linalg tier): points row-shard over the
+        # data axis, centers replicate, every reduction is a psum —
+        # k-means at corpus sizes one chip's HBM can't hold
+        self.mesh = mesh
 
     @staticmethod
     def setup(clusterCount, maxIterationCount=100,
-              distanceFunction="euclidean", seed=42):
+              distanceFunction="euclidean", seed=42, mesh=None):
         return KMeansClustering(clusterCount, maxIterationCount,
-                                distanceFunction, seed)
+                                distanceFunction, seed, mesh=mesh)
 
     def applyTo(self, points) -> ClusterSet:
         Xh = np.asarray(getattr(points, "toNumpy", lambda: points)(),
@@ -83,12 +83,20 @@ class KMeansClustering:
         # mean-center: keeps the fp32 quadratic distance form accurate
         # for data far from the origin (translation-invariant)
         mean = Xh.mean(0, keepdims=True)
-        X = jnp.asarray(Xh - mean)
         key = jax.random.key(self.seed)
+        first = int(jax.random.randint(key, (), 0, n))
 
+        if self.mesh is not None:
+            # sharded path: seeding AND Lloyd run inside one sharded
+            # program — the centered corpus is placed row-sharded and
+            # the full matrix never touches a single device
+            C, a, inertia = _lloyd_sharded(Xh - mean, first, self.k,
+                                           self.maxIter, self.mesh)
+            return ClusterSet(np.asarray(C) + mean, a, inertia)
+
+        X = jnp.asarray(Xh - mean)
         # farthest-point seeding with a running min-distance vector:
         # O(k*n*d) total, one distance column per new center
-        first = int(jax.random.randint(key, (), 0, n))
         idxs = [first]
         dmin = _sq_dists(X, X[first][None, :])[:, 0]
         for _ in range(self.k - 1):
@@ -137,6 +145,111 @@ def _lloyd(X, C0, k, maxIter):
     D = _sq_dists(X, C)
     a = jnp.argmin(D, 1)
     return C, a, jnp.sum(jnp.min(D, 1))
+
+
+def _lloyd_sharded(Xc, first_idx, k, maxIter, mesh):
+    """Farthest-point seeding + Lloyd iterations with the points
+    row-sharded over the mesh's data axis (linalg tier), in ONE sharded
+    program — the full corpus never materialises on a single device.
+    Seeding: the first center is extracted from its owning shard
+    (psum-masked dynamic slice), then each farthest point is the global
+    argmax of the running min-distance vector (local argmax candidates
+    all-gathered, re-argmaxed — same first-occurrence tie-break as the
+    single-device path, so the two paths seed identically). Lloyd:
+    distances are the same quadratic-form kernel per shard, center
+    sums/counts and the convergence flag reduce with psums, and empty
+    clusters re-seed to the GLOBAL farthest points (local top-k
+    candidates all-gathered, then re-topped)."""
+    from deeplearning4j_tpu.linalg import DistributedMatrix, ROW_AXIS
+    from deeplearning4j_tpu.linalg.distributed import _entry
+    from deeplearning4j_tpu.parallel._compat import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    dX = DistributedMatrix(np.asarray(Xc, np.float32), mesh,
+                           row_axis=ROW_AXIS)  # never-pad (PAR03)
+    r = ROW_AXIS
+    n_local = dX.block_shape()[0]
+    if n_local < k:
+        raise ValueError(
+            f"{dX.shape[0]} points over mesh axis '{r}' "
+            f"(size {mesh.shape[r]}) leave {n_local} rows per chip — "
+            f"fewer than k={k}; the distributed farthest-point re-seed "
+            "needs k candidates per shard")
+
+    def build():
+        def body(xl, first):
+            nl = xl.shape[0]
+            my = lax.axis_index(r)
+
+            # -- seeding (distributed farthest-point) ---------------
+            owner = first // nl
+            local = first % nl
+            pt0 = lax.psum(
+                jnp.where(owner == my,
+                          lax.dynamic_slice_in_dim(xl, local, 1, 0)[0],
+                          jnp.zeros((xl.shape[1],), xl.dtype)), r)
+            C0 = jnp.zeros((k, xl.shape[1]), xl.dtype).at[0].set(pt0)
+            dmin0 = _sq_dists(xl, pt0[None, :])[:, 0]
+
+            def seed_step(i, carry):
+                dmin, C = carry
+                li = jnp.argmax(dmin)
+                gv = lax.all_gather(dmin[li], r)          # [R]
+                gp = lax.all_gather(xl[li], r)            # [R, d]
+                pt = gp[jnp.argmax(gv)]
+                C = C.at[i].set(pt)
+                dmin = jnp.minimum(dmin,
+                                   _sq_dists(xl, pt[None, :])[:, 0])
+                return dmin, C
+
+            _, C0 = lax.fori_loop(1, k, seed_step, (dmin0, C0))
+
+            # -- Lloyd ----------------------------------------------
+            def step(C):
+                D = _sq_dists(xl, C)
+                a = jnp.argmin(D, 1)
+                onehot = jax.nn.one_hot(a, k, dtype=xl.dtype)
+                counts = lax.psum(jnp.sum(onehot, 0), r)
+                sums = lax.psum(onehot.T @ xl, r)
+                newC = sums / jnp.maximum(counts, 1.0)[:, None]
+                # global farthest points for empty-cluster re-seed:
+                # k local candidates, all-gathered, re-topped
+                lv, li = lax.top_k(jnp.min(D, 1), k)
+                gv = lax.all_gather(lv, r, axis=0, tiled=True)
+                gp = lax.all_gather(xl[li], r, axis=0, tiled=True)
+                far = gp[lax.top_k(gv, k)[1]]
+                return (jnp.where((counts > 0)[:, None], newC, far),
+                        a.astype(jnp.int32))
+
+            def cond(carry):
+                C, a_prev, a, changed, i = carry
+                return (i < maxIter) & changed
+
+            def loop(carry):
+                C, _, a, _, i = carry
+                C2, a2 = step(C)
+                changed = lax.psum(
+                    jnp.any(a != a2).astype(jnp.int32), r) > 0
+                return C2, a, a2, changed, i + jnp.asarray(1, jnp.int32)
+
+            a0 = jnp.full((xl.shape[0],), -1, jnp.int32)
+            C1, a1 = step(C0)
+            C, _, a, _, _ = lax.while_loop(
+                cond, loop,
+                (C1, a0, a1, jnp.asarray(True), jnp.asarray(1, jnp.int32)))
+            D = _sq_dists(xl, C)
+            a = jnp.argmin(D, 1).astype(jnp.int32)
+            inertia = lax.psum(jnp.sum(jnp.min(D, 1)), r)
+            return C, a, inertia
+
+        return shard_map(body, mesh=mesh,
+                         in_specs=(P(r, None), P()),
+                         out_specs=(P(None, None), P(r), P()),
+                         check_vma=False)
+
+    fn = _entry("kmeans_lloyd", mesh, (r, k, int(maxIter)), build)
+    C, a, inertia = fn(dX.jax(), jnp.asarray(int(first_idx), jnp.int32))
+    return C, np.asarray(a), inertia
 
 
 class NearestNeighbors:

@@ -19,7 +19,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from deeplearning4j_tpu.clustering.kmeans import _sq_dists
+from deeplearning4j_tpu.linalg.distributed import sq_dists as _sq_dists
 from deeplearning4j_tpu.clustering.trees import _as_matrix, _as_vector
 
 
@@ -30,9 +30,16 @@ class RandomProjectionLSH:
     probability 1 - theta/pi, so hashLength bits * numTables trades
     recall against candidate-set size exactly like the reference's
     (hashLength, numTables) pair.
+
+    With a `mesh`, index() hashes the corpus through the distributed
+    projection kernel (linalg.matmul: corpus rows sharded over the data
+    axis, the [d, T*L] hyperplane matrix replicated) — the sign codes of
+    a corpus bigger than one chip come back shard-by-shard. Queries stay
+    single-row local ops either way.
     """
 
-    def __init__(self, hashLength, numTables, inDimension, seed=0):
+    def __init__(self, hashLength, numTables, inDimension, seed=0,
+                 mesh=None):
         self.hashLength = int(hashLength)
         self.numTables = int(numTables)
         self.inDimension = int(inDimension)
@@ -45,16 +52,29 @@ class RandomProjectionLSH:
         self._R = jax.random.normal(
             key, (self.inDimension, self.numTables * self.hashLength),
             jnp.float32)
+        self.mesh = mesh
         self._tables = None
         self._X = None
         self._mean = None
 
-    def _codes(self, X):
+    def _project(self, X, distributed=False):
+        """[n, d] @ [d, T*L] sign projection; the corpus-sized call
+        routes through linalg's sharded GEMM when a mesh is set."""
+        if distributed and self.mesh is not None:
+            from deeplearning4j_tpu.linalg import (DistributedMatrix,
+                                                   ROW_AXIS, matmul)
+
+            dX = DistributedMatrix(np.asarray(X, np.float32), self.mesh,
+                                   row_axis=ROW_AXIS)  # never-pad PAR03
+            return matmul(dX, self._R).jax()
+        return jnp.asarray(X, jnp.float32) @ self._R
+
+    def _codes(self, X, distributed=False):
         """[n, d] -> int64 [n, T] packed sign codes. The projection is a
         device matmul; packing happens host-side in numpy int64 — device
         integers are int32 unless x64 mode is on, which would silently
         corrupt codes for hashLength > 30."""
-        bits = np.asarray((jnp.asarray(X, jnp.float32) @ self._R) >= 0)
+        bits = np.asarray(self._project(X, distributed=distributed) >= 0)
         bits = bits.reshape(-1, self.numTables, self.hashLength)
         weights = 2 ** np.arange(self.hashLength, dtype=np.int64)
         return (bits.astype(np.int64) * weights).sum(-1)
@@ -64,7 +84,7 @@ class RandomProjectionLSH:
         if Xh.shape[1] != self.inDimension:
             raise ValueError(
                 f"data must be [n, {self.inDimension}], got {Xh.shape}")
-        codes = self._codes(Xh)
+        codes = self._codes(Xh, distributed=True)
         self._tables = [dict() for _ in range(self.numTables)]
         for t in range(self.numTables):
             table = self._tables[t]

@@ -246,3 +246,42 @@ class DeepWalk:
         self._require_fit()
         self._check_vertex(v)
         return [int(w) for w in self._w2v.wordsNearest(str(int(v)), top)]
+
+    # ---- distributed-linalg products (linalg tier, docs/LINALG.md) ----
+    def embeddings(self) -> np.ndarray:
+        """[numVertices, vectorSize] embedding matrix, row i = vertex i
+        (every vertex is in the vocab: each walk epoch starts one walk
+        at every vertex and minWordFrequency is 1)."""
+        self._require_fit()
+        W = np.asarray(self._w2v._W, np.float32)
+        return W[[self._w2v.vocab[str(v)] for v in range(self._n)]]
+
+    def embeddingGram(self, mesh=None) -> np.ndarray:
+        """E^T E [vectorSize, vectorSize] — the Gram product downstream
+        embedding consumers (whitening, PCA projections) start from.
+        With a `mesh` the reduction runs distributed over row-sharded
+        embeddings (linalg.gram: one psum over the data axis; vertex
+        count must divide the axis — the never-pad PAR03 contract);
+        without, a local product."""
+        E = self.embeddings()
+        if mesh is None:
+            return E.T @ E
+        from deeplearning4j_tpu import linalg
+
+        dE = linalg.DistributedMatrix(E, mesh, row_axis=linalg.ROW_AXIS)
+        return linalg.gram(dE).toNumpy()
+
+    def similarityMatrix(self, mesh=None) -> np.ndarray:
+        """All-pairs cosine similarity [n, n] of the vertex embeddings.
+        With a `mesh`: linalg.matmul(transpose_b=True) — rows sharded,
+        one all_gather of the normalized embeddings over the data axis;
+        the result comes back row-sharded and is gathered to host."""
+        E = self.embeddings()
+        En = E / np.maximum(np.linalg.norm(E, axis=1, keepdims=True),
+                            1e-12)
+        if mesh is None:
+            return En @ En.T
+        from deeplearning4j_tpu import linalg
+
+        dE = linalg.DistributedMatrix(En, mesh, row_axis=linalg.ROW_AXIS)
+        return linalg.matmul(dE, dE, transpose_b=True).toNumpy()

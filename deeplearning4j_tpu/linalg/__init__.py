@@ -1,0 +1,49 @@
+"""Distributed linear algebra on mesh-sharded INDArrays.
+
+A new workload tier alongside training and serving (ROADMAP item 4;
+"Large Scale Distributed Linear Algebra With Tensor Processing Units",
+PAPERS.md arXiv:2112.09017): dense operands bigger than one chip's HBM
+live block-sharded over the same meshes the trainers use, and every
+routine is one shard_map program with named, statically-analyzable
+collectives.
+
+  DistributedMatrix      placement wrapper (row/col/2-D block specs,
+                         never-pad PAR03 divisibility contract)
+  matmul / gram /        SUMMA-style GEMM (+ transpose-fused variants),
+  covariance             Gram/covariance reduced over the sharded rows
+  rsvd / pca             randomized SVD / PCA, small factors replicated
+  cg / lstsq             matrix-free solvers with convergence
+                         diagnostics (the native replacement for the
+                         seed-old optax-CG failure; nn/solvers routes
+                         CONJUGATE_GRADIENT through cg)
+  validate_linalg_plan   PAR01/03/04/06 static pre-flight + per-chip
+                         byte bills (CLI: analysis --linalg)
+  precompile             AOT warm start of the public entry points
+                         (runtime/aot, docs/COMPILE.md)
+
+See docs/LINALG.md for layouts, the fits-on-a-chip rule, and the
+collective shape of each routine.
+"""
+
+from deeplearning4j_tpu.linalg.distributed import (  # noqa: F401
+    COL_AXIS, ROW_AXIS, DistributedMatrix, collective_counts, covariance,
+    gram, install_retrace_sentinel, matmul, pairwise_sq_dists,
+    precompile, sq_dists,
+)
+from deeplearning4j_tpu.linalg.solvers import (  # noqa: F401
+    CGResult, cg, lstsq,
+)
+from deeplearning4j_tpu.linalg.randomized import pca, rsvd  # noqa: F401
+from deeplearning4j_tpu.linalg.plan import (  # noqa: F401
+    CANONICAL_LINALG_MESH, CANONICAL_LINALG_PLANS, gram_plan, lstsq_plan,
+    matmul_plan, rsvd_plan, validate_linalg_plan,
+)
+
+__all__ = [
+    "DistributedMatrix", "ROW_AXIS", "COL_AXIS", "matmul", "gram",
+    "covariance", "pairwise_sq_dists", "sq_dists", "rsvd", "pca", "cg",
+    "lstsq", "CGResult", "collective_counts", "install_retrace_sentinel",
+    "precompile", "matmul_plan", "gram_plan", "rsvd_plan", "lstsq_plan",
+    "CANONICAL_LINALG_MESH", "CANONICAL_LINALG_PLANS",
+    "validate_linalg_plan",
+]

@@ -1,0 +1,292 @@
+"""Static validation + per-chip byte bills for distributed linalg plans.
+
+The partition-plan analyzer (PRs 2-3, analysis/partitioning.py) moves
+every statically decidable sharding mistake to a host-only pre-flight.
+This module extends that contract to the linalg workload tier: each
+canonical block plan (SUMMA GEMM, tall Gram, randomized SVD, CG
+least-squares) gets
+
+  * PAR01/PAR03 checks — axes exist, no axis reused, every sharded
+    dimension divides its axis (the same never-pad contract
+    DistributedMatrix enforces at placement time),
+  * PAR04 — the collective/axis lint (analysis.partitioning.
+    check_collectives) over the linalg sources themselves, so a
+    collective on a non-mesh axis cannot ship,
+  * PAR06 — an analytic per-chip byte bill of exactly what the
+    implemented kernels materialise (blocks, gathered panels, small
+    replicated factors), checked against an --hbm-gb budget. This is
+    how a matrix that does NOT fit one chip is admitted: the GLOBAL
+    operand may exceed HBM as long as the per-chip bill fits.
+
+CLI: ``python -m deeplearning4j_tpu.analysis --linalg`` validates the
+canonical plans on the dp4xtp2 mesh (exit 0/1/2 like every other
+subject).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from deeplearning4j_tpu.analysis.diagnostics import ERROR, WARNING, Report
+from deeplearning4j_tpu.analysis.partitioning import (
+    _mesh_tag, check_collectives, normalize_mesh,
+)
+
+__all__ = ["matmul_plan", "gram_plan", "rsvd_plan", "lstsq_plan",
+           "CANONICAL_LINALG_PLANS", "validate_linalg_plan"]
+
+#: the mesh the canonical plans target (the trainer dp4xtp2 regime)
+CANONICAL_LINALG_MESH = {"data": 4, "model": 2}
+
+#: canonical block plans: a square 2-D SUMMA GEMM plus the tall-skinny
+#: family (Gram / randomized SVD / CG least-squares) on a data matrix
+#: whose GLOBAL footprint (2^23 x 1024 fp32 = 34.4 GB) exceeds a 16 GB
+#: chip — the workload tier single-chip nd4j could never hold
+CANONICAL_LINALG_PLANS = (
+    {"name": "gemm_32k", "op": "matmul",
+     "m": 32768, "k": 32768, "n": 32768},
+    {"name": "gram_tall", "op": "gram", "n": 2 ** 23, "d": 1024},
+    {"name": "rsvd_tall", "op": "rsvd", "n": 2 ** 23, "d": 1024,
+     "rank": 64, "oversample": 8},
+    {"name": "lstsq_tall", "op": "lstsq", "n": 2 ** 23, "d": 1024},
+)
+
+
+def _axes_sizes(axes, row_axis, col_axis):
+    r = int(axes[row_axis]) if row_axis is not None else 1
+    c = int(axes[col_axis]) if col_axis is not None else 1
+    return r, c
+
+
+def matmul_plan(m, k, n, axes, row_axis="data", col_axis="model",
+                dtype_bytes=4):
+    """Per-chip byte bill of C[m,n] = A[m,k] @ B[k,n] under the
+    implemented SUMMA kernels (distributed._summa_2d_body /
+    _summa_1d_body). 2-D (col_axis set): B's k-blocks are gathered over
+    the row axis once (resident K x N/C panel) and A's blocks rotate
+    C-1 hops; 1-D: B's blocks rotate R-1 hops, nothing is gathered."""
+    r, c = _axes_sizes(axes, row_axis, col_axis)
+    a_block = m * k // (r * c) * dtype_bytes
+    b_block = k * n // (r * c) * dtype_bytes
+    out_block = m * n // (r * c) * dtype_bytes
+    if col_axis is not None:
+        gathered = k * (n // c) * dtype_bytes       # B gathered over rows
+        ring_wire = (c - 1) * a_block               # A hops the col ring
+        gather_wire = (r - 1) * (k // r) * (n // c) * dtype_bytes
+    else:
+        gathered = 0
+        ring_wire = (r - 1) * b_block               # B hops the row ring
+        gather_wire = 0
+    return {
+        "op": "matmul", "global_bytes": (m * k + k * n + m * n)
+                                        * dtype_bytes,
+        "a_block_bytes": a_block, "b_block_bytes": b_block,
+        "gathered_panel_bytes": gathered, "out_block_bytes": out_block,
+        "per_chip_bytes": a_block + b_block + gathered + out_block,
+        "ring_wire_bytes": ring_wire, "gather_wire_bytes": gather_wire,
+        "collectives": (("all_gather", "ppermute") if col_axis
+                        else ("ppermute",)),
+    }
+
+
+def gram_plan(n, d, axes, row_axis="data", col_axis=None, dtype_bytes=4):
+    """A^T A for a row-sharded tall A[n, d]: one psum of the d x d
+    partial; the replicated output is billed once per chip."""
+    r, c = _axes_sizes(axes, row_axis, col_axis)
+    a_block = n * d // (r * c) * dtype_bytes
+    gathered = (n // r) * d * dtype_bytes if col_axis is not None else 0
+    out = d * d * dtype_bytes
+    return {
+        "op": "gram", "global_bytes": n * d * dtype_bytes,
+        "a_block_bytes": a_block, "gathered_panel_bytes": gathered,
+        "out_block_bytes": out,
+        "per_chip_bytes": a_block + gathered + out,
+        # ring allreduce of the d x d partial
+        "ring_wire_bytes": 2 * (r - 1) * out // r,
+        "gather_wire_bytes": 0,
+        "collectives": ("psum",) + (("all_gather",) if col_axis else ()),
+    }
+
+
+def rsvd_plan(n, d, rank, axes, oversample=8, row_axis="data",
+              col_axis=None, dtype_bytes=4):
+    """Randomized SVD of row-sharded A[n, d] at rank `rank`: A's block
+    plus the row-sharded sketch Y [n/R, l] and the replicated small
+    factors (Omega/Z/B: 3 x d*l, Gram l*l)."""
+    r, c = _axes_sizes(axes, row_axis, col_axis)
+    l_ = min(rank + oversample, min(n, d))
+    a_block = n * d // (r * c) * dtype_bytes
+    gathered = (n // r) * d * dtype_bytes if col_axis is not None else 0
+    sketch = (n // r) * l_ * dtype_bytes
+    factors = (3 * d * l_ + l_ * l_) * dtype_bytes
+    return {
+        "op": "rsvd", "global_bytes": n * d * dtype_bytes,
+        "a_block_bytes": a_block, "gathered_panel_bytes": gathered,
+        "sketch_block_bytes": sketch, "out_block_bytes": factors,
+        "per_chip_bytes": a_block + gathered + sketch + factors,
+        "ring_wire_bytes": 2 * (r - 1) * (d * l_ * dtype_bytes) // r,
+        "gather_wire_bytes": 0,
+        "collectives": ("psum",) + (("all_gather",) if col_axis else ()),
+    }
+
+
+def lstsq_plan(n, d, axes, row_axis="data", col_axis=None, dtype_bytes=4):
+    """Normal-equation CG for row-sharded A[n, d]: A's block, the local
+    rhs rows, and the replicated k-sized CG state (x/r/z/p + matvec
+    temp = 5d) — matrix-free, A^T A never materialises."""
+    r, c = _axes_sizes(axes, row_axis, col_axis)
+    a_block = n * d // (r * c) * dtype_bytes
+    gathered = (n // r) * d * dtype_bytes if col_axis is not None else 0
+    rhs = (n // r) * dtype_bytes
+    state = 5 * d * dtype_bytes
+    return {
+        "op": "lstsq", "global_bytes": (n * d + n) * dtype_bytes,
+        "a_block_bytes": a_block, "gathered_panel_bytes": gathered,
+        "rhs_block_bytes": rhs, "out_block_bytes": state,
+        "per_chip_bytes": a_block + gathered + rhs + state,
+        # one d-vector psum per CG iteration (billed per iteration)
+        "ring_wire_bytes_per_iter": 2 * (r - 1) * d * dtype_bytes // r,
+        "gather_wire_bytes": 0,
+        "collectives": ("psum",) + (("all_gather",) if col_axis else ()),
+    }
+
+
+def _bill(plan, axes, dtype_bytes):
+    op = plan["op"]
+    if op == "matmul":
+        return matmul_plan(plan["m"], plan["k"], plan["n"], axes,
+                           row_axis=plan.get("row_axis", "data"),
+                           col_axis=plan.get("col_axis", "model"),
+                           dtype_bytes=dtype_bytes)
+    row = plan.get("row_axis", "data")
+    col = plan.get("col_axis")
+    if op == "gram":
+        return gram_plan(plan["n"], plan["d"], axes, row_axis=row,
+                         col_axis=col, dtype_bytes=dtype_bytes)
+    if op == "rsvd":
+        return rsvd_plan(plan["n"], plan["d"], plan["rank"], axes,
+                         oversample=plan.get("oversample", 8),
+                         row_axis=row, col_axis=col,
+                         dtype_bytes=dtype_bytes)
+    if op == "lstsq":
+        return lstsq_plan(plan["n"], plan["d"], axes, row_axis=row,
+                          col_axis=col, dtype_bytes=dtype_bytes)
+    raise ValueError(f"unknown linalg plan op {op!r}")
+
+
+def _plan_dims(plan):
+    """(dim, role, axis_role) triples the never-pad contract checks."""
+    op = plan["op"]
+    row = plan.get("row_axis", "data")
+    col = plan.get("col_axis", "model" if op == "matmul" else None)
+    if op == "matmul":
+        return [(plan["m"], "m (rows of A)", row),
+                (plan["k"], "k (contraction)", row),
+                (plan["k"], "k (contraction)", col),
+                (plan["n"], "n (cols of B)", col)]
+    return [(plan["n"], "n (rows)", row), (plan["d"], "d (cols)", col)]
+
+
+def validate_linalg_plan(mesh, plans=None, hbm_gb=None, dtype_bytes=4,
+                         check_sources=True):
+    """Static pre-flight of distributed-linalg block plans on one mesh:
+    PAR01 (axes exist), PAR03 (never-pad divisibility), PAR04 (the
+    collective lint over the linalg sources), PAR06 (per-chip bill vs
+    the HBM budget). Returns a Report; report.plan carries the
+    per-plan byte bills."""
+    axes = normalize_mesh(mesh)
+    plans = CANONICAL_LINALG_PLANS if plans is None else plans
+    report = Report(subject=f"linalg @ {_mesh_tag(axes)}")
+    bills = {}
+
+    for plan in plans:
+        name = plan.get("name", plan["op"])
+        where = f"linalg plan '{name}'"
+        usable = True
+        # axis reuse: the runtime (DistributedMatrix) rejects
+        # row_axis == col_axis, and _axes_sizes would double-count the
+        # shared axis (r*c) — under-billing per_chip_bytes by that
+        # factor and admitting plans that cannot even be placed
+        row = plan.get("row_axis", "data")
+        col = plan.get("col_axis",
+                       "model" if plan["op"] == "matmul" else None)
+        if col is not None and row == col:
+            report.add("PAR01", ERROR, where,
+                       f"row_axis and col_axis are both '{row}': a "
+                       "mesh axis can shard at most one dim",
+                       hint="pick distinct axes or drop col_axis")
+            continue
+        for dim, role, axis in _plan_dims(plan):
+            if axis is None:
+                continue
+            if axis not in axes:
+                report.add("PAR01", ERROR, where,
+                           f"plan shards {role} over mesh axis '{axis}' "
+                           f"but the mesh axes are {sorted(axes)}",
+                           hint="fix the axis name or add the axis to "
+                                "build_mesh(...)")
+                usable = False
+                continue
+            if dim % axes[axis] != 0:
+                report.add("PAR03", ERROR, where,
+                           f"{role} = {dim} is not divisible by mesh "
+                           f"axis '{axis}' (size {axes[axis]}): "
+                           "DistributedMatrix refuses to silently pad",
+                           hint=f"use a multiple of {axes[axis]} or "
+                                "replicate that dim")
+                usable = False
+        if not usable:
+            continue
+        bill = _bill(plan, axes, dtype_bytes)
+        bills[name] = bill
+        if hbm_gb is not None:
+            budget = float(hbm_gb) * 1e9
+            used = bill["per_chip_bytes"]
+            detail = (f"block {bill['a_block_bytes'] / 1e9:.3f} GB + "
+                      f"gathered {bill['gathered_panel_bytes'] / 1e9:.3f}"
+                      f" GB + out {bill['out_block_bytes'] / 1e9:.3f} GB"
+                      f"; global operand "
+                      f"{bill['global_bytes'] / 1e9:.3f} GB")
+            if used > budget:
+                report.add(
+                    "PAR06", ERROR, f"{where} @ {_mesh_tag(axes)}",
+                    f"predicted per-chip bytes {used / 1e9:.3f} GB "
+                    f"exceed the {float(hbm_gb):g} GB budget ({detail})",
+                    hint="shard over more axes, shrink the block, or "
+                         "stream panels")
+            elif used > 0.9 * budget:
+                report.add(
+                    "PAR06", WARNING, f"{where} @ {_mesh_tag(axes)}",
+                    f"predicted per-chip bytes {used / 1e9:.3f} GB are "
+                    f"within 10% of the {float(hbm_gb):g} GB budget "
+                    f"({detail})",
+                    hint="XLA scratch/fragmentation can push a >90% "
+                         "fit over the edge")
+
+    if check_sources:
+        import deeplearning4j_tpu.linalg as _pkg
+
+        base = os.path.dirname(os.path.abspath(_pkg.__file__))
+        for fname in ("distributed.py", "solvers.py", "randomized.py"):
+            path = os.path.join(base, fname)
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    src = fh.read()
+            except OSError:
+                continue
+            report.extend(check_collectives(src, axes, path=path))
+
+    report.plan = {"mesh": dict(axes), "bills": bills,
+                   "dtype_bytes": int(dtype_bytes)}
+    return report
+
+
+def per_chip_parity(dm):
+    """Cross-check helper: the static bill's block bytes for one placed
+    DistributedMatrix (the PAR06 'within the analyzer's contract'
+    gate) — must equal dm.per_chip_bytes() exactly."""
+    axes = dict(dm.mesh.shape)
+    r, c = _axes_sizes(axes, dm.row_axis, dm.col_axis)
+    return int(np.prod(dm.shape)) // (r * c) * dm.dtype.itemsize

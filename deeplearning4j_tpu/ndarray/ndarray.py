@@ -143,6 +143,18 @@ class INDArray:
         """Escape hatch to the underlying buffer (TPU-native extension)."""
         return self._jx
 
+    def distribute(self, mesh, row_axis="data", col_axis=None):
+        """Place this 2-D matrix block-sharded over `mesh` as a
+        linalg.DistributedMatrix (TPU-native extension; docs/LINALG.md)
+        — the entry point to the distributed linear algebra tier
+        (SUMMA matmul, Gram, randomized SVD/PCA, CG/least-squares) for
+        operands bigger than one chip's HBM. Dims that do not divide
+        their mesh axis raise the never-pad PAR03 contract error."""
+        from deeplearning4j_tpu.linalg import DistributedMatrix
+
+        return DistributedMatrix(self, mesh, row_axis=row_axis,
+                                 col_axis=col_axis)
+
     def castTo(self, dtype) -> "INDArray":
         return INDArray(self._jx.astype(resolve(dtype)))
 
