@@ -1637,6 +1637,13 @@ rec = {"cpu_mesh_steps_per_sec": round(1/dt, 1),
        "global_batch": 512,
        "devices": len(jax.devices()),
        "compression": m.gradient_compression}
+# analytic per-replica bytes-on-wire of this trainer's gradient
+# reduction (ISSUE 11: the headline's bytes_on_wire field)
+from deeplearning4j_tpu.parallel import compressed_wire_bytes
+G = sum(int(np.prod(l.shape)) * 4
+        for l in jtu.tree_leaves(net._params))
+rec["bytes_on_wire"] = compressed_wire_bytes(
+    G, len(jax.devices()), m.gradient_compression)
 # ---- replicated-vs-sharded weight update A/B ----
 ab = {}
 nets = {}
@@ -1689,7 +1696,90 @@ print(json.dumps(rec))
     rec["note"] = ("CORRECTNESS CERTIFICATION of the sharded psum path "
                    "on a virtual 8-device CPU mesh — wall-clock is CPU "
                    "time, NOT a TPU rate; int8 allreduce by default")
+    # ISSUE 11: bytes-on-wire vs convergence parity per compression
+    # mode, swept over virtual-mesh sizes (each size its own forced-
+    # device-count subprocess); a size that times out records an error
+    # without losing the banked 8-device record
+    rec["compression_sweep"] = {
+        str(nd): _grad_compression_sweep_one(nd, max(60, timeout_s // 4))
+        for nd in (8, 32, 128)}
     return rec
+
+
+def _grad_compression_sweep_one(n_devices, timeout_s):
+    """One virtual-mesh size of the grad_sharing compression sweep:
+    train the same tiny MLP under every gradient_compression mode for a
+    few steps and record final loss (parity vs dense), steps/sec and
+    the analytic per-replica bytes-on-wire per step."""
+    code = r"""
+import json, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.tree_util as jtu
+import numpy as np
+from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+    MultiLayerNetwork, DenseLayer, OutputLayer, Sgd)
+from deeplearning4j_tpu.parallel import (ParallelWrapper,
+    data_parallel_mesh, compressed_wire_bytes)
+ndev = len(jax.devices())
+def make_conf():
+    return (NeuralNetConfiguration.Builder().seed(3).updater(Sgd(0.1))
+            .activation("tanh").list()
+            .layer(DenseLayer(nOut=64)).layer(DenseLayer(nOut=32))
+            .layer(OutputLayer(nOut=8, activation="softmax"))
+            .setInputType(InputType.feedForward(32)).build())
+rng = np.random.RandomState(0)
+B = 2 * ndev
+yi = rng.randint(0, 8, B)
+x = (np.eye(8)[yi] @ rng.randn(8, 32) + 0.1 * rng.randn(B, 32)) \
+    .astype("float32")
+y = np.eye(8, dtype="float32")[yi]
+mesh = data_parallel_mesh()
+out = {"devices": ndev, "modes": {}}
+dense_loss = None
+for mode in (None, "int8", "block_int8", "threshold"):
+    net = MultiLayerNetwork(make_conf()).init()
+    kw = {"threshold": 1e-2} if mode == "threshold" else {}
+    pw = ParallelWrapper(net, mesh=mesh, gradient_compression=mode, **kw)
+    pw.fit(x, y)  # compile
+    t0 = time.perf_counter(); steps = 4
+    for _ in range(steps):
+        pw.fit(x, y)
+    sps = steps / (time.perf_counter() - t0)
+    G = sum(int(np.prod(l.shape)) * 4
+            for l in jtu.tree_leaves(net._params))
+    wire = compressed_wire_bytes(G, ndev, mode,
+                                 capacity=pw.encoding_capacity)
+    loss = float(net.score())
+    if mode is None:
+        dense_loss = loss
+    out["modes"][wire["mode"]] = {
+        "final_loss": round(loss, 5),
+        "loss_delta_vs_dense": None if dense_loss is None
+        else round(loss - dense_loss, 5),
+        "steps_per_sec": round(sps, 2),
+        "wire_bytes_per_step": wire["wire_bytes"],
+        "wire_ratio_vs_dense": wire["ratio"],
+    }
+print(json.dumps(out))
+"""
+    env = dict(os.environ)
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "host_platform_device_count" not in f]
+    env["XLA_FLAGS"] = " ".join(
+        flags + [f"--xla_force_host_platform_device_count={n_devices}"])
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=timeout_s, env=env,
+                           cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return {"error": f"timeout at {timeout_s}s "
+                         f"({n_devices} virtual devices)"}
+    if r.returncode != 0:
+        return {"error": (r.stderr or r.stdout)[-300:]}
+    return json.loads(r.stdout.strip().splitlines()[-1])
 
 
 def _run_config_subprocess(fn_name, budget):
@@ -1840,6 +1930,16 @@ def main():
         # recorded at top level so BENCH_r06+ is attributable
         "weight_update_mode": configs.get("grad_sharing", {}).get(
             "weight_update_mode", "replicated"),
+        # compressed gradient collectives (round 11, ISSUE 11): which
+        # compression mode the gradient-sharing trainer ran and its
+        # analytic per-replica bytes-on-wire per step — top level so
+        # BENCH_r06+ stays attributable; None/absent when the
+        # grad_sharing leg errored (tunnel_dead-safe: that leg is
+        # CPU-pinned and never touches the chip)
+        "compression_mode": configs.get("grad_sharing", {}).get(
+            "compression"),
+        "bytes_on_wire": configs.get("grad_sharing", {}).get(
+            "bytes_on_wire"),
         # the system's SECOND measured product surface (round 8): what
         # the continuous-batching model server sustains under open-loop
         # load, and its amortization factor over one-dispatch-per-

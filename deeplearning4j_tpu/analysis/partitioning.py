@@ -118,7 +118,9 @@ class ShardingPlan:
                  pipe_axis=_UNSET, param_specs=None,
                  min_shard_size=2 ** 16, microbatches=None,
                  weight_update="replicated",
-                 weight_update_min_shard=2 ** 16):
+                 weight_update_min_shard=2 ** 16,
+                 gradient_compression=None, compression_block=None,
+                 encoding_capacity=None):
         # axes the user wrote down themselves get strict PAR01 checking;
         # the canonical defaults adapt to whatever the mesh carries
         self.explicit_axes = set()
@@ -153,6 +155,28 @@ class ShardingPlan:
                 f"{weight_update!r}")
         self.weight_update = weight_update
         self.weight_update_min_shard = int(weight_update_min_shard)
+        # compressed gradient collectives (runtime twin:
+        # ParallelWrapper gradient_compression= — ISSUE 11): the plan
+        # bills the per-replica bytes-on-wire of the gradient reduction
+        # per mode (PAR06 grad_collective row). "threshold" does not
+        # compose with weight_update="sharded" — same runtime rule.
+        from deeplearning4j_tpu.parallel.sharding import COMPRESSION_MODES
+
+        if gradient_compression not in COMPRESSION_MODES:
+            raise ValueError(
+                "gradient_compression must be one of "
+                f"{COMPRESSION_MODES}, got {gradient_compression!r}")
+        if gradient_compression == "threshold" \
+                and weight_update == "sharded":
+            raise ValueError(
+                "gradient_compression='threshold' does not compose with "
+                "weight_update='sharded' (no per-parameter "
+                "reduce-scatter form); pick 'int8'/'block_int8' or the "
+                "replicated update — the runtime trainer enforces the "
+                "same rule")
+        self.gradient_compression = gradient_compression
+        self.compression_block = compression_block
+        self.encoding_capacity = encoding_capacity
 
     def spec_for(self, layer_key, pname, shape):
         """(spec tuple, explicit?) for one parameter."""
@@ -548,6 +572,20 @@ def _predict_hbm(report, conf, rows, axes, plan, batchSize, dataType,
     terms["weight_update"] = plan.weight_update
     terms["mesh"] = dict(axes)
     terms["pipeline_stages"] = pp if balance is not None else 1
+    # compressed gradient collectives (ISSUE 11): bill the per-replica
+    # bytes-on-wire of the dp gradient reduction per mode — fp32 grads
+    # over the per-chip (tp-divided) parameter residency, the same
+    # convention dp_weight_update_bytes uses. Informational (wire, not
+    # HBM): it does not enter the fit total.
+    terms["gradient_compression"] = plan.gradient_compression
+    if dp > 1:
+        from deeplearning4j_tpu.parallel.sharding import \
+            compressed_wire_bytes
+
+        terms["grad_collective"] = compressed_wire_bytes(
+            param_elems * 4, dp, plan.gradient_compression,
+            block=plan.compression_block,
+            capacity=plan.encoding_capacity)
     return terms
 
 

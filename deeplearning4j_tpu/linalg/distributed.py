@@ -577,7 +577,7 @@ def pairwise_sq_dists(a: DistributedMatrix, b):
 # ----------------------------------------------------------------------
 
 _COLLECTIVE_PRIMS = ("psum", "all_gather", "ppermute", "psum_scatter",
-                     "all_to_all", "pmin", "pmax")
+                     "reduce_scatter", "all_to_all", "pmin", "pmax")
 
 
 def collective_counts(fn, *args):

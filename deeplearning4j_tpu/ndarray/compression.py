@@ -25,7 +25,47 @@ import numpy as np
 
 from deeplearning4j_tpu.ndarray.ndarray import INDArray
 
-_ALGOS = ("GZIP", "FLOAT16", "INT8", "NOOP")
+_ALGOS = ("GZIP", "FLOAT16", "INT8", "THRESHOLD", "NOOP")
+
+
+# ---------------------------------------------------------------------
+# Strom-2015 threshold encoding (shared by the trainer step + codec)
+# ---------------------------------------------------------------------
+
+def threshold_cap(n: int, capacity: float) -> int:
+    """STATIC per-leaf encoding capacity: how many (index, sign) pairs
+    one replica may transmit for an n-element leaf. Fixed at trace time
+    so the encoded shapes never vary and the train step stays one
+    jitted executable."""
+    import math
+
+    return max(1, min(int(n), int(math.ceil(float(n) * float(capacity)))))
+
+
+def threshold_encode_fixed(flat, tau, cap):
+    """Fixed-capacity Strom threshold encoding of ONE flat vector (the
+    traced encoder `ParallelWrapper._threshold_step` runs per leaf; the
+    host-side THRESHOLD codec below mirrors it exactly).
+
+    The top-`cap` entries of |flat| are candidates; those with
+    |value| >= tau transmit as +-tau (sign encoding — Strom 2015), the
+    rest transmit nothing. Returns
+
+        idx[cap] int32   candidate positions (top-|.| order)
+        val[cap]         +-tau where transmitted, 0 where below tau
+        dense[n]         the dense equivalent of the wire message
+        residual[n]      flat - dense: the error feedback carried to the
+                         next step. Exact by construction:
+                         dense + residual == flat bitwise.
+    """
+    mag = jnp.abs(flat)
+    _, idx = jax.lax.top_k(mag, cap)
+    cand = jnp.take(flat, idx)
+    hit = jnp.abs(cand) >= tau.astype(flat.dtype)
+    val = jnp.where(hit, jnp.sign(cand) * tau.astype(flat.dtype),
+                    jnp.zeros((), flat.dtype))
+    dense = jnp.zeros_like(flat).at[idx].set(val)
+    return idx.astype(jnp.int32), val, dense, flat - dense
 
 
 class CompressedNDArray:
@@ -45,7 +85,9 @@ class CompressedNDArray:
     def compressedBytes(self):
         n = len(self.payload) if isinstance(self.payload, bytes) \
             else self.payload.nbytes
-        if self.extra is not None:
+        if isinstance(self.extra, dict):
+            n += sum(np.asarray(v).nbytes for v in self.extra.values())
+        elif self.extra is not None:
             n += np.asarray(self.extra).nbytes
         return n
 
@@ -63,11 +105,17 @@ class CompressedNDArray:
 class BasicNDArrayCompressor:
     """`Nd4j.getCompressor()` parity surface.
 
-    GZIP    lossless zlib over the raw buffer
-    FLOAT16 cast to f16 (lossy), restored to the original float dtype
-    INT8    per-tensor absmax affine int8 (lossy), scale in the sidecar
-    NOOP    descriptor-only identity (upstream ships one; useful to
-            exercise the codec path with zero loss)
+    GZIP      lossless zlib over the raw buffer
+    FLOAT16   cast to f16 (lossy), restored to the original float dtype
+    INT8      per-tensor absmax affine int8 (lossy), scale in the sidecar
+    THRESHOLD Strom-2015 sparse sign encoding (lossy): indices of
+              |x| >= tau as int32 + one sign byte each, decoded dense as
+              +-tau — the wire format of the trainer's
+              gradient_compression="threshold" step (the same encoder,
+              see threshold_encode_fixed), testable host-side in
+              isolation
+    NOOP      descriptor-only identity (upstream ships one; useful to
+              exercise the codec path with zero loss)
     """
 
     _instance = None
@@ -95,12 +143,28 @@ class BasicNDArrayCompressor:
     def getDefaultCompression(self):
         return self._default
 
-    def compress(self, arr, algo=None):
+    def compress(self, arr, algo=None, threshold=1e-3):
         algo = (algo or self._default).upper()
         if algo not in _ALGOS:
             raise ValueError(f"unknown compressor {algo!r}; "
                              f"available: {_ALGOS}")
         x = np.asarray(getattr(arr, "toNumpy", lambda: arr)())
+        if algo == "THRESHOLD":
+            if not np.issubdtype(x.dtype, np.floating):
+                raise ValueError("THRESHOLD compression needs a float "
+                                 "array")
+            tau = float(threshold)
+            if tau <= 0:
+                raise ValueError(f"threshold must be > 0, got {tau}")
+            flat = np.ascontiguousarray(x).reshape(-1)
+            # size-0 and all-below-tau short-circuit: an empty index set
+            # is a valid (maximally sparse) message, not an error
+            idx = (np.flatnonzero(np.abs(flat) >= tau).astype(np.int32)
+                   if flat.size else np.zeros((0,), np.int32))
+            signs = np.sign(flat[idx]).astype(np.int8)
+            return CompressedNDArray(
+                algo, signs, x.shape, x.dtype,
+                extra={"threshold": np.float32(tau), "indices": idx})
         if algo == "GZIP":
             return CompressedNDArray(
                 algo, zlib.compress(np.ascontiguousarray(x).tobytes(), 6),
@@ -133,6 +197,13 @@ class BasicNDArrayCompressor:
         elif carr.algo == "INT8":
             x = (carr.payload.astype(np.float32)
                  * np.float32(carr.extra)).astype(carr.dtype)
+        elif carr.algo == "THRESHOLD":
+            n = int(np.prod(carr.shape, dtype=np.int64))
+            x = np.zeros(n, dtype=carr.dtype)
+            idx = carr.extra["indices"]
+            if idx.size:
+                x[idx] = (carr.payload.astype(carr.dtype)
+                          * carr.dtype.type(carr.extra["threshold"]))
         else:  # NOOP
             x = carr.payload
         return INDArray(np.asarray(x).reshape(carr.shape))

@@ -11,10 +11,13 @@ from deeplearning4j_tpu.parallel.mesh import (
 )
 from deeplearning4j_tpu.parallel.trainer import (
     ParallelWrapper, SharedTrainingMaster, ParameterAveragingTrainingMaster,
+    FixedThresholdAlgorithm, AdaptiveThresholdAlgorithm,
+    TargetSparsityThresholdAlgorithm, ResidualClippingPostProcessor,
 )
 from deeplearning4j_tpu.parallel.sharding import (
-    ZeroShardedUpdate, dp_weight_update_bytes, replicate_params,
-    shard_params, spec_for_param,
+    ZeroShardedUpdate, ManualZeroUpdate, dp_weight_update_bytes,
+    compressed_wire_bytes, compressed_hlo_collective_bytes,
+    COMPRESSION_MODES, replicate_params, shard_params, spec_for_param,
 )
 from deeplearning4j_tpu.parallel.sequence import ring_attention, ulysses_attention
 from deeplearning4j_tpu.parallel.pipeline import PipelineParallel, partition_stages
@@ -34,9 +37,13 @@ from deeplearning4j_tpu.parallel.costmodel import (
 __all__ = [
     "build_mesh", "data_parallel_mesh", "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS",
     "PIPE_AXIS", "ParallelWrapper", "SharedTrainingMaster",
-    "ParameterAveragingTrainingMaster", "shard_params",
+    "ParameterAveragingTrainingMaster", "FixedThresholdAlgorithm",
+    "AdaptiveThresholdAlgorithm", "TargetSparsityThresholdAlgorithm",
+    "ResidualClippingPostProcessor", "shard_params",
     "replicate_params", "spec_for_param", "ZeroShardedUpdate",
-    "dp_weight_update_bytes", "ring_attention", "ulysses_attention",
+    "ManualZeroUpdate", "dp_weight_update_bytes",
+    "compressed_wire_bytes", "compressed_hlo_collective_bytes",
+    "COMPRESSION_MODES", "ring_attention", "ulysses_attention",
     "PipelineParallel", "partition_stages",
     "initializeMultiHost", "hybrid_mesh", "is_coordinator", "num_hosts",
     "ParallelInference",

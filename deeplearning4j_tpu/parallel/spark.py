@@ -55,8 +55,13 @@ class ParameterAveragingTrainingMasterBuilder:
 
 class SharedTrainingMasterBuilder:
     """Reference: SharedTrainingMaster.Builder (gradient-sharing mode;
-    int8-quantized allreduce by default, `thresholdAlgorithm` selects
-    Strom-2015 threshold encoding)."""
+    int8-quantized allreduce by default). `thresholdAlgorithm` selects
+    Strom-2015 threshold encoding and maps to REAL trainer config —
+    a number / FixedThresholdAlgorithm pins tau,
+    AdaptiveThresholdAlgorithm / TargetSparsityThresholdAlgorithm wire
+    the adaptive tau loop, ResidualClippingPostProcessor wires residual
+    clipping; unknown algorithms raise at build-time binding naming the
+    supported set (SharedTrainingMaster does the mapping)."""
 
     def __init__(self):
         self._kw = {}
@@ -65,12 +70,30 @@ class SharedTrainingMasterBuilder:
         self._kw["thresholdAlgorithm"] = algo
         return self
 
+    def residualPostProcessor(self, rpp):
+        self._kw["residualPostProcessor"] = rpp
+        return self
+
     def gradientCompression(self, gc):
         self._kw["gradient_compression"] = gc
         return self
 
     def targetSparsity(self, s):
         self._kw["targetSparsity"] = float(s)
+        return self
+
+    def encodingCapacity(self, c):
+        self._kw["encodingCapacity"] = float(c)
+        return self
+
+    def compressionBlock(self, b):
+        self._kw["compressionBlock"] = int(b)
+        return self
+
+    def weightUpdate(self, mode):
+        """'replicated' or 'sharded' (ZeRO) — int8/block_int8 compose
+        with 'sharded' via the compressed reduce-scatter."""
+        self._kw["weight_update"] = mode
         return self
 
     def build(self):

@@ -13,10 +13,30 @@ shardings = replicated and batch shardings = split over the mesh "data"
 axis; XLA's SPMD partitioner inserts the bf16 gradient all-reduce over ICI
 (the role of NCCL/Aeron). Threshold encoding existed because Ethernet
 allreduce was the bottleneck; dense bf16 over ICI is faster than any
-host-side sparse encode/decode, so the default is dense. An optional int8
-quantized allreduce (EQuARX-style, see PAPERS.md) is provided for
-DCN-limited deployments via gradient_compression="int8" using an explicit
-shard_map psum.
+host-side sparse encode/decode, so the default is dense. For DCN-limited
+deployments three compressed modes are selectable per config, each an
+explicit shard_map program with a statically billed bytes-on-wire
+contract (parallel.sharding.compressed_wire_bytes):
+
+  gradient_compression="int8"        per-tensor-scale quantized allreduce
+  gradient_compression="block_int8"  per-BLOCK-scale quantized allreduce
+                                     (EQuARX-style, PAPERS.md
+                                     arXiv:2506.17615) — tighter scales,
+                                     same wire bytes + a small scale
+                                     side channel
+  gradient_compression="threshold"   Strom-2015 sparse sign encoding
+                                     with per-replica error-feedback
+                                     residuals, fixed-capacity top-|g|
+                                     encoding so shapes stay static and
+                                     the step remains ONE jitted
+                                     executable; the residual rides the
+                                     donated updater-state carry (and
+                                     therefore fitDataSet's k-loop and
+                                     ResilientFit checkpoints)
+
+"int8"/"block_int8" compose with weight_update="sharded": the gradient
+reduction becomes a QUANTIZED reduce-scatter and the optimizer runs on
+the local 1/dp shard (parallel.sharding.ManualZeroUpdate).
 
 Determinism: batch stats (BN) and losses are computed over the GLOBAL
 batch (GSPMD reduces across shards), so DP training at any width produces
@@ -35,6 +55,70 @@ from deeplearning4j_tpu.parallel import mesh as _mesh
 from deeplearning4j_tpu.nn.multilayer import _unwrap
 
 
+# ----------------------------------------------------------------------
+# threshold-algorithm configs (reference: org.nd4j.parameterserver
+# ThresholdAlgorithm implementations) — SharedTrainingMaster maps these
+# to real trainer config instead of passing an opaque kwarg through
+# ----------------------------------------------------------------------
+
+class FixedThresholdAlgorithm:
+    """A constant Strom threshold tau (reference:
+    FixedThresholdAlgorithm)."""
+
+    def __init__(self, threshold):
+        self.threshold = float(threshold)
+
+
+class AdaptiveThresholdAlgorithm:
+    """Adapt tau multiplicatively so the mean transmitted fraction
+    tracks `sparsityTarget` (reference: AdaptiveThresholdAlgorithm)."""
+
+    def __init__(self, initialThreshold=1e-3, sparsityTarget=1e-2):
+        self.threshold = float(initialThreshold)
+        self.sparsityTarget = float(sparsityTarget)
+
+
+class TargetSparsityThresholdAlgorithm(AdaptiveThresholdAlgorithm):
+    """Alias of the adaptive algorithm with the target spelled first
+    (reference: TargetSparsityThresholdAlgorithm)."""
+
+    def __init__(self, sparsityTarget=1e-2, initialThreshold=1e-3):
+        super().__init__(initialThreshold, sparsityTarget)
+
+
+class ResidualClippingPostProcessor:
+    """Clip the error-feedback residual to +-(clipValue * tau) every
+    `frequency` iterations (reference:
+    ResidualClippingPostProcessor) — bounds how much stale gradient a
+    slow-moving coordinate can accumulate."""
+
+    def __init__(self, clipValue=5.0, frequency=1):
+        self.clipValue = float(clipValue)
+        self.frequency = int(frequency)
+        if self.clipValue <= 0:
+            raise ValueError(
+                f"clipValue must be > 0, got {clipValue}")
+        if self.frequency < 1:
+            raise ValueError(
+                f"frequency must be >= 1, got {frequency}")
+
+
+#: the named threshold algorithms SharedTrainingMaster accepts (a bare
+#: number is shorthand for FixedThresholdAlgorithm)
+THRESHOLD_ALGORITHMS = (FixedThresholdAlgorithm,
+                        AdaptiveThresholdAlgorithm,
+                        TargetSparsityThresholdAlgorithm)
+
+#: the packed updater-state carry of the threshold step: the canonical
+#: (params, upd, states, it, ...) signature is preserved by riding the
+#: error-feedback residual and the live tau INSIDE the donated upd slot
+_PACK_KEYS = frozenset({"upd", "ef", "tau"})
+
+
+def _is_packed(upd):
+    return isinstance(upd, dict) and set(upd.keys()) == _PACK_KEYS
+
+
 class ParallelWrapper:
     """Data-parallel trainer over a device mesh.
 
@@ -47,7 +131,14 @@ class ParallelWrapper:
     def __init__(self, net, mesh=None, gradient_compression=None,
                  batch_axis=_mesh.DATA_AXIS, threshold=1e-3,
                  targetSparsity=None, weight_update="replicated",
-                 min_shard_size=2 ** 16):
+                 min_shard_size=2 ** 16, encodingCapacity=None,
+                 residualClip=None, residualClipFrequency=1,
+                 compressionBlock=None):
+        from deeplearning4j_tpu.parallel.sharding import (
+            COMPRESSION_MODES, DEFAULT_COMPRESSION_BLOCK,
+            DEFAULT_ENCODING_CAPACITY,
+        )
+
         if getattr(net, "_solver", None) is not None:
             raise ValueError(
                 "distributed trainers require "
@@ -60,29 +151,84 @@ class ParallelWrapper:
         self.batch_axis = batch_axis
         self.gradient_compression = gradient_compression
         self.threshold = float(threshold)
+        if gradient_compression == "threshold" and self.threshold <= 0:
+            raise ValueError(
+                f"threshold (tau) must be > 0, got {threshold}: the "
+                "Strom encoder transmits sign(g)*tau, so a non-positive "
+                "tau would negate (or zero) every transmitted update")
         # reference: AdaptiveThresholdAlgorithm — adapt the threshold so
         # the transmitted fraction tracks this target (None = fixed)
         self.targetSparsity = None if targetSparsity is None \
             else float(targetSparsity)
+        # fixed-capacity encoding: the threshold step may transmit at
+        # most ceil(capacity * n) entries per leaf per step (static
+        # shapes — one executable). Auto (None) leaves headroom over an
+        # adaptive sparsity target.
+        if encodingCapacity is None:
+            cap = DEFAULT_ENCODING_CAPACITY if self.targetSparsity is None \
+                else max(DEFAULT_ENCODING_CAPACITY,
+                         min(1.0, 2.0 * self.targetSparsity))
+        else:
+            cap = float(encodingCapacity)
+            if not 0.0 < cap <= 1.0:
+                raise ValueError(
+                    f"encodingCapacity must be in (0, 1], got {cap}")
+            if self.targetSparsity is not None \
+                    and self.targetSparsity > cap:
+                raise ValueError(
+                    f"targetSparsity {self.targetSparsity} exceeds "
+                    f"encodingCapacity {cap}: the fixed-capacity "
+                    "encoder can never transmit more than the capacity "
+                    "fraction, so the adaptive threshold could not "
+                    "reach its target")
+        self.encoding_capacity = cap
+        self.residual_clip = None if residualClip is None \
+            else float(residualClip)
+        self.residual_clip_frequency = int(residualClipFrequency)
+        if self.residual_clip is not None and self.residual_clip <= 0:
+            raise ValueError(
+                f"residualClip must be > 0, got {residualClip}")
+        if self.residual_clip_frequency < 1:
+            raise ValueError(
+                "residualClipFrequency must be >= 1, got "
+                f"{residualClipFrequency}")
+        self.compression_block = DEFAULT_COMPRESSION_BLOCK \
+            if compressionBlock is None else int(compressionBlock)
+        if self.compression_block < 1:
+            raise ValueError(
+                f"compressionBlock must be >= 1, got {compressionBlock}")
         self._repl = NamedSharding(self.mesh, P())
         self._jit = None
-        self._residual = None  # threshold mode: (error feedback, threshold)
-        if gradient_compression not in (None, "int8", "threshold"):
+        if gradient_compression not in COMPRESSION_MODES:
             raise ValueError(
-                "gradient_compression must be None, 'int8' or 'threshold'")
+                "gradient_compression must be one of "
+                f"{COMPRESSION_MODES}, got {gradient_compression!r}")
         if weight_update not in ("replicated", "sharded"):
             raise ValueError(
                 "weight_update must be 'replicated' or 'sharded', got "
                 f"{weight_update!r}")
-        if weight_update == "sharded" and gradient_compression is not None:
+        if weight_update == "sharded" \
+                and gradient_compression == "threshold":
             raise ValueError(
-                f"weight_update='sharded' requires gradient_compression="
-                f"None (got {gradient_compression!r}): the compressed "
-                "steps run inside an explicit shard_map, where the "
-                "GSPMD sharding annotations the ZeRO update relies on "
-                "(reduce-scatter -> shard update -> all-gather) cannot "
-                "apply. Use the dense psum path, or keep the update "
-                "replicated.")
+                "weight_update='sharded' composes with "
+                "gradient_compression None/'int8'/'block_int8' "
+                "(compressed reduce-scatter -> 1/dp shard update -> "
+                "all-gather), but not 'threshold': the Strom step's "
+                "per-replica error-feedback residual transmits sparse "
+                "all-gathered messages, which have no per-parameter "
+                "reduce-scatter form. Pick 'int8'/'block_int8', or "
+                "keep the update replicated.")
+        if gradient_compression in ("int8", "block_int8") \
+                and weight_update == "sharded" \
+                and getattr(net.conf, "gradientNormalization", None) \
+                is not None:
+            raise ValueError(
+                "gradient normalization is applied to the REDUCED "
+                "gradient, but the compressed sharded update "
+                "reduce-scatters inside the weight-update hook — the "
+                "normalization would see per-replica gradients and "
+                "silently change semantics. Drop gradientNormalization "
+                "or use weight_update='replicated'.")
         self.weight_update = weight_update
         self.min_shard_size = int(min_shard_size)
         self._zero = None
@@ -93,6 +239,17 @@ class ParallelWrapper:
             self._zero = ZeroShardedUpdate(
                 self.mesh, axis=self.batch_axis,
                 min_shard_size=self.min_shard_size)
+
+    @property
+    def _residual(self):
+        """Threshold mode's (error-feedback tree, live tau) — carried
+        INSIDE the packed updater state (the donated step carry), so
+        fitDataSet's k-loop and ResilientFit checkpoints see it for
+        free. None outside threshold mode / before placement."""
+        u = getattr(self.net, "_upd_states", None)
+        if _is_packed(u):
+            return (u["ef"], u["tau"])
+        return None
 
     # ------------------------------------------------------------------
     def _shard_batch(self, arr):
@@ -114,11 +271,90 @@ class ParallelWrapper:
         n = self.net
         n._params = jax.device_put(n._params, self._repl)
         n._states = jax.device_put(n._states, self._repl)
+        if self.gradient_compression == "threshold":
+            self._uninstall_sharded_update()
+            self._pack_threshold_state()
+            return
+        self._unpack_threshold_state()
         if self._zero is not None:
             self._place_sharded_update()
         else:
             self._uninstall_sharded_update()
             n._upd_states = jax.device_put(n._upd_states, self._repl)
+
+    # ----- threshold mode: the packed residual carry -------------------
+    def _pack_threshold_state(self):
+        """Wrap the net's updater state as {'upd', 'ef', 'tau'}: the
+        per-replica error-feedback residual (leading [dp] device axis,
+        sharded over the data axis) and the LIVE tau ride the donated
+        updater-state slot, so the step keeps the canonical
+        (params, upd, states, ...) signature — one jitted executable,
+        k-loop carry and ResilientFit guard/checkpoints all for free.
+        Re-placement of an already-packed state (checkpoint restore,
+        repeated _place_replicated) is bitwise."""
+        n = self.net
+        ndev = self.mesh.shape[self.batch_axis]
+        ef_sh = NamedSharding(self.mesh, P(self.batch_axis))
+        if _is_packed(n._upd_states):
+            pack = n._upd_states
+            upd = jax.device_put(pack["upd"], self._repl)
+            ef = jax.device_put(pack["ef"], ef_sh)
+            tau = jax.device_put(jnp.asarray(pack["tau"], jnp.float32),
+                                 self._repl)
+        else:
+            upd = jax.device_put(n._upd_states, self._repl)
+            ef = jax.device_put(
+                jax.tree_util.tree_map(
+                    lambda p: jnp.zeros((ndev,) + p.shape, p.dtype),
+                    n._params), ef_sh)
+            tau = jax.device_put(jnp.asarray(self.threshold, jnp.float32),
+                                 self._repl)
+        n._upd_states = {"upd": upd, "ef": ef, "tau": tau}
+        # checkpoints save the CANONICAL plain updater state here; the
+        # residual itself is saved separately (writeModel trainer_state
+        # — see _ckpt_trainer_state) so a threshold-mode save still
+        # restores into any mode
+        n._upd_state_unview = (
+            lambda packed: packed["upd"] if _is_packed(packed) else packed)
+
+    def _unpack_threshold_state(self):
+        """Drop a PREVIOUS threshold-mode wrapper's packed carry: restore
+        the plain updater state and clear the unview hook, so dense/int8
+        wrappers (and the net's own fit) see the canonical layout."""
+        n = self.net
+        if not _is_packed(getattr(n, "_upd_states", None)):
+            return
+        n._upd_states = n._upd_states["upd"]
+        n._upd_state_unview = None
+
+    def _ckpt_trainer_state(self):
+        """The trainer-owned step state a checkpoint must persist for a
+        bitwise resume — threshold mode's error-feedback residual and
+        live tau (util.sharded_checkpoint writeModel trainer_state=...).
+        None when the mode carries no such state."""
+        u = getattr(self.net, "_upd_states", None)
+        if _is_packed(u):
+            return {"ef": u["ef"], "tau": u["tau"]}
+        return None
+
+    def _restore_trainer_state(self, state):
+        """Install a checkpoint's trainer state into the packed carry
+        (call after _place_replicated has packed fresh zeros)."""
+        if state is None:
+            return
+        n = self.net
+        if not _is_packed(n._upd_states):
+            raise ValueError(
+                "restoring threshold trainer state needs "
+                "gradient_compression='threshold' (the packed carry is "
+                "not installed)")
+        ef_sh = NamedSharding(self.mesh, P(self.batch_axis))
+        n._upd_states = {
+            "upd": n._upd_states["upd"],
+            "ef": jax.device_put(state["ef"], ef_sh),
+            "tau": jax.device_put(jnp.asarray(state["tau"], jnp.float32),
+                                  self._repl),
+        }
 
     def _uninstall_sharded_update(self):
         """Remove a PREVIOUS sharded-mode wrapper's ZeRO hook from the
@@ -154,7 +390,18 @@ class ParallelWrapper:
         restored checkpoint's canonical full-shape layout) is re-placed
         bitwise (the view is a reshape)."""
         n, z = self.net, self._zero
-        n._update_impl = z
+        if self.gradient_compression is None:
+            n._update_impl = z
+        else:
+            # compressed modes trace inside an explicit shard_map where
+            # GSPMD annotations cannot apply: the manual twin runs the
+            # QUANTIZED reduce-scatter -> local 1/dp shard update ->
+            # all-gather with the same eligibility and state layout
+            from deeplearning4j_tpu.parallel.sharding import \
+                ManualZeroUpdate
+
+            n._update_impl = ManualZeroUpdate(
+                z, self.gradient_compression, self.compression_block)
         n._upd_state_unview = self._unview_upd_states
         fresh = n._iteration == 0
         new = dict(n._upd_states) if self._is_graph() \
@@ -181,61 +428,77 @@ class ParallelWrapper:
 
     def _aot_extra(self):
         """Key suffix describing program context the net's config hash
-        cannot see: the mesh, the compression mode and the weight-update
-        mode all change the traced program."""
+        cannot see: the mesh, the compression mode (and its static
+        knobs — block size, encoding capacity, adaptive target,
+        residual clipping; the tau VALUE rides as a runtime array) and
+        the weight-update mode all change the traced program."""
         return (f"|pw[mesh={sorted(dict(self.mesh.shape).items())},"
                 f"axis={self.batch_axis},"
                 f"comp={self.gradient_compression},"
+                f"blk={self.compression_block},"
+                f"cap={self.encoding_capacity},"
+                f"tgt={self.targetSparsity},"
+                f"clip={self.residual_clip}"
+                f"@{self.residual_clip_frequency},"
                 f"wu={self.weight_update}]")
 
     def _build_jit(self):
         n = self.net
-        if self.gradient_compression == "threshold":
-            # per-replica residuals: leading device axis, sharded over the
-            # mesh so each replica carries its own error feedback; the
-            # (possibly adaptive) threshold rides along replicated
-            ndev = self.mesh.shape[self.batch_axis]
-            res = jax.device_put(
-                jax.tree_util.tree_map(
-                    lambda p: jnp.zeros((ndev,) + p.shape, p.dtype),
-                    n._params),
-                NamedSharding(self.mesh, P(self.batch_axis)))
-            t = jax.device_put(jnp.asarray(self.threshold, jnp.float32),
-                               self._repl)
-            self._residual = (res, t)
-            # threshold mode threads adaptive residual state through a
-            # different arity and its threshold value is trace-baked:
-            # stays on the plain jit (no AOT caching)
-            self._jit = jax.jit(self._threshold_step,
-                                donate_argnums=(0, 1, 2, 3))
-            return
-        step = n._train_step if self.gradient_compression is None \
-            else self._compressed_step
+        if self.gradient_compression is None:
+            step = n._train_step
+        elif self.gradient_compression == "threshold":
+            step = self._threshold_step
+        else:
+            step = self._compressed_step
         # params/opt/state replicated; batch args sharded over the data
         # axis. Routed through the AOT executable cache (runtime.aot):
         # the extra key part carries the mesh/compression/update mode.
+        # The threshold step qualifies too now that its residual rides
+        # the donated updater-state carry (tau is a runtime array, not
+        # a trace-baked constant).
         from deeplearning4j_tpu.runtime import aot
 
         self._jit = aot.cached_jit(step, owner=n, entry="pw_train_step",
                                    extra=self._aot_extra(),
                                    donate_argnums=(0, 1, 2))
 
+    def _upd_specs(self):
+        """shard_map partition specs for the updater-state argument:
+        replicated by default; under the compressed sharded update the
+        eligible leaves live as flat 1/dp shards over the data axis —
+        read off the PLACED state's actual shardings so the spec tree
+        can never drift from the layout."""
+        if self._zero is None:
+            return P()
+        return jax.tree_util.tree_map(
+            lambda l: l.sharding.spec if hasattr(l, "sharding") else P(),
+            self.net._upd_states)
+
     def _compressed_step(self, params, upd_states, states, iteration, x, y,
                          key, fmask, lmask):
-        """Train step with an explicit int8-quantized gradient all-reduce
-        (EQuARX-style). Uses shard_map over the data axis so the quantize →
-        psum → dequantize pipeline is expressed directly."""
+        """Train step with an explicit quantized gradient all-reduce:
+        per-tensor scale ("int8") or per-block scale ("block_int8",
+        EQuARX-style). shard_map over the data axis expresses the
+        quantize → integer psum → dequantize pipeline directly
+        (parallel.sharding.quantized_psum_mean). With
+        weight_update='sharded' the gradient reduction instead happens
+        INSIDE the weight-update hook (ManualZeroUpdate): a QUANTIZED
+        reduce-scatter feeds the local 1/dp shard update and the fresh
+        shards are all-gathered — compression and ZeRO stack."""
         from deeplearning4j_tpu.parallel._compat import shard_map
+        from deeplearning4j_tpu.parallel.sharding import \
+            quantized_psum_mean
 
         n = self.net
         mesh, ax = self.mesh, self.batch_axis
+        dp = int(self.mesh.shape[ax])
+        mode, blk = self.gradient_compression, self.compression_block
+        sharded = self._zero is not None
 
-        def qall(g):
-            scale = jnp.maximum(jnp.max(jnp.abs(g)), 1e-12)
-            scale = jax.lax.pmax(scale, ax)
-            q = jnp.clip(jnp.round(g / scale * 127.0), -127, 127).astype(jnp.int8)
-            summed = jax.lax.psum(q.astype(jnp.int32), ax)
-            return summed.astype(g.dtype) * (scale / 127.0) / jax.lax.psum(1, ax)
+        def qall_tree(grads):
+            return jax.tree_util.tree_map(
+                lambda g: quantized_psum_mean(g, ax, dp, mode, blk),
+                grads)
 
         def sync_states(states):
             # Per-shard batch stats (BN running mean/var) diverge across the
@@ -246,64 +509,97 @@ class ParallelWrapper:
                 if jnp.issubdtype(a.dtype, jnp.inexact) else a, states)
 
         def shard_step(params_r, upd_r, states_r, it_r, x_s, y_s, key_r, fm_s, lm_s):
+            # sharded: grads reach the update hook UNREDUCED — the
+            # ManualZeroUpdate hook performs the compressed
+            # reduce-scatter (eligible leaves) / all-reduce (fallback)
             return n._train_step(
                 params_r, upd_r, states_r, it_r, x_s, y_s, key_r, fm_s, lm_s,
-                grad_transform=lambda g: jax.tree_util.tree_map(qall, g),
+                grad_transform=None if sharded else qall_tree,
                 loss_transform=lambda l: jax.lax.pmean(l, ax),
                 state_transform=sync_states)
 
         spec_b = P(ax)
+        upd_specs = self._upd_specs()
         return shard_map(
             shard_step, mesh=mesh,
-            in_specs=(P(), P(), P(), P(), spec_b, spec_b, P(), spec_b if fmask is not None else P(),
+            in_specs=(P(), upd_specs, P(), P(), spec_b, spec_b, P(),
+                      spec_b if fmask is not None else P(),
                       spec_b if lmask is not None else P()),
-            out_specs=(P(), P(), P(), P()),
+            out_specs=(P(), upd_specs, P(), P()),
             check_vma=False,
         )(params, upd_states, states, iteration, x, y, key, fmask, lmask)
 
-    def _threshold_step(self, params, upd_states, states, residual,
-                        iteration, x, y, key, fmask, lmask):
+    def _threshold_step(self, params, upd_states, states, iteration, x, y,
+                        key, fmask, lmask):
         """Train step with threshold-encoded gradient sharing (reference:
         Strom 2015, the algorithm behind upstream SharedTrainingMaster's
-        sparse updates). Each replica adds its residual to the fresh
-        gradient, transmits only entries with |g| >= threshold — encoded
-        as +-threshold — and keeps the remainder as next step's residual
-        (error feedback). On ICI the "transmission" is a dense psum of
-        the thresholded tensor: the sparse wire format upstream pairs
-        with this algorithm is an Ethernet-era optimization, while the
-        algorithm's semantics (sparsified, error-compensated updates)
-        are preserved exactly."""
+        sparse updates). Each replica adds its error-feedback residual
+        to the fresh gradient and transmits at most
+        ceil(encodingCapacity * n) entries per leaf — the top-|.|
+        candidates with |value| >= tau, encoded as +-tau (sign
+        encoding); the remainder is next step's residual. The fixed
+        capacity keeps every shape static, so the whole step is ONE
+        jitted executable whose carry (residual + live tau) rides the
+        donated updater-state slot with the canonical signature.
+
+        The wire format is genuinely sparse: each replica all-gathers
+        its (index, +-tau) pairs and scatter-adds the dp messages into
+        the dense mean — bytes-on-wire scale with the capacity, not the
+        model (parallel.sharding.compressed_wire_bytes bills it)."""
         from deeplearning4j_tpu.parallel._compat import shard_map
+        from deeplearning4j_tpu.ndarray.compression import (
+            threshold_cap, threshold_encode_fixed,
+        )
 
         n = self.net
         mesh, ax = self.mesh, self.batch_axis
         target = self.targetSparsity
+        capacity = self.encoding_capacity
+        clip, clip_freq = self.residual_clip, self.residual_clip_frequency
 
         def sync_states(states):
             return jax.tree_util.tree_map(
                 lambda a: jax.lax.pmean(a, ax)
                 if jnp.issubdtype(a.dtype, jnp.inexact) else a, states)
 
-        def shard_step(params_r, upd_r, states_r, res_in, it_r, x_s, y_s,
+        def shard_step(params_r, pack, states_r, it_r, x_s, y_s,
                        key_r, fm_s, lm_s):
-            res_s, t = res_in
-            new_res_cell = []
+            upd_r, res_s, t = pack["upd"], pack["ef"], pack["tau"]
+            new_pack_cell = []
 
             def encode_all(grads):
                 g_leaves, treedef = jax.tree_util.tree_flatten(grads)
                 r_leaves = jax.tree_util.tree_flatten(res_s)[0]
                 means, new_rs = [], []
-                sent = total = 0.0
+                sent = 0.0
+                total = 0
+                dp = jax.lax.psum(1, ax)
                 for g, r in zip(g_leaves, r_leaves):
-                    acc = g + r[0].astype(g.dtype)  # drop local dev axis
-                    hit = jnp.abs(acc) >= t.astype(g.dtype)
-                    enc = jnp.where(hit,
-                                    jnp.sign(acc) * t.astype(g.dtype),
-                                    jnp.zeros((), g.dtype))
-                    new_rs.append((acc - enc)[None].astype(r.dtype))
-                    means.append(jax.lax.psum(enc, ax) / jax.lax.psum(1, ax))
-                    sent = sent + jnp.sum(hit)
-                    total = total + hit.size
+                    acc = (g + r[0].astype(g.dtype)).reshape(-1)
+                    cap = threshold_cap(acc.size, capacity)
+                    idx, val, dense, res = threshold_encode_fixed(
+                        acc, t, cap)
+                    # the sparse transmission: every replica broadcasts
+                    # its cap (index, +-tau) pairs; scatter-add
+                    # reassembles the dense sum locally
+                    gi = jax.lax.all_gather(idx, ax, tiled=True)
+                    gv = jax.lax.all_gather(val, ax, tiled=True)
+                    summed = jnp.zeros_like(acc).at[gi].add(gv)
+                    means.append((summed / dp).reshape(g.shape)
+                                 .astype(g.dtype))
+                    if clip is not None:
+                        # ResidualClippingPostProcessor: bound stale
+                        # accumulation to +-(clip * tau) every clip_freq
+                        # iterations
+                        lim = (clip * t).astype(res.dtype)
+                        clipped = jnp.clip(res, -lim, lim)
+                        res = jnp.where((it_r % clip_freq) == 0,
+                                        clipped, res) \
+                            if clip_freq > 1 else clipped
+                    new_rs.append(res.reshape(g.shape)[None]
+                                  .astype(r.dtype))
+                    sent = sent + jnp.sum(jnp.abs(val) > 0)
+                    total += acc.size
                 if target is None:
                     new_t = t
                 else:
@@ -314,29 +610,31 @@ class ParallelWrapper:
                     new_t = jnp.where(
                         frac > 1.25 * target, t * 1.1,
                         jnp.where(frac < 0.8 * target, t / 1.1, t))
-                new_res_cell.append(
+                new_pack_cell.append(
                     (jax.tree_util.tree_unflatten(treedef, new_rs),
                      new_t.astype(jnp.float32)))
                 return jax.tree_util.tree_unflatten(treedef, means)
 
-            out = n._train_step(
+            p, u, s, loss = n._train_step(
                 params_r, upd_r, states_r, it_r, x_s, y_s, key_r, fm_s, lm_s,
                 grad_transform=encode_all,
                 loss_transform=lambda l: jax.lax.pmean(l, ax),
                 state_transform=sync_states)
-            return out + (new_res_cell[0],)
+            new_res, new_t = new_pack_cell[0]
+            return p, {"upd": u, "ef": new_res, "tau": new_t}, s, loss
 
         spec_b = P(ax)
+        ef_specs = jax.tree_util.tree_map(lambda _: P(ax),
+                                          self.net._upd_states["ef"])
+        pack_specs = {"upd": P(), "ef": ef_specs, "tau": P()}
         return shard_map(
             shard_step, mesh=mesh,
-            in_specs=(P(), P(), P(), (spec_b, P()), P(), spec_b, spec_b,
-                      P(),
+            in_specs=(P(), pack_specs, P(), P(), spec_b, spec_b, P(),
                       spec_b if fmask is not None else P(),
                       spec_b if lmask is not None else P()),
-            out_specs=(P(), P(), P(), P(), (spec_b, P())),
+            out_specs=(P(), pack_specs, P(), P()),
             check_vma=False,
-        )(params, upd_states, states, residual, iteration, x, y, key,
-          fmask, lmask)
+        )(params, upd_states, states, iteration, x, y, key, fmask, lmask)
 
     # ------------------------------------------------------------------
     def fit(self, data, labels=None, epochs=None):
@@ -388,15 +686,9 @@ class ParallelWrapper:
             fmask = None if fmask is None else {n.conf.networkInputs[0]: fmask}
             lmask = None if lmask is None else [lmask]
         key = jax.random.fold_in(jax.random.key(n.conf.seed ^ 0x5EED), n._iteration)
-        if self._residual is not None:
-            (n._params, n._upd_states, n._states, loss,
-             self._residual) = self._jit(
-                n._params, n._upd_states, n._states, self._residual,
-                jnp.asarray(n._iteration, jnp.int32), x, y, key, fmask, lmask)
-        else:
-            n._params, n._upd_states, n._states, loss = self._jit(
-                n._params, n._upd_states, n._states,
-                jnp.asarray(n._iteration, jnp.int32), x, y, key, fmask, lmask)
+        n._params, n._upd_states, n._states, loss = self._jit(
+            n._params, n._upd_states, n._states,
+            jnp.asarray(n._iteration, jnp.int32), x, y, key, fmask, lmask)
         n._score = float(loss)
         n._iteration += 1
         for lst in n._listeners:
@@ -412,8 +704,9 @@ class ParallelWrapper:
         batch — GSPMD inserts the gradient collectives inside the loop.
         One host sync and one transfer per k batches; double-buffered
         staging; ragged tail through the per-batch sharded fit path.
-        Supports gradient_compression None (dense psum via GSPMD) and
-        'int8' (explicit shard_map allreduce)."""
+        Supports every gradient_compression mode — the threshold step's
+        residual + tau ride the donated updater-state carry, so the
+        k-loop threads them like any other state."""
         from deeplearning4j_tpu.data.iterators import stack_datasets
         from deeplearning4j_tpu.nn.multilayer import (
             fit_dataset_jit, run_fit_dataset_epoch)
@@ -429,11 +722,6 @@ class ParallelWrapper:
             self.fit(iterator, epochs=epochs)
             self._fit_dataset_syncs = n._iteration - it0  # 1/batch
             return self
-        if self.gradient_compression == "threshold":
-            raise ValueError(
-                "fitDataSet supports gradient_compression None/'int8'; "
-                "the 'threshold' step threads per-replica residual state "
-                "through a different arity — use fit()")
         bp = getattr(n.conf, "backpropType", None)
         if bp == "tbptt" or str(getattr(bp, "name", bp)) == "TruncatedBPTT":
             raise ValueError(
@@ -482,8 +770,9 @@ class ParallelWrapper:
         signature. Composes with weight_update='sharded' — the ZeRO
         layout is part of the cache key, and the updater state is
         allocated sharded before the warm lowering, exactly as fit()
-        would. The threshold-compression mode is not cacheable (its
-        step threads residual state); precompile returns {} there."""
+        would — and with every compression mode (the threshold carry
+        is part of the warmed signature since it rides the updater
+        state)."""
         from deeplearning4j_tpu.nn.multilayer import example_batch
 
         n = self.net
@@ -522,17 +811,16 @@ class ParallelWrapper:
         `(params, upd, states, it, x, y, key, fmask, lmask) ->
         (params', upd', states', loss)` signature, for harnesses that
         splice logic around it before jitting — runtime.resilience
-        wraps it in the non-finite guard. The threshold mode threads a
-        residual through the step (a different arity), so it cannot be
-        guarded this way."""
+        wraps it in the non-finite guard. Every compression mode is
+        wrappable: the threshold step's residual + tau ride inside the
+        updater-state slot, so a guarded skip rolls them back with the
+        rest of the carry (exactly the error-feedback semantics a
+        skipped step needs)."""
         if self.gradient_compression is None:
             return self.net._train_step
-        if self.gradient_compression == "int8":
-            return self._compressed_step
-        raise ValueError(
-            "trainStep() supports gradient_compression None/'int8'; the "
-            "'threshold' step carries per-replica residual state and is "
-            "not wrappable — run it without the non-finite guard")
+        if self.gradient_compression == "threshold":
+            return self._threshold_step
+        return self._compressed_step
 
     def averagingFrequency(self, *_):
         # synchronous psum makes per-step averaging exact already; the
@@ -549,15 +837,24 @@ class SharedTrainingMaster(ParallelWrapper):
     SharedTrainingMaster). Alias of ParallelWrapper with the quantized
     all-reduce enabled by default — the ICI-native analog of the
     reference's threshold-encoded sparse updates. Pass
-    ``gradient_compression=None`` for the dense bf16 psum, or
-    ``"threshold"`` for the reference's actual Strom-2015 algorithm
-    (sparsified +-threshold updates with per-replica error feedback —
-    see ParallelWrapper._threshold_step)."""
+    ``gradient_compression=None`` for the dense bf16 psum,
+    ``"block_int8"`` for EQuARX-style per-block scales, or
+    ``"threshold"`` / a ``thresholdAlgorithm`` for the reference's
+    actual Strom-2015 algorithm (fixed-capacity sparsified +-tau
+    updates with per-replica error feedback — see
+    ParallelWrapper._threshold_step).
 
-    def __init__(self, net, mesh=None, thresholdAlgorithm=None, **kw):
+    ``thresholdAlgorithm`` maps to REAL trainer config, not an opaque
+    kwarg: a bare number or FixedThresholdAlgorithm pins tau;
+    AdaptiveThresholdAlgorithm / TargetSparsityThresholdAlgorithm set
+    the initial tau plus targetSparsity (the adaptive loop);
+    ``residualPostProcessor=ResidualClippingPostProcessor(...)`` wires
+    residual clipping. Unknown algorithm objects raise naming the
+    supported set."""
+
+    def __init__(self, net, mesh=None, thresholdAlgorithm=None,
+                 residualPostProcessor=None, **kw):
         if thresholdAlgorithm is not None:
-            # parity with upstream's ThresholdAlgorithm arg: a number (or
-            # object with .threshold) selects the Strom encoding
             gc = kw.get("gradient_compression", "threshold")
             if gc != "threshold":
                 raise ValueError(
@@ -566,13 +863,43 @@ class SharedTrainingMaster(ParallelWrapper):
                     "only applies to the 'threshold' (Strom-2015) encoding; "
                     "drop one of the two arguments")
             kw.setdefault("gradient_compression", "threshold")
-            kw.setdefault("threshold",
-                          getattr(thresholdAlgorithm, "threshold",
-                                  thresholdAlgorithm))
-        if kw.get("weight_update") == "sharded":
-            # the ZeRO update needs the dense GSPMD psum path; asking for
-            # it implies opting out of this master's int8 default
-            kw.setdefault("gradient_compression", None)
+            algo = thresholdAlgorithm
+            if isinstance(algo, (int, float)) \
+                    and not isinstance(algo, bool):
+                algo = FixedThresholdAlgorithm(algo)
+            if isinstance(algo, AdaptiveThresholdAlgorithm):
+                kw.setdefault("threshold", algo.threshold)
+                kw.setdefault("targetSparsity", algo.sparsityTarget)
+            elif isinstance(algo, FixedThresholdAlgorithm) \
+                    or hasattr(algo, "threshold"):
+                # any object carrying .threshold duck-types as fixed
+                kw.setdefault("threshold", float(algo.threshold))
+            else:
+                names = [c.__name__ for c in THRESHOLD_ALGORITHMS]
+                raise ValueError(
+                    f"unknown thresholdAlgorithm {thresholdAlgorithm!r}; "
+                    f"pass a number (fixed tau) or one of {names}")
+        if residualPostProcessor is not None:
+            if kw.get("gradient_compression",
+                      "threshold") != "threshold" \
+                    and thresholdAlgorithm is None:
+                raise ValueError(
+                    "residualPostProcessor only applies to the "
+                    "'threshold' encoding (there is no residual "
+                    "elsewhere)")
+            rpp = residualPostProcessor
+            if not isinstance(rpp, ResidualClippingPostProcessor):
+                raise ValueError(
+                    f"unknown residualPostProcessor {rpp!r}; supported: "
+                    "ResidualClippingPostProcessor")
+            kw.setdefault("gradient_compression", "threshold")
+            kw.setdefault("residualClip", rpp.clipValue)
+            kw.setdefault("residualClipFrequency", rpp.frequency)
+        # ISSUE 11: compression and the ZeRO sharded update now STACK
+        # (compressed reduce-scatter) — asking for weight_update=
+        # "sharded" keeps this master's int8 default instead of
+        # silently opting out; only "threshold" cannot compose (the
+        # ParallelWrapper constructor rejects that pair loudly)
         kw.setdefault("gradient_compression", "int8")
         super().__init__(net, mesh=mesh, **kw)
 
@@ -652,9 +979,11 @@ class ParameterAveragingTrainingMaster(ParallelWrapper):
     def _place_replicated(self):
         """Give every replica its own (initially identical) copy: stack each
         leaf along a leading replica axis sharded over the data axis."""
-        # a net previously trained under a sharded-update wrapper must
-        # shed the ZeRO hook + flat-view state before stacking
+        # a net previously trained under a sharded-update or threshold
+        # wrapper must shed the ZeRO hook / packed residual carry before
+        # stacking
         self._uninstall_sharded_update()
+        self._unpack_threshold_state()
         n, dp = self.net, self.mesh.shape[self.batch_axis]
 
         def stack(tree):

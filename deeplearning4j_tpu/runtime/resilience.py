@@ -375,10 +375,19 @@ class ResilientFit:
 
         net = self.net
         path = _ckpt.step_path(self.checkpointDir, net._iteration)
+        # trainer-owned step state (threshold compression's error-
+        # feedback residual + live tau) rides the checkpoint as its own
+        # item so a mid-epoch resume replays the exact trajectory; the
+        # NET state stays canonical and restores into any mode
+        trainer_state = None
+        if self.wrapper is not None:
+            get = getattr(self.wrapper, "_ckpt_trainer_state", None)
+            trainer_state = get() if get is not None else None
         retry(lambda: ShardedModelSerializer.writeModel(
             net, path, saveUpdater=self.saveUpdater,
             extra={"iteration": net._iteration, "epoch": net._epoch,
-                   "batch_in_epoch": int(batch_in_epoch)}),
+                   "batch_in_epoch": int(batch_in_epoch)},
+            trainer_state=trainer_state),
             self.retryPolicy)
         _ckpt.gc_checkpoints(self.checkpointDir, self.keepLast)
         self._fire("onCheckpointSaved", path, net._iteration)
@@ -404,16 +413,29 @@ class ResilientFit:
         net._upd_states = restored._upd_states
         net._iteration = restored._iteration
         net._epoch = restored._epoch
-        extra = _ckpt.read_manifest(path).get("extra", {})
-        if self.wrapper is not None and self._jit is not None:
-            # a restore into an already-built step must re-place the
-            # state onto the mesh: checkpoints hold the CANONICAL
-            # full-shape updater-state layout, and under the ZeRO
-            # sharded update (weight_update='sharded') the live carry is
-            # the 1/dp flat-shard view — re-placement is bitwise (the
-            # view is a reshape). On a fresh resume _build_jit does this
-            # via the same _place_replicated.
+        manifest = _ckpt.read_manifest(path)
+        extra = manifest.get("extra", {})
+        if self.wrapper is not None:
+            # re-place the restored state onto the mesh: checkpoints
+            # hold the CANONICAL full-shape updater-state layout, and
+            # under the ZeRO sharded update (weight_update='sharded')
+            # the live carry is the 1/dp flat-shard view — re-placement
+            # is bitwise (the view is a reshape). Under threshold
+            # compression this also re-packs the residual carry (fresh
+            # zeros), which the saved trainer state then overwrites.
             self.wrapper._place_replicated()
+            if manifest.get("trainerState"):
+                tmpl = self.wrapper._ckpt_trainer_state()
+                if tmpl is not None:
+                    abstract = jax.tree_util.tree_map(
+                        lambda a: jax.ShapeDtypeStruct(
+                            a.shape, a.dtype, sharding=a.sharding),
+                        tmpl)
+                    ts = retry(
+                        lambda: _ckpt.restore_trainer_state(path,
+                                                            abstract),
+                        self.retryPolicy)
+                    self.wrapper._restore_trainer_state(ts)
         self._fire("onCheckpointRestored", path, net._iteration)
         return int(extra.get("batch_in_epoch", 0))
 

@@ -43,6 +43,7 @@ from deeplearning4j_tpu.util import serde
 
 _MANIFEST = "manifest.json"
 _STATE_DIR = "state"
+_TRAINER_DIR = "trainer"
 _STEP_RE = re.compile(r"^step_(\d+)$")
 _TMP_RE = re.compile(r"\.tmp-")
 
@@ -180,7 +181,8 @@ class ShardedModelSerializer:
     distributed complement of util.serializer.ModelSerializer)."""
 
     @staticmethod
-    def writeModel(net, path, saveUpdater=True, asyncSave=False, extra=None):
+    def writeModel(net, path, saveUpdater=True, asyncSave=False, extra=None,
+                   trainer_state=None):
         """Save to directory `path`. With asyncSave=True the write
         happens in the background — you MUST call the returned handle's
         .wait_until_finished() to join AND commit it. Sharded arrays
@@ -202,7 +204,15 @@ class ShardedModelSerializer:
         `extra`: optional JSON-serializable dict recorded in the
         manifest (read back via read_manifest) — resume metadata like
         ResilientFit's batch-within-epoch position rides here so it
-        commits atomically WITH the state it describes."""
+        commits atomically WITH the state it describes.
+
+        `trainer_state`: optional pytree of TRAINER-owned step state
+        saved as a separate item (read back via restore_trainer_state)
+        — e.g. the threshold-compression error-feedback residuals a
+        bitwise resume needs. Kept out of the net state on purpose:
+        the canonical net state must restore into ANY training mode,
+        while trainer state only means something to the wrapper that
+        wrote it."""
         import orbax.checkpoint as ocp
 
         path = os.path.abspath(str(path))
@@ -223,12 +233,20 @@ class ShardedModelSerializer:
                              "data": np.asarray(a).tolist()}
                             for a in conf_arrays],
             "saveUpdater": bool(saveUpdater),
+            "trainerState": trainer_state is not None,
         }
         if extra is not None:
             manifest["extra"] = extra
         if jax.process_index() == 0:
             with open(os.path.join(tmp, _MANIFEST), "w") as f:
                 json.dump(manifest, f)
+        if trainer_state is not None:
+            # synchronous side item inside the staging dir: it rides the
+            # same atomic commit rename as the main state
+            tckpt = ocp.StandardCheckpointer()
+            tckpt.save(os.path.join(tmp, _TRAINER_DIR), trainer_state,
+                       force=True)
+            tckpt.wait_until_finished()
         ckpt = (ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
                 if asyncSave else ocp.StandardCheckpointer())
         state_path = os.path.join(tmp, _STATE_DIR)
@@ -290,3 +308,21 @@ class ShardedModelSerializer:
         net._iteration = int(state["counters"]["iteration"])
         net._epoch = int(state["counters"]["epoch"])
         return net
+
+
+def restore_trainer_state(path, abstract):
+    """Restore the optional trainer-state item a writeModel(...,
+    trainer_state=...) save carried (e.g. ParallelWrapper's threshold
+    error-feedback residuals). `abstract` is the target pytree of
+    jax.ShapeDtypeStruct (with shardings) the restoring wrapper builds
+    from its freshly-placed state — only the wrapper knows the layout.
+    Returns None when the checkpoint has no trainer state."""
+    import orbax.checkpoint as ocp
+
+    p = os.path.join(os.path.abspath(str(path)), _TRAINER_DIR)
+    if not os.path.isdir(p):
+        return None
+    ckpt = ocp.StandardCheckpointer()
+    out = ckpt.restore(p, abstract)
+    ckpt.wait_until_finished()
+    return out

@@ -24,7 +24,7 @@ class TestCodecs:
     def test_singleton_and_catalog(self):
         assert self.c is BasicNDArrayCompressor.getInstance()
         assert set(self.c.getAvailableCompressors()) == \
-            {"GZIP", "FLOAT16", "INT8", "NOOP"}
+            {"GZIP", "FLOAT16", "INT8", "THRESHOLD", "NOOP"}
 
     def test_gzip_lossless_roundtrip(self):
         x = Nd4j.rand(17, 9, seed=3)

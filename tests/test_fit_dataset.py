@@ -425,15 +425,33 @@ class TestFitDataSetSharded:
         assert np.isfinite(net.score())
         assert net._iteration == 4
 
-    def test_threshold_mode_rejected(self):
+    def test_threshold_mode_k_loop_matches_per_batch(self):
+        """ISSUE 11: the threshold step's error-feedback residual rides
+        the donated updater-state carry, so the staged k-loop threads
+        it — the k=2 trajectory must match per-batch fit() bitwise."""
         from deeplearning4j_tpu.parallel import (ParallelWrapper,
                                                  data_parallel_mesh)
 
         net = MultiLayerNetwork(_mlp()).init()
         pw = ParallelWrapper(net, mesh=data_parallel_mesh(),
-                             gradient_compression="threshold")
-        with pytest.raises(ValueError, match="threshold"):
-            pw.fitDataSet(_iter(4, batch=16), stepsPerSync=2)
+                             gradient_compression="threshold",
+                             threshold=1e-2)
+        pw.fitDataSet(_iter(4, batch=16), stepsPerSync=2)
+        assert np.isfinite(net.score())
+        assert net._iteration == 4
+        assert pw._fit_dataset_syncs == 2
+        ref = MultiLayerNetwork(_mlp()).init()
+        pr = ParallelWrapper(ref, mesh=data_parallel_mesh(),
+                             gradient_compression="threshold",
+                             threshold=1e-2)
+        pr.fit(_iter(4, batch=16))
+        for a, b in zip(jax.tree_util.tree_leaves(net._params),
+                        jax.tree_util.tree_leaves(ref._params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # the residual carried out of the k-loop matches too
+        for a, b in zip(jax.tree_util.tree_leaves(pw._residual[0]),
+                        jax.tree_util.tree_leaves(pr._residual[0])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
     def test_parameter_averaging_rejected(self):
         from deeplearning4j_tpu.parallel import (

@@ -665,14 +665,34 @@ class TestThresholdGradientSharing:
         dense = self._mlp(seed=5)
         ParallelWrapper(dense).fit(x, y)
         net = self._mlp(seed=5)
+        # encodingCapacity=1.0: tau is the only limiter (the classic
+        # Strom regime); the default fixed capacity additionally bounds
+        # per-step traffic and trades convergence speed for wire bytes
         pw = ParallelWrapper(net, gradient_compression="threshold",
-                             threshold=1e-2)
+                             threshold=1e-2, encodingCapacity=1.0)
         for _ in range(100):
             pw.fit(x, y)
         # sign-style +-t updates converge slower than dense psum per step
         # (the trade upstream makes for sparse wire traffic), but must
         # still reach a good fit on separable data
         assert net.score() < 0.25, net.score()
+
+    def test_capacity_limited_encoder_still_converges(self):
+        """The default FIXED-capacity encoder (top-|.| candidates only)
+        transmits at most ceil(0.125*n) entries per leaf per step; error
+        feedback must still deliver the full gradient mass over time."""
+        from deeplearning4j_tpu.parallel import ParallelWrapper
+
+        x, y = self._data()
+        net = self._mlp(seed=5)
+        pw = ParallelWrapper(net, gradient_compression="threshold",
+                             threshold=1e-2)
+        assert pw.encoding_capacity == 0.125
+        first = None
+        for _ in range(150):
+            pw.fit(x, y)
+            first = first if first is not None else net.score()
+        assert net.score() < 0.5 * first, (first, net.score())
 
     def test_bad_compression_name_rejected(self):
         from deeplearning4j_tpu.parallel import ParallelWrapper

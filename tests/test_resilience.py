@@ -306,14 +306,19 @@ class TestParallelWrapperGuard:
         for leaf in jax.tree_util.tree_leaves(net2._params):
             assert np.isfinite(np.asarray(leaf)).all()
 
-    def test_threshold_compression_rejected_with_clear_error(self):
+    def test_threshold_compression_trains_under_guard(self):
+        """ISSUE 11: the threshold step is wrappable now — its residual
+        rides the updater-state carry, so the non-finite guard rolls it
+        back with the rest of the state on a skipped step."""
         from deeplearning4j_tpu.parallel import ParallelWrapper
 
         net = MultiLayerNetwork(_mlp()).init()
-        pw = ParallelWrapper(net, gradient_compression="threshold")
+        pw = ParallelWrapper(net, gradient_compression="threshold",
+                             threshold=1e-2)
         rf = ResilientFit(pw, retryPolicy=_FAST)
-        with pytest.raises(ValueError, match="threshold"):
-            rf.fit(_iter(), epochs=1)
+        rf.fit(_iter(), epochs=1)
+        assert np.isfinite(net.score())
+        assert rf.skippedSteps == 0
 
     def test_parameter_averaging_rejected_not_silently_replaced(self):
         # PATM's local-steps+periodic-pmean semantics live in its own

@@ -347,17 +347,24 @@ class TestEligibilityAndLayout:
         net = MultiLayerNetwork(_mlp()).init()
         with pytest.raises(ValueError, match="replicated.*sharded"):
             ParallelWrapper(net, mesh=_mesh(), weight_update="zero")
-        with pytest.raises(ValueError, match="gradient_compression"):
+        # ISSUE 11: int8/block_int8 now COMPOSE with the sharded update
+        # (compressed reduce-scatter); only threshold cannot
+        for gc in ("int8", "block_int8"):
+            pw = ParallelWrapper(net, mesh=_mesh(),
+                                 weight_update="sharded",
+                                 gradient_compression=gc)
+            assert pw._zero is not None
+        with pytest.raises(ValueError, match="threshold"):
             ParallelWrapper(net, mesh=_mesh(), weight_update="sharded",
-                            gradient_compression="int8")
+                            gradient_compression="threshold")
         with pytest.raises(ValueError, match="ParallelWrapper"):
             ParameterAveragingTrainingMaster(net, mesh=_mesh(),
                                              weight_update="sharded")
-        # SharedTrainingMaster: asking for the sharded update opts out
-        # of the int8 default instead of dying on the int8 conflict
+        # SharedTrainingMaster: the sharded update keeps the int8
+        # default — the two features stack now
         m = SharedTrainingMaster(net, mesh=_mesh(),
                                  weight_update="sharded")
-        assert m.gradient_compression is None
+        assert m.gradient_compression == "int8"
 
 
 # ----------------------------------------------------------------------
