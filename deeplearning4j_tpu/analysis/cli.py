@@ -18,6 +18,13 @@ consistency, pipeline balance, per-chip HBM fit) on every ``--mesh``
 (default: the canonical dp4xtp2 and dp2xpp4 meshes), and adds the
 recompilation-hazard lint (RTC01-03) to source paths.
 
+``--autotune`` runs the runtime autotuning arbiter
+(runtime/autotune.py, docs/AUTOTUNE.md) over the attribution subjects:
+sweep the lowering knobs, prove loss parity, score by attributed
+bytes, persist winners keyed like the AOT cache (exit 1 = a
+bitwise-contract kernel candidate diverged — a bug, not a tuning
+outcome).
+
 ``--linalg`` validates the canonical distributed-linalg block plans
 (linalg/plan.py: SUMMA GEMM, tall Gram, randomized SVD, CG
 least-squares) on each ``--mesh`` (default dp4xtp2): PAR01/03 axis and
@@ -95,10 +102,27 @@ def _build_parser():
                         "--cache-dir (or $DL4J_TPU_AOT_CACHE) so later "
                         "processes — trainers, serving, --attribution "
                         "reruns — warm-start")
+    p.add_argument("--autotune", nargs="?", const="all",
+                   metavar="SUBJECT",
+                   help="run the autotune arbiter (runtime/autotune.py, "
+                        "docs/AUTOTUNE.md) over SUBJECT (lenet, "
+                        "resnet_block, or 'all'): sweep the lowering "
+                        "knobs, prove loss parity per candidate, score "
+                        "by hbm_ledger attributed bytes (+ wall time on "
+                        "a live device), persist winners to --cache-dir "
+                        "(or $DL4J_TPU_AUTOTUNE_CACHE) keyed like the "
+                        "AOT cache. A later run (any process) recalls "
+                        "the winners with zero re-sweeps. Exit 1 if a "
+                        "bitwise-contract candidate failed parity")
+    p.add_argument("--force", action="store_true",
+                   help="with --autotune: re-sweep even when a "
+                        "persisted record exists")
     p.add_argument("--cache-dir", default=None, metavar="DIR",
                    help="executable-cache directory for --precompile/"
-                        "--attribution (default: $DL4J_TPU_AOT_CACHE, "
-                        "else memory-only)")
+                        "--attribution/--autotune (default: "
+                        "$DL4J_TPU_AOT_CACHE, else memory-only; "
+                        "--autotune stores its .tune.json records in "
+                        "the same directory)")
     return p
 
 
@@ -215,18 +239,27 @@ def main(argv=None):
             print(f"{code}  {desc}")
         return 0
 
-    if args.linalg and (args.parallel or args.zoo or args.paths
-                        or args.precompile or args.attribution):
-        # --linalg is its own subject; letting another subject's block
-        # return first would silently swallow this one's exit status
-        # and un-gate a CI wired to the combined command
-        print("--linalg cannot be combined with --parallel/--zoo/"
-              "--precompile/--attribution/paths; run the subjects as "
-              "separate commands", file=sys.stderr)
+    # each of these subjects RETURNS from its own block, so combining
+    # any two would silently swallow the second one's exit status and
+    # un-gate a CI wired to the combined command — at most ONE may be
+    # requested per invocation (zoo/paths form one combined subject)
+    selected = [name for name, on in (
+        ("--autotune", bool(args.autotune)),
+        ("--precompile", bool(args.precompile)),
+        ("--attribution", bool(args.attribution)),
+        ("--linalg", args.linalg),
+        # --parallel is a modifier OF the zoo/paths subject
+        ("--zoo/paths", bool(args.zoo or args.paths or args.parallel)),
+    ) if on]
+    if len(selected) > 1:
+        print(" + ".join(selected) + ": these subjects each own the "
+              "exit status; run them as separate commands",
+              file=sys.stderr)
         return 2
 
     aot_cache = None
-    if args.cache_dir or args.precompile or args.attribution:
+    if args.cache_dir or args.precompile or args.attribution \
+            or args.autotune:
         # an explicit dir (or the env var) turns on the persistent tier
         # for every compile this command pays; the handle is kept so
         # the --precompile report works even when the session cache is
@@ -235,6 +268,58 @@ def main(argv=None):
         from deeplearning4j_tpu.runtime import aot
 
         aot_cache = aot.enable(args.cache_dir)
+
+    if args.autotune:
+        from deeplearning4j_tpu.analysis.hbm import SUBJECTS
+        from deeplearning4j_tpu.runtime import autotune as _autotune
+
+        tune_store = _autotune.enable(args.cache_dir)
+        subjects = SUBJECTS if args.autotune == "all" \
+            else (args.autotune,)
+        results = {}
+        try:
+            for s in subjects:
+                results[s] = _autotune.autotune_subject(
+                    s, store_=tune_store, force=args.force)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        # a bitwise-contract candidate (parity_rtol == 0: the
+        # impl-swap knobs promise exact math) failing parity is a
+        # kernel bug the CI gate must see; math-changing knobs being
+        # rejected for tolerance is the arbiter working as designed.
+        # Only FRESH sweeps count: a recalled record's historical
+        # verdict must not keep CI red after the kernel is fixed
+        # (records persist unconditionally; re-prove with --force)
+        strict = {k.name for k in _autotune.KNOBS
+                  if k.parity_rtol == 0.0}
+        bitwise_fail = any(
+            p["verdict"] == "parity-fail" and p["knob"] in strict
+            for r in results.values() if r.swept for p in r.per_knob)
+        if args.as_json:
+            print(_json.dumps(
+                {"subjects": {s: {"key": r.key, "swept": r.swept,
+                                  "knobs": r.knobs,
+                                  "baseline_bytes": r.baseline_bytes,
+                                  "tuned_bytes": r.tuned_bytes,
+                                  "per_knob": r.per_knob,
+                                  "wall": r.wall}
+                              for s, r in results.items()},
+                 "store_dir": tune_store.directory,
+                 "bitwise_parity_failure": bitwise_fail}, indent=2))
+        else:
+            for s, r in results.items():
+                print(f"{s}:")
+                print("  " + r.format().replace("\n", "\n  "))
+            where = tune_store.directory or \
+                "memory only (set --cache-dir or " \
+                "$DL4J_TPU_AUTOTUNE_CACHE to persist)"
+            print(f"\nstore: {where}")
+            if bitwise_fail:
+                print("ERROR: a bitwise-contract knob candidate failed "
+                      "loss parity — a kernel impl diverged from the "
+                      "stock lowering", file=sys.stderr)
+        return 1 if bitwise_fail else 0
 
     if args.precompile:
         from deeplearning4j_tpu.analysis.hbm import (SUBJECTS,

@@ -84,12 +84,20 @@ def lower_train_step(net, x_shape, n_classes=10):
         np.zeros(B, dtype=int)])
     key = jax.random.key(0)
     it0 = jnp.asarray(0, jnp.int32)
+    # fresh-identity wrapper, NOT jax.jit(net._train_step): jax's
+    # global trace cache keys on bound-method EQUALITY, so once this
+    # net has fit() at this signature, a plain re-jit would serve the
+    # cached jaxpr and silently ignore any module-global knob flipped
+    # since (the autotune arbiter's whole sweep would read
+    # "identical") — a fresh lambda per call can never alias and the
+    # lowering always reflects the LIVE knob state
+    step = lambda *a: net._train_step(*a)  # noqa: E731
     if hasattr(net, "layers"):  # MultiLayerNetwork
-        return jax.jit(net._train_step).lower(
+        return jax.jit(step).lower(
             net._params, net._upd_states, net._states, it0, x, y, key,
             None, None)
     inputs = {net.conf.networkInputs[0]: x}
-    return jax.jit(net._train_step).lower(
+    return jax.jit(step).lower(
         net._params, net._upd_states, net._states, it0, inputs, [y],
         key, None, None)
 

@@ -735,6 +735,21 @@ class BatchNormalization(Layer):
         is_rnn = x.ndim == 3
         if is_rnn:  # [B,F,T] -> [B,T,F] so channels are last
             x = jnp.transpose(x, (0, 2, 1))
+        if _norm.bn_act_supported(self.activation):
+            # fused BN -> activation epilogue (round 12): the backward
+            # reads the OUTPUT (already the next layer's residual)
+            # instead of keeping the pre-activation BN result alive —
+            # one fewer activation-scale residual per BN. Honors the
+            # DL4J_TPU_BN_EPILOGUE / autotune-arbiter knob; activations
+            # outside the grad-from-output set take the legacy path.
+            y, rm, rv = _norm.batch_norm_act(
+                x, params.get("gamma"), params.get("beta"),
+                state["mean"], state["var"], train=train,
+                activation=self.activation, decay=self.decay,
+                eps=self.eps)
+            if is_rnn:
+                y = jnp.transpose(y, (0, 2, 1))
+            return y, {"mean": rm, "var": rv}
         y, rm, rv = _norm.batch_norm(
             x, params.get("gamma"), params.get("beta"),
             state["mean"], state["var"], train=train, decay=self.decay, eps=self.eps)

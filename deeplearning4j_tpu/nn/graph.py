@@ -174,7 +174,7 @@ class ComputationGraph:
 
     def precompile(self, batchSize=32, featuresShape=None,
                    labelsShape=None, entries=("train", "infer"),
-                   stepsPerSync=None, cache=None):
+                   stepsPerSync=None, cache=None, autotune=False):
         """AOT warm-start for single-input/single-output graphs: see
         MultiLayerNetwork.precompile. Multi-IO graphs have no canonical
         example batch — warm those by running one real batch."""
@@ -187,7 +187,8 @@ class ComputationGraph:
             self, batchSize=batchSize, featuresShape=featuresShape,
             labelsShape=labelsShape, entries=entries,
             stepsPerSync=stepsPerSync, cache=cache,
-            wrap_args=lambda x, y: ({in_name: x}, [y]))
+            wrap_args=lambda x, y: ({in_name: x}, [y]),
+            autotune=autotune)
 
     # ------------------------------------------------------------------
     def _cast_params(self, p):

@@ -353,12 +353,20 @@ def example_batch(net, batchSize, featuresShape=None, labelsShape=None):
 
 def precompile_network(net, batchSize=32, featuresShape=None,
                        labelsShape=None, entries=("train", "infer"),
-                       stepsPerSync=None, cache=None, wrap_args=None):
+                       stepsPerSync=None, cache=None, wrap_args=None,
+                       autotune=False):
     """Shared MultiLayerNetwork/ComputationGraph precompile driver:
     warm (or AOT-compile + persist) the selected entry points at one
     batch signature. wrap_args adapts (x, y) into the network-type call
-    convention (ComputationGraph's inputs-dict/labels-list)."""
+    convention (ComputationGraph's inputs-dict/labels-list).
+    autotune=True first installs this network's persisted tuned knobs
+    (runtime.autotune.warm_start — a no-op when no record exists), so
+    the warmed executables are the TUNED programs, in any process."""
     net._require_init()
+    if autotune:
+        from deeplearning4j_tpu.runtime import autotune as _autotune
+
+        _autotune.warm_start(net)
     x, y = example_batch(net, batchSize, featuresShape, labelsShape)
     key = jax.random.fold_in(jax.random.key(net.conf.seed ^ 0x5EED), 0)
     it0 = jnp.asarray(0, jnp.int32)
@@ -669,7 +677,7 @@ class MultiLayerNetwork:
 
     def precompile(self, batchSize=32, featuresShape=None,
                    labelsShape=None, entries=("train", "infer"),
-                   stepsPerSync=None, cache=None):
+                   stepsPerSync=None, cache=None, autotune=False):
         """AOT warm-start: compile (or load from the persistent
         executable cache) the train-step / inference / fitDataSet
         programs for one batch signature BEFORE the first real batch,
@@ -679,11 +687,14 @@ class MultiLayerNetwork:
         entries: any of "train", "infer"; stepsPerSync=k additionally
         warms the fitDataSet k-loop. cache: an aot.ExecutableCache (or
         None for the session cache, enabling a memory one if none is
-        active). Returns {entry: {key, status cold|warm, seconds}}."""
+        active). autotune=True installs this network's persisted
+        autotuned knobs first (docs/AUTOTUNE.md), so the process warms
+        the TUNED executables. Returns
+        {entry: {key, status cold|warm, seconds}}."""
         return precompile_network(
             self, batchSize=batchSize, featuresShape=featuresShape,
             labelsShape=labelsShape, entries=entries,
-            stepsPerSync=stepsPerSync, cache=cache)
+            stepsPerSync=stepsPerSync, cache=cache, autotune=autotune)
 
     # ------------------------------------------------------------------
     # pure functions (traced under jit)

@@ -101,6 +101,7 @@ def ambient_fingerprint():
     bench flips — a cache hit across two of THESE states would replay
     the wrong program."""
     from deeplearning4j_tpu.nn import losses as _losses
+    from deeplearning4j_tpu.nn import multilayer as _ml
     from deeplearning4j_tpu.ops import norm as _norm
     from deeplearning4j_tpu.ops import pallas_attention as _pattn
     from deeplearning4j_tpu.ops import pooling as _pooling
@@ -113,9 +114,17 @@ def ambient_fingerprint():
         "backend": jax.default_backend(),
         "devices": len(jax.devices()),
         "x64": bool(jax.config.jax_enable_x64),
+        # the autotune-arbiter knobs (runtime/autotune.py): every value
+        # the arbiter can flip lives in the key, so a tuned run and a
+        # stock run can NEVER share an executable — flipping a knob is
+        # a different program, not a warm hit
         "loss_tail": _losses._TAIL_MODE,
         "bn_tail": _norm._TAIL_MODE,
+        "bn_epilogue": _norm._EPILOGUE,
         "maxpool_bwd": _pooling._BACKWARD_IMPL,
+        "global_maxpool_bwd": _pooling._GLOBAL_MAXPOOL_BWD,
+        "flash_bwd": _pattn._BWD_IMPL,
+        "canon_staging": _ml._CANON_STAGING,
         "argmax_bwd_win": _pooling._ARGMAX_BWD_MAX_WINDOW,
         "flash_window": (_pattn._MIN_FLASH_SEQ, _pattn._BLOCKWISE_WINDOW,
                          _pattn._INTERPRET),

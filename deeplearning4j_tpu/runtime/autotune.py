@@ -1,0 +1,605 @@
+"""Runtime autotuning arbiter: measure once, start tuned forever.
+
+The framework exposes a family of lowering knobs — BN/loss dtype tails,
+the BN->activation epilogue, three maxpool backward impls, the flash
+attention backward strategy, fitDataSet staging — each shipped at a
+default chosen from ONE reference measurement (usually the TPU v5e
+round-4 window). The right setting is a function of backend, shapes and
+jaxlib version, so any fixed default is wrong somewhere; the EQuARX
+pattern (arXiv:2506.17615 — measure the variants, persist the winner,
+key by the configuration) applies to every one of these knobs, not just
+collectives.
+
+``autotune(net, x_shape)`` is that pattern as a runtime service:
+
+* **sweep** — coordinate descent over the knob registry. Each candidate
+  re-lowers the network's canonical train step under the flipped knob;
+  candidates whose HLO is byte-identical to the incumbent (the knob
+  does not touch this program — e.g. flash_bwd on an attention-free
+  CNN) are skipped without compiling.
+* **prove** — every adopted candidate must run ``steps`` training steps
+  on the live backend and reproduce the incumbent's loss sequence
+  (bitwise for impl-swap knobs like maxpool_bwd, tolerance-banded for
+  math-changing knobs like the wide tails). A faster-but-wrong
+  candidate is rejected, never scored.
+* **score** — ``util.hbm_ledger`` attributed bytes of the compiled step
+  (the bandwidth bill the round-5 attribution engine audits); when a
+  real accelerator is live, measured step wall time becomes the primary
+  score with bytes as the tiebreak. A candidate must win by
+  ``min_gain`` (default 0.5%) — noise never flips a default.
+* **persist** — winners are stored keyed EXACTLY like the AOT
+  executable cache (runtime/aot.py): ambient fingerprint x program
+  fingerprint x signature — except the knob values themselves are
+  excluded from the ambient part (they are the tuning's OUTPUT, not its
+  environment). Any later process calling ``autotune``/``warm_start``
+  with the same network on the same backend gets the persisted winners
+  applied with ZERO re-sweeps and zero compiles.
+
+The knob values live in the AOT ambient fingerprint, so installing a
+tuned config can never collide with a stock executable — flipping a
+knob IS a different cache key (gated in tests/test_aot_cache.py).
+
+Distinct from the hyperparameter-search ``arbiter/`` package: that
+tunes the MODEL (learning rates, layer sizes) by training to
+convergence; this tunes the LOWERING (same math, fewer bytes) by
+compiling and proving parity. See docs/AUTOTUNE.md.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+import time
+
+import numpy as np
+
+__all__ = [
+    "KNOBS", "Knob", "current_knobs", "applied", "install",
+    "tuning_key", "TuningStore", "enable", "disable", "store",
+    "autotune", "autotune_subject", "warm_start", "AutotuneResult",
+]
+
+#: bump when the knob inventory or record layout changes — old records
+#: become stale (re-swept), never silently misapplied
+TUNE_FORMAT = 1
+
+#: env var naming a directory for the persistent tier (shares a
+#: directory with the AOT executable cache comfortably: records are
+#: ``<key>.tune.json`` next to the ``.aotx`` executables)
+TUNE_DIR_ENV = "DL4J_TPU_AUTOTUNE_CACHE"
+
+
+class Knob:
+    """One tunable lowering toggle: where it lives (module attr), what
+    values it may take, how to set it, and how strictly a candidate
+    must reproduce the incumbent's loss sequence (rtol 0.0 = bitwise —
+    the impl-swap knobs are exact-math alternatives; > 0 = the knob
+    changes rounding, e.g. the wide tails)."""
+
+    def __init__(self, name, module, attr, candidates, setter=None,
+                 parity_rtol=0.0, doc=""):
+        self.name = name
+        self._module = module
+        self._attr = attr
+        self.candidates = tuple(candidates)
+        self._setter = setter  # name of a validating setter on module
+        self.parity_rtol = float(parity_rtol)
+        self.doc = doc
+
+    def _mod(self):
+        import importlib
+
+        return importlib.import_module(self._module)
+
+    def get(self):
+        return getattr(self._mod(), self._attr)
+
+    def set(self, value):
+        """Set the knob; returns the previous value."""
+        if value not in self.candidates:
+            raise ValueError(
+                f"knob {self.name}: {value!r} not in {self.candidates}")
+        mod = self._mod()
+        if self._setter is not None:
+            return getattr(mod, self._setter)(value)
+        old = getattr(mod, self._attr)
+        setattr(mod, self._attr, value)
+        return old
+
+
+#: the knob registry, in sweep order (cheapest-to-prove first). These
+#: are exactly the module globals the AOT ambient fingerprint carries —
+#: keep the two lists in sync (gated in tests/test_autotune.py).
+KNOBS = (
+    Knob("maxpool_bwd", "deeplearning4j_tpu.ops.pooling",
+         "_BACKWARD_IMPL", ("stock", "indices", "argmax"),
+         setter="set_maxpool_bwd",
+         doc="max_pool2d gradient: XLA select-and-scatter / saved-int8-"
+             "indices single-pass (non-overlapping windows) / argmax "
+             "recompute"),
+    Knob("global_maxpool_bwd", "deeplearning4j_tpu.ops.pooling",
+         "_GLOBAL_MAXPOOL_BWD", ("stock", "indices"),
+         setter="set_global_maxpool_bwd",
+         doc="global max-pool gradient: jnp.max autodiff / saved-argmax "
+             "elementwise pass"),
+    Knob("bn_epilogue", "deeplearning4j_tpu.ops.norm",
+         "_EPILOGUE", ("fused", "unfused"), setter="set_bn_epilogue",
+         parity_rtol=1e-4,  # tanh/sigmoid grad-from-output is ulp-level
+         doc="BN -> activation(-> add): one custom-VJP epilogue (no "
+             "pre-activation residual) / legacy composition"),
+    Knob("flash_bwd", "deeplearning4j_tpu.ops.pallas_attention",
+         "_BWD_IMPL", ("kernel", "recompute"), setter="set_flash_bwd",
+         parity_rtol=1e-3,
+         doc="pallas flash-attention backward: hand-written dq/dkv "
+             "kernels / jax.vjp recompute through the blockwise scan"),
+    Knob("bn_tail", "deeplearning4j_tpu.ops.norm",
+         "_TAIL_MODE", ("compute", "wide"), parity_rtol=0.05,
+         doc="BN activation-scale math dtype under a sub-fp32 policy"),
+    Knob("loss_tail", "deeplearning4j_tpu.nn.losses",
+         "_TAIL_MODE", ("compute", "wide"), parity_rtol=0.05,
+         doc="loss-tail activation-scale math dtype"),
+    # NOT registered: canon_staging (DL4J_TPU_CANON_STAGING). It only
+    # shapes the fitDataSet staging path, never the _train_step program
+    # this arbiter lowers and scores — sweeping it would record a dead
+    # 'identical' row on every subject. It IS in the AOT ambient
+    # fingerprint (flipping it re-keys executables) and bench.py's
+    # canon_staging_ab leg measures it on the program it does shape.
+)
+
+_KNOBS_BY_NAME = {k.name: k for k in KNOBS}
+
+
+def current_knobs():
+    """{name: live value} for every registered knob."""
+    return {k.name: k.get() for k in KNOBS}
+
+
+class applied:
+    """Context manager: set the given {name: value} knobs, restore the
+    previous values on exit (exception-safe, reverse order)."""
+
+    def __init__(self, knobs):
+        self._target = dict(knobs)
+        self._old = []
+
+    def __enter__(self):
+        for name, value in self._target.items():
+            knob = _KNOBS_BY_NAME[name]
+            self._old.append((knob, knob.get()))
+            knob.set(value)
+        return self
+
+    def __exit__(self, *exc):
+        for knob, value in reversed(self._old):
+            knob.set(value)
+        self._old = []
+        return False
+
+
+def install(knobs):
+    """Permanently set {name: value} knobs (the warm-start entry);
+    returns {name: previous} so a caller can undo. Callers must not
+    reuse jitted steps traced before the install — the AOT key changes
+    with the knobs, so cached executables re-key correctly, but a bare
+    jax.jit handle traced earlier keeps the old lowering."""
+    old = {}
+    for name, value in knobs.items():
+        old[name] = _KNOBS_BY_NAME[name].set(value)
+    return old
+
+
+# ----------------------------------------------------------------------
+# keys and the store
+# ----------------------------------------------------------------------
+
+def _ambient_base():
+    """The AOT ambient fingerprint MINUS the tuned knobs: the
+    environment the tuning is valid FOR, independent of where the
+    knobs currently point (a tuned process must look up the same
+    record it would have written when stock)."""
+    from deeplearning4j_tpu.runtime import aot
+
+    amb = dict(aot.ambient_fingerprint())
+    for k in _KNOBS_BY_NAME:
+        amb.pop(k, None)
+    amb["tune_format"] = TUNE_FORMAT
+    # knob inventory: adding a candidate or a knob re-tunes
+    amb["knob_inventory"] = tuple(
+        (k.name, k.candidates) for k in KNOBS)
+    return amb
+
+
+def tuning_key(net, extra=""):
+    """sha256 over (ambient-minus-knobs, program fingerprint) — the AOT
+    cache-key anatomy (docs/COMPILE.md) with the knob axis removed and
+    no call signature: tuned knobs are properties of the PROGRAM on
+    this backend, not of one batch shape, so precompile()/serving can
+    recall them for any signature (docs/AUTOTUNE.md 'Key anatomy')."""
+    from deeplearning4j_tpu.runtime import aot
+
+    try:
+        fp = aot.network_fingerprint(net)
+    except Exception:
+        fp = aot.samediff_fingerprint(net)  # SameDiff graphs
+    base = repr(sorted(_ambient_base().items()))
+    return hashlib.sha256("|".join(
+        [base, fp, extra]).encode()).hexdigest()
+
+
+class TuningStore:
+    """Two-tier {key: record} store mirroring aot.ExecutableCache:
+    process memory plus an optional JSON-per-key disk tier written
+    atomically (tmp + rename). Records embed the ambient base; a
+    version/backend change makes them stale (removed, re-swept), and a
+    corrupt file is a miss, never a crash."""
+
+    def __init__(self, directory=None):
+        self.directory = os.path.expanduser(str(directory)) \
+            if directory else None
+        if self.directory:
+            os.makedirs(self.directory, mode=0o700, exist_ok=True)
+        self._mem = {}
+        self.stats = {"hits": 0, "misses": 0, "puts": 0, "stale": 0,
+                      "corrupt": 0}
+
+    def _path(self, key):
+        return os.path.join(self.directory, key + ".tune.json")
+
+    def get(self, key):
+        rec = self._mem.get(key)
+        if rec is not None:
+            self.stats["hits"] += 1
+            return rec
+        if self.directory is None:
+            self.stats["misses"] += 1
+            return None
+        path = self._path(key)
+        if not os.path.exists(path):
+            self.stats["misses"] += 1
+            return None
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                rec = json.load(fh)
+        except Exception:
+            self.stats["corrupt"] += 1
+            self._remove(path)
+            return None
+        if rec.get("tune_format") != TUNE_FORMAT:
+            self.stats["stale"] += 1
+            self._remove(path)
+            return None
+        self.stats["hits"] += 1
+        self._mem[key] = rec
+        return rec
+
+    def put(self, key, rec):
+        rec = dict(rec)
+        rec["tune_format"] = TUNE_FORMAT
+        self._mem[key] = rec
+        self.stats["puts"] += 1
+        if self.directory is None:
+            return
+        try:
+            fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "w", encoding="utf-8") as fh:
+                    json.dump(rec, fh, indent=1)
+                os.replace(tmp, self._path(key))
+            except BaseException:
+                self._remove(tmp)
+                raise
+        except Exception:
+            pass  # memory tier still works; next process re-sweeps
+
+    @staticmethod
+    def _remove(path):
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+    def clear_memory(self):
+        self._mem.clear()
+
+
+_STORE = None
+
+
+def enable(directory=None):
+    """Turn on the process-wide tuning store (directory=None falls back
+    to $DL4J_TPU_AUTOTUNE_CACHE, memory-only if unset). Idempotent for
+    an unchanged directory. Returns the TuningStore."""
+    global _STORE
+    directory = directory or os.environ.get(TUNE_DIR_ENV) or None
+    norm = os.path.expanduser(str(directory)) if directory else None
+    if _STORE is not None and _STORE.directory == norm:
+        return _STORE
+    _STORE = TuningStore(directory)
+    return _STORE
+
+
+def disable():
+    global _STORE
+    _STORE = None
+
+
+def store():
+    """The active store, auto-enabling from the env var on first use
+    (mirrors aot.session_cache); creates a memory-only store when the
+    env var is unset so autotune() always has somewhere to persist."""
+    if _STORE is None:
+        enable()
+    return _STORE
+
+
+# ----------------------------------------------------------------------
+# the sweep
+# ----------------------------------------------------------------------
+
+class AutotuneResult:
+    """What autotune() found (or recalled): ``knobs`` is the winning
+    {name: value} config, ``swept`` says whether this call paid the
+    sweep or reused a persisted record, ``per_knob`` the candidate-by-
+    candidate audit trail (bytes, wall, parity, verdict)."""
+
+    def __init__(self, key, knobs, swept, baseline_bytes=None,
+                 tuned_bytes=None, per_knob=None, wall=None):
+        self.key = key
+        self.knobs = dict(knobs)
+        self.swept = swept
+        self.baseline_bytes = baseline_bytes
+        self.tuned_bytes = tuned_bytes
+        self.per_knob = list(per_knob or [])
+        self.wall = wall  # {"baseline_s": ..., "tuned_s": ...} | None
+
+    @property
+    def changed(self):
+        """Knobs the sweep moved off their pre-sweep values."""
+        return {k: v for k, v in self.knobs.items()
+                if self.per_knob and v != next(
+                    (p["from"] for p in self.per_knob
+                     if p["knob"] == k), v)}
+
+    def to_record(self):
+        return {
+            "knobs": self.knobs,
+            "baseline_bytes": self.baseline_bytes,
+            "tuned_bytes": self.tuned_bytes,
+            "per_knob": self.per_knob,
+            "wall": self.wall,
+        }
+
+    @classmethod
+    def from_record(cls, key, rec):
+        return cls(key, rec["knobs"], swept=False,
+                   baseline_bytes=rec.get("baseline_bytes"),
+                   tuned_bytes=rec.get("tuned_bytes"),
+                   per_knob=rec.get("per_knob"),
+                   wall=rec.get("wall"))
+
+    def format(self):
+        lines = [f"key {self.key[:16]}  "
+                 f"({'swept' if self.swept else 'recalled'})"]
+        for p in self.per_knob:
+            lines.append(
+                f"  {p['knob']:<20} {p['from']:>9} -> {p['to']:<9} "
+                f"{p['verdict']:<10}"
+                + (f" {p['bytes']:>12,} B" if p.get("bytes") else "")
+                + (f" {p['wall_s'] * 1e3:8.2f} ms"
+                   if p.get("wall_s") else ""))
+        if self.baseline_bytes and self.tuned_bytes is not None:
+            cut = 1.0 - self.tuned_bytes / self.baseline_bytes
+            lines.append(
+                f"  bytes/step {self.baseline_bytes:,} -> "
+                f"{self.tuned_bytes:,}  ({cut:+.1%} cut)")
+        return "\n".join(lines)
+
+
+def _lower_subject(net, x_shape):
+    from deeplearning4j_tpu.analysis.hbm import lower_train_step
+
+    return lower_train_step(net, x_shape)
+
+
+def _compile_subject(net, x_shape, lowered):
+    """Compile through the AOT cache: the candidate's knob values are
+    in the ambient fingerprint, so every candidate gets its own slot
+    and an autotune re-run in a warm process pays zero compiles."""
+    from deeplearning4j_tpu.analysis.hbm import compile_train_step
+
+    return compile_train_step(net, x_shape, lowered=lowered)
+
+
+def _ledger_bytes(compiled):
+    from deeplearning4j_tpu.util import hbm_ledger
+
+    return int(hbm_ledger.ledger_for_compiled(compiled)["total_bytes"])
+
+
+def _device_live():
+    import jax
+
+    return jax.default_backend() not in ("cpu",)
+
+
+def _step_args(net, x_shape, seed=0):
+    """Concrete parity-run arguments matching lower_train_step's
+    abstract signature (random data — all-ones would give the BN
+    pathological zero-variance batch)."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(seed)
+    B = x_shape[0]
+    x = jnp.asarray(rng.rand(*x_shape).astype("float32"))
+    y = jnp.asarray(np.eye(10, dtype="float32")[
+        rng.randint(0, 10, B)])
+    key = jax.random.key(0)
+    it0 = jnp.asarray(0, jnp.int32)
+    if hasattr(net, "layers"):
+        return (net._params, net._upd_states, net._states, it0, x, y,
+                key, None, None)
+    inputs = {net.conf.networkInputs[0]: x}
+    return (net._params, net._upd_states, net._states, it0, inputs,
+            [y], key, None, None)
+
+
+def _run_steps(compiled, args, steps):
+    """Execute the compiled step `steps` times, chaining the carry;
+    returns the loss sequence (host floats) and median wall seconds."""
+    import jax
+
+    params, upd, states, it0, x, y, key, fm, lm = args
+    losses = []
+    walls = []
+    for i in range(steps):
+        t0 = time.perf_counter()
+        params, upd, states, loss = compiled(
+            params, upd, states, it0 + i, x, y, key, fm, lm)
+        jax.block_until_ready(loss)
+        walls.append(time.perf_counter() - t0)
+        losses.append(float(np.asarray(loss, dtype=np.float64)))
+    return losses, float(np.median(walls))
+
+
+def _parity_ok(base_losses, cand_losses, rtol):
+    if any(not np.isfinite(v) for v in cand_losses):
+        return False
+    a = np.asarray(base_losses)
+    b = np.asarray(cand_losses)
+    if rtol <= 0.0:
+        return bool(np.array_equal(a, b))
+    return bool(np.allclose(a, b, rtol=rtol, atol=rtol * 1e-2))
+
+
+def autotune(net, x_shape, *, knobs=None, store_=None, steps=3,
+             force=False, min_gain=0.005, seed=0):
+    """Tune the registered knobs for `net`'s canonical train step.
+
+    Warm path: an un-forced call whose key is already in the store
+    returns the persisted winners WITHOUT sweeping or compiling (the
+    second-process contract — gate it with aot.CompileWatch). Cold
+    path: coordinate descent as described in the module docstring.
+    The process's knob state is left exactly as found — call
+    ``install(result.knobs)`` (or ``warm_start``) to adopt.
+
+    knobs: optional subset of knob names to sweep (default: all).
+    """
+    st = store_ if store_ is not None else store()
+    key = tuning_key(net)
+    if not force:
+        rec = st.get(key)
+        if rec is not None:
+            return AutotuneResult.from_record(key, rec)
+
+    names = list(knobs) if knobs else [k.name for k in KNOBS]
+    for n in names:
+        if n not in _KNOBS_BY_NAME:
+            raise ValueError(
+                f"unknown knob {n!r}; registry: "
+                f"{sorted(_KNOBS_BY_NAME)}")
+
+    best = current_knobs()
+    per_knob = []
+    # baseline: the current configuration (candidate contexts below
+    # restore the process state after every lower/compile/run, so the
+    # sweep leaves the knobs exactly as it found them)
+    low = _lower_subject(net, x_shape)
+    best_hlo = hashlib.sha256(low.as_text().encode()).hexdigest()
+    compiled = _compile_subject(net, x_shape, low)
+    baseline_bytes = best_bytes = _ledger_bytes(compiled)
+    args = _step_args(net, x_shape, seed=seed)
+    base_losses, base_wall = _run_steps(compiled, args, steps)
+    best_wall = base_wall
+    live = _device_live()
+
+    for name in names:
+        knob = _KNOBS_BY_NAME[name]
+        for cand in knob.candidates:
+            if cand == best[name]:
+                continue
+            entry = {"knob": name, "from": best[name], "to": cand}
+            with applied({**best, name: cand}):
+                low_c = _lower_subject(net, x_shape)
+                hlo_c = hashlib.sha256(
+                    low_c.as_text().encode()).hexdigest()
+                if hlo_c == best_hlo:
+                    entry["verdict"] = "identical"
+                    per_knob.append(entry)
+                    continue
+                comp_c = _compile_subject(net, x_shape, low_c)
+                bytes_c = _ledger_bytes(comp_c)
+                losses_c, wall_c = _run_steps(comp_c, args, steps)
+            entry["bytes"] = bytes_c
+            entry["wall_s"] = wall_c
+            if not _parity_ok(base_losses, losses_c,
+                              knob.parity_rtol):
+                entry["verdict"] = "parity-fail"
+                per_knob.append(entry)
+                continue
+            if live:
+                wins = wall_c < best_wall * (1.0 - min_gain) or (
+                    wall_c <= best_wall
+                    and bytes_c < best_bytes * (1.0 - min_gain))
+            else:
+                wins = bytes_c < best_bytes * (1.0 - min_gain)
+            if wins:
+                entry["verdict"] = "adopted"
+                best = {**best, name: cand}
+                best_bytes, best_wall, best_hlo = \
+                    bytes_c, wall_c, hlo_c
+                # parity is measured against the INCUMBENT: once a
+                # math-changing knob is adopted, later bitwise knobs
+                # must match the adopted trajectory, not the original
+                # baseline (a stale baseline would spuriously
+                # parity-fail every exact-impl candidate after a
+                # tail-mode adoption)
+                base_losses = losses_c
+            else:
+                entry["verdict"] = "no-gain"
+            per_knob.append(entry)
+
+    # wall is RECORDED on every backend (bench A/Bs it); it only enters
+    # the SCORE when a real accelerator is live
+    result = AutotuneResult(
+        key, best, swept=True, baseline_bytes=baseline_bytes,
+        tuned_bytes=best_bytes, per_knob=per_knob,
+        wall={"baseline_s": base_wall, "tuned_s": best_wall,
+              "scored_by": "wall+bytes" if live else "bytes"})
+    st.put(key, result.to_record())
+    return result
+
+
+def autotune_subject(subject, batch_size=None, **kw):
+    """autotune() over one of the analysis CLI's attribution subjects
+    (analysis.hbm.SUBJECTS: canonical batch sizes lenet=64,
+    resnet_block=32 — the bytes the tier-1 ceilings gate)."""
+    from deeplearning4j_tpu.analysis.hbm import build_subject
+
+    if batch_size is None:
+        batch_size = {"lenet": 64, "resnet_block": 32}.get(subject, 32)
+    net, x_shape, _slots = build_subject(subject, batch_size=batch_size)
+    return autotune(net, x_shape, **kw)
+
+
+def warm_start(net, store_=None):
+    """Look up the persisted winners for (ambient, net) and INSTALL
+    them; returns the installed {name: value} or None when no record
+    exists. The precompile()/serving warm-start hook: zero sweeps,
+    zero compiles, just the tuned point.
+
+    Knobs are PROCESS-GLOBAL (they are module globals read at trace
+    time), so in a process hosting several networks the last
+    warm-started network's winners govern every later lowering —
+    last-writer-wins, and a network whose record disagrees silently
+    loses its tuned point. Multi-model processes should either share
+    one tuned config (tune the flagship, install once) or scope
+    processes per model; see docs/AUTOTUNE.md."""
+    st = store_ if store_ is not None else store()
+    rec = st.get(tuning_key(net))
+    if rec is None:
+        return None
+    install(rec["knobs"])
+    return dict(rec["knobs"])

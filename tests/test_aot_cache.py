@@ -155,6 +155,26 @@ class TestKeys:
             _losses._TAIL_MODE = old
         assert k_compute != k_wide
 
+    def test_every_autotune_knob_separates_keys(self, fresh_cache):
+        """ISSUE 12 small-fix regression gate: the cache key must
+        incorporate the autotune arbiter's chosen knob values — a tuned
+        run and a stock run must NEVER share an executable. Flipping
+        EACH registered knob off its current value must change the key
+        (companion of TestKeys tail-mode / TestTrainerPrecompile
+        sharded-vs-replicated separations)."""
+        from deeplearning4j_tpu.runtime import autotune as at
+
+        net = _mln()
+        base_key = net.precompile(batchSize=8)["train_step"]["key"]
+        for knob in at.KNOBS:
+            alt = next(c for c in knob.candidates if c != knob.get())
+            with at.applied({knob.name: alt}):
+                k = _mln().precompile(batchSize=8)["train_step"]["key"]
+            assert k != base_key, (
+                f"knob {knob.name}={alt} produced the SAME cache key "
+                "as the stock config — tuned and stock runs would "
+                "share an executable")
+
     def test_batch_signature_change_misses(self, fresh_cache):
         k8 = _mln().precompile(batchSize=8)["train_step"]["key"]
         k16 = _mln().precompile(batchSize=16)["train_step"]["key"]

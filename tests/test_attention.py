@@ -341,6 +341,102 @@ class TestFlashKernel:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-4, atol=2e-4)
 
+    # -- round 12: the hand-written flash backward kernels ------------
+
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("Tq,Tk,bq,bk", [
+        (64, 64, 16, 16),   # aligned grid
+        (70, 70, 32, 32),   # padded grid (T % block != 0)
+        (24, 56, 16, 16),   # cross-attention lengths
+        (33, 17, 16, 8),    # ragged both sides, mixed blocks
+    ])
+    def test_bwd_kernels_match_fused_reference(self, interpret, causal,
+                                               Tq, Tk, bq, bk):
+        """The default backward is now the pallas dq/dkv kernel pair
+        (DL4J_TPU_FLASH_BWD=kernel): gradients vs autodiff through the
+        fused reference, including causal masking across padded and
+        cross-length grids (rows whose valid-key set the kernels must
+        rebuild from the saved logsumexp)."""
+        from deeplearning4j_tpu.ops import pallas_attention as pa
+        from deeplearning4j_tpu.ops.attention import dot_product_attention
+
+        assert pa._BWD_IMPL == "kernel"  # the shipped default
+        q, k, v = self._qkv(Tq=Tq, Tk=Tk, D=8, seed=3)
+
+        def f_flash(q, k, v):
+            return jnp.sum(interpret.flash_attention(
+                q, k, v, causal=causal, block_q=bq, block_k=bk) ** 2)
+
+        def f_ref(q, k, v):
+            return jnp.sum(
+                dot_product_attention(q, k, v, causal=causal) ** 2)
+
+        gf = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, nm in zip(gf, gr, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=3e-4, atol=3e-4,
+                err_msg=f"d{nm} Tq={Tq} Tk={Tk} causal={causal}")
+
+    def test_bwd_kernel_vs_recompute_knob(self, interpret):
+        """The two backward strategies must agree with each other (both
+        are exact-math flash backwards; only HBM traffic differs) and
+        the knob must restore cleanly."""
+        from deeplearning4j_tpu.ops import pallas_attention as pa
+
+        q, k, v = self._qkv(Tq=48, Tk=48, D=8, seed=5)
+
+        def g(qq, kk, vv):
+            return jax.grad(lambda a, b, c: jnp.sum(
+                interpret.flash_attention(
+                    a, b, c, causal=True, block_q=16,
+                    block_k=16) ** 2), argnums=(0, 1, 2))(qq, kk, vv)
+
+        g_kernel = g(q, k, v)
+        old = pa.set_flash_bwd("recompute")
+        try:
+            g_rec = g(q, k, v)
+        finally:
+            pa.set_flash_bwd(old)
+        for a, b in zip(g_kernel, g_rec):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_bwd_kernel_bf16_dtypes(self, interpret):
+        """bf16 q/k/v produce bf16 gradients (fp32 accumulators cast
+        at the kernel edge) within bf16 tolerance of the fp32 oracle."""
+        from deeplearning4j_tpu.ops.attention import dot_product_attention
+
+        q32, k32, v32 = self._qkv(Tq=32, Tk=32, D=8, seed=6)
+        q, k, v = (a.astype(jnp.bfloat16) for a in (q32, k32, v32))
+        gf = jax.grad(lambda a, b, c: jnp.sum(
+            interpret.flash_attention(
+                a, b, c, block_q=16,
+                block_k=16).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda a, b, c: jnp.sum(
+            dot_product_attention(a, b, c) ** 2),
+            argnums=(0, 1, 2))(q32, k32, v32)
+        for a, b in zip(gf, gr):
+            assert a.dtype == jnp.bfloat16
+            np.testing.assert_allclose(np.asarray(a, dtype=np.float32),
+                                       np.asarray(b), rtol=0.1,
+                                       atol=0.1)
+
+    def test_fwd_lse_matches_reference_logsumexp(self, interpret):
+        """The logsumexp the backward kernels consume must be the true
+        softmax normalizer (checked against a direct computation)."""
+        q, k, v = self._qkv(Tq=32, Tk=32, D=8, seed=7)
+        _out, lse = interpret._flash_fwd_impl(q, k, v, False, 16, 16)
+        B, H, T, D = q.shape
+        s = np.einsum("bhqd,bhkd->bhqk", np.asarray(q), np.asarray(k))
+        s = s / np.sqrt(D)
+        ref = np.log(np.sum(np.exp(
+            s - s.max(-1, keepdims=True)), -1)) + s.max(-1)
+        np.testing.assert_allclose(
+            np.asarray(lse).reshape(B * H, T),
+            ref.reshape(B * H, T), rtol=1e-5, atol=1e-5)
+
     def test_mha_routes_through_kernel(self, interpret, monkeypatch):
         """multi_head_attention and the layer-side _mha_apply must reach
         the pallas kernel (not silently fall back) when it is available."""

@@ -191,12 +191,16 @@ class TestTunnelProbe:
             self, monkeypatch, capsys):
         monkeypatch.setattr(bench, "bench_grad_sharing_virtual",
                             lambda budget: {"cpu_only": True})
+        monkeypatch.setattr(bench, "bench_autotune",
+                            lambda t: {"cpu_pinned": True})
         monkeypatch.setattr(bench, "_CONFIGS", {})
         bench._emit_tunnel_dead("jax.devices() hung > 60s")
         for name, _ in bench.SECONDARY_CONFIGS:
             assert bench._CONFIGS[name] == {"error": "tunnel_dead"}
         # the CPU-only virtual-mesh config never touches the chip: banked
         assert bench._CONFIGS["grad_sharing"] == {"cpu_only": True}
+        # round 12: the CPU-pinned autotune sweep banks on a dead tunnel
+        assert bench._CONFIGS["autotune"] == {"cpu_pinned": True}
         line = json.loads(capsys.readouterr().out.splitlines()[-1])
         assert "tunnel_dead" in line["error"]
         assert line["configs"]["fit_dataset"] == {"error": "tunnel_dead"}
