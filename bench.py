@@ -1531,6 +1531,9 @@ def bench_serving():
         rec["amortization"] = open_loop_vs_serial(
             host, "mlp", pi_serial, mlp_row, n_requests, max_clients=24)
         rec["amortization"]["mesh_devices"] = n_mesh
+        # the serving window's own telemetry view (queue/occupancy/
+        # latency instruments this leg just exercised) rides the record
+        rec["metrics_snapshot"] = host.metrics_snapshot()
         host.close()
     finally:
         aot._SESSION, aot._SESSION_INIT = prev_cache, prev_init
@@ -2040,12 +2043,29 @@ def main():
             configs.get("autotune", {}).get("lenet", {})),
         "resnet50": headline,
         "configs": configs,
+        # the driver process's own telemetry registry (ISSUE 13):
+        # host-only read, so it is tunnel_dead-safe by construction —
+        # the per-leg registries live in each subprocess's record
+        # (configs.serving.metrics_snapshot carries the serving window)
+        "metrics_snapshot": _metrics_snapshot_safe(),
     }
     if SMOKE:  # watermark loudly: tiny-shape CPU rehearsal, not a result
         line.update(value=0.0, vs_baseline=0.0,
                     smoke="DL4J_BENCH_SMOKE tiny-shape CPU rehearsal — "
                           "plumbing check only, NOT a measurement")
     print(json.dumps(line))
+
+
+def _metrics_snapshot_safe():
+    """This process's telemetry registry snapshot, or an error marker —
+    never an exception: the headline record must bank even when the
+    observability layer is the thing that is broken."""
+    try:
+        from deeplearning4j_tpu.runtime import telemetry
+
+        return telemetry.get_registry().snapshot()
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
 
 
 def _error_line(msg):
@@ -2063,6 +2083,8 @@ def _error_line(msg):
         rec["last_live_note"] = LAST_LIVE_POINTER
     if _CONFIGS:  # every secondary that finished before the failure
         rec["configs"] = _CONFIGS
+    # host-only read: banked even on a dead tunnel (ISSUE 13)
+    rec["metrics_snapshot"] = _metrics_snapshot_safe()
     print(json.dumps(rec), flush=True)
 
 

@@ -95,6 +95,14 @@ class RetraceSentinel:
     def _record(self, label):
         n = self.counts.get(label, 0) + 1
         self.counts[label] = n
+        # compile events are an ops signal too: mirror into the process
+        # registry (host-side state at trace time — no device op)
+        from deeplearning4j_tpu.runtime import telemetry
+
+        telemetry.get_registry().counter(
+            "dl4j_retrace_compiles_total",
+            "traces counted by RetraceSentinel-wrapped functions",
+            labels=("fn",)).labels(fn=label).inc()
         if n > self.max_compiles:
             raise RetraceError(
                 f"'{label}' is being traced for the {n}th time (budget "

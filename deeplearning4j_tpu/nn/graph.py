@@ -571,11 +571,20 @@ class ComputationGraph:
             self._fit_tbptt(inputs, labels, fmasks, lmasks)
             return
         key = jax.random.fold_in(jax.random.key(self.conf.seed ^ 0x5EED), self._iteration)
+        from deeplearning4j_tpu.nn.multilayer import _tm
+
+        tm = _tm()
+        t0 = tm["reg"].clock()
         self._params, self._upd_states, self._states, loss = self._jit_train(
             self._params, self._upd_states, self._states,
             jnp.asarray(self._iteration, jnp.int32), inputs, labels, key,
             fmasks, lmasks)
         self._score = float(loss)
+        dt = tm["reg"].clock() - t0
+        tm["step_s"].observe(dt)
+        tm["steps"].inc()
+        tm["reg"].trace.add("train.step", "train", t0, dt,
+                            {"iteration": self._iteration})
         self._iteration += 1
         for lst in self._listeners:
             lst.iterationDone(self, self._iteration, self._epoch)

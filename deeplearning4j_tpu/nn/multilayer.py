@@ -39,6 +39,45 @@ def _unwrap(x):
     return jnp.asarray(x)
 
 
+_TM = None
+
+
+def _tm():
+    """Lazily-resolved training telemetry handles (runtime.telemetry).
+    The registry's identity is process-stable, so the handles are
+    resolved once and the per-step cost is one histogram observe + one
+    ring append — host-side, between dispatches, never inside a traced
+    function (zero added device syncs / compiles; CI-gated)."""
+    global _TM
+    if _TM is None:
+        from deeplearning4j_tpu.runtime import telemetry
+
+        reg = telemetry.get_registry()
+        _TM = {
+            "reg": reg,
+            "step_s": reg.histogram(
+                "dl4j_train_step_seconds",
+                "train-step wall: dispatch + loss fetch, host-observed "
+                "at the jit boundary"),
+            "steps": reg.counter(
+                "dl4j_train_steps_total", "optimizer steps applied"),
+            "staging_s": reg.histogram(
+                "dl4j_fit_dataset_staging_seconds",
+                "fitDataSet k-block host stack + device placement"),
+            "sync_wait_s": reg.histogram(
+                "dl4j_fit_dataset_sync_wait_seconds",
+                "fitDataSet block on the in-flight k-block's losses "
+                "(the one host sync per block)"),
+            "data_wait_s": reg.histogram(
+                "dl4j_fit_dataset_data_wait_seconds",
+                "fitDataSet wait on the data iterator per k-stack"),
+            "syncs": reg.counter(
+                "dl4j_fit_dataset_syncs_total",
+                "fitDataSet host syncs (one per k-block)"),
+        }
+    return _TM
+
+
 def checkpointed_forward(layer, l_train):
     """layer.forward wrapped in jax.checkpoint (activation remat); layer
     and the static train flag ride as closures, array args (params,
@@ -427,10 +466,24 @@ def run_staged_blocks(iterator, k, dispatch, consume):
     the k-loop, which therefore never retraces on a ragged shape."""
     from deeplearning4j_tpu.data.iterators import iter_stacks
 
+    tm = _tm()
     pending = None     # (losses device array) of the in-flight block
     tail = []
+    stacks = iter_stacks(iterator, k)
+    _end = object()
     try:
-        for batches in iter_stacks(iterator, k):
+        while True:
+            # data-wait vs staging split (docs/OBSERVABILITY.md): this
+            # is the iterator's share of the block cadence — a slow
+            # data source shows up HERE, not as a slow-looking step
+            t0 = tm["reg"].clock()
+            batches = next(stacks, _end)
+            dt = tm["reg"].clock() - t0
+            tm["data_wait_s"].observe(dt)
+            tm["reg"].trace.add("fit_dataset.data_wait", "train", t0, dt,
+                                {"k": k})
+            if batches is _end:
+                break
             if len(batches) < k:
                 tail = batches
                 break
@@ -466,11 +519,22 @@ def run_fit_dataset_epoch(net, iterator, k, stack_fn, fit_one, jloop,
     ordinary per-batch sync for each of its batches."""
     syncs = 0
     it_next = net._iteration   # dispatch-side iteration cursor
+    tm = _tm()
 
     def consume(losses):
         nonlocal syncs
         syncs += 1
+        t0 = tm["reg"].clock()
         vals = np.asarray(losses)   # THE host sync for this block
+        dt = tm["reg"].clock() - t0
+        tm["sync_wait_s"].observe(dt)
+        tm["syncs"].inc()
+        # the k on-device steps count here (per-step WALL is only
+        # observable at a jit boundary, so the step histogram stays
+        # per-dispatch — the block's wall is staging + sync_wait)
+        tm["steps"].inc(len(vals))
+        tm["reg"].trace.add("fit_dataset.sync_wait", "train", t0, dt,
+                            {"k": k, "iteration": net._iteration})
         for v in vals:
             net._score = float(v)
             net._iteration += 1
@@ -482,13 +546,22 @@ def run_fit_dataset_epoch(net, iterator, k, stack_fn, fit_one, jloop,
 
     def dispatch(batches):
         nonlocal it_next
+        t0 = tm["reg"].clock()
         staged = stack_fn(batches)
         staged = jax.device_put(staged) if place is None \
             else place(staged)
+        dt = tm["reg"].clock() - t0
+        tm["staging_s"].observe(dt)
+        tm["reg"].trace.add("fit_dataset.staging", "train", t0, dt,
+                            {"k": k, "iteration": it_next})
         xs, ys, fms, lms = staged
+        t1 = tm["reg"].clock()
         net._params, net._upd_states, net._states, losses = jloop(
             net._params, net._upd_states, net._states,
             jnp.asarray(it_next, jnp.int32), xs, ys, fms, lms)
+        tm["reg"].trace.add("fit_dataset.dispatch", "train", t1,
+                            tm["reg"].clock() - t1,
+                            {"k": k, "iteration": it_next})
         it_next += k
         return losses
 
@@ -1042,10 +1115,17 @@ class MultiLayerNetwork:
             self._fit_tbptt(x, y, fmask, lmask)
             return
         key = jax.random.fold_in(jax.random.key(self.conf.seed ^ 0x5EED), self._iteration)
+        tm = _tm()
+        t0 = tm["reg"].clock()
         self._params, self._upd_states, self._states, loss = self._jit_train(
             self._params, self._upd_states, self._states,
             jnp.asarray(self._iteration, jnp.int32), x, y, key, fmask, lmask)
         self._score = float(loss)
+        dt = tm["reg"].clock() - t0
+        tm["step_s"].observe(dt)
+        tm["steps"].inc()
+        tm["reg"].trace.add("train.step", "train", t0, dt,
+                            {"iteration": self._iteration})
         self._iteration += 1
         for lst in self._listeners:
             lst.iterationDone(self, self._iteration, self._epoch)

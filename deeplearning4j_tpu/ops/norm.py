@@ -179,7 +179,7 @@ _LEAKY_ALPHA = None
 
 
 def _leaky_alpha():
-    global _LEAKY_ALPHA
+    global _LEAKY_ALPHA  # purity-ok[PUR04]: deterministic memo of a module constant — same float every process, trace-time write is benign
     if _LEAKY_ALPHA is None:
         _LEAKY_ALPHA = float(-_registry_act("leakyrelu")(-1.0))
     return _LEAKY_ALPHA

@@ -291,6 +291,77 @@ class StatsListener(TrainingListener):
                 f"→ {last[1]:.6f} @ iter {last[0]}")
 
 
+class MetricsListener(TrainingListener):
+    """Bridge the TrainingListener event stream into the process-wide
+    metrics registry (runtime.telemetry, docs/OBSERVABILITY.md): the
+    scrape-able twin of ScoreIterationListener/ResilienceListener.
+
+    Instruments (all under the registry the InferenceServer's
+    /metrics endpoint exposes):
+
+    * ``dl4j_train_iterations_total``        — iterationDone count
+    * ``dl4j_train_score``                   — last host-visible score
+      (read from the model's already-fetched loss: NO device sync)
+    * ``dl4j_train_epochs_total``            — onEpochEnd count
+    * ``dl4j_train_sync_boundaries_total``   — fitDataSet k-blocks
+    * ``dl4j_train_steps_skipped_total``     — non-finite skipped steps
+    * ``dl4j_checkpoints_saved_total`` / ``dl4j_checkpoints_restored_total``
+
+    Counting stays OFF the hot path: every hook fires from host-side
+    loop code that already holds the fetched loss. Attach once per
+    process per training run; counters are cumulative process-wide.
+    """
+
+    def __init__(self, registry=None):
+        from deeplearning4j_tpu.runtime import telemetry
+
+        reg = registry if registry is not None \
+            else telemetry.get_registry()
+        self.registry = reg
+        self._iters = reg.counter(
+            "dl4j_train_iterations_total",
+            "training iterations seen by the listener chain")
+        self._score = reg.gauge(
+            "dl4j_train_score",
+            "last host-visible training score (loss)")
+        self._epochs = reg.counter(
+            "dl4j_train_epochs_total", "training epochs completed")
+        self._syncs = reg.counter(
+            "dl4j_train_sync_boundaries_total",
+            "fitDataSet k-block sync boundaries")
+        self._skips = reg.counter(
+            "dl4j_train_steps_skipped_total",
+            "steps skipped by the non-finite guard")
+        self._saves = reg.counter(
+            "dl4j_checkpoints_saved_total", "checkpoints written")
+        self._restores = reg.counter(
+            "dl4j_checkpoints_restored_total",
+            "checkpoints restored (preemption recovery)")
+
+    def iterationDone(self, model, iteration, epoch):
+        self._iters.inc()
+        # _score is the loop's already-fetched host float — reading it
+        # costs nothing; model.score() on these models returns it as-is
+        s = getattr(model, "_score", None)
+        if s is not None:
+            self._score.set(float(s))
+
+    def onEpochEnd(self, model):
+        self._epochs.inc()
+
+    def onSyncBoundary(self, model, iteration, scores):
+        self._syncs.inc()
+
+    def onStepSkipped(self, model, iteration, epoch, loss):
+        self._skips.inc()
+
+    def onCheckpointSaved(self, model, path, iteration):
+        self._saves.inc()
+
+    def onCheckpointRestored(self, model, path, iteration):
+        self._restores.inc()
+
+
 class ResilienceListener(TrainingListener):
     """Collects the resilience event stream (skipped steps, checkpoint
     saves, restores) in memory — the assertion surface for the fault

@@ -69,7 +69,7 @@ class ParallelInference:
 
     def __init__(self, model, mesh=None, batchLimit=0, batchBuckets=None,
                  inferenceMode="INPLACE", queueLimit=64, maxWaitMs=2.0,
-                 int8=False, clock=None):
+                 int8=False, clock=None, metricsName=None):
         model._require_init()
         mode = str(inferenceMode).upper()
         if mode not in INFERENCE_MODES:
@@ -88,6 +88,9 @@ class ParallelInference:
         self.queueLimit = int(queueLimit)
         self.maxWaitMs = float(maxWaitMs)
         self._clock = clock
+        # the `model` label on the BATCHED queue's telemetry instruments
+        # (serving.host passes "name:vN"; None = per-instance default)
+        self.metricsName = metricsName
         self._batcher = None
         self._batcher_lock = threading.Lock()
         self._closed = False
@@ -343,7 +346,8 @@ class ParallelInference:
                 # request-path compile
                 feature_dtype=np.float32,
                 clock=self._clock,
-                start_thread=self._clock is None)
+                start_thread=self._clock is None,
+                name=self.metricsName)
         return self._batcher
 
     def close(self, drain=True):

@@ -476,6 +476,17 @@ def compressed_wire_bytes(grad_bytes, dp, compression=None, block=None,
         from deeplearning4j_tpu.ndarray.compression import threshold_cap
 
         wire = (dp - 1) * threshold_cap(N, capacity) * 5
+    # publish the static bill as gauges: a scrape of /metrics shows the
+    # per-replica bytes-on-wire the current config is billed for
+    # (host-side analytic math — never inside a traced function)
+    from deeplearning4j_tpu.runtime import telemetry
+
+    _g = telemetry.get_registry().gauge(
+        "dl4j_compressed_wire_bytes",
+        "analytic per-replica gradient bytes-on-wire per step",
+        labels=("mode",))
+    _g.labels(mode=compression or "dense").set(int(wire))
+    _g.labels(mode="dense").set(int(dense))
     return {
         "wire_bytes": int(wire),
         "dense_wire_bytes": int(dense),

@@ -89,6 +89,53 @@ def _package_version():
     return __version__
 
 
+_TM = None
+
+
+def _tm():
+    """Lazily-resolved AOT telemetry handles (runtime.telemetry): the
+    compile-vs-load split as registry instruments + trace spans, on top
+    of the per-cache ``stats``/``seconds`` dicts the CLI reports."""
+    global _TM
+    if _TM is None:
+        from deeplearning4j_tpu.runtime import telemetry
+
+        reg = telemetry.get_registry()
+        _TM = {
+            "reg": reg,
+            "hits_mem": reg.counter(
+                "dl4j_aot_cache_hits_total",
+                "executable-cache hits by tier",
+                labels=("tier",)).labels(tier="memory"),
+            "hits_disk": reg.counter(
+                "dl4j_aot_cache_hits_total",
+                "executable-cache hits by tier",
+                labels=("tier",)).labels(tier="disk"),
+            "misses": reg.counter(
+                "dl4j_aot_cache_misses_total",
+                "executable-cache misses (XLA compiles paid)"),
+            "compile_s": reg.histogram(
+                "dl4j_aot_compile_seconds",
+                "XLA compile wall on a cache miss"),
+            "load_s": reg.histogram(
+                "dl4j_aot_load_seconds",
+                "disk-tier deserialize wall on a disk hit"),
+        }
+    return _TM
+
+
+def _tm_compile(t0, key=None, entry=None):
+    """Record one cache-miss compile that started at perf_counter t0."""
+    tm = _tm()
+    dt = time.perf_counter() - t0
+    tm["misses"].inc()
+    tm["compile_s"].observe(dt)
+    tm["reg"].trace.add(
+        "aot.compile", "compile", t0, dt,
+        {"key": (key or "")[:16], "entry": entry or ""})
+    return dt
+
+
 # ----------------------------------------------------------------------
 # fingerprints: everything that shapes the traced program
 # ----------------------------------------------------------------------
@@ -300,6 +347,7 @@ class ExecutableCache:
         hit = self._mem.get(key)
         if hit is not None:
             self.stats["mem_hits"] += 1
+            _tm()["hits_mem"].inc()
             return hit
         if self.directory is None:
             return None
@@ -327,8 +375,14 @@ class ExecutableCache:
             self.stats["corrupt"] += 1
             self._remove(path)
             return None
-        self.seconds[key] = time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        self.seconds[key] = dt
         self.stats["disk_hits"] += 1
+        tm = _tm()
+        tm["hits_disk"].inc()
+        tm["load_s"].observe(dt)
+        tm["reg"].trace.add("aot.deserialize", "compile", t0, dt,
+                            {"key": key[:16]})
         self._mem[key] = compiled
         return compiled
 
@@ -493,7 +547,7 @@ def compile_lowered(lowered, key=None, cache=None, entry=None,
             cache.stats["misses"] += 1
             t0 = time.perf_counter()
             compiled = lowered.compile()
-            cache.seconds[key] = time.perf_counter() - t0
+            cache.seconds[key] = _tm_compile(t0, key, entry)
             cache.put(key, compiled, entry=entry)
     if donate_argnums:
         return _AotCall(compiled, donate_argnums)
@@ -610,7 +664,7 @@ class CachedJit:
                 cache.stats["misses"] += 1
                 t0 = time.perf_counter()
                 compiled = self._bare.lower(*args).compile()
-                cache.seconds[key] = time.perf_counter() - t0
+                cache.seconds[key] = _tm_compile(t0, key, self._entry)
                 cache.put(key, compiled, entry=self._entry)
             ent = (_AotCall(compiled, self._donate), key)
             self._table[sig] = ent

@@ -41,6 +41,35 @@ import numpy as np
 
 from deeplearning4j_tpu.util import sharded_checkpoint as _ckpt
 
+_TM = None
+
+
+def _tm():
+    """Lazily-resolved resilience telemetry handles (runtime.telemetry;
+    see docs/OBSERVABILITY.md). Event COUNTS (skips, saves, restores)
+    are the MetricsListener's job — the direct wiring here carries only
+    what the listener chain cannot see: retry fire counts and
+    checkpoint I/O durations."""
+    global _TM
+    if _TM is None:
+        from deeplearning4j_tpu.runtime import telemetry
+
+        reg = telemetry.get_registry()
+        _TM = {
+            "reg": reg,
+            "retries": reg.counter(
+                "dl4j_retries_total",
+                "transient failures retried with backoff (data fetch, "
+                "checkpoint I/O)"),
+            "ckpt_save_s": reg.histogram(
+                "dl4j_checkpoint_save_seconds",
+                "atomic checkpoint write wall (ResilientFit._save)"),
+            "ckpt_restore_s": reg.histogram(
+                "dl4j_checkpoint_restore_seconds",
+                "checkpoint restore wall (resume-after-preemption)"),
+        }
+    return _TM
+
 
 # ----------------------------------------------------------------------
 # retry with capped exponential backoff + deterministic jitter
@@ -99,6 +128,7 @@ def retry(fn, policy: RetryPolicy = None, on_retry=None):
             if attempt > policy.maxRetries:
                 raise
             d = policy.delay(attempt, rng)
+            _tm()["retries"].inc()
             if on_retry is not None:
                 on_retry(attempt, e, d)
             policy.sleep(d)
@@ -374,6 +404,8 @@ class ResilientFit:
             ShardedModelSerializer
 
         net = self.net
+        tm = _tm()
+        t0 = tm["reg"].clock()
         path = _ckpt.step_path(self.checkpointDir, net._iteration)
         # trainer-owned step state (threshold compression's error-
         # feedback residual + live tau) rides the checkpoint as its own
@@ -390,6 +422,10 @@ class ResilientFit:
             trainer_state=trainer_state),
             self.retryPolicy)
         _ckpt.gc_checkpoints(self.checkpointDir, self.keepLast)
+        dt = tm["reg"].clock() - t0
+        tm["ckpt_save_s"].observe(dt)
+        tm["reg"].trace.add("resilience.checkpoint_save", "resilience",
+                            t0, dt, {"iteration": net._iteration})
         self._fire("onCheckpointSaved", path, net._iteration)
 
     def _maybe_resume(self) -> int:
@@ -405,6 +441,8 @@ class ResilientFit:
             ShardedModelSerializer
 
         path = _ckpt.step_path(self.checkpointDir, step)
+        tm = _tm()
+        t0 = tm["reg"].clock()
         restored = retry(lambda: ShardedModelSerializer.restore(path),
                          self.retryPolicy)
         net = self.net
@@ -436,6 +474,11 @@ class ResilientFit:
                                                             abstract),
                         self.retryPolicy)
                     self.wrapper._restore_trainer_state(ts)
+        dt = tm["reg"].clock() - t0
+        tm["ckpt_restore_s"].observe(dt)
+        tm["reg"].trace.add("resilience.checkpoint_restore",
+                            "resilience", t0, dt,
+                            {"iteration": net._iteration})
         self._fire("onCheckpointRestored", path, net._iteration)
         return int(extra.get("batch_in_epoch", 0))
 
@@ -549,10 +592,19 @@ class ResilientFit:
         # seed, which is what makes the trajectories bitwise-identical
         key = jax.random.fold_in(
             jax.random.key(net.conf.seed ^ 0x5EED), net._iteration)
+        from deeplearning4j_tpu.nn.multilayer import _tm as _train_tm
+
+        tm = _train_tm()
+        t0 = tm["reg"].clock()
         net._params, net._upd_states, net._states, loss, ok = self._jit(
             net._params, net._upd_states, net._states,
             jnp.asarray(net._iteration, jnp.int32), x, y, key, fmask, lmask)
-        self._account_step(loss, bool(ok))
+        ok = bool(ok)   # the guarded step's host sync
+        dt = tm["reg"].clock() - t0
+        tm["step_s"].observe(dt)
+        tm["reg"].trace.add("train.step", "train", t0, dt,
+                            {"iteration": net._iteration, "ok": ok})
+        self._account_step(loss, ok)
 
     def _account_step(self, loss, ok):
         """Per-step guard accounting, shared by the k=1 path and the
@@ -563,6 +615,11 @@ class ResilientFit:
         net = self.net
         net._score = float(loss)
         net._iteration += 1
+        # counted HERE so the k=1 path and the k-vector block replay
+        # bill dl4j_train_steps_total identically
+        from deeplearning4j_tpu.nn.multilayer import _tm as _train_tm
+
+        _train_tm()["steps"].inc()
         if ok:
             self._bad = 0
         else:

@@ -41,7 +41,8 @@ class ServedModel:
         self.pi = ParallelInference(
             network, mesh=mesh, batchBuckets=batchBuckets,
             inferenceMode="BATCHED", queueLimit=queueLimit,
-            maxWaitMs=maxWaitMs, int8=int8, clock=clock)
+            maxWaitMs=maxWaitMs, int8=int8, clock=clock,
+            metricsName=f"{self.name}:v{self.version}")
 
     @property
     def batcher(self):
@@ -194,6 +195,39 @@ class ModelHost:
         with self._lock:
             models = list(self._models.values())
         return {sm.name: sm.policy() for sm in models}
+
+    def metrics_snapshot(self):
+        """One JSON-safe observability snapshot: the process-wide
+        registry (training + serving + AOT instruments, the same data
+        /metrics exposes) plus a per-served-model serving view (queue
+        stats, depth, occupancy). The programmatic twin of
+        ``GET /metrics`` (docs/OBSERVABILITY.md)."""
+        from deeplearning4j_tpu.runtime import telemetry
+
+        with self._lock:
+            models = list(self._models.values())
+        per_model = {}
+        for sm in models:
+            # a snapshot is a READ: never build the lazy batcher (that
+            # would spawn its scheduler thread, or raise on a closed
+            # instance racing a swap) — an idle model reports as such
+            b = sm.pi._batcher
+            if b is None:
+                per_model[sm.name] = {"version": sm.version,
+                                      "stats": None, "queue_depth": 0,
+                                      "occupancy": {"dispatches": 0,
+                                                    "mean_occupancy":
+                                                        None,
+                                                    "histogram": {}}}
+                continue
+            per_model[sm.name] = {
+                "version": sm.version,
+                "stats": dict(b.stats),
+                "queue_depth": b.depth,
+                "occupancy": b.occupancy_summary(),
+            }
+        return {"registry": telemetry.get_registry().snapshot(),
+                "models": per_model}
 
     def warm_all(self):
         """(Re)warm every registered model — the HTTP tier's /healthz

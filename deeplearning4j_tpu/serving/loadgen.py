@@ -34,10 +34,14 @@ def arrival_offsets(rate, n, seed=0):
 
 
 def percentile(values, q):
-    """Linear-interpolated percentile (q in [0, 100]) of a sequence."""
-    if len(values) == 0:
-        return None
-    return float(np.percentile(np.asarray(values, np.float64), q))
+    """Linear-interpolated percentile (q in [0, 100]) of a sequence.
+    Delegates to the ONE shared implementation the telemetry
+    histograms read out through (runtime.telemetry.percentile — same
+    'linear' method numpy defaults to; oracle-gated in
+    tests/test_telemetry.py)."""
+    from deeplearning4j_tpu.runtime.telemetry import percentile as _p
+
+    return _p(values, q)
 
 
 def summarize(latencies_s, duration_s, errors=None, scheduled=None):

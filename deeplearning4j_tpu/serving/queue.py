@@ -34,16 +34,28 @@ executable cache.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from collections import deque
 
 import numpy as np
 
+from deeplearning4j_tpu.runtime import telemetry
+
 __all__ = [
     "QueueFullError", "DeadlineExceededError", "ServingClosedError",
     "InferenceRequest", "MicroBatcher", "ManualClock",
 ]
+
+#: unique default metric label for anonymous batchers (each instance is
+#: its own time series so per-instance stats read through cleanly)
+_BATCHER_SEQ = itertools.count(1)
+
+#: the stats keys the deprecated dict view carries (and the per-model
+#: counter instruments behind them)
+_STAT_KEYS = ("requests", "rows", "dispatches", "dispatched_rows",
+              "coalesced", "expired", "rejected", "errors")
 
 
 class QueueFullError(RuntimeError):
@@ -136,11 +148,16 @@ class MicroBatcher:
     start_thread: run the background scheduler thread. False = the
                   owner drives `poll()`/`flush()` explicitly
                   (deterministic tests).
+    name:         the `model` label on this batcher's registry
+                  instruments (serving host passes "model:vN"); default
+                  a unique per-instance label so anonymous batchers
+                  never share series.
     """
 
     def __init__(self, dispatch, *, max_rows, queue_limit=64,
                  max_wait=0.002, bucket_for=None, trailing_shape=None,
-                 feature_dtype=None, clock=None, start_thread=True):
+                 feature_dtype=None, clock=None, start_thread=True,
+                 name=None):
         if int(queue_limit) < 1:
             raise ValueError(f"queue_limit must be >= 1, got {queue_limit}")
         if int(max_rows) < 1:
@@ -157,9 +174,60 @@ class MicroBatcher:
         self._cond = threading.Condition()
         self._pending = deque()
         self._closed = False
-        self.stats = {"requests": 0, "rows": 0, "dispatches": 0,
-                      "dispatched_rows": 0, "coalesced": 0,
-                      "expired": 0, "rejected": 0, "errors": 0}
+        self.name = str(name) if name else f"batcher{next(_BATCHER_SEQ)}"
+        # per-instance registry instruments (counters/gauge/histograms
+        # labeled model=<name>); the legacy `stats` dict survives as a
+        # read-through property over the counter children
+        reg = telemetry.get_registry()
+        lab = {"model": self.name}
+        self._registry = reg
+        self._m = {
+            "requests": reg.counter(
+                "dl4j_serving_requests_total",
+                "requests accepted into the serving queue",
+                labels=("model",)).labels(**lab),
+            "rows": reg.counter(
+                "dl4j_serving_rows_total",
+                "feature rows accepted into the serving queue",
+                labels=("model",)).labels(**lab),
+            "dispatches": reg.counter(
+                "dl4j_serving_dispatches_total",
+                "coalesced micro-batch dispatches",
+                labels=("model",)).labels(**lab),
+            "dispatched_rows": reg.counter(
+                "dl4j_serving_dispatched_rows_total",
+                "rows carried by dispatched micro-batches",
+                labels=("model",)).labels(**lab),
+            "coalesced": reg.counter(
+                "dl4j_serving_coalesced_total",
+                "requests coalesced into dispatched micro-batches",
+                labels=("model",)).labels(**lab),
+            "expired": reg.counter(
+                "dl4j_serving_expired_total",
+                "requests whose deadline passed before dispatch (504)",
+                labels=("model",)).labels(**lab),
+            "rejected": reg.counter(
+                "dl4j_serving_rejected_total",
+                "requests rejected on a full queue (429)",
+                labels=("model",)).labels(**lab),
+            "errors": reg.counter(
+                "dl4j_serving_errors_total",
+                "requests failed by a dispatch error",
+                labels=("model",)).labels(**lab),
+            "depth": reg.gauge(
+                "dl4j_serving_queue_depth",
+                "requests currently waiting in the serving queue",
+                labels=("model",)).labels(**lab),
+            "wait": reg.histogram(
+                "dl4j_serving_queue_wait_seconds",
+                "enqueue-to-dispatch wait per request",
+                labels=("model",)).labels(**lab),
+            "occupancy": reg.histogram(
+                "dl4j_serving_batch_occupancy",
+                "rows/bucket fill fraction per dispatch",
+                labels=("model",),
+                buckets=(0.25, 0.5, 0.75, 1.0)).labels(**lab),
+        }
         #: (rows, bucket) per dispatch — the occupancy record the
         #: serving bench histograms
         self.occupancy = []
@@ -195,14 +263,15 @@ class MicroBatcher:
             if self._closed:
                 raise ServingClosedError("batcher is closed")
             if len(self._pending) >= self.queue_limit:
-                self.stats["rejected"] += 1
+                self._m["rejected"].inc()
                 raise QueueFullError(
                     f"request queue full ({len(self._pending)} waiting, "
                     f"queueLimit={self.queue_limit})")
             req = InferenceRequest(features, self.clock(), deadline)
             self._pending.append(req)
-            self.stats["requests"] += 1
-            self.stats["rows"] += req.rows
+            self._m["requests"].inc()
+            self._m["rows"].inc(req.rows)
+            self._m["depth"].set(len(self._pending))
             self._cond.notify()
         if wait:
             return req.wait(timeout)
@@ -218,13 +287,14 @@ class MicroBatcher:
         keep = deque()
         for req in self._pending:
             if req.deadline is not None and now >= req.deadline:
-                self.stats["expired"] += 1
+                self._m["expired"].inc()
                 req.fail(DeadlineExceededError(
                     f"deadline passed {now - req.deadline:.3f}s before "
                     "dispatch"))
             else:
                 keep.append(req)
         self._pending = keep
+        self._m["depth"].set(len(self._pending))
 
     def _wait_needed_locked(self, now):
         """None = idle (nothing pending); 0 = dispatch now; > 0 =
@@ -252,24 +322,42 @@ class MicroBatcher:
                 break
             batch.append(self._pending.popleft())
             rows += req.rows
+        self._m["depth"].set(len(self._pending))
         return batch
 
     # -- dispatch (lock NOT held) ---------------------------------------
     def _run_batch(self, batch):
         rows = sum(r.rows for r in batch)
-        self.stats["dispatches"] += 1
-        self.stats["dispatched_rows"] += rows
-        self.stats["coalesced"] += len(batch)
-        self.occupancy.append((rows, int(self._bucket_for(rows))))
+        bucket = int(self._bucket_for(rows))
+        taken = self.clock()
+        oldest = min(r.enqueued_at for r in batch)
+        self._m["dispatches"].inc()
+        self._m["dispatched_rows"].inc(rows)
+        self._m["coalesced"].inc(len(batch))
+        self._m["occupancy"].observe(rows / bucket if bucket else 1.0)
+        for r in batch:
+            self._m["wait"].observe(taken - r.enqueued_at)
+        # enqueue→coalesce→dispatch→reply span chain on THIS batcher's
+        # clock (ManualClock-driven tests get deterministic traces)
+        self._registry.add_span(
+            "serving.coalesce", "serving", oldest, taken - oldest,
+            model=self.name, requests=len(batch), rows=rows)
+        self.occupancy.append((rows, bucket))
         try:
             feats = batch[0].features if len(batch) == 1 else \
                 np.concatenate([r.features for r in batch], axis=0)
             outs = self._dispatch(feats)
         except Exception as e:
-            self.stats["errors"] += len(batch)
+            self._m["errors"].inc(len(batch))
             for r in batch:
                 r.fail(e)
             return
+        finally:
+            self._registry.add_span(
+                "serving.dispatch", "serving", taken,
+                self.clock() - taken, model=self.name, rows=rows,
+                bucket=bucket)
+        t_reply = self.clock()
         multi = isinstance(outs, (list, tuple))
         outs_list = [np.asarray(o) for o in (outs if multi else [outs])]
         off = 0
@@ -277,6 +365,10 @@ class MicroBatcher:
             sl = [o[off:off + r.rows] for o in outs_list]
             off += r.rows
             r.finish(sl if multi else sl[0])
+        self._registry.add_span(
+            "serving.reply", "serving", t_reply,
+            self.clock() - t_reply, model=self.name,
+            requests=len(batch))
 
     # -- drivers --------------------------------------------------------
     def poll(self, now=None):
@@ -349,19 +441,52 @@ class MicroBatcher:
                     self._pending.popleft().fail(
                         ServingClosedError("batcher closed before "
                                            "dispatch"))
+                self._m["depth"].set(0)
             self._cond.notify_all()
         if drain:
             self.flush()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
+        # release this instance's registry series: a long-lived server
+        # rolling swaps (model:v1, v2, ...) or a process creating many
+        # anonymous batchers must not grow every future /metrics scrape
+        # with dead series. The cached self._m handles stay usable
+        # (the stats read-through keeps working after close) — they are
+        # just detached from exposition.
+        reg = self._registry
+        for metric in ("dl4j_serving_requests_total",
+                       "dl4j_serving_rows_total",
+                       "dl4j_serving_dispatches_total",
+                       "dl4j_serving_dispatched_rows_total",
+                       "dl4j_serving_coalesced_total",
+                       "dl4j_serving_expired_total",
+                       "dl4j_serving_rejected_total",
+                       "dl4j_serving_errors_total",
+                       "dl4j_serving_queue_depth",
+                       "dl4j_serving_queue_wait_seconds",
+                       "dl4j_serving_batch_occupancy"):
+            fam = reg.get(metric)
+            if fam is not None:
+                fam.remove(model=self.name)
         return self
 
     # -- reporting ------------------------------------------------------
+    @property
+    def stats(self):
+        """DEPRECATED read-through view over the registry counters
+        (runtime.telemetry): the historical dict keys, computed on
+        access. New code should read the `dl4j_serving_*` instruments
+        (labeled model=<name>) via /metrics or metrics_snapshot()."""
+        return {k: int(self._m[k].value) for k in _STAT_KEYS}
+
     def occupancy_summary(self):
         """Occupancy of every dispatch so far: mean rows/bucket plus a
         quartile histogram — the 'is max_wait tuned right' signal
-        (docs/SERVING.md)."""
+        (docs/SERVING.md). Computed from the `self.occupancy` record
+        (bench code assigns it directly); live dispatches additionally
+        feed the registry's dl4j_serving_batch_occupancy histogram,
+        whose quartile bucket edges mirror this binning."""
         if not self.occupancy:
             return {"dispatches": 0, "mean_occupancy": None,
                     "histogram": {}}

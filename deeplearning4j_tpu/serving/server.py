@@ -12,6 +12,9 @@ Routes:
 * ``GET /healthz``                 — readiness (503 until the warmup
   hook — ``ModelHost.warm_all`` by default — reports every model's
   bucket executables hot; the pod scheduler gate, docs/COMPILE.md).
+* ``GET /metrics``                 — Prometheus text exposition of the
+  process-wide telemetry registry (serving + training + AOT
+  instruments; runtime/telemetry.py, docs/OBSERVABILITY.md).
 * ``GET /v1/models``               — the multi-model policy table.
 * ``GET /v1/models/<name>``        — one model's policy row (404).
 * ``POST /v1/models/<name>:predict`` — body
@@ -39,9 +42,37 @@ __all__ = ["InferenceServer"]
 
 
 class _InferenceHandler(JsonHandler):
+    @classmethod
+    def metric_route(cls, path):
+        """Bounded route labels for dl4j_http_* instruments (model
+        names collapse into one 'predict'/'model' label so request
+        cardinality can never grow the registry)."""
+        path = path.rstrip("/") or "/"
+        if path == "/healthz":
+            return "healthz"
+        if path == "/metrics":
+            return "metrics"
+        if path == "/v1/models":
+            return "models"
+        if path.endswith(":predict"):
+            return "predict"
+        if path.startswith("/v1/models/"):
+            return "model"
+        return "other"
+
     def handle_GET(self):
         host = self._owner().host
         path = self.path.split("?", 1)[0].rstrip("/")
+        if path == "/metrics":
+            # Prometheus text exposition of the process registry:
+            # serving (queue depth/occupancy/wait/latency/429s) AND
+            # training (step wall, compile, retry/skip/checkpoint)
+            # instruments — whatever this process has recorded
+            from deeplearning4j_tpu.runtime import telemetry
+
+            return self._send(
+                200, telemetry.get_registry().prometheus(),
+                "text/plain; version=0.0.4; charset=utf-8")
         if path == "/v1/models":
             return self._json({"models": host.describe()})
         if path.startswith("/v1/models/"):

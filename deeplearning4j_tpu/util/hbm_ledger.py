@@ -843,6 +843,20 @@ def attribute_ledger(compiled, net=None, x_shape=None, optimizer_slots=1,
     if gap is not None:
         rec["gap_bytes"] = int(gap)
         rec["named_gap_frac"] = round(binsum / gap, 4) if gap > 0 else None
+    # publish the attribution totals as gauges (host-side static
+    # analysis): the /metrics view of what the last attributed compile
+    # was billed — total, floor and each named overhead bin
+    from deeplearning4j_tpu.runtime import telemetry
+
+    _g = telemetry.get_registry().gauge(
+        "dl4j_hbm_attributed_bytes",
+        "last attribute_ledger bill: charged bytes by bin",
+        labels=("bin",))
+    _g.labels(bin="total").set(rec["ledger_total_bytes"])
+    _g.labels(bin="floor").set(rec["floor_bytes"])
+    _g.labels(bin="uncategorized").set(rec["uncategorized_bytes"])
+    for b, v in rec["bins"].items():
+        _g.labels(bin=b).set(v)
     return rec
 
 

@@ -28,6 +28,7 @@ from __future__ import annotations
 import http.server
 import json
 import threading
+import time
 
 
 class HttpError(Exception):
@@ -54,6 +55,39 @@ class JsonHandler(http.server.BaseHTTPRequestHandler):
     _responded = False
     _suppressed = False
     _resp_lock = None
+    _t0 = None
+    _metric_done = False
+
+    @classmethod
+    def metric_route(cls, path):
+        """Bounded-cardinality route label for the per-route latency /
+        status-code instruments, or None to keep this handler
+        uninstrumented (the default — only handlers that opt in, like
+        the InferenceServer's, feed the registry)."""
+        return None
+
+    def _record_metrics(self, code):
+        """First response of the request: per-route latency histogram +
+        status-code counter into the process registry (host-side, after
+        the handler already produced its answer — never on any model's
+        dispatch path)."""
+        if self._metric_done or self._t0 is None:
+            return
+        route = self.metric_route(self.path.split("?", 1)[0])
+        if route is None:
+            return
+        self._metric_done = True
+        from deeplearning4j_tpu.runtime import telemetry
+
+        reg = telemetry.get_registry()
+        reg.counter("dl4j_http_requests_total",
+                    "HTTP responses by route and status code",
+                    labels=("route", "code")).labels(
+            route=route, code=int(code)).inc()
+        reg.histogram("dl4j_http_latency_seconds",
+                      "request receipt to response write, per route",
+                      labels=("route",)).labels(route=route).observe(
+            time.perf_counter() - self._t0)
 
     def log_message(self, *a):
         pass
@@ -68,6 +102,7 @@ class JsonHandler(http.server.BaseHTTPRequestHandler):
                 self._responded = True
         else:
             self._responded = True  # the dispatch safety net checks it
+        self._record_metrics(code)
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
@@ -90,6 +125,7 @@ class JsonHandler(http.server.BaseHTTPRequestHandler):
         return getattr(self.server, "owner", None)
 
     def do_GET(self):
+        self._t0 = time.perf_counter()
         if self.path.split("?", 1)[0] == "/healthz":
             owner = self._owner()
             ready = owner.ready if owner is not None else True
@@ -103,6 +139,7 @@ class JsonHandler(http.server.BaseHTTPRequestHandler):
         self._dispatch("GET")
 
     def do_POST(self):
+        self._t0 = time.perf_counter()
         self._dispatch("POST")
 
     def _dispatch(self, method):

@@ -10,54 +10,105 @@ wraps ``jax.profiler`` traces for inspection in TensorBoard/XProf.
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
-from collections import defaultdict
 
 
 class OpProfiler:
-    """Singleton section timer (reference: OpProfiler.getInstance())."""
+    """Singleton section timer (reference: OpProfiler.getInstance()),
+    re-implemented as a thin facade over the telemetry registry
+    (runtime.telemetry): every steady-state section observation lands
+    in the ``dl4j_profiler_section_seconds{section=...}`` histogram and
+    the first-call (compile) wall in the
+    ``dl4j_profiler_compile_seconds{section=...}`` gauge, so old call
+    sites keep their API while /metrics and metrics_snapshot() see the
+    same data. Thread-safe (serving worker threads time sections
+    concurrently — the old defaultdict mutation raced), clock
+    injectable (``OpProfiler(clock=ManualClock())`` in tests)."""
 
     _instance = None
+    _instance_lock = threading.Lock()
 
     @classmethod
     def getInstance(cls) -> "OpProfiler":
-        if cls._instance is None:
-            cls._instance = OpProfiler()
-        return cls._instance
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = OpProfiler()
+            return cls._instance
 
-    def __init__(self):
-        self.reset()
+    def __init__(self, clock=None, registry=None):
+        from deeplearning4j_tpu.runtime import telemetry
+
+        if registry is None:
+            registry = telemetry.get_registry()
+        self._registry = registry
+        self._clock = clock if clock is not None else registry.clock
+        self._lock = threading.RLock()
+        self._steady = registry.histogram(
+            "dl4j_profiler_section_seconds",
+            "OpProfiler steady-state section wall (first call excluded)",
+            labels=("section",))
+        self._compile = registry.gauge(
+            "dl4j_profiler_compile_seconds",
+            "OpProfiler first-call wall ~ compile time under jit",
+            labels=("section",))
+        self._first = {}  # section -> first-call wall (compile split)
 
     def reset(self):
-        self._times = defaultdict(float)
-        self._counts = defaultdict(int)
-        self._first = {}  # first-call wall time ~ compile time under jit
+        """Zero this profiler's sections in place (its registry series
+        included — handles stay attached, the singleton contract)."""
+        with self._lock:
+            for name in self._first:
+                self._steady.labels(section=name).reset()
+                self._compile.labels(section=name).reset()
+            self._first = {}
+        return self
 
     @contextlib.contextmanager
     def section(self, name: str):
-        t0 = time.perf_counter()
+        from deeplearning4j_tpu.runtime import telemetry
+
+        t0 = self._clock()
         try:
             yield
         finally:
-            dt = time.perf_counter() - t0
-            if name not in self._first:
-                self._first[name] = dt
-            else:
-                self._times[name] += dt
-                self._counts[name] += 1
+            # the kill switch skips ALL bookkeeping (incl. the
+            # first-call split) so disabled-mode readings stay
+            # internally consistent: invocations 0, times 0
+            if telemetry.enabled():
+                dt = self._clock() - t0
+                with self._lock:
+                    if name not in self._first:
+                        self._first[name] = dt
+                        self._compile.labels(section=name).set(dt)
+                    else:
+                        self._steady.labels(section=name).observe(dt)
+                self._registry.trace.add(f"profiler.{name}", "profiler",
+                                         t0, dt)
+
+    def _steady_child(self, name):
+        # READ path: must not create a series for a probed-but-never-
+        # timed section name
+        return self._steady.labels_get(section=name)
 
     def timeSpent(self, name: str) -> float:
         """Steady-state seconds (excludes the first, compiling call)."""
-        return self._times[name]
+        c = self._steady_child(name)
+        return c.sum if c is not None else 0.0
 
     def invocations(self, name: str) -> int:
-        return self._counts[name] + (1 if name in self._first else 0)
+        with self._lock:
+            seen = name in self._first
+        c = self._steady_child(name)
+        return (c.count if c is not None else 0) + (1 if seen else 0)
 
     def compileTime(self, name: str) -> float:
-        return self._first.get(name, 0.0)
+        with self._lock:
+            return self._first.get(name, 0.0)
 
     def averageTime(self, name: str) -> float:
-        return self._times[name] / max(self._counts[name], 1)
+        c = self._steady_child(name)
+        return c.sum / max(c.count, 1) if c is not None else 0.0
 
     def printOutDashboard(self) -> str:
         lines = [f"{'section':<28}{'calls':>7}{'compile_s':>11}"
