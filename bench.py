@@ -1016,9 +1016,18 @@ def bench_analysis():
     including the eval_shape forward-agreement deep check on every
     layer — is the cost a pre-flight `--zoo`/validate=True gate adds
     BEFORE any pod slot is claimed, so it must stay host-cheap. Also
-    times the purity lint over the package source."""
+    times the purity lint over the package source, the pass-8
+    thread-safety lint over the threaded tier (--concurrency), and
+    the pass-7 collective-contract sweep (one TRACE per
+    gradient-compression mode, zero compiles) — ISSUE 14."""
+    import jax
+    import jax.numpy as jnp
+    import jax.tree_util as jtu
+
     from deeplearning4j_tpu.analysis import lint_paths
+    from deeplearning4j_tpu.analysis import collectives as colan
     from deeplearning4j_tpu.analysis.cli import run_zoo
+    from deeplearning4j_tpu.analysis.threads import lint_thread_paths
 
     t0 = time.perf_counter()
     results = run_zoo(batch_size=32)
@@ -1033,6 +1042,52 @@ def bench_analysis():
     lint_rep = lint_paths([pkg])
     lint_s = time.perf_counter() - t0
 
+    # pass 8: the thread-safety lint over the canonical threaded tier
+    t0 = time.perf_counter()
+    thr_rep = lint_thread_paths()
+    threads_s = time.perf_counter() - t0
+
+    # pass 7: trace + contract-check every gradient_compression mode's
+    # train step on a dp mesh (make_jaxpr only — no XLA compile)
+    from deeplearning4j_tpu.nn import (
+        DenseLayer, InputType, MultiLayerNetwork,
+        NeuralNetConfiguration, OutputLayer, Sgd,
+    )
+    from deeplearning4j_tpu.parallel import (DATA_AXIS, ParallelWrapper,
+                                             build_mesh)
+
+    n_dev = len(jax.devices())
+    col_errors = {}
+    col_s = None
+    if n_dev > 1:
+        mesh = build_mesh({DATA_AXIS: n_dev})
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(7).updater(Sgd(0.05)).activation("tanh").list()
+                .layer(DenseLayer(nOut=16))
+                .layer(OutputLayer(nOut=4, activation="softmax"))
+                .setInputType(InputType.feedForward(8)).build())
+        rng = np.random.RandomState(0)
+        x = rng.randn(2 * n_dev, 8).astype("float32")
+        y = np.eye(4, dtype="float32")[rng.randint(0, 4, 2 * n_dev)]
+        t0 = time.perf_counter()
+        for mode in (None, "int8", "block_int8", "threshold"):
+            net = MultiLayerNetwork(conf).init()
+            pw = ParallelWrapper(net, mesh=mesh,
+                                 gradient_compression=mode)
+            pw._place_replicated()
+            rep = colan.verify_program(
+                pw.trainStep(), net._params, net._upd_states,
+                net._states, jnp.asarray(0, jnp.int32),
+                pw._shard_batch(jnp.asarray(x)),
+                pw._shard_batch(jnp.asarray(y)),
+                jax.random.key(0), None, None,
+                mesh=mesh, dp=n_dev,
+                contract=colan.compression_contract(
+                    mode, len(jtu.tree_leaves(net._params))))
+            if not rep.ok:
+                col_errors[mode or "dense"] = len(rep.errors)
+        col_s = round(time.perf_counter() - t0, 3)
+
     return {
         "zoo_models": len(results),
         "zoo_layers_checked": layers,
@@ -1041,10 +1096,17 @@ def bench_analysis():
         "zoo_errors": errors,  # must be {} — the corpus gate
         "lint_wall_s": round(lint_s, 3),
         "lint_violations": len(lint_rep.errors),
+        "threads_wall_s": round(threads_s, 3),
+        "threads_violations": len(thr_rep.errors),   # must be 0
+        "threads_suppressed": len(thr_rep.suppressed),
+        "collectives_wall_s": col_s,   # None on a 1-device host
+        "collectives_errors": col_errors,  # must be {} — contract gate
         "note": ("config shape/dtype validation (incl. eval_shape "
                  "forward-agreement deep check) over the 16-model zoo "
-                 "corpus + purity lint of the package source; "
-                 "host-only, no TPU"),
+                 "corpus + purity lint of the package source + "
+                 "thread-safety lint of the threaded tier + one-trace "
+                 "collective-contract sweep over the compression "
+                 "modes; host-only, no TPU"),
     }
 
 

@@ -1,6 +1,6 @@
 """Pre-compilation static analysis.
 
-Six passes, one CLI (``python -m deeplearning4j_tpu.analysis``):
+Eight passes, one CLI (``python -m deeplearning4j_tpu.analysis``):
 
 - shape/dtype inference over model configs (shapes.validate_model)
 - SameDiff graph validation (samediff_check.validate_samediff)
@@ -13,9 +13,18 @@ Six passes, one CLI (``python -m deeplearning4j_tpu.analysis``):
 - HBM gap attribution + dtype-policy audit of a named subject's
   compiled train step (hbm.run_attribution, CLI ``--attribution`` —
   the one pass that pays a host XLA compile)
+- SPMD collective-safety verification: the ordered collective
+  signature of any traceable program, checked for control-flow
+  deadlock hazards, axis sanity, quantized-accumulator agreement,
+  declarative CollectiveContract drift, bill-vs-measured byte
+  divergence and malformed rings (collectives.verify_program,
+  COL01-06 — one trace, zero compiles)
+- host-side thread-safety lint over the threaded serving/runtime tier
+  (threads.lint_thread_paths, THR01-04, CLI ``--concurrency``)
 
 See docs/ANALYSIS.md for the diagnostic catalogue and suppression
-syntax. ``MultiLayerNetwork.init(validate=True)`` /
+syntax (``purity-ok[...]`` / ``thread-ok[...]``).
+``MultiLayerNetwork.init(validate=True)`` /
 ``ComputationGraph.init(validate=True)`` run the shape pass eagerly and
 raise ConfigValidationError instead of deferring mistakes to trace
 time; passing ``mesh=``/``hbm_gb=`` extends the gate with the
@@ -38,13 +47,26 @@ from deeplearning4j_tpu.analysis.partitioning import (  # noqa: F401
 from deeplearning4j_tpu.analysis.retrace import (  # noqa: F401
     RetraceError, RetraceSentinel, lint_retrace, lint_retrace_paths,
 )
+from deeplearning4j_tpu.analysis.collectives import (  # noqa: F401
+    CollectiveContract, CollectiveSignature, check_acc_dtype, check_bill,
+    check_signature, collective_counts, collective_signature,
+    compression_contract, linalg_contract, verify_program,
+)
+from deeplearning4j_tpu.analysis.threads import (  # noqa: F401
+    THREADED_TIER, lint_thread_paths, lint_thread_source,
+)
 
 __all__ = ["ALL_CODES", "ConfigValidationError", "Diagnostic", "Report",
            "validate_model", "validate_or_raise", "validate_samediff",
            "validate_plan", "ShardingPlan", "check_collectives",
            "RetraceError", "RetraceSentinel", "lint_retrace",
            "lint_retrace_paths",
-           "lint_paths", "lint_source", "zoo_corpus"]
+           "lint_paths", "lint_source", "zoo_corpus",
+           "CollectiveContract", "CollectiveSignature",
+           "collective_counts", "collective_signature",
+           "check_signature", "check_acc_dtype", "check_bill",
+           "compression_contract", "linalg_contract", "verify_program",
+           "THREADED_TIER", "lint_thread_paths", "lint_thread_source"]
 
 
 def validate_or_raise(conf, batchSize=32, mesh=None, hbm_gb=None,

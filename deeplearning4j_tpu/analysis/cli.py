@@ -31,6 +31,14 @@ least-squares) on each ``--mesh`` (default dp4xtp2): PAR01/03 axis and
 never-pad divisibility, PAR04 collective lint over the linalg sources,
 and the PAR06 per-chip byte bill against ``--hbm-gb``.
 
+``--concurrency`` runs the host-side thread-safety lint (THR01-04:
+guarded state touched outside its lock, lock-order inversion,
+blocking calls under a held lock, unguarded lazy init) over the given
+source paths, defaulting to the package's own threaded tier
+(serving/, runtime/telemetry+aot+autotune+resilience+async_iterator,
+parallel/inference, util/httpserve+profiler). Pure AST — no imports,
+no jax, no execution.
+
 Exit status: 0 = clean (warnings allowed), 1 = errors found,
 2 = usage / unreadable input.
 """
@@ -73,6 +81,11 @@ def _build_parser():
                         "pairs, e.g. 'data=4,model=2'; repeatable "
                         "(default: the canonical dp4xtp2 and dp2xpp4 "
                         "meshes; --linalg defaults to dp4xtp2 only)")
+    p.add_argument("--concurrency", action="store_true",
+                   help="run the thread-safety lint (THR01-04, "
+                        "docs/ANALYSIS.md pass 8) over the given "
+                        "source paths (default: the package's "
+                        "threaded serving/runtime tier)")
     p.add_argument("--linalg", action="store_true",
                    help="statically validate the canonical distributed-"
                         "linalg block plans (SUMMA GEMM, tall Gram, "
@@ -248,8 +261,13 @@ def main(argv=None):
         ("--precompile", bool(args.precompile)),
         ("--attribution", bool(args.attribution)),
         ("--linalg", args.linalg),
+        # --concurrency owns the paths when given (they are its lint
+        # subject), so it conflicts with every other subject
+        ("--concurrency", args.concurrency),
         # --parallel is a modifier OF the zoo/paths subject
-        ("--zoo/paths", bool(args.zoo or args.paths or args.parallel)),
+        ("--zoo/paths", bool(args.zoo or (args.paths
+                                          and not args.concurrency)
+                             or args.parallel)),
     ) if on]
     if len(selected) > 1:
         print(" + ".join(selected) + ": these subjects each own the "
@@ -268,6 +286,36 @@ def main(argv=None):
         from deeplearning4j_tpu.runtime import aot
 
         aot_cache = aot.enable(args.cache_dir)
+
+    if args.concurrency:
+        import os as _os
+
+        from deeplearning4j_tpu.analysis.threads import (
+            lint_thread_paths, threaded_tier_paths,
+        )
+
+        paths = args.paths or None
+        if paths:
+            missing = [p for p in paths if not _os.path.exists(p)]
+            if missing:
+                # same vacuous-pass guard as the purity subject: a
+                # typo'd path must not un-gate a CI wired to this
+                print("no such path(s): " + ", ".join(missing),
+                      file=sys.stderr)
+                return 2
+        rep = lint_thread_paths(paths)
+        shown = paths if paths else \
+            [_os.path.relpath(p) for p in threaded_tier_paths()]
+        rep.subject = "threads:" + ",".join(shown)
+        if args.as_json:
+            print(_json.dumps(
+                {"reports": [_report_to_json(rep.subject, rep)],
+                 "ok": rep.ok}, indent=2))
+        else:
+            print(rep.format(verbose=args.verbose))
+            print(f"\n1 subject(s): {len(rep.errors)} error(s), "
+                  f"{len(rep.warnings)} warning(s)")
+        return 0 if rep.ok else 1
 
     if args.autotune:
         from deeplearning4j_tpu.analysis.hbm import SUBJECTS

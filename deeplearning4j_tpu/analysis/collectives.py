@@ -1,0 +1,862 @@
+"""SPMD collective-safety verifier: pass 7 of the analysis tier.
+
+A shard_map/jitted SPMD program's communication shape is a STATIC
+artifact: which collectives it issues, over which mesh axes, in which
+dtype, moving how many per-chip bytes. The repo's parallel modes have
+asserted fragments of that shape by hand in a dozen places (dryrun
+legs, per-test count asserts, per-test byte gates); this module hoists
+`linalg.collective_counts` into one general jaxpr walker that extracts
+an ordered **collective signature** from any traceable program — one
+trace (`jax.make_jaxpr`), zero compiles — and checks it declaratively:
+
+- COL01  collective under data-dependent control flow: a lax.cond whose
+         predicate can differ across replicas (branch divergence — the
+         replicas issue mismatched collectives and the program
+         deadlocks on device), or a lax.while_loop whose predicate is
+         not replica-uniform while its body communicates. Replica
+         uniformity is tracked through the jaxpr: sharded shard_map
+         inputs, `axis_index`, `ppermute` and scattered outputs are
+         divergent; collective REDUCTIONS (psum/pmax/pmin/all_gather)
+         wash divergence out — which is exactly why the CG
+         while_loop's `||r||^2 > tol` predicate is safe (every term
+         reaching it passed through a psum) and stays unflagged.
+- COL02  collective axis name unknown to the mesh (the jaxpr-level twin
+         of the source-level PAR04 lint).
+- COL03  quantized-accumulator bound agreement: the sum of dp int8
+         lanes needs int16 headroom only through dp=256
+         (127 * 256 = 32512); past that the runtime widens to int32.
+         Analyzer, byte bill and lowering must name the same
+         accumulator dtype — `check_acc_dtype` cross-checks the lowered
+         integer psum dtype, `parallel.sharding._acc_dtype`, and the
+         PAR06/bench bill's per-element accumulator bytes against the
+         one expected dtype for the given dp.
+- COL04  declared-vs-lowered drift: a `CollectiveContract` declares a
+         parallel mode's expected signature ONCE; the scattered
+         hand-rolled count asserts reroute through `contract.check`.
+- COL05  analytic-bill-vs-measured byte divergence: `check_bill`
+         generalizes the per-test 10% gates (test_grad_compression,
+         test_zero_sharding) into one reusable check.
+- COL06  malformed ppermute rings: a `perm` that is not a permutation
+         (duplicate source or destination) deadlocks or drops data; a
+         self-cycle (i -> i) is a no-op link that is almost always a
+         ring-arithmetic bug.
+
+Entry points:
+
+    sig = collective_signature(step_fn, *args)      # one trace
+    report = check_signature(sig, mesh_axes={"data", "model"})
+    report = CollectiveContract("int8", {"pmax": L, "psum": L+1}) \
+        .check(sig)
+    report = verify_program(fn, *args, mesh=mesh, contract=c, dp=8)
+
+Canonical contracts: `compression_contract(mode, n_leaves, ...)` for
+the four gradient_compression modes (incl. the ZeRO-composed sharded
+form) and `linalg_contract(routine)` for the distributed-linalg
+routines — the single source the dryrun legs and tests check against.
+
+Limits: the uniformity analysis assumes values entering from OUTSIDE
+the walked program (closed-over consts, non-shard_map invars) are
+replica-uniform, and treats a reduction over ANY axis as fully
+uniformizing (single-axis programs dominate this repo); divergence
+smuggled in through a host-computed operand is invisible. Collectives
+inserted by GSPMD *after* jaxpr staging (the dense data-parallel path,
+which has no explicit collectives) are out of reach by construction —
+their contract is the empty signature.
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.analysis.diagnostics import ERROR, WARNING, Report
+
+__all__ = [
+    "COLLECTIVE_PRIMS", "CollectiveSite", "CollectiveSignature",
+    "collective_signature", "collective_counts", "check_signature",
+    "check_acc_dtype", "check_bill", "CollectiveContract",
+    "compression_contract", "linalg_contract", "verify_program",
+    "expected_acc_dtype",
+]
+
+#: jaxpr primitive names tallied as collectives (hoisted from
+#: linalg.distributed, which re-exports for back-compat). psum_scatter
+#: appears in jaxprs as "reduce_scatter" on this jax; both names are
+#: kept so the walker survives either spelling.
+COLLECTIVE_PRIMS = ("psum", "all_gather", "ppermute", "psum_scatter",
+                    "reduce_scatter", "all_to_all", "pmin", "pmax")
+
+#: collectives whose output is identical on every replica of the
+#: reduced axis — they *wash out* divergence for the uniformity
+#: analysis. reduce_scatter/psum_scatter/ppermute/all_to_all hand each
+#: chip a different block and stay divergent.
+_UNIFORMIZING = {"psum", "pmin", "pmax", "all_gather"}
+
+#: primitives whose output differs per replica even from uniform inputs
+_DIVERGING = {"axis_index", "ppermute", "psum_scatter", "reduce_scatter",
+              "all_to_all"}
+
+
+class CollectiveSite:
+    """One collective site in jaxpr order (a site inside a loop counts
+    once — sites, not dispatches, same convention as
+    collective_counts)."""
+
+    __slots__ = ("prim", "axes", "dtype", "out_bytes", "context", "perm")
+
+    def __init__(self, prim, axes, dtype, out_bytes, context, perm=None):
+        self.prim = prim
+        self.axes = tuple(axes)
+        self.dtype = str(dtype)
+        self.out_bytes = int(out_bytes)
+        self.context = tuple(context)   # e.g. ("shard_map", "scan")
+        self.perm = perm                # ppermute only
+
+    def format(self):
+        ctx = ">".join(self.context) or "top"
+        return (f"{self.prim}[axes={','.join(self.axes)} "
+                f"dtype={self.dtype} bytes/chip={self.out_bytes} "
+                f"ctx={ctx}]")
+
+    def __repr__(self):
+        return f"<CollectiveSite {self.format()}>"
+
+
+class CollectiveSignature:
+    """Ordered collective sites of one traced program."""
+
+    def __init__(self, sites):
+        self.sites = list(sites)
+
+    def counts(self):
+        """{prim: site count} — the legacy collective_counts view."""
+        out = {}
+        for s in self.sites:
+            out[s.prim] = out.get(s.prim, 0) + 1
+        return out
+
+    def axes(self):
+        a = set()
+        for s in self.sites:
+            a |= set(s.axes)
+        return a
+
+    def __len__(self):
+        return len(self.sites)
+
+    def __iter__(self):
+        return iter(self.sites)
+
+    def format(self):
+        return "\n".join(s.format() for s in self.sites) or "(empty)"
+
+
+# ----------------------------------------------------------------------
+# jaxpr plumbing
+# ----------------------------------------------------------------------
+
+def _iter_sub_jaxprs(v):
+    """Yield (every) jaxpr reachable from one eqn param value."""
+    if hasattr(v, "jaxpr"):        # ClosedJaxpr
+        yield v.jaxpr
+    elif hasattr(v, "eqns"):       # raw Jaxpr
+        yield v
+    elif isinstance(v, (list, tuple)):
+        for x in v:
+            yield from _iter_sub_jaxprs(x)
+
+
+def _site_axes(eqn):
+    """Axis names of one collective eqn, across the two param
+    spellings (psum uses `axes`, the gather/permute family
+    `axis_name`)."""
+    ax = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+    if isinstance(ax, str):
+        ax = (ax,)
+    return tuple(a for a in ax if isinstance(a, str))
+
+
+def _out_bytes(eqn):
+    total = 0
+    for v in eqn.outvars:
+        aval = getattr(v, "aval", None)
+        if aval is None or not hasattr(aval, "shape"):
+            continue
+        n = 1
+        for d in aval.shape:
+            n *= int(d)
+        total += n * getattr(getattr(aval, "dtype", None), "itemsize", 4)
+    return total
+
+
+def _site_dtype(eqn):
+    for v in eqn.outvars:
+        dt = getattr(getattr(v, "aval", None), "dtype", None)
+        if dt is not None:
+            return dt
+    return "?"
+
+
+# ----------------------------------------------------------------------
+# replica-uniformity analysis (feeds COL01)
+# ----------------------------------------------------------------------
+
+class _Uniformity:
+    """Forward dataflow over one jaxpr: var -> replica-uniform?
+    Literals are uniform; everything else propagates per eqn."""
+
+    def __init__(self):
+        self.u = {}   # id(var) -> bool
+
+    def get(self, atom):
+        # Literal objects have a `val` and no binder — always uniform
+        if not hasattr(atom, "count") and hasattr(atom, "val"):
+            return True
+        return self.u.get(id(atom), True)  # unknown provenance: uniform
+
+    def set(self, var, val):
+        self.u[id(var)] = bool(val)
+
+    def run(self, jaxpr, invar_uniform, report=None, context=()):
+        """Propagate through `jaxpr` with the given invar uniformity;
+        returns the outvar uniformity list. When `report` is given,
+        COL01 findings for conds/whiles inside are appended."""
+        for var, uni in zip(jaxpr.invars, invar_uniform):
+            self.set(var, uni)
+        for var in getattr(jaxpr, "constvars", ()):
+            self.set(var, True)   # closed-over consts: assumed uniform
+        for eqn in jaxpr.eqns:
+            self._eqn(eqn, report, context)
+        return [self.get(v) for v in jaxpr.outvars]
+
+    # -- per-eqn transfer ------------------------------------------------
+    def _eqn(self, eqn, report, context):
+        name = eqn.primitive.name
+        ins = [self.get(v) for v in eqn.invars]
+        if name in _DIVERGING:
+            out = False
+        elif name in _UNIFORMIZING:
+            out = True
+        elif name == "while":
+            out = self._while(eqn, ins, report, context)
+            for v, u in zip(eqn.outvars, out):
+                self.set(v, u)
+            return
+        elif name == "cond":
+            out = self._cond(eqn, ins, report, context)
+            for v, u in zip(eqn.outvars, out):
+                self.set(v, u)
+            return
+        elif name == "scan":
+            out = self._scan(eqn, ins, report, context)
+            for v, u in zip(eqn.outvars, out):
+                self.set(v, u)
+            return
+        elif name == "shard_map":
+            # nested shard_map: inputs re-shard per in_names
+            out = self._shard_map(eqn, report, context)
+            for v, u in zip(eqn.outvars, out):
+                self.set(v, u)
+            return
+        else:
+            subs = [s for v in eqn.params.values()
+                    for s in _iter_sub_jaxprs(v)]
+            if subs:
+                # pjit / remat / custom_vjp etc: recurse when the inner
+                # jaxpr's arity matches; otherwise conservative join
+                out_list = None
+                for s in subs:
+                    if len(s.invars) == len(eqn.invars):
+                        out_list = _Uniformity().run(
+                            s, ins, report, context + (name,))
+                if out_list is not None \
+                        and len(out_list) == len(eqn.outvars):
+                    for v, u in zip(eqn.outvars, out_list):
+                        self.set(v, u)
+                    return
+                out = all(ins) and not any(
+                    _contains_diverging(s) for s in subs)
+            else:
+                out = all(ins)
+        for v in eqn.outvars:
+            self.set(v, out)
+
+    def _scan(self, eqn, ins, report, context):
+        p = eqn.params
+        jx = p["jaxpr"].jaxpr
+        n_const, n_carry = p["num_consts"], p["num_carry"]
+        consts = ins[:n_const]
+        carry = ins[n_const:n_const + n_carry]
+        xs = ins[n_const + n_carry:]
+        # fixpoint iterations run silent (report=None) — exactly ONE
+        # reporting pass below, or a hazard inside the body would be
+        # diagnosed once per iteration (cf. _while)
+        for _ in range(max(1, n_carry)):
+            out = _Uniformity().run(jx, consts + carry + xs, None,
+                                    context + ("scan",))
+            new_carry = [a and b for a, b in zip(out[:n_carry], carry)]
+            if new_carry == carry:
+                break
+            carry = new_carry
+        out = _Uniformity().run(jx, consts + carry + xs, report,
+                                context + ("scan",))
+        return out
+
+    def _while(self, eqn, ins, report, context):
+        p = eqn.params
+        cond_jx = p["cond_jaxpr"].jaxpr
+        body_jx = p["body_jaxpr"].jaxpr
+        cn, bn = p["cond_nconsts"], p["body_nconsts"]
+        cond_consts = ins[:cn]
+        body_consts = ins[cn:cn + bn]
+        carry = ins[cn + bn:]
+        # fixed point: divergence in the carry is sticky across
+        # iterations (a slot once divergent stays divergent)
+        for _ in range(max(1, len(carry))):
+            out = _Uniformity().run(body_jx, body_consts + carry, None,
+                                    context + ("while",))
+            new_carry = [a and b for a, b in zip(out, carry)]
+            if new_carry == carry:
+                break
+            carry = new_carry
+        pred = _Uniformity().run(cond_jx, cond_consts + carry, None,
+                                 context + ("while",))
+        pred_uniform = all(pred)
+        body_colls = _collect_collectives(body_jx) \
+            + _collect_collectives(cond_jx)
+        if report is not None and body_colls and not pred_uniform:
+            report.add(
+                "COL01", ERROR, _ctx_where(context, "while_loop"),
+                "collective(s) "
+                + ", ".join(sorted({c for c, _ in body_colls}))
+                + " execute inside a while_loop whose predicate is not "
+                  "replica-uniform: replicas can disagree on the trip "
+                  "count and deadlock mid-collective",
+                hint="derive the predicate from collectively-reduced "
+                     "values (psum/pmax) so every replica sees the "
+                     "same loop count")
+        # body may also re-run uniformity WITH report to surface nested
+        # hazards (cond-in-while etc.)
+        if report is not None:
+            _Uniformity().run(body_jx, body_consts + carry, report,
+                              context + ("while",))
+        if not pred_uniform:
+            # a replica-divergent trip count poisons EVERY output of
+            # the loop (each replica stops at a different iterate) —
+            # without this, a collective-free divergent while would
+            # launder its divergence and downstream COL01 hazards
+            # (e.g. a second loop bounded by this one's result) would
+            # pass silently
+            return [False] * len(carry)
+        return carry
+
+    def _cond(self, eqn, ins, report, context):
+        branches = eqn.params["branches"]
+        pred_uniform = ins[0] if ins else True
+        op_ins = ins[1:]
+        outs = None
+        branch_sigs = []
+        for br in branches:
+            jx = br.jaxpr if hasattr(br, "jaxpr") else br
+            o = _Uniformity().run(jx, op_ins, report,
+                                  context + ("cond",))
+            branch_sigs.append(
+                tuple((c, a) for c, a in _collect_collectives(jx)))
+            outs = o if outs is None else \
+                [a and b for a, b in zip(outs, o)]
+        has_coll = any(branch_sigs)
+        if report is not None and has_coll:
+            if not pred_uniform:
+                report.add(
+                    "COL01", ERROR, _ctx_where(context, "cond"),
+                    "collective(s) inside a cond whose predicate is "
+                    "not replica-uniform: replicas can take different "
+                    "branches and issue mismatched collectives "
+                    "(SPMD deadlock)",
+                    hint="reduce the predicate across the axis first, "
+                         "or hoist the collective out of the branch")
+            elif len(set(branch_sigs)) > 1:
+                report.add(
+                    "COL01", ERROR, _ctx_where(context, "cond"),
+                    "cond branches carry DIFFERENT collective "
+                    f"sequences {sorted(set(branch_sigs))}: any "
+                    "replica-level disagreement in the predicate "
+                    "deadlocks, and partial lowering (vmap/select "
+                    "rewrites) can break the pairing",
+                    hint="give every branch the same collective "
+                         "sequence, or hoist the collective above "
+                         "the cond")
+        if not pred_uniform:
+            outs = [False] * len(outs or [])
+        return outs or []
+
+    def _shard_map(self, eqn, report, context):
+        jx = next(_iter_sub_jaxprs(eqn.params.get("jaxpr")), None)
+        if jx is None:
+            return [True] * len(eqn.outvars)
+        in_names = eqn.params.get("in_names", ())
+        inv = []
+        for i, v in enumerate(jx.invars):
+            names = in_names[i] if i < len(in_names) else {}
+            sharded = bool(names) and any(names.values())
+            inv.append(not sharded)
+        out = _Uniformity().run(jx, inv, report,
+                                context + ("shard_map",))
+        # replicated-out values are uniform by contract
+        return [True] * len(eqn.outvars) if len(out) != len(eqn.outvars) \
+            else out
+
+
+def _contains_diverging(jaxpr):
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in _DIVERGING:
+            return True
+        for v in eqn.params.values():
+            for s in _iter_sub_jaxprs(v):
+                if _contains_diverging(s):
+                    return True
+    return False
+
+
+def _collect_collectives(jaxpr):
+    """[(prim, axes)] sites inside `jaxpr`, recursively."""
+    out = []
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in COLLECTIVE_PRIMS:
+            out.append((eqn.primitive.name, _site_axes(eqn)))
+        for v in eqn.params.values():
+            for s in _iter_sub_jaxprs(v):
+                out.extend(_collect_collectives(s))
+    return out
+
+
+def _ctx_where(context, what):
+    ctx = ">".join(context) if context else "top"
+    return f"{what} @ {ctx}"
+
+
+# ----------------------------------------------------------------------
+# signature extraction
+# ----------------------------------------------------------------------
+
+def _walk_sites(jaxpr, context, sites):
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in COLLECTIVE_PRIMS:
+            sites.append(CollectiveSite(
+                name, _site_axes(eqn), _site_dtype(eqn),
+                _out_bytes(eqn), context,
+                perm=eqn.params.get("perm")))
+        for key, v in eqn.params.items():
+            for s in _iter_sub_jaxprs(v):
+                sub = name if key in ("jaxpr", "call_jaxpr") else \
+                    f"{name}.{key.replace('_jaxpr', '')}" \
+                    if key != "branches" else f"{name}.branch"
+                _walk_sites(s, context + (sub,), sites)
+
+
+def extract_signature(closed_jaxpr):
+    """CollectiveSignature of an already-made (Closed)Jaxpr."""
+    jx = closed_jaxpr.jaxpr if hasattr(closed_jaxpr, "jaxpr") \
+        else closed_jaxpr
+    sites = []
+    _walk_sites(jx, (), sites)
+    return CollectiveSignature(sites)
+
+
+def collective_signature(fn, *args):
+    """Trace `fn(*args)` (jax.make_jaxpr — no compile) and extract its
+    ordered collective signature."""
+    import jax
+
+    return extract_signature(jax.make_jaxpr(fn)(*args))
+
+
+def collective_counts(fn, *args):
+    """Static collective-site counts of one traceable function — the
+    historical linalg.collective_counts contract (sites, not
+    dispatches: a ppermute inside a fori_loop counts once), now a view
+    over the signature walker."""
+    return collective_signature(fn, *args).counts()
+
+
+# ----------------------------------------------------------------------
+# checks
+# ----------------------------------------------------------------------
+
+def check_signature(sig_or_fn, *args, mesh_axes=None, subject=""):
+    """COL01 (control-flow hazard), COL02 (unknown axis) and COL06
+    (malformed ppermute ring) over one program. Accepts a traceable
+    `fn, *args` or a pre-extracted CollectiveSignature (COL01 needs the
+    jaxpr, so signature-only input covers COL02/COL06). Returns a
+    Report."""
+    import jax
+
+    report = Report(subject=subject or "collectives")
+    if isinstance(sig_or_fn, CollectiveSignature):
+        sig = sig_or_fn
+    else:
+        closed = jax.make_jaxpr(sig_or_fn)(*args)
+        sig = extract_signature(closed)
+        if mesh_axes is None:
+            mesh_axes = _mesh_axes_of(closed)
+        _Uniformity().run(closed.jaxpr,
+                          [True] * len(closed.jaxpr.invars), report)
+    axes = set(mesh_axes) if mesh_axes is not None else None
+    for site in sig:
+        where = site.format()
+        if axes is not None:
+            for a in site.axes:
+                if a not in axes:
+                    report.add(
+                        "COL02", ERROR, where,
+                        f"collective {site.prim} reduces over axis "
+                        f"'{a}' but the mesh axes are {sorted(axes)}",
+                        hint="rename the axis or add it to "
+                             "build_mesh(...) (the jaxpr-level twin "
+                             "of PAR04)")
+        if site.prim == "ppermute" and site.perm is not None:
+            _check_perm(report, site)
+    return report
+
+
+def _mesh_axes_of(closed):
+    """Mesh axes named by any shard_map eqn in the jaxpr, or None."""
+    axes = set()
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "shard_map":
+                mesh = eqn.params.get("mesh")
+                shape = getattr(mesh, "shape", None)
+                if shape:
+                    axes.update(shape)
+            for v in eqn.params.values():
+                for s in _iter_sub_jaxprs(v):
+                    walk(s)
+
+    walk(closed.jaxpr)
+    return axes or None
+
+
+def _check_perm(report, site):
+    perm = list(site.perm)
+    srcs = [s for s, _ in perm]
+    dsts = [d for _, d in perm]
+    where = site.format()
+    if len(set(srcs)) != len(srcs) or len(set(dsts)) != len(dsts):
+        report.add(
+            "COL06", ERROR, where,
+            f"ppermute perm {tuple(perm)} is not a permutation "
+            "(duplicate source or destination): replicas would "
+            "send/receive mismatched messages and deadlock",
+            hint="each source and each destination may appear at most "
+                 "once; build rings as [(j, (j+1) % n) for j in "
+                 "range(n)]")
+    self_edges = [(s, d) for s, d in perm if s == d]
+    if self_edges:
+        report.add(
+            "COL06", ERROR, where,
+            f"ppermute perm contains self-cycle(s) {self_edges}: a "
+            "chip sending to itself is a no-op link — almost always "
+            "an off-by-one in the ring arithmetic",
+            hint="rotate by (j + 1) % n, not j % n")
+    return report
+
+
+def expected_acc_dtype(dp):
+    """The integer accumulator dtype the quantized collectives need at
+    data-parallel degree dp: the sum of dp int8 lanes (|q| <= 127)
+    fits int16 through dp = 256 (127 * 256 = 32512 < 32767); past
+    that the runtime must widen to int32."""
+    import jax.numpy as jnp
+
+    return jnp.int16 if int(dp) <= 256 else jnp.int32
+
+
+def check_acc_dtype(sig, dp, billed_acc_bytes=None, subject=""):
+    """COL03: the quantized-collective accumulator agreement for one
+    compressed step's signature. Three parties must name ONE dtype for
+    the given dp: this analyzer (`expected_acc_dtype`), the runtime
+    lowering (`parallel.sharding._acc_dtype`, read out of the traced
+    program's integer psum/reduce_scatter sites), and the PAR06/bench
+    byte bill (pass its per-element accumulator bytes as
+    `billed_acc_bytes`). Returns a Report."""
+    import numpy as np
+
+    from deeplearning4j_tpu.parallel.sharding import _acc_dtype
+
+    report = Report(subject=subject or f"acc-dtype@dp{dp}")
+    want = np.dtype(expected_acc_dtype(dp))
+    runtime = np.dtype(_acc_dtype(int(dp)))
+    if runtime != want:
+        report.add(
+            "COL03", ERROR, "parallel.sharding._acc_dtype",
+            f"runtime accumulates int8 lanes in {runtime} at dp={dp} "
+            f"but {want} is required (127*dp "
+            f"{'fits int16' if want.itemsize == 2 else 'overflows int16'})",
+            hint="the widening boundary is dp=256")
+    int_sites = [s for s in sig
+                 if s.prim in ("psum", "psum_scatter", "reduce_scatter")
+                 and s.dtype.startswith("int")]
+    for s in int_sites:
+        if np.dtype(s.dtype) != want:
+            report.add(
+                "COL03", ERROR, s.format(),
+                f"lowered integer {s.prim} accumulates in {s.dtype} at "
+                f"dp={dp}; the quantized sum needs {want} "
+                f"(127*{dp} = {127 * int(dp)})",
+                hint="route quantization through "
+                     "parallel.sharding._quantize so the acc dtype "
+                     "tracks dp")
+    if billed_acc_bytes is not None \
+            and int(billed_acc_bytes) != want.itemsize:
+        report.add(
+            "COL03", ERROR, "byte bill",
+            f"the analytic bill charges {billed_acc_bytes} B/element "
+            f"for the integer accumulator at dp={dp}; the required "
+            f"{want} is {want.itemsize} B — analyzer, bill and "
+            "lowering disagree",
+            hint="bill via parallel.sharding."
+                 "compressed_hlo_collective_bytes, which derives the "
+                 "acc width from the shared _acc_dtype")
+    return report
+
+
+def check_bill(measured_bytes, analytic_bytes, rel=0.10, where="",
+               subject=""):
+    """COL05: one reusable analytic-bill-vs-measured gate — the
+    generalization of the per-test 10% byte gates. `measured_bytes` is
+    what the compiled program's ledger charges the collective rows;
+    `analytic_bytes` the static bill. Divergence beyond `rel` errors
+    (a lowering regression, e.g. an integer psum silently widening
+    back to f32, fails statically instead of on a TPU window)."""
+    report = Report(subject=subject or "collective-bill")
+    measured = float(measured_bytes)
+    analytic = float(analytic_bytes)
+    if analytic <= 0:
+        if measured > 0:
+            report.add("COL05", ERROR, where or "bill",
+                       f"analytic bill is 0 B but the lowering charges "
+                       f"{int(measured)} B of collective traffic")
+        return report
+    drift = abs(measured - analytic) / analytic
+    if drift > float(rel):
+        report.add(
+            "COL05", ERROR, where or "bill",
+            f"measured collective bytes {int(measured)} diverge "
+            f"{drift:.1%} from the analytic bill {int(analytic)} "
+            f"(gate: {float(rel):.0%})",
+            hint="either the lowering changed (requantize/widening "
+                 "regression) or the bill model is stale — they must "
+                 "move together")
+    return report
+
+
+# ----------------------------------------------------------------------
+# contracts (COL04)
+# ----------------------------------------------------------------------
+
+class CollectiveContract:
+    """A parallel mode's expected collective signature, declared once.
+
+    `counts` maps prim name -> expected site count: an int for an exact
+    bound or a (min, max) tuple (max None = unbounded). `axes`, when
+    given, is the set of mesh axes every site must reduce over
+    (subset check). Prims not named in `counts` are drift (COL04) —
+    an undeclared collective is exactly the silent-communication-shape
+    change the contract exists to catch.
+    """
+
+    def __init__(self, name, counts, axes=None, description="",
+                 expects_quantized=False):
+        self.name = str(name)
+        self.counts = dict(counts)
+        self.axes = None if axes is None else frozenset(axes)
+        self.description = str(description)
+        #: the mode's reductions must run on an INTEGER accumulator
+        #: (the quantized int8/block_int8 wire format): verify_program
+        #: errors (COL03) when such a contract lowers no integer
+        #: reduce site at all — the psum COUNT survives a silent
+        #: widening back to f32, the dtype does not
+        self.expects_quantized = bool(expects_quantized)
+
+    def _bounds(self, want):
+        if isinstance(want, tuple):
+            lo, hi = want
+            return int(lo), (None if hi is None else int(hi))
+        return int(want), int(want)
+
+    def check(self, sig_or_counts, subject=""):
+        """COL04 drift report of an observed signature (or a bare
+        {prim: count} dict) against this declaration."""
+        report = Report(subject=subject or f"contract:{self.name}")
+        if isinstance(sig_or_counts, CollectiveSignature):
+            got = sig_or_counts.counts()
+            axes = sig_or_counts.axes()
+        else:
+            got = dict(sig_or_counts)
+            axes = None
+        for prim, want in self.counts.items():
+            lo, hi = self._bounds(want)
+            n = got.get(prim, 0)
+            if n < lo or (hi is not None and n > hi):
+                bound = f"{lo}" if hi == lo else \
+                    f"[{lo}, {'∞' if hi is None else hi}]"
+                report.add(
+                    "COL04", ERROR, f"{self.name}:{prim}",
+                    f"declared {bound} {prim} site(s), lowered program "
+                    f"has {n} — the communication shape drifted from "
+                    "the mode's contract",
+                    hint=self.description or
+                    "update the CollectiveContract ONLY if the new "
+                    "shape is intended; otherwise the lowering "
+                    "regressed")
+        for prim, n in got.items():
+            if prim not in self.counts and n:
+                report.add(
+                    "COL04", ERROR, f"{self.name}:{prim}",
+                    f"lowered program issues {n} undeclared {prim} "
+                    "site(s) — communication the contract never "
+                    "admitted",
+                    hint="declare it in the contract or remove the "
+                         "collective")
+        if self.axes is not None and axes is not None:
+            extra = axes - self.axes
+            if extra:
+                report.add(
+                    "COL04", ERROR, self.name,
+                    f"program reduces over axes {sorted(extra)} the "
+                    f"contract restricts to {sorted(self.axes)}")
+        return report
+
+
+def compression_contract(mode, n_leaves, n_eligible=None, axis="data"):
+    """The declarative collective contract of one ParallelWrapper /
+    SharedTrainingMaster gradient_compression mode (the single source
+    the dryrun legs and tests check against):
+
+      None         {}                        — the dense path has no
+                                              jaxpr-level collectives
+                                              (GSPMD inserts them after
+                                              staging)
+      int8 /       pmax  = L (scale sync)    one per leaf
+      block_int8   psum  = L + 1             integer sum per leaf + the
+                                              loss pmean
+      threshold    all_gather = 2L           idx + value gathers/leaf
+                   psum = 1                  the loss pmean
+      int8/block_int8 + ZeRO (n_eligible=E of L leaves):
+                   reduce_scatter = E        quantized scatter/eligible
+                   all_gather     = E        fresh-param gather
+                   psum  = (L - E) + 1       fallback all-reduce + loss
+                   pmax  = L                 scale sync per leaf
+    """
+    L = int(n_leaves)
+    if mode is None:
+        return CollectiveContract(
+            "dense", {}, axes=(axis,),
+            description="dense data-parallel: collectives are "
+                        "GSPMD-inserted post-jaxpr; any explicit "
+                        "collective here is drift")
+    if mode == "threshold":
+        return CollectiveContract(
+            "threshold", {"all_gather": 2 * L, "psum": 1}, axes=(axis,),
+            description="Strom threshold encoding: one (idx, value) "
+                        "all_gather pair per leaf + the loss pmean")
+    if mode in ("int8", "block_int8"):
+        if n_eligible is None:
+            return CollectiveContract(
+                mode, {"pmax": L, "psum": L + 1}, axes=(axis,),
+                description="quantized all-reduce: scale pmax + "
+                            "integer psum per leaf + the loss pmean",
+                expects_quantized=True)
+        E = int(n_eligible)
+        return CollectiveContract(
+            f"{mode}+zero",
+            {"reduce_scatter": E, "all_gather": E,
+             "psum": (L - E) + 1, "pmax": L}, axes=(axis,),
+            description="quantized reduce-scatter (eligible leaves) + "
+                        "param all-gather; compressed all-reduce "
+                        "fallback for the rest + the loss pmean",
+            expects_quantized=True)
+    raise ValueError(
+        f"unknown gradient_compression mode {mode!r}; pick one of "
+        "(None, 'int8', 'block_int8', 'threshold')")
+
+
+#: declared signatures of the distributed-linalg routines
+#: (linalg/distributed.py + solvers.py bodies); lstsq's psum count is
+#: setup (A^T b + the initial residual matvec) + the ONE in-loop
+#: normal-equation reduction — sites, not iterations
+_LINALG_CONTRACTS = {
+    "matmul2d": {"all_gather": 1, "ppermute": 1},
+    "matmul1d": {"ppermute": 1},
+    "matmul_ta": {"psum": 1, "all_gather": (0, 2)},
+    "matmul_tb": {"all_gather": 1},
+    "gram": {"psum": 1, "all_gather": (0, 1)},
+    "covariance": {"psum": 2, "all_gather": (0, 1)},
+    "pairwise_sq_dists": {},
+    "lstsq": {"psum": 3},
+}
+
+
+def linalg_contract(routine):
+    """CollectiveContract of one canonical distributed-linalg routine
+    (SUMMA GEMM variants, Gram/covariance, CG least-squares)."""
+    try:
+        counts = _LINALG_CONTRACTS[routine]
+    except KeyError:
+        raise ValueError(
+            f"unknown linalg routine {routine!r}; declared: "
+            f"{sorted(_LINALG_CONTRACTS)}") from None
+    return CollectiveContract(
+        f"linalg.{routine}", counts,
+        description="linalg tier communication shape "
+                    "(docs/LINALG.md); update only with the routine")
+
+
+def verify_program(fn, *args, mesh=None, contract=None, dp=None,
+                   billed_acc_bytes=None, subject=""):
+    """One-stop pass-7 verification of a traceable SPMD program: trace
+    once, then COL01 (control-flow hazard), COL02 (axes vs `mesh`),
+    COL06 (rings), COL03 (when `dp` is given — quantized acc dtype
+    agreement) and COL04 (when a `contract` is given). Returns the
+    merged Report; `report.signature` carries the extracted
+    CollectiveSignature."""
+    import jax
+
+    from deeplearning4j_tpu.analysis.partitioning import normalize_mesh
+
+    closed = jax.make_jaxpr(fn)(*args)
+    sig = extract_signature(closed)
+    axes = set(normalize_mesh(mesh)) if mesh is not None \
+        else _mesh_axes_of(closed)
+    report = Report(subject=subject or "collectives")
+    _Uniformity().run(closed.jaxpr, [True] * len(closed.jaxpr.invars),
+                      report)
+    report.extend(check_signature(sig, mesh_axes=axes, subject=subject))
+    has_int_reduce = any(
+        s.dtype.startswith("int") and s.prim in
+        ("psum", "psum_scatter", "reduce_scatter") for s in sig)
+    # the COL03 accumulator check auto-fires only for contracts that
+    # DECLARE quantization: a program may legitimately psum an int32
+    # token/row count, and only the declaration says its integer
+    # reductions are int8-lane accumulators (call check_acc_dtype
+    # directly to audit an undeclared program)
+    if dp is not None and has_int_reduce and contract is not None \
+            and contract.expects_quantized:
+        report.extend(check_acc_dtype(sig, dp,
+                                      billed_acc_bytes=billed_acc_bytes))
+    if contract is not None:
+        if contract.expects_quantized and not has_int_reduce:
+            # a silent widening back to f32 keeps the psum COUNT
+            # intact — only the dtype betrays it, so its absence is
+            # itself the COL03 finding
+            report.add(
+                "COL03", ERROR, contract.name,
+                "quantized mode lowered NO integer reduce site: the "
+                "int8 lanes are being accumulated in float (the "
+                "compressed wire format silently widened)",
+                hint="route the reduction through parallel.sharding."
+                     "_quantize / quantized_psum_mean so the integer "
+                     "accumulator survives lowering")
+        report.extend(contract.check(sig))
+    report.signature = sig
+    return report

@@ -25,6 +25,15 @@ partitioning   PAR01 unknown mesh axis     PAR02 spec rank mismatch
                PAR05 pipeline imbalance    PAR06 per-chip HBM over budget
 retracing      RTC01 varying trace-key arg RTC02 unhashable static arg
                RTC03 shape-polymorphic feed
+collectives    COL01 collective under divergent control flow
+               COL02 collective axis unknown to the mesh
+               COL03 quantized-accumulator dtype disagreement
+               COL04 declared-vs-lowered signature drift
+               COL05 analytic-vs-measured collective bytes divergence
+               COL06 malformed ppermute ring
+threads        THR01 guarded state accessed outside its lock
+               THR02 lock-order inversion  THR03 blocking call under lock
+               THR04 unguarded lazy init of shared state
 """
 
 from __future__ import annotations
@@ -64,6 +73,20 @@ ALL_CODES = {
     "RTC01": "jit call site keyed on a varying Python value (retrace loop)",
     "RTC02": "unhashable/mutable value passed for a static jit argument",
     "RTC03": "shape-polymorphic argument stream forces retracing",
+    "COL01": "collective under data-dependent control flow (SPMD deadlock "
+             "hazard)",
+    "COL02": "collective reduces over an axis the mesh does not carry",
+    "COL03": "quantized-accumulator dtype disagrees between analyzer, "
+             "bill and lowering",
+    "COL04": "lowered collective signature drifted from the declared "
+             "CollectiveContract",
+    "COL05": "measured collective bytes diverge >tolerance from the "
+             "analytic bill",
+    "COL06": "ppermute perm is not a permutation (or carries self-cycles)",
+    "THR01": "shared guarded attribute accessed outside its lock",
+    "THR02": "lock-order inversion in the acquired-while-held graph",
+    "THR03": "blocking call while holding a lock",
+    "THR04": "unguarded lazy initialization of shared state",
 }
 
 

@@ -576,39 +576,14 @@ def pairwise_sq_dists(a: DistributedMatrix, b):
 # collective accounting + warm start
 # ----------------------------------------------------------------------
 
-_COLLECTIVE_PRIMS = ("psum", "all_gather", "ppermute", "psum_scatter",
-                     "reduce_scatter", "all_to_all", "pmin", "pmax")
-
-
-def collective_counts(fn, *args):
-    """Static collective-site counts of one traceable function: walk
-    the jaxpr (including shard_map / loop sub-jaxprs) and tally named
-    collectives. Sites, not dispatches — a ppermute inside a
-    fori_loop counts once. The dryrun/test contract asserts these so a
-    refactor cannot silently change a routine's communication shape."""
-    closed = jax.make_jaxpr(fn)(*args)
-    counts = {}
-
-    def iter_jaxprs(v):
-        if hasattr(v, "jaxpr"):
-            yield v.jaxpr
-        elif hasattr(v, "eqns"):
-            yield v
-        elif isinstance(v, (list, tuple)):
-            for x in v:
-                yield from iter_jaxprs(x)
-
-    def walk(jx):
-        for eqn in jx.eqns:
-            name = eqn.primitive.name
-            if name in _COLLECTIVE_PRIMS:
-                counts[name] = counts.get(name, 0) + 1
-            for v in eqn.params.values():
-                for sub in iter_jaxprs(v):
-                    walk(sub)
-
-    walk(closed.jaxpr)
-    return counts
+# Hoisted to the shared analysis tier (PR 14): the collective-site
+# walker grew into the full pass-7 signature verifier
+# (analysis/collectives.py — ordered signatures, COL01-06 checks,
+# CollectiveContract). Re-exported here unchanged so every existing
+# `linalg.collective_counts` call site keeps working.
+from deeplearning4j_tpu.analysis.collectives import (  # noqa: E402,F401
+    COLLECTIVE_PRIMS as _COLLECTIVE_PRIMS, collective_counts,
+)
 
 
 def precompile(mesh, m, k, n, dtype=np.float32, row_axis=ROW_AXIS,

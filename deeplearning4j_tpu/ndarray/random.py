@@ -32,7 +32,8 @@ class DefaultRandom:
             self._key = None
 
     def getSeed(self) -> int:
-        return self._seed
+        with self._lock:
+            return self._seed
 
     def nextKey(self) -> jax.Array:
         with self._lock:

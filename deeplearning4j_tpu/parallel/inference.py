@@ -318,11 +318,16 @@ class ParallelInference:
         return outs if len(outs) > 1 else outs[0]
 
     def _ensure_batcher(self):
-        if self._batcher is not None:  # racing first requests must all
-            return self._batcher       # land on ONE batcher
+        # double-checked lazy init (the PR 8 race, fixed by the lock
+        # below; the lock-free fast path is the benign half): racing
+        # first requests must all land on ONE batcher
+        b = self._batcher  # thread-ok[THR01]: atomic reference read — the double-checked fast path; a stale None just falls through to the locked slow path
+        if b is not None:
+            return b
         with self._batcher_lock:
-            if self._batcher is not None:
-                return self._batcher
+            b = self._batcher
+            if b is not None:
+                return b
             from deeplearning4j_tpu.serving.queue import (
                 MicroBatcher, ServingClosedError)
 
@@ -333,7 +338,7 @@ class ParallelInference:
                 raise ServingClosedError(
                     "ParallelInference is closed")
 
-            self._batcher = MicroBatcher(
+            b = MicroBatcher(
                 self._dispatch_coalesced,
                 max_rows=max(self.batchBuckets),
                 queue_limit=self.queueLimit,
@@ -348,7 +353,8 @@ class ParallelInference:
                 clock=self._clock,
                 start_thread=self._clock is None,
                 name=self.metricsName)
-        return self._batcher
+            self._batcher = b
+        return b
 
     def close(self, drain=True):
         """Stop the BATCHED-mode queue (sync modes keep working). Taken

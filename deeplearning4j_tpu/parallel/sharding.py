@@ -531,7 +531,11 @@ def compressed_hlo_collective_bytes(leaf_elems, dp, compression,
     block = DEFAULT_COMPRESSION_BLOCK if block is None else int(block)
     capacity = DEFAULT_ENCODING_CAPACITY if capacity is None \
         else float(capacity)
-    acc = 2 if dp <= 256 else 4
+    # the bill and the lowering share ONE accumulator-width definition
+    # (_acc_dtype) so they cannot drift apart; the analyzer's COL03
+    # check (analysis.collectives.check_acc_dtype) cross-checks both
+    # against the dp<=256 int16 bound independently
+    acc = jnp.dtype(_acc_dtype(dp)).itemsize
     total = 0
     for n in leaf_elems:
         n = int(n)

@@ -35,7 +35,8 @@ class NativeLoader:
 
     def err(self):
         """Why lib() returned None (the load/build exception), or None."""
-        return self._err
+        with self._lock:
+            return self._err
 
     def lib(self):
         """The loaded library, or None if unavailable (no compiler)."""

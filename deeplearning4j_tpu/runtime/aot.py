@@ -58,6 +58,7 @@ import hashlib
 import os
 import pickle
 import tempfile
+import threading
 import time
 
 import jax
@@ -324,6 +325,12 @@ class ExecutableCache:
             # encodes, so the directory must be writable ONLY by the
             # trusting user — created 0700, files land 0600 (mkstemp)
             os.makedirs(self.directory, mode=0o700, exist_ok=True)
+        # serving threads drive get/put concurrently (every BATCHED
+        # dispatch and every handler-thread first request lands here);
+        # the stats counters are read-modify-write and the memory tier
+        # is check-then-insert, so both live under one lock (the THR01
+        # audit, ISSUE 14). Reentrant: note_miss can fire under get.
+        self._lock = threading.RLock()
         self._mem = {}
         self.stats = {"mem_hits": 0, "disk_hits": 0, "misses": 0,
                       "puts": 0, "stale": 0, "corrupt": 0,
@@ -332,21 +339,40 @@ class ExecutableCache:
         #: the CLI --precompile report reads this
         self.seconds = {}
 
+    def note_miss(self, key=None, seconds=None):
+        """Count one compile-path miss (and optionally its wall) — the
+        lock-safe increment every caller that pays a compile uses
+        (CachedJit, compile_lowered); bare `stats["misses"] += 1` from
+        another thread would lose counts and CompileWatch proofs with
+        them."""
+        with self._lock:
+            self.stats["misses"] += 1
+            if key is not None and seconds is not None:
+                self.seconds[key] = float(seconds)
+
     # -- paths ----------------------------------------------------------
     def _path(self, key):
         return os.path.join(self.directory, key + ".aotx")
 
     def __contains__(self, key):
-        return key in self._mem or (
-            self.directory is not None and os.path.exists(self._path(key)))
+        with self._lock:
+            if key in self._mem:
+                return True
+        return self.directory is not None \
+            and os.path.exists(self._path(key))
 
     # -- read -----------------------------------------------------------
     def get(self, key, ambient=None):
         """-> Compiled or None. Memory first; then disk (deserialize +
-        promote to memory). Stale/corrupted disk entries are removed."""
-        hit = self._mem.get(key)
+        promote to memory). Stale/corrupted disk entries are removed.
+        The disk load itself runs unlocked — two threads racing the
+        same cold key can both deserialize (a benign duplicate load);
+        the memory tier and counters stay consistent either way."""
+        with self._lock:
+            hit = self._mem.get(key)
+            if hit is not None:
+                self.stats["mem_hits"] += 1
         if hit is not None:
-            self.stats["mem_hits"] += 1
             _tm()["hits_mem"].inc()
             return hit
         if self.directory is None:
@@ -359,12 +385,14 @@ class ExecutableCache:
             with open(path, "rb") as fh:
                 meta, payload, in_tree, out_tree = pickle.load(fh)
         except Exception:
-            self.stats["corrupt"] += 1
+            with self._lock:
+                self.stats["corrupt"] += 1
             self._remove(path)
             return None
         amb = ambient if ambient is not None else ambient_fingerprint()
         if meta.get("ambient") != amb:
-            self.stats["stale"] += 1
+            with self._lock:
+                self.stats["stale"] += 1
             self._remove(path)
             return None
         try:
@@ -372,18 +400,20 @@ class ExecutableCache:
 
             compiled = _se.deserialize_and_load(payload, in_tree, out_tree)
         except Exception:
-            self.stats["corrupt"] += 1
+            with self._lock:
+                self.stats["corrupt"] += 1
             self._remove(path)
             return None
         dt = time.perf_counter() - t0
-        self.seconds[key] = dt
-        self.stats["disk_hits"] += 1
+        with self._lock:
+            self.seconds[key] = dt
+            self.stats["disk_hits"] += 1
+            self._mem[key] = compiled
         tm = _tm()
         tm["hits_disk"].inc()
         tm["load_s"].observe(dt)
         tm["reg"].trace.add("aot.deserialize", "compile", t0, dt,
                             {"key": key[:16]})
-        self._mem[key] = compiled
         return compiled
 
     @staticmethod
@@ -399,8 +429,9 @@ class ExecutableCache:
         serialize to disk atomically. Serialization failures are
         swallowed — the memory tier still works and the next process
         simply recompiles."""
-        self._mem[key] = compiled
-        self.stats["puts"] += 1
+        with self._lock:
+            self._mem[key] = compiled
+            self.stats["puts"] += 1
         if self.directory is None:
             return
         try:
@@ -408,7 +439,8 @@ class ExecutableCache:
 
             payload, in_tree, out_tree = _se.serialize(compiled)
             if len(payload) > self.max_artifact_bytes:
-                self.stats["oversize"] += 1
+                with self._lock:
+                    self.stats["oversize"] += 1
                 return
             meta = {"ambient":
                     ambient if ambient is not None else ambient_fingerprint(),
@@ -427,7 +459,8 @@ class ExecutableCache:
     def clear_memory(self):
         """Drop the in-process tier (tests simulate a second process by
         clearing memory and re-reading disk)."""
-        self._mem.clear()
+        with self._lock:
+            self._mem.clear()
 
     def clear(self):
         self.clear_memory()
@@ -544,10 +577,9 @@ def compile_lowered(lowered, key=None, cache=None, entry=None,
     else:
         compiled = cache.get(key)
         if compiled is None:
-            cache.stats["misses"] += 1
             t0 = time.perf_counter()
             compiled = lowered.compile()
-            cache.seconds[key] = _tm_compile(t0, key, entry)
+            cache.note_miss(key, _tm_compile(t0, key, entry))
             cache.put(key, compiled, entry=entry)
     if donate_argnums:
         return _AotCall(compiled, donate_argnums)
@@ -604,6 +636,18 @@ class CachedJit:
         # changes the traced program, so a change invalidates the
         # derived fingerprint + table (checked per call, id() cheap)
         self._seen_impl = object()
+        # serving handler threads dispatch through ONE CachedJit
+        # concurrently; the signature table is check-then-insert and a
+        # first-seen signature pays an XLA compile, so the entry path
+        # is single-flight PER SIGNATURE (the THR01/THR04 audit,
+        # ISSUE 14): the table holds a threading.Event while a
+        # signature's compile is in flight — a racing thread with the
+        # SAME signature waits on it instead of duplicating the
+        # compile, while warm traffic for other signatures keeps
+        # flowing (the lock itself only guards table metadata, never
+        # the compile). RLock: invalidate() may fire inside the locked
+        # metadata path via the impl-change check.
+        self._lock = threading.RLock()
 
     # -- key plumbing ----------------------------------------------------
     def pin_cache(self, cache):
@@ -617,7 +661,7 @@ class CachedJit:
         return self._pinned_cache if self._pinned_cache is not None \
             else session_cache()
 
-    def _base_fp(self):
+    def _base_fp_locked(self):
         if self._fp_failed:
             return None
         if self._fingerprint is None:
@@ -635,40 +679,67 @@ class CachedJit:
         """Forget the derived fingerprint + signature table (the owner's
         program identity changed, e.g. a weight-update hook was
         installed)."""
+        with self._lock:
+            self._invalidate_locked()
+        return self
+
+    def _invalidate_locked(self):
         if self._owner is not None:
             self._fingerprint = None
         self._fp_failed = False
         self._table.clear()
-        return self
 
-    def _check_impl(self):
+    def _check_impl_locked(self):
         if self._owner is None:
             return
         cur = id(getattr(self._owner, "_update_impl", None))
         if cur != self._seen_impl:
             self._seen_impl = cur
-            self.invalidate()
+            self._invalidate_locked()
 
     # -- dispatch --------------------------------------------------------
     def _entry_for(self, args, cache):
-        self._check_impl()
         sig = abstract_signature(args)
-        ent = self._table.get(sig)
-        if ent is None:
-            fp = self._base_fp()
-            if fp is None:
-                return None, None
+        while True:
+            with self._lock:
+                self._check_impl_locked()
+                ent = self._table.get(sig)
+                if ent is None:
+                    fp = self._base_fp_locked()
+                    if fp is None:
+                        return None, None
+                    marker = threading.Event()
+                    self._table[sig] = marker   # we own this compile
+                    break
+                if not isinstance(ent, threading.Event):
+                    return ent
+                in_flight = ent
+            # another thread is compiling THIS signature: wait outside
+            # the lock, then re-read (its entry, or ownership if it
+            # failed / the table was invalidated mid-compile)
+            in_flight.wait()
+        try:
+            # the compile runs outside the lock — warm dispatches of
+            # OTHER signatures are never stalled behind it
             key = cache_key(fp, self._entry + self._extra, sig)
             compiled = cache.get(key)
             if compiled is None:
-                cache.stats["misses"] += 1
                 t0 = time.perf_counter()
                 compiled = self._bare.lower(*args).compile()
-                cache.seconds[key] = _tm_compile(t0, key, self._entry)
+                cache.note_miss(key, _tm_compile(t0, key, self._entry))
                 cache.put(key, compiled, entry=self._entry)
             ent = (_AotCall(compiled, self._donate), key)
-            self._table[sig] = ent
-        return ent
+            with self._lock:
+                if self._table.get(sig) is marker:
+                    self._table[sig] = ent
+            return ent
+        except BaseException:
+            with self._lock:
+                if self._table.get(sig) is marker:
+                    del self._table[sig]
+            raise
+        finally:
+            marker.set()   # wake waiters either way; they re-read
 
     def __call__(self, *args, **kwargs):
         cache = self._cache()
@@ -683,7 +754,8 @@ class CachedJit:
             # aval disagreement the signature didn't capture —
             # blacklist the entry so the plain jit owns this call
             # pattern from here on (no retry-per-call)
-            self._table[abstract_signature(args)] = (_BAD_ENTRY, None)
+            with self._lock:
+                self._table[abstract_signature(args)] = (_BAD_ENTRY, None)
             return self._fallback(*args)
 
     def warm(self, *args, cache=None):

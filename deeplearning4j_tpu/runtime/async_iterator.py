@@ -147,7 +147,7 @@ class AsyncDataSetIterator:
         if self._ring is None:
             # 2x + header margin: a padded final minibatch can carry mask
             # arrays the first batch lacks
-            self._ring = make_ring(self._queueSize, 2 * len(first) + 1024,
+            self._ring = make_ring(self._queueSize, 2 * len(first) + 1024,  # thread-ok[THR04]: single-consumer contract — _start_epoch only ever runs on the consumer thread; the producer receives the ring as an ARGUMENT precisely so it never races this attribute
                                    force_python=self._forcePython)
         else:
             self._ring.reopen()
@@ -332,7 +332,7 @@ class AsyncMultiDataSetIterator(AsyncDataSetIterator):
             return
         first = self._pack_mds(self._base.next())
         if self._ring is None:
-            self._ring = make_ring(self._queueSize, 2 * len(first) + 1024,
+            self._ring = make_ring(self._queueSize, 2 * len(first) + 1024,  # thread-ok[THR04]: single-consumer contract — see AsyncDataSetIterator._start_epoch; the producer gets the ring as an argument
                                    force_python=self._forcePython)
         else:
             self._ring.reopen()

@@ -236,11 +236,13 @@ class _Instrument:
         self._lock = threading.RLock()
         self._children = {}
         if not self.labelnames:
-            self._default = self._make_child({})
+            self._default = self._make_child_locked({})
         else:
             self._default = None
 
-    def _make_child(self, labels):
+    def _make_child_locked(self, labels):
+        # *_locked: caller holds self._lock (construction-time calls
+        # trivially satisfy it — the instance is unpublished)
         child = _Child(self, labels)
         self._children[tuple(labels.values())] = child
         return child
@@ -259,7 +261,7 @@ class _Instrument:
         with self._lock:
             child = self._children.get(key)
             if child is None:
-                child = self._make_child(
+                child = self._make_child_locked(
                     {ln: str(kv[ln]) for ln in self.labelnames})
         return child
 

@@ -30,6 +30,15 @@ import json
 import threading
 import time
 
+#: serializes HttpServerOwner start/stop across threads: two
+#: concurrent start() calls racing the `_httpd is None` check would
+#: each bind a ThreadingHTTPServer and leak one (the THR04 lazy-init
+#: shape). One module-level lock is enough — lifecycle flips are rare
+#: and never sit on a request path. (HttpServerOwner is a mixin with
+#: no __init__ of its own, so a per-instance lock has nowhere safe to
+#: be born.)
+_LIFECYCLE_LOCK = threading.Lock()
+
 
 class HttpError(Exception):
     """Typed HTTP failure a handler raises to answer a specific status
@@ -101,7 +110,7 @@ class JsonHandler(http.server.BaseHTTPRequestHandler):
                     return  # deadline already answered 503 for us
                 self._responded = True
         else:
-            self._responded = True  # the dispatch safety net checks it
+            self._responded = True  # thread-ok[THR01]: no-deadline mode — this request runs on exactly one handler thread; the lock (and its writers) only exist in deadline mode
         self._record_metrics(code)
         self.send_response(code)
         self.send_header("Content-Type", ctype)
@@ -157,10 +166,10 @@ class JsonHandler(http.server.BaseHTTPRequestHandler):
             try:
                 return impl()
             except HttpError as e:
-                if not self._responded:
+                if not self._responded:  # thread-ok[THR01]: no-deadline mode — one handler thread per request; the lock (and its writers) only exist in deadline mode
                     self._json({"error": e.message}, e.code)
             except Exception as e:
-                if not self._responded:
+                if not self._responded:  # thread-ok[THR01]: no-deadline mode — one handler thread per request; the lock (and its writers) only exist in deadline mode
                     self._json({"error": f"{type(e).__name__}: {e}"}, 500)
             return None
         # deadline mode: the handler body runs on a watched daemon
@@ -224,18 +233,20 @@ class HttpServerOwner:
     @property
     def port(self):
         """Bound port once started (pass port=0 for an ephemeral one)."""
-        return self._httpd.server_address[1] if self._httpd else None
+        httpd = self._httpd  # thread-ok[THR01]: atomic reference read; a probe racing stop() sees the old server or None, both valid answers
+        return httpd.server_address[1] if httpd else None
 
     @property
     def ready(self) -> bool:
         """What /healthz answers: started AND not administratively
         drained via setReady(False)."""
-        return self._httpd is not None and self._ready
+        return self._httpd is not None and self._ready  # thread-ok[THR01]: atomic reads; readiness is advisory and a stale answer is indistinguishable from probing a moment earlier
 
     def setReady(self, ready: bool):
         """Flip readiness without stopping the server (drain traffic
         during an index rebuild / model swap)."""
-        self._ready = bool(ready)
+        with _LIFECYCLE_LOCK:
+            self._ready = bool(ready)
         return self
 
     def _serve(self, handler_cls, port, requestDeadline=None,
@@ -247,18 +258,27 @@ class HttpServerOwner:
         executables are hot (pair with ``model.precompile`` /
         ``ParallelInference.precompile``, docs/COMPILE.md). A warmup
         failure leaves the server unready rather than crashing it."""
-        if self._httpd is not None:
-            return self
-        if requestDeadline is not None:
-            self.requestDeadline = float(requestDeadline) or None
-        self._warmup_error = None
-        self._ready = warmup is None  # a restart clears any previous drain
-        self._httpd = http.server.ThreadingHTTPServer(
-            ("127.0.0.1", port), handler_cls)
-        self._httpd.owner = self
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True)
-        self._thread.start()
+        with _LIFECYCLE_LOCK:
+            # double-checked under the lifecycle lock: concurrent
+            # start() calls must agree on ONE server instead of each
+            # binding (and one leaking) — the PR 8 lazy-init shape
+            if self._httpd is not None:
+                return self
+            if requestDeadline is not None:
+                self.requestDeadline = float(requestDeadline) or None
+            self._warmup_error = None
+            self._ready = warmup is None  # restart clears a previous drain
+            self._httpd = http.server.ThreadingHTTPServer(
+                ("127.0.0.1", port), handler_cls)
+            self._httpd.owner = self
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, daemon=True)
+            self._thread.start()
+            # generation tag: a warmup outlives stop()/restart, and a
+            # STALE one finishing must not mark the NEW server ready
+            # (or stamp its error onto it) — publish only if the
+            # server it warmed is still the live one
+            httpd = self._httpd
         if warmup is not None:
             def _warm():
                 try:
@@ -267,16 +287,30 @@ class HttpServerOwner:
                     # stay unready; /healthz carries the reason so 503
                     # "still warming" and 503 "warmup crashed" are
                     # distinguishable from outside the pod
-                    self._warmup_error = f"{type(e).__name__}: {e}"[:500]
+                    with _LIFECYCLE_LOCK:
+                        if self._httpd is httpd:
+                            self._warmup_error = \
+                                f"{type(e).__name__}: {e}"[:500]
                     return
-                self._ready = True
+                with _LIFECYCLE_LOCK:
+                    if self._httpd is httpd:
+                        self._ready = True
 
             threading.Thread(target=_warm, daemon=True).start()
         return self
 
     def stop(self):
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
-            self._thread = None
+        with _LIFECYCLE_LOCK:
+            httpd = self._httpd
+            if httpd is not None:
+                # close BEFORE publishing _httpd = None: a restart
+                # racing this stop must not observe "no server" while
+                # the old socket still listens (bind would raise
+                # EADDRINUSE). shutdown() only stops the accept loop
+                # (<= its 0.5 s poll; it does not wait for handler
+                # threads), so holding the lifecycle lock across it is
+                # bounded.
+                httpd.shutdown()
+                httpd.server_close()
+                self._httpd = None
+                self._thread = None

@@ -113,7 +113,9 @@ class OpProfiler:
     def printOutDashboard(self) -> str:
         lines = [f"{'section':<28}{'calls':>7}{'compile_s':>11}"
                  f"{'steady_avg_ms':>15}{'total_s':>9}"]
-        for name in sorted(self._first):
+        with self._lock:
+            names = sorted(self._first)  # snapshot vs concurrent sections
+        for name in names:
             lines.append(f"{name:<28}{self.invocations(name):>7}"
                          f"{self.compileTime(name):>11.3f}"
                          f"{self.averageTime(name) * 1e3:>15.3f}"

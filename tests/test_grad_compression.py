@@ -438,24 +438,29 @@ class TestMeasuredCollectiveBytes:
     @pytest.mark.parametrize("mode", ["int8", "block_int8", "threshold"])
     def test_replicated_modes_within_10pct(self, mode,
                                            compiled_compressed_steps):
+        from deeplearning4j_tpu.analysis.collectives import check_bill
+
         net, pw, compiled = compiled_compressed_steps[mode]
         measured = self._measured(compiled, net)
         model = compressed_hlo_collective_bytes(
             self._leaf_elems(net), DP, mode,
             capacity=pw.encoding_capacity)
-        assert measured == pytest.approx(model, rel=0.10), (
-            f"{mode}: measured collective bytes {measured} vs analytic "
-            f"bill {model}")
+        # the reusable COL05 gate (analysis.collectives, ISSUE 14)
+        rep = check_bill(measured, model, rel=0.10, where=mode)
+        assert rep.ok, rep.format()
 
     def test_composed_mode_within_10pct(self, compiled_compressed_steps):
+        from deeplearning4j_tpu.analysis.collectives import check_bill
+
         net, pw, compiled = compiled_compressed_steps["block_int8+zero"]
         measured = self._measured(compiled, net)
         z = pw._zero
         model = compressed_hlo_collective_bytes(
             self._leaf_elems(net), DP, "block_int8", sharded=True,
             eligible=lambda n: n >= 1024 and n % DP == 0)
-        assert measured == pytest.approx(model, rel=0.10), (
-            f"composed: measured {measured} vs bill {model}")
+        rep = check_bill(measured, model, rel=0.10,
+                         where="block_int8+zero")
+        assert rep.ok, rep.format()
         assert z is not None
 
     def test_block_int8_wire_under_30pct_of_dense(self):

@@ -535,13 +535,17 @@ class TestMeasuredWeightUpdateBin:
                                       sharded=True)
         # XLA:CPU lowering: all-reduce + local slice + param all-gather
         # over the eligible bytes; replicate-fallback leaves keep the
-        # plain 2G all-reduce
+        # plain 2G all-reduce. Gated through the reusable COL05 check
+        # (analysis.collectives.check_bill, ISSUE 14).
+        from deeplearning4j_tpu.analysis.collectives import check_bill
+
         model = bill["hlo_collective_bytes"]["all_reduce_gather"] \
             + 2 * rep
-        assert measured == pytest.approx(model, rel=0.10), (
-            f"sharded weight_update collective bin {measured} B is "
-            f"outside 10% of the analytic bill {model} B — the ZeRO "
-            "update's collective traffic regressed")
+        rep_bill = check_bill(measured, model, rel=0.10,
+                              where="zero sharded weight_update bin")
+        assert rep_bill.ok, (
+            f"{rep_bill.format()} — the ZeRO update's collective "
+            "traffic regressed")
 
     def test_per_chip_state_within_10pct_of_bill(self,
                                                  sharded_step_subject):
