@@ -1444,6 +1444,190 @@ def bench_autotune(timeout_s=420):
     return rec
 
 
+_SERVING_FLEET_CHILD = r"""
+import json, os, threading, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+    MultiLayerNetwork, DenseLayer, OutputLayer, Nesterovs)
+from deeplearning4j_tpu.nn.conf.recurrent import LSTM
+from deeplearning4j_tpu.nn.conf.layers import RnnOutputLayer
+from deeplearning4j_tpu.parallel.mesh import build_mesh
+from deeplearning4j_tpu.runtime import aot
+from deeplearning4j_tpu.serving import (ModelHost, FleetRouter,
+    SequenceScheduler, loadgen)
+from deeplearning4j_tpu.serving.fleet import (scenario_diurnal_ramp,
+    scenario_hot_model_skew, scenario_slow_client_storm)
+
+aot._SESSION = aot.ExecutableCache(None)   # cold, memory-only
+aot._SESSION_INIT = True
+rec = {}
+rng = np.random.RandomState(0)
+mesh = build_mesh({"data": 1})
+
+def mlp_conf(seed):
+    return (NeuralNetConfiguration.Builder().seed(seed)
+            .updater(Nesterovs(0.1, 0.9)).list()
+            .layer(DenseLayer(nOut=16, activation="relu"))
+            .layer(OutputLayer(nOut=4, activation="softmax",
+                               lossFunction="mcxent"))
+            .setInputType(InputType.feedForward(8)).build())
+
+hot = MultiLayerNetwork(mlp_conf(7)).init()
+cold = MultiLayerNetwork(mlp_conf(11)).init()
+
+def mk_host():
+    h = ModelHost(mesh=mesh)
+    h.register("hot", hot, batchBuckets=(16, 64), queueLimit=1024,
+               maxWaitMs=2.0)
+    h.register("cold", cold, batchBuckets=(16, 64), queueLimit=1024,
+               maxWaitMs=2.0)
+    return h
+
+def one_row(i):
+    return rng.randn(1, 8).astype(np.float32)
+
+def drive(router, n, rate, seed):
+    return loadgen.run_open_loop(
+        lambda x: router.submit("hot", x), lambda i: one_row(i),
+        rate=rate, n_requests=n, seed=seed, max_clients=24)
+
+# ---- fleet vs single replica (same open-loop rate) ----
+single = FleetRouter([mk_host()])
+single.submit("hot", one_row(0))
+t0 = time.perf_counter()
+for i in range(24):
+    single.submit("hot", one_row(i))
+rate = round(max(200.0, 8.0 * 24 / (time.perf_counter() - t0)), 1)
+rs = drive(single, 192, rate, seed=0)
+single.close()
+fleet = FleetRouter([mk_host() for _ in range(3)])
+with aot.CompileWatch() as watch:
+    rb = drive(fleet, 192, rate, seed=1)
+rec["fleet_vs_single"] = {
+    "open_loop_rate_rps": rate,
+    "replicas": 3,
+    "single_rps": rs["requests_per_sec"],
+    "single_p99_ms": rs.get("p99_ms"),
+    "fleet_rps": rb["requests_per_sec"],
+    "fleet_p50_ms": rb.get("p50_ms"),
+    "fleet_p99_ms": rb.get("p99_ms"),
+    "single_errors": rs["errors"], "fleet_errors": rb["errors"],
+    "speedup_vs_single": round(rb["requests_per_sec"]
+                               / rs["requests_per_sec"], 2)
+    if rb["requests_per_sec"] and rs["requests_per_sec"] else None,
+    "request_path_compiles": watch.misses,
+    "note": ("all replicas share ONE CPU device: the CPU fleet ratio "
+             "measures routing+queue-capacity overhead, not compute "
+             "scale-out — a live multi-host window measures the "
+             "latter"),
+}
+
+# ---- load scenarios (fleet-level rps/p99 + error classes) ----
+rec["scenarios"] = {}
+r = scenario_diurnal_ramp(lambda x: fleet.submit("hot", x), one_row,
+                          base_rate=rate / 4, peak_rate=rate,
+                          phases=3, requests_per_phase=48, seed=2)
+rec["scenarios"]["diurnal_ramp"] = {k: r[k] for k in
+    ("requests_per_sec", "p99_ms", "completed", "errors")}
+r = scenario_hot_model_skew(
+    lambda n: (lambda x: fleet.submit(n, x)), one_row,
+    models=["hot", "cold"], hot_fraction=0.8, rate=rate / 2,
+    n_requests=96, seed=3)
+rec["scenarios"]["hot_model_skew"] = {
+    "per_model": r["per_model"], "completed": r["completed"],
+    "errors": r["errors"], "p99_ms": r.get("p99_ms")}
+r = scenario_slow_client_storm(
+    lambda x: fleet.submit("hot", x), lambda c, i: one_row(i),
+    n_clients=24, requests_per_client=4, think_time_s=0.005, seed=4)
+rec["scenarios"]["slow_client_storm"] = {k: r[k] for k in
+    ("requests_per_sec", "p99_ms", "completed", "errors", "clients")}
+rec["fleet_metrics"] = {
+    "replicas": {rid: v["queue_depth"]
+                 for rid, v in fleet.metrics_snapshot()["replicas"]
+                 .items()},
+}
+fleet.close()
+
+# ---- iteration-level vs run-to-completion decode throughput ----
+rconf = (NeuralNetConfiguration.Builder().seed(5)
+         .updater(Nesterovs(0.1, 0.9)).list()
+         .layer(LSTM(nOut=32))
+         .layer(RnnOutputLayer(nOut=16, activation="softmax",
+                               lossFunction="mcxent"))
+         .setInputType(InputType.recurrent(16, 12)).build())
+# mixed-length workload with straggler skew (the regime iteration-
+# level scheduling exists for): mostly short sequences + long
+# stragglers interleaved, so every run-to-completion gang batch pads
+# its short members to a straggler's length while the iteration-level
+# table refills the freed slots mid-sequence
+lens = [24, 2, 2, 2, 2, 2] * 8
+seqs = [rng.randn(t, 16).astype(np.float32) for t in lens]
+ab = {}
+for mode in ("step", "gang"):
+    net = MultiLayerNetwork(rconf).init()
+    sched = SequenceScheduler(net, slot_buckets=(8,), queue_limit=64,
+                              admission=mode, start_thread=False)
+    sched.warm()
+    with aot.CompileWatch() as watch:
+        t0 = time.perf_counter()
+        reqs = [sched.submit(s, wait=False) for s in seqs]
+        sched.drain()
+        wall = time.perf_counter() - t0
+    st = sched.stats
+    ab[mode] = {
+        "wall_s": round(wall, 4),
+        "dispatches": st["dispatches"],
+        "slot_steps": st["slot_steps"],
+        "tokens_per_sec": round(st["slot_steps"] / wall, 1),
+        "mid_sequence_refills": st["refills"],
+        "occupancy": sched.occupancy_summary(),
+        "steady_state_compiles": watch.misses,
+    }
+    sched.close()
+rec["iteration_vs_gang"] = dict(ab, speedup=round(
+    ab["step"]["tokens_per_sec"] / ab["gang"]["tokens_per_sec"], 2))
+print("FLEETREC " + json.dumps(rec), flush=True)
+"""
+
+
+def bench_serving_fleet(timeout_s=420):
+    """Multi-host serving fleet + iteration-level sequence batching
+    (serving/fleet.py + serving/sequence.py, docs/SERVING.md): fleet
+    requests/sec + p99 vs a single replica under the same open-loop
+    rate, the three load scenarios (diurnal ramp, hot-model skew,
+    slow-client storm) with per-error-class counts, and the
+    iteration-level vs run-to-completion decode-throughput A/B on a
+    mixed-length recurrent workload. CPU-pinned subprocess BY DESIGN
+    (grad_sharing's pattern — never touches the chip, banks on a dead
+    tunnel): the levers measured are host-side scheduling ratios."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    try:
+        r = subprocess.run([sys.executable, "-c", _SERVING_FLEET_CHILD],
+                           capture_output=True, text=True, cwd=here,
+                           env=env, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {"error": f"serving_fleet exceeded {timeout_s}s"}
+    line = next((ln for ln in (r.stdout or "").splitlines()
+                 if ln.startswith("FLEETREC ")), None)
+    if line is None:
+        return {"error": (r.stderr or r.stdout or
+                          f"exit {r.returncode}").strip()[-300:]}
+    rec = json.loads(line[len("FLEETREC "):])
+    rec["note"] = (
+        "CPU rehearsal of the fleet tier: least-loaded routing over 3 "
+        "in-process ModelHost replicas + the Orca-style "
+        "iteration-level scheduler vs run-to-completion batching "
+        "(slot table, per-step rebatch, mid-sequence refill) — the "
+        ">=2x decode-throughput gate's bench twin (docs/SERVING.md)")
+    return rec
+
+
 def bench_serving():
     """Continuous-batching model server (ROADMAP item 3, docs/SERVING.md):
     open-loop Poisson load through the request queue + dynamic
@@ -2002,6 +2186,12 @@ def _emit_tunnel_dead(reason):
         _CONFIGS["autotune"] = bench_autotune(min(_budget(300), 420))
     except Exception as e:
         _CONFIGS["autotune"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    try:  # CPU-pinned like grad_sharing: banks on a dead tunnel too
+        _CONFIGS["serving_fleet"] = bench_serving_fleet(
+            min(_budget(300), 420))
+    except Exception as e:
+        _CONFIGS["serving_fleet"] = {
+            "error": f"{type(e).__name__}: {e}"[:300]}
     _error_line(f"tunnel_dead: {reason}")
 
 
@@ -2055,6 +2245,19 @@ def main():
         except Exception as e:
             configs["autotune"] = {
                 "error": f"{type(e).__name__}: {e}"[:300]}
+    # serving fleet + iteration-level sequence A/B: CPU-pinned
+    # subprocess like grad_sharing (tunnel_dead-safe by construction)
+    budget = _budget(450)
+    if budget < 45:
+        configs["serving_fleet"] = {
+            "error": "skipped: bench deadline reached"}
+    else:
+        try:
+            configs["serving_fleet"] = bench_serving_fleet(
+                min(budget, 420))
+        except Exception as e:
+            configs["serving_fleet"] = {
+                "error": f"{type(e).__name__}: {e}"[:300]}
     img_per_sec = headline["images_per_sec"]
     line = {
         "metric": "resnet50_train_images_per_sec_per_chip",
@@ -2090,6 +2293,15 @@ def main():
             "amortization", {}).get("batched_rps"),
         "serving_speedup_vs_serial": configs.get("serving", {}).get(
             "amortization", {}).get("speedup_vs_serial"),
+        # sequence serving + fleet (round 15, ISSUE 15): fleet-level
+        # requests/sec over 3 replicas and the iteration-level vs
+        # run-to-completion decode-throughput ratio — top level so
+        # BENCH_r15+ is attributable; None when the CPU-pinned leg
+        # errored (tunnel_dead-safe)
+        "fleet_rps": configs.get("serving_fleet", {}).get(
+            "fleet_vs_single", {}).get("fleet_rps"),
+        "sequence_decode_speedup": configs.get("serving_fleet", {}).get(
+            "iteration_vs_gang", {}).get("speedup"),
         # autotune arbiter (round 12, ISSUE 12): tuned-vs-stock
         # attributed bytes/step for the LeNet b64 attribution subject
         # (the ratcheted-ceiling gate's measurement) and the measured

@@ -661,6 +661,11 @@ class MultiLayerNetwork:
                                            entry="forward_infer")
         self._jit_loss = aot.cached_jit(self._loss_only, owner=self,
                                         entry="loss_only")
+        # functional slot-batched decode step (rnnStepBatched): its own
+        # AOT entry so the sequence-serving tier compiles one executable
+        # per slot bucket, shared across equal-config models
+        self._jit_rnn_step = aot.cached_jit(self._rnn_step, owner=self,
+                                            entry="rnn_step")
 
     def _make_jit_train(self, step_fn=None):
         """The canonical jit of the train step. Factored out so
@@ -1528,6 +1533,90 @@ class MultiLayerNetwork:
 
     def rnnClearPreviousState(self):
         self._rnn_state = None
+
+    # ----- functional slot-batched rnn step (serving/sequence.py) -----
+    def rnnCarrySpec(self):
+        """Per-layer carry-key tuples for the functional stepwise path:
+        ``("h", "c")`` for LSTM-family layers, ``("h",)`` for
+        SimpleRnn/GRU, ``()`` for everything else. Raises for nets whose
+        recurrent state is not a flat per-layer h/c carry (Bidirectional
+        needs the whole sequence, so stepwise decode is ill-defined for
+        it — same limit the stateful ``rnnTimeStep`` has, made loud)."""
+        from deeplearning4j_tpu.nn.conf import recurrent as R
+
+        spec = []
+        for i, layer in enumerate(self.layers):
+            if isinstance(layer, (R.Bidirectional, R.LastTimeStep)):
+                raise ValueError(
+                    f"layer {i} ({type(layer).__name__}) wraps its "
+                    "recurrent state: stepwise decode needs causal "
+                    "flat h/c carries (LSTM/GravesLSTM/SimpleRnn/GRU)")
+            if isinstance(layer, R.LSTM):
+                spec.append(("h", "c"))
+            elif isinstance(layer, R.BaseRecurrentLayer):
+                spec.append(("h",))
+            else:
+                spec.append(())
+        if not any(spec):
+            raise ValueError(
+                "no recurrent layers: nothing carries state between "
+                "steps — serve this net through the one-shot tier")
+        return tuple(spec)
+
+    def rnnCarryZeros(self, batch):
+        """Materialized zero carries (``[batch, nOut]`` per recurrent
+        layer, compute dtype) — the state a fresh sequence starts from.
+        Bitwise the state the scans synthesize from ``h0=None``, but as
+        explicit arrays so the slot scheduler can gather/scatter them
+        and the stepwise jit signature stays fixed per slot bucket."""
+        out = []
+        for keys, layer in zip(self.rnnCarrySpec(), self.layers):
+            H = getattr(layer, "nOut", None)
+            out.append({k: jnp.zeros((int(batch), int(H)),
+                                     self._compute_dtype) for k in keys})
+        return out
+
+    def _rnn_step(self, params, states, carries, x):
+        """PURE single-timestep forward: x [S, F] (one step per slot
+        row), carries = rnnCarrySpec-shaped h/c arrays [S, H]. Returns
+        (out [S, O], new_carries). The functional twin of rnnTimeStep —
+        no ``self._rnn_state`` mutation, so one jitted executable per
+        slot-count bucket serves ANY occupancy: rows are independent
+        (per-row matmuls + elementwise cells), a zero-padded slot can
+        never perturb a live one."""
+        h = self._entry(x[:, :, None])
+        spec = self.rnnCarrySpec()
+        new_carries = []
+        for i, layer in enumerate(self.layers):
+            pp = self.conf.preprocessors.get(i)
+            if pp is not None:
+                if hasattr(pp, "batch"):
+                    pp.batch = x.shape[0]
+                h = pp.preProcess(h, None)
+            if i == len(self.layers) - 1 \
+                    and isinstance(layer, (L.BaseOutputLayer, L.LossLayer)):
+                pre = layer.preoutput(self._cast_params(params[i]), h)
+                h = self._out_act(layer, pre)
+                new_carries.append({})
+                break
+            st = {**states[i], **carries[i]}
+            h, s = layer.forward(self._cast_params(params[i]), st, h,
+                                 False, None, None)
+            new_carries.append({k: s[k] for k in spec[i]})
+        if h.ndim == 3:
+            h = h[:, :, 0]
+        return h, new_carries
+
+    def rnnStepBatched(self, x, carries):
+        """One decode step for a slot batch: x [S, F] (slot-count-
+        bucketed), carries from rnnCarryZeros/previous steps. Returns
+        (out [S, O] jax array, new_carries). Jitted through the AOT
+        executable cache (entry ``rnn_step``) — one compile per slot
+        bucket, warmable via ``CachedJit.warm`` before traffic
+        (serving/sequence.py does exactly that)."""
+        return self._jit_rnn_step(self._params,
+                                  self._strip_carries(self._states),
+                                  carries, jnp.asarray(x))
 
     # ----- introspection ----------------------------------------------
     def params(self) -> INDArray:

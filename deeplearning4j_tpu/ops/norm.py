@@ -181,7 +181,10 @@ _LEAKY_ALPHA = None
 def _leaky_alpha():
     global _LEAKY_ALPHA  # purity-ok[PUR04]: deterministic memo of a module constant — same float every process, trace-time write is benign
     if _LEAKY_ALPHA is None:
-        _LEAKY_ALPHA = float(-_registry_act("leakyrelu")(-1.0))
+        # the registry function is jitted: a first call that lands
+        # inside an outer trace would hand float() a tracer
+        with jax.ensure_compile_time_eval():
+            _LEAKY_ALPHA = float(-_registry_act("leakyrelu")(-1.0))
     return _LEAKY_ALPHA
 
 

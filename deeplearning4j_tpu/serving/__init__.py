@@ -1,25 +1,34 @@
-"""Continuous-batching model server.
+"""Continuous-batching model server + the sequence/fleet tier.
 
 The serving tier that amortizes XLA dispatches across concurrent
 requests (the classic throughput lever of large-scale serving systems,
 arXiv:1605.08695, applied on top of the one-executable-per-bucket
 compilation model of arXiv:1810.09868):
 
-* ``queue``   — bounded request queue + dynamic micro-batcher: coalesce
+* ``queue``    — bounded request queue + dynamic micro-batcher: coalesce
   waiting requests up to the nearest batch bucket (or a max-wait
   deadline), pad, run ONE dispatch through the per-bucket AOT
   executable cache, slice results back per request. Injectable clock
   so latency-path tests run deterministically without sleeps.
-* ``host``    — multi-model host: model name -> (network, dtype policy,
+* ``sequence`` — iteration-level continuous batching for STATEFUL
+  models: a slot table of active sequences with carried hidden/cell
+  state, the batch re-formed every decode step (early-exit slots
+  refilled from the queue mid-sequence), one executable per slot
+  bucket, per-step deadlines.
+* ``host``     — multi-model host: model name -> (network, dtype policy,
   optional weight-only int8, batch buckets), each precompiled at
   registration, with a rolling model swap that warms the new version's
-  executables while the old one keeps serving.
-* ``server``  — the HTTP front (``InferenceServer``): /healthz-gated
+  executables while the old one keeps serving; sequence models ride in
+  a parallel table behind the same contract.
+* ``fleet``    — N ModelHost replicas behind a least-loaded router:
+  per-model SLOs, queue-depth-driven autoscale DECISIONS (callback
+  surface), fleet-wide zero-5xx rolling swaps, load scenarios.
+* ``server``   — the HTTP front (``InferenceServer``): /healthz-gated
   readiness, queue-full backpressure as 429, per-request deadlines as
-  504.
-* ``loadgen`` — open-loop (Poisson-arrival) load generator recording
-  requests/sec, p50/p99 latency and batch occupancy — the `serving`
-  bench headline.
+  504, ``:predict`` (one-shot) and ``:generate`` (sequence) routes.
+* ``loadgen``  — open-loop (Poisson-arrival) and closed-loop (blocking
+  clients + think time) load generators recording requests/sec,
+  p50/p99 latency, per-error-class counts and batch occupancy.
 
 See docs/SERVING.md.
 """
@@ -28,13 +37,21 @@ from deeplearning4j_tpu.serving.queue import (  # noqa: F401
     DeadlineExceededError, InferenceRequest, ManualClock, MicroBatcher,
     QueueFullError, ServingClosedError,
 )
+from deeplearning4j_tpu.serving.sequence import (  # noqa: F401
+    SequenceRequest, SequenceScheduler, greedy_onehot_feedback,
+)
 from deeplearning4j_tpu.serving.host import (  # noqa: F401
-    ModelHost, ServedModel,
+    ModelHost, ServedModel, ServedSequenceModel,
+)
+from deeplearning4j_tpu.serving.fleet import (  # noqa: F401
+    FleetRouter, ModelSLO,
 )
 from deeplearning4j_tpu.serving.server import InferenceServer  # noqa: F401
 
 __all__ = [
     "DeadlineExceededError", "InferenceRequest", "ManualClock",
     "MicroBatcher", "QueueFullError", "ServingClosedError",
-    "ModelHost", "ServedModel", "InferenceServer",
+    "SequenceRequest", "SequenceScheduler", "greedy_onehot_feedback",
+    "ModelHost", "ServedModel", "ServedSequenceModel",
+    "FleetRouter", "ModelSLO", "InferenceServer",
 ]

@@ -1,0 +1,521 @@
+"""Multi-host serving fleet: N ModelHost replicas behind one router.
+
+One ``ModelHost`` is one serving process's worth of models (queue +
+micro-batcher + warm executables per model). Production traffic from
+millions of users needs N of them — and the pieces that make N hosts a
+FLEET are host-side and statically testable: least-loaded dispatch,
+per-model SLOs, queue-depth-driven autoscaling *decisions* (a callback
+surface — the fleet layer decides, an operator/orchestrator actuates;
+no real processes are spawned here), and rolling swaps that stay
+zero-5xx fleet-wide because each replica's swap already is
+(serving/host.py).
+
+* ``FleetRouter.submit`` picks the replica with the LEAST total queued
+  work for the target model (queue depth + live slot count for
+  sequence models) and fails over to the next-least-loaded on
+  ``QueueFullError`` — a single saturated replica sheds to its peers
+  before the client ever sees a 429; only a fleet-wide full queue
+  surfaces backpressure.
+* ``register``/``register_sequence`` fan a model out to every replica;
+  ``swap_all`` rolls a new version across replicas ONE AT A TIME (the
+  remaining replicas keep serving, each per-replica swap is itself
+  warm-then-flip) — fleet-wide zero-5xx rolling deploys.
+* ``set_slo`` declares per-model targets (p99 ms, queue-depth bounds,
+  replica min/max); ``autoscale_tick`` turns the live queue depths +
+  measured p99 into scale decisions and invokes every ``on_scale``
+  callback with a structured record.
+* ``metrics_snapshot`` is the fleet view: per-replica queue depth +
+  slot occupancy + per-model fleet aggregates, additive over the
+  per-host PR 13 snapshot schema.
+
+Load scenarios (the bench `serving_fleet` leg's vocabulary): diurnal
+ramp (open-loop rate swept through a day curve), hot-model skew (one
+model takes most of the traffic), slow-client storm (closed-loop
+clients with think time holding results). Each records fleet
+requests/sec, p50/p99 and per-error-class counts.
+
+See docs/SERVING.md "Sequence serving + the fleet".
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+
+import numpy as np
+
+from deeplearning4j_tpu.runtime import telemetry
+from deeplearning4j_tpu.serving.queue import QueueFullError
+
+__all__ = ["FleetRouter", "ModelSLO", "scenario_diurnal_ramp",
+           "scenario_hot_model_skew", "scenario_slow_client_storm"]
+
+_REPLICA_SEQ = itertools.count(1)
+
+
+class ModelSLO:
+    """Per-model service-level objective + autoscale thresholds.
+
+    p99_ms:       latency target; a measured fleet p99 above it votes
+                  scale_up.
+    queue_high:   mean per-replica queued work above this votes
+                  scale_up.
+    queue_low:    mean per-replica queued work below this votes
+                  scale_down (never below min_replicas).
+    min_replicas/max_replicas: the decision clamp.
+    """
+
+    __slots__ = ("p99_ms", "queue_high", "queue_low", "min_replicas",
+                 "max_replicas")
+
+    def __init__(self, p99_ms=None, queue_high=8.0, queue_low=1.0,
+                 min_replicas=1, max_replicas=8):
+        if float(queue_low) > float(queue_high):
+            raise ValueError(
+                f"queue_low {queue_low} > queue_high {queue_high}: the "
+                "scale-down band must sit below the scale-up band")
+        self.p99_ms = None if p99_ms is None else float(p99_ms)
+        self.queue_high = float(queue_high)
+        self.queue_low = float(queue_low)
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+
+    def as_dict(self):
+        return {"p99_ms": self.p99_ms, "queue_high": self.queue_high,
+                "queue_low": self.queue_low,
+                "min_replicas": self.min_replicas,
+                "max_replicas": self.max_replicas}
+
+
+class FleetRouter:
+    """Least-loaded router over N ModelHost replicas (module
+    docstring). Thread-safe: the replica table and SLO book are
+    lock-guarded; dispatches run outside the lock."""
+
+    def __init__(self, replicas=(), clock=None):
+        self._lock = threading.Lock()
+        self._replicas = {}        # id -> ModelHost
+        self._slos = {}            # model name -> ModelSLO
+        self._scale_cbs = []
+        self._clock = clock
+        reg = telemetry.get_registry()
+        self._registry = reg
+        self._m_requests = reg.counter(
+            "dl4j_fleet_requests_total",
+            "requests routed by the fleet router",
+            labels=("model",))
+        self._m_failover = reg.counter(
+            "dl4j_fleet_failovers_total",
+            "requests shed to a peer replica on a full queue",
+            labels=("model",))
+        self._m_latency = reg.histogram(
+            "dl4j_fleet_request_seconds",
+            "router-measured request latency (the SLO p99 source)",
+            labels=("model",))
+        self._m_replicas = reg.gauge(
+            "dl4j_fleet_replicas", "replicas registered to the fleet")
+        for host in replicas:
+            self.add_replica(host)
+
+    # -- replica lifecycle ----------------------------------------------
+    def add_replica(self, host, replica_id=None):
+        """Attach one ModelHost; returns its replica id."""
+        rid = str(replica_id) if replica_id else \
+            f"replica{next(_REPLICA_SEQ)}"
+        with self._lock:
+            if rid in self._replicas:
+                raise ValueError(f"replica {rid!r} already attached")
+            self._replicas[rid] = host
+            self._m_replicas.set(len(self._replicas))
+        return rid
+
+    def remove_replica(self, replica_id, drain=True):
+        """Detach + close one replica (drain=True completes its queued
+        work — the scale-down path)."""
+        with self._lock:
+            host = self._replicas.pop(replica_id, None)
+            self._m_replicas.set(len(self._replicas))
+        if host is None:
+            raise KeyError(f"unknown replica {replica_id!r} "
+                           f"(attached: {self.replica_ids()})")
+        host.close(drain=drain)
+        return host
+
+    def replica_ids(self):
+        with self._lock:
+            return sorted(self._replicas)
+
+    def _hosts(self):
+        with self._lock:
+            return list(self._replicas.items())
+
+    # -- model fan-out ---------------------------------------------------
+    def register(self, name, network, **kw):
+        """Register a one-shot model on EVERY replica (equal configs
+        share bucket executables through the AOT session cache, so N
+        replicas warm for the price of one compile set)."""
+        return {rid: host.register(name, network, **kw)
+                for rid, host in self._hosts()}
+
+    def register_sequence(self, name, network, **kw):
+        """Register a sequence (iteration-level) model on every
+        replica."""
+        return {rid: host.register_sequence(name, network, **kw)
+                for rid, host in self._hosts()}
+
+    def swap_all(self, name, network, **overrides):
+        """Fleet-wide rolling deploy: swap replicas ONE AT A TIME.
+        While replica i warms+flips, the other N-1 keep serving the old
+        version; each per-replica swap is itself warm-then-flip with a
+        drain (serving/host.py), so no request anywhere sees a cold
+        compile or a 5xx. Covers one-shot AND sequence models (each
+        host routes by its own registration kind)."""
+        out = {}
+        for rid, host in self._hosts():
+            kind = host.kind(name)
+            if kind == "sequence":
+                out[rid] = host.swap_sequence(name, network, **overrides)
+            elif kind == "oneshot":
+                out[rid] = host.swap(name, network, **overrides)
+            else:
+                raise KeyError(
+                    f"replica {rid!r} does not serve model {name!r} — "
+                    "register it fleet-wide before swap_all")
+        return out
+
+    # -- dispatch --------------------------------------------------------
+    @staticmethod
+    def _queued_work(host, name):
+        """Outstanding work this replica holds for `name`: one-shot
+        requests queued or inside a running dispatch, or queue depth +
+        live slots for a sequence model (the least-loaded ranking
+        key); None when the replica does not serve the model. A
+        point-in-time probe — routing tolerates staleness."""
+        return host.queued_work(name)
+
+    def _ranked(self, name):
+        """(replica_id, host) pairs serving `name`, least loaded
+        first."""
+        ranked = []
+        for rid, host in self._hosts():
+            load = self._queued_work(host, name)
+            if load is not None:
+                ranked.append((load, rid, host))
+        if not ranked:
+            raise KeyError(
+                f"no replica serves model {name!r} "
+                f"(replicas: {self.replica_ids()})")
+        ranked.sort(key=lambda t: (t[0], t[1]))
+        return [(rid, host) for _, rid, host in ranked]
+
+    def submit(self, name, features, deadline_s=None):
+        """Route one one-shot request to the least-loaded replica; on
+        QueueFullError fail over to the next-least-loaded. Only a
+        fleet-wide full queue re-raises (the client's 429)."""
+        t0 = self._now()
+        out = self._failover(
+            name, lambda host: host.submit(name, features,
+                                           deadline_s=deadline_s))
+        # observed only for COMPLETED requests: a 429 storm's fast
+        # failures must not dilute the p99 the autoscaler votes on
+        self._m_latency.labels(model=name).observe(self._now() - t0)
+        return out
+
+    def submit_sequence(self, name, features, deadline_s=None,
+                        extra_steps=0, wait=True, timeout=None):
+        """Route one sequence to the least-loaded replica's slot
+        scheduler (same failover discipline as submit)."""
+        t0 = self._now()
+        out = self._failover(
+            name, lambda host: host.submit_sequence(
+                name, features, deadline_s=deadline_s,
+                extra_steps=extra_steps, wait=wait, timeout=timeout))
+        if wait:
+            # wait=False returns at enqueue — that sample would read
+            # sub-ms and suppress the autoscaler's p99 scale-up vote
+            self._m_latency.labels(model=name).observe(self._now() - t0)
+        return out
+
+    def _failover(self, name, call):
+        self._m_requests.labels(model=name).inc()
+        ranked = self._ranked(name)
+        last = None
+        for i, (rid, host) in enumerate(ranked):
+            try:
+                return call(host)
+            except QueueFullError as e:
+                last = e
+                if i + 1 < len(ranked):  # shed to the next peer
+                    self._m_failover.labels(model=name).inc()
+        raise last
+
+    def _now(self):
+        return self._clock() if self._clock is not None \
+            else self._registry.clock()
+
+    # -- SLOs + autoscale decisions --------------------------------------
+    def set_slo(self, name, **kw):
+        """Declare the SLO for one model (ModelSLO kwargs)."""
+        slo = ModelSLO(**kw)
+        with self._lock:
+            self._slos[name] = slo
+        return slo
+
+    def slos(self):
+        with self._lock:
+            return {n: s.as_dict() for n, s in self._slos.items()}
+
+    def on_scale(self, callback):
+        """Register a scale-decision callback:
+        ``callback(decision_dict)``. The fleet layer only DECIDES —
+        spawning/retiring replica processes is the operator's
+        (orchestrator's) actuation, wired through this surface."""
+        with self._lock:
+            self._scale_cbs.append(callback)
+        return callback
+
+    def autoscale_tick(self):
+        """Evaluate every SLO'd model against the live fleet state and
+        emit scale decisions. Returns the decision list; each decision
+        was also passed to every on_scale callback.
+
+        Votes: mean per-replica queued work > queue_high -> up;
+        measured fleet p99 above the SLO target -> up; queued work <
+        queue_low -> down. The desired count is clamped to
+        [min_replicas, max_replicas]; "hold" decisions are returned but
+        NOT dispatched to callbacks (callbacks see actionable deltas
+        only)."""
+        with self._lock:
+            slos = dict(self._slos)
+            cbs = list(self._scale_cbs)
+        decisions = []
+        for name, slo in slos.items():
+            loads = []
+            for _, host in self._hosts():
+                load = self._queued_work(host, name)
+                if load is not None:
+                    loads.append(load)
+            if not loads:
+                continue
+            n = len(loads)
+            mean_load = sum(loads) / n
+            child = self._m_latency.labels_get(model=name)
+            p99_ms = None
+            if child is not None:
+                p99 = child.percentile(99)
+                p99_ms = None if p99 is None else p99 * 1000.0
+            reasons = []
+            if mean_load > slo.queue_high:
+                reasons.append(
+                    f"mean queued work {mean_load:.1f} > "
+                    f"queue_high {slo.queue_high:g}")
+            if slo.p99_ms is not None and p99_ms is not None \
+                    and p99_ms > slo.p99_ms:
+                reasons.append(
+                    f"p99 {p99_ms:.1f}ms > slo {slo.p99_ms:g}ms")
+            if reasons:
+                desired = n + 1
+            elif mean_load < slo.queue_low:
+                desired = n - 1
+                reasons.append(
+                    f"mean queued work {mean_load:.1f} < "
+                    f"queue_low {slo.queue_low:g}")
+            else:
+                desired = n
+            # the replica bounds outrank the votes — and when a clamp
+            # changes the direction (n already past a bound), the bound
+            # must be the recorded justification, not the vote
+            bounded = max(slo.min_replicas,
+                          min(desired, slo.max_replicas))
+            if bounded != desired:
+                clamp = (f"replica bound: desired {desired} clamped "
+                         f"to {bounded} (min {slo.min_replicas:g}, "
+                         f"max {slo.max_replicas:g})")
+                if (bounded > n) != (desired > n) or bounded == n:
+                    reasons = [clamp]
+                else:
+                    reasons.append(clamp)
+                desired = bounded
+            decision = {
+                "model": name,
+                "replicas": n,
+                "desired_replicas": desired,
+                "action": ("scale_up" if desired > n else
+                           "scale_down" if desired < n else "hold"),
+                "mean_queued_work": round(mean_load, 2),
+                "p99_ms": None if p99_ms is None else round(p99_ms, 2),
+                "reasons": reasons,
+                "slo": slo.as_dict(),
+            }
+            decisions.append(decision)
+            if decision["action"] != "hold":
+                self._registry.event("fleet.scale_decision", "serving",
+                                     **{k: v for k, v in decision.items()
+                                        if k not in ("slo", "reasons")})
+                for cb in cbs:
+                    cb(decision)
+        return decisions
+
+    # -- observability / lifecycle ---------------------------------------
+    def metrics_snapshot(self):
+        """The fleet view: per-replica queue depth + slot occupancy,
+        per-model fleet aggregates, the SLO book, and the process
+        registry — additive over the per-host snapshot schema
+        (docs/OBSERVABILITY.md)."""
+        per_replica = {}
+        fleet_models = {}
+        for rid, host in self._hosts():
+            snap = host.metrics_snapshot()
+            replica_depth = 0
+            for name, view in snap["models"].items():
+                replica_depth += view["queue_depth"]
+                agg = fleet_models.setdefault(
+                    name, {"kind": "oneshot", "queue_depth": 0,
+                           "replicas": 0})
+                agg["queue_depth"] += view["queue_depth"]
+                agg["replicas"] += 1
+            for name, view in snap.get("sequences", {}).items():
+                replica_depth += view["queue_depth"]
+                agg = fleet_models.setdefault(
+                    name, {"kind": "sequence", "queue_depth": 0,
+                           "active_slots": 0, "replicas": 0})
+                agg["queue_depth"] += view["queue_depth"]
+                agg["active_slots"] = agg.get("active_slots", 0) \
+                    + view["active_slots"]
+                agg["replicas"] += 1
+            per_replica[rid] = {
+                "queue_depth": replica_depth,
+                "models": snap["models"],
+                "sequences": snap.get("sequences", {}),
+            }
+        return {"registry": telemetry.get_registry().snapshot(),
+                "replicas": per_replica,
+                "models": fleet_models,
+                "slos": self.slos()}
+
+    def close(self, drain=True):
+        with self._lock:
+            hosts = list(self._replicas.values())
+            self._replicas.clear()
+            self._m_replicas.set(0)
+        for host in hosts:
+            host.close(drain=drain)
+
+
+# ----------------------------------------------------------------------
+# fleet load scenarios (the bench `serving_fleet` vocabulary)
+# ----------------------------------------------------------------------
+
+def scenario_diurnal_ramp(submit, make_request, *, base_rate,
+                          peak_rate, phases=5, requests_per_phase=64,
+                          seed=0, max_clients=16):
+    """Open-loop rate swept low -> peak -> low (a day curve compressed
+    into `phases` phases). Records per-phase rps/p50/p99 + error
+    classes and the whole-run aggregate."""
+    from deeplearning4j_tpu.serving import loadgen
+
+    if phases < 3:
+        # 2 phases would put both samples at the triangle's feet —
+        # base_rate twice, peak_rate never driven
+        raise ValueError(f"need >= 3 phases for a ramp, got {phases}")
+    # triangle curve: up to the peak and back down
+    half = (phases - 1) / 2.0
+    rates = [base_rate + (peak_rate - base_rate)
+             * (1.0 - abs(i - half) / half) for i in range(phases)]
+    recs = []
+    for i, rate in enumerate(rates):
+        recs.append(dict(loadgen.run_open_loop(
+            submit, make_request, rate=rate,
+            n_requests=requests_per_phase, seed=seed + i,
+            max_clients=max_clients), phase=i,
+            rate_rps=round(rate, 1)))
+    total = sum(r["completed"] for r in recs)
+    dur = sum(r["duration_s"] for r in recs)
+    errors = {}
+    for r in recs:
+        for k, v in r["errors"].items():
+            errors[k] = errors.get(k, 0) + v
+    p99s = [r["p99_ms"] for r in recs if r.get("p99_ms") is not None]
+    return {"scenario": "diurnal_ramp", "phases": recs,
+            "completed": total, "errors": errors,
+            "requests_per_sec": round(total / dur, 2) if dur else None,
+            "p99_ms": max(p99s) if p99s else None}
+
+
+def scenario_hot_model_skew(submit_for, make_request, *, models,
+                            hot_fraction=0.8, rate=200.0,
+                            n_requests=128, seed=0, max_clients=16):
+    """One model takes `hot_fraction` of the traffic, the rest split
+    the remainder — the skew that makes per-model least-loaded routing
+    earn its keep. submit_for(name) -> submit callable. Records
+    per-model rps/p99 + error classes."""
+    from deeplearning4j_tpu.serving import loadgen
+
+    models = list(models)
+    if len(models) < 2:
+        raise ValueError("hot-model skew needs >= 2 models")
+    hot, rest = models[0], models[1:]
+    rng = np.random.RandomState(seed)
+    picks = [hot if rng.rand() < hot_fraction
+             else rest[rng.randint(len(rest))]
+             for _ in range(n_requests)]
+
+    # route by request index: the loadgen drives (name, features)
+    # tuples so the per-model split is part of the seeded schedule
+    rec_by_model = {m: {"lat": [], "errors": {}} for m in models}
+    lock = threading.Lock()
+
+    def tagged_make(i):
+        return (picks[i], make_request(i))
+
+    def tagged_submit(req):
+        name, x = req
+        import time as _t
+
+        t0 = _t.monotonic()
+        try:
+            submit_for(name)(x)
+            with lock:
+                rec_by_model[name]["lat"].append(_t.monotonic() - t0)
+        except Exception as e:
+            with lock:
+                errs = rec_by_model[name]["errors"]
+                errs[type(e).__name__] = errs.get(type(e).__name__,
+                                                  0) + 1
+            raise
+
+    rec = loadgen.run_open_loop(tagged_submit, tagged_make, rate=rate,
+                                n_requests=n_requests, seed=seed,
+                                max_clients=max_clients)
+    per_model = {}
+    for m in models:
+        lat = sorted(rec_by_model[m]["lat"])
+        per_model[m] = {
+            "requests": len(lat)
+            + sum(rec_by_model[m]["errors"].values()),
+            "errors": rec_by_model[m]["errors"],
+            "p99_ms": None if not lat else round(
+                loadgen.percentile(lat, 99) * 1000.0, 3),
+        }
+    return {"scenario": "hot_model_skew", "hot_model": hot,
+            "hot_fraction": hot_fraction, "per_model": per_model,
+            **{k: rec[k] for k in ("requests", "completed", "errors",
+                                   "requests_per_sec", "p50_ms",
+                                   "p99_ms") if k in rec}}
+
+
+def scenario_slow_client_storm(submit, make_request, *, n_clients=24,
+                               requests_per_client=8,
+                               think_time_s=0.01, seed=0,
+                               timeout_s=120.0):
+    """A storm of CLOSED-LOOP clients that block on each response and
+    think before the next request — the slow-client population an
+    open loop cannot model (loadgen.run_closed_loop). Records
+    rps/p50/p99 + error classes."""
+    from deeplearning4j_tpu.serving import loadgen
+
+    rec = loadgen.run_closed_loop(
+        submit, make_request, n_clients=n_clients,
+        requests_per_client=requests_per_client,
+        think_time_s=think_time_s, seed=seed, timeout_s=timeout_s)
+    return dict(rec, scenario="slow_client_storm")

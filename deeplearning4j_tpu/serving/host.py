@@ -22,7 +22,7 @@ import time
 
 import numpy as np
 
-__all__ = ["ServedModel", "ModelHost"]
+__all__ = ["ServedModel", "ServedSequenceModel", "ModelHost"]
 
 
 class ServedModel:
@@ -86,13 +86,67 @@ class ServedModel:
         return self
 
 
+class ServedSequenceModel:
+    """One (name, version) SEQUENCE entry: network + its iteration-
+    level slot scheduler (serving/sequence.py). Build through
+    ModelHost.register_sequence/swap_sequence."""
+
+    def __init__(self, name, version, network, slotBuckets=None,
+                 queueLimit=64, feedback=None, clock=None):
+        from deeplearning4j_tpu.serving.sequence import SequenceScheduler
+
+        self.name = str(name)
+        self.version = int(version)
+        self.network = network
+        self.scheduler = SequenceScheduler(
+            network, slot_buckets=slotBuckets, queue_limit=queueLimit,
+            feedback=feedback, clock=clock,
+            start_thread=clock is None,
+            name=f"{self.name}:v{self.version}")
+
+    def warm(self, cache=None):
+        """Precompile the decode step for every slot bucket."""
+        return self.scheduler.warm(cache=cache)
+
+    def submit(self, features, deadline_s=None, extra_steps=0,
+               wait=True, timeout=None):
+        sched = self.scheduler
+        deadline = None if deadline_s is None else \
+            sched.clock() + float(deadline_s)
+        return sched.submit(features, deadline=deadline,
+                            extra_steps=extra_steps, wait=wait,
+                            timeout=deadline_s if timeout is None
+                            else timeout)
+
+    def policy(self):
+        import jax.numpy as jnp
+
+        return {
+            "model": self.name,
+            "version": self.version,
+            "kind": "sequence",
+            "dtype": jnp.dtype(self.network._compute_dtype).name,
+            "slotBuckets": list(self.scheduler.slot_buckets),
+            "queueLimit": self.scheduler.queue_limit,
+            "featureSize": self.scheduler.feature_size,
+        }
+
+    def close(self, drain=True):
+        self.scheduler.close(drain=drain)
+        return self
+
+
 class ModelHost:
-    """name -> ServedModel routing table (module docstring)."""
+    """name -> ServedModel routing table (module docstring), plus a
+    parallel table of sequence (iteration-level) models — one host =
+    one serving process's worth of models; serving/fleet.py stacks N
+    hosts behind a router."""
 
     def __init__(self, mesh=None, clock=None):
         self._mesh = mesh
         self._clock = clock
         self._models = {}
+        self._sequences = {}        # name -> ServedSequenceModel
         self._registering = set()   # names reserved mid-register
         self._lock = threading.Lock()
 
@@ -103,7 +157,8 @@ class ModelHost:
         production default) warms every bucket executable before the
         model is routable."""
         with self._lock:
-            if name in self._models or name in self._registering:
+            if name in self._models or name in self._sequences \
+                    or name in self._registering:
                 raise ValueError(
                     f"model {name!r} is already registered — use "
                     "swap() to roll a new version")
@@ -156,6 +211,130 @@ class ModelHost:
         return {"model": name, "version": new.version,
                 "warm": report, "warm_s": round(warm_s, 3)}
 
+    # -- sequence (iteration-level) models -------------------------------
+    def register_sequence(self, name, network, *, slotBuckets=None,
+                          queueLimit=64, feedback=None, precompile=True):
+        """Serve a recurrent `network` as the SEQUENCE model `name`
+        (version 1) behind an iteration-level slot scheduler
+        (serving/sequence.py). precompile=True warms the decode-step
+        executable for every slot bucket before the model is
+        routable."""
+        with self._lock:
+            if name in self._models or name in self._sequences \
+                    or name in self._registering:
+                raise ValueError(
+                    f"model {name!r} is already registered — use "
+                    "swap_sequence() to roll a new version")
+            self._registering.add(name)
+        try:
+            sm = ServedSequenceModel(name, 1, network,
+                                     slotBuckets=slotBuckets,
+                                     queueLimit=queueLimit,
+                                     feedback=feedback,
+                                     clock=self._clock)
+            try:
+                report = sm.warm() if precompile else None
+            except Exception:
+                # the ctor already started the scheduler thread and
+                # registered telemetry series — a failed warm must not
+                # leak either
+                sm.close(drain=False)
+                raise
+            with self._lock:
+                self._sequences[name] = sm
+        finally:
+            with self._lock:
+                self._registering.discard(name)
+        return {"model": name, "version": sm.version, "warm": report}
+
+    def swap_sequence(self, name, network, **overrides):
+        """Rolling swap of a sequence model: build + WARM the new
+        version's slot-bucket executables while the current one keeps
+        stepping, flip atomically, drain the old scheduler (sequences
+        already admitted or queued finish on the version they were
+        enqueued against)."""
+        with self._lock:
+            old = self._sequences.get(name)
+            if old is None:
+                raise KeyError(
+                    f"unknown sequence model {name!r}: "
+                    "register_sequence() it first (registered: "
+                    f"{sorted(self._sequences)})")
+        pol = old.policy()
+        kw = {"slotBuckets": tuple(pol["slotBuckets"]) or None,
+              "queueLimit": pol["queueLimit"],
+              "feedback": old.scheduler.feedback}
+        kw.update(overrides)
+        new = ServedSequenceModel(name, old.version + 1, network,
+                                  clock=self._clock, **kw)
+        t0 = time.perf_counter()
+        try:
+            report = new.warm()       # old version keeps stepping
+        except Exception:
+            new.close(drain=False)    # old version stays routed
+            raise
+        warm_s = time.perf_counter() - t0
+        with self._lock:
+            self._sequences[name] = new   # atomic routing flip
+        old.close(drain=True)
+        return {"model": name, "version": new.version,
+                "warm": report, "warm_s": round(warm_s, 3)}
+
+    def sequence_model(self, name):
+        with self._lock:
+            sm = self._sequences.get(name)
+            registered = sorted(self._sequences)
+        if sm is None:
+            raise KeyError(
+                f"unknown sequence model {name!r} (registered: "
+                f"{registered})")
+        return sm
+
+    def submit_sequence(self, name, features, deadline_s=None,
+                        extra_steps=0, wait=True, timeout=None):
+        """Route one sequence ([T, F] per-step features) to `name`'s
+        slot scheduler. Same swap re-route contract as submit(): a
+        request losing the resolve/enqueue race against a
+        swap_sequence lands on the new version, never a 5xx."""
+        from deeplearning4j_tpu.serving.queue import ServingClosedError
+
+        feats = np.asarray(features)
+        try:
+            return self.sequence_model(name).submit(
+                feats, deadline_s=deadline_s, extra_steps=extra_steps,
+                wait=wait, timeout=timeout)
+        except ServingClosedError:
+            return self.sequence_model(name).submit(
+                feats, deadline_s=deadline_s, extra_steps=extra_steps,
+                wait=wait, timeout=timeout)
+
+    def queued_work(self, name):
+        """Outstanding work this host holds for `name` — one-shot
+        requests queued OR inside a running dispatch (a wedged batch
+        must read as load, not idleness), or queue depth + live slots
+        for a sequence model; None when the model is not served here.
+        The fleet router's least-loaded ranking key (a point-in-time
+        read)."""
+        with self._lock:
+            sm = self._models.get(name)
+            seq = self._sequences.get(name)
+        if sm is not None:
+            b = sm.pi._batcher  # thread-ok[THR01]: atomic reference read — an idle model (no batcher yet) just reports 0
+            return 0 if b is None else b.outstanding
+        if seq is not None:
+            return seq.scheduler.depth + seq.scheduler.active_slots
+        return None
+
+    def kind(self, name):
+        """'oneshot' | 'sequence' | None when `name` is not served
+        here — the fleet's swap_all dispatch key."""
+        with self._lock:
+            if name in self._models:
+                return "oneshot"
+            if name in self._sequences:
+                return "sequence"
+        return None
+
     # -- request path ---------------------------------------------------
     def model(self, name):
         with self._lock:
@@ -184,28 +363,39 @@ class ModelHost:
     # -- introspection / lifecycle --------------------------------------
     def names(self):
         with self._lock:
-            return sorted(self._models)
+            return sorted(self._models) + sorted(self._sequences)
 
     def __contains__(self, name):
         with self._lock:
-            return name in self._models
+            return name in self._models or name in self._sequences
 
     def describe(self):
-        """The multi-model policy table (docs/SERVING.md)."""
+        """The multi-model policy table (docs/SERVING.md); sequence
+        models ride along with ``"kind": "sequence"`` rows."""
         with self._lock:
             models = list(self._models.values())
-        return {sm.name: sm.policy() for sm in models}
+            seqs = list(self._sequences.values())
+        table = {sm.name: sm.policy() for sm in models}
+        table.update({sm.name: sm.policy() for sm in seqs})
+        return table
 
     def metrics_snapshot(self):
         """One JSON-safe observability snapshot: the process-wide
         registry (training + serving + AOT instruments, the same data
         /metrics exposes) plus a per-served-model serving view (queue
         stats, depth, occupancy). The programmatic twin of
-        ``GET /metrics`` (docs/OBSERVABILITY.md)."""
+        ``GET /metrics`` (docs/OBSERVABILITY.md).
+
+        Schema: the PR 13 keys (``registry``, ``models``) are stable —
+        bench.py consumes them unchanged; the fleet view is ADDITIVE:
+        ``sequences`` (per sequence model: queue depth + live slots +
+        slot-occupancy summary, the per-replica row
+        serving/fleet.py aggregates)."""
         from deeplearning4j_tpu.runtime import telemetry
 
         with self._lock:
             models = list(self._models.values())
+            seqs = list(self._sequences.values())
         per_model = {}
         for sm in models:
             # a snapshot is a READ: never build the lazy batcher (that
@@ -226,20 +416,39 @@ class ModelHost:
                 "queue_depth": b.depth,
                 "occupancy": b.occupancy_summary(),
             }
+        per_seq = {}
+        for sm in seqs:
+            sched = sm.scheduler
+            per_seq[sm.name] = {
+                "version": sm.version,
+                "stats": dict(sched.stats),
+                "queue_depth": sched.depth,
+                "active_slots": sched.active_slots,
+                "slot_occupancy": sched.occupancy_summary(),
+            }
         return {"registry": telemetry.get_registry().snapshot(),
-                "models": per_model}
+                "models": per_model,
+                "sequences": per_seq}
 
     def warm_all(self):
-        """(Re)warm every registered model — the HTTP tier's /healthz
-        warmup hook: cache hits are cheap, so gating readiness on this
-        is safe even when registration already precompiled."""
+        """(Re)warm every registered model (one-shot AND sequence) —
+        the HTTP tier's /healthz warmup hook: cache hits are cheap, so
+        gating readiness on this is safe even when registration
+        already precompiled."""
         with self._lock:
             models = list(self._models.values())
-        return {sm.name: sm.warm() for sm in models}
+            seqs = list(self._sequences.values())
+        out = {sm.name: sm.warm() for sm in models}
+        out.update({sm.name: sm.warm() for sm in seqs})
+        return out
 
     def close(self, drain=True):
         with self._lock:
             models = list(self._models.values())
+            seqs = list(self._sequences.values())
             self._models.clear()
+            self._sequences.clear()
         for sm in models:
+            sm.close(drain=drain)
+        for sm in seqs:
             sm.close(drain=drain)

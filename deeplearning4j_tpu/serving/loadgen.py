@@ -1,4 +1,4 @@
-"""Open-loop (Poisson-arrival) load generator for the serving tier.
+"""Load generators for the serving tier: open loop AND closed loop.
 
 Open-loop means arrivals are scheduled by an external clock,
 independent of completions — the honest way to measure a server
@@ -8,10 +8,17 @@ process) drawn from a SEEDED rng, so a run is reproducible; per-request
 latency is measured from the SCHEDULED arrival (so pacer slip and
 queueing both count against the server, the open-loop convention).
 
-The measured products — requests/sec sustained, p50/p99 latency, and
-the dispatcher's batch-occupancy histogram — are the `serving` bench
-headline alongside training throughput (bench.py bench_serving,
-docs/SERVING.md).
+``run_closed_loop`` models the population an open loop cannot: clients
+that BLOCK on each response (and optionally think before the next
+request) — the slow-client storm of serving/fleet.py's scenarios.
+Closed-loop latency runs submit→completion per request, and think
+times are seeded jitter so a storm replays exactly.
+
+Both record per-ERROR-CLASS counts (exception type name -> count) in
+the summarize() record. The measured products — requests/sec
+sustained, p50/p99 latency, error classes, and the dispatcher's
+batch-occupancy histogram — are the `serving`/`serving_fleet` bench
+records (bench.py, docs/SERVING.md).
 """
 
 from __future__ import annotations
@@ -21,7 +28,8 @@ import time
 
 import numpy as np
 
-__all__ = ["arrival_offsets", "percentile", "summarize", "run_open_loop"]
+__all__ = ["arrival_offsets", "percentile", "summarize",
+           "run_open_loop", "run_closed_loop"]
 
 
 def arrival_offsets(rate, n, seed=0):
@@ -132,3 +140,67 @@ def run_open_loop(submit, make_request, *, rate, n_requests, seed=0,
         errs["TimeoutAbandoned"] = missing
     return summarize(done, duration, errors=errs,
                      scheduled=n_requests)
+
+
+def run_closed_loop(submit, make_request, *, n_clients,
+                    requests_per_client, think_time_s=0.0, seed=0,
+                    timeout_s=120.0, clock=time.monotonic,
+                    sleep=time.sleep):
+    """Drive `submit` with `n_clients` CLOSED-LOOP clients: each sends
+    one request, BLOCKS on the response, thinks, repeats — the
+    self-throttling population (slow clients holding results) an open
+    loop cannot model, and the load shape of the fleet's slow-client
+    storm scenario (serving/fleet.py).
+
+    make_request: (client, i) -> features for that client's i-th
+    request. think_time_s: mean think pause between a response and the
+    next request, drawn as SEEDED exponential jitter per client so a
+    storm replays exactly (0 = a tight closed loop). Latency is
+    submit→completion (the closed-loop convention — there is no
+    external schedule to slip against); a request that raises is
+    counted by exception type and the client moves on. The record is
+    summarize() plus ``mode``/``clients`` fields.
+    """
+    n_clients = int(n_clients)
+    per = int(requests_per_client)
+    lat = []
+    errors = {}
+    state_lock = threading.Lock()
+
+    def client(c):
+        rng = np.random.RandomState(seed + c)
+        for i in range(per):
+            t0 = clock()
+            try:
+                x = make_request(c, i)
+                submit(x)
+                done = clock() - t0
+                with state_lock:
+                    lat.append(done)
+            except Exception as e:
+                with state_lock:
+                    key = type(e).__name__
+                    errors[key] = errors.get(key, 0) + 1
+            if think_time_s > 0:
+                sleep(float(rng.exponential(think_time_s)))
+
+    workers = [threading.Thread(target=client, args=(c,), daemon=True)
+               for c in range(n_clients)]
+    t0 = clock()
+    for w in workers:
+        w.start()
+    deadline = clock() + timeout_s
+    for w in workers:
+        w.join(timeout=max(0.0, deadline - clock()))
+    duration = clock() - t0
+    with state_lock:
+        done, errs = list(lat), dict(errors)
+    scheduled = n_clients * per
+    missing = scheduled - len(done) - sum(errs.values())
+    if missing > 0:
+        errs["TimeoutAbandoned"] = missing
+    rec = summarize(done, duration, errors=errs, scheduled=scheduled)
+    rec["mode"] = "closed"
+    rec["clients"] = n_clients
+    rec["think_time_s"] = float(think_time_s)
+    return rec

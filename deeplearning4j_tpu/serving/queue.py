@@ -116,10 +116,26 @@ class InferenceRequest:
 
     def wait(self, timeout=None):
         """Block until the batch carrying this request completes.
-        Raises the dispatch failure, the deadline expiry, or — when
-        `timeout` elapses first — DeadlineExceededError (the caller's
-        end of the deadline contract: the client is released even if
-        the dispatcher is wedged mid-batch)."""
+
+        THE serving tier's release contract, stated once (ServedModel,
+        the HTTP 504 path and SequenceRequest.wait all defer here). The
+        caller is released by exactly one of:
+
+        1. result — the dispatch carrying this request completed;
+        2. the dispatch's failure, re-raised (HTTP 500);
+        3. DeadlineExceededError set by the SCHEDULER — the deadline
+           passed while the request was still queued (it never wasted
+           bucket rows) or, for sequences, at a step boundary;
+        4. DeadlineExceededError raised HERE when `timeout` elapses
+           first — the MID-DISPATCH release: even when the dispatcher
+           is wedged inside a batch that includes this request, the
+           client is released at its deadline (HTTP 504) while the
+           batch itself runs to completion in the background. A
+           released request's late result is discarded, never
+           delivered.
+
+        There is no path that leaves the caller blocked forever short
+        of timeout=None with a dispatcher that never returns."""
         if not self._event.wait(timeout):
             raise DeadlineExceededError(
                 f"no result within {timeout:.3f}s")
@@ -173,6 +189,7 @@ class MicroBatcher:
         self.feature_dtype = feature_dtype
         self._cond = threading.Condition()
         self._pending = deque()
+        self._inflight = 0      # requests popped into a running dispatch
         self._closed = False
         self.name = str(name) if name else f"batcher{next(_BATCHER_SEQ)}"
         # per-instance registry instruments (counters/gauge/histograms
@@ -322,11 +339,22 @@ class MicroBatcher:
                 break
             batch.append(self._pending.popleft())
             rows += req.rows
+        # popped requests stay visible as load (`outstanding`) until
+        # their dispatch returns — a wedged dispatch must not make the
+        # batcher read idle to the fleet's least-loaded ranking
+        self._inflight += len(batch)
         self._m["depth"].set(len(self._pending))
         return batch
 
     # -- dispatch (lock NOT held) ---------------------------------------
     def _run_batch(self, batch):
+        try:
+            self._dispatch_batch(batch)
+        finally:
+            with self._cond:
+                self._inflight -= len(batch)
+
+    def _dispatch_batch(self, batch):
         rows = sum(r.rows for r in batch)
         bucket = int(self._bucket_for(rows))
         taken = self.clock()
@@ -429,6 +457,15 @@ class MicroBatcher:
         with self._cond:
             return len(self._pending)
 
+    @property
+    def outstanding(self):
+        """Requests this batcher still owes a reply: queued + popped
+        into a dispatch that has not returned. The load signal
+        (ModelHost.queued_work / fleet least-loaded ranking) — `depth`
+        alone reads 0 while a wedged dispatch holds a whole batch."""
+        with self._cond:
+            return len(self._pending) + self._inflight
+
     def close(self, drain=True):
         """Stop accepting. drain=True completes everything already
         queued (the rolling-swap contract: enqueued requests finish on
@@ -487,23 +524,32 @@ class MicroBatcher:
         (bench code assigns it directly); live dispatches additionally
         feed the registry's dl4j_serving_batch_occupancy histogram,
         whose quartile bucket edges mirror this binning."""
-        if not self.occupancy:
-            return {"dispatches": 0, "mean_occupancy": None,
-                    "histogram": {}}
-        occ = [rows / bucket for rows, bucket in self.occupancy]
-        hist = {"0-25%": 0, "25-50%": 0, "50-75%": 0, "75-100%": 0}
-        for o in occ:
-            if o <= 0.25:
-                hist["0-25%"] += 1
-            elif o <= 0.5:
-                hist["25-50%"] += 1
-            elif o <= 0.75:
-                hist["50-75%"] += 1
-            else:
-                hist["75-100%"] += 1
-        return {"dispatches": len(occ),
-                "mean_occupancy": round(sum(occ) / len(occ), 4),
-                "mean_rows_per_dispatch": round(
-                    sum(r for r, _ in self.occupancy)
-                    / len(self.occupancy), 2),
-                "histogram": hist}
+        return occupancy_summary_from(self.occupancy,
+                                      "mean_rows_per_dispatch")
+
+
+def occupancy_summary_from(records, rows_key):
+    """Mean/quartile-histogram occupancy math over (rows, bucket)
+    records — shared by MicroBatcher dispatches and the sequence
+    scheduler's decode steps (`rows_key` names the per-tier mean:
+    rows per dispatch vs live slots per step). One binning; the two
+    tiers must never diverge."""
+    if not records:
+        return {"dispatches": 0, "mean_occupancy": None,
+                "histogram": {}}
+    occ = [rows / bucket for rows, bucket in records]
+    hist = {"0-25%": 0, "25-50%": 0, "50-75%": 0, "75-100%": 0}
+    for o in occ:
+        if o <= 0.25:
+            hist["0-25%"] += 1
+        elif o <= 0.5:
+            hist["25-50%"] += 1
+        elif o <= 0.75:
+            hist["50-75%"] += 1
+        else:
+            hist["75-100%"] += 1
+    return {"dispatches": len(occ),
+            "mean_occupancy": round(sum(occ) / len(occ), 4),
+            rows_key: round(sum(r for r, _ in records)
+                            / len(records), 2),
+            "histogram": hist}

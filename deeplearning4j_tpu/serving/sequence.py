@@ -1,0 +1,644 @@
+"""Iteration-level continuous batching for stateful (recurrent) models.
+
+The one-shot tier (serving/queue.py) coalesces REQUESTS; sequence
+workloads need the batch re-formed every DECODE STEP — the Orca
+iteration-level scheduling insight, applied to the stack's stateful
+``rnnTimeStep`` path. A run-to-completion (gang) batch pads every
+sequence to the longest in its batch and holds finished slots hostage
+until the stragglers drain; re-batching per step lets an early-exit
+slot be refilled from the queue MID-SEQUENCE, so the device always
+steps a full-as-possible batch of live tokens.
+
+Mechanics (``SequenceScheduler``):
+
+* a **slot table** of active sequences, each carrying its own per-layer
+  hidden/cell state as host arrays. Every iteration the scheduler
+  GATHERS the live carries into one ``[S, H]`` batch per layer/key
+  (zero rows for empty slots), steps the model ONCE via the functional
+  ``MultiLayerNetwork.rnnStepBatched`` (nn/multilayer.py), and
+  SCATTERS the outputs + new carries back per slot. Rows are
+  independent, so one executable per **slot bucket** serves any
+  occupancy — padding can never perturb a live slot, and per-slot
+  output is bitwise what serial ``rnnTimeStep`` produces
+  (tests/test_sequence_serving.py gates it). Known limit, the PR 8
+  precedent: when a sequence's steps SPAN different slot buckets, the
+  bucket change can alter XLA's dot lowering and round 1 ulp apart —
+  within a fixed bucket parity is structural and bitwise; pin
+  ``slot_buckets`` to one size where bitwise reproducibility across
+  occupancy changes matters more than padded-row compute.
+* slot counts are **bucketed** (``slot_buckets``) through the AOT
+  executable cache exactly like the one-shot tier's batch buckets: the
+  compile budget is ``len(slot_buckets)``, ``warm()`` precompiles every
+  bucket, and a warmed scheduler serves any mix of sequence lengths
+  with ZERO steady-state compiles (CompileWatch-gated).
+* admission: ``submit`` appends to a bounded FIFO (``QueueFullError``
+  past ``queue_limit`` — backpressure, never a hang); free slots are
+  refilled from the queue at every iteration boundary
+  (``admission="step"``). ``admission="gang"`` is the deliberate
+  run-to-completion baseline — refill only when the table drains — so
+  the iteration-level win is measurable as an A/B on the SAME code
+  path (bench serving_fleet, the >=2x tier-1 gate).
+* per-request **deadlines are honored per step**: an expired sequence —
+  queued OR mid-flight — is failed at the next iteration boundary and
+  its slot refilled; the caller side of the contract is
+  ``SequenceRequest.wait`` (the release rules are stated once, on
+  ``queue.InferenceRequest.wait``).
+* the clock is injectable (``queue.ManualClock``) and the scheduler can
+  be driven synchronously via ``poll()``/``drain()`` with
+  ``start_thread=False`` — the same zero-sleep deterministic test seam
+  the MicroBatcher exposes.
+
+Generation mode: a request may ask for ``extra_steps`` beyond its
+prompt; the next input row is then ``feedback(last_output_row)`` — the
+host-side closed loop of a char-rnn sampler (greedy argmax one-hot by
+default when the scheduler's ``feedback`` is set).
+
+See docs/SERVING.md "Sequence serving + the fleet".
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from deeplearning4j_tpu.runtime import telemetry
+from deeplearning4j_tpu.serving.queue import (
+    DeadlineExceededError, QueueFullError, ServingClosedError,
+    occupancy_summary_from,
+)
+
+__all__ = ["SequenceRequest", "SequenceScheduler", "greedy_onehot_feedback"]
+
+#: unique default metric label for anonymous schedulers
+_SCHED_SEQ = itertools.count(1)
+
+#: default slot-count buckets: one executable per bucket, ever
+DEFAULT_SLOT_BUCKETS = (1, 2, 4, 8)
+
+#: the stats keys the dict view carries
+_STAT_KEYS = ("sequences", "completed", "dispatches", "slot_steps",
+              "expired", "rejected", "errors", "refills")
+
+
+def greedy_onehot_feedback(vocab):
+    """feedback closure for one-hot token models: argmax the output
+    row, feed the matching one-hot back as the next input (greedy
+    char-rnn sampling — deterministic, so generation tests stay
+    bitwise)."""
+    eye = np.eye(int(vocab), dtype=np.float32)
+
+    def feedback(out_row):
+        return eye[int(np.argmax(out_row))]
+
+    return feedback
+
+
+class SequenceRequest:
+    """One sequence: prompt features [T, F] consumed one timestep per
+    scheduler iteration, plus optional generation steps.
+
+    total steps = T + extra_steps; step t consumes ``features[t]`` for
+    t < T and ``feedback(outputs[t-1])`` after. The result is the
+    stacked per-step output [total, O]. ``wait`` follows the serving
+    tier's one release contract — see ``queue.InferenceRequest.wait``
+    (dispatch failure, per-step deadline expiry, or caller-timeout
+    release while the scheduler is mid-step)."""
+
+    __slots__ = ("features", "steps", "extra_steps", "feedback",
+                 "enqueued_at", "deadline", "started_at", "steps_done",
+                 "outputs", "carry", "result", "error", "_event")
+
+    def __init__(self, features, enqueued_at, deadline=None,
+                 extra_steps=0, feedback=None):
+        self.features = features            # [T, F] float32
+        self.steps = int(features.shape[0]) + int(extra_steps)
+        self.extra_steps = int(extra_steps)
+        self.feedback = feedback
+        self.enqueued_at = float(enqueued_at)
+        self.deadline = None if deadline is None else float(deadline)
+        self.started_at = None              # first-step admission time
+        self.steps_done = 0
+        self.outputs = []                   # per-step [O] rows
+        self.carry = None                   # per-layer {key: [H]} rows
+        self.result = None
+        self.error = None
+        self._event = threading.Event()
+
+    @property
+    def done(self):
+        return self._event.is_set()
+
+    def next_input(self):
+        """The feature row this sequence consumes at its next step."""
+        t = self.steps_done
+        if t < self.features.shape[0]:
+            return self.features[t]
+        if self.feedback is None:
+            raise RuntimeError(
+                "generation step with no feedback fn (extra_steps > 0 "
+                "needs a request- or scheduler-level feedback)")
+        return np.asarray(self.feedback(self.outputs[-1]),
+                          np.float32)
+
+    def finish(self, result):
+        self.result = result
+        self._event.set()
+
+    def fail(self, exc):
+        self.error = exc
+        self._event.set()
+
+    def wait(self, timeout=None):
+        """Block for the stacked [steps, O] output. Release rules are
+        the serving tier's single wait contract —
+        ``queue.InferenceRequest.wait``."""
+        if not self._event.wait(timeout):
+            raise DeadlineExceededError(f"no result within {timeout:.3f}s")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class SequenceScheduler:
+    """Iteration-level slot scheduler over one recurrent model (module
+    docstring).
+
+    model:        an initialized MultiLayerNetwork with >=1 recurrent
+                  layer (validated eagerly via ``rnnCarrySpec``).
+    slot_buckets: slot-count executable buckets; max(slot_buckets) is
+                  the table capacity.
+    queue_limit:  bound on WAITING sequences (QueueFullError past it).
+    admission:    "step" (refill free slots every iteration — the
+                  iteration-level discipline) or "gang" (refill only
+                  when the table is empty — the run-to-completion
+                  baseline the >=2x gate measures against).
+    feedback:     scheduler-level generation feedback
+                  (out_row [O]) -> next input row [F]; a request's own
+                  feedback overrides it.
+    clock/start_thread/name: the MicroBatcher test seam — inject
+                  ManualClock and drive ``poll()``/``drain()`` with no
+                  thread for deterministic tests.
+    """
+
+    def __init__(self, model, *, slot_buckets=None, queue_limit=64,
+                 admission="step", feedback=None, clock=None,
+                 start_thread=True, name=None):
+        if admission not in ("step", "gang"):
+            raise ValueError(
+                f"admission must be 'step' (iteration-level) or 'gang' "
+                f"(run-to-completion baseline), got {admission!r}")
+        if int(queue_limit) < 1:
+            raise ValueError(f"queue_limit must be >= 1, got {queue_limit}")
+        self.model = model
+        self._spec = model.rnnCarrySpec()   # validates the net, eagerly
+        # carries cross the jit boundary UNCAST (unlike x, which
+        # _entry casts in-graph): host-side slot state must live in
+        # the model's compute dtype or per-step outputs diverge from
+        # serial rnnTimeStep on non-f32 policies
+        self._carry_dtype = np.dtype(model._compute_dtype)
+        buckets = slot_buckets or DEFAULT_SLOT_BUCKETS
+        self.slot_buckets = tuple(sorted(int(b) for b in buckets))
+        if self.slot_buckets[0] < 1:
+            raise ValueError(f"slot buckets must be >= 1, got {buckets}")
+        self.max_slots = self.slot_buckets[-1]
+        self.queue_limit = int(queue_limit)
+        self.admission = admission
+        self.feedback = feedback
+        self.clock = clock if clock is not None else time.monotonic
+        it = model.conf.inputType
+        #: per-step feature width the submit contract validates
+        self.feature_size = int(it.size)
+        self._cond = threading.Condition()
+        # one iteration at a time: the background loop and a concurrent
+        # close(drain=True)/poll() caller must never both snapshot the
+        # slot table and double-step a sequence
+        self._step_lock = threading.Lock()
+        self._pending = deque()
+        self._active = []                   # the slot table
+        self._closed = False
+        self.name = str(name) if name else f"seq{next(_SCHED_SEQ)}"
+        #: (active_slots, bucket) per dispatch — the occupancy record
+        self.occupancy = []
+        reg = telemetry.get_registry()
+        lab = {"model": self.name}
+        self._registry = reg
+        self._m = {
+            "sequences": reg.counter(
+                "dl4j_seq_sequences_total",
+                "sequences accepted into the sequence queue",
+                labels=("model",)).labels(**lab),
+            "completed": reg.counter(
+                "dl4j_seq_completed_total",
+                "sequences completed (all steps served)",
+                labels=("model",)).labels(**lab),
+            "dispatches": reg.counter(
+                "dl4j_seq_dispatches_total",
+                "slot-batched decode-step dispatches",
+                labels=("model",)).labels(**lab),
+            "slot_steps": reg.counter(
+                "dl4j_seq_slot_steps_total",
+                "live slot-steps served (occupancy x dispatches)",
+                labels=("model",)).labels(**lab),
+            "expired": reg.counter(
+                "dl4j_seq_expired_total",
+                "sequences failed by a per-step deadline expiry (504)",
+                labels=("model",)).labels(**lab),
+            "rejected": reg.counter(
+                "dl4j_seq_rejected_total",
+                "sequences rejected on a full queue (429)",
+                labels=("model",)).labels(**lab),
+            "errors": reg.counter(
+                "dl4j_seq_errors_total",
+                "sequences failed by a dispatch error",
+                labels=("model",)).labels(**lab),
+            "refills": reg.counter(
+                "dl4j_seq_refills_total",
+                "mid-sequence slot refills (admissions while other "
+                "slots were mid-flight)",
+                labels=("model",)).labels(**lab),
+            "depth": reg.gauge(
+                "dl4j_seq_queue_depth",
+                "sequences waiting for a slot",
+                labels=("model",)).labels(**lab),
+            "active": reg.gauge(
+                "dl4j_seq_active_slots",
+                "slots occupied by live sequences",
+                labels=("model",)).labels(**lab),
+            "wait": reg.histogram(
+                "dl4j_seq_queue_wait_seconds",
+                "enqueue-to-first-step wait per sequence",
+                labels=("model",)).labels(**lab),
+            "occupancy": reg.histogram(
+                "dl4j_seq_slot_occupancy",
+                "live-slots/bucket fill fraction per decode step",
+                labels=("model",),
+                buckets=(0.25, 0.5, 0.75, 1.0)).labels(**lab),
+        }
+        self._thread = None
+        if start_thread:
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    # -- submit ---------------------------------------------------------
+    def submit(self, features, deadline=None, extra_steps=0,
+               feedback=None, wait=True, timeout=None):
+        """Enqueue one sequence of per-step features [T, F] (T >= 1).
+
+        deadline: absolute time on this scheduler's clock; checked at
+        every STEP boundary, queued or mid-flight. extra_steps: closed-
+        loop generation steps past the prompt (needs a feedback fn).
+        wait=True blocks for the stacked [T+extra, O] result; False
+        returns the SequenceRequest.
+        """
+        features = np.asarray(features, np.float32)
+        if features.ndim != 2 or features.shape[0] < 1:
+            raise ValueError(
+                f"features must be [steps, {self.feature_size}] with "
+                f"steps >= 1, got shape {features.shape}")
+        if features.shape[1] != self.feature_size:
+            raise ValueError(
+                f"per-step feature width {features.shape[1]} does not "
+                f"match the model's {self.feature_size}")
+        fb = feedback if feedback is not None else self.feedback
+        if int(extra_steps) > 0 and fb is None:
+            raise ValueError(
+                "extra_steps > 0 needs a feedback fn (request- or "
+                "scheduler-level) to close the generation loop")
+        with self._cond:
+            if self._closed:
+                raise ServingClosedError("sequence scheduler is closed")
+            if len(self._pending) >= self.queue_limit:
+                self._m["rejected"].inc()
+                raise QueueFullError(
+                    f"sequence queue full ({len(self._pending)} waiting, "
+                    f"queueLimit={self.queue_limit})")
+            req = SequenceRequest(features, self.clock(), deadline,
+                                  extra_steps=extra_steps, feedback=fb)
+            self._pending.append(req)
+            self._m["sequences"].inc()
+            self._m["depth"].set(len(self._pending))
+            self._cond.notify()
+        if wait:
+            return req.wait(timeout)
+        return req
+
+    # -- scheduling core (lock held) ------------------------------------
+    def _expire_locked(self, now):
+        """Fail every sequence — queued or MID-FLIGHT — whose deadline
+        has passed: the per-step deadline contract. A mid-flight expiry
+        frees its slot this same iteration."""
+        keep = deque()
+        for req in self._pending:
+            if req.deadline is not None and now >= req.deadline:
+                self._m["expired"].inc()
+                req.fail(DeadlineExceededError(
+                    f"deadline passed {now - req.deadline:.3f}s before "
+                    "a slot was granted"))
+            else:
+                keep.append(req)
+        self._pending = keep
+        live = []
+        for req in self._active:
+            if req.deadline is not None and now >= req.deadline:
+                self._m["expired"].inc()
+                req.fail(DeadlineExceededError(
+                    f"deadline passed at step {req.steps_done}/"
+                    f"{req.steps} — slot released mid-sequence"))
+            else:
+                live.append(req)
+        self._active = live
+        self._m["depth"].set(len(self._pending))
+        self._m["active"].set(len(self._active))
+
+    def _refill_locked(self, now):
+        """Admit queued sequences into free slots. admission="step"
+        refills at every iteration boundary (slots freed by early exit
+        or expiry are re-used MID-SEQUENCE); "gang" only admits into an
+        empty table — the run-to-completion baseline."""
+        if self.admission == "gang" and self._active:
+            return
+        midrun = any(r.steps_done > 0 for r in self._active)
+        while self._pending and len(self._active) < self.max_slots:
+            req = self._pending.popleft()
+            req.started_at = now
+            req.carry = [{k: np.zeros((self._carry_width(i),),
+                                      self._carry_dtype)
+                          for k in keys}
+                         for i, keys in enumerate(self._spec)]
+            self._active.append(req)
+            self._m["wait"].observe(now - req.enqueued_at)
+            if midrun:
+                self._m["refills"].inc()
+        self._m["depth"].set(len(self._pending))
+        self._m["active"].set(len(self._active))
+
+    def _carry_width(self, layer_idx):
+        return int(getattr(self.model.layers[layer_idx], "nOut"))
+
+    def bucket_for(self, n):
+        """Smallest slot bucket >= n live slots (the executable that
+        serves this iteration)."""
+        for b in self.slot_buckets:
+            if n <= b:
+                return b
+        return self.slot_buckets[-1]
+
+    # -- one iteration (dispatch outside the lock) ----------------------
+    def _gather(self, batch, S, rows):
+        """Stack the batch's validated next-input rows + carries into
+        the fixed [S, ...] bucket signature (zero rows pad the empty
+        slots)."""
+        x = np.zeros((S, self.feature_size), np.float32)
+        for i, row in enumerate(rows):
+            x[i] = row
+        carries = []
+        for li, keys in enumerate(self._spec):
+            d = {}
+            for k in keys:
+                col = np.zeros((S, self._carry_width(li)),
+                               self._carry_dtype)
+                for i, req in enumerate(batch):
+                    col[i] = req.carry[li][k]
+                d[k] = col
+            carries.append(d)
+        return x, carries
+
+    def _step_once(self):
+        """One scheduler iteration: expire -> refill -> gather ->
+        dispatch ONE slot-batched decode step -> scatter. Returns the
+        number of live slots stepped (0 = idle). Serialized by the
+        step lock — concurrent drivers (background loop vs a draining
+        close) take turns instead of double-stepping a sequence."""
+        with self._step_lock:
+            return self._iterate_locked()
+
+    def _iterate_locked(self):
+        # *_locked: called with the STEP lock held (one driver at a
+        # time); the condition lock is still taken around each shared-
+        # state section below
+        with self._cond:
+            now = self.clock()
+            self._expire_locked(now)
+            self._refill_locked(now)
+            batch = list(self._active)
+        if not batch:
+            return 0
+        # pull next-input rows BEFORE the padded gather: a raising (or
+        # wrong-width) feedback fails ITS request and frees the slot —
+        # it must never kill the scheduler thread (the wait contract:
+        # no path leaves a caller blocked on a dead dispatcher)
+        rows, bad = [], []
+        for req in batch:
+            try:
+                row = np.asarray(req.next_input(),
+                                 dtype=np.float32).reshape(-1)
+                if row.shape[0] != self.feature_size:
+                    raise ValueError(
+                        f"feedback row has width {row.shape[0]}, "
+                        f"model feature size is {self.feature_size}")
+                rows.append(row)
+            except Exception as e:
+                bad.append((req, e))
+        if bad:
+            failed = {r for r, _ in bad}
+            with self._cond:
+                self._m["errors"].inc(len(bad))
+                for req, e in bad:
+                    req.fail(e)
+                self._active = [r for r in self._active
+                                if r not in failed]
+                self._m["active"].set(len(self._active))
+            batch = [r for r in batch if r not in failed]
+            if not batch:
+                return len(bad)     # progress: drain must not stall
+        S = self.bucket_for(len(batch))
+        x, carries = self._gather(batch, S, rows)
+        t0 = self.clock()
+        self._m["dispatches"].inc()
+        self._m["slot_steps"].inc(len(batch))
+        self._m["occupancy"].observe(len(batch) / S)
+        self.occupancy.append((len(batch), S))
+        try:
+            out, new_carries = self.model.rnnStepBatched(x, carries)
+            out = np.asarray(out)
+            # ONE device->host pull per carry array per iteration; the
+            # per-slot scatter below then slices host rows (a per-slot
+            # np.asarray of a jax row would pay S separate transfers)
+            new_carries = [{k: np.asarray(v) for k, v in d.items()}
+                           for d in new_carries]
+        except Exception as e:
+            with self._cond:
+                self._m["errors"].inc(len(batch))
+                for req in batch:
+                    req.fail(e)
+                self._active = [r for r in self._active
+                                if r not in batch]
+                self._m["active"].set(len(self._active))
+            return 0
+        finally:
+            self._registry.add_span(
+                "sequence.step", "serving", t0, self.clock() - t0,
+                model=self.name, slots=len(batch), bucket=S)
+        # scatter: per-slot output row + refreshed carry rows
+        finished = []
+        with self._cond:
+            for i, req in enumerate(batch):
+                if req.done:        # expired/failed between gather+now
+                    continue
+                req.outputs.append(out[i])
+                req.carry = [{k: new_carries[li][k][i] for k in keys}
+                             for li, keys in enumerate(self._spec)]
+                req.steps_done += 1
+                if req.steps_done >= req.steps:
+                    finished.append(req)
+            if finished:
+                self._active = [r for r in self._active
+                                if r not in finished]
+                self._m["completed"].inc(len(finished))
+                self._m["active"].set(len(self._active))
+        for req in finished:        # release waiters outside the lock
+            req.finish(np.stack(req.outputs, axis=0))
+        return len(batch)
+
+    # -- drivers --------------------------------------------------------
+    def poll(self):
+        """One synchronous scheduler iteration (the thread-less test
+        seam): expire, refill, step the slot batch once. Returns the
+        number of live slots stepped — 0 means idle (nothing queued or
+        active). Deterministic under ManualClock: no sleeps, no
+        background thread."""
+        return self._step_once()
+
+    def drain(self):
+        """Run iterations until the table AND queue are empty (ignores
+        nothing — deadlines still expire per step on the clock)."""
+        while self._step_once():
+            pass
+        return self
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                if self._closed and not self._pending \
+                        and not self._active:
+                    return
+                if not self._pending and not self._active:
+                    self._cond.wait(0.05)
+                    continue
+            try:
+                self._step_once()
+            except Exception as e:
+                # defensive: an unexpected scheduler bug must release
+                # every waiter, never leave them blocked on a dead
+                # thread; the loop stays up for new submits
+                self._fail_all(e)
+
+    def _fail_all(self, exc):
+        """Fail every queued + active sequence with `exc` and clear
+        the table (the scheduler-bug escape hatch)."""
+        with self._cond:
+            n = len(self._pending) + len(self._active)
+            if n:
+                self._m["errors"].inc(n)
+            while self._pending:
+                self._pending.popleft().fail(exc)
+            for req in self._active:
+                req.fail(exc)
+            self._active = []
+            self._m["depth"].set(0)
+            self._m["active"].set(0)
+
+    # -- introspection / lifecycle --------------------------------------
+    @property
+    def depth(self):
+        """Sequences waiting for a slot."""
+        with self._cond:
+            return len(self._pending)
+
+    @property
+    def active_slots(self):
+        with self._cond:
+            return len(self._active)
+
+    @property
+    def stats(self):
+        """Dict view over the registry counters (dl4j_seq_*)."""
+        return {k: int(self._m[k].value) for k in _STAT_KEYS}
+
+    def occupancy_summary(self):
+        """Mean live-slots/bucket + quartile histogram over every
+        decode step so far (the 'is the table sized right' signal —
+        docs/SERVING.md)."""
+        return occupancy_summary_from(self.occupancy, "mean_live_slots")
+
+    def warm(self, cache=None):
+        """Precompile the decode-step executable for EVERY slot bucket
+        (hits are free) so a serving process steps its first sequence
+        hot. Returns {bucket: {key, status, seconds}}. The warm
+        signature mirrors the live dispatch EXACTLY (host-numpy
+        carries, like _gather builds) — a mismatched container type
+        would change the AOT signature and demote the first real step
+        to a fresh compile."""
+        import jax.numpy as jnp
+
+        report = {}
+        for S in self.slot_buckets:
+            x = jnp.asarray(np.zeros((S, self.feature_size), np.float32))
+            carries = [{k: np.zeros((S, self._carry_width(li)),
+                                    self._carry_dtype) for k in keys}
+                       for li, keys in enumerate(self._spec)]
+            key, status, secs = self.model._jit_rnn_step.warm(
+                self.model._params,
+                self.model._strip_carries(self.model._states),
+                carries, x, cache=cache)
+            if status is not None:
+                report[int(S)] = {"key": key, "status": status,
+                                  "seconds": round(secs, 3)}
+        return report
+
+    def close(self, drain=True):
+        """Stop accepting. drain=True serves everything already queued
+        or mid-flight to completion; drain=False fails them with
+        ServingClosedError."""
+        with self._cond:
+            self._closed = True
+            if not drain:
+                while self._pending:
+                    self._pending.popleft().fail(
+                        ServingClosedError("scheduler closed before "
+                                           "a slot was granted"))
+                for req in self._active:
+                    req.fail(ServingClosedError(
+                        "scheduler closed mid-sequence"))
+                self._active = []
+                self._m["depth"].set(0)
+                self._m["active"].set(0)
+            self._cond.notify_all()
+        if drain:
+            self.drain()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        # release this instance's registry series (MicroBatcher.close
+        # precedent: per-instance series must not accumulate forever)
+        reg = self._registry
+        for metric in ("dl4j_seq_sequences_total",
+                       "dl4j_seq_completed_total",
+                       "dl4j_seq_dispatches_total",
+                       "dl4j_seq_slot_steps_total",
+                       "dl4j_seq_expired_total",
+                       "dl4j_seq_rejected_total",
+                       "dl4j_seq_errors_total",
+                       "dl4j_seq_refills_total",
+                       "dl4j_seq_queue_depth",
+                       "dl4j_seq_active_slots",
+                       "dl4j_seq_queue_wait_seconds",
+                       "dl4j_seq_slot_occupancy"):
+            fam = reg.get(metric)
+            if fam is not None:
+                fam.remove(model=self.name)
+        return self

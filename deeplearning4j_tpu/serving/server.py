@@ -15,11 +15,17 @@ Routes:
 * ``GET /metrics``                 — Prometheus text exposition of the
   process-wide telemetry registry (serving + training + AOT
   instruments; runtime/telemetry.py, docs/OBSERVABILITY.md).
-* ``GET /v1/models``               — the multi-model policy table.
+* ``GET /v1/models``               — the multi-model policy table
+  (sequence models ride along with ``"kind": "sequence"`` rows).
 * ``GET /v1/models/<name>``        — one model's policy row (404).
 * ``POST /v1/models/<name>:predict`` — body
   ``{"instances": [...], "deadlineMs": optional}`` ->
   ``{"predictions": [...], "model": ..., "version": ..., "rows": n}``.
+* ``POST /v1/models/<name>:generate`` — the SEQUENCE route
+  (iteration-level slot scheduler, serving/sequence.py): body
+  ``{"steps": [[...], ...], "extraSteps": optional, "deadlineMs":
+  optional}`` -> ``{"outputs": [[...], ...], "steps": n}``; the
+  deadline is honored per decode STEP.
 
 Backpressure contract (docs/SERVING.md): queue full -> 429, deadline
 exceeded -> 504, unknown model -> 404, malformed request -> 400,
@@ -56,6 +62,8 @@ class _InferenceHandler(JsonHandler):
             return "models"
         if path.endswith(":predict"):
             return "predict"
+        if path.endswith(":generate"):
+            return "generate"
         if path.startswith("/v1/models/"):
             return "model"
         return "other"
@@ -86,6 +94,9 @@ class _InferenceHandler(JsonHandler):
     def handle_POST(self):
         host = self._owner().host
         path = self.path.split("?", 1)[0]
+        if path.startswith("/v1/models/") and path.endswith(":generate"):
+            return self._handle_generate(
+                host, path[len("/v1/models/"):-len(":generate")])
         if not (path.startswith("/v1/models/")
                 and path.endswith(":predict")):
             raise HttpError(404, f"no route {path}")
@@ -102,8 +113,11 @@ class _InferenceHandler(JsonHandler):
         except (TypeError, ValueError) as e:
             raise HttpError(400, f"instances not array-like: {e}")
         deadline_ms = body.get("deadlineMs")
-        deadline_s = None if deadline_ms is None \
-            else float(deadline_ms) / 1000.0
+        try:
+            deadline_s = None if deadline_ms is None \
+                else float(deadline_ms) / 1000.0
+        except (TypeError, ValueError) as e:
+            raise HttpError(400, f"deadlineMs not numeric: {e}")
         try:
             try:
                 sm = host.model(name)
@@ -128,6 +142,48 @@ class _InferenceHandler(JsonHandler):
             if isinstance(out, list) else np.asarray(out).tolist()
         return self._json({"predictions": preds, "model": sm.name,
                            "version": sm.version, "rows": len(feats)})
+
+    def _handle_generate(self, host, name):
+        """POST :generate — one sequence through the iteration-level
+        slot scheduler; same backpressure contract as :predict (429/
+        504/503/400/404), the deadline honored per decode step."""
+        try:
+            body = self._read_json_object()
+        except ValueError as e:
+            raise HttpError(400, str(e))
+        steps = body.get("steps")
+        if steps is None:
+            raise HttpError(400, 'body must carry "steps": [[...], ...]')
+        try:
+            feats = np.asarray(steps, dtype=np.float32)
+        except (TypeError, ValueError) as e:
+            raise HttpError(400, f"steps not array-like: {e}")
+        deadline_ms = body.get("deadlineMs")
+        try:
+            deadline_s = None if deadline_ms is None \
+                else float(deadline_ms) / 1000.0
+            extra = int(body.get("extraSteps", 0))
+        except (TypeError, ValueError) as e:
+            raise HttpError(400, f"deadlineMs/extraSteps not numeric: {e}")
+        try:
+            out = host.submit_sequence(name, feats,
+                                       deadline_s=deadline_s,
+                                       extra_steps=extra)
+            sm = host.sequence_model(name)  # post-submit: live version
+        except KeyError as e:
+            raise HttpError(404, str(e))
+        except ValueError as e:
+            raise HttpError(400, str(e))
+        except QueueFullError as e:
+            raise HttpError(429, str(e))
+        except DeadlineExceededError as e:
+            raise HttpError(504, str(e))
+        except ServingClosedError as e:
+            raise HttpError(503, str(e))
+        out = np.asarray(out)
+        return self._json({"outputs": out.tolist(), "model": sm.name,
+                           "version": sm.version,
+                           "steps": int(out.shape[0])})
 
 
 class InferenceServer(HttpServerOwner):

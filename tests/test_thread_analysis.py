@@ -603,6 +603,30 @@ class TestSelfCheck:
             assert os.path.exists(p), p
         assert len(THREADED_TIER) >= 8
 
+    def test_sequence_and_fleet_modules_covered_and_clean(self):
+        """ISSUE 15: the two new serving modules (the iteration-level
+        scheduler and the fleet router) are INSIDE the linted tier —
+        the `serving` directory entry picks them up file-by-file — and
+        lint clean on their own: the slot table, step lock and replica
+        book keep the PR 14 concurrency discipline."""
+        import os
+
+        from deeplearning4j_tpu.analysis.purity import iter_py_files
+        from deeplearning4j_tpu.analysis.threads import (
+            lint_thread_paths, threaded_tier_paths,
+        )
+
+        tier_files = {os.path.basename(p)
+                      for p in iter_py_files(threaded_tier_paths())}
+        assert {"sequence.py", "fleet.py"} <= tier_files
+        import deeplearning4j_tpu as pkg
+
+        base = os.path.join(os.path.dirname(os.path.abspath(
+            pkg.__file__)), "serving")
+        for mod in ("sequence.py", "fleet.py"):
+            rep = lint_thread_paths([os.path.join(base, mod)])
+            assert rep.ok, rep.format()
+
     def test_cli_concurrency_contract(self, tmp_path):
         """--concurrency keeps the CLI's 0/1/2 exit contract."""
         from deeplearning4j_tpu.analysis.cli import main
