@@ -72,7 +72,8 @@ __all__ = ["lint_thread_source", "lint_thread_paths", "THREADED_TIER",
 #: the package's thread-heavy modules — the default --concurrency
 #: subject and the tier-1 clean gate (ISSUE 14)
 THREADED_TIER = (
-    "serving",
+    "serving",                 # includes breaker.py (failure domains)
+    "runtime/chaos.py",        # fault seams fire on serving threads
     "runtime/telemetry.py",
     "runtime/aot.py",
     "runtime/autotune.py",

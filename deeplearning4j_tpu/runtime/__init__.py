@@ -16,6 +16,9 @@ from deeplearning4j_tpu.runtime.resilience import (
     RetryPolicy, retry, FaultInjector, Preemption, ResilientFit,
     NonFiniteStepError, non_finite_guard,
 )
+from deeplearning4j_tpu.runtime.chaos import (
+    ChaosError, ChaosPlan, fault_point,
+)
 
 __all__ = [
     "NativeRingBuffer", "PythonRingBuffer", "make_ring", "native_lib",
@@ -24,4 +27,5 @@ __all__ = [
     "PF_OK", "PF_TIMEOUT", "PF_CLOSED", "PF_TOO_BIG",
     "RetryPolicy", "retry", "FaultInjector", "Preemption", "ResilientFit",
     "NonFiniteStepError", "non_finite_guard",
+    "ChaosError", "ChaosPlan", "fault_point",
 ]

@@ -64,6 +64,9 @@ import time
 import jax
 import numpy as np
 
+from deeplearning4j_tpu.runtime.chaos import \
+    fault_point as _chaos_fault_point
+
 __all__ = [
     "ExecutableCache", "CachedJit", "cached_jit", "compile_lowered",
     "enable", "disable", "session_cache", "ambient_fingerprint",
@@ -382,6 +385,11 @@ class ExecutableCache:
             return None
         t0 = time.perf_counter()
         try:
+            # chaos seam INSIDE the corrupt-handling try: an injected
+            # raise or a corrupted path must be absorbed exactly like
+            # organic disk rot — a miss, never an error
+            # (runtime/chaos.py, seam aot.disk_read)
+            path = _chaos_fault_point("aot.disk_read", path)
             with open(path, "rb") as fh:
                 meta, payload, in_tree, out_tree = pickle.load(fh)
         except Exception:
@@ -437,6 +445,10 @@ class ExecutableCache:
         try:
             from jax.experimental import serialize_executable as _se
 
+            # chaos seam inside the swallow-everything try: an injected
+            # disk-write fault costs the artifact, never the process
+            # (runtime/chaos.py, seam aot.disk_write)
+            _chaos_fault_point("aot.disk_write")
             payload, in_tree, out_tree = _se.serialize(compiled)
             if len(payload) > self.max_artifact_bytes:
                 with self._lock:

@@ -151,6 +151,12 @@ class FaultInjector:
     killAfterStep — or drawn reproducibly from the seed
     (randomIOFaults). Every injection is recorded in .events as
     (kind, position) tuples so tests assert on exactly what fired.
+
+    Scope: the TRAINING data path only. The process-wide
+    generalization — seeded fault schedules against named seams at
+    every SERVING dispatch boundary (and this module's checkpoint
+    write/restore) — is ``runtime.chaos.ChaosPlan``
+    (docs/RESILIENCE.md "Chaos harness").
     """
 
     def __init__(self, seed: int = 0):
@@ -415,12 +421,20 @@ class ResilientFit:
         if self.wrapper is not None:
             get = getattr(self.wrapper, "_ckpt_trainer_state", None)
             trainer_state = get() if get is not None else None
-        retry(lambda: ShardedModelSerializer.writeModel(
-            net, path, saveUpdater=self.saveUpdater,
-            extra={"iteration": net._iteration, "epoch": net._epoch,
-                   "batch_in_epoch": int(batch_in_epoch)},
-            trainer_state=trainer_state),
-            self.retryPolicy)
+        from deeplearning4j_tpu.runtime.chaos import fault_point
+
+        def _write():
+            # chaos seam INSIDE the retry lambda: an injected write
+            # fault is retried like any transient I/O failure
+            # (runtime/chaos.py, seam checkpoint.write)
+            fault_point("checkpoint.write")
+            return ShardedModelSerializer.writeModel(
+                net, path, saveUpdater=self.saveUpdater,
+                extra={"iteration": net._iteration, "epoch": net._epoch,
+                       "batch_in_epoch": int(batch_in_epoch)},
+                trainer_state=trainer_state)
+
+        retry(_write, self.retryPolicy)
         _ckpt.gc_checkpoints(self.checkpointDir, self.keepLast)
         dt = tm["reg"].clock() - t0
         tm["ckpt_save_s"].observe(dt)
@@ -431,20 +445,41 @@ class ResilientFit:
     def _maybe_resume(self) -> int:
         """Restore the latest complete checkpoint into the wrapped net,
         returning the batch-within-epoch to replay past (0 = fresh or
-        epoch-aligned resume)."""
+        epoch-aligned resume). A checkpoint that fails its content
+        digest (CheckpointDigestError, util/sharded_checkpoint.py) is
+        treated as ABSENT: the walk falls back to the previous
+        snapshot instead of restoring silently-corrupt state."""
         if self.checkpointDir is None:
             return 0
-        step = _ckpt.latest_step(self.checkpointDir)
-        if step is None:
+        steps = _ckpt.complete_steps(self.checkpointDir)
+        if not steps:
             return 0
-        from deeplearning4j_tpu.util.sharded_checkpoint import \
-            ShardedModelSerializer
+        from deeplearning4j_tpu.runtime.chaos import fault_point
+        from deeplearning4j_tpu.util.sharded_checkpoint import (
+            CheckpointDigestError, ShardedModelSerializer,
+        )
 
-        path = _ckpt.step_path(self.checkpointDir, step)
         tm = _tm()
         t0 = tm["reg"].clock()
-        restored = retry(lambda: ShardedModelSerializer.restore(path),
-                         self.retryPolicy)
+        restored = path = None
+        for step in reversed(steps):
+            path = _ckpt.step_path(self.checkpointDir, step)
+
+            def _restore(p=path):
+                # chaos seam INSIDE the retry lambda (runtime/chaos.py,
+                # seam checkpoint.restore)
+                fault_point("checkpoint.restore")
+                return ShardedModelSerializer.restore(p)
+
+            try:
+                restored = retry(_restore, self.retryPolicy)
+                break
+            except CheckpointDigestError:
+                tm["reg"].event("resilience.checkpoint_corrupt",
+                                "resilience", step=step, path=path)
+                continue
+        if restored is None:
+            return 0    # every snapshot failed its digest: fresh start
         net = self.net
         net._params = restored._params
         net._states = restored._states

@@ -208,6 +208,14 @@ class _Child:
             vals = list(self.samples)
         return percentile(vals, q)
 
+    def mean(self):
+        """Mean over ALL observations (sum/count, not the bounded
+        reservoir); None before the first observe. The fleet brownout
+        controller's measured per-item service estimate
+        (serving/fleet.py)."""
+        with self._parent._lock:
+            return self.sum / self.count if self.count else None
+
     def reset(self):
         """Zero this series in place (handles cached by callers stay
         attached — MicroBatcher/OpProfiler read-through views rely on

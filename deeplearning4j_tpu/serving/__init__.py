@@ -23,6 +23,11 @@ compilation model of arXiv:1810.09868):
 * ``fleet``    — N ModelHost replicas behind a least-loaded router:
   per-model SLOs, queue-depth-driven autoscale DECISIONS (callback
   surface), fleet-wide zero-5xx rolling swaps, load scenarios.
+* ``breaker``  — the failure-domain primitives the fleet composes:
+  per-replica circuit breaker (closed/open/half-open), quarantine +
+  probe re-admission, ratio-capped retry budget, brownout admission
+  control. Proven against the deterministic chaos harness
+  (runtime/chaos.py).
 * ``server``   — the HTTP front (``InferenceServer``): /healthz-gated
   readiness, queue-full backpressure as 429, per-request deadlines as
   504, ``:predict`` (one-shot) and ``:generate`` (sequence) routes.
@@ -33,9 +38,12 @@ compilation model of arXiv:1810.09868):
 See docs/SERVING.md.
 """
 
+from deeplearning4j_tpu.serving.breaker import (  # noqa: F401
+    BrownoutController, CircuitBreaker, ReplicaHealth, RetryBudget,
+)
 from deeplearning4j_tpu.serving.queue import (  # noqa: F401
     DeadlineExceededError, InferenceRequest, ManualClock, MicroBatcher,
-    QueueFullError, ServingClosedError,
+    QueueFullError, RequestCancelledError, ServingClosedError,
 )
 from deeplearning4j_tpu.serving.sequence import (  # noqa: F401
     SequenceRequest, SequenceScheduler, greedy_onehot_feedback,
@@ -50,8 +58,11 @@ from deeplearning4j_tpu.serving.server import InferenceServer  # noqa: F401
 
 __all__ = [
     "DeadlineExceededError", "InferenceRequest", "ManualClock",
-    "MicroBatcher", "QueueFullError", "ServingClosedError",
+    "MicroBatcher", "QueueFullError", "RequestCancelledError",
+    "ServingClosedError",
     "SequenceRequest", "SequenceScheduler", "greedy_onehot_feedback",
     "ModelHost", "ServedModel", "ServedSequenceModel",
     "FleetRouter", "ModelSLO", "InferenceServer",
+    "BrownoutController", "CircuitBreaker", "ReplicaHealth",
+    "RetryBudget",
 ]

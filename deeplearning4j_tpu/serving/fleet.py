@@ -28,6 +28,22 @@ zero-5xx fleet-wide because each replica's swap already is
   slot occupancy + per-model fleet aggregates, additive over the
   per-host PR 13 snapshot schema.
 
+Failure domains (docs/SERVING.md "Failure domains"): every replica
+carries a ``ReplicaHealth`` — a closed/open/half-open circuit breaker
+over its dispatch outcomes plus a quarantine flag — consulted by the
+least-loaded ranking, so a replica that WEDGES or THROWS (not just one
+that politely raises QueueFullError) is evicted from organic traffic
+and re-admitted only after the breaker's half-open probe successes (or
+``probe_tick`` health canaries for a quarantined replica). Failover
+covers every dispatch-path error class (counted per class in
+``dl4j_fleet_failovers_total``) under a per-model ratio-capped
+``RetryBudget`` so a brown-out cannot amplify into a retry storm;
+``set_hedge`` arms tail-latency hedging for idempotent one-shot
+``:predict`` (second replica fired at the p95 mark, first response
+wins, loser cancelled); ``set_brownout`` sheds deadline-hopeless
+requests at admission. All of it is exercised by the deterministic
+chaos harness (runtime/chaos.py, seam ``fleet.dispatch``).
+
 Load scenarios (the bench `serving_fleet` leg's vocabulary): diurnal
 ramp (open-loop rate swept through a day curve), hot-model skew (one
 model takes most of the traffic), slow-client storm (closed-loop
@@ -45,10 +61,19 @@ import threading
 import numpy as np
 
 from deeplearning4j_tpu.runtime import telemetry
-from deeplearning4j_tpu.serving.queue import QueueFullError
+from deeplearning4j_tpu.runtime.chaos import fault_point
+from deeplearning4j_tpu.serving.breaker import (
+    BrownoutController, ReplicaHealth, RetryBudget,
+)
+from deeplearning4j_tpu.serving.queue import (
+    DeadlineExceededError, QueueFullError, ServingClosedError,
+)
 
 __all__ = ["FleetRouter", "ModelSLO", "scenario_diurnal_ramp",
            "scenario_hot_model_skew", "scenario_slow_client_storm"]
+
+#: breaker-state gauge encoding (dl4j_fleet_breaker_state)
+_BREAKER_STATES = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
 
 _REPLICA_SEQ = itertools.count(1)
 
@@ -92,12 +117,28 @@ class FleetRouter:
     docstring). Thread-safe: the replica table and SLO book are
     lock-guarded; dispatches run outside the lock."""
 
-    def __init__(self, replicas=(), clock=None):
+    def __init__(self, replicas=(), clock=None, breaker=None,
+                 readmit_after=3, retry_ratio=0.2, retry_burst=10.0):
+        """breaker: dict of CircuitBreaker kwargs applied to every
+        replica's health record (window/failure_ratio/min_samples/
+        open_for_s/close_after), or False to disable breaker +
+        quarantine gating entirely. retry_ratio/retry_burst: the
+        per-model RetryBudget (serving/breaker.py)."""
         self._lock = threading.Lock()
         self._replicas = {}        # id -> ModelHost
+        self._health = {}          # id -> ReplicaHealth
         self._slos = {}            # model name -> ModelSLO
         self._scale_cbs = []
+        self._budgets = {}         # model name -> RetryBudget
+        self._hedge = {}           # model name -> {"after_s": ...}
+        self._brownouts = {}       # model name -> BrownoutController
+        self._probes = {}          # model name -> canary features
         self._clock = clock
+        self._breaker_kw = None if breaker is False else dict(breaker
+                                                              or {})
+        self._readmit_after = int(readmit_after)
+        self._retry_ratio = float(retry_ratio)
+        self._retry_burst = float(retry_burst)
         reg = telemetry.get_registry()
         self._registry = reg
         self._m_requests = reg.counter(
@@ -106,14 +147,33 @@ class FleetRouter:
             labels=("model",))
         self._m_failover = reg.counter(
             "dl4j_fleet_failovers_total",
-            "requests shed to a peer replica on a full queue",
-            labels=("model",))
+            "requests shed to a peer replica, by error class",
+            labels=("model", "error"))
         self._m_latency = reg.histogram(
             "dl4j_fleet_request_seconds",
             "router-measured request latency (the SLO p99 source)",
             labels=("model",))
         self._m_replicas = reg.gauge(
             "dl4j_fleet_replicas", "replicas registered to the fleet")
+        self._m_breaker = reg.gauge(
+            "dl4j_fleet_breaker_state",
+            "per-replica breaker state (0 closed, 1 half-open, 2 open)",
+            labels=("replica",))
+        self._m_hedges = reg.counter(
+            "dl4j_fleet_hedges_total",
+            "hedged second dispatches fired", labels=("model",))
+        self._m_hedge_wins = reg.counter(
+            "dl4j_fleet_hedge_wins_total",
+            "hedged dispatches won by the second replica",
+            labels=("model",))
+        self._m_shed = reg.counter(
+            "dl4j_fleet_brownout_shed_total",
+            "requests shed at admission (deadline already unmeetable)",
+            labels=("model",))
+        self._m_probes = reg.counter(
+            "dl4j_fleet_probes_total",
+            "health-probe canaries against quarantined replicas",
+            labels=("model", "outcome"))
         for host in replicas:
             self.add_replica(host)
 
@@ -126,7 +186,12 @@ class FleetRouter:
             if rid in self._replicas:
                 raise ValueError(f"replica {rid!r} already attached")
             self._replicas[rid] = host
+            if self._breaker_kw is not None:
+                self._health[rid] = ReplicaHealth(
+                    readmit_after=self._readmit_after,
+                    clock=self._now, **self._breaker_kw)
             self._m_replicas.set(len(self._replicas))
+        self._m_breaker.labels(replica=rid).set(0.0)
         return rid
 
     def remove_replica(self, replica_id, drain=True):
@@ -134,7 +199,9 @@ class FleetRouter:
         work — the scale-down path)."""
         with self._lock:
             host = self._replicas.pop(replica_id, None)
+            self._health.pop(replica_id, None)
             self._m_replicas.set(len(self._replicas))
+        self._m_breaker.remove(replica=replica_id)
         if host is None:
             raise KeyError(f"unknown replica {replica_id!r} "
                            f"(attached: {self.replica_ids()})")
@@ -193,29 +260,104 @@ class FleetRouter:
         point-in-time probe — routing tolerates staleness."""
         return host.queued_work(name)
 
+    def health(self, replica_id):
+        """The replica's ReplicaHealth (breaker + quarantine), or None
+        when breaker gating is disabled (breaker=False)."""
+        with self._lock:
+            return self._health.get(replica_id)
+
+    def _note_outcome(self, rid, ok):
+        """Feed one dispatch outcome into the replica's breaker and
+        mirror the resulting state into the breaker gauge."""
+        h = self.health(rid)
+        if h is None:
+            return
+        state = h.record(ok)
+        self._m_breaker.labels(replica=rid).set(
+            _BREAKER_STATES.get(state, 0.0))
+
     def _ranked(self, name):
         """(replica_id, host) pairs serving `name`, least loaded
-        first."""
-        ranked = []
+        first. Replicas whose breaker is OPEN or that are QUARANTINED
+        are excluded from organic traffic — unless that would empty
+        the list, in which case the router FAILS OPEN and ranks the
+        barred replicas anyway (a wrongly-tripped fleet must degrade,
+        not hard-down; docs/SERVING.md "Failure domains")."""
+        ranked, barred = [], []
         for rid, host in self._hosts():
             load = self._queued_work(host, name)
-            if load is not None:
+            if load is None:
+                continue
+            h = self.health(rid)
+            if h is None or h.admissible():
                 ranked.append((load, rid, host))
-        if not ranked:
+            else:
+                barred.append((load, rid, host))
+        if not ranked and not barred:
             raise KeyError(
                 f"no replica serves model {name!r} "
                 f"(replicas: {self.replica_ids()})")
+        if not ranked:
+            ranked = barred  # fail open: serving beats a hard down
         ranked.sort(key=lambda t: (t[0], t[1]))
         return [(rid, host) for _, rid, host in ranked]
 
+    def _budget(self, name):
+        with self._lock:
+            b = self._budgets.get(name)
+            if b is None:
+                b = self._budgets[name] = RetryBudget(
+                    ratio=self._retry_ratio, burst=self._retry_burst)
+        return b
+
+    # -- admission: brownout ---------------------------------------------
+    def set_brownout(self, name, est_item_s=None, margin=1.0,
+                     enabled=True):
+        """Arm (or disarm) admission-time shedding for `name`: a
+        deadline-carrying request whose estimated queue delay (least
+        queued work x per-item estimate) already exceeds its deadline
+        is rejected NOW with DeadlineExceededError instead of wasting
+        queue space. est_item_s=None uses the measured mean request
+        latency (dl4j_fleet_request_seconds)."""
+        with self._lock:
+            if not enabled:
+                return self._brownouts.pop(name, None)
+            bo = self._brownouts[name] = BrownoutController(
+                est_item_s=est_item_s, margin=margin)
+        return bo
+
+    def _admit(self, name, deadline_s, least_load):
+        with self._lock:
+            bo = self._brownouts.get(name)
+        if bo is None or deadline_s is None:
+            return
+        child = self._m_latency.labels_get(model=name)
+        measured = child.mean() if child is not None else None
+        if bo.should_shed(least_load, deadline_s, measured):
+            self._m_shed.labels(model=name).inc()
+            raise DeadlineExceededError(
+                f"brownout: ~{bo.estimate_wait_s(least_load, measured):.3f}s "
+                f"of queued work ahead exceeds the {deadline_s:.3f}s "
+                f"deadline — shed at admission")
+
+    # -- dispatch --------------------------------------------------------
     def submit(self, name, features, deadline_s=None):
-        """Route one one-shot request to the least-loaded replica; on
-        QueueFullError fail over to the next-least-loaded. Only a
-        fleet-wide full queue re-raises (the client's 429)."""
+        """Route one one-shot request to the least-loaded admissible
+        replica; fail over on ANY dispatch-path error (not just
+        QueueFullError) within the per-model retry budget. Only a
+        fleet-wide failure re-raises. With set_hedge armed, a second
+        replica is fired at the p95 mark and the first response
+        wins."""
+        with self._lock:
+            hedge = self._hedge.get(name)
+        if hedge is not None:
+            return self._submit_hedged(name, features, deadline_s,
+                                       hedge)
         t0 = self._now()
         out = self._failover(
             name, lambda host: host.submit(name, features,
-                                           deadline_s=deadline_s))
+                                           deadline_s=deadline_s),
+            deadline_s=deadline_s)
         # observed only for COMPLETED requests: a 429 storm's fast
         # failures must not dilute the p99 the autoscaler votes on
         self._m_latency.labels(model=name).observe(self._now() - t0)
@@ -224,30 +366,201 @@ class FleetRouter:
     def submit_sequence(self, name, features, deadline_s=None,
                         extra_steps=0, wait=True, timeout=None):
         """Route one sequence to the least-loaded replica's slot
-        scheduler (same failover discipline as submit)."""
+        scheduler (same failover discipline as submit; sequences are
+        stateful mid-decode, so they are never hedged)."""
         t0 = self._now()
         out = self._failover(
             name, lambda host: host.submit_sequence(
                 name, features, deadline_s=deadline_s,
-                extra_steps=extra_steps, wait=wait, timeout=timeout))
+                extra_steps=extra_steps, wait=wait, timeout=timeout),
+            deadline_s=deadline_s)
         if wait:
             # wait=False returns at enqueue — that sample would read
             # sub-ms and suppress the autoscaler's p99 scale-up vote
             self._m_latency.labels(model=name).observe(self._now() - t0)
         return out
 
-    def _failover(self, name, call):
+    def _failover(self, name, call, deadline_s=None, want_rid=False):
+        """Try replicas least-loaded first. Error classification:
+
+        * QueueFullError / ServingClosedError — backpressure or a
+          replica mid-retirement: fail over (budget-capped) but do NOT
+          charge the replica's breaker; load is not a fault.
+        * DeadlineExceededError, ValueError, KeyError — the REQUEST's
+          own problem (deadline spent, malformed, unknown model): no
+          failover, no breaker charge; re-raise immediately.
+        * anything else — a replica fault: charge the breaker, fail
+          over (budget-capped). Only a fleet-wide failure surfaces.
+        """
         self._m_requests.labels(model=name).inc()
+        budget = self._budget(name)
+        budget.note_request()
         ranked = self._ranked(name)
+        self._admit(name, deadline_s,
+                    self._queued_work(ranked[0][1], name) or 0)
         last = None
         for i, (rid, host) in enumerate(ranked):
             try:
-                return call(host)
-            except QueueFullError as e:
+                # the routing chaos seam: an injected raise here is a
+                # dispatch-path fault on THIS replica (runtime/chaos.py)
+                fault_point("fleet.dispatch")
+                out = call(host)
+            except (QueueFullError, ServingClosedError) as e:
                 last = e
-                if i + 1 < len(ranked):  # shed to the next peer
-                    self._m_failover.labels(model=name).inc()
+                if i + 1 < len(ranked) and budget.try_spend():
+                    self._m_failover.labels(
+                        model=name, error=type(e).__name__).inc()
+                    continue
+                raise
+            except (DeadlineExceededError, ValueError, KeyError):
+                raise
+            except Exception as e:
+                last = e
+                self._note_outcome(rid, False)
+                if i + 1 < len(ranked) and budget.try_spend():
+                    self._m_failover.labels(
+                        model=name, error=type(e).__name__).inc()
+                    continue
+                raise
+            else:
+                self._note_outcome(rid, True)
+                return (out, rid) if want_rid else out
         raise last
+
+    # -- hedged dispatch -------------------------------------------------
+    def set_hedge(self, name, after_s=None, enabled=True):
+        """Arm (or disarm) tail-latency hedging for idempotent one-shot
+        `name`: when the primary has not answered within the hedge
+        mark, fire the SAME request at the next-ranked replica — first
+        response wins, the loser is cancelled. after_s=None uses the
+        live p95 of dl4j_fleet_request_seconds (falling back to 50 ms
+        until enough samples exist). Hedges spend the same retry
+        budget as failovers, so a brown-out cannot double the load."""
+        with self._lock:
+            if not enabled:
+                return self._hedge.pop(name, None)
+            self._hedge[name] = {"after_s": None if after_s is None
+                                 else float(after_s)}
+
+    def _hedge_after(self, name, conf):
+        if conf["after_s"] is not None:
+            return conf["after_s"]
+        child = self._m_latency.labels_get(model=name)
+        p95 = child.percentile(95) if child is not None else None
+        return 0.05 if p95 is None else p95
+
+    def _submit_hedged(self, name, features, deadline_s, conf):
+        import time as _time
+
+        t0 = self._now()
+        req1, rid1 = self._failover(
+            name, lambda host: host.submit(name, features,
+                                           deadline_s=deadline_s,
+                                           wait=False),
+            deadline_s=deadline_s, want_rid=True)
+        legs = [(rid1, req1)]
+        hedge_after = self._hedge_after(name, conf)
+        if not req1.wait_done(hedge_after):
+            # primary is past the hedge mark: fire the second replica
+            # (next-ranked, never the same one) if budget allows
+            cand = next(((rid, h) for rid, h in self._ranked(name)
+                         if rid != rid1), None)
+            if cand is not None and self._budget(name).try_spend():
+                rid2, host2 = cand
+                rem = None if deadline_s is None else \
+                    max(1e-3, deadline_s - (self._now() - t0))
+                try:
+                    req2 = host2.submit(name, features, deadline_s=rem,
+                                        wait=False)
+                except Exception:
+                    req2 = None  # hedge enqueue failed: primary races on
+                if req2 is not None:
+                    self._m_hedges.labels(model=name).inc()
+                    legs.append((rid2, req2))
+        # first COMPLETED-with-result leg wins; a leg that completes
+        # with an error is charged to its replica and dropped so the
+        # other leg keeps racing (hedging covers faults for free)
+        last_err = None
+        while legs:
+            for rid, req in list(legs):
+                if not req.wait_done(0.002 / len(legs)):
+                    continue
+                if req.error is not None:
+                    self._note_outcome(rid, False)
+                    legs.remove((rid, req))
+                    last_err = req.error
+                    continue
+                for orid, other in legs:    # cancel the loser(s)
+                    if other is not req:
+                        other.cancel()
+                self._note_outcome(rid, True)
+                if req is not req1:
+                    self._m_hedge_wins.labels(model=name).inc()
+                self._m_latency.labels(model=name).observe(
+                    self._now() - t0)
+                return req.result
+            if deadline_s is not None \
+                    and self._now() - t0 > deadline_s + 1.0:
+                # backstop only: each leg's own deadline releases it
+                # (the queue.py wait contract) long before this fires
+                for _, req in legs:
+                    req.cancel()
+                raise DeadlineExceededError(
+                    f"hedged request exceeded {deadline_s:.3f}s")
+            _time.sleep(0.0)  # yield between polls
+        raise last_err
+
+    # -- health probes / quarantine --------------------------------------
+    def quarantine(self, replica_id):
+        """Remove a replica from organic traffic; it serves only
+        probe_tick canaries until readmit_after consecutive successes
+        re-admit it (breaker reset on re-admission)."""
+        h = self.health(replica_id)
+        if h is None:
+            raise RuntimeError(
+                "breaker gating disabled (breaker=False) — "
+                "quarantine needs ReplicaHealth")
+        h.quarantine()
+        self._m_breaker.labels(replica=replica_id).set(
+            _BREAKER_STATES["open"])
+        return h
+
+    def set_probe(self, name, features, deadline_s=1.0):
+        """Register the canary request probe_tick sends for `name`."""
+        with self._lock:
+            self._probes[name] = (np.asarray(features),
+                                  float(deadline_s))
+
+    def probe_tick(self):
+        """Send one canary per (quarantined replica, probed model it
+        serves). Returns structured probe results; a replica whose
+        consecutive-success streak reaches readmit_after is re-admitted
+        (and its breaker reset). Call this from the operator loop the
+        same way as autoscale_tick."""
+        with self._lock:
+            probes = dict(self._probes)
+        results = []
+        for rid, host in self._hosts():
+            h = self.health(rid)
+            if h is None or not h.quarantined:
+                continue
+            for name, (feats, deadline_s) in probes.items():
+                if host.queued_work(name) is None:
+                    continue
+                try:
+                    host.submit(name, feats, deadline_s=deadline_s)
+                    ok = True
+                except Exception:
+                    ok = False
+                readmitted = h.note_probe(ok)
+                self._m_probes.labels(
+                    model=name, outcome="ok" if ok else "fail").inc()
+                if readmitted:
+                    self._m_breaker.labels(replica=rid).set(
+                        _BREAKER_STATES["closed"])
+                results.append({"replica": rid, "model": name,
+                                "ok": ok, "readmitted": readmitted})
+        return results
 
     def _now(self):
         return self._clock() if self._clock is not None \
@@ -507,15 +820,45 @@ def scenario_hot_model_skew(submit_for, make_request, *, models,
 def scenario_slow_client_storm(submit, make_request, *, n_clients=24,
                                requests_per_client=8,
                                think_time_s=0.01, seed=0,
-                               timeout_s=120.0):
+                               timeout_s=120.0, hedged_submit=None,
+                               hedge_stats=None):
     """A storm of CLOSED-LOOP clients that block on each response and
     think before the next request — the slow-client population an
     open loop cannot model (loadgen.run_closed_loop). Records
-    rps/p50/p99 + error classes."""
+    rps/p50/p99 + error classes.
+
+    hedged_submit: optional second submit callable with tail-latency
+    hedging armed (FleetRouter.set_hedge) — the SAME seeded storm
+    reruns through it and the record gains a ``hedged`` sub-record
+    with the hedge fire-rate and the p99 delta (negative = hedging
+    won; docs/SERVING.md "Failure domains" explains when it loses).
+    hedge_stats: zero-arg callable returning the cumulative
+    hedges-fired count (e.g. the dl4j_fleet_hedges_total child's
+    ``.value``) so the scenario can report the fire-rate."""
     from deeplearning4j_tpu.serving import loadgen
 
     rec = loadgen.run_closed_loop(
         submit, make_request, n_clients=n_clients,
         requests_per_client=requests_per_client,
         think_time_s=think_time_s, seed=seed, timeout_s=timeout_s)
-    return dict(rec, scenario="slow_client_storm")
+    out = dict(rec, scenario="slow_client_storm")
+    if hedged_submit is not None:
+        fired0 = hedge_stats() if hedge_stats is not None else None
+        hrec = loadgen.run_closed_loop(
+            hedged_submit, make_request, n_clients=n_clients,
+            requests_per_client=requests_per_client,
+            think_time_s=think_time_s, seed=seed, timeout_s=timeout_s)
+        hedged = {k: hrec[k] for k in ("requests", "completed",
+                                       "errors", "requests_per_sec",
+                                       "p50_ms", "p99_ms")
+                  if k in hrec}
+        if fired0 is not None:
+            fired = hedge_stats() - fired0
+            hedged["hedges_fired"] = int(fired)
+            hedged["hedge_rate"] = round(
+                fired / max(1, hrec.get("requests", 0)), 4)
+        if "p99_ms" in hrec and "p99_ms" in rec:
+            hedged["p99_delta_ms"] = round(
+                hrec["p99_ms"] - rec["p99_ms"], 3)
+        out["hedged"] = hedged
+    return out

@@ -53,16 +53,22 @@ class ServedModel:
         per-bucket {key, status, seconds} report."""
         return self.pi.precompile(cache=cache)
 
-    def submit(self, features, deadline_s=None):
+    def submit(self, features, deadline_s=None, wait=True):
         """Queue one request (features [rows, ...]) and block for its
         sliced result. deadline_s bounds the WHOLE request (queue wait
         + dispatch): expiry raises DeadlineExceededError whether the
         request was still queued or the dispatcher is busy. May raise
-        QueueFullError (backpressure)."""
+        QueueFullError (backpressure). wait=False returns the
+        InferenceRequest at enqueue — the fleet's hedged-dispatch
+        handle (serving/fleet.py)."""
+        from deeplearning4j_tpu.runtime.chaos import fault_point
+
         b = self.batcher
+        features = fault_point("host.submit", features)
         deadline = None if deadline_s is None else \
             b.clock() + float(deadline_s)
-        return b.submit(features, deadline=deadline, timeout=deadline_s)
+        return b.submit(features, deadline=deadline, wait=wait,
+                        timeout=deadline_s)
 
     def policy(self):
         """The policy row the multi-model table reports."""
@@ -110,7 +116,10 @@ class ServedSequenceModel:
 
     def submit(self, features, deadline_s=None, extra_steps=0,
                wait=True, timeout=None):
+        from deeplearning4j_tpu.runtime.chaos import fault_point
+
         sched = self.scheduler
+        features = fault_point("host.submit_sequence", features)
         deadline = None if deadline_s is None else \
             sched.clock() + float(deadline_s)
         return sched.submit(features, deadline=deadline,
@@ -345,20 +354,25 @@ class ModelHost:
                 f"{sorted(self.names())})")
         return sm
 
-    def submit(self, name, features, deadline_s=None):
+    def submit(self, name, features, deadline_s=None, wait=True):
         """Route one request. Once ENQUEUED, a request completes on the
         version it was enqueued against even if a swap lands mid-flight
         (the drain contract). A request that instead loses the
         resolve/enqueue race against a swap — the old version closed
         between routing and enqueue — is transparently re-routed to the
-        new version: a rolling swap must never surface as a 5xx."""
+        new version: a rolling swap must never surface as a 5xx.
+        wait=False returns the InferenceRequest at enqueue (the swap
+        re-route still covers the ENQUEUE race; the returned handle
+        then completes on its version)."""
         from deeplearning4j_tpu.serving.queue import ServingClosedError
 
         feats = np.asarray(features)
         try:
-            return self.model(name).submit(feats, deadline_s=deadline_s)
+            return self.model(name).submit(feats, deadline_s=deadline_s,
+                                           wait=wait)
         except ServingClosedError:
-            return self.model(name).submit(feats, deadline_s=deadline_s)
+            return self.model(name).submit(feats, deadline_s=deadline_s,
+                                           wait=wait)
 
     # -- introspection / lifecycle --------------------------------------
     def names(self):

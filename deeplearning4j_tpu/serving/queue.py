@@ -42,10 +42,12 @@ from collections import deque
 import numpy as np
 
 from deeplearning4j_tpu.runtime import telemetry
+from deeplearning4j_tpu.runtime.chaos import fault_point
 
 __all__ = [
     "QueueFullError", "DeadlineExceededError", "ServingClosedError",
-    "InferenceRequest", "MicroBatcher", "ManualClock",
+    "RequestCancelledError", "InferenceRequest", "MicroBatcher",
+    "ManualClock",
 ]
 
 #: unique default metric label for anonymous batchers (each instance is
@@ -68,6 +70,12 @@ class DeadlineExceededError(RuntimeError):
 
 class ServingClosedError(RuntimeError):
     """Submitted to a closed/draining batcher (HTTP 503)."""
+
+
+class RequestCancelledError(RuntimeError):
+    """The submitter cancelled a still-pending request (e.g. the
+    losing leg of a hedged dispatch, serving/fleet.py) — the scheduler
+    drops it before it wastes bucket rows."""
 
 
 class ManualClock:
@@ -113,6 +121,26 @@ class InferenceRequest:
     def fail(self, exc):
         self.error = exc
         self._event.set()
+
+    def wait_done(self, timeout=None):
+        """Block up to `timeout` for completion WITHOUT raising or
+        consuming the outcome: True = a result or error is set. The
+        hedged-dispatch primitive — the router polls two in-flight
+        requests and only the winner's ``wait()`` re-raises."""
+        return self._event.wait(timeout)
+
+    def cancel(self, exc=None):
+        """Best-effort cancellation: a still-pending request is failed
+        with RequestCancelledError (the scheduler then drops it before
+        it wastes bucket rows — _take_batch_locked skips done
+        requests); a request already completed, or already inside a
+        running dispatch, keeps its outcome and its late result is
+        simply discarded. Returns True when THIS call cancelled it."""
+        if self._event.is_set():
+            return False
+        self.fail(exc if exc is not None else RequestCancelledError(
+            "request cancelled by submitter"))
+        return True
 
     def wait(self, timeout=None):
         """Block until the batch carrying this request completes.
@@ -335,6 +363,11 @@ class MicroBatcher:
         batch, rows = [], 0
         while self._pending:
             req = self._pending[0]
+            if req.done:
+                # cancelled (hedge loser) or released: already failed,
+                # must not waste bucket rows
+                self._pending.popleft()
+                continue
             if batch and rows + req.rows > self.max_rows:
                 break
             batch.append(self._pending.popleft())
@@ -374,6 +407,10 @@ class MicroBatcher:
         try:
             feats = batch[0].features if len(batch) == 1 else \
                 np.concatenate([r.features for r in batch], axis=0)
+            # chaos seam INSIDE the batch-failure try: an injected
+            # raise fails this batch exactly the way an organic
+            # dispatch error does (runtime/chaos.py)
+            feats = fault_point("queue.dispatch", feats)
             outs = self._dispatch(feats)
         except Exception as e:
             self._m["errors"].inc(len(batch))
@@ -424,7 +461,8 @@ class MicroBatcher:
                 if not self._pending:
                     return
                 batch = self._take_batch_locked()
-            self._run_batch(batch)
+            if batch:    # may be empty when every waiter was cancelled
+                self._run_batch(batch)
 
     def _loop(self):
         """Background scheduler. Uses the real condition-variable clock

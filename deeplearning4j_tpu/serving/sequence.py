@@ -66,6 +66,8 @@ from collections import deque
 import numpy as np
 
 from deeplearning4j_tpu.runtime import telemetry
+from deeplearning4j_tpu.runtime.chaos import \
+    fault_point as _chaos_fault_point
 from deeplearning4j_tpu.serving.queue import (
     DeadlineExceededError, QueueFullError, ServingClosedError,
     occupancy_summary_from,
@@ -463,6 +465,10 @@ class SequenceScheduler:
         self._m["occupancy"].observe(len(batch) / S)
         self.occupancy.append((len(batch), S))
         try:
+            # chaos seam INSIDE the step-failure try: an injected raise
+            # fails this slot batch the way an organic step error does
+            # (runtime/chaos.py)
+            x = _chaos_fault_point("sequence.step", x)
             out, new_carries = self.model.rnnStepBatched(x, carries)
             out = np.asarray(out)
             # ONE device->host pull per carry array per iteration; the

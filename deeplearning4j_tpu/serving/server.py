@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from deeplearning4j_tpu.runtime.chaos import fault_point
 from deeplearning4j_tpu.serving.queue import (
     DeadlineExceededError, QueueFullError, ServingClosedError,
 )
@@ -92,6 +93,10 @@ class _InferenceHandler(JsonHandler):
         raise HttpError(404, f"no route {path}")
 
     def handle_POST(self):
+        # chaos seam for the HTTP boundary itself: an injected raise
+        # here surfaces as the handler's 500 — the client-visible
+        # failure mode the fleet's failover must absorb upstream
+        fault_point("server.request")
         host = self._owner().host
         path = self.path.split("?", 1)[0]
         if path.startswith("/v1/models/") and path.endswith(":generate"):

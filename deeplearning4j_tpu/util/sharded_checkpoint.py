@@ -31,6 +31,7 @@ rotation).
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import re
@@ -46,6 +47,42 @@ _STATE_DIR = "state"
 _TRAINER_DIR = "trainer"
 _STEP_RE = re.compile(r"^step_(\d+)$")
 _TMP_RE = re.compile(r"\.tmp-")
+
+
+class CheckpointDigestError(ValueError):
+    """The restored state does not hash to the digest its manifest
+    committed with — the checkpoint is silently corrupt (bit rot, a
+    torn copy, a tampered file). ResilientFit treats it as ABSENT and
+    falls back to the previous snapshot (runtime/resilience.py)."""
+
+
+def state_digest(state) -> str:
+    """sha256 over the state pytree's leaves (dtype + shape + raw
+    bytes, in deterministic tree-flatten order). Computed from the
+    in-memory state at save time — it rides manifest.json through the
+    same atomic commit rename as the arrays it describes — and
+    recomputed from the restored state at restore time. Single-host
+    only: a multi-host save skips the digest (gathering every remote
+    shard through one host at save time would defeat the sharded
+    writer), so absence of the manifest key means "not verified",
+    never "corrupt".
+
+    Integer leaves are canonicalized to int64 before hashing: the
+    restore target is rebuilt through jnp.asarray, which narrows the
+    int64 step counters to int32 when jax_enable_x64 is off — a
+    LEGITIMATE width coercion, not corruption, and it must not depend
+    on whether the saving and restoring interpreters agree on the x64
+    flag. Float/bool leaves keep their exact dtype (a bf16/f32 flip IS
+    corruption)."""
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(state):
+        a = np.asarray(leaf)
+        if a.dtype.kind in "iu":
+            a = np.asarray(a, np.int64)
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
 
 
 def _commit(tmp: str, final: str):
@@ -95,24 +132,33 @@ def step_path(directory, step: int) -> str:
     return os.path.join(os.path.abspath(str(directory)), f"step_{int(step)}")
 
 
-def latest_step(directory):
-    """Highest step number with a COMPLETE checkpoint under `directory`
-    (a committed `step_<n>` dir with its manifest), or None. Staged
+def complete_steps(directory):
+    """Every step number with a COMPLETE checkpoint under `directory`
+    (a committed `step_<n>` dir with its manifest), ascending. Staged
     `.tmp-*` leftovers from preempted saves are never candidates — the
-    commit rename is what makes a checkpoint visible here."""
+    commit rename is what makes a checkpoint visible here. The resume
+    fallback chain: ResilientFit walks this newest-first so a
+    digest-corrupt latest checkpoint falls back to the previous
+    snapshot (runtime/resilience.py)."""
     directory = os.path.abspath(str(directory))
     if not os.path.isdir(directory):
-        return None
-    best = None
+        return []
+    steps = []
     for name in os.listdir(directory):
         m = _STEP_RE.match(name)
         if not m:
             continue
         if not os.path.exists(os.path.join(directory, name, _MANIFEST)):
             continue
-        n = int(m.group(1))
-        best = n if best is None else max(best, n)
-    return best
+        steps.append(int(m.group(1)))
+    return sorted(steps)
+
+
+def latest_step(directory):
+    """Highest complete step under `directory`, or None
+    (complete_steps)."""
+    steps = complete_steps(directory)
+    return steps[-1] if steps else None
 
 
 def gc_checkpoints(directory, keepLast: int):
@@ -223,6 +269,7 @@ class ShardedModelSerializer:
             os.makedirs(tmp)
         conf_arrays = []
         conf_node = serde.encode(net.conf, conf_arrays)
+        state = _net_state(net, saveUpdater)
         manifest = {
             "cls": type(net).__name__,
             "conf": conf_node,
@@ -235,6 +282,10 @@ class ShardedModelSerializer:
             "saveUpdater": bool(saveUpdater),
             "trainerState": trainer_state is not None,
         }
+        if jax.process_count() == 1:
+            # content digest riding the same atomic commit as the
+            # state it describes; restore() verifies it
+            manifest["digest"] = state_digest(state)
         if extra is not None:
             manifest["extra"] = extra
         if jax.process_index() == 0:
@@ -250,7 +301,7 @@ class ShardedModelSerializer:
         ckpt = (ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
                 if asyncSave else ocp.StandardCheckpointer())
         state_path = os.path.join(tmp, _STATE_DIR)
-        ckpt.save(state_path, _net_state(net, saveUpdater), force=True)
+        ckpt.save(state_path, state, force=True)
         handle = _AtomicSaveHandle(ckpt, tmp, path)
         if not asyncSave:
             handle.wait_until_finished()
@@ -300,6 +351,15 @@ class ShardedModelSerializer:
         ckpt = ocp.StandardCheckpointer()
         state = ckpt.restore(os.path.join(path, _STATE_DIR), abstract)
         ckpt.wait_until_finished()
+
+        want = manifest.get("digest")
+        if want is not None and jax.process_count() == 1:
+            got = state_digest(state)
+            if got != want:
+                raise CheckpointDigestError(
+                    f"checkpoint {path} fails digest verification "
+                    f"(manifest {want[:12]}…, restored {got[:12]}…) — "
+                    "silently-corrupt state must not be restored")
 
         net._params = state["params"]
         net._states = state["states"]

@@ -158,12 +158,14 @@ class TestFleetRouting:
                 while b.depth < 1 and time.time() < deadline:
                     time.sleep(0.01)
                 assert b.depth == 1
-            reg_before = fleet._m_failover.labels(model="m").value
+            lab = fleet._m_failover.labels(model="m",
+                                           error="QueueFullError")
+            reg_before = lab.value
             with pytest.raises(QueueFullError):
                 fleet.submit("m", _rows(1, seed=9))
-            # the router tried the peer before giving up
-            assert fleet._m_failover.labels(model="m").value \
-                == reg_before + 1
+            # the router tried the peer before giving up, and the
+            # failover was counted under its error class
+            assert lab.value == reg_before + 1
             for ev in releases:
                 ev.set()
         finally:
@@ -441,6 +443,36 @@ class TestScenarios:
             assert rec["scenario"] == "slow_client_storm"
             assert rec["completed"] == 18 and rec["errors"] == {}
             assert rec["p99_ms"] is not None
+        finally:
+            fleet.close()
+
+    def test_slow_client_storm_hedged_rerun(self, fresh_cache):
+        """hedged_submit reruns the SAME seeded storm through the
+        hedging path and the record gains the fire-rate + p99 delta
+        (ISSUE 16 satellite)."""
+        fleet, _ = _fleet(2, _mln(), queueLimit=128)
+        try:
+            hedges = fleet._m_hedges.labels(model="m")
+            armed = []
+
+            def hedged_submit(x):
+                if not armed:   # arm lazily: the base storm runs clean
+                    fleet.set_hedge("m", after_s=10.0)
+                    armed.append(1)
+                return fleet.submit("m", x)
+
+            rec = scenario_slow_client_storm(
+                lambda x: fleet.submit("m", x),
+                lambda c, i: _rows(1, seed=c * 10 + i),
+                n_clients=4, requests_per_client=3, think_time_s=0.0,
+                seed=2, hedged_submit=hedged_submit,
+                hedge_stats=lambda: hedges.value)
+            h = rec["hedged"]
+            assert h["completed"] == 12 and h["errors"] == {}
+            # a 10 s mark never fires on this workload: the record
+            # still carries the (zero) fire-rate and the p99 delta
+            assert h["hedges_fired"] == 0 and h["hedge_rate"] == 0.0
+            assert isinstance(h["p99_delta_ms"], float)
         finally:
             fleet.close()
 
