@@ -1017,9 +1017,10 @@ def bench_analysis():
     layer — is the cost a pre-flight `--zoo`/validate=True gate adds
     BEFORE any pod slot is claimed, so it must stay host-cheap. Also
     times the purity lint over the package source, the pass-8
-    thread-safety lint over the threaded tier (--concurrency), and
+    thread-safety lint over the threaded tier (--concurrency), the
+    pass-9 failure-path lint over the same tier (--failpaths), and
     the pass-7 collective-contract sweep (one TRACE per
-    gradient-compression mode, zero compiles) — ISSUE 14."""
+    gradient-compression mode, zero compiles) — ISSUE 14/18."""
     import jax
     import jax.numpy as jnp
     import jax.tree_util as jtu
@@ -1027,6 +1028,7 @@ def bench_analysis():
     from deeplearning4j_tpu.analysis import lint_paths
     from deeplearning4j_tpu.analysis import collectives as colan
     from deeplearning4j_tpu.analysis.cli import run_zoo
+    from deeplearning4j_tpu.analysis.faults import lint_fault_paths
     from deeplearning4j_tpu.analysis.threads import lint_thread_paths
 
     t0 = time.perf_counter()
@@ -1046,6 +1048,12 @@ def bench_analysis():
     t0 = time.perf_counter()
     thr_rep = lint_thread_paths()
     threads_s = time.perf_counter() - t0
+
+    # pass 9: the failure-path lint over the same tier (pure AST —
+    # host-only, device-safe under a dead tunnel like every lint here)
+    t0 = time.perf_counter()
+    flt_rep = lint_fault_paths()
+    failpaths_s = time.perf_counter() - t0
 
     # pass 7: trace + contract-check every gradient_compression mode's
     # train step on a dp mesh (make_jaxpr only — no XLA compile)
@@ -1099,14 +1107,18 @@ def bench_analysis():
         "threads_wall_s": round(threads_s, 3),
         "threads_violations": len(thr_rep.errors),   # must be 0
         "threads_suppressed": len(thr_rep.suppressed),
+        "failpaths_wall_s": round(failpaths_s, 3),
+        "failpaths_violations": len(flt_rep.errors),   # must be 0
+        "failpaths_suppressed": len(flt_rep.suppressed),
         "collectives_wall_s": col_s,   # None on a 1-device host
         "collectives_errors": col_errors,  # must be {} — contract gate
         "note": ("config shape/dtype validation (incl. eval_shape "
                  "forward-agreement deep check) over the 16-model zoo "
                  "corpus + purity lint of the package source + "
-                 "thread-safety lint of the threaded tier + one-trace "
-                 "collective-contract sweep over the compression "
-                 "modes; host-only, no TPU"),
+                 "thread-safety and failure-path lints of the "
+                 "threaded tier + one-trace collective-contract "
+                 "sweep over the compression modes; host-only, "
+                 "no TPU"),
     }
 
 

@@ -1,6 +1,6 @@
 """Pre-compilation static analysis.
 
-Eight passes, one CLI (``python -m deeplearning4j_tpu.analysis``):
+Nine passes, one CLI (``python -m deeplearning4j_tpu.analysis``):
 
 - shape/dtype inference over model configs (shapes.validate_model)
 - SameDiff graph validation (samediff_check.validate_samediff)
@@ -21,9 +21,14 @@ Eight passes, one CLI (``python -m deeplearning4j_tpu.analysis``):
   COL01-06 — one trace, zero compiles)
 - host-side thread-safety lint over the threaded serving/runtime tier
   (threads.lint_thread_paths, THR01-04, CLI ``--concurrency``)
+- failure-path lint + fault-seam coverage proof over the same tier
+  (faults.lint_fault_paths / faults.seam_coverage, FLT01-06, CLI
+  ``--failpaths``): swallowed excepts, dispatch boundaries with no
+  reachable chaos seam, unbounded blocking/retry, seams under held
+  locks, seam-name integrity against runtime/chaos.py
 
 See docs/ANALYSIS.md for the diagnostic catalogue and suppression
-syntax (``purity-ok[...]`` / ``thread-ok[...]``).
+syntax (``purity-ok[...]`` / ``thread-ok[...]`` / ``fault-ok[...]``).
 ``MultiLayerNetwork.init(validate=True)`` /
 ``ComputationGraph.init(validate=True)`` run the shape pass eagerly and
 raise ConfigValidationError instead of deferring mistakes to trace
@@ -55,6 +60,9 @@ from deeplearning4j_tpu.analysis.collectives import (  # noqa: F401
 from deeplearning4j_tpu.analysis.threads import (  # noqa: F401
     THREADED_TIER, lint_thread_paths, lint_thread_source,
 )
+from deeplearning4j_tpu.analysis.faults import (  # noqa: F401
+    coverage_gaps, lint_fault_paths, lint_fault_source, seam_coverage,
+)
 
 __all__ = ["ALL_CODES", "ConfigValidationError", "Diagnostic", "Report",
            "validate_model", "validate_or_raise", "validate_samediff",
@@ -66,7 +74,9 @@ __all__ = ["ALL_CODES", "ConfigValidationError", "Diagnostic", "Report",
            "collective_counts", "collective_signature",
            "check_signature", "check_acc_dtype", "check_bill",
            "compression_contract", "linalg_contract", "verify_program",
-           "THREADED_TIER", "lint_thread_paths", "lint_thread_source"]
+           "THREADED_TIER", "lint_thread_paths", "lint_thread_source",
+           "lint_fault_paths", "lint_fault_source", "seam_coverage",
+           "coverage_gaps"]
 
 
 def validate_or_raise(conf, batchSize=32, mesh=None, hbm_gb=None,

@@ -39,6 +39,14 @@ source paths, defaulting to the package's own threaded tier
 parallel/inference, util/httpserve+profiler). Pure AST — no imports,
 no jax, no execution.
 
+``--failpaths`` runs the failure-path lint (FLT01-06,
+docs/ANALYSIS.md pass 9) over the given source paths, defaulting to
+the same threaded tier: swallowed broad excepts, dispatch boundaries
+with no reachable chaos ``fault_point()`` seam, unbounded blocking
+calls, seams firing under held locks, boundless retry/poll loops, and
+seam-name integrity against runtime/chaos.py. Pure AST — no imports,
+no jax, no execution.
+
 Exit status: 0 = clean (warnings allowed), 1 = errors found,
 2 = usage / unreadable input.
 """
@@ -86,6 +94,13 @@ def _build_parser():
                         "docs/ANALYSIS.md pass 8) over the given "
                         "source paths (default: the package's "
                         "threaded serving/runtime tier)")
+    p.add_argument("--failpaths", action="store_true",
+                   help="run the failure-path lint (FLT01-06, "
+                        "docs/ANALYSIS.md pass 9: swallowed excepts, "
+                        "seam-less dispatch boundaries, unbounded "
+                        "blocking/retry, seams under locks, seam-name "
+                        "integrity) over the given source paths "
+                        "(default: the package's threaded tier)")
     p.add_argument("--linalg", action="store_true",
                    help="statically validate the canonical distributed-"
                         "linalg block plans (SUMMA GEMM, tall Gram, "
@@ -261,12 +276,15 @@ def main(argv=None):
         ("--precompile", bool(args.precompile)),
         ("--attribution", bool(args.attribution)),
         ("--linalg", args.linalg),
-        # --concurrency owns the paths when given (they are its lint
-        # subject), so it conflicts with every other subject
+        # --concurrency/--failpaths own the paths when given (they are
+        # their lint subject), so each conflicts with every other
+        # subject
         ("--concurrency", args.concurrency),
+        ("--failpaths", args.failpaths),
         # --parallel is a modifier OF the zoo/paths subject
         ("--zoo/paths", bool(args.zoo or (args.paths
-                                          and not args.concurrency)
+                                          and not args.concurrency
+                                          and not args.failpaths)
                              or args.parallel)),
     ) if on]
     if len(selected) > 1:
@@ -307,6 +325,35 @@ def main(argv=None):
         shown = paths if paths else \
             [_os.path.relpath(p) for p in threaded_tier_paths()]
         rep.subject = "threads:" + ",".join(shown)
+        if args.as_json:
+            print(_json.dumps(
+                {"reports": [_report_to_json(rep.subject, rep)],
+                 "ok": rep.ok}, indent=2))
+        else:
+            print(rep.format(verbose=args.verbose))
+            print(f"\n1 subject(s): {len(rep.errors)} error(s), "
+                  f"{len(rep.warnings)} warning(s)")
+        return 0 if rep.ok else 1
+
+    if args.failpaths:
+        import os as _os
+
+        from deeplearning4j_tpu.analysis.faults import lint_fault_paths
+        from deeplearning4j_tpu.analysis.threads import threaded_tier_paths
+
+        paths = args.paths or None
+        if paths:
+            missing = [p for p in paths if not _os.path.exists(p)]
+            if missing:
+                # same vacuous-pass guard as the other lint subjects: a
+                # typo'd path must not un-gate a CI wired to this
+                print("no such path(s): " + ", ".join(missing),
+                      file=sys.stderr)
+                return 2
+        rep = lint_fault_paths(paths)
+        shown = paths if paths else \
+            [_os.path.relpath(p) for p in threaded_tier_paths()]
+        rep.subject = "faults:" + ",".join(shown)
         if args.as_json:
             print(_json.dumps(
                 {"reports": [_report_to_json(rep.subject, rep)],

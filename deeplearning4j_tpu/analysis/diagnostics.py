@@ -34,6 +34,11 @@ collectives    COL01 collective under divergent control flow
 threads        THR01 guarded state accessed outside its lock
                THR02 lock-order inversion  THR03 blocking call under lock
                THR04 unguarded lazy init of shared state
+faults         FLT01 swallowed exception   FLT02 seamless dispatch boundary
+               FLT03 unbounded blocking call
+               FLT04 fault seam under a held lock
+               FLT05 unbounded retry/poll loop
+               FLT06 seam-name integrity (typo'd or dead seam)
 """
 
 from __future__ import annotations
@@ -87,6 +92,16 @@ ALL_CODES = {
     "THR02": "lock-order inversion in the acquired-while-held graph",
     "THR03": "blocking call while holding a lock",
     "THR04": "unguarded lazy initialization of shared state",
+    "FLT01": "broad except swallows the error class (no raise/classify/"
+             "count)",
+    "FLT02": "dispatch boundary with no reachable chaos fault_point seam",
+    "FLT03": "blocking call with no timeout (defeats the deadline "
+             "contract)",
+    "FLT04": "fault_point reachable while a lock is held (wedge becomes "
+             "deadlock)",
+    "FLT05": "retry/poll loop with no bound, budget, or backoff",
+    "FLT06": "fault_point literal not a registered seam, or a seam no "
+             "code invokes",
 }
 
 

@@ -281,7 +281,7 @@ class ParallelInference:
             else:
                 it = self.model.conf.inputType
             return tuple(shape_for_input_type(it, 1)[1:])
-        except Exception:
+        except Exception:  # fault-ok[FLT01]: None IS the classification — "no static shape known" routes the caller to the dynamic-shape path; any config family may legitimately lack input types
             return None
 
     def _run(self, inputs, B):

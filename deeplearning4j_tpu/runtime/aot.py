@@ -230,7 +230,7 @@ def samediff_fingerprint(sd):
 
         try:
             upd = serde.to_json(tc.updater)
-        except Exception:
+        except Exception:  # fault-ok[FLT01]: the repr fallback IS the handling — any stable string works as a cache-key component, a serde failure only changes the key, never correctness
             upd = repr(vars(tc.updater)) if hasattr(tc.updater, "__dict__") \
                 else repr(tc.updater)
         parts.append(f"tc:{upd}:{tc.l1}:{tc.l2}:{tc.weightDecay}:"
@@ -337,7 +337,7 @@ class ExecutableCache:
         self._mem = {}
         self.stats = {"mem_hits": 0, "disk_hits": 0, "misses": 0,
                       "puts": 0, "stale": 0, "corrupt": 0,
-                      "oversize": 0}
+                      "oversize": 0, "store_errors": 0}
         #: key -> seconds of the compile (miss) or load (disk hit);
         #: the CLI --precompile report reads this
         self.seconds = {}
@@ -466,7 +466,12 @@ class ExecutableCache:
                 self._remove(tmp)
                 raise
         except Exception:
-            pass
+            # disk store is best-effort (the memory tier already holds
+            # the executable), but a silently failing store looks like
+            # a working cache that never warms across processes — count
+            # it so operators can tell "cold by design" from "broken"
+            with self._lock:
+                self.stats["store_errors"] += 1
 
     def clear_memory(self):
         """Drop the in-process tier (tests simulate a second process by
@@ -570,7 +575,7 @@ class _AotCall:
                         try:
                             if not leaf.is_deleted():
                                 leaf.delete()
-                        except Exception:
+                        except Exception:  # fault-ok[FLT01]: deletion is a memory hint, never a correctness step (class docstring) — there is nothing to classify when the runtime declines it
                             pass
         return out
 
@@ -682,7 +687,7 @@ class CachedJit:
                 return None
             try:
                 self._fingerprint = network_fingerprint(self._owner)
-            except Exception:
+            except Exception:  # fault-ok[FLT01]: _fp_failed IS the classification — dispatch consults it and routes every call to the plain-jit fallback instead of the cache
                 self._fp_failed = True
                 return None
         return self._fingerprint
@@ -728,8 +733,12 @@ class CachedJit:
                 in_flight = ent
             # another thread is compiling THIS signature: wait outside
             # the lock, then re-read (its entry, or ownership if it
-            # failed / the table was invalidated mid-compile)
-            in_flight.wait()
+            # failed / the table was invalidated mid-compile). Bounded:
+            # the owner's finally guarantees marker.set(), but a 1s
+            # cap means a thread killed mid-compile (or a marker that
+            # leaked through invalidate) degrades to a slow re-read
+            # loop instead of a permanent wedge
+            in_flight.wait(1.0)
         try:
             # the compile runs outside the lock — warm dispatches of
             # OTHER signatures are never stalled behind it

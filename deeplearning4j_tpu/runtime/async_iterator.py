@@ -113,7 +113,7 @@ class AsyncDataSetIterator:
         self._start_epoch()
 
     # ----- producer ---------------------------------------------------
-    def _producer(self, ring):
+    def _producer(self, ring):  # fault-ok[FLT02]: data-layer faults are FaultInjector's domain (runtime/resilience.py wraps the BASE iterator) — the chaos seams cover the serving tier, not the training feed
         try:
             while self._base.hasNext():
                 payload = _pack_dataset(self._base.next())
@@ -289,7 +289,7 @@ class AsyncMultiDataSetIterator(AsyncDataSetIterator):
     AsyncMultiDataSetIterator). Packs the flattened feature/label/mask
     lists instead of the 4-slot DataSet layout."""
 
-    def _producer(self, ring):  # same loop, different pack
+    def _producer(self, ring):  # fault-ok[FLT02]: same loop, different pack — data-layer faults are FaultInjector's domain (runtime/resilience.py), not a chaos seam
         try:
             while self._base.hasNext():
                 payload = self._pack_mds(self._base.next())

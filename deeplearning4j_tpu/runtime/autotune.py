@@ -221,7 +221,7 @@ def tuning_key(net, extra=""):
 
     try:
         fp = aot.network_fingerprint(net)
-    except Exception:
+    except Exception:  # fault-ok[FLT01]: the SameDiff-fingerprint fallback IS the handling — the two graph families share one entry point and the except is the dispatch between them
         fp = aot.samediff_fingerprint(net)  # SameDiff graphs
     base = repr(sorted(_ambient_base().items()))
     return hashlib.sha256("|".join(
@@ -242,7 +242,7 @@ class TuningStore:
             os.makedirs(self.directory, mode=0o700, exist_ok=True)
         self._mem = {}
         self.stats = {"hits": 0, "misses": 0, "puts": 0, "stale": 0,
-                      "corrupt": 0}
+                      "corrupt": 0, "store_errors": 0}
 
     def _path(self, key):
         return os.path.join(self.directory, key + ".tune.json")
@@ -260,7 +260,7 @@ class TuningStore:
             self.stats["misses"] += 1
             return None
         try:
-            with open(path, "r", encoding="utf-8") as fh:
+            with open(path, "r", encoding="utf-8") as fh:  # fault-ok[FLT02]: the tuning store is read once per sweep at startup, off the serving dispatch path — its failure contract (corrupt -> counted miss) is total without an injection seam
                 rec = json.load(fh)
         except Exception:
             self.stats["corrupt"] += 1
@@ -291,7 +291,10 @@ class TuningStore:
                 self._remove(tmp)
                 raise
         except Exception:
-            pass  # memory tier still works; next process re-sweeps
+            # memory tier still works and the next process re-sweeps,
+            # but count the failed store so a read-only tune dir shows
+            # up in stats instead of silently re-tuning every process
+            self.stats["store_errors"] += 1
 
     @staticmethod
     def _remove(path):

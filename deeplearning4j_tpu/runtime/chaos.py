@@ -25,7 +25,9 @@ seam                      dispatch boundary
                           (serving/sequence.py)
 ``fleet.dispatch``        FleetRouter per-replica dispatch attempt
                           (serving/fleet.py, inside the failover try)
-``server.request``        the HTTP POST handler (serving/server.py)
+``server.request``        the HTTP GET/POST handlers (serving/
+                          server.py; ordinals interleave in request
+                          order)
 ``aot.disk_read``         ExecutableCache disk-tier load (runtime/
                           aot.py; payload is the artifact path — a
                           corrupt rule makes the open fail, which the
@@ -67,14 +69,38 @@ import random
 import threading
 
 __all__ = ["ChaosError", "ChaosPlan", "SEAMS", "arm", "armed_plan",
-           "disarm", "fault_point"]
+           "disarm", "fault_point", "register_seam", "registered_seams"]
 
-#: the registered seam names (callers may add more — the plan does not
-#: validate, this is the documented inventory)
+#: the built-in seam inventory; new boundaries add theirs via
+#: ``register_seam`` — arming a plan that schedules a name in neither
+#: is rejected (a typo'd seam would otherwise silently never fire)
 SEAMS = ("host.submit", "host.submit_sequence", "queue.dispatch",
          "sequence.step", "fleet.dispatch", "server.request",
          "aot.disk_read", "aot.disk_write", "checkpoint.write",
          "checkpoint.restore")
+
+#: seams registered at runtime beyond the built-in inventory
+_EXTRA_SEAMS = set()
+
+
+def register_seam(name):
+    """Register a seam name beyond the built-in ``SEAMS`` inventory so
+    plans scheduling it pass arm-time validation. Idempotent; returns
+    the name (handy at module scope: ``SEAM = register_seam("x.y")``)."""
+    name = str(name)
+    if not name:
+        raise ValueError("seam name must be non-empty")
+    with _ARM_LOCK:
+        if name not in SEAMS:
+            _EXTRA_SEAMS.add(name)
+    return name
+
+
+def registered_seams():
+    """Every seam a plan may schedule: the built-in inventory plus
+    everything ``register_seam``-ed, as a tuple."""
+    with _ARM_LOCK:
+        return SEAMS + tuple(sorted(_EXTRA_SEAMS))
 
 _KINDS = ("raise", "wedge", "slow", "corrupt")
 
@@ -104,9 +130,22 @@ def fault_point(seam, payload=None):
 
 
 def arm(plan):
-    """Install `plan` process-wide (replacing any armed plan)."""
+    """Install `plan` process-wide (replacing any armed plan).
+
+    Rejects a plan that schedules rules against a seam that is neither
+    in ``SEAMS`` nor ``register_seam``-ed: a typo'd seam name would
+    otherwise arm fine and silently never fire — the chaos run reports
+    green without having injected anything."""
     global _PLAN
     with _ARM_LOCK:
+        unknown = sorted(set(getattr(plan, "_rules", ()) or ())
+                         - set(SEAMS) - _EXTRA_SEAMS)
+        if unknown:
+            raise ValueError(
+                "plan schedules unknown seam(s) "
+                + ", ".join(repr(s) for s in unknown)
+                + " — not in chaos.SEAMS and never register_seam()-ed; "
+                "a typo'd seam would silently never fire")
         _PLAN = plan
     return plan
 
@@ -132,7 +171,7 @@ def default_corrupt(payload):
     callers in ways no real corruption does)."""
     try:
         import numpy as np
-    except Exception:  # pragma: no cover - numpy is a hard dep in-repo
+    except ImportError:  # pragma: no cover - numpy is a hard dep in-repo
         np = None
     if np is not None and isinstance(payload, np.ndarray) \
             and payload.size:

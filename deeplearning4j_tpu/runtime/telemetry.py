@@ -564,7 +564,7 @@ class MetricsRegistry:
         """Write chrome_trace() to `path` (atomic tmp+rename); returns
         the path."""
         tmp = f"{path}.tmp"
-        with open(tmp, "w") as fh:
+        with open(tmp, "w") as fh:  # fault-ok[FLT02]: observability export, off every dispatch path — an export failure raises to the operator who asked for the file; nothing in the serving tier depends on it
             json.dump(self.chrome_trace(), fh)
         os.replace(tmp, path)
         return path
@@ -572,7 +572,7 @@ class MetricsRegistry:
     def export_jsonl(self, path):
         """One JSON object per span, oldest first; returns the path."""
         tmp = f"{path}.tmp"
-        with open(tmp, "w") as fh:
+        with open(tmp, "w") as fh:  # fault-ok[FLT02]: observability export, off every dispatch path — same contract as export_chrome_trace above
             for s in self.trace.spans():
                 fh.write(json.dumps(s) + "\n")
         os.replace(tmp, path)

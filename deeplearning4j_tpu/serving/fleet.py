@@ -450,14 +450,22 @@ class FleetRouter:
         return 0.05 if p95 is None else p95
 
     def _submit_hedged(self, name, features, deadline_s, conf):
-        import time as _time
-
         t0 = self._now()
+        # completion wakeup: every leg notifies this condition the
+        # moment it finishes (add_done_callback), so the race loop
+        # below sleeps on a bounded CV wait instead of busy-spinning
+        done = threading.Condition()
+
+        def _wake(_req):  # idempotent — add_done_callback may re-call
+            with done:
+                done.notify_all()
+
         req1, rid1 = self._failover(
             name, lambda host: host.submit(name, features,
                                            deadline_s=deadline_s,
                                            wait=False),
             deadline_s=deadline_s, want_rid=True)
+        req1.add_done_callback(_wake)
         legs = [(rid1, req1)]
         hedge_after = self._hedge_after(name, conf)
         if not req1.wait_done(hedge_after):
@@ -472,10 +480,22 @@ class FleetRouter:
                 try:
                     req2 = host2.submit(name, features, deadline_s=rem,
                                         wait=False)
-                except Exception:
-                    req2 = None  # hedge enqueue failed: primary races on
+                except Exception as e:
+                    # hedge enqueue refused: the primary races on
+                    # alone, but the refusal is COUNTED under its
+                    # error class and — unless it is backpressure —
+                    # charged to the refusing replica, same as any
+                    # dispatch fault (a silently swallowed refusal
+                    # here hid dead hedge replicas from the breaker)
+                    req2 = None
+                    if not isinstance(e, (QueueFullError,
+                                          ServingClosedError)):
+                        self._note_outcome(rid2, False)
+                    self._m_failover.labels(
+                        model=name, error=type(e).__name__).inc()
                 if req2 is not None:
                     self._m_hedges.labels(model=name).inc()
+                    req2.add_done_callback(_wake)
                     legs.append((rid2, req2))
         # first COMPLETED-with-result leg wins; a leg that completes
         # with an error is charged to its replica and dropped so the
@@ -483,7 +503,7 @@ class FleetRouter:
         last_err = None
         while legs:
             for rid, req in list(legs):
-                if not req.wait_done(0.002 / len(legs)):
+                if not req.done:
                     continue
                 if req.error is not None:
                     self._note_outcome(rid, False)
@@ -507,7 +527,13 @@ class FleetRouter:
                     req.cancel()
                 raise DeadlineExceededError(
                     f"hedged request exceeded {deadline_s:.3f}s")
-            _time.sleep(0.0)  # yield between polls
+            with done:
+                # bounded wait: a completing leg's callback wakes this
+                # immediately (no lost wakeup — the re-check holds the
+                # condition lock the callback must take to notify);
+                # the 50 ms bound only paces the deadline backstop
+                if not any(r.done for _, r in legs):
+                    done.wait(0.05)
         raise last_err
 
     # -- health probes / quarantine --------------------------------------
@@ -550,7 +576,7 @@ class FleetRouter:
                 try:
                     host.submit(name, feats, deadline_s=deadline_s)
                     ok = True
-                except Exception:
+                except Exception:  # fault-ok[FLT01]: the outcome IS the classification — it feeds dl4j_fleet_probes_total{outcome=fail} and the readmission streak just below; a failing canary is the signal probe_tick measures
                     ok = False
                 readmitted = h.note_probe(ok)
                 self._m_probes.labels(

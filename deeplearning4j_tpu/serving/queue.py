@@ -99,7 +99,7 @@ class InferenceRequest:
     and the completion event the submitting thread blocks on."""
 
     __slots__ = ("features", "rows", "enqueued_at", "deadline",
-                 "result", "error", "_event")
+                 "result", "error", "_event", "_cbs")
 
     def __init__(self, features, enqueued_at, deadline=None):
         self.features = features
@@ -109,18 +109,38 @@ class InferenceRequest:
         self.result = None
         self.error = None
         self._event = threading.Event()
+        self._cbs = []
 
     @property
     def done(self):
         return self._event.is_set()
 
+    def add_done_callback(self, cb):
+        """Run ``cb(self)`` once the request completes (result, error
+        or cancellation); if it already has, run it now on the caller.
+        Callbacks run on the completing thread — keep them tiny and
+        non-blocking (the hedged-dispatch wakeup just notifies a
+        condition). Ordering is append-then-recheck so a completion
+        racing the registration can never be missed, at the cost that
+        a callback may run twice in that race — callbacks MUST be
+        idempotent."""
+        self._cbs.append(cb)
+        if self._event.is_set():
+            cb(self)
+
+    def _notify(self):
+        for cb in list(self._cbs):
+            cb(self)
+
     def finish(self, result):
         self.result = result
         self._event.set()
+        self._notify()
 
     def fail(self, exc):
         self.error = exc
         self._event.set()
+        self._notify()
 
     def wait_done(self, timeout=None):
         """Block up to `timeout` for completion WITHOUT raising or

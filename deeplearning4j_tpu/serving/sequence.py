@@ -416,7 +416,7 @@ class SequenceScheduler:
         step lock — concurrent drivers (background loop vs a draining
         close) take turns instead of double-stepping a sequence."""
         with self._step_lock:
-            return self._iterate_locked()
+            return self._iterate_locked()  # fault-ok[FLT04]: the step lock is the scheduler's own serialization contract — sequence.step firing under it IS the wedged-scheduler fault the harness injects, and waiters are released by deadline expiry (the wait contract), never by this lock
 
     def _iterate_locked(self):
         # *_locked: called with the STEP lock held (one driver at a

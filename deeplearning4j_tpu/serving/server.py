@@ -70,6 +70,12 @@ class _InferenceHandler(JsonHandler):
         return "other"
 
     def handle_GET(self):
+        # same chaos seam as the POST boundary (ordinals interleave in
+        # request order): an injected raise surfaces as this handler's
+        # 500, the read path's client-visible failure mode — before
+        # this seam landed, GET routes were the one HTTP boundary a
+        # ChaosPlan could never exercise
+        fault_point("server.request")
         host = self._owner().host
         path = self.path.split("?", 1)[0].rstrip("/")
         if path == "/metrics":

@@ -180,13 +180,13 @@ class JsonHandler(http.server.BaseHTTPRequestHandler):
         self._resp_lock = threading.Lock()
         done = threading.Event()
 
-        def run():
+        def run():  # fault-ok[FLT02]: deadline-mode dispatch WRAPPER — impl() is the concrete handler, which owns the request seam (serving/server.py fires server.request before routing)
             try:
                 impl()
             except HttpError as e:
                 try:
                     self._json({"error": e.message}, e.code)
-                except Exception:
+                except Exception:  # fault-ok[FLT01]: the client hung up mid-error-reply — the connection is gone, there is no one left to classify for
                     pass
             except Exception as e:
                 try:
@@ -194,7 +194,7 @@ class JsonHandler(http.server.BaseHTTPRequestHandler):
                     # response lock drops this if the deadline already
                     # answered 503
                     self._json({"error": f"{type(e).__name__}: {e}"}, 500)
-                except Exception:
+                except Exception:  # fault-ok[FLT01]: connection gone (or 503 already sent under the response lock); nothing left to report to
                     pass  # connection is gone; nothing left to report to
             finally:
                 done.set()
@@ -280,7 +280,7 @@ class HttpServerOwner:
             # server it warmed is still the live one
             httpd = self._httpd
         if warmup is not None:
-            def _warm():
+            def _warm():  # fault-ok[FLT02]: warmup runs a USER callable whose own boundaries carry the seams; its failure is already classified into _warmup_error and surfaced on /healthz
                 try:
                     warmup()
                 except Exception as e:
