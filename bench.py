@@ -1779,6 +1779,112 @@ def bench_serving_chaos(timeout_s=300):
     return rec
 
 
+_SERVING_PAGED_CHILD = r"""
+import json, os, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from deeplearning4j_tpu.nn.transformer import (CausalTransformerLM,
+    dense_serial_trajectory)
+from deeplearning4j_tpu.runtime import aot
+from deeplearning4j_tpu.serving import (PagedSequenceScheduler,
+    greedy_sampler, stream_rng)
+
+aot._SESSION = aot.ExecutableCache(None)   # cold, memory-only
+aot._SESSION_INIT = True
+rec = {}
+rng = np.random.default_rng(0)
+
+S = 8                                    # slot bucket
+m = CausalTransformerLM(vocab=257, d_model=64, n_heads=4, n_layers=2,
+                        max_context=160, page_size=16, seed=0)
+lens = (18, 34, 50, 66, 90, 96)          # ragged: 6/8 slots = 75%
+n_new = 48
+prompts = [rng.integers(0, m.vocab, size=n).tolist() for n in lens]
+n_tok = len(lens) * n_new
+
+# ---- paged leg: concurrent ragged generate over the page pool ----
+sched = PagedSequenceScheduler(m, num_pages=96, slot_buckets=(S,),
+                               start_thread=False, name="bench-paged")
+sched.warm()                             # decode buckets + prefill hot
+reqs = [sched.submit(p, max_new_tokens=n_new, wait=False)
+        for p in prompts]
+peak = 0
+t0 = time.perf_counter()
+while sched.poll():
+    peak = max(peak, sched.cache.bytes_in_use())
+paged_s = time.perf_counter() - t0
+assert all(r.done and r.error is None for r in reqs)
+dense_bytes = m.dense_cache_bytes(S)
+rec["residency"] = {
+    "paged_peak_bytes": int(peak),
+    "dense_reserved_bytes": int(dense_bytes),
+    "ratio": round(peak / dense_bytes, 4),
+    "gate": 0.6, "pass": bool(peak <= 0.6 * dense_bytes),
+    "live_slots": len(lens), "bucket": S,
+    "prompt_lens": list(lens), "new_tokens": n_new,
+    "occupancy": sched.occupancy_summary()}
+rec["paged"] = {
+    "tokens": n_tok, "wall_s": round(paged_s, 3),
+    "decode_tokens_per_s": round(n_tok / paged_s, 1),
+    "prefill_chunks": int(sched.prefill_chunks),
+    "staging_reuse_bytes": int(sched.staging_reuse_bytes)}
+sched.close()
+
+# ---- dense twin: same prompts through the dense-slab serial path
+# (one live row in a bucket-S slab — the residency model the paged
+# pool replaces, and the serial decode-throughput baseline) ----
+dense_serial_trajectory(m, prompts[0][:4], 2, greedy_sampler(),
+                        stream_rng(0, 0), bucket=S)   # warm compiles
+t0 = time.perf_counter()
+for i, p in enumerate(prompts):
+    dense_serial_trajectory(m, p, n_new, greedy_sampler(),
+                            stream_rng(0, i), bucket=S)
+dense_s = time.perf_counter() - t0
+rec["dense_serial"] = {
+    "tokens": n_tok, "wall_s": round(dense_s, 3),
+    "decode_tokens_per_s": round(n_tok / dense_s, 1)}
+rec["throughput_paged_vs_dense_serial"] = round(dense_s / paged_s, 3)
+print("PAGEDREC " + json.dumps(rec), flush=True)
+"""
+
+
+def bench_serving_paged(timeout_s=300):
+    """Paged KV-cache serving A/B (ISSUE 19, docs/SERVING.md "Paged KV
+    cache"): HBM residency of the block-table page pool vs the dense
+    twin's S x max_context reservation at >= 75% ragged occupancy
+    (gate: paged peak <= 0.6x dense), plus aggregate decode
+    tokens/sec — the continuously-batched paged scheduler against the
+    serial dense-slab trajectory on the same prompts. CPU-pinned
+    subprocess BY DESIGN (grad_sharing's pattern — never touches the
+    chip, banks on a dead tunnel): residency is computed from the pool
+    accounting and the lever measured is scheduler-side."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    try:
+        r = subprocess.run([sys.executable, "-c", _SERVING_PAGED_CHILD],
+                           capture_output=True, text=True, cwd=here,
+                           env=env, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {"error": f"serving_paged exceeded {timeout_s}s"}
+    line = next((ln for ln in (r.stdout or "").splitlines()
+                 if ln.startswith("PAGEDREC ")), None)
+    if line is None:
+        return {"error": (r.stderr or r.stdout or
+                          f"exit {r.returncode}").strip()[-300:]}
+    rec = json.loads(line[len("PAGEDREC "):])
+    rec["note"] = (
+        "CPU rehearsal of the paged KV tier: ragged transformer "
+        "prompts at 75% slot occupancy hold only live-token pages "
+        "(gate <= 0.6x the dense S x max_context reservation) while "
+        "the interleaved prefill+decode scheduler sustains the serial "
+        "dense path's throughput (docs/SERVING.md)")
+    return rec
+
+
 def bench_serving():
     """Continuous-batching model server (ROADMAP item 3, docs/SERVING.md):
     open-loop Poisson load through the request queue + dynamic
@@ -2349,6 +2455,12 @@ def _emit_tunnel_dead(reason):
     except Exception as e:
         _CONFIGS["serving_chaos"] = {
             "error": f"{type(e).__name__}: {e}"[:300]}
+    try:  # CPU-pinned like grad_sharing: banks on a dead tunnel too
+        _CONFIGS["serving_paged"] = bench_serving_paged(
+            min(_budget(300), 300))
+    except Exception as e:
+        _CONFIGS["serving_paged"] = {
+            "error": f"{type(e).__name__}: {e}"[:300]}
     _error_line(f"tunnel_dead: {reason}")
 
 
@@ -2428,6 +2540,19 @@ def main():
         except Exception as e:
             configs["serving_chaos"] = {
                 "error": f"{type(e).__name__}: {e}"[:300]}
+    # paged KV-cache residency + decode-throughput A/B: CPU-pinned
+    # subprocess like grad_sharing (tunnel_dead-safe by construction)
+    budget = _budget(330)
+    if budget < 45:
+        configs["serving_paged"] = {
+            "error": "skipped: bench deadline reached"}
+    else:
+        try:
+            configs["serving_paged"] = bench_serving_paged(
+                min(budget, 300))
+        except Exception as e:
+            configs["serving_paged"] = {
+                "error": f"{type(e).__name__}: {e}"[:300]}
     img_per_sec = headline["images_per_sec"]
     line = {
         "metric": "resnet50_train_images_per_sec_per_chip",
@@ -2478,6 +2603,16 @@ def main():
         # CPU-pinned leg errored (tunnel_dead-safe)
         "chaos_overhead_x": configs.get("serving_chaos", {}).get(
             "overhead", {}).get("ratio"),
+        # paged KV cache (round 19, ISSUE 19): peak page-pool bytes
+        # over the dense S x max_context reservation at 75% ragged
+        # occupancy (gate <= 0.6x) and the paged scheduler's aggregate
+        # decode tokens/sec — top level so BENCH_r19+ is attributable;
+        # None when the CPU-pinned leg errored (tunnel_dead-safe)
+        "kv_paged_residency_x": configs.get("serving_paged", {}).get(
+            "residency", {}).get("ratio"),
+        "kv_paged_decode_tokens_per_s": configs.get(
+            "serving_paged", {}).get("paged", {}).get(
+            "decode_tokens_per_s"),
         # autotune arbiter (round 12, ISSUE 12): tuned-vs-stock
         # attributed bytes/step for the LeNet b64 attribution subject
         # (the ratcheted-ceiling gate's measurement) and the measured

@@ -51,7 +51,11 @@ Codes (stable; suppressions and tests key on them):
 - FLT06  seam-name integrity: a ``fault_point("name")`` literal that
          is not a registered seam (a typo'd seam silently never
          fires), or — over the full default tier — a registered seam
-         no linted code invokes (dead inventory).
+         no linted code invokes (dead inventory). The universe is
+         ``chaos.registered_seams()`` plus every
+         ``register_seam("name")`` literal found statically in the
+         linted sources (runtime registration must not depend on
+         import order).
 
 Suppression mirrors pass 8, with its own tag::
 
@@ -589,6 +593,25 @@ def _known_seams(seams=None):
     return frozenset(chaos.registered_seams())
 
 
+def _declared_seams(tree):
+    """Seam literals registered via ``register_seam("name")`` in this
+    tree — discovered statically, so the FLT06 universe never depends
+    on which modules the current process happened to import before
+    linting."""
+    out = set()
+    for n in ast.walk(tree):
+        if not isinstance(n, ast.Call):
+            continue
+        f = n.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else None)
+        if name == "register_seam":
+            lit = _seam_literal(n)
+            if lit is not None:
+                out.add(lit)
+    return out
+
+
 def _lint_source(source, path, seams):
     report = Report(subject=f"faults:{path}")
     try:
@@ -598,6 +621,7 @@ def _lint_source(source, path, seams):
                    f"file does not parse: {e.msg}")
         return report, set()
 
+    seams = frozenset(seams) | _declared_seams(tree)
     findings = []
     idx = _lint_tree(tree, findings)
 
@@ -651,14 +675,22 @@ def lint_fault_paths(paths=None, seams=None):
     universe = _known_seams(seams)
     report = Report(subject="faults")
     used = set()
+    sources = []
     for path in iter_py_files(paths if paths is not None
                               else threaded_tier_paths()):
         try:
             with open(path, "r", encoding="utf-8") as fh:
-                src = fh.read()
+                sources.append((path, fh.read()))
         except OSError as e:
             report.add("LNT00", ERROR, path, f"unreadable: {e}")
-            continue
+    # first pass: a seam register_seam()-ed in one linted file is a
+    # valid target for fault_point literals in every other
+    for path, src in sources:
+        try:
+            universe |= _declared_seams(ast.parse(src, filename=path))
+        except SyntaxError:
+            pass                     # LNT00 from _lint_source below
+    for path, src in sources:
         rep, file_used = _lint_source(src, path, universe)
         used |= file_used
         report.extend(rep)

@@ -1,0 +1,340 @@
+"""Functional causal-transformer step twin for the paged serving tier.
+
+PR 15's sequence scheduler serves the RNN h/c carry twin
+(``MultiLayerNetwork.rnnStepBatched``); the transformer-class path
+carries KV instead of a fixed-width hidden state, so its serving twin
+is a pair of PURE step functions over an external paged KV cache
+(serving/kvcache.py):
+
+* ``prefill`` — append ONE page-sized prompt chunk's K/V into the
+  slot's freshly allocated page and attend the chunk's queries over
+  the block table so far (causal in-chunk). Bounded work per call:
+  a long prompt is consumed one chunk per scheduler iteration and can
+  never stall the running decode batch.
+* ``decode`` — one token per live slot: append each slot's K/V row at
+  its block table's (page, offset), then one block-table attention
+  step over every slot (one executable per slot bucket, exactly the
+  rnnStepBatched discipline — warm every bucket, zero steady-state
+  compiles).
+
+Attention goes through ``ops.pallas_attention.paged_attend`` — the
+portable page-sequential online-softmax twin of the pallas block-table
+kernels, with page_size as the block size, so the serving path on CPU
+and the pallas kernels on TPU accumulate in the SAME block order as
+the dense flash kernel (the bitwise-parity contract
+tests/test_paged_attention.py gates).
+
+A DENSE-cache twin (``decode_dense``/``prefill_dense``: contiguous
+``[L, S, max_context, H, Dh]`` slabs, the pre-paged shape) rides along
+as the bench A/B baseline and the serial-trajectory oracle: it views
+its slab as pages and runs the SAME attention core, so paged-vs-dense
+generation is bitwise comparable (``dense_serial_trajectory``).
+
+This is a serving twin, not a trainer: parameters are seeded at
+construction (pure ``numpy.random.default_rng``), there is no fit
+path, and every step function is cached through runtime/aot with an
+explicit config fingerprint.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["CausalTransformerLM", "dense_serial_trajectory"]
+
+
+def _rmsnorm(x, g):
+    xf = x.astype(jnp.float32)
+    inv = jnp.reciprocal(
+        jnp.sqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + 1e-6))
+    return (xf * inv).astype(x.dtype) * g
+
+
+class CausalTransformerLM:
+    """Decoder-only causal LM with paged-KV serving step functions
+    (module docstring).
+
+    vocab/d_model/n_heads/n_layers/d_ff: the usual dims (d_ff defaults
+    to 4*d_model). max_context bounds positions; page_size is the KV
+    page AND the prefill chunk size (max_context % page_size == 0).
+    dtype is the compute/storage dtype (params, KV pools, residual
+    stream); logits always come back fp32 for host-side sampling.
+    """
+
+    #: duck-type marker the serving host dispatches on
+    kind = "paged_lm"
+
+    def __init__(self, *, vocab, d_model=32, n_heads=2, n_layers=2,
+                 d_ff=None, max_context=64, page_size=8,
+                 dtype="float32", seed=0):
+        if int(d_model) % int(n_heads):
+            raise ValueError(
+                f"d_model {d_model} must divide by n_heads {n_heads}")
+        if int(max_context) % int(page_size):
+            raise ValueError(
+                f"max_context {max_context} must be a multiple of "
+                f"page_size {page_size}")
+        self.vocab = int(vocab)
+        self.d_model = int(d_model)
+        self.n_heads = int(n_heads)
+        self.n_layers = int(n_layers)
+        self.d_ff = int(d_ff) if d_ff else 4 * self.d_model
+        self.max_context = int(max_context)
+        self.page_size = int(page_size)
+        self.max_pages_per_slot = self.max_context // self.page_size
+        self.head_dim = self.d_model // self.n_heads
+        self.seed = int(seed)
+        self._compute_dtype = jnp.dtype(dtype)
+        self._params = self._init_params()
+        from deeplearning4j_tpu.runtime import aot
+
+        fp = self.fingerprint()
+        # donation renames the pool/slab buffers in place on TPU; the
+        # CPU backend ignores donation with a warning per dispatch, so
+        # only ask for it where it exists
+        on_tpu = jax.default_backend() in ("tpu", "axon")
+        dec_don = (2, 3) if on_tpu else ()
+        pre_don = (4, 5) if on_tpu else ()
+        self._jit_decode = aot.cached_jit(
+            self._decode_paged, entry="paged_decode", fingerprint=fp,
+            donate_argnums=dec_don)
+        self._jit_prefill = aot.cached_jit(
+            self._prefill_paged, entry="paged_prefill", fingerprint=fp,
+            donate_argnums=pre_don)
+        self._jit_decode_dense = aot.cached_jit(
+            self._decode_dense, entry="dense_decode", fingerprint=fp,
+            donate_argnums=dec_don)
+        self._jit_prefill_dense = aot.cached_jit(
+            self._prefill_dense, entry="dense_prefill", fingerprint=fp,
+            donate_argnums=pre_don)
+
+    def fingerprint(self):
+        """Config hash for the AOT cache key (explicit: this twin has
+        no conf JSON for network_fingerprint to derive from)."""
+        return ("causal-lm:"
+                f"v{self.vocab}:d{self.d_model}:h{self.n_heads}:"
+                f"L{self.n_layers}:ff{self.d_ff}:T{self.max_context}:"
+                f"p{self.page_size}:{self._compute_dtype.name}:"
+                f"s{self.seed}")
+
+    def _init_params(self):
+        rng = np.random.default_rng(self.seed)
+        dt = self._compute_dtype
+
+        def w(*shape):
+            return jnp.asarray(
+                (rng.standard_normal(shape) * 0.02).astype(np.float32),
+                dt)
+
+        layers = []
+        for _ in range(self.n_layers):
+            layers.append({
+                "ln1": jnp.ones((self.d_model,), dt),
+                "wq": w(self.d_model, self.d_model),
+                "wk": w(self.d_model, self.d_model),
+                "wv": w(self.d_model, self.d_model),
+                "wo": w(self.d_model, self.d_model),
+                "ln2": jnp.ones((self.d_model,), dt),
+                "w1": w(self.d_model, self.d_ff),
+                "w2": w(self.d_ff, self.d_model),
+            })
+        return {"emb": w(self.vocab, self.d_model),
+                "pos": w(self.max_context, self.d_model),
+                "lnf": jnp.ones((self.d_model,), dt),
+                "layers": layers}
+
+    # -- shared block pieces (traced inside the step functions) ----------
+    def _qkv(self, lp, x):
+        S = x.shape[0]
+        q = (x @ lp["wq"]).reshape(S, self.n_heads, self.head_dim)
+        k = (x @ lp["wk"]).reshape(S, self.n_heads, self.head_dim)
+        v = (x @ lp["wv"]).reshape(S, self.n_heads, self.head_dim)
+        return q, k, v
+
+    def _mlp(self, lp, h):
+        x = _rmsnorm(h, lp["ln2"])
+        return h + jax.nn.gelu(x @ lp["w1"]) @ lp["w2"]
+
+    def _logits(self, params, h):
+        hn = _rmsnorm(h, params["lnf"])
+        return jnp.dot(hn, params["emb"].T,
+                       preferred_element_type=jnp.float32)
+
+    # -- paged step functions (pure; jitted via runtime/aot) -------------
+    def _decode_paged(self, params, tokens, kps, vps, bts, sls):
+        """One decode token per slot. tokens [S] i32 (last sampled),
+        kps/vps [L, P, page, H, Dh] pools, bts [S, MP] block tables,
+        sls [S] live KV length per slot (the new token's position).
+        Returns (logits [S, V] fp32, kps', vps'). Padded slots (sl=0,
+        block table all null-page) write their garbage row into the
+        null page — identical values for every padded row, never
+        attended by a live slot — and their logits rows are ignored
+        by the scheduler's scatter."""
+        S = tokens.shape[0]
+        h = params["emb"][tokens] + params["pos"][sls]
+        pages = bts[jnp.arange(S), sls // self.page_size]
+        offs = sls % self.page_size
+        from deeplearning4j_tpu.ops.pallas_attention import paged_attend
+
+        for li, lp in enumerate(params["layers"]):
+            x = _rmsnorm(h, lp["ln1"])
+            q, k, v = self._qkv(lp, x)
+            kps = kps.at[li, pages, offs].set(k)
+            vps = vps.at[li, pages, offs].set(v)
+            att = paged_attend(q[:, None], kps[li][bts], vps[li][bts],
+                               sls + 1, sls)[:, 0]
+            h = h + att.reshape(S, self.d_model) @ lp["wo"]
+            h = self._mlp(lp, h)
+        return self._logits(params, h), kps, vps
+
+    def _prefill_paged(self, params, tokens, t0, n_valid, kps, vps, bt):
+        """One page-sized prompt chunk for ONE slot. tokens [C=page]
+        i32 (zero-padded past n_valid), t0 = chunk offset (multiple of
+        page_size), bt [MP] the slot's block table with the chunk's
+        fresh page already installed at t0//page. Writes the chunk's
+        K/V into that page (padded rows too — decode overwrites them
+        before they are ever unmasked) and attends the chunk causally
+        over the table. Returns (last-valid-row logits [V] fp32,
+        kps', vps')."""
+        C = tokens.shape[0]
+        zero = jnp.zeros((), t0.dtype)      # x64 mode: indices must
+        pos = jax.lax.dynamic_slice(params["pos"], (t0, zero),
+                                    (C, self.d_model))
+        h = params["emb"][tokens] + pos
+        page_id = bt[t0 // self.page_size]
+        L = jnp.reshape(t0 + n_valid, (1,))
+        t0v = jnp.reshape(t0, (1,))
+        from deeplearning4j_tpu.ops.pallas_attention import paged_attend
+
+        for li, lp in enumerate(params["layers"]):
+            x = _rmsnorm(h, lp["ln1"])
+            q, k, v = self._qkv(lp, x)
+            kps = kps.at[li, page_id].set(k)
+            vps = vps.at[li, page_id].set(v)
+            att = paged_attend(q[None], kps[li][bt][None],
+                               vps[li][bt][None], L, t0v)[0]
+            h = h + att.reshape(C, self.d_model) @ lp["wo"]
+            h = self._mlp(lp, h)
+        h_last = jax.lax.dynamic_index_in_dim(h, n_valid - 1, 0,
+                                              keepdims=True)
+        return self._logits(params, h_last)[0], kps, vps
+
+    # -- dense-cache twins (bench baseline + serial oracle) --------------
+    def _decode_dense(self, params, tokens, kcs, vcs, sls):
+        """Dense-slab decode: kcs/vcs [L, S, max_context, H, Dh].
+        Views the slab as pages and runs the SAME attention core, so
+        a dense trajectory is bitwise comparable to the paged one."""
+        S = tokens.shape[0]
+        h = params["emb"][tokens] + params["pos"][sls]
+        rows = jnp.arange(S)
+        from deeplearning4j_tpu.ops.pallas_attention import paged_attend
+
+        for li, lp in enumerate(params["layers"]):
+            x = _rmsnorm(h, lp["ln1"])
+            q, k, v = self._qkv(lp, x)
+            kcs = kcs.at[li, rows, sls].set(k)
+            vcs = vcs.at[li, rows, sls].set(v)
+            kpg = kcs[li].reshape(S, self.max_pages_per_slot,
+                                  self.page_size, self.n_heads,
+                                  self.head_dim)
+            vpg = vcs[li].reshape(S, self.max_pages_per_slot,
+                                  self.page_size, self.n_heads,
+                                  self.head_dim)
+            att = paged_attend(q[:, None], kpg, vpg, sls + 1, sls)[:, 0]
+            h = h + att.reshape(S, self.d_model) @ lp["wo"]
+            h = self._mlp(lp, h)
+        return self._logits(params, h), kcs, vcs
+
+    def _prefill_dense(self, params, tokens, t0, n_valid, kcs, vcs,
+                       slot):
+        """Dense-slab chunked prefill for ONE slot (same chunking as
+        the paged path — the oracle must take the same block steps)."""
+        C = tokens.shape[0]
+        zero = jnp.zeros((), t0.dtype)      # x64 mode: indices must
+        pos = jax.lax.dynamic_slice(params["pos"], (t0, zero),
+                                    (C, self.d_model))
+        h = params["emb"][tokens] + pos
+        L = jnp.reshape(t0 + n_valid, (1,))
+        t0v = jnp.reshape(t0, (1,))
+        from deeplearning4j_tpu.ops.pallas_attention import paged_attend
+
+        for li, lp in enumerate(params["layers"]):
+            x = _rmsnorm(h, lp["ln1"])
+            q, k, v = self._qkv(lp, x)
+            liv = jnp.asarray(li, t0.dtype)
+            kcs = jax.lax.dynamic_update_slice(
+                kcs, k[None, None], (liv, slot, t0, zero, zero))
+            vcs = jax.lax.dynamic_update_slice(
+                vcs, v[None, None], (liv, slot, t0, zero, zero))
+            kr = jax.lax.dynamic_index_in_dim(kcs[li], slot, 0,
+                                              keepdims=False)
+            vr = jax.lax.dynamic_index_in_dim(vcs[li], slot, 0,
+                                              keepdims=False)
+            kpg = kr.reshape(self.max_pages_per_slot, self.page_size,
+                             self.n_heads, self.head_dim)
+            vpg = vr.reshape(self.max_pages_per_slot, self.page_size,
+                             self.n_heads, self.head_dim)
+            att = paged_attend(q[None], kpg[None], vpg[None], L, t0v)[0]
+            h = h + att.reshape(C, self.d_model) @ lp["wo"]
+            h = self._mlp(lp, h)
+        h_last = jax.lax.dynamic_index_in_dim(h, n_valid - 1, 0,
+                                              keepdims=True)
+        return self._logits(params, h_last)[0], kcs, vcs
+
+    # -- cache builders ---------------------------------------------------
+    def dense_cache(self, S):
+        """Zeroed dense KV slabs for S slots — the residency baseline:
+        S x max_context rows live on HBM regardless of load."""
+        shape = (self.n_layers, int(S), self.max_context, self.n_heads,
+                 self.head_dim)
+        return (jnp.zeros(shape, self._compute_dtype),
+                jnp.zeros(shape, self._compute_dtype))
+
+    def dense_cache_bytes(self, S):
+        """HBM the dense twin reserves for S slots (K and V)."""
+        return (2 * self.n_layers * int(S) * self.max_context
+                * self.n_heads * self.head_dim
+                * self._compute_dtype.itemsize)
+
+
+def dense_serial_trajectory(model, prompt, n_new, sampler, rng,
+                            bucket=1):
+    """The serial oracle: ONE sequence generated through the DENSE
+    twin at a fixed slot bucket (live row 0, padding rows dead) —
+    page-size prefill chunks, then one decode step per generated
+    token, sampling with the caller's rng stream. Returns (tokens
+    [n_new] int list, logits [n_new, V] fp32) — what the paged
+    scheduler must reproduce bitwise for the same (seed, stream)
+    within the same bucket."""
+    prompt = np.asarray(prompt, np.int32).reshape(-1)
+    S = int(bucket)
+    kcs, vcs = model.dense_cache(S)
+    page = model.page_size
+    t0 = 0
+    last = None
+    while t0 < prompt.shape[0]:
+        n_valid = min(page, prompt.shape[0] - t0)
+        chunk = np.zeros((page,), np.int32)
+        chunk[:n_valid] = prompt[t0:t0 + n_valid]
+        last, kcs, vcs = model._jit_prefill_dense(
+            model._params, chunk, jnp.asarray(t0, jnp.int32),
+            jnp.asarray(n_valid, jnp.int32), kcs, vcs,
+            jnp.asarray(0, jnp.int32))
+        t0 += n_valid
+    tokens, logits = [], []
+    logits.append(np.asarray(last))
+    tokens.append(int(sampler(logits[-1], rng)))
+    seq_len = int(prompt.shape[0])
+    for _ in range(int(n_new) - 1):
+        tok = np.zeros((S,), np.int32)
+        tok[0] = tokens[-1]
+        sls = np.zeros((S,), np.int32)
+        sls[0] = seq_len
+        out, kcs, vcs = model._jit_decode_dense(
+            model._params, tok, kcs, vcs, sls)
+        seq_len += 1
+        logits.append(np.asarray(out)[0])
+        tokens.append(int(sampler(logits[-1], rng)))
+    return tokens, np.stack(logits, axis=0)

@@ -445,6 +445,300 @@ def _choose_impl(T, *, on_tpu, force_streaming=False, has_mask=False,
     return "flash"
 
 
+# ----------------------------------------------------------------------
+# paged KV attention: block-table decode + chunked prefill (serving)
+# ----------------------------------------------------------------------
+# The serving tier (serving/kvcache.py) stores KV in fixed-size pages
+# inside a device-resident pool [P, page, H, Dh]; a per-slot block
+# table maps logical KV block j -> physical page bt[s, j] (the
+# vLLM/PagedAttention shape). The kernels below index K/V through that
+# table instead of a contiguous [T, Dh] buffer; page_size doubles as
+# the kernel's block_k, so the online-softmax accumulation order is
+# IDENTICAL to the dense flash kernel's block order and the outputs
+# are bitwise equal to _fwd_kernel on the same tokens
+# (tests/test_paged_attention.py gates it across aligned/padded/bf16
+# grids). Trailing pages past a slot's seq_len are fully masked and
+# are bitwise no-ops on the (acc, m, l) carry — the grid can always
+# run the full static block-table width.
+
+
+def _paged_decode_kernel(bt_ref, sl_ref, q_ref, k_ref, v_ref, *refs,
+                         page: int, scale: float):
+    """One (slot, head, page) program of the block-table decode grid.
+
+    Scalar-prefetch refs: bt_ref [S, MP] block table, sl_ref [S] live
+    KV length per slot. q_ref [1, 1, Dh] is the slot's single query
+    row; k_ref/v_ref [1, page, 1, Dh] are the page the index map
+    gathered through the block table. The online-softmax carry (acc,
+    m, l) lives in VMEM scratch across the page axis (innermost grid
+    dim); p == 0 initialises it, the last page normalises and writes
+    the output row. A padded slot (sl == 0) masks every key, so l
+    stays 0 and the l == 0 guard emits exact zeros — with the
+    _LSE_EMPTY (+1e30) sentinel on the lse output, exactly like the
+    dense kernel's fully-padded rows."""
+    from jax.experimental import pallas as pl
+
+    if len(refs) == 5:
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        o_ref, acc_ref, m_ref, l_ref = refs
+        lse_ref = None
+    s_i = pl.program_id(0)
+    p = pl.program_id(2)
+    n_pages = pl.num_programs(2)
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    length = sl_ref[s_i]
+    q = q_ref[0].astype(jnp.float32) * scale            # [1, Dh]
+    kj = k_ref[0, :, 0, :].astype(jnp.float32)          # [page, Dh]
+    vj = v_ref[0, :, 0, :].astype(jnp.float32)
+    s = jax.lax.dot_general(q, kj, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    k_pos = p * page + jax.lax.broadcasted_iota(jnp.int32, (1, page), 1)
+    # the decode query sits at position length-1, so the causal mask
+    # q_pos >= k_pos coincides with the length mask k_pos < length —
+    # causal by construction, one comparison
+    valid = k_pos < length
+    s = jnp.where(valid, s, _NEG_INF)
+    m, l, acc = m_ref[...], l_ref[...], acc_ref[...]
+    m_new = jnp.maximum(m, jnp.max(s, axis=1))
+    corr = jnp.exp(m - m_new)
+    pr = jnp.exp(s - m_new[:, None])
+    pr = jnp.where(valid, pr, 0.0)
+    m_ref[...] = m_new
+    l_ref[...] = l * corr + jnp.sum(pr, axis=1)
+    acc_ref[...] = acc * corr[:, None] + jax.lax.dot_general(
+        pr, vj, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(p == n_pages - 1)
+    def _finalize():
+        l_f = l_ref[...]
+        o_ref[0] = (acc_ref[...]
+                    / jnp.where(l_f == 0, 1.0, l_f)[:, None]
+                    ).astype(o_ref.dtype)
+        if lse_ref is not None:
+            lse_ref[0] = jnp.where(l_f > 0, m_ref[...] + jnp.log(l_f),
+                                   _LSE_EMPTY)
+
+
+def paged_flash_decode(q, k_pool, v_pool, block_tables, seq_lens,
+                       need_lse=False, interpret=None):
+    """Block-table flash decode: one query row per slot, K/V gathered
+    through the slot's block table.
+
+    q [S, H, Dh]; k_pool/v_pool [P, page, H, Dh]; block_tables
+    [S, MP] int32 (physical page per logical block — padded slots
+    point at the pool's null page); seq_lens [S] int32 (live KV
+    tokens per slot; 0 = padded slot -> zero output row + _LSE_EMPTY
+    sentinel). Returns [S, H, Dh] (and lse [S, H] fp32 when
+    need_lse). Bitwise-equal to the dense flash kernel on the same
+    tokens when page == the dense kernel's block_k."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    S, H, Dh = q.shape
+    page = k_pool.shape[1]
+    MP = block_tables.shape[1]
+    interp = _INTERPRET if interpret is None else interpret
+    kernel = functools.partial(_paged_decode_kernel, page=page,
+                               scale=1.0 / (Dh ** 0.5))
+    out_shape = [jax.ShapeDtypeStruct((S, H, Dh), q.dtype)]
+    out_specs = [pl.BlockSpec((1, 1, Dh),
+                              lambda s, h, p, bt, sl: (s, h, 0))]
+    if need_lse:
+        out_shape.append(jax.ShapeDtypeStruct((S, H), jnp.float32))
+        out_specs.append(pl.BlockSpec((1, 1),
+                                      lambda s, h, p, bt, sl: (s, h)))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, H, MP),
+        in_specs=[
+            pl.BlockSpec((1, 1, Dh), lambda s, h, p, bt, sl: (s, h, 0)),
+            pl.BlockSpec((1, page, 1, Dh),
+                         lambda s, h, p, bt, sl: (bt[s, p], 0, h, 0)),
+            pl.BlockSpec((1, page, 1, Dh),
+                         lambda s, h, p, bt, sl: (bt[s, p], 0, h, 0)),
+        ],
+        out_specs=out_specs,
+        scratch_shapes=[pltpu.VMEM((1, Dh), jnp.float32),
+                        pltpu.VMEM((1,), jnp.float32),
+                        pltpu.VMEM((1,), jnp.float32)],
+    )
+    res = pl.pallas_call(kernel, grid_spec=grid_spec,
+                         out_shape=out_shape, interpret=interp)(
+        jnp.asarray(block_tables, jnp.int32),
+        jnp.asarray(seq_lens, jnp.int32), q, k_pool, v_pool)
+    return (res[0], res[1]) if need_lse else res[0]
+
+
+def _paged_prefill_kernel(bt_ref, prm_ref, q_ref, k_ref, v_ref, o_ref,
+                          acc_ref, m_ref, l_ref, *, page: int,
+                          chunk: int, scale: float):
+    """One (head, page) program of the chunked-prefill grid: the
+    chunk's C query rows (positions t0..t0+C-1) against every page of
+    ONE slot's block table — its own freshly written page included, so
+    in-chunk attention is causal by the q_pos >= k_pos mask. prm_ref
+    carries (t0, L) where L = t0 + valid chunk rows; padded chunk rows
+    (q_pos >= L) emit garbage the caller slices off, and their zeroed
+    KV rows are masked from every valid query by k_pos < L."""
+    from jax.experimental import pallas as pl
+
+    p = pl.program_id(1)
+    n_pages = pl.num_programs(1)
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    t0 = prm_ref[0]
+    L = prm_ref[1]
+    q = q_ref[0].astype(jnp.float32) * scale            # [C, Dh]
+    kj = k_ref[0, :, 0, :].astype(jnp.float32)          # [page, Dh]
+    vj = v_ref[0, :, 0, :].astype(jnp.float32)
+    s = jax.lax.dot_general(q, kj, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    q_pos = t0 + jax.lax.broadcasted_iota(jnp.int32, (chunk, page), 0)
+    k_pos = p * page + jax.lax.broadcasted_iota(jnp.int32,
+                                                (chunk, page), 1)
+    valid = (k_pos < L) & (q_pos >= k_pos)
+    s = jnp.where(valid, s, _NEG_INF)
+    m, l, acc = m_ref[...], l_ref[...], acc_ref[...]
+    m_new = jnp.maximum(m, jnp.max(s, axis=1))
+    corr = jnp.exp(m - m_new)
+    pr = jnp.exp(s - m_new[:, None])
+    pr = jnp.where(valid, pr, 0.0)
+    m_ref[...] = m_new
+    l_ref[...] = l * corr + jnp.sum(pr, axis=1)
+    acc_ref[...] = acc * corr[:, None] + jax.lax.dot_general(
+        pr, vj, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(p == n_pages - 1)
+    def _finalize():
+        l_f = l_ref[...]
+        o_ref[0] = (acc_ref[...]
+                    / jnp.where(l_f == 0, 1.0, l_f)[:, None]
+                    ).astype(o_ref.dtype)
+
+
+def paged_flash_prefill(q_chunk, k_pool, v_pool, block_table, t0,
+                        n_valid, interpret=None):
+    """Chunked-prefill attention for ONE slot: the prompt chunk's
+    queries (C rows at offset t0, C == page_size) against the slot's
+    whole block table — the chunk's own KV page must already be
+    written into the pool (kvcache append, then this kernel; causal
+    in-chunk by construction).
+
+    q_chunk [C, H, Dh]; k_pool/v_pool [P, page, H, Dh]; block_table
+    [MP] int32; t0 = chunk offset (multiple of page_size); n_valid =
+    live rows in this chunk (< C only for the prompt's tail chunk).
+    Returns [C, H, Dh]; rows past n_valid are padding garbage the
+    caller slices off."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    C, H, Dh = q_chunk.shape
+    page = k_pool.shape[1]
+    MP = block_table.shape[0]
+    interp = _INTERPRET if interpret is None else interpret
+    kernel = functools.partial(_paged_prefill_kernel, page=page,
+                               chunk=C, scale=1.0 / (Dh ** 0.5))
+    t0 = jnp.asarray(t0, jnp.int32)
+    prm = jnp.stack([t0, t0 + jnp.asarray(n_valid, jnp.int32)])
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(H, MP),
+        in_specs=[
+            pl.BlockSpec((1, C, Dh), lambda h, p, bt, prm_: (h, 0, 0)),
+            pl.BlockSpec((1, page, 1, Dh),
+                         lambda h, p, bt, prm_: (bt[p], 0, h, 0)),
+            pl.BlockSpec((1, page, 1, Dh),
+                         lambda h, p, bt, prm_: (bt[p], 0, h, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, C, Dh),
+                               lambda h, p, bt, prm_: (h, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((C, Dh), jnp.float32),
+                        pltpu.VMEM((C,), jnp.float32),
+                        pltpu.VMEM((C,), jnp.float32)],
+    )
+    out = pl.pallas_call(kernel, grid_spec=grid_spec,
+                         out_shape=jax.ShapeDtypeStruct((H, C, Dh),
+                                                        q_chunk.dtype),
+                         interpret=interp)(
+        jnp.asarray(block_table, jnp.int32), prm,
+        jnp.moveaxis(q_chunk, 1, 0), k_pool, v_pool)
+    return jnp.moveaxis(out, 0, 1)
+
+
+def _paged_attend_core(q, k_pages, v_pages, length, q0):
+    """Portable twin of the paged kernels for ONE (slot, head): q
+    [R, Dh] raw queries at positions q0..q0+R-1, k_pages/v_pages
+    [MP, page, Dh] gathered pages, length = live KV tokens. Page-
+    sequential online softmax — the SAME accumulation order and ops
+    as the kernels (and, page == block_k, as the dense flash kernel),
+    so the serving hot path on CPU and the pallas path on TPU agree
+    bitwise per page-block reduction."""
+    R, Dh = q.shape
+    MP, page, _ = k_pages.shape
+    qs = q.astype(jnp.float32) * (1.0 / (Dh ** 0.5))
+    q_pos = q0 + jax.lax.broadcasted_iota(jnp.int32, (R, page), 0)
+
+    def body(j, carry):
+        acc, m, l = carry
+        kj = jax.lax.dynamic_index_in_dim(
+            k_pages, j, 0, keepdims=False).astype(jnp.float32)
+        vj = jax.lax.dynamic_index_in_dim(
+            v_pages, j, 0, keepdims=False).astype(jnp.float32)
+        s = jax.lax.dot_general(qs, kj, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        k_pos = j * page + jax.lax.broadcasted_iota(jnp.int32,
+                                                    (R, page), 1)
+        valid = (k_pos < length) & (q_pos >= k_pos)
+        s = jnp.where(valid, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        corr = jnp.exp(m - m_new)
+        pr = jnp.exp(s - m_new[:, None])
+        pr = jnp.where(valid, pr, 0.0)
+        l_new = l * corr + jnp.sum(pr, axis=1)
+        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+            pr, vj, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    acc, m, l = jax.lax.fori_loop(
+        0, MP, body,
+        (jnp.zeros((R, Dh), jnp.float32),
+         jnp.full((R,), _NEG_INF, jnp.float32),
+         jnp.zeros((R,), jnp.float32)))
+    return (acc / jnp.where(l == 0, 1.0, l)[:, None]).astype(q.dtype)
+
+
+def paged_attend(q, k_pages, v_pages, lengths, q_starts):
+    """Batched portable paged attention (the serving hot path's form,
+    jit-safe): q [S, R, H, Dh] (R = 1 for decode, R = chunk for
+    prefill), k_pages/v_pages [S, MP, page, H, Dh] (pool pages already
+    gathered through each slot's block table — on CPU one jnp take;
+    the pallas kernels do this gather per-page in VMEM instead),
+    lengths [S] live KV tokens, q_starts [S] position of q row 0.
+    Returns [S, R, H, Dh]; a length-0 slot yields exact zero rows."""
+    qt = jnp.moveaxis(q, 2, 1)                # [S, H, R, Dh]
+    kt = jnp.moveaxis(k_pages, 3, 1)          # [S, H, MP, page, Dh]
+    vt = jnp.moveaxis(v_pages, 3, 1)
+    per_head = jax.vmap(_paged_attend_core,
+                        in_axes=(0, 0, 0, None, None))
+    per_slot = jax.vmap(per_head, in_axes=(0, 0, 0, 0, 0))
+    out = per_slot(qt, kt, vt, lengths, q_starts)
+    return jnp.moveaxis(out, 1, 2)
+
+
 def flash_attention(q, k, v, causal=False, key_mask=None,
                     block_q=512, block_k=512, force_streaming=False):
     """Attention [B,H,T,D] with automatic kernel dispatch.

@@ -14,7 +14,16 @@ compilation model of arXiv:1810.09868):
   models: a slot table of active sequences with carried hidden/cell
   state, the batch re-formed every decode step (early-exit slots
   refilled from the queue mid-sequence), one executable per slot
-  bucket, per-step deadlines.
+  bucket, per-step deadlines. The KV-slot twin
+  (``PagedSequenceScheduler``) serves token-prompt transformer models
+  over the paged KV cache, interleaving bounded prefill chunks with
+  the decode batch.
+* ``kvcache``  — the paged KV cache itself: fixed-size KV blocks in a
+  device-resident pool, per-slot block tables, allocation/free at
+  step boundaries, copy-on-write prefix sharing; pool exhaustion is
+  the typed ``KVCacheFullError`` (429).
+* ``sampling`` — host-side decode samplers (greedy, temperature/top-k)
+  with deterministic per-(seed, stream) RNG streams.
 * ``host``     — multi-model host: model name -> (network, dtype policy,
   optional weight-only int8, batch buckets), each precompiled at
   registration, with a rolling model swap that warms the new version's
@@ -45,8 +54,16 @@ from deeplearning4j_tpu.serving.queue import (  # noqa: F401
     DeadlineExceededError, InferenceRequest, ManualClock, MicroBatcher,
     QueueFullError, RequestCancelledError, ServingClosedError,
 )
+from deeplearning4j_tpu.serving.kvcache import (  # noqa: F401
+    KVCacheFullError, PagedKVCache,
+)
+from deeplearning4j_tpu.serving.sampling import (  # noqa: F401
+    greedy_sampler, sampled_onehot_feedback, stream_rng,
+    temperature_sampler,
+)
 from deeplearning4j_tpu.serving.sequence import (  # noqa: F401
-    SequenceRequest, SequenceScheduler, greedy_onehot_feedback,
+    GenerationRequest, PagedSequenceScheduler, SequenceRequest,
+    SequenceScheduler, greedy_onehot_feedback,
 )
 from deeplearning4j_tpu.serving.host import (  # noqa: F401
     ModelHost, ServedModel, ServedSequenceModel,
@@ -61,6 +78,10 @@ __all__ = [
     "MicroBatcher", "QueueFullError", "RequestCancelledError",
     "ServingClosedError",
     "SequenceRequest", "SequenceScheduler", "greedy_onehot_feedback",
+    "GenerationRequest", "PagedSequenceScheduler",
+    "KVCacheFullError", "PagedKVCache",
+    "greedy_sampler", "temperature_sampler", "stream_rng",
+    "sampled_onehot_feedback",
     "ModelHost", "ServedModel", "ServedSequenceModel",
     "FleetRouter", "ModelSLO", "InferenceServer",
     "BrownoutController", "CircuitBreaker", "ReplicaHealth",

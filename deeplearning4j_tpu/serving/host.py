@@ -94,21 +94,38 @@ class ServedModel:
 
 class ServedSequenceModel:
     """One (name, version) SEQUENCE entry: network + its iteration-
-    level slot scheduler (serving/sequence.py). Build through
-    ModelHost.register_sequence/swap_sequence."""
+    level slot scheduler (serving/sequence.py). A network with
+    ``kind == "paged_lm"`` (nn/transformer.py) is served behind the
+    KV-slot ``PagedSequenceScheduler`` instead of the h/c carry
+    scheduler — token prompts in, sampled tokens out, KV on a bounded
+    paged pool. Build through ModelHost.register_sequence/
+    swap_sequence."""
 
     def __init__(self, name, version, network, slotBuckets=None,
-                 queueLimit=64, feedback=None, clock=None):
-        from deeplearning4j_tpu.serving.sequence import SequenceScheduler
+                 queueLimit=64, feedback=None, clock=None,
+                 numPages=64, sampler=None, samplerSeed=0,
+                 prefixSharing=True):
+        from deeplearning4j_tpu.serving.sequence import (
+            PagedSequenceScheduler, SequenceScheduler,
+        )
 
         self.name = str(name)
         self.version = int(version)
         self.network = network
-        self.scheduler = SequenceScheduler(
-            network, slot_buckets=slotBuckets, queue_limit=queueLimit,
-            feedback=feedback, clock=clock,
-            start_thread=clock is None,
-            name=f"{self.name}:v{self.version}")
+        self.paged = getattr(network, "kind", None) == "paged_lm"
+        if self.paged:
+            self.scheduler = PagedSequenceScheduler(
+                network, num_pages=numPages, slot_buckets=slotBuckets,
+                queue_limit=queueLimit, sampler=sampler,
+                sampler_seed=samplerSeed, prefix_sharing=prefixSharing,
+                clock=clock, start_thread=clock is None,
+                name=f"{self.name}:v{self.version}")
+        else:
+            self.scheduler = SequenceScheduler(
+                network, slot_buckets=slotBuckets,
+                queue_limit=queueLimit, feedback=feedback, clock=clock,
+                start_thread=clock is None,
+                name=f"{self.name}:v{self.version}")
 
     def warm(self, cache=None):
         """Precompile the decode step for every slot bucket."""
@@ -118,6 +135,10 @@ class ServedSequenceModel:
                wait=True, timeout=None):
         from deeplearning4j_tpu.runtime.chaos import fault_point
 
+        if self.paged:
+            raise ValueError(
+                f"model {self.name!r} is a paged token model — use "
+                "generate()/submit_tokens() with a token prompt")
         sched = self.scheduler
         features = fault_point("host.submit_sequence", features)
         deadline = None if deadline_s is None else \
@@ -127,18 +148,50 @@ class ServedSequenceModel:
                             timeout=deadline_s if timeout is None
                             else timeout)
 
+    def submit_tokens(self, tokens, deadline_s=None, max_new_tokens=1,
+                      wait=True, timeout=None):
+        """Queue one token prompt on the paged scheduler (the
+        :generate token path). Same deadline/wait contract as
+        submit()."""
+        from deeplearning4j_tpu.runtime.chaos import fault_point
+
+        if not self.paged:
+            raise ValueError(
+                f"model {self.name!r} serves per-step features, not "
+                "token prompts — use submit()")
+        sched = self.scheduler
+        tokens = fault_point("host.submit_sequence", tokens)
+        deadline = None if deadline_s is None else \
+            sched.clock() + float(deadline_s)
+        return sched.submit(tokens, deadline=deadline,
+                            max_new_tokens=max_new_tokens, wait=wait,
+                            timeout=deadline_s if timeout is None
+                            else timeout)
+
     def policy(self):
         import jax.numpy as jnp
 
-        return {
+        pol = {
             "model": self.name,
             "version": self.version,
             "kind": "sequence",
             "dtype": jnp.dtype(self.network._compute_dtype).name,
             "slotBuckets": list(self.scheduler.slot_buckets),
             "queueLimit": self.scheduler.queue_limit,
-            "featureSize": self.scheduler.feature_size,
         }
+        if self.paged:
+            cache = self.scheduler.cache
+            pol.update({
+                "paged": True,
+                "vocab": self.scheduler.vocab,
+                "maxContext": self.network.max_context,
+                "pageSize": cache.page_size,
+                "numPages": cache.num_pages,
+                "prefixSharing": self.scheduler.prefix_sharing,
+            })
+        else:
+            pol["featureSize"] = self.scheduler.feature_size
+        return pol
 
     def close(self, drain=True):
         self.scheduler.close(drain=drain)
@@ -222,10 +275,15 @@ class ModelHost:
 
     # -- sequence (iteration-level) models -------------------------------
     def register_sequence(self, name, network, *, slotBuckets=None,
-                          queueLimit=64, feedback=None, precompile=True):
+                          queueLimit=64, feedback=None, precompile=True,
+                          numPages=64, sampler=None, samplerSeed=0,
+                          prefixSharing=True):
         """Serve a recurrent `network` as the SEQUENCE model `name`
         (version 1) behind an iteration-level slot scheduler
-        (serving/sequence.py). precompile=True warms the decode-step
+        (serving/sequence.py) — or, for a ``kind == "paged_lm"``
+        network, the KV-slot paged scheduler (numPages/sampler/
+        samplerSeed/prefixSharing apply there; feedback applies only to
+        the carry path). precompile=True warms the decode-step
         executable for every slot bucket before the model is
         routable."""
         with self._lock:
@@ -240,7 +298,10 @@ class ModelHost:
                                      slotBuckets=slotBuckets,
                                      queueLimit=queueLimit,
                                      feedback=feedback,
-                                     clock=self._clock)
+                                     clock=self._clock,
+                                     numPages=numPages, sampler=sampler,
+                                     samplerSeed=samplerSeed,
+                                     prefixSharing=prefixSharing)
             try:
                 report = sm.warm() if precompile else None
             except Exception:
@@ -271,8 +332,14 @@ class ModelHost:
                     f"{sorted(self._sequences)})")
         pol = old.policy()
         kw = {"slotBuckets": tuple(pol["slotBuckets"]) or None,
-              "queueLimit": pol["queueLimit"],
-              "feedback": old.scheduler.feedback}
+              "queueLimit": pol["queueLimit"]}
+        if old.paged:
+            kw.update({"numPages": pol["numPages"],
+                       "sampler": old.scheduler.sampler,
+                       "samplerSeed": old.scheduler.sampler_seed,
+                       "prefixSharing": pol["prefixSharing"]})
+        else:
+            kw["feedback"] = old.scheduler.feedback
         kw.update(overrides)
         new = ServedSequenceModel(name, old.version + 1, network,
                                   clock=self._clock, **kw)
@@ -316,6 +383,25 @@ class ModelHost:
             return self.sequence_model(name).submit(
                 feats, deadline_s=deadline_s, extra_steps=extra_steps,
                 wait=wait, timeout=timeout)
+
+    def generate(self, name, tokens, deadline_s=None, max_new_tokens=1,
+                 wait=True, timeout=None):
+        """Route one token prompt to `name`'s PAGED sequence scheduler
+        (:generate with a "tokens" body). Same swap re-route contract
+        as submit_sequence."""
+        from deeplearning4j_tpu.serving.queue import ServingClosedError
+
+        toks = np.asarray(tokens)
+        try:
+            return self.sequence_model(name).submit_tokens(
+                toks, deadline_s=deadline_s,
+                max_new_tokens=max_new_tokens, wait=wait,
+                timeout=timeout)
+        except ServingClosedError:
+            return self.sequence_model(name).submit_tokens(
+                toks, deadline_s=deadline_s,
+                max_new_tokens=max_new_tokens, wait=wait,
+                timeout=timeout)
 
     def queued_work(self, name):
         """Outstanding work this host holds for `name` — one-shot

@@ -68,12 +68,17 @@ import numpy as np
 from deeplearning4j_tpu.runtime import telemetry
 from deeplearning4j_tpu.runtime.chaos import \
     fault_point as _chaos_fault_point
+from deeplearning4j_tpu.runtime.chaos import register_seam
+from deeplearning4j_tpu.serving.kvcache import (
+    KVCacheFullError, PagedKVCache,
+)
 from deeplearning4j_tpu.serving.queue import (
     DeadlineExceededError, QueueFullError, ServingClosedError,
     occupancy_summary_from,
 )
 
-__all__ = ["SequenceRequest", "SequenceScheduler", "greedy_onehot_feedback"]
+__all__ = ["SequenceRequest", "SequenceScheduler", "GenerationRequest",
+           "PagedSequenceScheduler", "greedy_onehot_feedback"]
 
 #: unique default metric label for anonymous schedulers
 _SCHED_SEQ = itertools.count(1)
@@ -84,6 +89,81 @@ DEFAULT_SLOT_BUCKETS = (1, 2, 4, 8)
 #: the stats keys the dict view carries
 _STAT_KEYS = ("sequences", "completed", "dispatches", "slot_steps",
               "expired", "rejected", "errors", "refills")
+
+#: chunked-prefill chaos seam (PagedSequenceScheduler): fires before
+#: each prompt chunk dispatch, so a ChaosPlan can fail/wedge/corrupt a
+#: prefill exactly where production would (runtime/chaos.py)
+PREFILL_SEAM = register_seam("sequence.prefill")
+
+#: the registry families both scheduler classes record into (and
+#: release per-instance series from at close())
+_SEQ_METRIC_FAMILIES = (
+    "dl4j_seq_sequences_total", "dl4j_seq_completed_total",
+    "dl4j_seq_dispatches_total", "dl4j_seq_slot_steps_total",
+    "dl4j_seq_expired_total", "dl4j_seq_rejected_total",
+    "dl4j_seq_errors_total", "dl4j_seq_refills_total",
+    "dl4j_seq_queue_depth", "dl4j_seq_active_slots",
+    "dl4j_seq_queue_wait_seconds", "dl4j_seq_slot_occupancy",
+)
+
+
+def _seq_metrics(reg, name):
+    """The dl4j_seq_* instrument set, labelled for one scheduler
+    instance — shared by the carry-slot and KV-slot schedulers so both
+    report through the same families (docs/OBSERVABILITY.md)."""
+    lab = {"model": name}
+    return {
+        "sequences": reg.counter(
+            "dl4j_seq_sequences_total",
+            "sequences accepted into the sequence queue",
+            labels=("model",)).labels(**lab),
+        "completed": reg.counter(
+            "dl4j_seq_completed_total",
+            "sequences completed (all steps served)",
+            labels=("model",)).labels(**lab),
+        "dispatches": reg.counter(
+            "dl4j_seq_dispatches_total",
+            "slot-batched decode-step dispatches",
+            labels=("model",)).labels(**lab),
+        "slot_steps": reg.counter(
+            "dl4j_seq_slot_steps_total",
+            "live slot-steps served (occupancy x dispatches)",
+            labels=("model",)).labels(**lab),
+        "expired": reg.counter(
+            "dl4j_seq_expired_total",
+            "sequences failed by a per-step deadline expiry (504)",
+            labels=("model",)).labels(**lab),
+        "rejected": reg.counter(
+            "dl4j_seq_rejected_total",
+            "sequences rejected on a full queue (429)",
+            labels=("model",)).labels(**lab),
+        "errors": reg.counter(
+            "dl4j_seq_errors_total",
+            "sequences failed by a dispatch error",
+            labels=("model",)).labels(**lab),
+        "refills": reg.counter(
+            "dl4j_seq_refills_total",
+            "mid-sequence slot refills (admissions while other "
+            "slots were mid-flight)",
+            labels=("model",)).labels(**lab),
+        "depth": reg.gauge(
+            "dl4j_seq_queue_depth",
+            "sequences waiting for a slot",
+            labels=("model",)).labels(**lab),
+        "active": reg.gauge(
+            "dl4j_seq_active_slots",
+            "slots occupied by live sequences",
+            labels=("model",)).labels(**lab),
+        "wait": reg.histogram(
+            "dl4j_seq_queue_wait_seconds",
+            "enqueue-to-first-step wait per sequence",
+            labels=("model",)).labels(**lab),
+        "occupancy": reg.histogram(
+            "dl4j_seq_slot_occupancy",
+            "live-slots/bucket fill fraction per decode step",
+            labels=("model",),
+            buckets=(0.25, 0.5, 0.75, 1.0)).labels(**lab),
+    }
 
 
 def greedy_onehot_feedback(vocab):
@@ -221,65 +301,17 @@ class SequenceScheduler:
         self._step_lock = threading.Lock()
         self._pending = deque()
         self._active = []                   # the slot table
+        self._staging = {}                  # S -> reused gather buffers
+        #: host bytes served from the staging pool instead of fresh
+        #: np.zeros (the bench decode leg's alloc-reduction record)
+        self.staging_reuse_bytes = 0
         self._closed = False
         self.name = str(name) if name else f"seq{next(_SCHED_SEQ)}"
         #: (active_slots, bucket) per dispatch — the occupancy record
         self.occupancy = []
         reg = telemetry.get_registry()
-        lab = {"model": self.name}
         self._registry = reg
-        self._m = {
-            "sequences": reg.counter(
-                "dl4j_seq_sequences_total",
-                "sequences accepted into the sequence queue",
-                labels=("model",)).labels(**lab),
-            "completed": reg.counter(
-                "dl4j_seq_completed_total",
-                "sequences completed (all steps served)",
-                labels=("model",)).labels(**lab),
-            "dispatches": reg.counter(
-                "dl4j_seq_dispatches_total",
-                "slot-batched decode-step dispatches",
-                labels=("model",)).labels(**lab),
-            "slot_steps": reg.counter(
-                "dl4j_seq_slot_steps_total",
-                "live slot-steps served (occupancy x dispatches)",
-                labels=("model",)).labels(**lab),
-            "expired": reg.counter(
-                "dl4j_seq_expired_total",
-                "sequences failed by a per-step deadline expiry (504)",
-                labels=("model",)).labels(**lab),
-            "rejected": reg.counter(
-                "dl4j_seq_rejected_total",
-                "sequences rejected on a full queue (429)",
-                labels=("model",)).labels(**lab),
-            "errors": reg.counter(
-                "dl4j_seq_errors_total",
-                "sequences failed by a dispatch error",
-                labels=("model",)).labels(**lab),
-            "refills": reg.counter(
-                "dl4j_seq_refills_total",
-                "mid-sequence slot refills (admissions while other "
-                "slots were mid-flight)",
-                labels=("model",)).labels(**lab),
-            "depth": reg.gauge(
-                "dl4j_seq_queue_depth",
-                "sequences waiting for a slot",
-                labels=("model",)).labels(**lab),
-            "active": reg.gauge(
-                "dl4j_seq_active_slots",
-                "slots occupied by live sequences",
-                labels=("model",)).labels(**lab),
-            "wait": reg.histogram(
-                "dl4j_seq_queue_wait_seconds",
-                "enqueue-to-first-step wait per sequence",
-                labels=("model",)).labels(**lab),
-            "occupancy": reg.histogram(
-                "dl4j_seq_slot_occupancy",
-                "live-slots/bucket fill fraction per decode step",
-                labels=("model",),
-                buckets=(0.25, 0.5, 0.75, 1.0)).labels(**lab),
-        }
+        self._m = _seq_metrics(reg, self.name)
         self._thread = None
         if start_thread:
             self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -390,23 +422,45 @@ class SequenceScheduler:
         return self.slot_buckets[-1]
 
     # -- one iteration (dispatch outside the lock) ----------------------
+    def _staging_for(self, S):
+        """Per-bucket gather/scatter staging buffers, allocated once
+        and reused every iteration (the dispatch copies them to device
+        via jnp.asarray, so host-side reuse can never alias a live
+        step). Before this, _gather paid a fresh np.zeros per column
+        per step — pure allocator churn the bench decode leg now
+        counts as staging_reuse_bytes."""
+        st = self._staging.get(S)
+        if st is None:
+            x = np.zeros((S, self.feature_size), np.float32)
+            carries = [{k: np.zeros((S, self._carry_width(li)),
+                                    self._carry_dtype) for k in keys}
+                       for li, keys in enumerate(self._spec)]
+            st = (x, carries)
+            self._staging[S] = st
+        else:
+            self.staging_reuse_bytes += (
+                st[0].nbytes
+                + sum(c.nbytes for d in st[1] for c in d.values()))
+        return st
+
     def _gather(self, batch, S, rows):
         """Stack the batch's validated next-input rows + carries into
         the fixed [S, ...] bucket signature (zero rows pad the empty
-        slots)."""
-        x = np.zeros((S, self.feature_size), np.float32)
+        slots). Buffers come from the per-bucket staging pool; rows
+        past the live batch are re-zeroed so a previous iteration's
+        occupancy can never leak into the padding."""
+        n = len(rows)
+        x, carries = self._staging_for(S)
         for i, row in enumerate(rows):
             x[i] = row
-        carries = []
+        x[n:] = 0.0
         for li, keys in enumerate(self._spec):
-            d = {}
+            d = carries[li]
             for k in keys:
-                col = np.zeros((S, self._carry_width(li)),
-                               self._carry_dtype)
+                col = d[k]
                 for i, req in enumerate(batch):
                     col[i] = req.carry[li][k]
-                d[k] = col
-            carries.append(d)
+                col[n:] = 0
         return x, carries
 
     def _step_once(self):
@@ -632,18 +686,595 @@ class SequenceScheduler:
         # release this instance's registry series (MicroBatcher.close
         # precedent: per-instance series must not accumulate forever)
         reg = self._registry
-        for metric in ("dl4j_seq_sequences_total",
-                       "dl4j_seq_completed_total",
-                       "dl4j_seq_dispatches_total",
-                       "dl4j_seq_slot_steps_total",
-                       "dl4j_seq_expired_total",
-                       "dl4j_seq_rejected_total",
-                       "dl4j_seq_errors_total",
-                       "dl4j_seq_refills_total",
-                       "dl4j_seq_queue_depth",
-                       "dl4j_seq_active_slots",
-                       "dl4j_seq_queue_wait_seconds",
-                       "dl4j_seq_slot_occupancy"):
+        for metric in _SEQ_METRIC_FAMILIES:
+            fam = reg.get(metric)
+            if fam is not None:
+                fam.remove(model=self.name)
+        return self
+
+
+class GenerationRequest:
+    """One token-prompt generation request on the KV-slot path.
+
+    The prompt is consumed in page-sized prefill chunks; generation
+    then appends one token per decode iteration until ``max_new``
+    tokens have been sampled. ``pages``/``block_row``/``seq_len`` are
+    the slot's KV state (owned page ids, logical-block -> physical-page
+    row, live KV rows). ``wait`` follows the serving tier's one release
+    contract — see ``queue.InferenceRequest.wait``."""
+
+    __slots__ = ("tokens", "max_new", "sampler", "rng", "stream_id",
+                 "enqueued_at", "deadline", "started_at", "prefilled",
+                 "seq_len", "pages", "block_row", "out_tokens",
+                 "logits_rows", "logits", "result", "error", "_event")
+
+    def __init__(self, tokens, enqueued_at, deadline=None, max_new=1,
+                 sampler=None, rng=None, stream_id=0):
+        self.tokens = tokens                # [T] int32 prompt
+        self.max_new = int(max_new)
+        self.sampler = sampler
+        self.rng = rng
+        self.stream_id = int(stream_id)
+        self.enqueued_at = float(enqueued_at)
+        self.deadline = None if deadline is None else float(deadline)
+        self.started_at = None
+        self.prefilled = 0                  # prompt tokens with KV live
+        self.seq_len = 0                    # total KV rows live
+        self.pages = []                     # owned page ids (in order)
+        self.block_row = None               # [MP] int32
+        self.out_tokens = []                # sampled tokens, in order
+        self.logits_rows = []               # fp32 [V] per sampled token
+        self.logits = None                  # stacked at finish
+        self.result = None
+        self.error = None
+        self._event = threading.Event()
+
+    @property
+    def done(self):
+        return self._event.is_set()
+
+    def finish(self, result):
+        self.logits = (np.stack(self.logits_rows, axis=0)
+                       if self.logits_rows else None)
+        self.result = result
+        self._event.set()
+
+    def fail(self, exc):
+        self.error = exc
+        self._event.set()
+
+    def wait(self, timeout=None):
+        """Block for the sampled token ids [max_new] (int64). Release
+        rules are the serving tier's single wait contract —
+        ``queue.InferenceRequest.wait``."""
+        if not self._event.wait(timeout):
+            raise DeadlineExceededError(f"no result within {timeout:.3f}s")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class PagedSequenceScheduler:
+    """Iteration-level KV-slot scheduler over one paged-attention LM
+    (``nn.transformer.CausalTransformerLM`` or any ``kind ==
+    "paged_lm"`` twin).
+
+    The carry-slot scheduler above gathers/scatters h/c rows; here the
+    per-slot state is KV in a bounded ``PagedKVCache`` instead, and
+    every iteration interleaves at most ONE page-sized prefill chunk
+    (bounded work — a long prompt can never stall the running batch)
+    with one slot-batched decode step over every fully-prefilled slot.
+    Admission, buckets, per-step deadlines, ManualClock/poll()/drain(),
+    and the dl4j_seq_* metric families are the same discipline as
+    ``SequenceScheduler``; pool exhaustion surfaces as the typed
+    ``KVCacheFullError`` (429), never a hang. Prefix sharing
+    (``prefix_sharing=True``) adopts a registered prompt's pages
+    copy-on-write at admission.
+
+    Sampling is host-side: ``sampler(logits_row, rng) -> token`` with a
+    per-request ``stream_rng(sampler_seed, stream_id)`` stream, stream
+    ids assigned in submit order — deterministic per (seed, stream), so
+    the bitwise-vs-serial gate holds with temperature sampling too.
+    """
+
+    def __init__(self, model, *, num_pages, slot_buckets=None,
+                 queue_limit=64, admission="step", sampler=None,
+                 sampler_seed=0, prefix_sharing=True, clock=None,
+                 start_thread=True, name=None):
+        from deeplearning4j_tpu.serving.sampling import greedy_sampler
+
+        if getattr(model, "kind", None) != "paged_lm":
+            raise ValueError(
+                "PagedSequenceScheduler needs a paged-LM step twin "
+                f"(kind == 'paged_lm'), got {type(model).__name__}")
+        if admission not in ("step", "gang"):
+            raise ValueError(
+                f"admission must be 'step' (iteration-level) or 'gang' "
+                f"(run-to-completion baseline), got {admission!r}")
+        if int(queue_limit) < 1:
+            raise ValueError(f"queue_limit must be >= 1, got {queue_limit}")
+        self.model = model
+        self.vocab = int(model.vocab)
+        buckets = slot_buckets or DEFAULT_SLOT_BUCKETS
+        self.slot_buckets = tuple(sorted(int(b) for b in buckets))
+        if self.slot_buckets[0] < 1:
+            raise ValueError(f"slot buckets must be >= 1, got {buckets}")
+        self.max_slots = self.slot_buckets[-1]
+        self.queue_limit = int(queue_limit)
+        self.admission = admission
+        self.sampler = sampler if sampler is not None else greedy_sampler()
+        self.sampler_seed = int(sampler_seed)
+        self.prefix_sharing = bool(prefix_sharing)
+        self.clock = clock if clock is not None else time.monotonic
+        self.name = str(name) if name else f"seq{next(_SCHED_SEQ)}"
+        self.cache = PagedKVCache(
+            n_layers=model.n_layers, n_heads=model.n_heads,
+            head_dim=model.head_dim, page_size=model.page_size,
+            num_pages=num_pages, dtype=model._compute_dtype,
+            model=self.name)
+        self._mp = int(model.max_pages_per_slot)
+        self._cond = threading.Condition()
+        self._step_lock = threading.Lock()
+        self._pending = deque()
+        self._active = []                   # the KV-slot table
+        self._staging = {}                  # S -> reused decode buffers
+        #: host bytes served from the staging pool instead of fresh
+        #: np.zeros (the bench decode leg's alloc-reduction record)
+        self.staging_reuse_bytes = 0
+        self._stream_ids = itertools.count(0)
+        self._closed = False
+        #: (live_decode_slots, bucket) per decode dispatch
+        self.occupancy = []
+        #: prompt chunks prefilled (the interleave record)
+        self.prefill_chunks = 0
+        reg = telemetry.get_registry()
+        self._registry = reg
+        self._m = _seq_metrics(reg, self.name)
+        self._thread = None
+        if start_thread:
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    # -- submit ---------------------------------------------------------
+    def submit(self, tokens, deadline=None, max_new_tokens=1,
+               sampler=None, wait=True, timeout=None):
+        """Enqueue one token prompt [T] (T >= 1, ids in [0, vocab)).
+
+        max_new_tokens >= 1 tokens are generated (the first is sampled
+        from the prompt's final logits, so KV grows by T + max_new - 1
+        rows, bounded by the model's max_context). deadline: absolute
+        time on this scheduler's clock, checked per step. wait=True
+        blocks for the sampled token ids; False returns the
+        GenerationRequest. A prompt that could NEVER fit the pool is
+        rejected up front with KVCacheFullError (429)."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        if tokens.shape[0] < 1:
+            raise ValueError("prompt must have >= 1 token")
+        if np.any(tokens < 0) or np.any(tokens >= self.vocab):
+            raise ValueError(
+                f"prompt token ids must be in [0, {self.vocab})")
+        max_new = int(max_new_tokens)
+        if max_new < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        total = tokens.shape[0] + max_new - 1
+        if total > self.model.max_context:
+            raise ValueError(
+                f"prompt + generation needs {total} KV rows, model "
+                f"max_context is {self.model.max_context}")
+        if self.cache.pages_for(total) > self.cache.capacity:
+            raise KVCacheFullError(
+                f"sequence needs {self.cache.pages_for(total)} pages, "
+                f"pool capacity is {self.cache.capacity} — unservable "
+                f"at any load")
+        with self._cond:
+            if self._closed:
+                raise ServingClosedError("sequence scheduler is closed")
+            if len(self._pending) >= self.queue_limit:
+                self._m["rejected"].inc()
+                raise QueueFullError(
+                    f"sequence queue full ({len(self._pending)} waiting, "
+                    f"queueLimit={self.queue_limit})")
+            sid = next(self._stream_ids)
+            from deeplearning4j_tpu.serving.sampling import stream_rng
+            req = GenerationRequest(
+                tokens, self.clock(), deadline, max_new=max_new,
+                sampler=sampler if sampler is not None else self.sampler,
+                rng=stream_rng(self.sampler_seed, sid), stream_id=sid)
+            self._pending.append(req)
+            self._m["sequences"].inc()
+            self._m["depth"].set(len(self._pending))
+            self._cond.notify()
+        if wait:
+            return req.wait(timeout)
+        return req
+
+    # -- scheduling core ------------------------------------------------
+    def _release_req(self, req):
+        """Return a request's pages to the pool (slot teardown)."""
+        if req.pages:
+            self.cache.release(req.pages)
+            req.pages = []
+
+    def _expire_locked(self, now):
+        keep = deque()
+        for req in self._pending:
+            if req.deadline is not None and now >= req.deadline:
+                self._m["expired"].inc()
+                req.fail(DeadlineExceededError(
+                    f"deadline passed {now - req.deadline:.3f}s before "
+                    "a slot was granted"))
+            else:
+                keep.append(req)
+        self._pending = keep
+        live = []
+        for req in self._active:
+            if req.deadline is not None and now >= req.deadline:
+                self._m["expired"].inc()
+                self._release_req(req)
+                req.fail(DeadlineExceededError(
+                    f"deadline passed at {len(req.out_tokens)}/"
+                    f"{req.max_new} tokens — slot released "
+                    "mid-generation"))
+            else:
+                live.append(req)
+        self._active = live
+        self._m["depth"].set(len(self._pending))
+        self._m["active"].set(len(self._active))
+
+    def _refill_locked(self, now):
+        """Admit queued prompts into free KV slots; prefix sharing
+        adopts registered pages copy-on-write here. An exact-prompt
+        adoption may complete the prompt outright — its first token is
+        sampled from the registered logits (returned for the caller to
+        process OUTSIDE this lock)."""
+        adopted_done = []
+        if self.admission == "gang" and self._active:
+            return adopted_done
+        midrun = any(r.seq_len > 0 for r in self._active)
+        while self._pending and len(self._active) < self.max_slots:
+            req = self._pending.popleft()
+            req.started_at = now
+            req.block_row = np.zeros((self._mp,), np.int32)
+            logits = None
+            if self.prefix_sharing:
+                pages, n_shared, logits = self.cache.match_prefix(
+                    req.tokens)
+                if pages:
+                    req.pages = list(pages)
+                    req.block_row[:len(pages)] = pages
+                    req.prefilled = req.seq_len = int(n_shared)
+            self._active.append(req)
+            self._m["wait"].observe(now - req.enqueued_at)
+            if midrun:
+                self._m["refills"].inc()
+            if logits is not None:
+                adopted_done.append((req, logits))
+        self._m["depth"].set(len(self._pending))
+        self._m["active"].set(len(self._active))
+        return adopted_done
+
+    def bucket_for(self, n):
+        """Smallest slot bucket >= n live slots."""
+        for b in self.slot_buckets:
+            if n <= b:
+                return b
+        return self.slot_buckets[-1]
+
+    def _fail_req(self, req, exc):
+        """Fail one mid-flight request and free its slot + pages."""
+        self._release_req(req)
+        with self._cond:
+            self._m["errors"].inc()
+            req.fail(exc)
+            self._active = [r for r in self._active if r is not req]
+            self._m["active"].set(len(self._active))
+
+    def _complete_prompt(self, req, last_logits):
+        """The prompt is fully in KV: sample the first generated token
+        from its final-position logits. Returns True if that already
+        finishes the request (max_new == 1)."""
+        row = np.asarray(last_logits, np.float32)
+        req.logits_rows.append(row)
+        req.out_tokens.append(int(req.sampler(row, req.rng)))
+        if len(req.out_tokens) >= req.max_new:
+            self._finish_req(req)
+            return True
+        return False
+
+    def _finish_req(self, req):
+        self._release_req(req)
+        with self._cond:
+            self._active = [r for r in self._active if r is not req]
+            self._m["completed"].inc()
+            self._m["active"].set(len(self._active))
+        req.finish(np.asarray(req.out_tokens, np.int64))
+
+    def _prefill_one(self, req):
+        """Dispatch ONE page-sized prompt chunk for one slot: allocate
+        the chunk's page, append its K/V, attend causally over the
+        table so far. Completing the prompt registers it for prefix
+        sharing and samples the first token. Returns True on progress;
+        a pool-exhausted or chaos-injected failure fails THIS request
+        only (typed, 429 at the HTTP tier)."""
+        import jax.numpy as jnp
+
+        page = self.model.page_size
+        T = int(req.tokens.shape[0])
+        t0 = req.prefilled
+        n_valid = min(page, T - t0)
+        t0c = self.clock()
+        try:
+            pg = self.cache.alloc(1)[0]
+            req.pages.append(pg)
+            req.block_row[t0 // page] = pg
+            chunk = np.zeros((page,), np.int32)
+            chunk[:n_valid] = req.tokens[t0:t0 + n_valid]
+            # chaos seam INSIDE the failure try: an injected raise
+            # fails this prefill like an organic dispatch error
+            chunk = _chaos_fault_point("sequence.prefill", chunk)
+            logits, kps, vps = self.model._jit_prefill(
+                self.model._params, chunk, jnp.asarray(t0, jnp.int32),
+                jnp.asarray(n_valid, jnp.int32), self.cache.k_pools,
+                self.cache.v_pools, req.block_row)
+            self.cache.k_pools, self.cache.v_pools = kps, vps
+        except Exception as e:
+            self._fail_req(req, e)
+            return True                     # progress: the slot freed
+        finally:
+            self._registry.add_span(
+                "sequence.prefill", "serving", t0c,
+                self.clock() - t0c, model=self.name, chunk=n_valid)
+        req.prefilled += n_valid
+        req.seq_len = req.prefilled
+        self.prefill_chunks += 1
+        if req.prefilled >= T:
+            last = np.asarray(logits)
+            if self.prefix_sharing:
+                self.cache.register_prefix(req.tokens, req.pages, last)
+            self._complete_prompt(req, last)
+        return True
+
+    def _staging_for(self, S):
+        """Per-bucket decode staging buffers (tokens, seq lens, block
+        tables), allocated once and reused every iteration — the same
+        alloc-churn fix as the carry path's _gather pool."""
+        st = self._staging.get(S)
+        if st is None:
+            st = (np.zeros((S,), np.int32), np.zeros((S,), np.int32),
+                  np.zeros((S, self._mp), np.int32))
+            self._staging[S] = st
+        else:
+            self.staging_reuse_bytes += sum(a.nbytes for a in st)
+        return st
+
+    def _decode_batch(self, batch):
+        """One slot-batched decode step over every fully-prefilled
+        slot: per-slot page prep (CoW fork / fresh page at a page
+        boundary — a pool-exhausted slot fails alone), padded gather,
+        ONE dispatch, scatter + sample."""
+        import jax.numpy as jnp
+
+        ready = []
+        for req in batch:
+            try:
+                idx = req.seq_len // self.model.page_size
+                if req.seq_len % self.model.page_size == 0 \
+                        and req.block_row[idx] == 0:
+                    pg = self.cache.alloc(1)[0]
+                    req.pages.append(pg)
+                    req.block_row[idx] = pg
+                else:
+                    old = int(req.block_row[idx])
+                    pg = self.cache.ensure_private(old)
+                    if pg != old:
+                        req.block_row[idx] = pg
+                        req.pages = [pg if p == old else p
+                                     for p in req.pages]
+                ready.append(req)
+            except Exception as e:
+                self._fail_req(req, e)
+        if not ready:
+            return 0
+        S = self.bucket_for(len(ready))
+        tok, sls, bts = self._staging_for(S)
+        n = len(ready)
+        for i, req in enumerate(ready):
+            tok[i] = req.out_tokens[-1]
+            sls[i] = req.seq_len
+            bts[i] = req.block_row
+        tok[n:] = 0
+        sls[n:] = 0
+        bts[n:] = 0
+        t0c = self.clock()
+        self._m["dispatches"].inc()
+        self._m["slot_steps"].inc(n)
+        self._m["occupancy"].observe(n / S)
+        self.occupancy.append((n, S))
+        try:
+            tok = _chaos_fault_point("sequence.step", tok)
+            out, kps, vps = self.model._jit_decode(
+                self.model._params, tok, self.cache.k_pools,
+                self.cache.v_pools, bts, sls)
+            self.cache.k_pools, self.cache.v_pools = kps, vps
+            out = np.asarray(out)
+        except Exception as e:
+            with self._cond:
+                self._m["errors"].inc(len(ready))
+                for req in ready:
+                    self._release_req(req)
+                    req.fail(e)
+                self._active = [r for r in self._active
+                                if r not in ready]
+                self._m["active"].set(len(self._active))
+            return 0
+        finally:
+            self._registry.add_span(
+                "sequence.step", "serving", t0c, self.clock() - t0c,
+                model=self.name, slots=n, bucket=S)
+        finished = []
+        for i, req in enumerate(ready):
+            if req.done:                # expired between gather + now
+                continue
+            req.seq_len += 1
+            row = out[i].astype(np.float32, copy=False)
+            req.logits_rows.append(row)
+            req.out_tokens.append(int(req.sampler(row, req.rng)))
+            if len(req.out_tokens) >= req.max_new:
+                finished.append(req)
+        for req in finished:
+            self._finish_req(req)
+        return n
+
+    def _step_once(self):
+        with self._step_lock:
+            return self._iterate_locked()  # fault-ok[FLT04]: the step lock is the scheduler's own serialization contract — a seam firing under it IS the wedged-scheduler fault the harness injects, and waiters are released by deadline expiry (the wait contract), never by this lock
+
+    def _iterate_locked(self):
+        """One iteration: expire -> refill (prefix adoption) -> at most
+        ONE prefill chunk -> one slot-batched decode step. Returns the
+        progress count (0 = idle)."""
+        with self._cond:
+            now = self.clock()
+            self._expire_locked(now)
+            adopted = self._refill_locked(now)
+        progress = 0
+        for req, logits in adopted:       # exact-prefix admissions
+            self._complete_prompt(req, logits)
+            progress += 1
+        with self._cond:
+            batch = list(self._active)
+        if not batch:
+            return progress
+        pre = next((r for r in batch
+                    if not r.done and r.prefilled < r.tokens.shape[0]),
+                   None)
+        if pre is not None:
+            self._prefill_one(pre)
+            progress += 1
+        decode = [r for r in batch
+                  if not r.done and r.prefilled >= r.tokens.shape[0]]
+        if decode:
+            progress += self._decode_batch(decode)
+        return progress
+
+    # -- drivers --------------------------------------------------------
+    def poll(self):
+        """One synchronous scheduler iteration (the thread-less test
+        seam). Returns the progress count — 0 means idle."""
+        return self._step_once()
+
+    def drain(self):
+        """Run iterations until the table AND queue are empty."""
+        while self._step_once():
+            pass
+        return self
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                if self._closed and not self._pending \
+                        and not self._active:
+                    return
+                if not self._pending and not self._active:
+                    self._cond.wait(0.05)
+                    continue
+            try:
+                self._step_once()
+            except Exception as e:
+                self._fail_all(e)
+
+    def _fail_all(self, exc):
+        with self._cond:
+            n = len(self._pending) + len(self._active)
+            if n:
+                self._m["errors"].inc(n)
+            while self._pending:
+                self._pending.popleft().fail(exc)
+            for req in self._active:
+                self._release_req(req)
+                req.fail(exc)
+            self._active = []
+            self._m["depth"].set(0)
+            self._m["active"].set(0)
+
+    # -- introspection / lifecycle --------------------------------------
+    @property
+    def depth(self):
+        with self._cond:
+            return len(self._pending)
+
+    @property
+    def active_slots(self):
+        with self._cond:
+            return len(self._active)
+
+    @property
+    def stats(self):
+        """Dict view over the registry counters (dl4j_seq_*)."""
+        return {k: int(self._m[k].value) for k in _STAT_KEYS}
+
+    def occupancy_summary(self):
+        return occupancy_summary_from(self.occupancy, "mean_live_slots")
+
+    def warm(self, cache=None):
+        """Precompile the decode executable for EVERY slot bucket plus
+        the (bucket-independent) prefill chunk executable, so a serving
+        process generates its first token hot. Returns {bucket: {...},
+        "prefill": {...}} for fresh compiles. Signatures mirror the
+        live dispatch EXACTLY (host-numpy staging arrays + the live
+        pool handles)."""
+        import jax.numpy as jnp
+
+        report = {}
+        for S in self.slot_buckets:
+            tok = np.zeros((S,), np.int32)
+            sls = np.zeros((S,), np.int32)
+            bts = np.zeros((S, self._mp), np.int32)
+            key, status, secs = self.model._jit_decode.warm(
+                self.model._params, tok, self.cache.k_pools,
+                self.cache.v_pools, bts, sls, cache=cache)
+            if status is not None:
+                report[int(S)] = {"key": key, "status": status,
+                                  "seconds": round(secs, 3)}
+        chunk = np.zeros((self.model.page_size,), np.int32)
+        bt = np.zeros((self._mp,), np.int32)
+        key, status, secs = self.model._jit_prefill.warm(
+            self.model._params, chunk, jnp.asarray(0, jnp.int32),
+            jnp.asarray(1, jnp.int32), self.cache.k_pools,
+            self.cache.v_pools, bt, cache=cache)
+        if status is not None:
+            report["prefill"] = {"key": key, "status": status,
+                                 "seconds": round(secs, 3)}
+        return report
+
+    def close(self, drain=True):
+        """Stop accepting. drain=True serves everything queued or
+        mid-flight to completion; drain=False fails them with
+        ServingClosedError and frees their pages."""
+        with self._cond:
+            self._closed = True
+            if not drain:
+                while self._pending:
+                    self._pending.popleft().fail(
+                        ServingClosedError("scheduler closed before "
+                                           "a slot was granted"))
+                for req in self._active:
+                    self._release_req(req)
+                    req.fail(ServingClosedError(
+                        "scheduler closed mid-generation"))
+                self._active = []
+                self._m["depth"].set(0)
+                self._m["active"].set(0)
+            self._cond.notify_all()
+        if drain:
+            self.drain()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self.cache.close()
+        reg = self._registry
+        for metric in _SEQ_METRIC_FAMILIES:
             fam = reg.get(metric)
             if fam is not None:
                 fam.remove(model=self.name)

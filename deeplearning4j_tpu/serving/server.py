@@ -157,34 +157,59 @@ class _InferenceHandler(JsonHandler):
     def _handle_generate(self, host, name):
         """POST :generate — one sequence through the iteration-level
         slot scheduler; same backpressure contract as :predict (429/
-        504/503/400/404), the deadline honored per decode step."""
+        504/503/400/404), the deadline honored per decode step.
+
+        Two body shapes: ``{"steps": [[...], ...], "extraSteps"}``
+        routes per-step features to the carry-slot (RNN) scheduler;
+        ``{"tokens": [...], "maxNewTokens"}`` routes a token prompt to
+        the paged KV scheduler -> ``{"tokens": [...], "steps": n}``.
+        A KV-pool-exhausted prompt is a 429 (KVCacheFullError —
+        admission backpressure, exactly like a full queue)."""
+        from deeplearning4j_tpu.serving.kvcache import KVCacheFullError
+
         try:
             body = self._read_json_object()
         except ValueError as e:
             raise HttpError(400, str(e))
         steps = body.get("steps")
-        if steps is None:
-            raise HttpError(400, 'body must carry "steps": [[...], ...]')
-        try:
-            feats = np.asarray(steps, dtype=np.float32)
-        except (TypeError, ValueError) as e:
-            raise HttpError(400, f"steps not array-like: {e}")
+        tokens = body.get("tokens")
+        if steps is None and tokens is None:
+            raise HttpError(
+                400, 'body must carry "steps": [[...], ...] (feature '
+                'sequence) or "tokens": [...] (paged token prompt)')
         deadline_ms = body.get("deadlineMs")
         try:
             deadline_s = None if deadline_ms is None \
                 else float(deadline_ms) / 1000.0
             extra = int(body.get("extraSteps", 0))
+            max_new = int(body.get("maxNewTokens", 1))
         except (TypeError, ValueError) as e:
-            raise HttpError(400, f"deadlineMs/extraSteps not numeric: {e}")
+            raise HttpError(
+                400, f"deadlineMs/extraSteps/maxNewTokens not "
+                f"numeric: {e}")
         try:
-            out = host.submit_sequence(name, feats,
-                                       deadline_s=deadline_s,
-                                       extra_steps=extra)
+            if tokens is not None:
+                try:
+                    toks = np.asarray(tokens, dtype=np.int32)
+                except (TypeError, ValueError) as e:
+                    raise HttpError(400, f"tokens not array-like: {e}")
+                out = host.generate(name, toks, deadline_s=deadline_s,
+                                    max_new_tokens=max_new)
+            else:
+                try:
+                    feats = np.asarray(steps, dtype=np.float32)
+                except (TypeError, ValueError) as e:
+                    raise HttpError(400, f"steps not array-like: {e}")
+                out = host.submit_sequence(name, feats,
+                                           deadline_s=deadline_s,
+                                           extra_steps=extra)
             sm = host.sequence_model(name)  # post-submit: live version
         except KeyError as e:
             raise HttpError(404, str(e))
         except ValueError as e:
             raise HttpError(400, str(e))
+        except KVCacheFullError as e:  # pool exhausted: backpressure
+            raise HttpError(429, str(e))
         except QueueFullError as e:
             raise HttpError(429, str(e))
         except DeadlineExceededError as e:
@@ -192,6 +217,10 @@ class _InferenceHandler(JsonHandler):
         except ServingClosedError as e:
             raise HttpError(503, str(e))
         out = np.asarray(out)
+        if tokens is not None:
+            return self._json({"tokens": [int(t) for t in out],
+                               "model": sm.name, "version": sm.version,
+                               "steps": int(out.shape[0])})
         return self._json({"outputs": out.tolist(), "model": sm.name,
                            "version": sm.version,
                            "steps": int(out.shape[0])})

@@ -193,6 +193,8 @@ class TestTunnelProbe:
                             lambda budget: {"cpu_only": True})
         monkeypatch.setattr(bench, "bench_autotune",
                             lambda t: {"cpu_pinned": True})
+        monkeypatch.setattr(bench, "bench_serving_paged",
+                            lambda t: {"paged": True})
         monkeypatch.setattr(bench, "_CONFIGS", {})
         bench._emit_tunnel_dead("jax.devices() hung > 60s")
         for name, _ in bench.SECONDARY_CONFIGS:
@@ -201,9 +203,35 @@ class TestTunnelProbe:
         assert bench._CONFIGS["grad_sharing"] == {"cpu_only": True}
         # round 12: the CPU-pinned autotune sweep banks on a dead tunnel
         assert bench._CONFIGS["autotune"] == {"cpu_pinned": True}
+        # round 19: the CPU-pinned paged KV A/B banks on a dead tunnel
+        assert bench._CONFIGS["serving_paged"] == {"paged": True}
         line = json.loads(capsys.readouterr().out.splitlines()[-1])
         assert "tunnel_dead" in line["error"]
         assert line["configs"]["fit_dataset"] == {"error": "tunnel_dead"}
+
+
+class TestServingPagedLeg:
+    """bench_serving_paged's wrapper contract, against a stand-in
+    child (the real paged child is a subprocess measurement, not
+    selection logic)."""
+
+    def test_parses_pagedrec_line_and_attaches_note(self, monkeypatch):
+        rec = {"residency": {"ratio": 0.41, "gate": 0.6, "pass": True},
+               "paged": {"decode_tokens_per_s": 512.0}}
+        monkeypatch.setattr(
+            bench, "_SERVING_PAGED_CHILD",
+            "import json\nprint('PAGEDREC ' + json.dumps(%r))" % (rec,))
+        out = bench.bench_serving_paged(60)
+        assert out["residency"]["pass"] is True
+        assert out["paged"]["decode_tokens_per_s"] == 512.0
+        assert "note" in out
+
+    def test_child_failure_returns_error_record(self, monkeypatch):
+        monkeypatch.setattr(
+            bench, "_SERVING_PAGED_CHILD",
+            "import sys; sys.stderr.write('pool exploded'); sys.exit(3)")
+        out = bench.bench_serving_paged(60)
+        assert "pool exploded" in out["error"]
 
 
 class TestMaxpoolABSelection:

@@ -15,8 +15,8 @@ What must hold:
   glob, so a new serving module cannot silently dodge the pass);
 - the runtime twin: ``seam_coverage`` proves every registered chaos
   seam fires at least once across a live soak (fleet + sequence +
-  HTTP + AOT disk + checkpoint paths), and a deliberately dead seam
-  trips the gate;
+  paged KV generate + HTTP + AOT disk + checkpoint paths), and a
+  deliberately dead seam trips the gate;
 - the audit regressions: the hedged-dispatch busy-wait is gone (CV
   wait, no ``sleep(0.0)``), a refused hedge enqueue is counted and
   charged, GET routes fire the ``server.request`` seam, disk-store
@@ -515,9 +515,11 @@ def _fleet(n_replicas, net, *, router_kw=None, **kw):
 class TestSeamCoverageGate:
     def test_every_registered_seam_fires(self, tmp_path, fresh_cache):
         """The 100% gate: one soak drives fleet traffic, a sequence
-        decode, live HTTP GET+POST, AOT disk read/write and a
-        checkpointed fit — and EVERY seam in chaos.SEAMS fires at
-        least once. A seam this soak cannot reach is dead inventory."""
+        decode, a paged token generate, live HTTP GET+POST, AOT disk
+        read/write and a checkpointed fit — and EVERY seam in
+        chaos.registered_seams() fires at least once. A seam this soak
+        cannot reach is dead inventory."""
+        from deeplearning4j_tpu.nn.transformer import CausalTransformerLM
         from deeplearning4j_tpu.runtime.aot import ExecutableCache
         from deeplearning4j_tpu.runtime.resilience import (
             ResilientFit, RetryPolicy,
@@ -527,6 +529,11 @@ class TestSeamCoverageGate:
         fleet, _ = _fleet(2, _mln())
         host = ModelHost()
         host.register_sequence("s", _rnn_net(), slotBuckets=(4,))
+        host.register_sequence(
+            "g", CausalTransformerLM(vocab=11, d_model=8, n_heads=1,
+                                     n_layers=1, max_context=8,
+                                     page_size=4, seed=0),
+            slotBuckets=(2,), numPages=8)
         srv = InferenceServer(host).start(port=0, warmup=False)
         disk = ExecutableCache(str(tmp_path / "aot"))
         junk = disk._path("deadbeef")
@@ -542,6 +549,8 @@ class TestSeamCoverageGate:
             fleet.submit("m", _rows(2))
             # host.submit_sequence + sequence.step
             host.submit_sequence("s", seq)
+            # sequence.prefill + kv.page_alloc (the paged KV tier)
+            host.generate("g", [1, 2, 3, 4, 5], max_new_tokens=1)
             # server.request — GET and POST both route through it
             _get(base + "/v1/models")
             # aot.disk_write (serialize of a non-executable fails
@@ -565,7 +574,7 @@ class TestSeamCoverageGate:
             srv.stop()
             host.close(drain=True)
             fleet.close()
-        assert set(counts) == set(chaos.SEAMS)
+        assert set(counts) == set(chaos.registered_seams())
         assert coverage_gaps(counts) == [], counts
 
     def test_get_routes_fire_the_request_seam(self):

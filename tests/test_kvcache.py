@@ -1,0 +1,175 @@
+"""Page-lifecycle gates for the paged KV cache (serving/kvcache.py,
+docs/SERVING.md "Paged KV cache").
+
+What must hold:
+
+- alloc/free discipline: pages come off a free list with refcount 1,
+  release at refcount 0 returns them for REUSE, the null page 0 is
+  never allocated and never freed, accounting (pages_in_use /
+  bytes_in_use / gauges) is exact at every transition;
+- exhaustion is the typed ``KVCacheFullError`` — admission
+  backpressure, never a swallowed except;
+- copy-on-write prefix sharing: registered prompt pages are adopted
+  by reference, a shared page is forked on first append
+  (``ensure_private``: device copy, original intact for the other
+  holders), and LRU registry eviction frees pages BEFORE admission
+  fails;
+- ``close()`` releases the registry and this instance's gauge series.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.runtime import telemetry
+from deeplearning4j_tpu.serving.kvcache import (
+    KVCacheFullError, PagedKVCache,
+)
+
+
+def _cache(num_pages=8, **kw):
+    kw.setdefault("n_layers", 2)
+    kw.setdefault("n_heads", 2)
+    kw.setdefault("head_dim", 4)
+    kw.setdefault("page_size", 4)
+    return PagedKVCache(num_pages=num_pages, **kw)
+
+
+class TestAllocFree:
+    def test_alloc_release_refill_reuses_pages(self):
+        c = _cache(num_pages=6)
+        assert c.capacity == 5 and c.pages_in_use == 0
+        a = c.alloc(3)
+        assert 0 not in a and len(set(a)) == 3
+        assert c.pages_in_use == 3
+        c.release(a)
+        assert c.pages_in_use == 0
+        b = c.alloc(5)
+        # the freed pages are REUSED — the pool never grows
+        assert set(a) <= set(b) and c.pages_in_use == 5
+        c.close()
+
+    def test_null_page_release_is_a_noop(self):
+        c = _cache()
+        c.release([0])
+        assert c.pages_in_use == 0
+        assert 0 not in c.alloc(c.capacity)
+        c.close()
+
+    def test_exhaustion_raises_typed(self):
+        c = _cache(num_pages=4)
+        c.alloc(3)
+        with pytest.raises(KVCacheFullError):
+            c.alloc(1)
+        c.close()
+
+    def test_accounting_and_gauges(self):
+        c = _cache(num_pages=8, model="acct")
+        per_page = 2 * 2 * 4 * 4 * 4  # L*page*H*Dh*itemsize, K and V
+        assert c.page_bytes() == 2 * per_page
+        pages = c.alloc(3)
+        assert c.bytes_in_use() == 3 * c.page_bytes()
+        assert c._g_in_use.value == 3
+        c.release(pages[:1])
+        assert c._g_in_use.value == 2
+        assert c.pages_for(1) == 1 and c.pages_for(4) == 1 \
+            and c.pages_for(5) == 2
+        c.close()
+        fam = telemetry.get_registry().get("dl4j_kv_pages_in_use")
+        assert fam is None or fam.labels_get(model="acct") is None
+
+
+class TestCopyOnWrite:
+    def test_exact_match_adopts_and_partial_holds_tail(self):
+        c = _cache(num_pages=10, page_size=4)
+        tokens = [1, 2, 3, 4, 5, 6]          # 2 pages, tail partial
+        pages = c.alloc(2)
+        logits = np.arange(7, dtype=np.float32)
+        c.register_prefix(tokens, pages, logits)
+        # exact match: both pages + the stored logits
+        got, n, lg = c.match_prefix(tokens)
+        assert got == pages and n == 6
+        assert np.array_equal(lg, logits)
+        assert all(c.is_shared(p) for p in got)
+        # longer prompt: only the FULL page is adoptable; the partial
+        # tail must be re-prefilled by the adopter
+        got2, n2, lg2 = c.match_prefix(tokens + [9, 9])
+        assert got2 == pages[:1] and n2 == 4 and lg2 is None
+        c.close()
+
+    def test_ensure_private_forks_shared_page(self):
+        c = _cache(num_pages=6, page_size=2)
+        (pg,) = c.alloc(1)
+        c.k_pools = c.k_pools.at[:, pg].set(1.5)
+        c.v_pools = c.v_pools.at[:, pg].set(-2.0)
+        c.register_prefix([3, 4], [pg], np.zeros(3, np.float32))
+        c.release([pg])              # the prefilling slot finished
+        adopted, n, _ = c.match_prefix([3, 4])
+        assert adopted == [pg] and c.is_shared(pg)
+        new = c.ensure_private(pg)
+        assert new != pg
+        # the fork carries the page's values; the original keeps its
+        # other holder (the registry) and its data
+        assert np.all(np.asarray(c.k_pools[:, new]) == 1.5)
+        assert np.all(np.asarray(c.v_pools[:, new]) == -2.0)
+        assert not c.is_shared(pg) and c._ref[pg] == 1
+        c.k_pools = c.k_pools.at[:, new].set(9.0)
+        assert np.all(np.asarray(c.k_pools[:, pg]) == 1.5)
+        # unshared pages come back unchanged — no copy paid
+        assert c.ensure_private(new) == new
+        c.close()
+
+    def test_lru_eviction_frees_registry_before_failing(self):
+        c = _cache(num_pages=5, page_size=4)   # capacity 4
+        a = c.alloc(1)
+        b = c.alloc(1)
+        c.register_prefix([1], a, np.zeros(2, np.float32))
+        c.register_prefix([2], b, np.zeros(2, np.float32))
+        c.release(a)
+        c.release(b)                 # both live only in the registry
+        # touch [2] so [1] is the LRU victim
+        got, _, _ = c.match_prefix([2])
+        c.release(got)
+        assert c.pages_in_use == 2
+        newly = c.alloc(3)           # forces one eviction ([1])
+        assert len(newly) == 3
+        assert c.match_prefix([1]) == ([], 0, None)
+        got2, _, _ = c.match_prefix([2])
+        assert got2 == b             # the touched entry survived
+        c.close()
+
+    def test_registry_pages_survive_owner_release(self):
+        c = _cache(num_pages=6)
+        pages = c.alloc(2)
+        c.register_prefix([5, 6, 7], pages, np.zeros(2, np.float32))
+        c.release(pages)             # the owning slot finished
+        assert c.pages_in_use == 2   # the registry still holds them
+        got, n, _ = c.match_prefix([5, 6, 7])
+        assert got == pages and n == 3
+        c.close()
+
+    def test_shared_gauge_tracks_registry(self):
+        c = _cache(num_pages=8, model="shr")
+        pages = c.alloc(2)
+        c.register_prefix([1, 2], pages, np.zeros(2, np.float32))
+        assert c._g_shared.value == 2
+        while c._prefixes:
+            c._evict_lru_prefix()
+        assert c._g_shared.value == 0
+        c.close()
+
+
+class TestValidation:
+    def test_rejects_degenerate_shapes(self):
+        with pytest.raises(ValueError):
+            _cache(num_pages=1)
+        with pytest.raises(ValueError):
+            _cache(page_size=0)
+
+    def test_dtype_flows_into_pools(self):
+        c = _cache(dtype=jnp.bfloat16)
+        assert c.k_pools.dtype == jnp.bfloat16
+        # 2 (K and V) * L2 * page4 * H2 * Dh4 * 2 bytes
+        assert c.page_bytes() == 2 * 2 * 4 * 2 * 4 * 2
+        c.close()

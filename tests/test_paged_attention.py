@@ -1,0 +1,218 @@
+"""Block-table paged-attention kernel gates (ops/pallas_attention.py
+``paged_flash_decode`` / ``paged_flash_prefill`` / ``paged_attend``,
+docs/SERVING.md "Paged KV cache").
+
+What must hold (the ISSUE 19 kernel acceptance):
+
+- the paged DECODE kernel (one query row per slot, K/V gathered
+  through the slot's block table) is BITWISE equal to the dense flash
+  kernel on the same tokens — aligned, padded and bf16 grids, with the
+  pool pages physically scattered;
+- the chunked-PREFILL kernel (page-sized prompt chunk attending
+  causally over the table so far) is bitwise the dense kernel's rows
+  for every chunk;
+- padded slots behave like the dense kernel's fully-masked rows: zero
+  output, the +1e30 lse sentinel, and trailing null-page blocks are
+  bitwise no-ops on the accumulators;
+- the portable ``paged_attend`` core (the serving step functions'
+  attention) accumulates in the same page order: bitwise in bf16,
+  <= 1 ulp in f32 vs the kernels.
+
+Everything runs in pallas interpret mode on CPU — the same numerics
+contract the dense flash kernel's parity suite uses.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops import pallas_attention as pa
+
+
+@pytest.fixture(autouse=True)
+def _interpret(monkeypatch):
+    monkeypatch.setattr(pa, "_INTERPRET", True)
+
+
+# ----------------------------------------------------------------------
+# subjects
+# ----------------------------------------------------------------------
+
+def _paged_layout(T, page, P, H, D, dtype, rng, start_page=1):
+    """Contiguous K/V [1, H, T, D] plus the SAME tokens scattered into
+    a paged pool through a randomly permuted block table (physical
+    page order deliberately != logical order)."""
+    k = rng.standard_normal((1, H, T, D)).astype(np.float32)
+    v = rng.standard_normal((1, H, T, D)).astype(np.float32)
+    MP = -(-T // page)
+    kp = np.zeros((P, page, H, D), np.float32)
+    vp = np.zeros((P, page, H, D), np.float32)
+    bt = np.zeros((MP,), np.int32)
+    order = rng.permutation(np.arange(start_page, P))[:MP]
+    for j in range(MP):
+        pid = int(order[j])
+        bt[j] = pid
+        n = min(page, T - j * page)
+        kp[pid, :n] = np.moveaxis(k[0, :, j * page:j * page + n], 0, 1)
+        vp[pid, :n] = np.moveaxis(v[0, :, j * page:j * page + n], 0, 1)
+    return (k.astype(dtype), v.astype(dtype), kp.astype(dtype),
+            vp.astype(dtype), bt)
+
+
+GRIDS = [
+    pytest.param(8, 4, np.float32, id="aligned-f32"),
+    pytest.param(7, 4, np.float32, id="padded-f32"),
+    pytest.param(8, 4, jnp.bfloat16, id="aligned-bf16"),
+    pytest.param(7, 4, jnp.bfloat16, id="padded-bf16"),
+]
+
+
+# ----------------------------------------------------------------------
+# decode kernel vs the dense flash kernel
+# ----------------------------------------------------------------------
+
+class TestPagedDecodeParity:
+    @pytest.mark.parametrize("T,page,dtype", GRIDS)
+    def test_decode_bitwise_vs_dense_flash(self, T, page, dtype):
+        """The block-table decode kernel's output for the last token is
+        BITWISE the dense flash kernel's last row (block_q=1,
+        block_k=page — identical accumulation order), pool pages
+        scattered."""
+        rng = np.random.default_rng(0)
+        H, D, P = 2, 8, 12
+        k, v, kp, vp, bt = _paged_layout(T, page, P, H, D, dtype, rng)
+        q_full = rng.standard_normal((1, H, T, D)).astype(
+            np.float32).astype(dtype)
+        dense, _ = pa._flash_fwd_impl(jnp.asarray(q_full),
+                                      jnp.asarray(k), jnp.asarray(v),
+                                      True, 1, page, need_lse=False)
+        dense_last = np.asarray(dense)[0, :, T - 1, :]
+        S, MP = 2, bt.shape[0]
+        bts = np.zeros((S, MP), np.int32)
+        bts[0] = bt
+        sls = np.zeros((S,), np.int32)
+        sls[0] = T
+        q = np.zeros((S, H, D), dtype)
+        q[0] = np.moveaxis(q_full[0, :, T - 1], 0, 0)
+        out = pa.paged_flash_decode(jnp.asarray(q), jnp.asarray(kp),
+                                    jnp.asarray(vp), bts, sls)
+        out = np.asarray(out)
+        assert np.array_equal(out[0].view(np.uint8),
+                              dense_last.view(np.uint8))
+
+    @pytest.mark.parametrize("T,page,dtype", GRIDS)
+    def test_padded_slot_rows_masked_like_dense(self, T, page, dtype):
+        """A padded slot (seq_len 0, block table all null page) is the
+        dense kernel's fully-masked row: zero output, +1e30 lse
+        sentinel — never NaN, never garbage."""
+        rng = np.random.default_rng(0)
+        H, D, P = 2, 8, 12
+        _, _, kp, vp, bt = _paged_layout(T, page, P, H, D, dtype, rng)
+        S, MP = 2, bt.shape[0]
+        bts = np.zeros((S, MP), np.int32)
+        bts[0] = bt
+        sls = np.zeros((S,), np.int32)
+        sls[0] = T
+        q = rng.standard_normal((S, H, D)).astype(np.float32).astype(dtype)
+        out, lse = pa.paged_flash_decode(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp), bts, sls,
+            need_lse=True)
+        assert np.all(np.asarray(out)[1] == 0)
+        assert np.all(np.asarray(lse)[1] == pa._LSE_EMPTY)
+
+    def test_trailing_null_pages_are_noops(self):
+        """Blocks past a slot's live length run against the null page
+        but contribute nothing: extending the block-table width leaves
+        the output bitwise identical (the masked-block no-op the
+        bounded-pool layout depends on)."""
+        rng = np.random.default_rng(2)
+        T, page, H, D, P = 12, 4, 2, 8, 16
+        _, _, kp, vp, bt = _paged_layout(T, page, P, H, D,
+                                         np.float32, rng)
+        # poison the null page: a real no-op must mask it, not rely on
+        # it being zero
+        kp[0] = 7.5
+        vp[0] = -3.25
+        q = rng.standard_normal((1, H, D)).astype(np.float32)
+        sls = np.asarray([T], np.int32)
+        out_tight = pa.paged_flash_decode(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            bt[None], sls)
+        wide = np.zeros((1, bt.shape[0] + 3), np.int32)
+        wide[0, :bt.shape[0]] = bt
+        out_wide = pa.paged_flash_decode(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            wide, sls)
+        assert np.array_equal(np.asarray(out_tight).view(np.uint8),
+                              np.asarray(out_wide).view(np.uint8))
+
+
+# ----------------------------------------------------------------------
+# chunked-prefill kernel vs the dense flash kernel
+# ----------------------------------------------------------------------
+
+class TestPagedPrefillParity:
+    @pytest.mark.parametrize("T,page,dtype", GRIDS)
+    def test_prefill_chunks_bitwise_vs_dense_flash(self, T, page, dtype):
+        """Every page-sized prompt chunk's attention rows are BITWISE
+        the dense flash kernel's rows over the same prefix (block_q =
+        block_k = page) — the chunked prefill appends into scattered
+        pages yet accumulates in the identical block order."""
+        rng = np.random.default_rng(1)
+        H, D, P = 2, 8, 12
+        k, v, kp, vp, bt = _paged_layout(T, page, P, H, D, dtype, rng)
+        q_full = rng.standard_normal((1, H, T, D)).astype(
+            np.float32).astype(dtype)
+        for c in range(-(-T // page)):
+            t0 = c * page
+            n_valid = min(page, T - t0)
+            Tc = t0 + n_valid
+            dense, _ = pa._flash_fwd_impl(
+                jnp.asarray(q_full[:, :, :Tc]),
+                jnp.asarray(k[:, :, :Tc]), jnp.asarray(v[:, :, :Tc]),
+                True, page, page, need_lse=False)
+            dense_rows = np.asarray(dense)[0, :, t0:Tc, :]
+            qc = np.zeros((page, H, D), dtype)
+            qc[:n_valid] = np.moveaxis(q_full[0, :, t0:Tc], 0, 1)
+            out = pa.paged_flash_prefill(
+                jnp.asarray(qc), jnp.asarray(kp), jnp.asarray(vp),
+                bt, t0, n_valid)
+            got = np.moveaxis(np.asarray(out)[:n_valid], 0, 1)
+            assert np.array_equal(got.view(np.uint8),
+                                  dense_rows.view(np.uint8)), \
+                f"chunk {c} diverged from the dense kernel"
+
+
+# ----------------------------------------------------------------------
+# the portable core (serving step functions)
+# ----------------------------------------------------------------------
+
+class TestPagedAttendCore:
+    @pytest.mark.parametrize("T,page,dtype", GRIDS)
+    def test_core_matches_kernels_page_order(self, T, page, dtype):
+        """``paged_attend`` (what the transformer step twins trace)
+        accumulates page-sequentially like the kernels: bitwise in
+        bf16, a couple ulp in f32 (XLA fuses the f32 reductions
+        slightly differently; the serving-parity gates compare
+        core-vs-core, so this tolerance never stacks)."""
+        rng = np.random.default_rng(3)
+        H, D, P = 2, 8, 12
+        _, _, kp, vp, bt = _paged_layout(T, page, P, H, D, dtype, rng)
+        q = rng.standard_normal((1, H, D)).astype(np.float32).astype(dtype)
+        sls = np.asarray([T], np.int32)
+        out = np.asarray(pa.paged_flash_decode(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            bt[None], sls))
+        kpg = jnp.asarray(kp)[bt[None]]
+        vpg = jnp.asarray(vp)[bt[None]]
+        ref = np.asarray(pa.paged_attend(
+            jnp.asarray(q[:, None]), kpg, vpg, jnp.asarray(sls),
+            jnp.asarray(sls) - 1))[:, 0]
+        if dtype == jnp.bfloat16:
+            assert np.array_equal(ref.view(np.uint8),
+                                  out.view(np.uint8))
+        else:
+            err = np.max(np.abs(ref.astype(np.float64)
+                                - out.astype(np.float64)))
+            assert err <= 3e-7, f"core-vs-kernel error {err}"
