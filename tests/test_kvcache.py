@@ -20,12 +20,22 @@ What must hold:
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.runtime import telemetry
 from deeplearning4j_tpu.serving.kvcache import (
     KVCacheFullError, PagedKVCache,
 )
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _drop_jax_caches_after_module():
+    # This module churns many tiny single-use executables; left in
+    # jax's global caches they stay live for the rest of the tier-1
+    # process and starve the big zoo fits that run last.
+    yield
+    jax.clear_caches()
 
 
 def _cache(num_pages=8, **kw):

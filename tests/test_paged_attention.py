@@ -25,6 +25,7 @@ contract the dense flash kernel's parity suite uses.
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.ops import pallas_attention as pa
@@ -33,6 +34,15 @@ from deeplearning4j_tpu.ops import pallas_attention as pa
 @pytest.fixture(autouse=True)
 def _interpret(monkeypatch):
     monkeypatch.setattr(pa, "_INTERPRET", True)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _drop_jax_caches_after_module():
+    # Interpret-mode pallas churns many tiny single-use executables;
+    # left in jax's global caches they stay live for the rest of the
+    # tier-1 process and starve the big zoo fits that run last.
+    yield
+    jax.clear_caches()
 
 
 # ----------------------------------------------------------------------

@@ -33,6 +33,8 @@ import urllib.request
 import numpy as np
 import pytest
 
+import jax
+
 from deeplearning4j_tpu.nn.transformer import (
     CausalTransformerLM, dense_serial_trajectory,
 )
@@ -42,6 +44,15 @@ from deeplearning4j_tpu.serving import (
     PagedSequenceScheduler, ServingClosedError, greedy_sampler,
     stream_rng, temperature_sampler,
 )
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _drop_jax_caches_after_module():
+    # This module traces many model/bucket step twins; left in jax's
+    # global caches they stay live for the rest of the tier-1 process
+    # and starve the big zoo fits that run last.
+    yield
+    jax.clear_caches()
 
 
 @pytest.fixture
