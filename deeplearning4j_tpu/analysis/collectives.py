@@ -728,7 +728,9 @@ class CollectiveContract:
         return report
 
 
-def compression_contract(mode, n_leaves, n_eligible=None, axis="data"):
+def compression_contract(mode, n_leaves, n_eligible=None, axis="data",
+                         group_axis="group", intra_axis="intra",
+                         intra_quantized=True, adaptive=False):
     """The declarative collective contract of one ParallelWrapper /
     SharedTrainingMaster gradient_compression mode (the single source
     the dryrun legs and tests check against):
@@ -747,6 +749,17 @@ def compression_contract(mode, n_leaves, n_eligible=None, axis="data"):
                    all_gather     = E        fresh-param gather
                    psum  = (L - E) + 1       fallback all-reduce + loss
                    pmax  = L                 scale sync per leaf
+      hierarchical (2-D group x intra mesh; ROADMAP item 4):
+                   reduce_scatter = L        hop-1 group psum_scatter
+                                             per leaf (intra axis)
+                   all_gather = 3L           hop-2 idx + value gathers
+                                             (group axis) + hop-3
+                                             fan-back (intra axis)
+                   pmax = L                  hop-1 scale sync (only when
+                                             intra_quantized)
+                   psum = 1 (+1 adaptive)    loss pmean (+ the adaptive
+                                             tau's transmitted-fraction
+                                             pmean)
     """
     L = int(n_leaves)
     if mode is None:
@@ -760,6 +773,20 @@ def compression_contract(mode, n_leaves, n_eligible=None, axis="data"):
             "threshold", {"all_gather": 2 * L, "psum": 1}, axes=(axis,),
             description="Strom threshold encoding: one (idx, value) "
                         "all_gather pair per leaf + the loss pmean")
+    if mode == "hierarchical":
+        counts = {"reduce_scatter": L, "all_gather": 3 * L,
+                  "psum": 2 if adaptive else 1}
+        if intra_quantized:
+            counts["pmax"] = L
+        return CollectiveContract(
+            "hierarchical", counts, axes=(group_axis, intra_axis),
+            description="2-hop exchange: per leaf one "
+                        "dense/block_int8 psum_scatter over the intra "
+                        "axis (hop 1), idx+value all_gathers over the "
+                        "group axis (hop 2) and the intra fan-back "
+                        "all_gather (hop 3); + the loss pmean"
+                        + (" + adaptive tau pmean" if adaptive else ""),
+            expects_quantized=bool(intra_quantized))
     if mode in ("int8", "block_int8"):
         if n_eligible is None:
             return CollectiveContract(
@@ -778,7 +805,7 @@ def compression_contract(mode, n_leaves, n_eligible=None, axis="data"):
             expects_quantized=True)
     raise ValueError(
         f"unknown gradient_compression mode {mode!r}; pick one of "
-        "(None, 'int8', 'block_int8', 'threshold')")
+        "(None, 'int8', 'block_int8', 'threshold', 'hierarchical')")
 
 
 #: declared signatures of the distributed-linalg routines

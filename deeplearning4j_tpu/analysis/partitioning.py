@@ -120,7 +120,7 @@ class ShardingPlan:
                  weight_update="replicated",
                  weight_update_min_shard=2 ** 16,
                  gradient_compression=None, compression_block=None,
-                 encoding_capacity=None):
+                 encoding_capacity=None, compression_group=None):
         # axes the user wrote down themselves get strict PAR01 checking;
         # the canonical defaults adapt to whatever the mesh carries
         self.explicit_axes = set()
@@ -166,17 +166,26 @@ class ShardingPlan:
             raise ValueError(
                 "gradient_compression must be one of "
                 f"{COMPRESSION_MODES}, got {gradient_compression!r}")
-        if gradient_compression == "threshold" \
+        if gradient_compression in ("threshold", "hierarchical") \
                 and weight_update == "sharded":
             raise ValueError(
-                "gradient_compression='threshold' does not compose with "
-                "weight_update='sharded' (no per-parameter "
-                "reduce-scatter form); pick 'int8'/'block_int8' or the "
-                "replicated update — the runtime trainer enforces the "
+                f"gradient_compression={gradient_compression!r} does "
+                "not compose with weight_update='sharded' (no "
+                "per-parameter reduce-scatter form); pick "
+                "'int8'/'block_int8' or the replicated update — the "
+                "runtime trainer enforces the same rule")
+        if compression_group is not None \
+                and gradient_compression != "hierarchical":
+            raise ValueError(
+                f"compression_group given together with "
+                f"gradient_compression={gradient_compression!r}: the "
+                "node-group size only applies to the 'hierarchical' "
+                "2-hop exchange — the runtime trainer enforces the "
                 "same rule")
         self.gradient_compression = gradient_compression
         self.compression_block = compression_block
         self.encoding_capacity = encoding_capacity
+        self.compression_group = compression_group
 
     def spec_for(self, layer_key, pname, shape):
         """(spec tuple, explicit?) for one parameter."""
@@ -585,7 +594,9 @@ def _predict_hbm(report, conf, rows, axes, plan, batchSize, dataType,
         terms["grad_collective"] = compressed_wire_bytes(
             param_elems * 4, dp, plan.gradient_compression,
             block=plan.compression_block,
-            capacity=plan.encoding_capacity)
+            capacity=plan.encoding_capacity,
+            group_size=plan.compression_group
+            if plan.gradient_compression == "hierarchical" else None)
     return terms
 
 

@@ -8,6 +8,7 @@ meshes + XLA ICI collectives.
 
 from deeplearning4j_tpu.parallel.mesh import (
     build_mesh, data_parallel_mesh, DATA_AXIS, MODEL_AXIS, SEQ_AXIS, PIPE_AXIS,
+    GROUP_AXIS, INTRA_AXIS,
 )
 from deeplearning4j_tpu.parallel.trainer import (
     ParallelWrapper, SharedTrainingMaster, ParameterAveragingTrainingMaster,
@@ -18,6 +19,8 @@ from deeplearning4j_tpu.parallel.sharding import (
     ZeroShardedUpdate, ManualZeroUpdate, dp_weight_update_bytes,
     compressed_wire_bytes, compressed_hlo_collective_bytes,
     COMPRESSION_MODES, replicate_params, shard_params, spec_for_param,
+    DEFAULT_COMPRESSION_GROUP, default_compression_group,
+    hierarchical_grad_exchange, hierarchical_mesh, hierarchical_shard_elems,
 )
 from deeplearning4j_tpu.parallel.sequence import ring_attention, ulysses_attention
 from deeplearning4j_tpu.parallel.pipeline import PipelineParallel, partition_stages
@@ -43,7 +46,10 @@ __all__ = [
     "replicate_params", "spec_for_param", "ZeroShardedUpdate",
     "ManualZeroUpdate", "dp_weight_update_bytes",
     "compressed_wire_bytes", "compressed_hlo_collective_bytes",
-    "COMPRESSION_MODES", "ring_attention", "ulysses_attention",
+    "COMPRESSION_MODES", "GROUP_AXIS", "INTRA_AXIS",
+    "DEFAULT_COMPRESSION_GROUP", "default_compression_group",
+    "hierarchical_grad_exchange", "hierarchical_mesh",
+    "hierarchical_shard_elems", "ring_attention", "ulysses_attention",
     "PipelineParallel", "partition_stages",
     "initializeMultiHost", "hybrid_mesh", "is_coordinator", "num_hosts",
     "ParallelInference",

@@ -22,6 +22,13 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 PIPE_AXIS = "pipe"
+#: the 2-D factorization of a pure data-parallel mesh the hierarchical
+#: gradient exchange runs over: "group" ranges over node groups (the
+#: sparse leader hop), "intra" over the chips of one group (the dense/
+#: quantized reduce-scatter hop). intra is INNERMOST so one group's
+#: chips sit on contiguous (fastest-ICI) devices.
+GROUP_AXIS = "group"
+INTRA_AXIS = "intra"
 
 
 def build_mesh(axes=None, devices=None) -> Mesh:

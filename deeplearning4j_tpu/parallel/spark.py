@@ -90,6 +90,23 @@ class SharedTrainingMasterBuilder:
         self._kw["compressionBlock"] = int(b)
         return self
 
+    def compressionGroupSize(self, g):
+        """Node-group size of the hierarchical 2-hop exchange — selects
+        gradient_compression='hierarchical' (dense/block_int8
+        reduce-scatter inside each g-chip group, Strom threshold
+        exchange between group leaders). Must be a divisor of the
+        data-parallel degree in [2, dp/2] — at least 2 chips per group
+        AND at least 2 groups; the binding raises naming the
+        constraint otherwise (SharedTrainingMaster does the mapping)."""
+        self._kw["compressionGroupSize"] = int(g)
+        return self
+
+    def intraGroupCompression(self, mode):
+        """Hop-1 encoding inside the node group: 'block_int8' (default)
+        or None for the dense f32 reduce-scatter."""
+        self._kw["intraGroupCompression"] = mode
+        return self
+
     def weightUpdate(self, mode):
         """'replicated' or 'sharded' (ZeRO) — int8/block_int8 compose
         with 'sharded' via the compressed reduce-scatter."""

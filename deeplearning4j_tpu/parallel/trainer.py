@@ -133,10 +133,12 @@ class ParallelWrapper:
                  targetSparsity=None, weight_update="replicated",
                  min_shard_size=2 ** 16, encodingCapacity=None,
                  residualClip=None, residualClipFrequency=1,
-                 compressionBlock=None):
+                 compressionBlock=None, compressionGroupSize=None,
+                 intraGroupCompression="block_int8"):
         from deeplearning4j_tpu.parallel.sharding import (
             COMPRESSION_MODES, DEFAULT_COMPRESSION_BLOCK,
-            DEFAULT_ENCODING_CAPACITY,
+            DEFAULT_ENCODING_CAPACITY, default_compression_group,
+            hierarchical_mesh,
         )
 
         if getattr(net, "_solver", None) is not None:
@@ -151,7 +153,8 @@ class ParallelWrapper:
         self.batch_axis = batch_axis
         self.gradient_compression = gradient_compression
         self.threshold = float(threshold)
-        if gradient_compression == "threshold" and self.threshold <= 0:
+        if gradient_compression in ("threshold", "hierarchical") \
+                and self.threshold <= 0:
             raise ValueError(
                 f"threshold (tau) must be > 0, got {threshold}: the "
                 "Strom encoder transmits sign(g)*tau, so a non-positive "
@@ -203,17 +206,47 @@ class ParallelWrapper:
             raise ValueError(
                 "gradient_compression must be one of "
                 f"{COMPRESSION_MODES}, got {gradient_compression!r}")
+        if intraGroupCompression not in (None, "block_int8"):
+            raise ValueError(
+                "intraGroupCompression must be None (dense hop-1 "
+                "reduce-scatter) or 'block_int8', got "
+                f"{intraGroupCompression!r}")
+        self.intra_compression = intraGroupCompression
+        self._hmesh = None
+        self._n_groups = None
+        self.compression_group = None
+        if gradient_compression == "hierarchical":
+            dp = self.mesh.shape.get(self.batch_axis, 0)
+            gsz = default_compression_group(dp) \
+                if compressionGroupSize is None else int(compressionGroupSize)
+            # hierarchical_mesh does the loud validation (divisibility,
+            # 1-D pure-data mesh, g >= 2)
+            self._hmesh = hierarchical_mesh(
+                self.mesh, gsz, batch_axis=self.batch_axis)
+            self._n_groups = dp // gsz
+            self.compression_group = gsz
+            # ONE mesh everywhere in hierarchical mode: placements and
+            # the shard_map step must agree on the (group, intra) mesh,
+            # or every step would reshard through a mesh change
+            self._repl = NamedSharding(self._hmesh, P())
+        elif compressionGroupSize is not None:
+            raise ValueError(
+                f"compressionGroupSize given together with "
+                f"gradient_compression={gradient_compression!r}: the "
+                "node-group size only applies to the 'hierarchical' "
+                "2-hop exchange; drop one of the two arguments")
         if weight_update not in ("replicated", "sharded"):
             raise ValueError(
                 "weight_update must be 'replicated' or 'sharded', got "
                 f"{weight_update!r}")
         if weight_update == "sharded" \
-                and gradient_compression == "threshold":
+                and gradient_compression in ("threshold", "hierarchical"):
             raise ValueError(
                 "weight_update='sharded' composes with "
                 "gradient_compression None/'int8'/'block_int8' "
                 "(compressed reduce-scatter -> 1/dp shard update -> "
-                "all-gather), but not 'threshold': the Strom step's "
+                "all-gather), but not "
+                f"{gradient_compression!r}: the Strom exchange's "
                 "per-replica error-feedback residual transmits sparse "
                 "all-gathered messages, which have no per-parameter "
                 "reduce-scatter form. Pick 'int8'/'block_int8', or "
@@ -254,11 +287,18 @@ class ParallelWrapper:
     # ------------------------------------------------------------------
     def _shard_batch(self, arr):
         """Divisibility-checked batch placement (sharding.shard_batch:
-        rejects indivisible batches naming the axis, never pads)."""
+        rejects indivisible batches naming the axis, never pads).
+        Hierarchical mode shards over BOTH factor axes of the 2-D
+        (group, intra) mesh — same device order, same per-chip rows as
+        the flat data mesh, but placed on the mesh the step runs on."""
         from deeplearning4j_tpu.parallel.sharding import shard_batch
 
         if arr is None:
             return None
+        if self._hmesh is not None:
+            return shard_batch(
+                arr, self._hmesh,
+                batch_axis=(_mesh.GROUP_AXIS, _mesh.INTRA_AXIS))
         return shard_batch(arr, self.mesh, batch_axis=self.batch_axis)
 
     def _place_replicated(self):
@@ -274,6 +314,10 @@ class ParallelWrapper:
         if self.gradient_compression == "threshold":
             self._uninstall_sharded_update()
             self._pack_threshold_state()
+            return
+        if self.gradient_compression == "hierarchical":
+            self._uninstall_sharded_update()
+            self._pack_hier_state()
             return
         self._unpack_threshold_state()
         if self._zero is not None:
@@ -297,6 +341,8 @@ class ParallelWrapper:
         ef_sh = NamedSharding(self.mesh, P(self.batch_axis))
         if _is_packed(n._upd_states):
             pack = n._upd_states
+            self._check_carry_layout(
+                pack, lambda p: (ndev,) + p.shape, "threshold")
             upd = jax.device_put(pack["upd"], self._repl)
             ef = jax.device_put(pack["ef"], ef_sh)
             tau = jax.device_put(jnp.asarray(pack["tau"], jnp.float32),
@@ -314,6 +360,65 @@ class ParallelWrapper:
         # residual itself is saved separately (writeModel trainer_state
         # — see _ckpt_trainer_state) so a threshold-mode save still
         # restores into any mode
+        n._upd_state_unview = (
+            lambda packed: packed["upd"] if _is_packed(packed) else packed)
+
+    def _check_carry_layout(self, pack, expect_shape, mode):
+        """Refuse to re-place a packed {upd, ef, tau} carry whose
+        residual layout belongs to the OTHER sparse mode: flat threshold
+        carries per-replica full-shape residuals [dp, *p.shape],
+        hierarchical carries per-chip shard residuals [groups, group,
+        m]. Silently re-placing one as the other would device_put
+        garbage into the step."""
+        ef_leaves = jax.tree_util.tree_leaves(pack["ef"])
+        p_leaves = jax.tree_util.tree_leaves(self.net._params)
+        for e, p in zip(ef_leaves, p_leaves):
+            want = tuple(expect_shape(p))
+            if tuple(e.shape) != want:
+                raise ValueError(
+                    f"packed residual carry has leaf shape {tuple(e.shape)} "
+                    f"where gradient_compression={mode!r} expects {want}: "
+                    "the carry was packed by the other sparse mode "
+                    "(flat 'threshold' vs 'hierarchical' residual "
+                    "layouts are incompatible). Re-fit from a plain "
+                    "updater state, or restore a checkpoint taken in "
+                    "the same mode.")
+
+    def _pack_hier_state(self):
+        """Hierarchical-mode packed carry: same {'upd', 'ef', 'tau'}
+        shape as the flat threshold mode, but the error-feedback
+        residual lives where hop 2 encodes — the per-chip 1/group_size
+        shard of each (zero-padded) leaf, laid out [n_groups,
+        group_size, shard_elems] and sharded over BOTH mesh axes, so the
+        shard_map step sees exactly its local f32 residual row."""
+        from deeplearning4j_tpu.parallel.sharding import \
+            hierarchical_shard_elems
+
+        n = self.net
+        gsz, ng = self.compression_group, self._n_groups
+        ef_sh = NamedSharding(
+            self._hmesh, P(_mesh.GROUP_AXIS, _mesh.INTRA_AXIS))
+        if _is_packed(n._upd_states):
+            pack = n._upd_states
+            self._check_carry_layout(
+                pack,
+                lambda p: (ng, gsz, hierarchical_shard_elems(p.size, gsz)),
+                "hierarchical")
+            upd = jax.device_put(pack["upd"], self._repl)
+            ef = jax.device_put(pack["ef"], ef_sh)
+            tau = jax.device_put(jnp.asarray(pack["tau"], jnp.float32),
+                                 self._repl)
+        else:
+            upd = jax.device_put(n._upd_states, self._repl)
+            ef = jax.device_put(
+                jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(
+                        (ng, gsz, hierarchical_shard_elems(p.size, gsz)),
+                        jnp.float32),
+                    n._params), ef_sh)
+            tau = jax.device_put(jnp.asarray(self.threshold, jnp.float32),
+                                 self._repl)
+        n._upd_states = {"upd": upd, "ef": ef, "tau": tau}
         n._upd_state_unview = (
             lambda packed: packed["upd"] if _is_packed(packed) else packed)
 
@@ -345,10 +450,25 @@ class ParallelWrapper:
         n = self.net
         if not _is_packed(n._upd_states):
             raise ValueError(
-                "restoring threshold trainer state needs "
-                "gradient_compression='threshold' (the packed carry is "
-                "not installed)")
-        ef_sh = NamedSharding(self.mesh, P(self.batch_axis))
+                "restoring sparse-exchange trainer state needs "
+                "gradient_compression='threshold' or 'hierarchical' "
+                "(the packed carry is not installed)")
+        if self._hmesh is not None:
+            from deeplearning4j_tpu.parallel.sharding import \
+                hierarchical_shard_elems
+
+            gsz, ng = self.compression_group, self._n_groups
+            self._check_carry_layout(
+                state,
+                lambda p: (ng, gsz, hierarchical_shard_elems(p.size, gsz)),
+                "hierarchical")
+            ef_sh = NamedSharding(
+                self._hmesh, P(_mesh.GROUP_AXIS, _mesh.INTRA_AXIS))
+        else:
+            ndev = self.mesh.shape[self.batch_axis]
+            self._check_carry_layout(
+                state, lambda p: (ndev,) + p.shape, "threshold")
+            ef_sh = NamedSharding(self.mesh, P(self.batch_axis))
         n._upd_states = {
             "upd": n._upd_states["upd"],
             "ef": jax.device_put(state["ef"], ef_sh),
@@ -440,6 +560,8 @@ class ParallelWrapper:
                 f"tgt={self.targetSparsity},"
                 f"clip={self.residual_clip}"
                 f"@{self.residual_clip_frequency},"
+                f"grp={self.compression_group},"
+                f"imode={self.intra_compression},"
                 f"wu={self.weight_update}]")
 
     def _build_jit(self):
@@ -448,6 +570,8 @@ class ParallelWrapper:
             step = n._train_step
         elif self.gradient_compression == "threshold":
             step = self._threshold_step
+        elif self.gradient_compression == "hierarchical":
+            step = self._hierarchical_step
         else:
             step = self._compressed_step
         # params/opt/state replicated; batch args sharded over the data
@@ -636,6 +760,103 @@ class ParallelWrapper:
             check_vma=False,
         )(params, upd_states, states, iteration, x, y, key, fmask, lmask)
 
+    def _hierarchical_step(self, params, upd_states, states, iteration,
+                           x, y, key, fmask, lmask):
+        """Train step with the 2-hop hierarchical sparse exchange
+        (ROADMAP item 4): hop 1 is a dense-or-block_int8 psum_scatter
+        reduce over the INTRA axis (each chip ends up owning the group
+        sum of a 1/group_size shard), hop 2 is the fixed-capacity Strom
+        threshold exchange over the GROUP axis — every chip encodes its
+        shard's above-tau entries and all-gathers the (index, +-tau)
+        pairs with the n_groups-1 peer chips holding the SAME shard in
+        the other groups — then the dense mean shard is all-gathered
+        back over the intra axis. Error feedback lives on the per-chip
+        shard (where hop 2 truncates), so the carry {upd, ef, tau}
+        rides the donated updater-state slot exactly as the flat
+        threshold mode's does: one jitted executable, bitwise k-loop
+        and ResilientFit resume. Wire bytes scale with
+        capacity x n_groups (not capacity x dp) — bills in
+        parallel.sharding.compressed_wire_bytes."""
+        from deeplearning4j_tpu.parallel._compat import shard_map
+        from deeplearning4j_tpu.parallel.sharding import \
+            hierarchical_grad_exchange
+
+        n = self.net
+        hmesh = self._hmesh
+        gax, iax = _mesh.GROUP_AXIS, _mesh.INTRA_AXIS
+        gsz, ng = self.compression_group, self._n_groups
+        target = self.targetSparsity
+        capacity = self.encoding_capacity
+        clip, clip_freq = self.residual_clip, self.residual_clip_frequency
+        imode, blk = self.intra_compression, self.compression_block
+
+        def sync_states(states):
+            return jax.tree_util.tree_map(
+                lambda a: jax.lax.pmean(a, (gax, iax))
+                if jnp.issubdtype(a.dtype, jnp.inexact) else a, states)
+
+        def shard_step(params_r, pack, states_r, it_r, x_s, y_s,
+                       key_r, fm_s, lm_s):
+            upd_r, res_s, t = pack["upd"], pack["ef"], pack["tau"]
+            new_pack_cell = []
+
+            def encode_all(grads):
+                g_leaves, treedef = jax.tree_util.tree_flatten(grads)
+                r_leaves = jax.tree_util.tree_flatten(res_s)[0]
+                means, new_rs = [], []
+                sent = 0.0
+                total = 0
+                for g, r in zip(g_leaves, r_leaves):
+                    mean, res, nsent = hierarchical_grad_exchange(
+                        g, r[0, 0], t, group_size=gsz, n_groups=ng,
+                        capacity=capacity, group_axis=gax,
+                        intra_axis=iax, intra_mode=imode, block=blk)
+                    if clip is not None:
+                        lim = (clip * t).astype(res.dtype)
+                        clipped = jnp.clip(res, -lim, lim)
+                        res = jnp.where((it_r % clip_freq) == 0,
+                                        clipped, res) \
+                            if clip_freq > 1 else clipped
+                    means.append(mean)
+                    new_rs.append(res[None, None].astype(r.dtype))
+                    sent = sent + nsent
+                    total += res.size
+                if target is None:
+                    new_t = t
+                else:
+                    # adaptive tau tracks the mean TRANSMITTED fraction
+                    # of the per-chip shards (the quantity hop 2 pays
+                    # wire for), averaged over the whole 2-D mesh
+                    frac = jax.lax.pmean(sent / total, (gax, iax))
+                    new_t = jnp.where(
+                        frac > 1.25 * target, t * 1.1,
+                        jnp.where(frac < 0.8 * target, t / 1.1, t))
+                new_pack_cell.append(
+                    (jax.tree_util.tree_unflatten(treedef, new_rs),
+                     new_t.astype(jnp.float32)))
+                return jax.tree_util.tree_unflatten(treedef, means)
+
+            p, u, s, loss = n._train_step(
+                params_r, upd_r, states_r, it_r, x_s, y_s, key_r, fm_s, lm_s,
+                grad_transform=encode_all,
+                loss_transform=lambda l: jax.lax.pmean(l, (gax, iax)),
+                state_transform=sync_states)
+            new_res, new_t = new_pack_cell[0]
+            return p, {"upd": u, "ef": new_res, "tau": new_t}, s, loss
+
+        spec_b = P((gax, iax))
+        ef_specs = jax.tree_util.tree_map(lambda _: P(gax, iax),
+                                          self.net._upd_states["ef"])
+        pack_specs = {"upd": P(), "ef": ef_specs, "tau": P()}
+        return shard_map(
+            shard_step, mesh=hmesh,
+            in_specs=(P(), pack_specs, P(), P(), spec_b, spec_b, P(),
+                      spec_b if fmask is not None else P(),
+                      spec_b if lmask is not None else P()),
+            out_specs=(P(), pack_specs, P(), P()),
+            check_vma=False,
+        )(params, upd_states, states, iteration, x, y, key, fmask, lmask)
+
     # ------------------------------------------------------------------
     def fit(self, data, labels=None, epochs=None):
         from deeplearning4j_tpu.data.dataset import DataSet
@@ -750,6 +971,10 @@ class ParallelWrapper:
             stack_fn = stack_datasets
 
         def place(staged):
+            if self._hmesh is not None:
+                return shard_batch_stack(
+                    staged, self._hmesh,
+                    (_mesh.GROUP_AXIS, _mesh.INTRA_AXIS))
             return shard_batch_stack(staged, self.mesh, self.batch_axis)
 
         self._fit_dataset_syncs = 0
@@ -820,6 +1045,8 @@ class ParallelWrapper:
             return self.net._train_step
         if self.gradient_compression == "threshold":
             return self._threshold_step
+        if self.gradient_compression == "hierarchical":
+            return self._hierarchical_step
         return self._compressed_step
 
     def averagingFrequency(self, *_):
@@ -850,18 +1077,44 @@ class SharedTrainingMaster(ParallelWrapper):
     the initial tau plus targetSparsity (the adaptive loop);
     ``residualPostProcessor=ResidualClippingPostProcessor(...)`` wires
     residual clipping. Unknown algorithm objects raise naming the
-    supported set."""
+    supported set.
+
+    ``compressionGroupSize=g`` selects the hierarchical 2-hop exchange
+    (``gradient_compression="hierarchical"``) with node groups of g
+    chips: dense/block_int8 reduce-scatter inside each group, Strom
+    threshold exchange between group leaders — wire bytes scale with
+    capacity x n_groups instead of capacity x dp (see
+    ParallelWrapper._hierarchical_step). Composes with
+    thresholdAlgorithm / residualPostProcessor, which configure the
+    leader hop's encoder."""
 
     def __init__(self, net, mesh=None, thresholdAlgorithm=None,
-                 residualPostProcessor=None, **kw):
+                 residualPostProcessor=None, compressionGroupSize=None,
+                 **kw):
+        if compressionGroupSize is not None:
+            # process FIRST so a bare compressionGroupSize= selects the
+            # hierarchical mode before the threshold-algorithm mapping
+            # defaults gradient_compression (the algorithm then
+            # configures hop 2's tau, which IS the Strom encoder)
+            gc = kw.get("gradient_compression", "hierarchical")
+            if gc != "hierarchical":
+                raise ValueError(
+                    f"compressionGroupSize given together with "
+                    f"gradient_compression={gc!r}: the node-group size "
+                    "only applies to the 'hierarchical' 2-hop exchange; "
+                    "drop one of the two arguments")
+            kw.setdefault("gradient_compression", "hierarchical")
+            kw["compressionGroupSize"] = compressionGroupSize
         if thresholdAlgorithm is not None:
             gc = kw.get("gradient_compression", "threshold")
-            if gc != "threshold":
+            if gc not in ("threshold", "hierarchical"):
                 raise ValueError(
                     f"thresholdAlgorithm given together with "
                     f"gradient_compression={gc!r}: the threshold algorithm "
-                    "only applies to the 'threshold' (Strom-2015) encoding; "
-                    "drop one of the two arguments")
+                    "only applies to the 'threshold' (Strom-2015) encoding "
+                    "or the 'hierarchical' 2-hop exchange (whose leader "
+                    "hop is the same encoder); drop one of the two "
+                    "arguments")
             kw.setdefault("gradient_compression", "threshold")
             algo = thresholdAlgorithm
             if isinstance(algo, (int, float)) \
@@ -881,12 +1134,12 @@ class SharedTrainingMaster(ParallelWrapper):
                     f"pass a number (fixed tau) or one of {names}")
         if residualPostProcessor is not None:
             if kw.get("gradient_compression",
-                      "threshold") != "threshold" \
+                      "threshold") not in ("threshold", "hierarchical") \
                     and thresholdAlgorithm is None:
                 raise ValueError(
                     "residualPostProcessor only applies to the "
-                    "'threshold' encoding (there is no residual "
-                    "elsewhere)")
+                    "'threshold' and 'hierarchical' encodings (there "
+                    "is no residual elsewhere)")
             rpp = residualPostProcessor
             if not isinstance(rpp, ResidualClippingPostProcessor):
                 raise ValueError(

@@ -82,6 +82,24 @@ def _fixed_seed():
     yield
 
 
+def drop_jax_caches_fixture():
+    """Factory for the module-teardown cache-drop hygiene fixture the
+    trace-heavy modules install (`_drop_jax_caches_after_module =
+    drop_jax_caches_fixture()` at module scope). Such modules churn many
+    tiny single-use executables (interpret-mode pallas kernels, paged
+    step twins); left in jax's global caches they stay live for the rest
+    of the tier-1 process and starve the big zoo fits that run last —
+    PR 19's full-suite YOLO2 segfault. One shared definition so the next
+    trace-heavy module can't reintroduce it with a drifted copy."""
+
+    @pytest.fixture(autouse=True, scope="module")
+    def _drop_jax_caches_after_module():
+        yield
+        jax.clear_caches()
+
+    return _drop_jax_caches_after_module
+
+
 # ----------------------------------------------------------------------
 # session-scoped compiled subjects: the attribution/bytes-gate tests all
 # interrogate the SAME canonical train-step compiles (LeNet b64 and the

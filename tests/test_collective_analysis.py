@@ -488,6 +488,8 @@ def compressed_subjects(dmesh):
         ("threshold", "threshold", {"threshold": 1e-2}),
         ("block_int8+zero", "block_int8",
          {"weight_update": "sharded", "min_shard_size": 64}),
+        ("hierarchical", "hierarchical",
+         {"threshold": 1e-2, "compressionGroupSize": 4}),
     )
     out = {}
     with CompileWatch() as watch:
@@ -537,6 +539,48 @@ class TestTrainerContracts:
         c = colan.compression_contract("block_int8", sub["n_leaves"],
                                        n_eligible=sub["n_eligible"])
         rep = c.check(sub["signature"])
+        assert rep.ok, rep.format()
+
+    def test_hierarchical_matches_declared_contract(
+            self, compressed_subjects):
+        """COL04 over the 2-hop hierarchical step (the tier-1 gate the
+        tentpole adds): the declared two-hop signature — per leaf one
+        hop-1 reduce_scatter, three all_gathers (hop-2 idx + value,
+        hop-3 fan-back), one scale pmax, plus the single loss pmean —
+        must match the traced step EXACTLY, per-hop counts and axes."""
+        sub = compressed_subjects["hierarchical"]
+        L = sub["n_leaves"]
+        c = colan.compression_contract("hierarchical", L)
+        rep = c.check(sub["signature"])
+        assert rep.ok, rep.format()
+        # exact per-hop counts, asserted directly so a miscounted
+        # contract cannot mask a miscounted program
+        counts = sub["signature"].counts()
+        assert counts["reduce_scatter"] == L          # hop 1 per leaf
+        assert counts["all_gather"] == 3 * L          # hop 2 (x2) + hop 3
+        assert counts["pmax"] == L                    # hop-1 scale sync
+        assert counts["psum"] == 1                    # the loss pmean
+        # the two hops ride DIFFERENT axes of the 2-D mesh
+        hop1_axes = {ax for s in sub["signature"]
+                     if s.prim in ("reduce_scatter", "psum_scatter")
+                     for ax in s.axes}
+        gather_axes = {ax for s in sub["signature"]
+                       if s.prim == "all_gather" for ax in s.axes}
+        assert hop1_axes == {"intra"}
+        assert gather_axes == {"group", "intra"}
+
+    def test_hierarchical_full_verify_clean(self, compressed_subjects):
+        """One-stop COL01/02/03/06 + contract over the hierarchical
+        step. dp is the GROUP size: the hop-1 integer sum spans only the
+        group's lanes, so the COL03 accumulator-dtype rule keys off
+        group_size, not the full data-parallel degree."""
+        sub = compressed_subjects["hierarchical"]
+        pw = sub["pw"]
+        rep = colan.verify_program(
+            pw.trainStep(), *sub["args"], mesh=pw._hmesh,
+            dp=pw.compression_group,
+            contract=colan.compression_contract(
+                "hierarchical", sub["n_leaves"]))
         assert rep.ok, rep.format()
 
     def test_full_verify_clean_per_mode(self, compressed_subjects,

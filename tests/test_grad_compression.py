@@ -128,6 +128,61 @@ class TestThresholdEncoder:
         d = np.asarray(dense)
         assert d[1] == -0.25 and d[3] == 0.25 and d[4] == 0.0
 
+    def test_degenerate_tiny_leaf_cap_rounds_to_one(self):
+        """n < 1/capacity: the cap rounds UP to one pair (never 0 — a
+        leaf must always be able to drain). The hierarchical leader hop
+        hits this shape routinely: a bias leaf split into group_size
+        shards can leave each chip with a handful of elements."""
+        for n in (1, 2, 3, 7):
+            flat = jnp.asarray(np.full(n, 0.5, np.float32))
+            cap = threshold_cap(n, 0.125)
+            assert cap == 1
+            idx, val, dense, res = threshold_encode_fixed(
+                flat, jnp.float32(0.25), cap)
+            assert idx.shape == (1,) and val.shape == (1,)
+            # exactly one +-tau transmits; the rest stays residual
+            assert np.sum(np.abs(np.asarray(dense)) > 0) == 1
+            np.testing.assert_allclose(np.asarray(dense + res),
+                                       np.asarray(flat), rtol=2e-7)
+
+    def test_degenerate_all_zero_leaf(self):
+        """An all-zero gradient leaf (frozen layer, padded shard tail)
+        transmits NOTHING — the fixed-capacity slots fill with value 0,
+        the scatter-add is a no-op, and the residual stays zero. The
+        hierarchical mode's zero-padding of leaves to a group_size
+        multiple depends on exactly this."""
+        for n in (1, 8, 100):
+            flat = jnp.zeros(n, jnp.float32)
+            cap = threshold_cap(n, 0.125)
+            idx, val, dense, res = threshold_encode_fixed(
+                flat, jnp.float32(1e-3), cap)
+            assert np.all(np.asarray(val) == 0)
+            assert np.all(np.asarray(dense) == 0)
+            assert np.all(np.asarray(res) == 0)
+            # indices stay in range so the scatter-add is well-defined
+            assert np.all((np.asarray(idx) >= 0)
+                          & (np.asarray(idx) < n))
+
+    def test_degenerate_leaf_at_min_shard_size(self):
+        """A leaf of exactly min_shard_size (2**16) elements — the ZeRO
+        eligibility boundary, and a realistic per-chip shard under the
+        hierarchical exchange — encodes with a full-size static cap and
+        reconstructs to 1 ulp."""
+        n = 2 ** 16
+        rng = np.random.RandomState(7)
+        flat = jnp.asarray(rng.randn(n).astype("float32"))
+        cap = threshold_cap(n, 0.125)
+        assert cap == n // 8
+        idx, val, dense, res = threshold_encode_fixed(
+            flat, jnp.float32(0.5), cap)
+        assert idx.shape == (cap,)
+        np.testing.assert_allclose(np.asarray(dense + res),
+                                   np.asarray(flat), rtol=2e-7, atol=0)
+        sent = np.asarray(dense)
+        nz = np.flatnonzero(sent)
+        assert len(nz) <= cap
+        assert np.all(np.abs(np.asarray(flat))[nz] >= 0.5)
+
     def test_drain_reconstructs_dense_sum_exactly(self):
         """Synthetic drain (the acceptance gate): a constant gradient g
         with power-of-two-representable entries and tau=0.5 keeps every
@@ -490,8 +545,43 @@ class TestCompressedBills:
         # ring-gathered to 7 peers
         rec = compressed_wire_bytes(4000, 8, "threshold")
         assert rec["wire_bytes"] == 7 * 125 * 5 == 4375
+        # hierarchical dp8, group 4 (2 groups), block_int8 hop 1:
+        #   hop1 (int8 RS)     = 3*(1000 + 4*ceil(1000/256))//4 = 762
+        #   hop3 (f32 gather)  = 3*1000*4//4                    = 3000
+        #   leader (Strom)     = (2-1)*ceil(250*0.125)*5        = 160
+        rec = compressed_wire_bytes(4000, 8, "hierarchical",
+                                    group_size=4)
+        assert rec["intra_wire_bytes"] == 762 + 3000
+        assert rec["leader_wire_bytes"] == 160
+        assert rec["wire_bytes"] == 3922
+        assert rec["groups"] == 2
+        assert rec["flat_threshold_wire_bytes"] == 4375
         with pytest.raises(ValueError, match="gradient_compression"):
             compressed_wire_bytes(4000, 8, "sparse")
+        with pytest.raises(ValueError, match="divisor"):
+            compressed_wire_bytes(4000, 8, "hierarchical", group_size=3)
+        with pytest.raises(ValueError, match="hierarchical"):
+            compressed_wire_bytes(4000, 8, "threshold", group_size=4)
+
+    def test_wire_hierarchical_crosses_past_dp128(self):
+        """The tentpole's analytic crossover (the reason this mode
+        exists): at dp128 the flat threshold wire is ~10x dense, while
+        the 2-hop form undercuts BOTH — wire scales with
+        capacity x groups, not capacity x dp."""
+        rec = compressed_wire_bytes(4000, 128, "hierarchical",
+                                    group_size=8)
+        flat = compressed_wire_bytes(4000, 128, "threshold")
+        assert rec["wire_bytes"] < flat["wire_bytes"]
+        assert rec["wire_bytes"] < rec["dense_wire_bytes"]
+        assert rec["vs_flat_threshold"] < 0.10
+        # when it loses (documented note, PARALLEL.md): at small dp
+        # with a SPARSE capacity the near-dense intra hops dominate and
+        # flat threshold wins outright
+        small = compressed_wire_bytes(4000, 8, "hierarchical",
+                                      group_size=4, capacity=0.01)
+        small_flat = compressed_wire_bytes(4000, 8, "threshold",
+                                           capacity=0.01)
+        assert small["wire_bytes"] > small_flat["wire_bytes"]
 
     def test_dp_weight_update_bytes_compression(self):
         G = 1000 * 4

@@ -36,13 +36,11 @@ def _interpret(monkeypatch):
     monkeypatch.setattr(pa, "_INTERPRET", True)
 
 
-@pytest.fixture(autouse=True, scope="module")
-def _drop_jax_caches_after_module():
-    # Interpret-mode pallas churns many tiny single-use executables;
-    # left in jax's global caches they stay live for the rest of the
-    # tier-1 process and starve the big zoo fits that run last.
-    yield
-    jax.clear_caches()
+# interpret-mode pallas churns many tiny single-use executables; the
+# shared hygiene fixture drops jax's global caches at module teardown
+from conftest import drop_jax_caches_fixture
+
+_drop_jax_caches_after_module = drop_jax_caches_fixture()
 
 
 # ----------------------------------------------------------------------

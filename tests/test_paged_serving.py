@@ -46,13 +46,11 @@ from deeplearning4j_tpu.serving import (
 )
 
 
-@pytest.fixture(autouse=True, scope="module")
-def _drop_jax_caches_after_module():
-    # This module traces many model/bucket step twins; left in jax's
-    # global caches they stay live for the rest of the tier-1 process
-    # and starve the big zoo fits that run last.
-    yield
-    jax.clear_caches()
+# this module traces many model/bucket step twins; the shared hygiene
+# fixture drops jax's global caches at module teardown
+from conftest import drop_jax_caches_fixture
+
+_drop_jax_caches_after_module = drop_jax_caches_fixture()
 
 
 @pytest.fixture
